@@ -1,0 +1,80 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Brand-new framework with the capability surface of PaddlePaddle
+(reference studied in SURVEY.md), built from scratch on JAX/XLA/Pallas:
+- dygraph-feel eager API backed by an autograd tape over jax.vjp
+  (works eagerly AND under jit-trace; see base/tape.py)
+- ops lower to jnp/lax (XLA fuses; MXU for matmuls), Pallas for hot
+  fused kernels (flash attention, rms_norm, adamw)
+- hybrid parallelism over jax.sharding meshes (dp/sharding/tp/pp/sep/ep)
+- distributed checkpoint, elastic launch, profiler, AMP, DataLoader.
+
+Top-level namespace mirrors paddle.* (~ref: python/paddle/__init__.py).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# -- base ---------------------------------------------------------------
+from .base import dtype as _dtype_mod
+from .base.dtype import (  # noqa: F401
+    bfloat16,
+    bool_ as bool,  # noqa: A001
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    float8_e4m3fn,
+    float8_e5m2,
+    get_default_dtype,
+    iinfo,
+    finfo,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .base.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .base.flags import get_flags, set_flags  # noqa: F401
+from .base.random import get_rng_state, seed, set_rng_state  # noqa: F401
+from .base.tensor import Tensor, to_tensor  # noqa: F401
+from .base.tape import no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+
+# -- tensor ops into the top namespace (paddle.* style) -----------------
+from .tensor import *  # noqa: F401,F403
+from .tensor import einsum  # noqa: F401
+
+# -- subpackages --------------------------------------------------------
+from . import autograd  # noqa: F401
+
+from .autograd import grad  # noqa: F401
+
+
+def disable_static(place=None):
+    """Dygraph is the only eager mode here; kept for parity."""
+
+
+def enable_static():
+    raise RuntimeError(
+        "paddle_tpu has no ProgramDesc static mode; use paddle_tpu.jit.to_static "
+        "(jax.jit tracing) for compiled execution."
+    )
+
+
+def in_dynamic_mode() -> bool:
+    return True
+
+
+def is_grad_enabled_():  # legacy alias
+    return is_grad_enabled()

@@ -1,0 +1,113 @@
+"""paddle_tpu.autograd (ref: python/paddle/autograd/).
+
+backward / grad over the eager tape; PyLayer for custom VJPs;
+saved_tensors_hooks; functional jacobian/hessian via jax transforms.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from ..base import tape as _tape
+from ..base.tape import (  # noqa: F401
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from ..base.tensor import Tensor
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401
+
+
+def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity (ref: python/paddle/autograd/autograd.py)."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is not None and isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    _tape.run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(
+    outputs: Union[Tensor, Sequence[Tensor]],
+    inputs: Union[Tensor, Sequence[Tensor]],
+    grad_outputs=None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    only_inputs: bool = True,
+    allow_unused: bool = False,
+    no_grad_vars=None,
+) -> List[Optional[Tensor]]:
+    """paddle.grad parity (ref: python/paddle/base/dygraph/base.py grad)."""
+    single = isinstance(outputs, Tensor)
+    outputs = [outputs] if single else list(outputs)
+    inputs_list = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    grads = _tape.run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=retain_graph,
+        inputs=inputs_list,
+        create_graph=create_graph,
+    )
+    if not allow_unused:
+        for g, i in zip(grads, inputs_list):
+            if g is None:
+                raise RuntimeError(
+                    f"One of the differentiated tensors ({i.name}) appears unused in "
+                    "the graph; pass allow_unused=True to return None for it."
+                )
+    return grads
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Functional jacobian via double-vjp over the tape (dense)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if isinstance(xs, Tensor):
+        xs = [xs]
+    single_y = isinstance(ys, Tensor)
+    ys_list = [ys] if single_y else list(ys)
+    jac_rows = []
+    for y in ys_list:
+        flat_n = int(np.prod(y.shape)) if y.shape else 1
+        rows = []
+        for k in range(flat_n):
+            seed = jnp.zeros((flat_n,), y._data.dtype).at[k].set(1.0).reshape(y._data.shape)
+            gs = _tape.run_backward(
+                [y], [Tensor(seed, _internal=True)], retain_graph=True, inputs=xs
+            )
+            rows.append([None if g is None else g._data.reshape(-1) for g in gs])
+        per_x = []
+        for xi in range(len(xs)):
+            mat = jnp.stack([rows[k][xi] for k in range(flat_n)])
+            per_x.append(Tensor(mat.reshape(tuple(y.shape) + tuple(xs[xi].shape)), _internal=True))
+        jac_rows.append(per_x if len(per_x) > 1 else per_x[0])
+    return jac_rows[0] if single_y else jac_rows
+
+
+def hessian(ys, xs, batch_axis=None):
+    import jax
+    import jax.numpy as jnp
+
+    if not isinstance(ys, Tensor) or ys.size != 1:
+        raise ValueError("hessian expects a scalar output")
+    if isinstance(xs, Tensor):
+        single = True
+        xs = [xs]
+    else:
+        single = False
+    (g,) = (
+        grad(ys, xs[0:1], create_graph=True)
+        if len(xs) == 1
+        else (None,)
+    )
+    if len(xs) != 1:
+        raise NotImplementedError("multi-input hessian: call per input")
+    h = jacobian(g, xs[0])
+    return h if single else [h]

@@ -1,0 +1,123 @@
+"""PyLayer — user-defined autograd functions.
+
+ref: python/paddle/autograd/py_layer.py:270 + C++ fluid/eager/pylayer/.
+The forward runs like any other op; a TapeNode is created whose vjp calls
+the user's ``backward`` staticmethod. Because the tape also runs under
+jit-trace, user PyLayers are jit-compatible as long as their bodies are.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from jax import tree_util
+
+from ..base import tape as _tape
+from ..base.tensor import Tensor
+
+
+class PyLayerContext:
+    """ctx object handed to forward/backward (ref: py_layer.py PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    # paddle exposes both names
+    saved_tensors = saved_tensor
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+
+        # run forward with grad disabled: the node we record IS the grad fn
+        with _tape.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+
+        single = isinstance(outs, Tensor)
+        out_list = [outs] if single else list(outs if isinstance(outs, (list, tuple)) else [outs])
+
+        tensor_inputs = [
+            a for a in tree_util.tree_leaves((args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+            if isinstance(a, Tensor)
+        ]
+        requires = _tape.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+        if not requires:
+            return outs
+
+        out_avals = [(tuple(t.shape), t.dtype) for t in out_list]
+        _, out_treedef = tree_util.tree_flatten([0] * len(out_list))
+
+        diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+
+        def vjp_fn(cotangents):
+            cot_tensors = [
+                c if isinstance(c, Tensor) else Tensor(c, _internal=True)
+                for c in cotangents
+            ]
+            with _tape.no_grad():
+                gin = cls.backward(ctx, *cot_tensors)
+            gin = [gin] if isinstance(gin, Tensor) or gin is None else list(gin)
+            # align returned grads with *all* tensor inputs, then filter to diff
+            if len(gin) == len(tensor_inputs):
+                aligned = gin
+            elif len(gin) == len(diff_inputs):
+                aligned = []
+                it = iter(gin)
+                for t in tensor_inputs:
+                    aligned.append(next(it) if not t.stop_gradient else None)
+            else:
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(gin)} grads for "
+                    f"{len(tensor_inputs)} tensor inputs"
+                )
+            import jax.numpy as jnp
+
+            out = []
+            for t, g in zip(tensor_inputs, aligned):
+                if t.stop_gradient:
+                    continue
+                if g is None:
+                    # zero-fill: None is not a pytree leaf, so it would
+                    # misalign with node.inputs downstream
+                    out.append(jnp.zeros(tuple(t.shape), t.dtype))
+                else:
+                    out.append(g._data if isinstance(g, Tensor) else g)
+            return tuple(out)
+
+        node = _tape.TapeNode(
+            vjp_fn, tuple(diff_inputs), out_avals, out_treedef, name=cls.__name__
+        )
+        for i, t in enumerate(out_list):
+            t.stop_gradient = False
+            t._grad_node = node
+            t._out_index = i
+        return outs
+
+
+class LegacyPyLayer(PyLayer):
+    pass

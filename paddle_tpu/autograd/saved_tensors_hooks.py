@@ -1,0 +1,38 @@
+"""saved_tensors_hooks (ref: python/paddle/autograd/saved_tensors_hooks.py).
+
+In the reference this intercepts TensorWrapper save/restore (used by
+reentrant-free recompute). Here residuals are captured inside jax.vjp
+closures, so pack/unpack hooks are applied at the Tensor level by the
+recompute machinery; this context manager exposes the same API surface
+and is honored by paddle_tpu.distributed.fleet.recompute.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class _HookState(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_state = _HookState()
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _state.stack.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+        return False
+
+
+def current_hooks():
+    return _state.stack[-1] if _state.stack else None

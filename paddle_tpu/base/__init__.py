@@ -1,0 +1,16 @@
+from . import dtype, device, flags, random  # noqa: F401
+from .dtype import (  # noqa: F401
+    convert_dtype,
+    get_default_dtype,
+    set_default_dtype,
+)
+from .device import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    get_device,
+    set_device,
+)
+from .flags import get_flags, set_flags  # noqa: F401
+from .random import Generator, get_rng_state_tracker, seed  # noqa: F401

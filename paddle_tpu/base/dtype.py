@@ -1,0 +1,122 @@
+"""Data types for paddle_tpu.
+
+TPU-native dtype surface. The reference exposes dtypes both as
+``paddle.float32``-style singletons and ``'float32'`` strings
+(ref: /root/reference/python/paddle/framework/dtype.py). Here dtypes ARE
+numpy/jax dtypes — everything in the framework accepts a string, a numpy
+dtype, a jnp scalar type, or these aliases interchangeably.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes  # shipped with jax
+
+# Canonical dtype singletons (np.dtype instances).
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_ALIASES = {
+    "bool": bool_,
+    "paddle.bool": bool_,
+    "bfloat16": bfloat16,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+_FLOAT_DTYPES = (float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2)
+_INT_DTYPES = (uint8, int8, int16, int32, int64)
+_COMPLEX_DTYPES = (complex64, complex128)
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any dtype spec (str / np / jnp / paddle-style) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, np.dtype):
+        return dtype
+    if isinstance(dtype, str):
+        name = dtype.replace("paddle.", "")
+        if name in _ALIASES:
+            return _ALIASES[name]
+        return np.dtype(name)
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return convert_dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOAT_DTYPES
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in _INT_DTYPES or d == bool_
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in _COMPLEX_DTYPES
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return jnp.iinfo(convert_dtype(dtype))
+
+
+_DEFAULT_DTYPE = [float32]
+
+
+def set_default_dtype(d):
+    """ref: python/paddle/framework/framework.py set_default_dtype."""
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(
+            f"set_default_dtype only supports float16/bfloat16/float32/float64, got {d}"
+        )
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype() -> np.dtype:
+    return _DEFAULT_DTYPE[0]
+
+
+def promote_types(a, b):
+    return jnp.promote_types(convert_dtype(a), convert_dtype(b))
+
+
+def canonical_dtype(dtype) -> np.dtype:
+    """Map a requested dtype to what the backend can hold: without
+    jax_enable_x64, 64-bit ints/floats canonicalize to 32-bit (paddle's
+    int64 defaults stay API-compatible; storage is int32 on TPU)."""
+    import jax
+
+    d = convert_dtype(dtype)
+    if not jax.config.jax_enable_x64:
+        if d == int64:
+            return int32
+        if d == float64:
+            return float32
+        if d == complex128:
+            return complex64
+    return d
+
+
+def canonical_int() -> np.dtype:
+    return canonical_dtype(int64)

@@ -1,0 +1,335 @@
+"""The Tensor facade.
+
+TPU-native counterpart of the reference's eager Tensor
+(ref: paddle/fluid/pybind/eager.cc + python/paddle/base/dygraph/
+tensor_patch_methods.py). Wraps an immutable ``jax.Array`` plus autograd
+metadata (``stop_gradient``, ``.grad``, tape edge). "In-place" methods
+rebind the underlying array — sound because saved vjp residuals hold the
+old immutable value, which eliminates the reference's tensor version
+counter machinery (TensorWrapper, ref: fluid/eager/tensor_wrapper.h).
+
+Registered as a jax pytree node so Tensors flow through jit/shard_map
+boundaries (paddle_tpu.jit functionalization relies on this).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import tree_util
+
+from . import dtype as dtypes
+from . import tape as _tape
+from .device import Place, get_place
+
+_tensor_counter = itertools.count()
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_out_index",
+        "_grad_hooks",
+        "_retain_grads",
+        "name",
+        "persistable",
+        "_dist_attr",
+        "__weakref__",
+    )
+
+    def __init__(
+        self,
+        data: Any = None,
+        dtype=None,
+        place: Optional[Place] = None,
+        stop_gradient: bool = True,
+        name: Optional[str] = None,
+        persistable: bool = False,
+        _internal: bool = False,
+    ):
+        if isinstance(data, Tensor):
+            data = data._data
+        if data is None:
+            data = jnp.zeros((), dtypes.get_default_dtype())
+        if not _internal or not isinstance(data, (jax.Array, np.ndarray)):
+            dt = dtypes.canonical_dtype(dtype) if dtype is not None else None
+            if dt is None and isinstance(data, (float,)):
+                dt = dtypes.get_default_dtype()
+            if dt is None and isinstance(data, (list, tuple)):
+                probe = np.asarray(data)
+                if probe.dtype == np.float64:
+                    dt = dtypes.get_default_dtype()
+            data = jnp.asarray(data, dtype=dt)
+        elif dtype is not None:
+            dt = dtypes.canonical_dtype(dtype)
+            if np.result_type(data) != dt:
+                data = jnp.asarray(data, dtype=dt)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self._grad_hooks = []
+        self._retain_grads = False
+        self.name = name or f"tensor_{next(_tensor_counter)}"
+        self.persistable = persistable
+        self._dist_attr = None
+
+    # ------------------------------------------------------------------
+    # metadata
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    dim = lambda self: self._data.ndim  # noqa: E731 paddle method form
+    rank = lambda self: self._data.ndim  # noqa: E731
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    def numel(self) -> int:
+        return self.size
+
+    @property
+    def place(self) -> Place:
+        return get_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    def is_dist(self) -> bool:
+        return self._dist_attr is not None
+
+    @property
+    def dist_attr(self):
+        return self._dist_attr
+
+    # ------------------------------------------------------------------
+    # autograd surface
+    # ------------------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        if value is not None and not isinstance(value, Tensor):
+            value = Tensor(value)
+        self._grad = value
+
+    def backward(self, grad_tensor: Optional["Tensor"] = None, retain_graph: bool = False):
+        _tape.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._data, stop_gradient=True, _internal=True)
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook):
+        """Grad hook (ref: tensor_patch_methods.py register_hook). Returns a
+        removable handle."""
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                if hook in self._grad_hooks:
+                    self._grad_hooks.remove(hook)
+
+        return _Handle()
+
+    # ------------------------------------------------------------------
+    # conversion
+    # ------------------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._data
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # ------------------------------------------------------------------
+    # device / dtype movement
+    # ------------------------------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        from . import tape
+
+        dt = dtypes.canonical_dtype(dtype)
+        return tape.apply(lambda x: x.astype(dt), self, op_name="cast")
+
+    cast = astype
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        """tensor.to(dtype) / to(device) / to(device, dtype) parity."""
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, np.dtype, type)):
+                try:
+                    dtype = dtypes.convert_dtype(a)
+                    continue
+                except TypeError:
+                    pass  # it's a device string
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    def cpu(self) -> "Tensor":
+        return Tensor(np.asarray(self._data), stop_gradient=self.stop_gradient)
+
+    def tpu(self) -> "Tensor":
+        return self
+
+    cuda = tpu  # parity shim
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    def clone(self) -> "Tensor":
+        from . import tape
+
+        return tape.apply(lambda x: x + 0, self, op_name="clone")
+
+    def contiguous(self) -> "Tensor":
+        return self
+
+    def is_contiguous(self) -> bool:
+        return True
+
+    # ------------------------------------------------------------------
+    # in-place helpers
+    # ------------------------------------------------------------------
+    def _inplace_from(self, result: "Tensor") -> "Tensor":
+        """Adopt result's value+tape edge (functional in-place).
+
+        If the producing node lists *this* object among its inputs (e.g.
+        ``y += 1``), swap that edge to a snapshot of the pre-update tensor
+        — otherwise rebinding our _grad_node would create a self-loop.
+        """
+        node = result._grad_node
+        if node is not None and any(inp is self for inp in node.inputs):
+            snapshot = Tensor(self._data, stop_gradient=self.stop_gradient, _internal=True)
+            snapshot._grad_node = self._grad_node
+            snapshot._out_index = self._out_index
+            node.inputs = tuple(
+                snapshot if inp is self else inp for inp in node.inputs
+            )
+        self._data = result._data
+        self._grad_node = result._grad_node
+        self._out_index = result._out_index
+        self.stop_gradient = result.stop_gradient and self.stop_gradient
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = jnp.asarray(value, dtype=self._data.dtype)
+        return self
+
+    def copy_(self, other, *_):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    # ------------------------------------------------------------------
+    # misc
+    # ------------------------------------------------------------------
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            val = np.asarray(self._data)
+            body = np.array2string(val, precision=6, suppress_small=True, threshold=64)
+        except Exception:
+            body = f"<traced {self._data}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_info},\n"
+            f"       {body})"
+        )
+
+    __str__ = __repr__
+
+
+# ---------------------------------------------------------------------------
+# pytree registration: Tensors flow through jax.jit / shard_map / tree_map.
+# aux carries stop_gradient so round-tripping preserves trainability.
+# ---------------------------------------------------------------------------
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._data,), (t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    stop_gradient, name = aux
+    out = Tensor(children[0], stop_gradient=stop_gradient, name=name, _internal=True)
+    return out
+
+
+tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor parity (ref: python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor) and dtype is None:
+        t = Tensor(data._data, stop_gradient=stop_gradient, _internal=True)
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,0 +1,168 @@
+"""paddle_tpu.tensor — op wrappers + Tensor method patching.
+
+Mirrors python/paddle/tensor/__init__.py: every functional op is also
+installed as a Tensor method, and operator dunders route through the same
+tape dispatch so autograd sees everything.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from ..base.tensor import Tensor, to_tensor  # noqa: F401
+from . import creation, einsum as einsum_mod, linalg, logic, manipulation, math, random, search, stat
+
+from .creation import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+
+def _has_bool_mask(idx):
+    def _chk(i):
+        if isinstance(i, Tensor):
+            return i.dtype == np.bool_
+        if isinstance(i, (np.ndarray, jax.Array)):
+            return np.result_type(i) == np.bool_
+        return False
+
+    if isinstance(idx, tuple):
+        return builtins.any(_chk(i) for i in idx)
+    return _chk(idx)
+
+
+def _tensor_getitem(self: Tensor, idx):
+    if _has_bool_mask(idx) and not isinstance(idx, tuple):
+        return manipulation.masked_select(self, idx if isinstance(idx, Tensor) else Tensor(idx))
+
+    def _f(a, i):
+        if isinstance(i, list):
+            i = tuple(i) if builtins.any(isinstance(e, (slice, type(None), type(Ellipsis))) for e in i) else jnp.asarray(i)
+        return a[i]
+
+    return apply(_f, self, idx, op_name="getitem")
+
+
+def _tensor_setitem(self: Tensor, idx, value):
+    if _has_bool_mask(idx) and not isinstance(idx, tuple):
+        res = apply(
+            lambda a, m, v: jnp.where(m, jnp.asarray(v, a.dtype) if not hasattr(v, "dtype") else v.astype(a.dtype), a),
+            self,
+            idx,
+            value,
+            op_name="setitem_mask",
+        )
+        self._inplace_from(res)
+        return
+
+    def _f(a, i, v):
+        if isinstance(i, list):
+            i = jnp.asarray(i)
+        v = jnp.asarray(v, a.dtype) if not hasattr(v, "astype") else v.astype(a.dtype)
+        return a.at[i].set(v)
+
+    self._inplace_from(apply(_f, self, idx, value, op_name="setitem"))
+
+
+# ---------------------------------------------------------------------------
+# method patching (ref: python/paddle/base/dygraph/tensor_patch_methods.py)
+# ---------------------------------------------------------------------------
+
+_METHOD_SOURCES = [creation, math, manipulation, linalg, logic, search, stat, random, einsum_mod]
+
+_NON_METHODS = {
+    "to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace", "logspace",
+    "eye", "meshgrid", "tril_indices", "triu_indices", "assign", "one_hot",
+    "uniform", "randint", "randperm", "randn", "rand", "gaussian", "standard_normal",
+    "normal", "scatter_nd", "broadcast_shape", "complex", "polar",
+}
+
+
+def _install_methods():
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith("_") or name in _NON_METHODS:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if getattr(fn, "__module__", "").startswith("jax"):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+
+    Tensor.__getitem__ = _tensor_getitem
+    Tensor.__setitem__ = _tensor_setitem
+
+    # arithmetic dunders
+    Tensor.__add__ = lambda s, o: math.add(s, o)
+    Tensor.__radd__ = lambda s, o: math.add(s, o)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(Tensor(o) if not isinstance(o, Tensor) else o, s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(Tensor(o) if not isinstance(o, Tensor) else o, s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(Tensor(o) if not isinstance(o, Tensor) else o, s)
+    Tensor.__mod__ = lambda s, o: math.remainder(s, o)
+    Tensor.__rmod__ = lambda s, o: math.remainder(Tensor(o) if not isinstance(o, Tensor) else o, s)
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: math.pow(Tensor(o) if not isinstance(o, Tensor) else o, s)
+    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(Tensor(o) if not isinstance(o, Tensor) else o, s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__pos__ = lambda s: s
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__invert__ = lambda s: logic.logical_not(s) if s.dtype == np.bool_ else logic.bitwise_not(s)
+
+    # comparisons (elementwise, paddle semantics)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+
+    def _and(s, o):
+        return logic.logical_and(s, o) if s.dtype == np.bool_ else logic.bitwise_and(s, o)
+
+    def _or(s, o):
+        return logic.logical_or(s, o) if s.dtype == np.bool_ else logic.bitwise_or(s, o)
+
+    def _xor(s, o):
+        return logic.logical_xor(s, o) if s.dtype == np.bool_ else logic.bitwise_xor(s, o)
+
+    Tensor.__and__ = _and
+    Tensor.__rand__ = _and
+    Tensor.__or__ = _or
+    Tensor.__ror__ = _or
+    Tensor.__xor__ = _xor
+    Tensor.__rxor__ = _xor
+
+    # iadd etc. rebind (functional in-place)
+    Tensor.__iadd__ = lambda s, o: s._inplace_from(math.add(s, o))
+    Tensor.__isub__ = lambda s, o: s._inplace_from(math.subtract(s, o))
+    Tensor.__imul__ = lambda s, o: s._inplace_from(math.multiply(s, o))
+    Tensor.__itruediv__ = lambda s, o: s._inplace_from(math.divide(s, o))
+
+    # transpose property
+    Tensor.T = property(lambda s: manipulation.t(s) if s.ndim <= 2 else manipulation.transpose(s, list(builtins.reversed(builtins.range(s.ndim)))))
+    Tensor.mT = property(lambda s: manipulation.swapaxes(s, -1, -2))
+
+
+_install_methods()

@@ -1,0 +1,212 @@
+"""Tensor creation ops (ref: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import dtype as dtypes
+from ..base.tape import apply
+from ..base.tensor import Tensor, to_tensor  # noqa: F401
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data if isinstance(s, Tensor) else s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return dtypes.canonical_dtype(default or dtypes.get_default_dtype())
+    return dtypes.canonical_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)), _internal=True)
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)), _internal=True)
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = (
+            dtypes.get_default_dtype()
+            if isinstance(fill_value, float)
+            else (dtypes.bool_ if isinstance(fill_value, bool) else dtypes.canonical_int())
+        )
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)), _internal=True)
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    return apply(lambda a: jnp.zeros_like(a, dtype=_dt(dtype, np.dtype(a.dtype))), x.detach() if isinstance(x, Tensor) else x, op_name="zeros_like")
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    return apply(lambda a: jnp.ones_like(a, dtype=_dt(dtype, np.dtype(a.dtype))), x.detach() if isinstance(x, Tensor) else x, op_name="ones_like")
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    return apply(
+        lambda a: jnp.full_like(a, fill_value, dtype=_dt(dtype, np.dtype(a.dtype))),
+        x.detach() if isinstance(x, Tensor) else x,
+        op_name="full_like",
+    )
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    def _val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _val(start), _val(end), _val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            dtypes.get_default_dtype()
+            if any(isinstance(v, float) for v in (start, end, step))
+            else dtypes.canonical_int()
+        )
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)), _internal=True)
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    def _val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(
+        jnp.linspace(_val(start), _val(stop), int(_val(num)), dtype=_dt(dtype)),
+        _internal=True,
+    )
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    def _val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(
+        jnp.logspace(_val(start), _val(stop), int(_val(num)), base=_val(base), dtype=_dt(dtype)),
+        _internal=True,
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)), _internal=True)
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    def _diag(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+            return out
+        return jnp.diagonal(a, offset=offset)
+
+    return apply(_diag, x, op_name="diag")
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return apply(lambda a: jnp.diagflat(a, k=offset), x, op_name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None) -> Tensor:
+    def _f(a):
+        out = jnp.zeros((*a.shape, a.shape[-1] + abs(offset)), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        ndim = out.ndim
+        d1, d2 = dim1 % ndim, dim2 % ndim
+        perm = [i for i in range(ndim) if i not in (ndim - 2, ndim - 1)]
+        # place last two axes at dim1/dim2
+        order = []
+        src = iter(perm)
+        for i in range(ndim):
+            if i == d1:
+                order.append(ndim - 2)
+            elif i == d2:
+                order.append(ndim - 1)
+            else:
+                order.append(next(src))
+        return jnp.transpose(out, order)
+
+    return apply(_f, x, op_name="diag_embed")
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return apply(lambda a: jnp.tril(a, k=diagonal), x, op_name="tril")
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return apply(lambda a: jnp.triu(a, k=diagonal), x, op_name="triu")
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), _dt(dtype)), _internal=True)
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), _dt(dtype)), _internal=True)
+
+
+def meshgrid(*args, name=None):
+    args = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = apply(lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), *args, op_name="meshgrid")
+    return list(outs)
+
+
+def assign(x, output=None) -> Tensor:
+    src = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+    out = apply(lambda a: a + 0 if np.issubdtype(np.result_type(a), np.number) else a, src, op_name="assign")
+    if output is not None:
+        output._inplace_from(out)
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return x.clone()
+
+
+def complex(real, imag, name=None) -> Tensor:
+    return apply(lambda r, i: jax.lax.complex(r, i), real, imag, op_name="complex")
+
+
+import jax  # noqa: E402  (used by complex above)
+
+
+def polar(abs_, angle, name=None) -> Tensor:
+    return apply(
+        lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)),
+        abs_,
+        angle,
+        op_name="polar",
+    )
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    import jax.nn as jnn
+
+    return apply(
+        lambda a: jnn.one_hot(a, num_classes, dtype=dtypes.get_default_dtype()),
+        x,
+        op_name="one_hot",
+    )

@@ -1,0 +1,15 @@
+"""einsum (ref: python/paddle/tensor/einsum.py) — direct jnp.einsum,
+which XLA maps onto MXU dot_generals."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base.tape import apply
+
+
+def einsum(equation, *operands, name=None):
+    if not isinstance(equation, str):
+        raise TypeError("einsum equation must be a string")
+    return apply(
+        lambda *arrs: jnp.einsum(equation, *arrs), *operands, op_name="einsum"
+    )

@@ -1,0 +1,348 @@
+"""Linear algebra ops (ref: python/paddle/tensor/linalg.py).
+
+matmul & friends are the MXU path: keep operands batched and let XLA tile
+them onto the systolic array. bf16 accumulation uses f32 by default via
+``precision``/preferred_element_type.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import dtype as dtypes
+from ..base.tape import apply
+from ..base.tensor import Tensor
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def _f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        pref = None
+        precision = None
+        if np.result_type(a) in (dtypes.bfloat16, dtypes.float16):
+            # low-precision inputs: MXU-native, accumulate in f32
+            pref = jnp.float32
+        elif np.result_type(a) == dtypes.float32:
+            # f32 inputs: full precision (TPU default truncates to bf16;
+            # the reference's cuBLAS fp32 path does not — parity)
+            precision = jax.lax.Precision.HIGHEST
+        out = jnp.matmul(a, b, preferred_element_type=pref, precision=precision)
+        if pref is not None:
+            out = out.astype(np.result_type(a))
+        return out
+
+    return apply(_f, x, y, op_name="matmul")
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    def _f(a, b):
+        if a.ndim == 1:
+            return jnp.dot(a, b)
+        return jnp.sum(a * b, axis=-1)
+
+    return apply(_f, x, y, op_name="dot")
+
+
+def mv(x, vec, name=None):
+    return apply(lambda a, v: a @ v, x, vec, op_name="mv")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def _f(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(a))))
+            return jnp.linalg.norm(a, ord=None, axis=ax, keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=ax, keepdims=keepdim)
+        if p == float("inf"):
+            if ax is None:
+                return jnp.max(jnp.abs(a))
+            return jnp.linalg.norm(a, ord=np.inf, axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            if ax is None:
+                return jnp.min(jnp.abs(a))
+            return jnp.linalg.norm(a, ord=-np.inf, axis=ax, keepdims=keepdim)
+        if ax is None:
+            return jnp.sum(jnp.abs(a) ** p) ** (1.0 / p)
+        if isinstance(ax, tuple) and len(ax) > 1:
+            return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return apply(_f, x, op_name="norm")
+
+
+vector_norm = norm
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply(
+        lambda a: jnp.linalg.norm(a, ord=p if p != "fro" else None, axis=tuple(axis), keepdims=keepdim),
+        x,
+        op_name="matrix_norm",
+    )
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y if isinstance(x, Tensor) else Tensor(x) - y, p=p)
+
+
+def cond(x, p=None, name=None):
+    return apply(lambda a: jnp.linalg.cond(a, p=p), x, op_name="cond")
+
+
+def cross(x, y, axis=9, name=None):
+    def _f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return apply(_f, x, y, op_name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    def _f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+    return apply(_f, x, op_name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def _f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return apply(_f, x, y, op_name="cholesky_solve")
+
+
+def qr(x, mode="reduced", name=None):
+    outs = apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)) if mode != "r" else (jnp.linalg.qr(a, mode="r"),), x, op_name="qr")
+    return outs if mode != "r" else outs[0]
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        x,
+        op_name="svd",
+    )
+
+
+def svdvals(x, name=None):
+    return apply(lambda a: jnp.linalg.svd(a, compute_uv=False), x, op_name="svdvals")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def _f(a):
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+        k = q or min(6, a.shape[-1])
+        return u[..., :k], s[..., :k], jnp.swapaxes(vh, -1, -2)[..., :k]
+
+    return apply(_f, x, op_name="pca_lowrank")
+
+
+def eig(x, name=None):
+    """General eig: CPU-only in XLA; falls back to numpy eagerly."""
+    from .manipulation import _require_eager
+
+    _require_eager("eig", x)
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    w, v = np.linalg.eig(a)
+    return Tensor(jnp.asarray(w), _internal=True), Tensor(jnp.asarray(v), _internal=True)
+
+
+def eigvals(x, name=None):
+    from .manipulation import _require_eager
+
+    _require_eager("eigvals", x)
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)), _internal=True)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)), x, op_name="eigh")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a), x, op_name="eigvalsh")
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x, op_name="inv")
+
+
+inverse = inv
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x, op_name="pinv")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def _f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return apply(_f, x, y, op_name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    outs = apply(
+        lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)), x, y, op_name="lstsq"
+    )
+    return outs
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def _f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+    lu_t, piv = apply(_f, x, op_name="lu")
+    if get_infos:
+        from .creation import zeros
+
+        return lu_t, piv, zeros([1], dtype="int32")
+    return lu_t, piv
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    def _f(a, piv):
+        m = a.shape[-2]
+        L = jnp.tril(a, -1) + jnp.eye(m, a.shape[-1], dtype=a.dtype)
+        U = jnp.triu(a)
+        # build permutation matrix from 1-based pivots
+        perm = jnp.arange(m)
+        piv0 = piv - 1
+
+        def body(i, p):
+            pi = piv0[i]
+            a_, b_ = p[i], p[pi]
+            p = p.at[i].set(b_)
+            return p.at[pi].set(a_)
+
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        P = jnp.eye(m, dtype=a.dtype)[perm].T
+        return P, L[..., : min(a.shape[-2:]), :][..., : a.shape[-2], : min(a.shape[-2:])], U
+
+    P, L, U = apply(_f, lu_data, lu_pivots, op_name="lu_unpack")
+    return P, L, U
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), x, op_name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.matrix_rank(a, rtol=tol), x, op_name="matrix_rank")
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, op_name="det")
+
+
+def slogdet(x, name=None):
+    def _f(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+
+    return apply(_f, x, op_name="slogdet")
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *arrs: jnp.linalg.multi_dot(arrs), *x, op_name="multi_dot")
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):  # noqa: A002
+    def _f(a, *w):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi), weights=w[0] if w else None, density=density)
+        return h
+
+    args = (input, weight) if weight is not None else (input,)
+    return apply(_f, *args, op_name="histogram")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    w = np.asarray(weights._data) if isinstance(weights, Tensor) else weights
+    h, edges = np.histogramdd(a, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(h), _internal=True), [Tensor(jnp.asarray(e), _internal=True) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    from .manipulation import _require_eager
+
+    _require_eager("bincount", x)
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    length = max(minlength, int(a.max()) + 1 if a.size else 0)
+
+    def _f(xx, *w):
+        return jnp.bincount(xx, weights=w[0] if w else None, length=length)
+
+    args = (x, weights) if weights is not None else (x,)
+    return apply(_f, *args, op_name="bincount")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, op_name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
+        x,
+        op_name="cov",
+    )
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def _f(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+    return apply(_f, x, y, op_name="cdist")
+
+
+def householder_product(x, tau, name=None):
+    def _f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        Q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else eye
+
+        def body(i, Q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i].at[..., i].set(1.0))
+            H = jnp.eye(m, dtype=a.dtype) - t[..., i] * jnp.outer(v, v)
+            return Q @ H
+
+        for i in range(n):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, jnp.zeros_like(v), v)
+            v = v.at[i].set(1.0)
+            H = jnp.eye(m, dtype=a.dtype) - t[..., i] * jnp.outer(v, v)
+            Q = Q @ H
+        return Q[..., :, :n]
+
+    return apply(_f, x, tau, op_name="householder_product")

@@ -1,0 +1,79 @@
+"""Comparison / logical / bitwise ops (ref: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from ..base.tensor import Tensor
+
+
+def _cmp(jfn, opname):
+    def op(x, y, name=None):
+        return apply(jfn, x, y, op_name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+bitwise_left_shift = _cmp(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = _cmp(jnp.right_shift, "bitwise_right_shift")
+
+
+def logical_not(x, out=None, name=None):
+    return apply(jnp.logical_not, x, op_name="logical_not")
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply(jnp.bitwise_not, x, op_name="bitwise_not")
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), x, y, op_name="equal_all")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(
+        lambda a, b: jnp.allclose(a, b, rtol=float(rtol), atol=float(atol), equal_nan=equal_nan),
+        x,
+        y,
+        op_name="allclose",
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(
+        lambda a, b: jnp.isclose(a, b, rtol=float(rtol), atol=float(atol), equal_nan=equal_nan),
+        x,
+        y,
+        op_name="isclose",
+    )
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0), _internal=True)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply(
+        lambda a, t: jnp.isin(a, t, assume_unique=assume_unique, invert=invert),
+        x,
+        test_x,
+        op_name="isin",
+    )

@@ -1,0 +1,552 @@
+"""Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py).
+
+On TPU all of these are XLA reshapes/transposes/gathers; "views" do not
+exist (arrays are immutable), so view-style APIs return new Tensors and
+the in-place variants rebind (see base/tensor.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import dtype as dtypes
+from ..base.tape import apply
+from ..base.tensor import Tensor
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        return tuple(int(i) for i in v.numpy())
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    return tuple(int(i._data if isinstance(i, Tensor) else i) for i in v)
+
+
+def cast(x, dtype):
+    dt = dtypes.convert_dtype(dtype)
+    return apply(lambda a: a.astype(dt), x, op_name="cast")
+
+
+def reshape(x, shape, name=None):
+    shape = _ints(shape)
+    return apply(lambda a: jnp.reshape(a, shape), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace_from(reshape(x, shape))
+
+
+view = reshape
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def _f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1 :]
+        return jnp.reshape(a, new_shape)
+
+    return apply(_f, x, op_name="flatten")
+
+
+def transpose(x, perm=None, name=None):
+    perm = None if perm is None else _ints(perm)
+    return apply(lambda a: jnp.transpose(a, perm), x, op_name="transpose")
+
+
+def t(x, name=None):
+    def _f(a):
+        if a.ndim < 2:
+            return a
+        return a.T
+
+    return apply(_f, x, op_name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(
+        lambda a: jnp.moveaxis(a, _ints(source), _ints(destination)),
+        x,
+        op_name="moveaxis",
+    )
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis0, axis1), x, op_name="swapaxes")
+
+
+transpose_ = lambda x, perm=None, name=None: x._inplace_from(transpose(x, perm))  # noqa: E731
+
+
+def squeeze(x, axis=None, name=None):
+    def _f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = _ints(axis)
+        axes = tuple(ax % a.ndim for ax in axes)
+        axes = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return apply(_f, x, op_name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace_from(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis)
+    return apply(lambda a: jnp.expand_dims(a, axes), x, op_name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace_from(unsqueeze(x, axis))
+
+
+def concat(x, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    xs = list(x)
+    return apply(lambda *arrs: jnp.concatenate(arrs, axis=axis), *xs, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    xs = list(x)
+    return apply(lambda *arrs: jnp.stack(arrs, axis=axis), *xs, op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def _f(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        secs = [
+            int(s._data if isinstance(s, Tensor) else s) for s in num_or_sections
+        ]
+        # paddle allows one -1 section
+        if -1 in secs:
+            known = sum(s for s in secs if s != -1)
+            secs[secs.index(-1)] = a.shape[axis] - known
+        idx = np.cumsum(secs)[:-1]
+        return tuple(jnp.split(a, idx, axis=axis))
+
+    return list(apply(_f, x, op_name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = (x._data if isinstance(x, Tensor) else np.asarray(x)).shape[axis]
+    outs = apply(
+        lambda a: tuple(jnp.take(a, i, axis=axis) for i in range(n)),
+        x,
+        op_name="unbind",
+    )
+    return list(outs)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def expand(x, shape, name=None):
+    shape = _ints(shape)
+
+    def _f(a):
+        tgt = list(shape)
+        # paddle: -1 keeps original dim; leading dims may be added
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - off] if i >= off else 1
+        return jnp.broadcast_to(a, tuple(tgt))
+
+    return apply(_f, x, op_name="expand")
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    tgt = tuple((y._data if isinstance(y, Tensor) else np.asarray(y)).shape)
+    return apply(lambda a: jnp.broadcast_to(a, tgt), x, op_name="expand_as")
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = apply(lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)), *inputs, op_name="broadcast_tensors")
+    return list(outs)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), x, op_name="tile")
+
+
+def flip(x, axis, name=None):
+    axes = _ints(axis)
+    return apply(lambda a: jnp.flip(a, axis=axes), x, op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, op_name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    shifts = _ints(shifts)
+    axes = None if axis is None else _ints(axis)
+
+    def _f(a):
+        if axes is None:
+            return jnp.roll(a, shifts if len(shifts) > 1 else shifts[0])
+        return jnp.roll(a, shifts, axis=axes)
+
+    return apply(_f, x, op_name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def _f(a, idx):
+        if idx.ndim == 0:
+            idx = idx[None]
+        return jnp.take(a, idx, axis=axis)
+
+    return apply(_f, x, index, op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def _f(a, idx):
+        k = idx.shape[-1]
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))] if k == a.ndim else a[
+            tuple(jnp.moveaxis(idx, -1, 0))
+        ]
+        return out
+
+    return apply(_f, x, index, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _f(a, idx, upd):
+        if idx.ndim == 2 and idx.shape[1] == 1:
+            idx = idx[:, 0]
+        if overwrite:
+            return a.at[idx].set(upd)
+        # paddle: overwrite=False means zero destination rows then add
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+
+    return apply(_f, x, index, updates, op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace_from(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def _f(a, idx, upd):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+
+    return apply(_f, x, index, updates, op_name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    upd_dtype = updates.dtype if isinstance(updates, Tensor) else np.result_type(updates)
+    return scatter_nd_add(zeros(shape, dtype=upd_dtype), index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda a, i: jnp.take(a, i, axis=axis), x, index, op_name="index_select")
+
+
+def index_sample(x, index, name=None):
+    return apply(
+        lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index, op_name="index_sample"
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    def _f(a, i, v):
+        perm = None
+        if axis % a.ndim != 0:
+            a_m = jnp.moveaxis(a, axis, 0)
+            v_m = jnp.moveaxis(v, axis, 0)
+            out = a_m.at[i].add(v_m)
+            return jnp.moveaxis(out, 0, axis)
+        return a.at[i].add(v)
+
+    return apply(_f, x, index, value, op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def _f(a, v, *idx):
+        ref = a.at[tuple(idx)]
+        return ref.add(v) if accumulate else ref.set(v)
+
+    return apply(_f, x, value, *indices, op_name="index_put")
+
+
+def index_fill(x, index, axis, fill_value, name=None):
+    def _f(a, i):
+        a_m = jnp.moveaxis(a, axis, 0)
+        out = a_m.at[i].set(jnp.asarray(fill_value, a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply(_f, x, index, op_name="index_fill")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(
+        lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+        arr,
+        indices,
+        op_name="take_along_axis",
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True, name=None):  # noqa: A002
+    def _f(a, i, v):
+        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), i.shape) if not hasattr(v, "shape") or v.shape != i.shape else v
+        return jnp.put_along_axis(a, i, v, axis=axis, inplace=False, mode="fill" if False else None) if False else _put(a, i, v)
+
+    def _put(a, i, v):
+        dims = [jnp.arange(s).reshape([-1 if d == k else 1 for k in range(i.ndim)]) for d, s in enumerate(i.shape)]
+        idx = tuple(i if d == axis % a.ndim else jnp.broadcast_to(dims[d], i.shape) for d in range(a.ndim))
+        ref = a.at[idx]
+        if reduce == "assign":
+            return ref.set(v)
+        if reduce in ("add",):
+            return ref.add(v)
+        if reduce in ("mul", "multiply"):
+            return ref.multiply(v)
+        if reduce == "amax":
+            return ref.max(v)
+        if reduce == "amin":
+            return ref.min(v)
+        raise ValueError(f"unknown reduce {reduce!r}")
+
+    return apply(_f, arr, indices, values, op_name="put_along_axis")
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    import builtins
+
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+
+    def _f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(s, e)
+        return a[tuple(idx)]
+
+    return apply(_f, x, op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+
+    axes, starts, ends, strides = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
+
+    def _f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(s, e, st)
+        return a[tuple(idx)]
+
+    return apply(_f, x, op_name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    import builtins
+
+    shape = _ints(shape)
+    offsets = _ints(offsets) if offsets is not None else (0,) * len(shape)
+
+    def _f(a):
+        idx = []
+        for d in range(a.ndim):
+            size = shape[d] if shape[d] != -1 else a.shape[d] - offsets[d]
+            idx.append(builtins.slice(offsets[d], offsets[d] + size))
+        return a[tuple(idx)]
+
+    return apply(_f, x, op_name="crop")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    from ..nn.functional import pad as _nnpad
+
+    return _nnpad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def _f(a, *maybe_r):
+        r = maybe_r[0] if maybe_r else repeats
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.repeat(a, r, total_repeat_length=None if isinstance(r, int) else int(np.sum(np.asarray(r))))
+        return jnp.repeat(a, r, axis=axis, total_repeat_length=None if isinstance(r, int) else int(np.sum(np.asarray(r))))
+
+    if isinstance(repeats, Tensor):
+        return apply(_f, x, repeats, op_name="repeat_interleave")
+    return apply(_f, x, op_name="repeat_interleave")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Limited as_strided: materializes via flat gather (no aliasing on TPU)."""
+
+    def _f(a):
+        flat = a.reshape(-1)
+        idx = np.full(tuple(shape), offset, dtype=np.int64)
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            ar = np.arange(s) * st
+            idx = idx + ar.reshape([-1 if k == d else 1 for k in range(len(shape))])
+        return flat[jnp.asarray(idx)]
+
+    return apply(_f, x, op_name="as_strided")
+
+
+def unfold(x, axis, size, step, name=None):
+    def _f(a):
+        n = (a.shape[axis] - size) // step + 1
+        starts = np.arange(n) * step
+        slices = [jnp.take(a, jnp.arange(s, s + size), axis=axis) for s in starts]
+        return jnp.stack(slices, axis=axis)
+
+    return apply(_f, x, op_name="unfold")
+
+
+def masked_select(x, mask, name=None):
+    """Dynamic-shape op: eager only (under jit, use where/masked ops).
+
+    ref: python/paddle/tensor/search.py masked_select. XLA requires static
+    shapes, so under trace this raises with guidance — same stance jax
+    takes (jnp.extract).
+    """
+    _require_eager("masked_select", x, mask)
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    m = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    m = np.broadcast_to(np.asarray(m), a.shape)
+    idx = np.nonzero(m)
+    flat_idx = jnp.asarray(np.ravel_multi_index(idx, m.shape))
+    # gather keeps the op differentiable w.r.t. x
+    return apply(lambda arr: arr.reshape(-1)[flat_idx], x, op_name="masked_select")
+
+
+def masked_fill(x, mask, value, name=None):
+    def _f(a, m):
+        return jnp.where(m, jnp.asarray(value.item() if isinstance(value, Tensor) else value, a.dtype), a)
+
+    return apply(_f, x, mask, op_name="masked_fill")
+
+
+def masked_fill_(x, mask, value, name=None):
+    return x._inplace_from(masked_fill(x, mask, value))
+
+
+def masked_scatter(x, mask, value, name=None):
+    _require_eager("masked_scatter", x, mask)
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    m = np.broadcast_to(np.asarray(mask._data if isinstance(mask, Tensor) else mask), a.shape)
+    v = np.asarray(value._data if isinstance(value, Tensor) else value).reshape(-1)
+    out = a.copy()
+    out[m] = v[: int(m.sum())]
+    return Tensor(jnp.asarray(out), _internal=True)
+
+
+def _require_eager(opname, *tensors):
+    import jax.core as jcore
+
+    for t in tensors:
+        d = t._data if isinstance(t, Tensor) else t
+        if isinstance(d, jcore.Tracer):
+            raise RuntimeError(
+                f"{opname} produces a data-dependent shape and cannot run under "
+                f"jit/to_static on TPU; restructure with where/masks, or run eagerly."
+            )
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    _require_eager("unique", x)
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        res = (res,)
+    outs = tuple(Tensor(jnp.asarray(r), _internal=True) for r in res)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    _require_eager("unique_consecutive", x)
+    a = np.asarray(x._data if isinstance(x, Tensor) else x).reshape(-1) if axis is None else np.asarray(x._data)
+    keep = np.concatenate([[True], a[1:] != a[:-1]]) if a.ndim == 1 else None
+    vals = a[keep]
+    outs = [Tensor(jnp.asarray(vals), _internal=True)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv), _internal=True))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, len(a)))
+        outs.append(Tensor(jnp.asarray(counts), _internal=True))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def chunk_eval(*args, **kwargs):
+    raise NotImplementedError("chunk_eval is a legacy sequence op; not provided")
+
+
+def tensordot(x, y, axes=2, name=None):
+    def _norm(ax):
+        if isinstance(ax, Tensor):
+            return ax.tolist()
+        return ax
+
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=_norm(axes)), x, y, op_name="tensordot")
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, x, op_name="atleast_1d") for x in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, x, op_name="atleast_2d") for x in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, x, op_name="atleast_3d") for x in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x, op_name="as_complex")
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x, op_name="as_real")
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size if isinstance(x, Tensor) else np.asarray(x).size, jnp.int64), _internal=True)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    def _f(a):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        in_shard = (a >= lo) & (a < lo + shard_size)
+        return jnp.where(in_shard, a - lo, ignore_value)
+
+    return apply(_f, input, op_name="shard_index")

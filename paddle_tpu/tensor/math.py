@@ -1,0 +1,393 @@
+"""Math ops (ref: python/paddle/tensor/math.py, ops.py).
+
+Every op lowers to jnp/lax through the tape dispatch (base/tape.apply),
+which records vjp closures when grads are needed. XLA fuses chains of
+these elementwise ops into single kernels — the role phi's fused
+elementwise CUDA kernels play in the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import dtype as dtypes
+from ..base.tape import apply
+
+
+def _cint():
+    from ..base.dtype import canonical_int
+
+    return canonical_int()
+from ..base.tensor import Tensor
+
+
+def _unary(jfn, opname):
+    def op(x, name=None):
+        return apply(jfn, x, op_name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+def _binary(jfn, opname):
+    def op(x, y, name=None):
+        return apply(jfn, x, y, op_name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+# -- elementwise binary ------------------------------------------------------
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+divide = _binary(jnp.divide, "divide")
+floor_divide = _binary(jnp.floor_divide, "floor_divide")
+remainder = _binary(jnp.remainder, "remainder")
+mod = remainder
+floor_mod = remainder
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+logaddexp = _binary(jnp.logaddexp, "logaddexp")
+atan2 = _binary(jnp.arctan2, "atan2")
+hypot = _binary(jnp.hypot, "hypot")
+nextafter = _binary(jnp.nextafter, "nextafter")
+copysign = _binary(jnp.copysign, "copysign")
+heaviside = _binary(jnp.heaviside, "heaviside")
+gcd = _binary(jnp.gcd, "gcd")
+lcm = _binary(jnp.lcm, "lcm")
+inner = _binary(jnp.inner, "inner")
+ldexp = _binary(jnp.ldexp, "ldexp")
+
+
+def pow(x, y, name=None):  # noqa: A001
+    return apply(jnp.power, x, y, op_name="pow")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def _f(a, s, b):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out
+
+    out = apply(_f, x, scale, bias, op_name="scale")
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+# -- elementwise unary -------------------------------------------------------
+abs = _unary(jnp.abs, "abs")  # noqa: A001
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(lambda x: jax.lax.rsqrt(x), "rsqrt")
+square = _unary(jnp.square, "square")
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")  # noqa: A001
+trunc = _unary(jnp.trunc, "trunc")
+frac = _unary(lambda x: x - jnp.trunc(x), "frac")
+sign = _unary(jnp.sign, "sign")
+sgn = _unary(jnp.sign, "sgn")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+neg = _unary(jnp.negative, "neg")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+i0 = _unary(jax.scipy.special.i0, "i0")
+i0e = _unary(jax.scipy.special.i0e, "i0e")
+i1 = _unary(jax.scipy.special.i1, "i1")
+i1e = _unary(jax.scipy.special.i1e, "i1e")
+angle = _unary(jnp.angle, "angle")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+isnan = _unary(jnp.isnan, "isnan")
+isinf = _unary(jnp.isinf, "isinf")
+isfinite = _unary(jnp.isfinite, "isfinite")
+isneginf = _unary(jnp.isneginf, "isneginf")
+isposinf = _unary(jnp.isposinf, "isposinf")
+isreal = _unary(jnp.isreal, "isreal")
+exponent = _unary(lambda x: jnp.frexp(x)[1], "exponent")
+
+
+def logit(x, eps=None, name=None):
+    def _f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+
+    return apply(_f, x, op_name="logit")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x, op_name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    return apply(
+        lambda idx, *ins: jnp.stack(ins, 0)[idx.reshape(-1), jnp.arange(ins[0].shape[0])],
+        index,
+        *inputs,
+        op_name="multiplex",
+    )
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, lo, hi), x, op_name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight, op_name="lerp")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        x,
+        op_name="nan_to_num",
+    )
+
+
+# -- reductions --------------------------------------------------------------
+def _norm_axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)
+
+
+def _reduce(jfn, opname):
+    def op(x, axis=None, keepdim=False, name=None):
+        return apply(
+            lambda a: jfn(a, axis=_norm_axis(axis), keepdims=keepdim),
+            x,
+            op_name=opname,
+        )
+
+    op.__name__ = opname
+    return op
+
+
+sum = _reduce(jnp.sum, "sum")  # noqa: A001
+mean = _reduce(jnp.mean, "mean")
+prod = _reduce(jnp.prod, "prod")
+max = _reduce(jnp.max, "max")  # noqa: A001
+min = _reduce(jnp.min, "min")  # noqa: A001
+amax = _reduce(jnp.max, "amax")
+amin = _reduce(jnp.min, "amin")
+nansum = _reduce(jnp.nansum, "nansum")
+nanmean = _reduce(jnp.nanmean, "nanmean")
+all = _reduce(jnp.all, "all")  # noqa: A001
+any = _reduce(jnp.any, "any")  # noqa: A001
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda a: jax.scipy.special.logsumexp(a, axis=_norm_axis(axis), keepdims=keepdim),
+        x,
+        op_name="logsumexp",
+    )
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda a: jnp.count_nonzero(a, axis=_norm_axis(axis), keepdims=keepdim),
+        x,
+        op_name="count_nonzero",
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def _f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=dtypes.convert_dtype(dtype))
+        return jnp.cumsum(a, axis=int(axis), dtype=dtypes.convert_dtype(dtype))
+
+    return apply(_f, x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def _f(a):
+        if dim is None:
+            a = a.reshape(-1)
+            return jnp.cumprod(a, dtype=dtypes.convert_dtype(dtype))
+        return jnp.cumprod(a, axis=int(dim), dtype=dtypes.convert_dtype(dtype))
+
+    return apply(_f, x, op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    vals = apply(
+        lambda a: jax.lax.associative_scan(
+            jnp.maximum, a.reshape(-1) if axis is None else a, axis=0 if axis is None else int(axis)
+        ),
+        x,
+        op_name="cummax",
+    )
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    ax = 0 if axis is None else int(axis)
+    if axis is None:
+        a = a.reshape(-1)
+    return vals, Tensor(_prefix_arg(a, ax, jnp.maximum), _internal=True)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    vals = apply(
+        lambda a: jax.lax.associative_scan(
+            jnp.minimum, a.reshape(-1) if axis is None else a, axis=0 if axis is None else int(axis)
+        ),
+        x,
+        op_name="cummin",
+    )
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    ax = 0 if axis is None else int(axis)
+    if axis is None:
+        a = a.reshape(-1)
+    return vals, Tensor(_prefix_arg(a, ax, jnp.minimum), _internal=True)
+
+
+def _prefix_arg(a, ax, cmp):
+    """Indices of the running max/min along ax (associative scan on pairs)."""
+    idx = jnp.broadcast_to(
+        jnp.arange(a.shape[ax]).reshape([-1 if i == ax else 1 for i in range(a.ndim)]),
+        a.shape,
+    ).astype(_cint() if jax.config.jax_enable_x64 else jnp.int32)
+
+    def combine(p, q):
+        pv, pi = p
+        qv, qi = q
+        take_q = cmp(pv, qv) == qv
+        return cmp(pv, qv), jnp.where(take_q, qi, pi)
+
+    _, ind = jax.lax.associative_scan(combine, (a, idx), axis=ax)
+    return ind
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(
+        lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+        x,
+        op_name="trace",
+    )
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        x,
+        op_name="diagonal",
+    )
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, x, y, op_name="kron")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    def _f(a, *extras):
+        pre = extras[0] if prepend is not None else None
+        app = extras[-1] if append is not None else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+
+    extras = [e for e in (prepend, append) if e is not None]
+    return apply(_f, x, *extras, op_name="diff")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return apply(
+        lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, op_name="addmm"
+    )
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y, op_name="outer")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply(lambda a: jnp.vander(a, N=n, increasing=increasing), x, op_name="vander")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def _f(a):
+        dims = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+
+    return apply(_f, x, op_name="renorm")
+
+
+def take(x, index, mode="raise", name=None):
+    def _f(a, i):
+        flat = a.reshape(-1)
+        if mode == "wrap":
+            i = i % flat.shape[0]
+        elif mode == "clip":
+            i = jnp.clip(i, 0, flat.shape[0] - 1)
+        return flat[i]
+
+    return apply(_f, x, index, op_name="take")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def increment(x, value=1.0, name=None):
+    return x._inplace_from(apply(lambda a: a + value, x, op_name="increment"))
+
+
+# in-place variants (functional rebinding; see base/tensor.py docstring)
+def _make_inplace(fn):
+    def inplace(x, *args, **kwargs):
+        return x._inplace_from(fn(x, *args, **kwargs))
+
+    inplace.__name__ = fn.__name__ + "_"
+    return inplace
+
+
+add_ = _make_inplace(add)
+subtract_ = _make_inplace(subtract)
+multiply_ = _make_inplace(multiply)
+divide_ = _make_inplace(divide)
+scale_ = _make_inplace(scale)
+clip_ = _make_inplace(clip)
+exp_ = _make_inplace(exp)
+sqrt_ = _make_inplace(sqrt)
+rsqrt_ = _make_inplace(rsqrt)
+reciprocal_ = _make_inplace(reciprocal)
+round_ = _make_inplace(round)
+floor_ = _make_inplace(floor)
+ceil_ = _make_inplace(ceil)
+tanh_ = _make_inplace(tanh)
+abs_ = _make_inplace(abs)
+neg_ = _make_inplace(neg)

@@ -1,0 +1,162 @@
+"""Random sampling ops (ref: python/paddle/tensor/random.py).
+
+All draws pull subkeys from the default Generator (base/random.py), so
+``paddle_tpu.seed`` controls everything and the functionalized train step
+can thread RNG state through jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import dtype as dtypes
+from ..base import random as _random
+from ..base.tape import apply
+
+
+def _cint():
+    from ..base.dtype import canonical_int
+
+    return canonical_int()
+from ..base.tensor import Tensor
+from .creation import _dt, _shape
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = _random.next_key() if not seed else jax.random.key(seed)
+    return Tensor(
+        jax.random.uniform(key, _shape(shape), _dt(dtype), minval=min, maxval=max),
+        _internal=True,
+    )
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    return x.set_value(uniform(tuple(x.shape), x.dtype, min, max, seed)._data)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        def _f(m, s):
+            shp = np.broadcast_shapes(np.shape(m), np.shape(s))
+            return m + s * jax.random.normal(_random.next_key(), shp, dtypes.get_default_dtype())
+
+        return apply(_f, mean, std, op_name="normal")
+    key = _random.next_key()
+    return Tensor(
+        mean + std * jax.random.normal(key, _shape(shape), _dt(None)), _internal=True
+    )
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    return x.set_value(normal(mean, std, tuple(x.shape))._data)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = _random.next_key() if not seed else jax.random.key(seed)
+    return Tensor(
+        mean + std * jax.random.normal(key, _shape(shape), _dt(dtype)), _internal=True
+    )
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, 0, dtype)
+
+
+def standard_gamma(alpha, name=None):
+    def _f(a):
+        return jax.random.gamma(_random.next_key(), a)
+
+    return apply(_f, alpha, op_name="standard_gamma")
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = _random.next_key()
+    return Tensor(
+        jax.random.randint(key, _shape(shape), low, high, _dt(dtype, np.dtype("int64"))),
+        _internal=True,
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, tuple(x.shape), dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = _random.next_key()
+    return Tensor(
+        jax.random.permutation(key, n).astype(_dt(dtype, np.dtype("int64"))),
+        _internal=True,
+    )
+
+
+def bernoulli(x, name=None):
+    def _f(p):
+        return jax.random.bernoulli(_random.next_key(), p).astype(p.dtype)
+
+    return apply(_f, x.detach() if isinstance(x, Tensor) else x, op_name="bernoulli")
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = _random.next_key()
+    return x.set_value(jax.random.bernoulli(key, p, tuple(x.shape)).astype(x._data.dtype))
+
+
+def binomial(count, prob, name=None):
+    def _f(n, p):
+        return jax.random.binomial(_random.next_key(), n, p).astype(_cint())
+
+    return apply(_f, count, prob, op_name="binomial")
+
+
+def poisson(x, name=None):
+    def _f(lam):
+        return jax.random.poisson(_random.next_key(), lam).astype(lam.dtype)
+
+    return apply(_f, x.detach() if isinstance(x, Tensor) else x, op_name="poisson")
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = _random.next_key()
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(a, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(*a.shape[:-1], num_samples) if a.ndim > 1 else (num_samples,))
+        if a.ndim > 1:
+            out = out.reshape(*a.shape[:-1], num_samples)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, a.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(_cint()), _internal=True)
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = _random.next_key()
+    return x.set_value(jax.random.exponential(key, tuple(x.shape), x._data.dtype) / lam)
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    key = _random.next_key()
+    return x.set_value(loc + scale * jax.random.cauchy(key, tuple(x.shape), x._data.dtype))
+
+
+def geometric_(x, probs, name=None):
+    key = _random.next_key()
+    u = jax.random.uniform(key, tuple(x.shape), jnp.float32, 1e-7, 1.0)
+    return x.set_value((jnp.ceil(jnp.log(u) / jnp.log1p(-probs))).astype(x._data.dtype))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    key = _random.next_key()
+    return x.set_value(jnp.exp(mean + std * jax.random.normal(key, tuple(x.shape), x._data.dtype)))

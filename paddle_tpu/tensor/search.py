@@ -1,0 +1,144 @@
+"""Search / sort / indexing ops (ref: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+
+
+def _cint():
+    from ..base.dtype import canonical_int
+
+    return canonical_int()
+from ..base.tensor import Tensor
+from .manipulation import _require_eager
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _f(a):
+        if axis is None:
+            return jnp.argmax(a.reshape(-1)).astype(_cint())
+        out = jnp.argmax(a, axis=int(axis), keepdims=keepdim)
+        return out.astype(_cint())
+
+    return apply(_f, x.detach() if isinstance(x, Tensor) else x, op_name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _f(a):
+        if axis is None:
+            return jnp.argmin(a.reshape(-1)).astype(_cint())
+        return jnp.argmin(a, axis=int(axis), keepdims=keepdim).astype(_cint())
+
+    return apply(_f, x.detach() if isinstance(x, Tensor) else x, op_name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def _f(a):
+        out = jnp.argsort(a, axis=axis, stable=stable, descending=descending)
+        return out.astype(_cint())
+
+    return apply(_f, x.detach() if isinstance(x, Tensor) else x, op_name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def _f(a):
+        out = jnp.sort(a, axis=axis, stable=stable, descending=descending)
+        return out
+
+    return apply(_f, x, op_name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    k = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def _f(a):
+        ax = -1 if axis is None else int(axis)
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return (
+            jnp.moveaxis(vals, -1, ax),
+            jnp.moveaxis(idx.astype(_cint()), -1, ax),
+        )
+
+    return apply(_f, x, op_name="topk")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _f(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        s = jnp.sort(moved, axis=-1)
+        si = jnp.argsort(moved, axis=-1)
+        v = s[..., k - 1]
+        i = si[..., k - 1].astype(_cint())
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            i = jnp.expand_dims(i, axis)
+        return v, i
+
+    return apply(_f, x, op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def _f(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        s = jnp.sort(moved, axis=-1)
+        n = s.shape[-1]
+        # count run lengths in sorted order
+        eq = s[..., :, None] == s[..., None, :]
+        counts = eq.sum(-1)
+        best = jnp.argmax(counts, axis=-1)
+        vals = jnp.take_along_axis(s, best[..., None], axis=-1)[..., 0]
+        idx = jnp.argmax(
+            (moved == vals[..., None]) * jnp.arange(n, 0, -1), axis=-1
+        )
+        idx = (n - 1) - jnp.argmax(jnp.flip(moved == vals[..., None], -1), axis=-1)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx.astype(_cint())
+
+    return apply(_f, x, op_name="mode")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y, op_name="where")
+
+
+def where_(condition, x, y, name=None):
+    return x._inplace_from(where(condition, x, y))
+
+
+def nonzero(x, as_tuple=False):
+    _require_eager("nonzero", x)
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    idx = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.reshape(-1, 1) if False else i), _internal=True) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, -1).astype(np.int64)), _internal=True)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    return apply(
+        lambda s, v: jnp.searchsorted(s, v, side="right" if right else "left").astype(
+            jnp.int32 if out_int32 else _cint()
+        )
+        if s.ndim == 1
+        else jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side="right" if right else "left"))(
+            s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape).astype(jnp.int32 if out_int32 else _cint()),
+        sorted_sequence,
+        values,
+        op_name="searchsorted",
+    )
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)

@@ -1,0 +1,64 @@
+"""Statistics ops (ref: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from .math import _norm_axis
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        lambda a: jnp.std(a, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        op_name="std",
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        lambda a: jnp.var(a, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        op_name="var",
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def _f(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_norm_axis(axis), keepdims=keepdim)
+        # 'min' mode: lower of the two middle values
+        ax = _norm_axis(axis)
+        if ax is None:
+            flat = jnp.sort(a.reshape(-1))
+            return flat[(flat.shape[0] - 1) // 2]
+        s = jnp.sort(a, axis=ax)
+        k = (a.shape[ax] - 1) // 2
+        out = jnp.take(s, k, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+
+    return apply(_f, x, op_name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply(
+        lambda a: jnp.nanmedian(a, axis=_norm_axis(axis), keepdims=keepdim),
+        x,
+        op_name="nanmedian",
+    )
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def _f(a):
+        qq = jnp.asarray(q)
+        return jnp.quantile(a, qq, axis=_norm_axis(axis), keepdims=keepdim, method=interpolation)
+
+    return apply(_f, x, op_name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def _f(a):
+        return jnp.nanquantile(a, jnp.asarray(q), axis=_norm_axis(axis), keepdims=keepdim, method=interpolation)
+
+    return apply(_f, x, op_name="nanquantile")

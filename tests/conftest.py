@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh so every parallelism path is
+exercisable without a TPU pod (SURVEY.md §4 implication (c): fake/CPU mesh
+backend). Must configure BEFORE jax initializes a backend.
+"""
+import os
+
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# the axon tunnel bakes "axon,cpu" into the config default; override it
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fixed_seed():
+    import paddle_tpu
+
+    paddle_tpu.seed(2024)
+    yield

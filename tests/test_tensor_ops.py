@@ -1,0 +1,235 @@
+"""Op unit tests vs numpy — the OpTest pattern
+(ref: test/legacy_test/op_test.py:418 check_output against numpy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, dtype=np.float32), stop_gradient=sg)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        np.testing.assert_array_equal(paddle.ones([2]).numpy(), [1, 1])
+        np.testing.assert_array_equal(paddle.full([2], 7).numpy(), [7, 7])
+        assert paddle.full([1], 7).dtype in (np.int32, np.int64)
+        assert paddle.full([1], 7.0).dtype == np.float32
+
+    def test_arange_linspace(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+        )
+
+    def test_eye_tril_triu(self):
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        a = np.arange(9).reshape(3, 3).astype(np.float32)
+        np.testing.assert_array_equal(paddle.tril(t(a)).numpy(), np.tril(a))
+        np.testing.assert_array_equal(paddle.triu(t(a), 1).numpy(), np.triu(a, 1))
+
+    def test_to_tensor_dtypes(self):
+        assert paddle.to_tensor([1, 2]).dtype == np.int64 or paddle.to_tensor([1, 2]).dtype == np.int32
+        assert paddle.to_tensor([1.0]).dtype == np.float32
+        assert paddle.to_tensor(np.float64(1.0), dtype="float64").dtype in (np.float32, np.float64)
+
+    def test_one_hot(self):
+        oh = paddle.one_hot(paddle.to_tensor([0, 2]), 3).numpy()
+        np.testing.assert_array_equal(oh, [[1, 0, 0], [0, 0, 1]])
+
+
+class TestMath:
+    def test_elementwise(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.add(t(a), t(b)).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose(paddle.multiply(t(a), t(b)).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose(paddle.maximum(t(a), t(b)).numpy(), np.maximum(a, b))
+        np.testing.assert_allclose((t(a) / (t(b) + 10)).numpy(), a / (b + 10), rtol=1e-5)
+
+    def test_unary(self):
+        a = np.random.rand(4).astype(np.float32) + 0.5
+        np.testing.assert_allclose(paddle.sqrt(t(a)).numpy(), np.sqrt(a), rtol=1e-6)
+        np.testing.assert_allclose(paddle.log(t(a)).numpy(), np.log(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.exp(t(a)).numpy(), np.exp(a), rtol=1e-6)
+        np.testing.assert_allclose(paddle.rsqrt(t(a)).numpy(), 1 / np.sqrt(a), rtol=1e-5)
+
+    def test_reductions(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.sum(t(a)).numpy(), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.mean(t(a), axis=1).numpy(), a.mean(1), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            paddle.max(t(a), axis=[0, 2]).numpy(), a.max((0, 2)), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            paddle.sum(t(a), axis=-1, keepdim=True).numpy(),
+            a.sum(-1, keepdims=True),
+            rtol=1e-5,
+        )
+
+    def test_cumsum_clip(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.cumsum(t(a), axis=1).numpy(), a.cumsum(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.clip(t(a), -0.5, 0.5).numpy(), a.clip(-0.5, 0.5))
+
+    def test_logsumexp(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        got = paddle.logsumexp(t(a), axis=1).numpy()
+        want = np.log(np.exp(a).sum(1))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+        assert paddle.reshape(t(a), [4, 6]).shape == [4, 6]
+        np.testing.assert_array_equal(
+            paddle.transpose(t(a), [2, 0, 1]).numpy(), a.transpose(2, 0, 1)
+        )
+        assert paddle.flatten(t(a), 1, 2).shape == [2, 12]
+
+    def test_concat_stack_split(self):
+        a = np.ones((2, 3), np.float32)
+        b = np.zeros((2, 3), np.float32)
+        assert paddle.concat([t(a), t(b)], axis=0).shape == [4, 3]
+        assert paddle.stack([t(a), t(b)], axis=1).shape == [2, 2, 3]
+        parts = paddle.split(t(np.arange(12).reshape(6, 2).astype(np.float32)), 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        parts = paddle.split(t(np.arange(12).reshape(6, 2).astype(np.float32)), [1, 2, -1], axis=0)
+        assert [p.shape[0] for p in parts] == [1, 2, 3]
+
+    def test_squeeze_unsqueeze_expand(self):
+        a = np.ones((1, 3, 1), np.float32)
+        assert paddle.squeeze(t(a)).shape == [3]
+        assert paddle.squeeze(t(a), axis=0).shape == [3, 1]
+        assert paddle.unsqueeze(t(np.ones(3, np.float32)), [0, 2]).shape == [1, 3, 1]
+        assert paddle.expand(t(np.ones((1, 3), np.float32)), [4, 3]).shape == [4, 3]
+
+    def test_gather_scatter(self):
+        a = np.arange(12).reshape(4, 3).astype(np.float32)
+        np.testing.assert_array_equal(
+            paddle.gather(t(a), paddle.to_tensor([0, 2])).numpy(), a[[0, 2]]
+        )
+        upd = paddle.scatter(
+            t(np.zeros((4, 2), np.float32)),
+            paddle.to_tensor([1, 3]),
+            t(np.ones((2, 2), np.float32)),
+        )
+        want = np.zeros((4, 2)); want[[1, 3]] = 1
+        np.testing.assert_array_equal(upd.numpy(), want)
+
+    def test_getitem_setitem(self):
+        a = np.arange(12).reshape(3, 4).astype(np.float32)
+        x = t(a)
+        np.testing.assert_array_equal(x[1].numpy(), a[1])
+        np.testing.assert_array_equal(x[:, 1:3].numpy(), a[:, 1:3])
+        np.testing.assert_array_equal(x[paddle.to_tensor([0, 2])].numpy(), a[[0, 2]])
+        x[0, 0] = 99.0
+        assert x.numpy()[0, 0] == 99.0
+
+    def test_getitem_grad(self):
+        x = t(np.arange(6).reshape(2, 3), sg=False)
+        x[0].sum().backward()
+        np.testing.assert_array_equal(x.grad.numpy(), [[1, 1, 1], [0, 0, 0]])
+
+    def test_tile_flip_roll(self):
+        a = np.arange(6).reshape(2, 3).astype(np.float32)
+        np.testing.assert_array_equal(paddle.tile(t(a), [2, 1]).numpy(), np.tile(a, (2, 1)))
+        np.testing.assert_array_equal(paddle.flip(t(a), [0]).numpy(), a[::-1])
+        np.testing.assert_array_equal(paddle.roll(t(a), 1, 1).numpy(), np.roll(a, 1, 1))
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(t(a), t(b.T), transpose_y=True).numpy(), a @ b, rtol=1e-5
+        )
+
+    def test_batched_matmul(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.bmm(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+
+    def test_norm_solve(self):
+        a = np.random.randn(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = np.random.randn(3, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.norm(t(b)).numpy(), np.linalg.norm(b), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            paddle.solve(t(a), t(b)).numpy(), np.linalg.solve(a, b), rtol=1e-3
+        )
+
+    def test_einsum(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(), a @ b, rtol=1e-5
+        )
+
+    def test_matmul_grad(self):
+        a = t(np.random.randn(2, 3), sg=False)
+        b = t(np.random.randn(3, 2), sg=False)
+        paddle.matmul(a, b).sum().backward()
+        np.testing.assert_allclose(
+            a.grad.numpy(), np.ones((2, 2)) @ b.numpy().T, rtol=1e-5
+        )
+
+
+class TestSearchLogic:
+    def test_argmax_topk_sort(self):
+        a = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+        np.testing.assert_array_equal(paddle.argmax(t(a), axis=1).numpy(), [0, 1])
+        vals, idx = paddle.topk(t(a), 2, axis=1)
+        np.testing.assert_array_equal(vals.numpy(), [[3, 2], [5, 4]])
+        np.testing.assert_array_equal(paddle.sort(t(a), axis=1).numpy(), np.sort(a, 1))
+
+    def test_where_comparisons(self):
+        a = np.array([1.0, -2.0, 3.0], np.float32)
+        x = t(a)
+        np.testing.assert_array_equal((x > 0).numpy(), a > 0)
+        np.testing.assert_array_equal(
+            paddle.where(x > 0, x, -x).numpy(), np.abs(a)
+        )
+
+    def test_nonzero_eager(self):
+        a = np.array([0.0, 1.0, 0.0, 2.0], np.float32)
+        nz = paddle.nonzero(t(a))
+        np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+    def test_masked_select_eager(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        out = paddle.masked_select(t(a), paddle.to_tensor([True, False, True]))
+        np.testing.assert_array_equal(out.numpy(), [1.0, 3.0])
+
+
+class TestRandom:
+    def test_seeded_reproducible(self):
+        paddle.seed(7)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_shapes_ranges(self):
+        u = paddle.uniform([100], min=0.0, max=1.0).numpy()
+        assert (u >= 0).all() and (u < 1).all()
+        r = paddle.randint(0, 5, [50]).numpy()
+        assert (r >= 0).all() and (r < 5).all()
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+
+class TestStat:
+    def test_std_var_median(self):
+        a = np.random.randn(3, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.std(t(a)).numpy(), a.std(ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(paddle.var(t(a), axis=1).numpy(), a.var(1, ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(paddle.median(t(a)).numpy(), np.median(a), rtol=1e-5)
