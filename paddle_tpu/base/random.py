@@ -16,6 +16,7 @@ reference's stateful Philox offset.
 from __future__ import annotations
 
 import contextlib
+import zlib
 from typing import Dict
 
 import jax
@@ -114,9 +115,13 @@ class RNGStatesTracker:
         """Swap the default generator for the named branch inside the ctx."""
         global _default_generator
         if name not in self._states:
-            # lazily branch off the default seed, folding in the name hash
+            # lazily branch off the default seed, folding in a deterministic
+            # digest of the name — hash() is randomized per process
+            # (PYTHONHASHSEED) and would silently desynchronize the
+            # documented cross-rank invariant of the global branch
             self._states[name] = Generator(
-                (_default_generator.initial_seed() + (hash(name) % 2**31)) % 2**31
+                (_default_generator.initial_seed() + zlib.crc32(name.encode()))
+                % 2**31
             )
         prev = _default_generator
         _default_generator = self._states[name]

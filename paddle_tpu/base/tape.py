@@ -23,6 +23,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import threading
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -171,6 +172,9 @@ def apply(fn: Callable, *args, op_name: str = "", **kwargs):
         name=op_name or getattr(fn, "__name__", "op"),
         fwd_fn=closure,
     )
+    node_ref = weakref.ref(node)
+    for inp in node.inputs:
+        inp._consumer_nodes.append(node_ref)
     return _wrap_outputs(out, node=node, op_name=op_name)
 
 

@@ -37,6 +37,7 @@ class Tensor:
         "_out_index",
         "_grad_hooks",
         "_retain_grads",
+        "_consumer_nodes",
         "name",
         "persistable",
         "_dist_attr",
@@ -77,6 +78,7 @@ class Tensor:
         self._out_index = 0
         self._grad_hooks = []
         self._retain_grads = False
+        self._consumer_nodes = []  # weakrefs to TapeNodes that consumed self
         self.name = name or f"tensor_{next(_tensor_counter)}"
         self.persistable = persistable
         self._dist_attr = None
@@ -256,12 +258,39 @@ class Tensor:
     def _inplace_from(self, result: "Tensor") -> "Tensor":
         """Adopt result's value+tape edge (functional in-place).
 
-        If the producing node lists *this* object among its inputs (e.g.
-        ``y += 1``), swap that edge to a snapshot of the pre-update tensor
-        — otherwise rebinding our _grad_node would create a self-loop.
+        Any tape node that consumed the *pre-mutation* value — including
+        the node producing ``result`` itself (``y += 1``) — must keep an
+        edge to that value, or cotangents arriving from earlier consumers
+        would be routed to the post-mutation node and silently dropped
+        (the reference guards this with a tensor inplace-version counter,
+        ref: fluid/eager/tensor_wrapper.h). We snapshot the old value and
+        swap ``self``→``snapshot`` in every live consumer's input list;
+        if the pre-mutation tensor was a differentiable leaf, a grad hook
+        on the snapshot routes its accumulated grad back to ``self.grad``.
         """
         node = result._grad_node
-        if node is not None and any(inp is self for inp in node.inputs):
+        if self._consumer_nodes and (node is not None or not self.stop_gradient):
+            snapshot = Tensor(self._data, stop_gradient=self.stop_gradient, _internal=True)
+            snapshot._grad_node = self._grad_node
+            snapshot._out_index = self._out_index
+            snapshot._consumer_nodes = self._consumer_nodes
+            self._consumer_nodes = []
+            for ref in snapshot._consumer_nodes:
+                n = ref()
+                if n is not None and any(inp is self for inp in n.inputs):
+                    n.inputs = tuple(
+                        snapshot if inp is self else inp for inp in n.inputs
+                    )
+            if snapshot._grad_node is None and not snapshot.stop_gradient:
+                owner = self
+
+                def _route_leaf_grad(g, _owner=owner):
+                    _owner._grad = g if _owner._grad is None else _owner._grad + g
+                    return None
+
+                snapshot._grad_hooks = list(self._grad_hooks) + [_route_leaf_grad]
+        elif node is not None and any(inp is self for inp in node.inputs):
+            # no earlier consumers: just break the self-loop
             snapshot = Tensor(self._data, stop_gradient=self.stop_gradient, _internal=True)
             snapshot._grad_node = self._grad_node
             snapshot._out_index = self._out_index
@@ -271,6 +300,8 @@ class Tensor:
         self._data = result._data
         self._grad_node = result._grad_node
         self._out_index = result._out_index
+        if node is not None:
+            self._consumer_nodes = []
         self.stop_gradient = result.stop_gradient and self.stop_gradient
         return self
 

@@ -414,10 +414,15 @@ def as_strided(x, shape, stride, offset=0, name=None):
 
 def unfold(x, axis, size, step, name=None):
     def _f(a):
-        n = (a.shape[axis] - size) // step + 1
+        ax = axis % a.ndim
+        n = (a.shape[ax] - size) // step + 1
         starts = np.arange(n) * step
-        slices = [jnp.take(a, jnp.arange(s, s + size), axis=axis) for s in starts]
-        return jnp.stack(slices, axis=axis)
+        # window-content dim goes LAST (reference layout: view_as_windows)
+        slices = [
+            jnp.moveaxis(jnp.take(a, jnp.arange(s, s + size), axis=ax), ax, -1)
+            for s in starts
+        ]
+        return jnp.stack(slices, axis=ax)
 
     return apply(_f, x, op_name="unfold")
 
@@ -484,16 +489,32 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False, axi
 
 def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
     _require_eager("unique_consecutive", x)
-    a = np.asarray(x._data if isinstance(x, Tensor) else x).reshape(-1) if axis is None else np.asarray(x._data)
-    keep = np.concatenate([[True], a[1:] != a[:-1]]) if a.ndim == 1 else None
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.concatenate([[True], a[1:] != a[:-1]])
+        n = a.shape[0]
+    else:
+        axis = axis % a.ndim
+        a = np.moveaxis(a, axis, 0)
+        n = a.shape[0]
+        if n == 0:
+            keep = np.zeros((0,), dtype=bool)
+        else:
+            diff = a[1:] != a[:-1]
+            keep = np.concatenate(
+                [[True], diff.reshape(n - 1, -1).any(axis=1) if n > 1 else np.zeros((0,), bool)]
+            )
     vals = a[keep]
+    if axis is not None:
+        vals = np.moveaxis(vals, 0, axis)
     outs = [Tensor(jnp.asarray(vals), _internal=True)]
     if return_inverse:
         inv = np.cumsum(keep) - 1
         outs.append(Tensor(jnp.asarray(inv), _internal=True))
     if return_counts:
         idx = np.nonzero(keep)[0]
-        counts = np.diff(np.append(idx, len(a)))
+        counts = np.diff(np.append(idx, n))
         outs.append(Tensor(jnp.asarray(counts), _internal=True))
     return tuple(outs) if len(outs) > 1 else outs[0]
 

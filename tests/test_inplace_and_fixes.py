@@ -1,0 +1,77 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md).
+
+Covers: in-place mutation after a tensor was consumed (grad routing to the
+pre-mutation value), unfold window-dim layout, deterministic lazy RNG
+branches, unique_consecutive with axis.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestInplaceGradRouting:
+    def test_inplace_after_consume_leaf(self):
+        # y depends on pre-mutation x; grad must still reach x.grad
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        y = x * 3
+        x += 1
+        y.sum().backward()
+        assert x.grad is not None
+        np.testing.assert_allclose(x.grad.numpy(), 3.0)
+
+    def test_inplace_pre_and_post_paths_accumulate(self):
+        a = paddle.to_tensor(2.0, stop_gradient=False)
+        b = a * a          # db/da = 2a = 4 (pre-mutation value)
+        a += 1             # a: 2 -> 3
+        c = a * 5          # dc/da = 5 through the += edge
+        (b + c).backward()
+        np.testing.assert_allclose(a.grad.numpy(), 9.0)
+
+    def test_inplace_self_loop_still_works(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        x += 1
+        (x * 2).backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2.0)
+
+    def test_inplace_nonleaf_routing(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        h = x * 2          # h = 4, non-leaf
+        y = h * 3          # consumed pre-mutation h
+        h += 1             # h mutated after consumption
+        z = h * 10
+        (y + z).backward()
+        # dy/dx = 6; dz/dx = 10 * d(h+1)/dx = 20 -> 26
+        np.testing.assert_allclose(x.grad.numpy(), 26.0)
+
+
+def test_unfold_window_dim_last():
+    t = paddle.to_tensor(np.arange(12).reshape(4, 3).astype("float32"))
+    u = paddle.unfold(t, 0, 2, 2)
+    assert u.shape == [2, 3, 2]
+    np.testing.assert_array_equal(u.numpy()[0, :, 0], [0, 1, 2])
+    np.testing.assert_array_equal(u.numpy()[0, :, 1], [3, 4, 5])
+    # last axis keeps old behavior shape
+    u2 = paddle.unfold(t, 1, 2, 1)
+    assert u2.shape == [4, 2, 2]
+
+
+def test_unique_consecutive_axis():
+    t = paddle.to_tensor(np.array([[1, 1], [1, 1], [2, 2]]))
+    v, counts = paddle.unique_consecutive(t, return_counts=True, axis=0)
+    np.testing.assert_array_equal(v.numpy(), [[1, 1], [2, 2]])
+    np.testing.assert_array_equal(counts.numpy(), [2, 1])
+
+
+def test_rng_lazy_branch_deterministic():
+    from paddle_tpu.base.random import RNGStatesTracker, get_rng_state_tracker
+
+    paddle.seed(123)
+    tr = get_rng_state_tracker()
+    with tr.rng_state("some_branch"):
+        a = paddle.rand([4]).numpy()
+    paddle.seed(123)
+    tr.reset()
+    with tr.rng_state("some_branch"):
+        b = paddle.rand([4]).numpy()
+    np.testing.assert_array_equal(a, b)
