@@ -1,0 +1,48 @@
+"""ParamAttr — per-parameter configuration.
+
+ref: python/paddle/base/param_attr.py (ParamAttr). Carries name,
+initializer, learning-rate scale, regularizer, trainable flag and
+do_model_average/need_clip knobs used by layers when creating parameters.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ParamAttr:
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        initializer=None,
+        learning_rate: float = 1.0,
+        regularizer=None,
+        trainable: bool = True,
+        do_model_average: bool = True,
+        need_clip: bool = True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr) -> Optional["ParamAttr"]:
+        """Normalize user input: None → default attr; False → no parameter;
+        str → named attr; Initializer → attr with that initializer."""
+        if attr is None:
+            return ParamAttr()
+        if attr is False:
+            return None
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        # assume it is an initializer instance
+        return ParamAttr(initializer=attr)
+
+
+def _to_attr(attr):
+    return ParamAttr._to_attr(attr)

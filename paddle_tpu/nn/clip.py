@@ -1,0 +1,126 @@
+"""Gradient clipping strategies.
+
+ref: python/paddle/nn/clip.py (ClipGradByValue, ClipGradByNorm,
+ClipGradByGlobalNorm). Each is a callable applied by the optimizer to
+the (param, grad) list before the update; global-norm computes one
+fp32 norm over all grads (single fused XLA reduction on TPU — and,
+under hybrid parallel, the HybridParallelOptimizer wraps this with the
+cross-mesh-axis allreduce, ref: hybrid_parallel_optimizer.py:255).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base.tape import apply
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm", "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+    def _clip(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, apply(lambda a: jnp.clip(a, self.min, self.max), g, op_name="clip_by_value")))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def _f(a):
+                norm = jnp.sqrt(jnp.sum(jnp.square(a.astype(jnp.float32))))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+                return (a.astype(jnp.float32) * scale).astype(a.dtype)
+
+            out.append((p, apply(_f, g, op_name="clip_by_norm")))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _clip(self, params_grads):
+        clippable = [(p, g) for p, g in params_grads if g is not None and getattr(p, "need_clip", True)]
+        if not clippable:
+            return params_grads
+        grads = [g for _, g in clippable]
+
+        def _sq(a):
+            return jnp.sum(jnp.square(a.astype(jnp.float32)))
+
+        sq_sums = [apply(_sq, g, op_name="sq_sum") for g in grads]
+        total = sq_sums[0]
+        for s in sq_sums[1:]:
+            total = total + s
+        global_norm = apply(lambda t: jnp.sqrt(t), total, op_name="global_norm")
+        scale = apply(
+            lambda n: jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0),
+            global_norm,
+            op_name="clip_scale",
+        )
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, apply(lambda a, s: (a.astype(jnp.float32) * s).astype(a.dtype), g, scale, op_name="apply_clip")))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """torch-style utility (ref: python/paddle/nn/utils/clip_grad_norm_.py)."""
+    if not isinstance(parameters, (list, tuple)):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return None
+    import numpy as np
+
+    if norm_type == float("inf"):
+        total = max(float(jnp.max(jnp.abs(g._data))) for g in grads)
+    else:
+        total = float(
+            sum(jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** norm_type) for g in grads)
+            ** (1.0 / norm_type)
+        )
+    scale = max_norm / (total + 1e-6)
+    if scale < 1.0:
+        for p in parameters:
+            if p.grad is not None:
+                p.grad._data = (p.grad._data.astype(jnp.float32) * scale).astype(p.grad._data.dtype)
+    from ..base.tensor import Tensor
+
+    return Tensor(total, _internal=True)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if not isinstance(parameters, (list, tuple)):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)

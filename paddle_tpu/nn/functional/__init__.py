@@ -1,0 +1,14 @@
+"""paddle_tpu.nn.functional — functional op surface.
+
+ref: python/paddle/nn/functional/__init__.py. All functions lower to
+jnp/lax through the autograd tape (paddle_tpu.base.tape.apply).
+"""
+from .activation import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+
+from . import activation, attention, common, conv, loss, norm, pooling  # noqa: F401

@@ -1,0 +1,133 @@
+"""Attention functionals.
+
+ref: python/paddle/nn/functional/flash_attention.py:198
+(flash_attention / scaled_dot_product_attention wrapping the external
+FlashAttention-2 CUDA library via phi flash_attn kernels).
+
+TPU-native design: one public entry, ``scaled_dot_product_attention``,
+that dispatches to
+- a **Pallas flash-attention kernel** (paddle_tpu.ops.flash_attention)
+  when running on TPU with supported shapes/dtypes, and
+- a reference jnp implementation otherwise (CPU tests, odd shapes).
+Layout follows the reference: [batch, seq, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...base.tape import apply
+from ...base.tensor import Tensor
+
+__all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_kernel", "flash_attn_qkvpacked"]
+
+
+def _naive_attention(q, k, v, mask, dropout_p, causal, scale, key):
+    """Reference jnp path; q/k/v: [B, S, H, D] (paddle flash-attn layout)."""
+    qh = jnp.swapaxes(q, 1, 2)  # [B, H, S, D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    # GQA: broadcast kv heads over query-head groups
+    if kh.shape[1] != qh.shape[1]:
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    logits = logits.astype(jnp.float32)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), jnp.zeros((), probs.dtype))
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)  # back to [B, S, H, D]
+
+
+def _use_pallas(q_shape, dtype, mask, dropout_p) -> bool:
+    if mask is not None or dropout_p > 0:
+        return False
+    try:
+        d = jax.devices()[0]
+        if d.platform not in ("tpu",):
+            return False
+    except Exception:
+        return False
+    head_dim = q_shape[-1]
+    return head_dim in (64, 128, 256) and q_shape[1] % 128 == 0
+
+
+def scaled_dot_product_attention(
+    query,
+    key,
+    value,
+    attn_mask=None,
+    dropout_p=0.0,
+    is_causal=False,
+    training=True,
+    name=None,
+):
+    """ref: python/paddle/nn/functional/flash_attention.py
+    scaled_dot_product_attention. Input layout [B, S, H, D]."""
+    from ...base import random as _random
+
+    if not training:
+        dropout_p = 0.0
+    rng_key = _random.next_key() if dropout_p > 0 else None
+
+    if _use_pallas(tuple(query.shape), query.dtype, attn_mask, dropout_p):
+        try:
+            from ...ops.flash_attention import flash_attention_fwd
+
+            def _pallas(qq, kk, vv):
+                return flash_attention_fwd(qq, kk, vv, causal=is_causal)
+
+            return apply(_pallas, query, key, value, op_name="flash_attention")
+        except Exception:
+            pass  # fall through to the jnp path
+
+    def _f(qq, kk, vv, *maybe_mask):
+        m = maybe_mask[0] if maybe_mask else None
+        return _naive_attention(qq, kk, vv, m, dropout_p, is_causal, None, rng_key)
+
+    args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())
+    return apply(_f, *args, op_name="scaled_dot_product_attention")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """ref: flash_attention.py:198 — same output tuple (out, softmax)."""
+    out = scaled_dot_product_attention(
+        query, key, value, None, dropout, causal, training
+    )
+    return out, None
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False, fixed_seed_offset=None, rng_name="", training=True, name=None):
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout, causal, return_softmax, fixed_seed_offset, rng_name, training, name)
+
+
+class sdp_kernel:
+    """Context selecting the attention backend (parity shim; TPU picks
+    automatically between Pallas and jnp)."""
+
+    def __init__(self, enable_flash=True, enable_math=True, enable_mem_efficient=True):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,409 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate, …
+
+ref: python/paddle/nn/functional/common.py + input.py. TPU notes:
+- ``linear`` is a single jnp.matmul so XLA maps it onto the MXU and fuses
+  the bias add (no fused-op kernel needed, SURVEY §7.1).
+- ``dropout`` draws from the framework Generator (splittable key), so it
+  is reproducible and decorrelated across TP ranks via RNGStatesTracker.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...base import random as _random
+from ...base.tape import apply
+from ...base.tensor import Tensor
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "pad",
+    "embedding", "one_hot", "interpolate", "upsample", "cosine_similarity",
+    "normalize", "unfold", "fold", "pixel_shuffle", "pixel_unshuffle",
+    "channel_shuffle", "label_smooth", "bilinear", "class_center_sample",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b); W is [in_features, out_features] (paddle layout)."""
+    if bias is None:
+        return apply(lambda a, w: jnp.matmul(a, w), x, weight, op_name="linear")
+    return apply(lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias, op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or (isinstance(p, (int, float)) and p == 0):
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda a: a * (1.0 - p), x, op_name="dropout_infer")
+        return x
+
+    if isinstance(p, Tensor):
+        p = float(p.item())
+    if not 0 <= p < 1:
+        if p == 1:
+            return apply(lambda a: jnp.zeros_like(a), x, op_name="dropout")
+        raise ValueError(f"dropout p must be in [0,1], got {p}")
+
+    key = _random.next_key()
+
+    def _f(a):
+        if axis is None:
+            mask_shape = a.shape
+        else:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            mask_shape = tuple(
+                a.shape[i] if i in axes else 1 for i in range(a.ndim)
+            )
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+
+    return apply(_f, x, op_name="dropout")
+
+
+def _dropout_nd(x, p, training, data_format, ndim_spatial, name):
+    if not training or p == 0:
+        return x
+    key = _random.next_key()
+
+    def _f(a):
+        if data_format.startswith("NC"):
+            mask_shape = a.shape[:2] + (1,) * ndim_spatial
+        else:
+            mask_shape = (a.shape[0],) + (1,) * ndim_spatial + (a.shape[-1],)
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+
+    return apply(_f, x, op_name="dropout_nd")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return _dropout_nd(x, p, training, data_format, 2, name)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    return _dropout_nd(x, p, training, data_format, 3, name)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout (ref: common.py alpha_dropout)."""
+    if not training or p == 0:
+        return x
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    alpha_p = -alpha * scale
+    a_coef = ((1 - p) * (1 + p * alpha_p**2)) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+    key = _random.next_key()
+
+    def _f(t):
+        keep = jax.random.bernoulli(key, 1.0 - p, t.shape)
+        return a_coef * jnp.where(keep, t, jnp.asarray(alpha_p, t.dtype)) + b_coef
+
+    return apply(_f, x, op_name="alpha_dropout")
+
+
+_PAD_MODES = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True, name=None):  # noqa: A002
+    """ref: python/paddle/nn/functional/common.py pad.
+
+    ``pad`` may cover all axes (len == 2*ndim, paired low/high from the
+    first axis) or only the spatial axes in data_format order (reversed,
+    last-axis-first, like the reference/torch convention).
+    """
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = list(int(p) for p in pad)
+    jmode = _PAD_MODES.get(mode)
+    if jmode is None:
+        raise ValueError(f"unsupported pad mode {mode!r}")
+
+    def _f(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            if pad_from_left_axis:
+                widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+            else:
+                widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)][::-1]
+        else:
+            n_spatial = len(pad) // 2
+            widths = [(0, 0)] * nd
+            if data_format.startswith("NC"):
+                spatial_axes = list(range(2, 2 + (nd - 2)))
+            else:
+                spatial_axes = list(range(1, 1 + (nd - 2)))
+            # reference pads last spatial axis first
+            for i in range(n_spatial):
+                ax = spatial_axes[-(i + 1)]
+                widths[ax] = (pad[2 * i], pad[2 * i + 1])
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode="constant", constant_values=jnp.asarray(value, a.dtype))
+        return jnp.pad(a, widths, mode=jmode)
+
+    return apply(_f, x, op_name="pad")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, max_norm=None, norm_type=2.0, name=None):
+    def _f(w, ids):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            pidx = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            mask = (ids == pidx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+
+    return apply(_f, weight, x, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(
+        lambda ids: jax.nn.one_hot(ids.astype(jnp.int32), num_classes, dtype=jnp.float32),
+        x,
+        op_name="one_hot",
+    )
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _f(lbl, *maybe_prior):
+        k = lbl.shape[-1]
+        if maybe_prior:
+            return (1 - epsilon) * lbl + epsilon * maybe_prior[0]
+        return (1 - epsilon) * lbl + epsilon / k
+
+    if prior_dist is not None:
+        return apply(_f, label, prior_dist, op_name="label_smooth")
+    return apply(_f, label, op_name="label_smooth")
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    """ref: common.py interpolate — nearest/bilinear/bicubic/trilinear/area
+    via jax.image.resize (area ≈ 'linear' antialiased reduction)."""
+    if isinstance(size, Tensor):
+        size = size.tolist()
+
+    def _f(a):
+        channels_last = not data_format.startswith("NC")
+        nd_spatial = a.ndim - 2
+        if channels_last:
+            spatial = a.shape[1:-1]
+        else:
+            spatial = a.shape[2:]
+        if size is not None:
+            out_spatial = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd_spatial
+            out_spatial = tuple(int(np.floor(s * f)) for s, f in zip(spatial, sf))
+        if channels_last:
+            out_shape = (a.shape[0],) + out_spatial + (a.shape[-1],)
+        else:
+            out_shape = a.shape[:2] + out_spatial
+        method = {
+            "nearest": "nearest",
+            "bilinear": "bilinear",
+            "bicubic": "bicubic",
+            "trilinear": "trilinear",
+            "linear": "linear",
+            "area": "linear",
+        }[mode]
+        if method == "trilinear":
+            method = "linear"
+        if mode != "nearest" and align_corners:
+            # jax.image.resize has no align_corners; emulate with explicit
+            # coordinate gather for the bilinear 2-D case
+            if nd_spatial == 2 and method in ("bilinear", "linear"):
+                return _bilinear_align_corners(a, out_spatial, channels_last)
+        return jax.image.resize(a, out_shape, method=method)
+
+    return apply(_f, x, op_name="interpolate")
+
+
+def _bilinear_align_corners(a, out_spatial, channels_last):
+    if channels_last:
+        a = jnp.moveaxis(a, -1, 1)
+    N, C, H, W = a.shape
+    oh, ow = out_spatial
+    ys = jnp.linspace(0, H - 1, oh)
+    xs = jnp.linspace(0, W - 1, ow)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy = (ys - y0).reshape(1, 1, -1, 1).astype(a.dtype)
+    wx = (xs - x0).reshape(1, 1, 1, -1).astype(a.dtype)
+    v00 = a[:, :, y0][:, :, :, x0]
+    v01 = a[:, :, y0][:, :, :, x1]
+    v10 = a[:, :, y1][:, :, :, x0]
+    v11 = a[:, :, y1][:, :, :, x1]
+    out = (
+        v00 * (1 - wy) * (1 - wx)
+        + v01 * (1 - wy) * wx
+        + v10 * wy * (1 - wx)
+        + v11 * wy * wx
+    )
+    if channels_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format, name)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def _f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply(_f, x1, x2, op_name="cosine_similarity")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _f(a):
+        norm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(norm, epsilon)
+
+    return apply(_f, x, op_name="normalize")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (ref: common.py unfold): NCHW → [N, C*kh*kw, L]."""
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    pads = _pair(paddings)
+    if len(pads) == 2:
+        pt, pb, pl, pr = pads[0], pads[0], pads[1], pads[1]
+    else:
+        pt, pb, pl, pr = pads
+
+    def _f(a):
+        N, C, H, W = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+        Hp, Wp = a.shape[2], a.shape[3]
+        oh = (Hp - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (Wp - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (kh, kw), (sh, sw), padding="VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )  # [N, C*kh*kw, oh, ow]
+        return patches.reshape(N, C * kh * kw, oh * ow)
+
+    return apply(_f, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im: inverse of unfold (sum of overlapping patches)."""
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    pads = _pair(paddings)
+    if len(pads) == 2:
+        pt, pb, pl, pr = pads[0], pads[0], pads[1], pads[1]
+    else:
+        pt, pb, pl, pr = pads
+
+    def _f(cols):
+        N = cols.shape[0]
+        C = cols.shape[1] // (kh * kw)
+        Hp, Wp = oh + pt + pb, ow + pl + pr
+        nh = (Hp - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (Wp - (dw * (kw - 1) + 1)) // sw + 1
+        cols_r = cols.reshape(N, C, kh, kw, nh, nw)
+        out = jnp.zeros((N, C, Hp, Wp), cols.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i * dh : i * dh + nh * sh : sh, j * dw : j * dw + nw * sw : sw].add(
+                    cols_r[:, :, i, j]
+                )
+        return out[:, :, pt : pt + oh, pl : pl + ow]
+
+    return apply(_f, x, op_name="fold")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def _f(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            a = a.reshape(N, C // (r * r), r, r, H, W)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(N, C // (r * r), H * r, W * r)
+        N, H, W, C = a.shape
+        a = a.reshape(N, H, W, C // (r * r), r, r)
+        a = a.transpose(0, 1, 4, 2, 5, 3)
+        return a.reshape(N, H * r, W * r, C // (r * r))
+
+    return apply(_f, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def _f(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            a = a.reshape(N, C, H // r, r, W // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(N, C * r * r, H // r, W // r)
+        N, H, W, C = a.shape
+        a = a.reshape(N, H // r, r, W // r, r, C)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        return a.reshape(N, H // r, W // r, C * r * r)
+
+    return apply(_f, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _f(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            return a.reshape(N, groups, C // groups, H, W).transpose(0, 2, 1, 3, 4).reshape(N, C, H, W)
+        N, H, W, C = a.shape
+        return a.reshape(N, H, W, groups, C // groups).transpose(0, 1, 2, 4, 3).reshape(N, H, W, C)
+
+    return apply(_f, x, op_name="channel_shuffle")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[n, o] = x1[n] @ W[o] @ x2[n] (+ b) (ref: common.py bilinear)."""
+
+    def _f(a, b, w, *maybe_bias):
+        out = jnp.einsum("ni,oij,nj->no", a, w, b)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+
+    if bias is not None:
+        return apply(_f, x1, x2, weight, bias, op_name="bilinear")
+    return apply(_f, x1, x2, weight, op_name="bilinear")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample is a PartialFC training op; use full-class "
+        "margin softmax on TPU (MXU-friendly) instead."
+    )

@@ -1,0 +1,159 @@
+"""Convolution functionals over lax.conv_general_dilated.
+
+ref: python/paddle/nn/functional/conv.py (conv2d etc. → phi conv kernels /
+cudnn). On TPU the single XLA convolution primitive covers all of
+cudnn's algo zoo — XLA tiles it onto the MXU; weight layout is paddle's
+[out_c, in_c/groups, *kernel] mapped via dimension_numbers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...base.tape import apply
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _norm_padding(padding, n):
+    """paddle padding: int | list[n] | list[2n] | [[lo,hi],...] | 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if padding and isinstance(padding[0], (list, tuple)):
+        # may include batch/channel dims pairs; keep the last n pairs
+        pairs = [tuple(int(x) for x in p) for p in padding]
+        return pairs[-n:]
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, n, name):
+    strides = _tuple(stride, n)
+    dilations = _tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    spatial = "DHW"[-n:]
+    if data_format.startswith("NC"):
+        lhs_spec = "NC" + spatial
+    else:
+        lhs_spec = "N" + spatial + "C"
+    dn = (lhs_spec, "OI" + spatial, lhs_spec)
+
+    def _f(a, w, *maybe_b):
+        out = jax.lax.conv_general_dilated(
+            a, w,
+            window_strides=strides,
+            padding=pad,
+            rhs_dilation=dilations,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[1 if data_format.startswith("NC") else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(_f, *args, op_name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    fmt = "NCH" if data_format in ("NCL", "NCH") else "NHC"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, fmt, 1, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3, "conv3d")
+
+
+def _conv_transpose_nd(
+    x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, output_size, n, name
+):
+    strides = _tuple(stride, n)
+    dilations = _tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    out_pad = _tuple(output_padding, n) if output_padding is not None else (0,) * n
+    spatial = "DHW"[-n:]
+    lhs_spec = ("NC" + spatial) if data_format.startswith("NC") else ("N" + spatial + "C")
+    # paddle transpose-conv weight layout: [in_c, out_c/groups, *kernel] → "IO"
+    dn = (lhs_spec, "IO" + spatial, lhs_spec)
+
+    def _f(a, w, *maybe_b):
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            # conv_transpose pad semantics: effective output crop
+            padding_cfg = [
+                (
+                    dilations[i] * (w.shape[2 + i] - 1) - pad[i][0],
+                    dilations[i] * (w.shape[2 + i] - 1) - pad[i][1] + out_pad[i],
+                )
+                for i in range(n)
+            ]
+        if groups > 1:
+            # grouped transpose: split I axis; lax transpose has no
+            # feature_group_count for IO layout, do per-group and concat
+            a_groups = jnp.split(a, groups, axis=1 if lhs_spec.startswith("NC") else -1)
+            w_groups = jnp.split(w, groups, axis=0)
+            outs = [
+                jax.lax.conv_general_dilated(
+                    ag, wg, window_strides=(1,) * n, padding=padding_cfg,
+                    lhs_dilation=strides, rhs_dilation=dilations,
+                    dimension_numbers=dn, transpose_kernel=True,
+                )
+                for ag, wg in zip(a_groups, w_groups)
+            ]
+            out = jnp.concatenate(outs, axis=1 if lhs_spec.startswith("NC") else -1)
+        else:
+            out = jax.lax.conv_general_dilated(
+                a, w, window_strides=(1,) * n, padding=padding_cfg,
+                lhs_dilation=strides, rhs_dilation=dilations,
+                dimension_numbers=dn, transpose_kernel=True,
+            )
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[1 if lhs_spec.startswith("NC") else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(_f, *args, op_name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    fmt = "NCH" if data_format in ("NCL", "NCH") else "NHC"
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, fmt, output_size, 1, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, output_size, 2, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, output_size, 3, "conv3d_transpose")

@@ -1,0 +1,288 @@
+"""Loss functionals.
+
+ref: python/paddle/nn/functional/loss.py. cross_entropy keeps the
+reference's combined softmax+CE surface (use_softmax, soft_label,
+ignore_index, weight, label_smoothing) but lowers to one fused
+log_softmax+gather — a single XLA fusion on TPU instead of the
+softmax_with_cross_entropy CUDA kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...base.tape import apply
+from ...base.tensor import Tensor
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "cosine_embedding_loss",
+    "hinge_embedding_loss", "triplet_margin_loss", "log_loss", "square_error_cost",
+    "sigmoid_focal_loss", "softmax_with_cross_entropy", "poisson_nll_loss",
+    "multi_label_soft_margin_loss", "soft_margin_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(
+    input,  # noqa: A002
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    def _f(logits, lbl, *maybe_w):
+        ax = axis % logits.ndim
+        num_classes = logits.shape[ax]
+        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax else jnp.log(
+            jnp.clip(logits, 1e-15, 1.0)
+        )
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape[ax] == num_classes and np.dtype(lbl.dtype).kind == "f"):
+            soft = lbl
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / num_classes
+            loss = -jnp.sum(soft * logp, axis=ax)
+            valid = None
+        else:
+            ids = lbl
+            if ids.ndim == logits.ndim:  # trailing singleton label dim
+                ids = jnp.squeeze(ids, axis=ax)
+            ids = ids.astype(jnp.int32)
+            valid = ids != ignore_index
+            safe_ids = jnp.where(valid, ids, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_ids, ax), axis=ax
+            ).squeeze(ax)
+            if label_smoothing > 0:
+                smooth_term = jnp.mean(logp, axis=ax)
+                loss = -(1 - label_smoothing) * picked - label_smoothing * smooth_term
+            else:
+                loss = -picked
+            loss = jnp.where(valid, loss, 0.0)
+            if maybe_w:
+                w = maybe_w[0][safe_ids]
+                w = jnp.where(valid, w, 0.0)
+                loss = loss * w
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        if reduction == "mean":
+            if valid is not None:
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.mean(loss)
+        return _reduce(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(_f, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    # reference keeps a trailing singleton dim on the hard-label path
+    loss = apply(lambda a: jnp.expand_dims(a, axis), loss, op_name="unsqueeze_loss") if not soft_label else loss
+    if return_softmax:
+        from .activation import softmax as _softmax
+
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
+    def _f(logp, lbl, *maybe_w):
+        ids = lbl.astype(jnp.int32)
+        valid = ids != ignore_index
+        safe = jnp.where(valid, ids, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        loss = -jnp.where(valid, picked, 0.0)
+        if maybe_w:
+            w = maybe_w[0][safe] * valid.astype(logp.dtype)
+            loss = loss * w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(_f, *args, op_name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(lambda a, b: _reduce((a - b) ** 2, reduction), input, label, op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label, op_name="l1_loss")
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return apply(lambda a, b: (a - b) ** 2, input, label, op_name="square_error_cost")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def _f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply(_f, input, label, op_name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    def _f(p, y, *maybe_w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(_f, *args, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    def _f(z, y, *rest):
+        # numerically-stable BCE-with-logits
+        log_sig = jax.nn.log_sigmoid(z)
+        log_one_minus = jax.nn.log_sigmoid(-z)
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+        pos_term = -y * log_sig
+        if pw is not None:
+            pos_term = pos_term * pw
+        loss = pos_term - (1 - y) * log_one_minus
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = (logit, label) + tuple(t for t in (weight, pos_weight) if t is not None)
+    return apply(_f, *args, op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    def _f(logp, q):
+        if log_target:
+            loss = jnp.exp(q) * (q - logp)
+        else:
+            safe_q = jnp.clip(q, 1e-12, None)
+            loss = q * (jnp.log(safe_q) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply(_f, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    def _f(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+
+    return apply(_f, input, other, label, op_name="margin_ranking_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def _f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply(_f, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    def _f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+
+    return apply(_f, input, label, op_name="hinge_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):  # noqa: A002
+    def _f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.abs(u - v) ** p, axis=-1) + epsilon, 1.0 / p)
+
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+        return _reduce(loss, reduction)
+
+    return apply(_f, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    def _f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return apply(_f, input, label, op_name="log_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def _f(z, y, *maybe_norm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if maybe_norm:
+            loss = loss / maybe_norm[0]
+        return _reduce(loss, reduction)
+
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return apply(_f, *args, op_name="sigmoid_focal_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8, reduction="mean", name=None):  # noqa: A002
+    def _f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * np.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply(_f, input, label, op_name="poisson_nll_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(
+        lambda a, y: _reduce(jnp.log1p(jnp.exp(-y * a)), reduction),
+        input, label, op_name="soft_margin_loss",
+    )
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    def _f(z, y, *maybe_w):
+        loss = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        loss = jnp.mean(loss, axis=-1)
+        return _reduce(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(_f, *args, op_name="multi_label_soft_margin_loss")

@@ -1,0 +1,198 @@
+"""Normalization functionals.
+
+ref: python/paddle/nn/functional/norm.py (batch_norm/layer_norm →
+phi kernels like gpu/layer_norm_kernel.cu). On TPU these are jnp
+reductions + elementwise math that XLA fuses into single HBM passes;
+rms_norm matches the reference's fused_rms_norm surface
+(ref: paddle/phi/kernels/fusion/gpu/fused_rms_norm*).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...base.tape import apply
+from ...base.tensor import Tensor
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm", "local_response_norm", "rms_norm"]
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    """Functional BN. In training mode also updates the running stats
+    in-place (reference semantics: new = momentum*old + (1-momentum)*batch).
+    """
+    if use_global_stats is None:
+        use_global_stats = not training
+    channels_first = data_format.startswith("NC") and data_format != "NC"
+
+    def _stats_axes(ndim):
+        if ndim <= 2:
+            return (0,), 1 if ndim == 2 else 0
+        ch_axis = 1 if channels_first else ndim - 1
+        axes = tuple(i for i in range(ndim) if i != ch_axis)
+        return axes, ch_axis
+
+    has_w, has_b = weight is not None, bias is not None
+
+    def _affine(out, wb, shape):
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    if use_global_stats:
+        def _f(a, m, v, *wb):
+            axes, ch_axis = _stats_axes(a.ndim)
+            shape = [1] * a.ndim
+            shape[ch_axis] = a.shape[ch_axis]
+            out = (a - m.reshape(shape)) / jnp.sqrt(v.reshape(shape) + epsilon)
+            return _affine(out, wb, shape)
+
+        args = (x, running_mean, running_var) + tuple(t for t in (weight, bias) if t is not None)
+        return apply(_f, *args, op_name="batch_norm")
+
+    # training: compute batch stats; update running stats eagerly
+    def _f(a, *wb):
+        axes, ch_axis = _stats_axes(a.ndim)
+        mean = jnp.mean(a, axis=axes)
+        var = jnp.var(a, axis=axes)
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        out = (a - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+        return _affine(out, wb, shape), mean, var
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    out, batch_mean, batch_var = apply(_f, *args, op_name="batch_norm")
+    if running_mean is not None:
+        running_mean.set_value(momentum * running_mean._data + (1 - momentum) * batch_mean._data)
+    if running_var is not None:
+        running_var.set_value(momentum * running_var._data + (1 - momentum) * batch_var._data)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+    has_w, has_b = weight is not None, bias is not None
+
+    def _f(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(_f, *args, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
+    """RMSNorm (ref: fused_rms_norm surface; used by Llama-family models)."""
+
+    def _f(a, *w):
+        # stats in fp32 even for bf16 inputs (matches the fused kernel)
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=axis, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    args = (x,) + ((weight,) if weight is not None else ())
+    return apply(_f, *args, op_name="rms_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    channels_first = data_format.startswith("NC")
+    has_w, has_b = weight is not None, bias is not None
+
+    def _f(a, *wb):
+        ch_axis = 1 if channels_first else a.ndim - 1
+        axes = tuple(i for i in range(a.ndim) if i not in (0, ch_axis))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + eps)
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(_f, *args, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    channels_first = data_format.startswith("NC")
+    has_w, has_b = weight is not None, bias is not None
+
+    def _f(a, *wb):
+        if not channels_first:
+            a = jnp.moveaxis(a, -1, 1)
+        N, C = a.shape[0], a.shape[1]
+        spatial = a.shape[2:]
+        g = a.reshape(N, num_groups, C // num_groups, *spatial)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(N, C, *spatial)
+        shape = [1, C] + [1] * len(spatial)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        if not channels_first:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(_f, *args, op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def _f(a):
+        channels_first = data_format.startswith("NC")
+        if not channels_first:
+            a = jnp.moveaxis(a, -1, 1)
+        sq = jnp.square(a)
+        C = a.shape[1]
+        half = size // 2
+        pad_width = [(0, 0)] * a.ndim
+        pad_width[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad_width)
+        acc = sum(padded[:, i : i + C] for i in range(size))
+        out = a / jnp.power(k + alpha * acc / size, beta)
+        if not channels_first:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(_f, x, op_name="local_response_norm")

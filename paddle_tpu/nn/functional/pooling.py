@@ -1,0 +1,253 @@
+"""Pooling functionals over lax.reduce_window.
+
+ref: python/paddle/nn/functional/pooling.py. XLA's reduce_window is the
+single TPU primitive behind max/avg pooling (replaces the phi pool2d
+kernel family); adaptive pools compute per-output windows statically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...base.tape import apply
+from ...base.tensor import Tensor
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "max_pool1d", "max_pool2d", "max_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+    "lp_pool1d", "lp_pool2d", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else list(v) * n)[:n])
+    return (int(v),) * n
+
+
+def _norm_pad(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if padding and isinstance(padding[0], (list, tuple)):
+        return [tuple(int(x) for x in p) for p in padding][-n:]
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, data_format, ceil_mode, name,
+          count_include_pad=True, average=False):
+    ks = _tuple(kernel, n)
+    st = _tuple(stride if stride is not None else kernel, n)
+    pad = _norm_pad(padding, n)
+    channels_first = data_format.startswith("NC")
+
+    def _f(a):
+        if channels_first:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = ([(0, 0), (0, 0)] + list(pad)) if not isinstance(pad, str) else pad
+        else:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = ([(0, 0)] + list(pad) + [(0, 0)]) if not isinstance(pad, str) else pad
+        if ceil_mode and not isinstance(pads, str):
+            # extend high padding so the last partial window is included
+            spatial_axes = range(2, 2 + n) if channels_first else range(1, 1 + n)
+            pads = list(pads)
+            for i, ax in enumerate(spatial_axes):
+                size = a.shape[ax] + pads[ax][0] + pads[ax][1]
+                rem = (size - ks[i]) % st[i]
+                if rem:
+                    pads[ax] = (pads[ax][0], pads[ax][1] + st[i] - rem)
+        if average:
+            summed = jax.lax.reduce_window(a, jnp.asarray(0, a.dtype), jax.lax.add, window, strides, pads)
+            if count_include_pad and not isinstance(pads, str):
+                denom = np.prod(ks)
+                return summed / jnp.asarray(denom, a.dtype)
+            ones = jnp.ones_like(a)
+            counts = jax.lax.reduce_window(ones, jnp.asarray(0, a.dtype), jax.lax.add, window, strides, pads)
+            return summed / counts
+        return jax.lax.reduce_window(a, init(a.dtype), reducer, window, strides, pads)
+
+    return apply(_f, x, op_name=name)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, jax.lax.max,
+                lambda dt: jnp.asarray(-jnp.inf if np.dtype(dt).kind == "f" else np.iinfo(dt).min, dt),
+                data_format, ceil_mode, "max_pool2d")
+    if return_mask:
+        idx = _max_pool_indices(x, kernel_size, stride, padding, data_format)
+        return out, idx
+    return out
+
+
+def _max_pool_indices(x, kernel_size, stride, padding, data_format):
+    """Flat spatial argmax index per window (for max_unpool)."""
+    ks = _tuple(kernel_size, 2)
+    st = _tuple(stride if stride is not None else kernel_size, 2)
+    pad = _norm_pad(padding, 2)
+
+    def _f(a):
+        N, C, H, W = a.shape
+        lin = jnp.arange(H * W, dtype=jnp.float64 if False else jnp.float32).reshape(1, 1, H, W)
+        lin = jnp.broadcast_to(lin, a.shape)
+        # select-and-gather: encode (value, index) lexicographically via
+        # reduce_window on a large-composite trick is overkill; use
+        # conv_general_dilated_patches for small kernels instead
+        patches = jax.lax.conv_general_dilated_patches(
+            a, ks, st, padding=pad if not isinstance(pad, str) else pad,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )  # [N, C*kh*kw, oh, ow]
+        oh, ow = patches.shape[2], patches.shape[3]
+        patches = patches.reshape(N, C, ks[0] * ks[1], oh, ow)
+        arg = jnp.argmax(patches, axis=2)  # [N, C, oh, ow] index inside window
+        ky, kx = arg // ks[1], arg % ks[1]
+        oy = jnp.arange(oh).reshape(1, 1, -1, 1)
+        ox = jnp.arange(ow).reshape(1, 1, 1, -1)
+        p0 = pad[0][0] if not isinstance(pad, str) else 0
+        p1 = pad[1][0] if not isinstance(pad, str) else 0
+        iy = oy * st[0] + ky - p0
+        ix = ox * st[1] + kx - p1
+        return (iy * W + ix).astype(jnp.int32)
+
+    return apply(_f, x, op_name="max_pool2d_indices")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, None, None, data_format, ceil_mode,
+                 "avg_pool2d", count_include_pad=not exclusive, average=True)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, jax.lax.max,
+                lambda dt: jnp.asarray(-jnp.inf if np.dtype(dt).kind == "f" else np.iinfo(dt).min, dt),
+                "NCH", ceil_mode, "max_pool1d")
+    return out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, None, None, "NCH", ceil_mode,
+                 "avg_pool1d", count_include_pad=not exclusive, average=True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
+                 lambda dt: jnp.asarray(-jnp.inf if np.dtype(dt).kind == "f" else np.iinfo(dt).min, dt),
+                 data_format, ceil_mode, "max_pool3d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, None, None, data_format, ceil_mode,
+                 "avg_pool3d", count_include_pad=not exclusive, average=True)
+
+
+def _adaptive_pool(x, output_size, n, mode, data_format, name):
+    def _norm_out(os):
+        if isinstance(os, int):
+            return (os,) * n
+        return tuple(a if a is not None else None for a in os)
+
+    out_sizes = _norm_out(output_size)
+
+    def _f(a):
+        channels_first = data_format.startswith("NC")
+        spatial_axes = list(range(2, 2 + n)) if channels_first else list(range(1, 1 + n))
+        out = a
+        for i, ax in enumerate(spatial_axes):
+            osz = out_sizes[i]
+            if osz is None:
+                continue
+            isz = out.shape[ax]
+            # split into osz windows: start/end per adaptive formula
+            starts = [(j * isz) // osz for j in range(osz)]
+            ends = [-(-((j + 1) * isz) // osz) for j in range(osz)]
+            pieces = []
+            for s, e in zip(starts, ends):
+                sl = [slice(None)] * out.ndim
+                sl[ax] = slice(s, e)
+                win = out[tuple(sl)]
+                red = jnp.max(win, axis=ax, keepdims=True) if mode == "max" else jnp.mean(win, axis=ax, keepdims=True)
+                pieces.append(red)
+            out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    return apply(_f, x, op_name=name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", "NCH", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format, "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format, "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max", "NCH", "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max", "NCHW", "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max", "NCDHW", "adaptive_max_pool3d")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCL", name=None):
+    p = float(norm_type)
+    powed = apply(lambda a: jnp.abs(a) ** p, x, op_name="lp_pow")
+    pooled = avg_pool1d(powed, kernel_size, stride, padding, exclusive=False, ceil_mode=ceil_mode)
+    ks = _tuple(kernel_size, 1)[0]
+    return apply(lambda a: (a * ks) ** (1.0 / p), pooled, op_name="lp_root")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    powed = apply(lambda a: jnp.abs(a) ** p, x, op_name="lp_pow")
+    pooled = avg_pool2d(powed, kernel_size, stride, padding, ceil_mode=ceil_mode, exclusive=False)
+    ks = _tuple(kernel_size, 2)
+    scale = ks[0] * ks[1]
+    return apply(lambda a: (a * scale) ** (1.0 / p), pooled, op_name="lp_root")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
+    ks = _tuple(kernel_size, 2)
+    st = _tuple(stride if stride is not None else kernel_size, 2)
+
+    def _f(a, idx):
+        N, C, oh, ow = a.shape
+        if output_size is not None:
+            H, W = output_size[-2], output_size[-1]
+        else:
+            H = (oh - 1) * st[0] + ks[0] - 2 * (padding if isinstance(padding, int) else 0)
+            W = (ow - 1) * st[1] + ks[1] - 2 * (padding if isinstance(padding, int) else 0)
+        out = jnp.zeros((N, C, H * W), a.dtype)
+        flat_idx = idx.reshape(N, C, -1)
+        flat_val = a.reshape(N, C, -1)
+        out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(out, flat_idx, flat_val)
+        return out.reshape(N, C, H, W)
+
+    return apply(_f, x, indices, op_name="max_unpool2d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL", output_size=None, name=None):
+    raise NotImplementedError("max_unpool1d: use max_unpool2d with a singleton H dim")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW", output_size=None, name=None):
+    raise NotImplementedError("max_unpool3d not yet provided")

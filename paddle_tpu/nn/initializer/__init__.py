@@ -1,0 +1,237 @@
+"""Parameter initializers.
+
+TPU-native counterpart of python/paddle/nn/initializer/ (ref:
+python/paddle/nn/initializer/__init__.py). Each initializer is a callable
+``init(shape, dtype) -> jax.Array`` drawing from the framework's default
+splittable Generator (paddle_tpu.base.random), so initialization is
+reproducible under ``paddle_tpu.seed`` and trace-safe.
+
+Fan computation follows the reference's ``_compute_fans``
+(ref: python/paddle/nn/initializer/xavier.py): 2-D weights are [fan_in,
+fan_out] (paddle Linear stores W as [in, out]); >2-D uses
+shape[1]*receptive as fan_in, shape[0]*receptive as fan_out.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...base import random as _random
+from ...base import dtype as _dtypes
+
+__all__ = [
+    "Initializer",
+    "Constant",
+    "Normal",
+    "TruncatedNormal",
+    "Uniform",
+    "XavierNormal",
+    "XavierUniform",
+    "KaimingNormal",
+    "KaimingUniform",
+    "Assign",
+    "Orthogonal",
+    "Dirac",
+    "calculate_gain",
+    "set_global_initializer",
+]
+
+
+def _compute_fans(shape):
+    if not shape:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+def calculate_gain(nonlinearity, param=None):
+    """ref: python/paddle/nn/initializer/initializer.py calculate_gain."""
+    recommended = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "conv1d_transpose": 1.0,
+        "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in recommended:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return recommended[nonlinearity]
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        dtype = _dtypes.canonical_dtype(dtype) if dtype is not None else _dtypes.get_default_dtype()
+        return self._generate(tuple(int(s) for s in shape), dtype)
+
+    def _generate(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _generate(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def _generate(self, shape, dtype):
+        sample_dt = dtype if np.dtype(dtype).kind == "f" else jnp.float32
+        out = self.mean + self.std * jax.random.normal(_random.next_key(), shape, sample_dt)
+        return out.astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    """Normal truncated to [mean + a*std, mean + b*std] (ref:
+    python/paddle/nn/initializer/normal.py TruncatedNormal)."""
+
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0, name=None):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def _generate(self, shape, dtype):
+        sample_dt = dtype if np.dtype(dtype).kind == "f" else jnp.float32
+        out = jax.random.truncated_normal(_random.next_key(), self.a, self.b, shape, sample_dt)
+        return (self.mean + self.std * out).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def _generate(self, shape, dtype):
+        sample_dt = dtype if np.dtype(dtype).kind == "f" else jnp.float32
+        out = jax.random.uniform(_random.next_key(), shape, sample_dt, self.low, self.high)
+        return out.astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dtype):
+        f_in, f_out = _compute_fans(shape)
+        f_in = self.fan_in if self.fan_in is not None else f_in
+        f_out = self.fan_out if self.fan_out is not None else f_out
+        std = self.gain * math.sqrt(2.0 / (f_in + f_out))
+        return Normal(0.0, std)._generate(shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dtype):
+        f_in, f_out = _compute_fans(shape)
+        f_in = self.fan_in if self.fan_in is not None else f_in
+        f_out = self.fan_out if self.fan_out is not None else f_out
+        limit = self.gain * math.sqrt(6.0 / (f_in + f_out))
+        return Uniform(-limit, limit)._generate(shape, dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def _generate(self, shape, dtype):
+        f_in, _ = _compute_fans(shape)
+        f_in = self.fan_in if self.fan_in is not None else f_in
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(f_in)
+        return Normal(0.0, std)._generate(shape, dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def _generate(self, shape, dtype):
+        f_in, _ = _compute_fans(shape)
+        f_in = self.fan_in if self.fan_in is not None else f_in
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / f_in)
+        return Uniform(-limit, limit)._generate(shape, dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def _generate(self, shape, dtype):
+        v = self.value
+        if hasattr(v, "_data"):
+            v = v._data
+        arr = jnp.asarray(np.asarray(v), dtype=dtype)
+        if tuple(arr.shape) != shape:
+            arr = arr.reshape(shape)
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def _generate(self, shape, dtype):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal initializer needs >=2 dims")
+        rows, cols = shape[0], int(np.prod(shape[1:]))
+        flat = jax.random.normal(_random.next_key(), (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        q = q.T if rows < cols else q
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv kernel init (ref:
+    python/paddle/nn/initializer/dirac.py)."""
+
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def _generate(self, shape, dtype):
+        if len(shape) < 3:
+            raise ValueError("Dirac needs a conv kernel shape")
+        out = np.zeros(shape, dtype=np.float32)
+        out_per_group = shape[0] // self.groups
+        mid = tuple(s // 2 for s in shape[2:])
+        for g in range(self.groups):
+            for i in range(min(out_per_group, shape[1])):
+                out[(g * out_per_group + i, i) + mid] = 1.0
+        return jnp.asarray(out, dtype=dtype)
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """ref: python/paddle/nn/initializer/__init__.py set_global_initializer."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _default_weight_init():
+    return _global_weight_init if _global_weight_init is not None else XavierUniform()
+
+
+def _default_bias_init():
+    return _global_bias_init if _global_bias_init is not None else Constant(0.0)

@@ -1,0 +1,113 @@
+"""Convolution layers (ref: python/paddle/nn/layer/conv.py _ConvNd).
+
+Weight layout: [out_channels, in_channels // groups, *kernel] (paddle);
+default weight init Normal(0, sqrt(2/fan_in)) matching the reference's
+_get_default_param_initializer (conv.py:170-175).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose"]
+
+
+def _tuple(v, n):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+class _ConvNd(Layer):
+    ndim_spatial = 2
+    transposed = False
+
+    def __init__(
+        self,
+        in_channels,
+        out_channels,
+        kernel_size,
+        stride=1,
+        padding=0,
+        dilation=1,
+        groups=1,
+        padding_mode="zeros",
+        weight_attr=None,
+        bias_attr=None,
+        data_format=None,
+        output_padding=0,
+    ):
+        super().__init__()
+        n = self.ndim_spatial
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _tuple(kernel_size, n)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._padding_mode = padding_mode
+        self._data_format = data_format or ("NCL", "NCHW", "NCDHW")[n - 1]
+        self._output_padding = output_padding
+
+        if self.transposed:
+            filter_shape = [in_channels, out_channels // groups] + list(self._kernel_size)
+            default_init = None
+        else:
+            filter_shape = [out_channels, in_channels // groups] + list(self._kernel_size)
+            fan = int(np.prod(self._kernel_size)) * in_channels
+            default_init = I.Normal(0.0, (2.0 / fan) ** 0.5)
+        self.weight = self.create_parameter(shape=filter_shape, attr=weight_attr, default_initializer=default_init)
+        self.bias = self.create_parameter(shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def extra_repr(self):
+        return (
+            f"{self._in_channels}, {self._out_channels}, kernel_size={self._kernel_size}, "
+            f"stride={self._stride}, padding={self._padding}"
+        )
+
+
+class Conv1D(_ConvNd):
+    ndim_spatial = 1
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self._stride, self._padding, self._dilation, self._groups, self._data_format)
+
+
+class Conv2D(_ConvNd):
+    ndim_spatial = 2
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride, self._padding, self._dilation, self._groups, self._data_format)
+
+
+class Conv3D(_ConvNd):
+    ndim_spatial = 3
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self._stride, self._padding, self._dilation, self._groups, self._data_format)
+
+
+class Conv1DTranspose(_ConvNd):
+    ndim_spatial = 1
+    transposed = True
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self._stride, self._padding, self._output_padding, self._groups, self._dilation, output_size, self._data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    ndim_spatial = 2
+    transposed = True
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(x, self.weight, self.bias, self._stride, self._padding, self._output_padding, self._groups, self._dilation, output_size, self._data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    ndim_spatial = 3
+    transposed = True
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self._stride, self._padding, self._output_padding, self._groups, self._dilation, output_size, self._data_format)

@@ -1,0 +1,406 @@
+"""nn.Layer — the module base class.
+
+TPU-native counterpart of the reference Layer
+(ref: python/paddle/nn/layer/layers.py:351). Holds named parameters,
+buffers and sublayers; supports forward pre/post hooks, state_dict
+round-trips with structured names, train/eval modes, dtype casting via
+``to``/``astype``, and ``apply``.
+
+Parameters are ``Parameter`` (a Tensor with ``stop_gradient=False``);
+their arrays are jax.Arrays, so a Layer's state flows through
+``paddle_tpu.jit`` functionalization as a flat list of arrays gathered by
+``named_parameters``/``named_buffers`` — no pybind/VarBase machinery.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ...base import dtype as _dtypes
+from ...base.param_attr import ParamAttr
+from ...base.tensor import Tensor
+from .. import initializer as I
+
+__all__ = ["Layer", "Parameter"]
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ref: EagerParamBase, python/paddle/base/framework.py)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed")
+
+    def __init__(self, data, trainable=True, name=None, **kw):
+        super().__init__(data, stop_gradient=not trainable, name=name, persistable=True, _internal=True)
+        self.optimize_attr = kw.get("optimize_attr", {"learning_rate": 1.0})
+        self.regularizer = kw.get("regularizer")
+        self.do_model_average = kw.get("do_model_average", True)
+        self.need_clip = kw.get("need_clip", True)
+        self.is_distributed = False
+
+    @property
+    def trainable(self) -> bool:
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v: bool):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+class HookRemoveHelper:
+    next_id = 0
+
+    def __init__(self, hooks: dict):
+        self._hooks = hooks
+        self._id = HookRemoveHelper.next_id
+        HookRemoveHelper.next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self.training = True
+        self._dtype = _dtypes.canonical_dtype(dtype) if dtype is not None else None
+        self._parameters: Dict[str, Parameter] = collections.OrderedDict()
+        self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._sub_layers: Dict[str, "Layer"] = collections.OrderedDict()
+        self._forward_pre_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._full_name = name_scope or type(self).__name__.lower()
+        self._casted_by_pure_fp16 = False
+
+    # ------------------------------------------------------------------
+    # parameter / buffer / sublayer registration
+    # ------------------------------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias: bool = False,
+        default_initializer=None,
+    ) -> Optional[Parameter]:
+        """ref: python/paddle/nn/layer/layers.py create_parameter — bias
+        defaults to zeros, weight to the global default (Xavier-uniform)."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is None:
+            return None
+        dtype = _dtypes.canonical_dtype(dtype) if dtype is not None else (self._dtype or _dtypes.get_default_dtype())
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = I._default_bias_init() if is_bias else I._default_weight_init()
+        data = init(shape, dtype)
+        p = Parameter(
+            data,
+            trainable=attr.trainable,
+            name=attr.name,
+            optimize_attr={"learning_rate": attr.learning_rate},
+            regularizer=attr.regularizer,
+            do_model_average=attr.do_model_average,
+            need_clip=attr.need_clip,
+        )
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError(f"add_parameter expects Parameter, got {type(parameter)}")
+        self._parameters[name] = parameter
+        return parameter
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable: bool = True):
+        """Non-parameter state (e.g. BN running stats); persistable buffers
+        are included in state_dict (ref: layers.py register_buffer)."""
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor, _internal=True)
+        self._buffers[name] = tensor
+        if persistable:
+            self._non_persistable_buffer_names.discard(name)
+        else:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        if sublayer is not None and not isinstance(sublayer, Layer):
+            raise TypeError(f"add_sublayer expects Layer, got {type(sublayer)}")
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    # ------------------------------------------------------------------
+    # attribute magic
+    # ------------------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning parameters")
+            params[name] = value
+            layers is not None and layers.pop(name, None)
+            buffers is not None and buffers.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            layers[name] = value
+            params is not None and params.pop(name, None)
+            buffers is not None and buffers.pop(name, None)
+        elif buffers is not None and name in buffers:
+            if value is not None and not isinstance(value, Tensor):
+                value = Tensor(value, _internal=True)
+            buffers[name] = value
+        else:
+            if params is not None and name in params:
+                del params[name]
+            if layers is not None and name in layers:
+                del layers[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                self._non_persistable_buffer_names.discard(name)
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + list(self._buffers) + list(self._sub_layers)
+
+    # ------------------------------------------------------------------
+    # traversal
+    # ------------------------------------------------------------------
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self: bool = False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, l in self.named_children():
+            if l is None:
+                continue
+            sub_prefix = prefix + ("." if prefix else "") + name
+            yield from l.named_sublayers(prefix=sub_prefix, include_self=True, layers_set=layers_set)
+
+    def parameters(self, include_sublayers: bool = True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        gen = (
+            self.named_sublayers(prefix=prefix, include_self=True)
+            if include_sublayers
+            else [(prefix, self)]
+        )
+        for layer_prefix, layer in gen:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield layer_prefix + ("." if layer_prefix else "") + name, p
+
+    def buffers(self, include_sublayers: bool = True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True):
+        seen = set()
+        gen = (
+            self.named_sublayers(prefix=prefix, include_self=True)
+            if include_sublayers
+            else [(prefix, self)]
+        )
+        for layer_prefix, layer in gen:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield layer_prefix + ("." if layer_prefix else "") + name, b
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    def full_name(self) -> str:
+        return self._full_name
+
+    # ------------------------------------------------------------------
+    # modes
+    # ------------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # ------------------------------------------------------------------
+    # hooks
+    # ------------------------------------------------------------------
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        helper = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[helper._id] = hook
+        return helper
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        helper = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[helper._id] = hook
+        return helper
+
+    # ------------------------------------------------------------------
+    # call
+    # ------------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    # ------------------------------------------------------------------
+    # state dict
+    # ------------------------------------------------------------------
+    def state_dict(
+        self,
+        destination=None,
+        include_sublayers: bool = True,
+        structured_name_prefix: str = "",
+        use_hook: bool = True,
+    ):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip("."), include_sublayers=include_sublayers):
+            dest[name] = p
+        gen = (
+            self.named_sublayers(prefix=structured_name_prefix.rstrip("."), include_self=True)
+            if include_sublayers
+            else [(structured_name_prefix.rstrip("."), self)]
+        )
+        for layer_prefix, layer in gen:
+            for name, b in layer._buffers.items():
+                if b is None or name in layer._non_persistable_buffer_names:
+                    continue
+                dest[layer_prefix + ("." if layer_prefix else "") + name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        """Load; returns (missing_keys, unexpected_keys) like the reference."""
+        own = self.state_dict()
+        missing, matched = [], set()
+        for name, target in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            value = state_dict[name]
+            if isinstance(value, Tensor):
+                value = value._data
+            value = np.asarray(value)
+            if tuple(value.shape) != tuple(target.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: loaded {value.shape} vs layer {tuple(target.shape)}"
+                )
+            target.set_value(value)
+            matched.add(name)
+        unexpected = [k for k in state_dict if k not in own]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------------
+    # dtype / device movement
+    # ------------------------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_params(dtype)
+        return self
+
+    def astype(self, dtype):
+        self._cast_params(dtype)
+        return self
+
+    def float(self):
+        return self.astype("float32")
+
+    def half(self):
+        return self.astype("float16")
+
+    def bfloat16(self):
+        return self.astype("bfloat16")
+
+    def _cast_params(self, dtype, floating_only: bool = True):
+        dt = _dtypes.canonical_dtype(dtype)
+        for t in list(self.parameters()) + list(self.buffers()):
+            if floating_only and t.dtype.kind not in "fc" and not _dtypes.is_floating_point(t.dtype):
+                continue
+            t._data = t._data.astype(dt)
+        self._dtype = dt
+        for l in self.sublayers():
+            l._dtype = dt
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # ------------------------------------------------------------------
+    # repr
+    # ------------------------------------------------------------------
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, child in self.named_children():
+            child_repr = repr(child).split("\n")
+            child_repr = [child_repr[0]] + ["  " + ln for ln in child_repr[1:]]
+            lines.append(f"({name}): " + "\n".join(child_repr))
+        main = type(self).__name__ + "("
+        if extra and not lines:
+            return main + extra + ")"
+        body = ([extra] if extra else []) + lines
+        if not body:
+            return main + ")"
+        return main + "\n  " + "\n  ".join(b for b in body) + "\n)"

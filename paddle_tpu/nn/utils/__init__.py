@@ -1,0 +1,24 @@
+"""nn.utils (ref: python/paddle/nn/utils/__init__.py)."""
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...tensor import manipulation as M
+
+    return M.concat([M.reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p.set_value(vec._data[offset : offset + n].reshape(p._data.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    raise NotImplementedError("weight_norm reparameterization: use SpectralNorm or explicit normalization")
+
+
+def remove_weight_norm(layer, name="weight"):
+    raise NotImplementedError

@@ -1,0 +1,18 @@
+"""paddle_tpu.optimizer (ref: python/paddle/optimizer/__init__.py)."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    ASGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    NAdam,
+    Optimizer,
+    RAdam,
+    RMSProp,
+    Rprop,
+    SGD,
+)

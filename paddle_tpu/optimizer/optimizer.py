@@ -1,0 +1,527 @@
+"""Optimizer base + the full optimizer set.
+
+ref: python/paddle/optimizer/optimizer.py:1863 (step), adam.py, adamw.py:493
+(fused adamw path), momentum.py, rmsprop.py, …
+
+TPU-native design: update math is raw jnp on the params' arrays inside
+``no_grad`` — a handful of fused elementwise XLA ops per parameter.
+Accumulators are plain jax arrays held in a nested dict (a pytree), so
+``paddle_tpu.jit`` threads the whole optimizer state through the
+compiled train step and donates the old buffers (the reference needs
+fused multi-tensor CUDA kernels for this; XLA fuses the update chain
+automatically). ``multi_precision`` keeps fp32 master weights for
+bf16/fp16 params (ref: optimizer.py _create_master_weight).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import dtype as _dtypes
+from ..base.tape import no_grad
+from ..base.tensor import Tensor
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Adadelta",
+    "Adamax", "RMSProp", "Lamb", "NAdam", "RAdam", "Rprop", "ASGD",
+]
+
+
+class L2Decay:
+    """ref: python/paddle/regularizer.py L2Decay — grad += coeff * param."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param, grad):
+        return grad + self.coeff * param
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param, grad):
+        return grad + self.coeff * jnp.sign(param)
+
+
+class Optimizer:
+    _accum_names: List[str] = []
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        parameters=None,
+        weight_decay=None,
+        grad_clip=None,
+        multi_precision=False,
+        name=None,
+    ):
+        if parameters is None:
+            raise ValueError("parameters must be given (dygraph mode requires the param list)")
+        self._param_groups = self._normalize_params(parameters)
+        self._learning_rate = learning_rate
+        self._lr_override = None  # set by paddle_tpu.jit to a traced scalar
+        if isinstance(weight_decay, (int, float)):
+            self.regularization = L2Decay(float(weight_decay))
+        else:
+            self.regularization = weight_decay  # L1Decay/L2Decay/None
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # accumulators: name -> param.name -> jnp array  (a pytree)
+        self._accumulators: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._global_step = 0
+
+    # ------------------------------------------------------------------
+    def _normalize_params(self, parameters):
+        parameters = list(parameters)
+        if parameters and isinstance(parameters[0], dict):
+            groups = []
+            for g in parameters:
+                g = dict(g)
+                g["params"] = list(g["params"])
+                groups.append(g)
+            return groups
+        return [{"params": parameters}]
+
+    @property
+    def _parameter_list(self):
+        out = []
+        for g in self._param_groups:
+            out.extend(g["params"])
+        return out
+
+    # ------------------------------------------------------------------
+    # learning rate
+    # ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def _lr(self):
+        if self._lr_override is not None:
+            return self._lr_override
+        return self.get_lr()
+
+    # ------------------------------------------------------------------
+    # accumulators
+    # ------------------------------------------------------------------
+    def _get_accum(self, name: str, param, init=None):
+        store = self._accumulators.setdefault(name, {})
+        key = param.name
+        if key not in store:
+            if init is None:
+                dt = jnp.float32 if self._use_master(param) else param._data.dtype
+                store[key] = jnp.zeros(param._data.shape, dt)
+            else:
+                store[key] = init
+        return store[key]
+
+    def _set_accum(self, name: str, param, value):
+        self._accumulators[name][param.name] = value
+
+    def _use_master(self, param) -> bool:
+        return self._multi_precision and np.dtype(param.dtype) in (
+            np.dtype(_dtypes.float16),
+            np.dtype(_dtypes.bfloat16),
+        )
+
+    def _master_weight(self, param):
+        if not self._use_master(param):
+            return None
+        store = self._accumulators.setdefault("master_weight", {})
+        if param.name not in store:
+            store[param.name] = param._data.astype(jnp.float32)
+        return store[param.name]
+
+    # ------------------------------------------------------------------
+    # step
+    # ------------------------------------------------------------------
+    @no_grad()
+    def step(self):
+        self._global_step += 1
+        for group in self._param_groups:
+            params_grads = [
+                (p, p.grad) for p in group["params"] if not p.stop_gradient and p.grad is not None
+            ]
+            # reference order (ref: optimizer.py:1519-1525): grad clip FIRST,
+            # then regularization — the decay term is not clipped
+            grad_clip = group.get("grad_clip", self._grad_clip)
+            if grad_clip is not None:
+                params_grads = grad_clip(params_grads)
+            group_reg = group.get("weight_decay", None)
+            if isinstance(group_reg, (int, float)):
+                group_reg = L2Decay(float(group_reg))
+            new_pg = []
+            for p, g in params_grads:
+                # parameter's own regularizer wins, then the group's, then
+                # the optimizer-level one (reference precedence)
+                reg = getattr(p, "regularizer", None) or group_reg or self.regularization
+                if reg is not None:
+                    g = Tensor(reg(p._data, g._data), _internal=True)
+                new_pg.append((p, g))
+            params_grads = new_pg
+            group_lr_scale = float(group.get("learning_rate", 1.0))
+            for p, g in params_grads:
+                garr = g._data if isinstance(g, Tensor) else g
+                lr_scale = p.optimize_attr.get("learning_rate", 1.0) if getattr(p, "optimize_attr", None) else 1.0
+                self._update_param(p, garr, lr_scale * group_lr_scale, group)
+
+    def _update_param(self, p, g, lr_scale, group):
+        raise NotImplementedError
+
+    def _apply(self, p, new_value):
+        """Write back an update computed in master precision."""
+        if self._use_master(p):
+            self._accumulators["master_weight"][p.name] = new_value
+            p._data = new_value.astype(p._data.dtype)
+        else:
+            p._data = new_value.astype(p._data.dtype)
+
+    def _param_value(self, p):
+        mw = self._master_weight(p)
+        return mw if mw is not None else p._data
+
+    # ------------------------------------------------------------------
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        self.step()
+        return None, None
+
+    # ------------------------------------------------------------------
+    # state dict
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for name, store in self._accumulators.items():
+            for pname, arr in store.items():
+                sd[f"{pname}.{name}"] = Tensor(arr, _internal=True)
+        sd["global_step"] = self._global_step
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for key, val in state_dict.items():
+            if key in ("global_step", "LR_Scheduler"):
+                continue
+            pname, _, accum = key.rpartition(".")
+            if isinstance(val, Tensor):
+                val = val._data
+            self._accumulators.setdefault(accum, {})[pname] = jnp.asarray(np.asarray(val))
+
+    set_dict = set_state_dict
+
+
+# ---------------------------------------------------------------------------
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _update_param(self, p, g, lr_scale, group):
+        lr = self._lr() * lr_scale
+        pv = self._param_value(p)
+        self._apply(p, pv - lr * g.astype(pv.dtype))
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update_param(self, p, g, lr_scale, group):
+        lr = self._lr() * lr_scale
+        pv = self._param_value(p)
+        g = g.astype(pv.dtype)
+        vel = self._get_accum("velocity", p)
+        vel = self._momentum * vel + g
+        self._set_accum("velocity", p, vel)
+        if self._use_nesterov:
+            self._apply(p, pv - lr * (g + self._momentum * vel))
+        else:
+            self._apply(p, pv - lr * vel)
+
+
+class _AdamBase(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _moments(self, p, g):
+        pv = self._param_value(p)
+        g = g.astype(pv.dtype)
+        m = self._get_accum("moment1", p)
+        v = self._get_accum("moment2", p)
+        b1p = self._get_accum("beta1_pow", p, init=jnp.ones((), pv.dtype))
+        b2p = self._get_accum("beta2_pow", p, init=jnp.ones((), pv.dtype))
+        b1p = b1p * self._beta1
+        b2p = b2p * self._beta2
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_accum("moment1", p, m)
+        self._set_accum("moment2", p, v)
+        self._set_accum("beta1_pow", p, b1p)
+        self._set_accum("beta2_pow", p, b2p)
+        return pv, g, m, v, b1p, b2p
+
+    def _adam_delta(self, lr, m, v, b1p, b2p):
+        # paddle adam kernel: lr_t = lr * sqrt(1-b2^t)/(1-b1^t);
+        # denom = sqrt(v) + eps * sqrt(1-b2^t)
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        return lr_t * m / (jnp.sqrt(v) + self._epsilon * jnp.sqrt(1 - b2p))
+
+
+class Adam(_AdamBase):
+    def _update_param(self, p, g, lr_scale, group):
+        lr = self._lr() * lr_scale
+        pv, g, m, v, b1p, b2p = self._moments(p, g)
+        self._apply(p, pv - self._adam_delta(lr, m, v, b1p, b2p))
+
+
+class AdamW(_AdamBase):
+    """Decoupled weight decay (ref: python/paddle/optimizer/adamw.py:493).
+    paddle default weight_decay (coeff) = 0.01; apply_decay_param_fun
+    filters which params decay."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = float(weight_decay) if not callable(weight_decay) else weight_decay
+        self._lr_ratio = lr_ratio
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _update_param(self, p, g, lr_scale, group):
+        lr = self._lr() * lr_scale
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        pv, g, m, v, b1p, b2p = self._moments(p, g)
+        decay = self._coeff
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            decay = 0.0
+        if getattr(p, "no_weight_decay", False):
+            decay = 0.0
+        pv = pv * (1.0 - lr * decay)
+        self._apply(p, pv - self._adam_delta(lr, m, v, b1p, b2p))
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _update_param(self, p, g, lr_scale, group):
+        lr = self._lr() * lr_scale
+        pv = self._param_value(p)
+        g = g.astype(pv.dtype)
+        mom = self._get_accum("moment", p, init=jnp.full(pv.shape, self._initial, pv.dtype))
+        mom = mom + g * g
+        self._set_accum("moment", p, mom)
+        self._apply(p, pv - lr * g / (jnp.sqrt(mom) + self._epsilon))
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _update_param(self, p, g, lr_scale, group):
+        lr = self._lr() * lr_scale
+        pv = self._param_value(p)
+        g = g.astype(pv.dtype)
+        E_g = self._get_accum("avg_squared_grad", p)
+        E_u = self._get_accum("avg_squared_update", p)
+        E_g = self._rho * E_g + (1 - self._rho) * g * g
+        update = jnp.sqrt(E_u + self._epsilon) / jnp.sqrt(E_g + self._epsilon) * g
+        E_u = self._rho * E_u + (1 - self._rho) * update * update
+        self._set_accum("avg_squared_grad", p, E_g)
+        self._set_accum("avg_squared_update", p, E_u)
+        self._apply(p, pv - lr * update)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr_scale, group):
+        lr = self._lr() * lr_scale
+        pv = self._param_value(p)
+        g = g.astype(pv.dtype)
+        m = self._get_accum("moment", p)
+        inf = self._get_accum("inf_norm", p)
+        b1p = self._get_accum("beta1_pow", p, init=jnp.ones((), pv.dtype))
+        b1p = b1p * self._beta1
+        m = self._beta1 * m + (1 - self._beta1) * g
+        inf = jnp.maximum(self._beta2 * inf, jnp.abs(g))
+        self._set_accum("moment", p, m)
+        self._set_accum("inf_norm", p, inf)
+        self._set_accum("beta1_pow", p, b1p)
+        self._apply(p, pv - (lr / (1 - b1p)) * m / (inf + self._epsilon))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _update_param(self, p, g, lr_scale, group):
+        lr = self._lr() * lr_scale
+        pv = self._param_value(p)
+        g = g.astype(pv.dtype)
+        ms = self._get_accum("mean_square", p)
+        mom = self._get_accum("momentum", p)
+        ms = self._rho * ms + (1 - self._rho) * g * g
+        self._set_accum("mean_square", p, ms)
+        if self._centered:
+            mg = self._get_accum("mean_grad", p)
+            mg = self._rho * mg + (1 - self._rho) * g
+            self._set_accum("mean_grad", p, mg)
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * mom + lr * g / denom
+        self._set_accum("momentum", p, mom)
+        self._apply(p, pv - mom)
+
+
+class Lamb(Optimizer):
+    """ref: python/paddle/optimizer/lamb.py — layer-wise trust ratio."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g, lr_scale, group):
+        lr = self._lr() * lr_scale
+        pv = self._param_value(p)
+        g = g.astype(pv.dtype)
+        m = self._get_accum("moment1", p)
+        v = self._get_accum("moment2", p)
+        b1p = self._get_accum("beta1_pow", p, init=jnp.ones((), pv.dtype))
+        b2p = self._get_accum("beta2_pow", p, init=jnp.ones((), pv.dtype))
+        b1p, b2p = b1p * self._beta1, b2p * self._beta2
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_accum("moment1", p, m)
+        self._set_accum("moment2", p, v)
+        self._set_accum("beta1_pow", p, b1p)
+        self._set_accum("beta2_pow", p, b2p)
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) else self._wd
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + wd * pv
+        p_norm = jnp.linalg.norm(pv)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        self._apply(p, pv - lr * trust * r)
+
+
+class NAdam(_AdamBase):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 momentum_decay=0.004, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, weight_decay, grad_clip)
+        self._momentum_decay = momentum_decay
+
+    def _update_param(self, p, g, lr_scale, group):
+        lr = self._lr() * lr_scale
+        pv = self._param_value(p)
+        g = g.astype(pv.dtype)
+        t = self._global_step
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._momentum_decay))
+        mu_next = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._momentum_decay))
+        mu_prod = self._get_accum("mu_product", p, init=jnp.ones((), pv.dtype))
+        mu_prod = mu_prod * mu_t
+        self._set_accum("mu_product", p, mu_prod)
+        m = self._get_accum("moment1", p)
+        v = self._get_accum("moment2", p)
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_accum("moment1", p, m)
+        self._set_accum("moment2", p, v)
+        m_hat = mu_next * m / (1 - mu_prod * mu_next) + (1 - mu_t) * g / (1 - mu_prod)
+        v_hat = v / (1 - self._beta2 ** t)
+        self._apply(p, pv - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon))
+
+
+class RAdam(_AdamBase):
+    def _update_param(self, p, g, lr_scale, group):
+        lr = self._lr() * lr_scale
+        pv, g, m, v, b1p, b2p = self._moments(p, g)
+        t = self._global_step
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        rho_t = rho_inf - 2 * t * (self._beta2 ** t) / (1 - self._beta2 ** t)
+        m_hat = m / (1 - b1p)
+        if rho_t > 5:
+            v_hat = jnp.sqrt(v / (1 - b2p))
+            r = np.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            self._apply(p, pv - lr * r * m_hat / (v_hat + self._epsilon))
+        else:
+            self._apply(p, pv - lr * m_hat)
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _update_param(self, p, g, lr_scale, group):
+        pv = self._param_value(p)
+        g = g.astype(pv.dtype)
+        prev = self._get_accum("prev_grad", p)
+        lrs = self._get_accum("lrs", p, init=jnp.full(pv.shape, self._lr(), pv.dtype))
+        sign = jnp.sign(g * prev)
+        lrs = jnp.where(sign > 0, jnp.minimum(lrs * self._etas[1], self._lr_range[1]),
+                        jnp.where(sign < 0, jnp.maximum(lrs * self._etas[0], self._lr_range[0]), lrs))
+        g_eff = jnp.where(sign < 0, jnp.zeros_like(g), g)
+        self._set_accum("prev_grad", p, g_eff)
+        self._set_accum("lrs", p, lrs)
+        self._apply(p, pv - lrs * jnp.sign(g_eff))
+
+
+class ASGD(Optimizer):
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._batch_num = batch_num
+
+    def _update_param(self, p, g, lr_scale, group):
+        lr = self._lr() * lr_scale
+        pv = self._param_value(p)
+        self._apply(p, pv - lr * g.astype(pv.dtype))

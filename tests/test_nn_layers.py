@@ -1,0 +1,169 @@
+"""nn.Layer / layers tests (ref test pattern: test/legacy_test API tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerBase:
+    def test_parameters_and_naming(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        names = [n for n, _ in m.named_parameters()]
+        assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+        assert len(m.parameters()) == 4
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Linear(4, 3)
+        m2 = nn.Linear(4, 3)
+        m2.set_state_dict(m1.state_dict())
+        np.testing.assert_array_equal(m1.weight.numpy(), m2.weight.numpy())
+        np.testing.assert_array_equal(m1.bias.numpy(), m2.bias.numpy())
+
+    def test_state_dict_missing_unexpected(self):
+        m = nn.Linear(4, 3)
+        missing, unexpected = m.set_state_dict({"weight": m.weight.numpy(), "junk": np.zeros(3)})
+        assert missing == ["bias"]
+        assert unexpected == ["junk"]
+
+    def test_buffers_in_state_dict(self):
+        bn = nn.BatchNorm2D(5)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_train_eval_propagates(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_forward_hooks(self):
+        m = nn.Linear(2, 2)
+        calls = []
+        h = m.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        m(paddle.to_tensor(np.zeros((1, 2), "float32")))
+        assert calls == [1]
+        h.remove()
+        m(paddle.to_tensor(np.zeros((1, 2), "float32")))
+        assert calls == [1]
+
+    def test_cast_bfloat16(self):
+        m = nn.Linear(4, 3)
+        m.bfloat16()
+        assert m.weight.dtype == np.dtype(paddle.bfloat16)
+
+    def test_apply_fn(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+        seen = []
+        m.apply(lambda l: seen.append(type(l).__name__))
+        assert seen.count("Linear") == 2
+
+
+class TestFunctionalNumerics:
+    def test_linear_matches_numpy(self):
+        x = np.random.randn(3, 4).astype("float32")
+        w = np.random.randn(4, 5).astype("float32")
+        b = np.random.randn(5).astype("float32")
+        out = F.linear(paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+
+    def test_conv2d_matches_manual(self):
+        x = np.random.randn(1, 1, 4, 4).astype("float32")
+        w = np.ones((1, 1, 2, 2), "float32")
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+        expected = np.zeros((1, 1, 3, 3), "float32")
+        for i in range(3):
+            for j in range(3):
+                expected[0, 0, i, j] = x[0, 0, i : i + 2, j : j + 2].sum()
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5)
+
+    def test_layer_norm(self):
+        x = np.random.randn(2, 5).astype("float32")
+        out = F.layer_norm(paddle.to_tensor(x), 5)
+        mu = x.mean(-1, keepdims=True)
+        sd = x.std(-1, keepdims=True)
+        np.testing.assert_allclose(out.numpy(), (x - mu) / np.sqrt(sd**2 + 1e-5), rtol=1e-4)
+
+    def test_batch_norm_train_updates_stats(self):
+        bn = nn.BatchNorm2D(3, momentum=0.9)
+        x = paddle.to_tensor(np.random.randn(4, 3, 5, 5).astype("float32") * 3 + 1)
+        bn.train()
+        bn(x)
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+
+    def test_softmax_cross_entropy_vs_numpy(self):
+        logits = np.random.randn(6, 4).astype("float32")
+        labels = np.random.randint(0, 4, (6,))
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expected = -np.log(p[np.arange(6), labels]).mean()
+        np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 3).astype("float32")
+        labels = np.array([0, 1, -100, 2])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels), ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        keep = labels != -100
+        expected = -np.log(p[np.arange(4), np.where(keep, labels, 0)])[keep].mean()
+        np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+
+    def test_dropout_zero_in_eval(self):
+        x = paddle.to_tensor(np.ones((10, 10), "float32"))
+        out = F.dropout(x, 0.5, training=False)
+        np.testing.assert_array_equal(out.numpy(), x.numpy())
+
+    def test_dropout_scales_in_train(self):
+        paddle.seed(0)
+        x = paddle.to_tensor(np.ones((1000,), "float32"))
+        out = F.dropout(x, 0.5, training=True).numpy()
+        assert set(np.unique(out)).issubset({0.0, 2.0})
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        ids = paddle.to_tensor(np.array([0, 3]))
+        out = emb(ids)
+        np.testing.assert_array_equal(out.numpy()[0], np.zeros(4, "float32"))
+
+    def test_sdpa_matches_naive(self):
+        np.random.seed(0)
+        q = np.random.randn(2, 4, 2, 8).astype("float32")
+        k = np.random.randn(2, 4, 2, 8).astype("float32")
+        v = np.random.randn(2, 4, 2, 8).astype("float32")
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), is_causal=True
+        )
+        # naive reference
+        qh, kh, vh = [a.transpose(0, 2, 1, 3) for a in (q, k, v)]
+        logits = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(8)
+        mask = np.tril(np.ones((4, 4), bool))
+        logits = np.where(mask, logits, -np.inf)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expected = (p @ vh).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-4, atol=1e-5)
+
+    def test_pad_reflect(self):
+        x = paddle.to_tensor(np.arange(12).reshape(1, 1, 3, 4).astype("float32"))
+        out = F.pad(x, [1, 1, 0, 0], mode="reflect")
+        assert out.shape == [1, 1, 3, 6]
+
+
+class TestGradClip:
+    def test_global_norm_clip(self):
+        w = paddle.to_tensor(np.ones(4, "float32"), stop_gradient=False)
+        (w * paddle.to_tensor(np.full(4, 10.0, "float32"))).sum().backward()
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        (_, g), = clip([(w, w.grad)])
+        assert abs(np.linalg.norm(g.numpy()) - 1.0) < 1e-5
+
+    def test_clip_by_value(self):
+        w = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+        clip = nn.ClipGradByValue(0.5)
+        g = paddle.to_tensor(np.array([1.0, -2.0, 0.1], "float32"))
+        (_, gc), = clip([(w, g)])
+        np.testing.assert_allclose(gc.numpy(), [0.5, -0.5, 0.1])
