@@ -1,0 +1,129 @@
+"""Benchmark: transformer LM train step on the real chip.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Metric: tokens/sec/chip for a Llama-style decoder LM train step
+(forward+backward+AdamW) compiled via paddle_tpu.jit.to_static, bf16
+activations path. vs_baseline = achieved MFU / 0.55 (the conventional
+A100-class MFU anchor for Llama-2 pretrain stacks, BASELINE.md north
+star: MFU parity ⇒ vs_baseline ≥ 1.0).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# bf16 peak FLOP/s per chip by TPU generation (device_kind substring)
+_PEAK = {
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6": 918e12,  # trillium
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.tensor import manipulation as M
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        config = LlamaConfig(
+            vocab_size=8192, hidden_size=512, intermediate_size=1408,
+            num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=8,
+            max_position_embeddings=1024,
+        )
+        batch, seq, steps, warmup = 8, 512, 10, 3
+    else:  # CPU fallback so the bench is runnable anywhere
+        config = LlamaConfig.tiny()
+        batch, seq, steps, warmup = 2, 64, 3, 1
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(config)
+    if on_tpu:
+        model.bfloat16()  # bf16 params+activations; AdamW keeps fp32 masters
+    opt = popt.AdamW(
+        learning_rate=1e-4, parameters=model.parameters(), multi_precision=on_tpu
+    )
+
+    def step(ids, labels):
+        logits = model(ids)
+        b, s, v = logits.shape
+        loss = F.cross_entropy(
+            M.reshape(logits, [b * s, v]).astype("float32"), M.reshape(labels, [b * s])
+        )
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = paddle.jit.to_static(step, layers=[model], optimizers=[opt])
+
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(0, config.vocab_size, (batch, seq))
+    ids = paddle.to_tensor(ids_np.astype("int32"))
+    labels = paddle.to_tensor(ids_np.astype("int32"))
+
+    for _ in range(warmup):
+        loss = compiled(ids, labels)
+    loss._data.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = compiled(ids, labels)
+    loss._data.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+    flops_per_token = model.flops_per_token(seq)
+    achieved = tokens_per_sec * flops_per_token
+    mfu = achieved / _peak_flops(dev)
+    vs_baseline = mfu / 0.55
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(vs_baseline, 4),
+                "extra": {
+                    "mfu": round(mfu, 4),
+                    "step_ms": round(1000 * dt / steps, 2),
+                    "loss": round(float(np.asarray(loss._data)), 4),
+                    "device": getattr(dev, "device_kind", str(dev)),
+                    "params": model.num_params(),
+                    "batch": batch,
+                    "seq": seq,
+                    "dtype": "bfloat16" if on_tpu else "float32",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

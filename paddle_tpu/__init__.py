@@ -63,6 +63,7 @@ from .autograd import grad  # noqa: F401
 from .base.param_attr import ParamAttr  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import jit  # noqa: F401
 from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
 from . import regularizer  # noqa: F401
 

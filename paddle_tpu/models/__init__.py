@@ -1,0 +1,11 @@
+"""paddle_tpu.models — model zoo (BASELINE configs).
+
+llama: decoder LM family (configs #3/#4); vision models live in
+paddle_tpu.vision (config #1).
+"""
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaDecoderLayer,
+    LlamaForCausalLM,
+    LlamaModel,
+)

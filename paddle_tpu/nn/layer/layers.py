@@ -29,7 +29,10 @@ __all__ = ["Layer", "Parameter"]
 class Parameter(Tensor):
     """Trainable tensor (ref: EagerParamBase, python/paddle/base/framework.py)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed")
+    __slots__ = (
+        "optimize_attr", "regularizer", "do_model_average", "need_clip",
+        "is_distributed", "tp_axis", "no_weight_decay",
+    )
 
     def __init__(self, data, trainable=True, name=None, **kw):
         super().__init__(data, stop_gradient=not trainable, name=name, persistable=True, _internal=True)
@@ -38,6 +41,8 @@ class Parameter(Tensor):
         self.do_model_average = kw.get("do_model_average", True)
         self.need_clip = kw.get("need_clip", True)
         self.is_distributed = False
+        self.tp_axis = None  # TP sharding hint consumed by distributed wrappers
+        self.no_weight_decay = False
 
     @property
     def trainable(self) -> bool:
