@@ -308,7 +308,9 @@ class Tensor:
     def set_value(self, value):
         if isinstance(value, Tensor):
             value = value._data
-        self._data = jnp.asarray(value, dtype=self._data.dtype)
+        # jnp.array copies (asarray would alias — fatal once jit donates
+        # the source buffer: the alias would be deleted with it)
+        self._data = jnp.array(value, dtype=self._data.dtype, copy=True)
         return self
 
     def copy_(self, other, *_):

@@ -1,0 +1,46 @@
+"""paddle_tpu.distributed — collectives, hybrid parallelism, auto-parallel.
+
+TPU-native replacement for the reference's distributed stack
+(ref: python/paddle/distributed/, paddle/fluid/distributed/): NCCL
+process groups become named mesh axes with XLA collectives over ICI/DCN
+(SURVEY §5.8); TCPStore becomes the JAX coordination service; the
+bucketed reducer and comm streams disappear into GSPMD + the XLA
+latency-hiding scheduler.
+"""
+from __future__ import annotations
+
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    destroy_process_group,
+    get_group,
+    is_initialized,
+    new_group,
+)
+from .communication import (  # noqa: F401
+    all_gather,
+    all_gather_into_tensor,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    get_rank_in_trace,
+    p2p_sendrecv,
+    ppermute,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    shard_map,
+)
+from . import fleet  # noqa: F401

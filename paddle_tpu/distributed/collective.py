@@ -1,0 +1,168 @@
+"""Process groups over jax.sharding mesh axes.
+
+TPU-native redesign of the reference's ProcessGroup runtime
+(ref: paddle/fluid/distributed/collective/process_group.h:48,
+python/paddle/distributed/collective.py:186 new_group). There is no NCCL
+on TPU: a "process group" is a named mesh axis; collectives are XLA HLO
+ops (lax.psum / all_gather / psum_scatter / ppermute / all_to_all)
+compiled over ICI/DCN by GSPMD. A Group therefore carries (axis_name,
+ranks, mesh) instead of a communicator handle, and the "rendezvous"
+(TCPStore, ncclUniqueId exchange) collapses into JAX's coordination
+service, which jax.distributed.initialize owns.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+# id 0 is reserved for the default world group (init_default_group)
+_group_counter = itertools.count(1)
+
+
+class ReduceOp:
+    """Reduction type for collective ops (ref: process_group.h ReduceOp)."""
+
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication group = a set of global ranks bound to a mesh axis.
+
+    ``axis_name`` is the jax mesh axis the group's collectives run over
+    when traced inside shard_map/jit; ``ranks`` are global device indices
+    (parity with the reference's Group, collective.py:66).
+    """
+
+    def __init__(
+        self,
+        ranks: Sequence[int],
+        axis_name: str,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        pg_id: Optional[int] = None,
+        name: str = "",
+    ):
+        self.ranks = list(ranks)
+        self.axis_name = axis_name
+        self.mesh = mesh
+        self.id = next(_group_counter) if pg_id is None else pg_id
+        self.name = name or f"pg_{self.id}"
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def world_size(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def rank(self) -> int:
+        """This controller's rank within the group (single-controller: the
+        per-shard rank only exists inside a trace; host-side we report the
+        position of process_index's first device, 0 in practice)."""
+        gr = self.get_group_rank(_host_global_rank())
+        return gr
+
+    def is_member(self) -> bool:
+        return _host_global_rank() in self.ranks
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return (
+            f"Group(id={self.id}, axis='{self.axis_name}', nranks={self.nranks}, "
+            f"ranks={self.ranks})"
+        )
+
+
+# --------------------------------------------------------------------------
+# global registry / default group
+# --------------------------------------------------------------------------
+
+_default_group: Optional[Group] = None
+_groups: dict = {}
+
+
+def _host_global_rank() -> int:
+    return jax.process_index()
+
+
+def _default_mesh(devices=None) -> jax.sharding.Mesh:
+    devices = list(jax.devices()) if devices is None else list(devices)
+    return jax.sharding.Mesh(np.array(devices), ("world",))
+
+
+def init_default_group(mesh: Optional[jax.sharding.Mesh] = None) -> Group:
+    """Create the default (world) group; called by init_parallel_env."""
+    global _default_group
+    if mesh is None:
+        mesh = _default_mesh()
+    axis = mesh.axis_names[0]
+    n = int(np.prod(list(mesh.shape.values())))
+    _default_group = Group(list(range(n)), axis, mesh=mesh, pg_id=0, name="default")
+    _groups[0] = _default_group
+    return _default_group
+
+
+def is_initialized() -> bool:
+    return _default_group is not None
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    global _default_group
+    if group is None or group is _default_group:
+        _default_group = None
+        _groups.clear()
+    else:
+        _groups.pop(group.id, None)
+
+
+def _get_global_group() -> Group:
+    if _default_group is None:
+        init_default_group()
+    return _default_group
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    return _groups.get(gid)
+
+
+def new_group(
+    ranks: Optional[Sequence[int]] = None,
+    backend: Optional[str] = None,
+    timeout=None,
+    axis_name: Optional[str] = None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> Group:
+    """paddle.distributed.new_group parity (collective.py:186).
+
+    On TPU a subgroup is a sub-mesh axis. When ``ranks`` covers every
+    device it aliases the default world axis; otherwise we build a
+    dedicated 1-D mesh over the chosen devices so shard_map'd code can
+    bind the group's axis.
+    """
+    world = _get_global_group()
+    if ranks is None:
+        ranks = list(world.ranks)
+    ranks = sorted(ranks)
+    name = axis_name or f"pg{next(_group_counter)}"
+    if mesh is None:
+        devs = list(jax.devices())
+        bad = [r for r in ranks if r >= len(devs)]
+        if bad:
+            raise ValueError(
+                f"new_group: ranks {bad} exceed device count {len(devs)}"
+            )
+        sub = [devs[r] for r in ranks]
+        mesh = jax.sharding.Mesh(np.array(sub), (name,))
+    g = Group(ranks, name, mesh=mesh, name=name)
+    _groups[g.id] = g
+    return g

@@ -1,0 +1,292 @@
+"""Collective communication API (paddle.distributed.* parity).
+
+Semantics on TPU (SURVEY §5.8): collectives are XLA ops over mesh axes.
+Every function here is dual-mode:
+
+- **traced** (inside ``shard_map``/``pjit`` with the group's axis bound —
+  how all real multi-chip code runs): lowers to ``lax.psum`` /
+  ``lax.all_gather`` / ``lax.psum_scatter`` / ``lax.all_to_all`` /
+  ``lax.ppermute``, compiled onto ICI by XLA.
+- **eager, group of 1**: identity (matches the reference's single-rank
+  fast path, e.g. communication/all_reduce.py returns immediately when
+  world_size == 1).
+- **eager, group > 1**: raises — the single-controller model has no
+  per-rank eager view; use paddle_tpu.distributed.shard_map (or a
+  jit'ed sharded step) exactly like the reference requires a launched
+  process group (ref: process_group.h:48 requires initialized PG).
+
+In-place convention follows the reference (all_reduce mutates its input
+tensor and returns None in sync mode).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...base.tensor import Tensor
+from ..collective import Group, ReduceOp, _get_global_group
+
+
+def _resolve(group: Optional[Group]) -> Group:
+    return group if group is not None else _get_global_group()
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _data(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _group_rank_of(g: Group, rank: int, op: str) -> int:
+    """Map a global rank to its in-group rank; reject non-members."""
+    gr = g.get_group_rank(rank)
+    if gr < 0:
+        raise ValueError(f"{op}: rank {rank} is not a member of group {g.ranks}")
+    return gr
+
+
+def _eager_guard(g: Group, op: str) -> bool:
+    """True -> caller should no-op (single rank). Raises on eager multi-rank."""
+    if g.nranks == 1:
+        return True
+    raise RuntimeError(
+        f"{op}: eager collectives over a {g.nranks}-rank group are not "
+        "representable in the single-controller model; run this code inside "
+        "paddle_tpu.distributed.shard_map(...) or a jit'ed sharded step "
+        "(the XLA equivalent of launching a process group)."
+    )
+
+
+def _reduce_traced(x, g: Group, op: int):
+    axis = g.axis_name
+    if op == ReduceOp.SUM:
+        return lax.psum(x, axis)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, axis)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, axis)
+    if op == ReduceOp.PROD:
+        # exp(psum(log)) breaks on zeros/negatives; gather then multiply
+        return jnp.prod(lax.all_gather(x, axis), axis=0)
+    if op == ReduceOp.AVG:
+        return lax.pmean(x, axis)
+    raise ValueError(f"unknown ReduceOp {op}")
+
+
+def all_reduce(tensor: Tensor, op: int = ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True):
+    """paddle.distributed.all_reduce parity (communication/all_reduce.py)."""
+    g = _resolve(group)
+    x = _data(tensor)
+    if not _is_traced(x):
+        if _eager_guard(g, "all_reduce"):
+            return
+    out = _reduce_traced(x, g, op)
+    tensor._inplace_from(Tensor(out, stop_gradient=tensor.stop_gradient, _internal=True))
+
+
+def all_gather(tensor_list: List, tensor: Tensor, group: Optional[Group] = None, sync_op: bool = True):
+    """Gather each rank's tensor into ``tensor_list`` (rank order)."""
+    g = _resolve(group)
+    x = _data(tensor)
+    if not _is_traced(x):
+        if _eager_guard(g, "all_gather"):
+            tensor_list.append(tensor.clone() if isinstance(tensor, Tensor) else Tensor(x))
+            return
+    stacked = lax.all_gather(x, g.axis_name)  # [nranks, ...]
+    for r in range(g.nranks):
+        tensor_list.append(Tensor(stacked[r], _internal=True))
+
+
+def all_gather_object(obj_list: List, obj, group: Optional[Group] = None):
+    g = _resolve(group)
+    if g.nranks == 1:
+        obj_list.append(obj)
+        return
+    raise RuntimeError("all_gather_object requires multi-host coordination; single-controller holds the global view already")
+
+
+def all_gather_into_tensor(out: Tensor, tensor: Tensor, group: Optional[Group] = None, axis: int = 0):
+    """Concatenated all_gather (stream.all_gather concat form)."""
+    g = _resolve(group)
+    x = _data(tensor)
+    if not _is_traced(x):
+        if _eager_guard(g, "all_gather_into_tensor"):
+            out._inplace_from(Tensor(x, _internal=True))
+            return
+    res = lax.all_gather(x, g.axis_name, tiled=True, axis=axis)
+    out._inplace_from(Tensor(res, _internal=True))
+
+
+def reduce(tensor: Tensor, dst: int = 0, op: int = ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True):
+    """Reduce to ``dst``. SPMD note: every shard computes the reduction
+    (free on TPU — psum is the HLO); non-dst ranks keep their input,
+    matching the reference's visible behavior."""
+    g = _resolve(group)
+    x = _data(tensor)
+    if not _is_traced(x):
+        if _eager_guard(g, "reduce"):
+            return
+    red = _reduce_traced(x, g, op)
+    me = lax.axis_index(g.axis_name)
+    out = jnp.where(me == _group_rank_of(g, dst, "reduce"), red, x)
+    tensor._inplace_from(Tensor(out, _internal=True))
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None, sync_op: bool = True):
+    """Broadcast from group rank of global rank ``src``."""
+    g = _resolve(group)
+    x = _data(tensor)
+    if not _is_traced(x):
+        if _eager_guard(g, "broadcast"):
+            return
+    src_in_group = _group_rank_of(g, src, "broadcast")
+    stacked = lax.all_gather(x, g.axis_name)
+    tensor._inplace_from(Tensor(stacked[src_in_group], _internal=True))
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op: int = ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True):
+    """Reduce then scatter: out gets this rank's shard of the sum.
+
+    Accepts a list of per-rank tensors or one stacked/concatenated tensor
+    (ref: communication/reduce_scatter.py).
+    """
+    g = _resolve(group)
+    if isinstance(tensor_or_tensor_list, (list, tuple)):
+        # list of per-rank tensors -> concatenate along axis 0
+        x = jnp.concatenate([_data(t) for t in tensor_or_tensor_list], axis=0)
+    else:
+        x = _data(tensor_or_tensor_list)
+    if not _is_traced(x) and not _is_traced(_data(tensor)):
+        if _eager_guard(g, "reduce_scatter"):
+            tensor._inplace_from(Tensor(x, _internal=True))
+            return
+    if op == ReduceOp.SUM:
+        out = lax.psum_scatter(x, g.axis_name, scatter_dimension=0, tiled=True)
+    elif op == ReduceOp.AVG:
+        out = lax.psum_scatter(x, g.axis_name, scatter_dimension=0, tiled=True) / g.nranks
+    else:
+        red = _reduce_traced(x, g, op)
+        me = lax.axis_index(g.axis_name)
+        shard = x.shape[0] // g.nranks
+        out = lax.dynamic_slice_in_dim(red, me * shard, shard, axis=0)
+    tensor._inplace_from(Tensor(out, _internal=True))
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group: Optional[Group] = None, sync_op: bool = True):
+    """Scatter ``tensor_list`` from src; rank r receives element r."""
+    g = _resolve(group)
+    if tensor_list is not None:
+        x = jnp.stack([_data(t) for t in tensor_list], axis=0)
+    else:
+        x = _data(tensor)
+    if not _is_traced(x) and not _is_traced(_data(tensor)):
+        if _eager_guard(g, "scatter"):
+            tensor._inplace_from(Tensor(x[0] if tensor_list is not None else x, _internal=True))
+            return
+    me = lax.axis_index(g.axis_name)
+    # every shard holds the full stacked input (broadcast from src first)
+    src_in_group = _group_rank_of(g, src, "scatter")
+    stacked = lax.all_gather(x, g.axis_name)[src_in_group]
+    out = lax.dynamic_index_in_dim(stacked, me, axis=0, keepdims=False)
+    tensor._inplace_from(Tensor(out, _internal=True))
+
+
+def alltoall(out_tensor_list: List, in_tensor_list: List, group: Optional[Group] = None, sync_op: bool = True):
+    """Each rank sends in_tensor_list[r] to rank r (communication/all_to_all.py)."""
+    g = _resolve(group)
+    parts = [_data(t) for t in in_tensor_list]
+    if not any(_is_traced(p) for p in parts):
+        if _eager_guard(g, "alltoall"):
+            out_tensor_list.extend(Tensor(p, _internal=True) for p in parts)
+            return
+    x = jnp.stack(parts, axis=0)  # [nranks, ...]
+    out = lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0, tiled=False)
+    # lax.all_to_all with non-tiled splits axis0 across ranks: out[r] = from rank r
+    for r in range(g.nranks):
+        out_tensor_list.append(Tensor(out[r], _internal=True))
+
+
+def alltoall_single(out: Tensor, tensor: Tensor, in_split_sizes=None, out_split_sizes=None, group: Optional[Group] = None, sync_op: bool = True):
+    g = _resolve(group)
+    x = _data(tensor)
+    if not _is_traced(x):
+        if _eager_guard(g, "alltoall_single"):
+            out._inplace_from(Tensor(x, _internal=True))
+            return
+    if in_split_sizes or out_split_sizes:
+        raise NotImplementedError("uneven alltoall splits require ragged all_to_all; pad to equal splits on TPU")
+    res = lax.all_to_all(x, g.axis_name, split_axis=0, concat_axis=0, tiled=True)
+    out._inplace_from(Tensor(res, _internal=True))
+
+
+def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None, sync_op: bool = True):
+    """P2P send. SPMD: realized as a ppermute pair — see isend/irecv note."""
+    g = _resolve(group)
+    x = _data(tensor)
+    if not _is_traced(x):
+        _eager_guard(g, "send")
+        return
+    raise RuntimeError(
+        "send/recv inside a trace must be paired; use "
+        "paddle_tpu.distributed.p2p_sendrecv(tensor, src, dst) (lax.ppermute) "
+        "— SPMD programs execute both sides of the transfer in one op."
+    )
+
+
+def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None, sync_op: bool = True):
+    """P2P recv — same SPMD pairing rule as :func:`send`."""
+    g = _resolve(group)
+    x = _data(tensor)
+    if not _is_traced(x):
+        _eager_guard(g, "recv")
+        return
+    raise RuntimeError(
+        "send/recv inside a trace must be paired; use "
+        "paddle_tpu.distributed.p2p_sendrecv(tensor, src, dst) (lax.ppermute)."
+    )
+
+
+def p2p_sendrecv(tensor: Tensor, src: int, dst: int, group: Optional[Group] = None) -> Tensor:
+    """One-hop transfer: the shard at group-rank ``src`` lands at ``dst``;
+    other shards receive zeros. The TPU-native form of batched
+    isend/irecv (ref: p2p_communication.py:553 _p2p_helper)."""
+    g = _resolve(group)
+    x = _data(tensor)
+    if not _is_traced(x):
+        if _eager_guard(g, "p2p_sendrecv"):
+            return Tensor(x, _internal=True)
+    out = lax.ppermute(x, g.axis_name, perm=[(src, dst)])
+    return Tensor(out, _internal=True)
+
+
+def ppermute(tensor: Tensor, perm: Sequence, group: Optional[Group] = None) -> Tensor:
+    """Raw lax.ppermute passthrough (ring shifts for PP/ring-attention)."""
+    g = _resolve(group)
+    x = _data(tensor)
+    if not _is_traced(x):
+        if _eager_guard(g, "ppermute"):
+            return Tensor(x, _internal=True)
+    return Tensor(lax.ppermute(x, g.axis_name, perm=list(perm)), _internal=True)
+
+
+def barrier(group: Optional[Group] = None):
+    """Host barrier. Single-process: device sync; multi-host: coordination
+    service barrier (jax.experimental.multihost_utils)."""
+    g = _resolve(group)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"pg_barrier_{g.id}")
+    else:
+        jnp.zeros(()).block_until_ready()
+
+
+def get_rank_in_trace(group: Optional[Group] = None):
+    """Traced axis index (the SPMD rank) — only meaningful inside shard_map."""
+    g = _resolve(group)
+    return lax.axis_index(g.axis_name)

@@ -1,0 +1,93 @@
+"""Fleet: hybrid-parallel orchestration facade.
+
+TPU-native counterpart of the reference's fleet package
+(ref: python/paddle/distributed/fleet/fleet.py:99,166,598). ``init``
+builds the hybrid topology as a named jax Mesh; ``distributed_model``
+wraps the user Layer per parallel mode (precedence pp > mp > sep >
+sharding > dp, ref topology.py:283); ``distributed_optimizer`` wraps
+the optimizer with hybrid-aware grad clip.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+
+_fleet_initialized = False
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective: bool = True, strategy: Optional[DistributedStrategy] = None):
+    """fleet.init parity (fleet.py:166): build topology + comm groups."""
+    global _fleet_initialized, _strategy
+    from .. import parallel as _parallel
+
+    _strategy = strategy if strategy is not None else DistributedStrategy()
+    hc = _strategy.hybrid_configs
+    order = hc.get("order", ["dp", "pp", "sharding", "sep", "mp"])
+    dims = [hc.get(f"{name}_degree", 1) for name in order]
+    topo = CommunicateTopology(order, dims)
+    hcg = HybridCommunicateGroup(topo)
+    set_hybrid_communicate_group(hcg)
+    _parallel.init_parallel_env(hcg.mesh)
+    _fleet_initialized = True
+    return hcg
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _strategy
+
+
+def distributed_model(model):
+    """Wrap per parallel mode (ref: fleet/model.py:32)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("call fleet.init() first")
+    from ..parallel import DataParallel
+
+    if hcg.get_pipe_parallel_world_size() > 1:
+        from .meta_parallel import PipelineParallel
+
+        return PipelineParallel(model, hcg, _strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        from .meta_parallel import TensorParallel
+
+        return TensorParallel(model, hcg, _strategy)
+    if hcg.get_sharding_parallel_world_size() > 1:
+        return model  # sharding handled by the sharded optimizer placement
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model, mesh=hcg.mesh, dp_axis="dp",
+                            group=hcg.get_data_parallel_group())
+    return model
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
+    """Wrap the user optimizer (ref: fleet.py distributed_optimizer →
+    HybridParallelOptimizer, hybrid_parallel_optimizer.py:255)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return optimizer
+    from .meta_optimizers import HybridParallelOptimizer
+
+    return HybridParallelOptimizer(optimizer, hcg, strategy or _strategy)
+
+
+def get_rank() -> int:
+    from ..parallel import get_rank as _gr
+
+    return _gr()
+
+
+def worker_num() -> int:
+    from ..parallel import get_world_size as _ws
+
+    return _ws()
+
+
+worker_index = get_rank

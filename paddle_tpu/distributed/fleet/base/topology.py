@@ -1,0 +1,205 @@
+"""Hybrid-parallel topology: N-D rank mesh + communication groups.
+
+TPU-native redesign of the reference's CommunicateTopology /
+HybridCommunicateGroup (ref: python/paddle/distributed/fleet/base/
+topology.py:65,178). The reference builds NCCL groups by enumerating
+rank tuples; here the topology directly materializes a
+``jax.sharding.Mesh`` whose named axes ARE the communication groups —
+collectives over an axis ride ICI, and GSPMD shardings reference the
+axis names. Axis order follows the reference default
+['dp','pp','sharding','sep','mp'] (distributed_strategy.py:210).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ...collective import Group
+
+_HYBRID_AXES = ["dp", "pp", "sharding", "sep", "mp"]
+
+
+class CommunicateTopology:
+    """Cartesian rank topology (ref: topology.py:65)."""
+
+    def __init__(
+        self,
+        hybrid_group_names: Sequence[str] = _HYBRID_AXES,
+        dims: Sequence[int] = (1, 1, 1, 1, 1),
+    ):
+        assert len(hybrid_group_names) == len(dims)
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*[range(d) for d in dims]))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+        self._rank2coord = {i: c for i, c in enumerate(self.coordinate)}
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank: int) -> Tuple[int, ...]:
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on ``axis_name`` equals ``index``."""
+        ax = self._parallel_names.index(axis_name)
+        return sorted(
+            self._coord2rank[c] for c in self.coordinate if c[ax] == index
+        )
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Rank groups that vary only along ``axis_name`` (ref
+        get_comm_list): one group per combination of the other axes."""
+        ax = self._parallel_names.index(axis_name)
+        others = [
+            range(d) for i, d in enumerate(self._dims) if i != ax
+        ]
+        groups = []
+        for combo in itertools.product(*others):
+            ranks = []
+            for k in range(self._dims[ax]):
+                coord = list(combo)
+                coord.insert(ax, k)
+                ranks.append(self._coord2rank[tuple(coord)])
+            groups.append(ranks)
+        return groups
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = list(self.get_coord(global_rank))
+        for name, v in kwargs.items():
+            coord[self._parallel_names.index(name)] = v
+        return self._coord2rank[tuple(coord)]
+
+
+class HybridCommunicateGroup:
+    """Holds the hybrid mesh + per-axis groups (ref: topology.py:178).
+
+    The jax Mesh is built once with all five axes; each parallel group is
+    a :class:`Group` bound to its axis name. Fused groups (dp+sharding
+    for param sync, pp+mp for checks) get their own tuple of axes.
+    """
+
+    def __init__(self, topology: CommunicateTopology, devices=None):
+        self._topo = topology
+        n = topology.world_size()
+        devices = list(jax.devices())[:n] if devices is None else list(devices)
+        if len(devices) < n:
+            raise ValueError(
+                f"topology needs {n} devices, have {len(devices)}; on a "
+                "dev host set XLA_FLAGS=--xla_force_host_platform_device_count"
+            )
+        dims = [topology.get_dim(a) for a in topology.get_hybrid_group_names()]
+        self.mesh = jax.sharding.Mesh(
+            np.array(devices).reshape(dims), tuple(topology.get_hybrid_group_names())
+        )
+
+        self.global_rank = 0  # single controller; per-shard rank is traced
+
+        def _dim(name):
+            return (
+                topology.get_dim(name)
+                if name in topology.get_hybrid_group_names()
+                else 1
+            )
+
+        self._dp_degree = _dim("dp")
+        self._pp_degree = _dim("pp")
+        self._sharding_degree = _dim("sharding")
+        self._sep_degree = _dim("sep")
+        self._mp_degree = _dim("mp")
+
+        self._groups: Dict[str, Group] = {}
+        for axis in topology.get_hybrid_group_names():
+            ranks = topology.get_comm_list(axis)[0]
+            self._groups[axis] = Group(ranks, axis, mesh=self.mesh, name=axis)
+
+    # -- degrees -------------------------------------------------------
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sep_degree
+
+    # -- groups --------------------------------------------------------
+    def get_data_parallel_group(self) -> Group:
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self) -> Group:
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, sharding=False) -> Group:
+        axes = ("pp", "mp") if not sharding else ("pp", "sharding", "mp")
+        return Group(list(range(self._topo.world_size())), axes, mesh=self.mesh, name="check")
+
+    def get_dp_sep_parallel_group(self) -> Group:
+        return Group(list(range(self._topo.world_size())), ("dp", "sep"), mesh=self.mesh, name="dp_sep")
+
+    # -- ranks (host-side: rank 0's coordinates; traced code uses
+    #    lax.axis_index on the axis names) ------------------------------
+    def get_data_parallel_rank(self) -> int:
+        return 0
+
+    def get_model_parallel_rank(self) -> int:
+        return 0
+
+    def get_stage_id(self) -> int:
+        return 0
+
+    def get_sharding_parallel_rank(self) -> int:
+        return 0
+
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    # -- p2p neighbours for PP ----------------------------------------
+    def get_p2p_groups(self):
+        return None  # PP uses ppermute over the 'pp' axis directly
+
+    def __repr__(self):
+        dims = {a: self._topo.get_dim(a) for a in self._topo.get_hybrid_group_names()}
+        return f"HybridCommunicateGroup({dims})"
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

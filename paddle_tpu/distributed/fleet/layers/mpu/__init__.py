@@ -1,0 +1,13 @@
+from paddle_tpu.base.random import (  # noqa: F401  (ref: mpu/random.py RNGStatesTracker)
+    RNGStatesTracker,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)
+
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    mark_as_sequence_parallel_parameter,
+)

@@ -1,0 +1,48 @@
+"""Dygraph meta-optimizers for hybrid parallelism.
+
+Ref: fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:255.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class HybridParallelOptimizer:
+    """Wraps the user optimizer for hybrid-parallel training.
+
+    The reference localizes grad clip per comm group and fuses
+    mp-duplicated grad allreduce; under GSPMD grads arrive already
+    globally reduced, so the wrapper's remaining jobs are (a) making the
+    global-norm clip see the full (sharded) parameter set — automatic,
+    since the tape's grads are global arrays — and (b) API parity
+    (step/clear_grad/state_dict passthrough, _inner_opt access).
+    """
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss)
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def set_lr(self, lr):
+        self._inner_opt.set_lr(lr)
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)

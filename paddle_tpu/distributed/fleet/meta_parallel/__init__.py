@@ -1,0 +1,107 @@
+"""Meta-parallel model wrappers (TP / SEP / PP).
+
+Ref: python/paddle/distributed/fleet/meta_parallel/ — TensorParallel
+broadcasts non-TP params and leaves TP layers to their own collectives
+(tensor_parallel.py); on TPU the equivalent is placing every parameter
+on the hybrid mesh with its tp_axis sharding (GSPMD owns the
+collectives thereafter).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..layers.mpu.mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+
+
+class _MetaParallelBase:
+    """Common wrapper plumbing (ref: meta_parallel_base.py)."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    __call__ = forward
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+
+def place_parameters_on_mesh(layers, mesh, mp_axis="mp", fsdp_axis=None):
+    """Place every parameter: tp_axis-annotated dims shard over mp;
+    optionally FSDP-shard a remaining divisible dim; else replicate."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mp_size = mesh.shape.get(mp_axis, 1) if hasattr(mesh.shape, "get") else dict(mesh.shape)[mp_axis]
+    fsdp_size = dict(mesh.shape).get(fsdp_axis, 1) if fsdp_axis else 1
+    for p in layers.parameters():
+        if isinstance(p._data, jax.core.Tracer):
+            continue
+        shape = tuple(p._data.shape)
+        spec = [None] * len(shape)
+        tp_axis = getattr(p, "tp_axis", None)
+        if tp_axis is not None and mp_size > 1 and shape[tp_axis] % mp_size == 0:
+            spec[tp_axis] = mp_axis
+        if fsdp_axis and fsdp_size > 1:
+            for ax in range(len(shape)):
+                if spec[ax] is None and shape[ax] % fsdp_size == 0 and shape[ax] >= fsdp_size:
+                    spec[ax] = fsdp_axis
+                    break
+        p._data = jax.device_put(p._data, NamedSharding(mesh, P(*spec)))
+
+
+class TensorParallel(_MetaParallelBase):
+    """ref: meta_parallel/tensor_parallel.py — broadcast non-TP params
+    (= replicate on the mesh) and shard TP params by their tp_axis."""
+
+    def _prepare_for_model(self):
+        place_parameters_on_mesh(self._layers, self._hcg.mesh, mp_axis="mp")
+
+
+class SegmentParallel(_MetaParallelBase):
+    """ref: meta_parallel/segment_parallel.py:26 — param broadcast over
+    dp/sharding; the model shards the sequence over the sep axis."""
+
+    def _prepare_for_model(self):
+        place_parameters_on_mesh(self._layers, self._hcg.mesh, mp_axis="mp")
+
+
+from .pipeline_parallel import (  # noqa: E402,F401
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    SharedLayerDesc,
+)

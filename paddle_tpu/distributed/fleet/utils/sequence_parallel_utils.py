@@ -1,0 +1,159 @@
+"""Megatron-style sequence parallelism over the TP group.
+
+TPU-native redesign of ref: python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py (ScatterOp:85, GatherOp:97, AllGatherOp:111,
+ReduceScatterOp:127, ColumnSequenceParallelLinear:427,
+RowSequenceParallelLinear:562). The reference hand-codes
+all-gather-forward/reduce-scatter-backward PyLayers; here each "op" is a
+GSPMD sharding constraint moving the activation between
+sequence-sharded and replicated layouts over the mp axis — XLA emits
+the all_gather/reduce_scatter pair (fwd/bwd) automatically and overlaps
+it with the matmuls (the reference needed a bespoke overlap pipe,
+SPInnerOverlapLinear:255).
+
+Layout convention matches the reference: activations are [s, b, h]
+(sequence first), sharded on dim 0.
+"""
+from __future__ import annotations
+
+import jax
+
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import functional as F
+
+from ..layers.mpu.mp_layers import _MpLayerBase, _constrain, _resolve_mesh_axis
+
+
+def _seq_spec(ndim, axis_name):
+    from jax.sharding import PartitionSpec as P
+
+    return P(axis_name, *([None] * (ndim - 1)))
+
+
+def _repl_spec():
+    from jax.sharding import PartitionSpec as P
+
+    return P()
+
+
+class _SPOp:
+    """Callable namespace mimicking the reference's PyLayer.apply API."""
+
+    @staticmethod
+    def _mesh_axis(group):
+        return _resolve_mesh_axis(group)
+
+
+class ScatterOp(_SPOp):
+    """Replicated -> sequence-sharded (fwd split, bwd all-gather)."""
+
+    @staticmethod
+    def apply(input, group=None):
+        mesh, axis = _resolve_mesh_axis(group)
+        return _constrain(input, mesh, _seq_spec(input.ndim, axis))
+
+
+class GatherOp(_SPOp):
+    """Sequence-sharded -> replicated (fwd all-gather, bwd split)."""
+
+    @staticmethod
+    def apply(input, group=None):
+        mesh, _ = _resolve_mesh_axis(group)
+        return _constrain(input, mesh, _repl_spec())
+
+
+class AllGatherOp(_SPOp):
+    """fwd all-gather, bwd reduce-scatter (ref :111) — same constraint
+    pair as GatherOp under GSPMD; the bwd collective choice is XLA's."""
+
+    @staticmethod
+    def apply(input, group=None):
+        mesh, _ = _resolve_mesh_axis(group)
+        return _constrain(input, mesh, _repl_spec())
+
+
+class ReduceScatterOp(_SPOp):
+    """fwd reduce-scatter, bwd all-gather (ref :127)."""
+
+    @staticmethod
+    def apply(input, group=None):
+        mesh, axis = _resolve_mesh_axis(group)
+        return _constrain(input, mesh, _seq_spec(input.ndim, axis))
+
+
+def scatter(input, group=None):
+    return ScatterOp.apply(input, group)
+
+
+def all_gather(input, group=None):
+    return AllGatherOp.apply(input, group)
+
+
+def reduce_scatter(input, group=None):
+    return ReduceScatterOp.apply(input, group)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1, fuse=False):
+    """ref :192 — allreduce of sequence-parallel params (layernorm) over
+    mp. Under GSPMD those grads arrive fully reduced; retained as a
+    no-op registration for API parity."""
+    return []
+
+
+class ColumnSequenceParallelLinear(nn.Layer, _MpLayerBase):
+    """ref :427 — input is seq-sharded; all-gather to full sequence, then
+    column-parallel matmul leaving out_features mp-sharded."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._init_mp(mp_group)
+        if self.is_mp and out_features % self.world_size != 0:
+            raise ValueError(f"out_features {out_features} % mp {self.world_size} != 0")
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(shape=[in_features, out_features], attr=weight_attr)
+        self.weight.tp_axis = 1
+        self.weight.is_distributed = self.is_mp
+        self.bias = None
+        if has_bias:  # reference treats None as falsy (:433)
+            self.bias = self.create_parameter(shape=[out_features], is_bias=True)
+            self.bias.tp_axis = 0
+
+    def forward(self, x):
+        from jax.sharding import PartitionSpec as P
+
+        if self.is_mp:
+            x = _constrain(x, self._mesh, _repl_spec())  # all-gather sequence
+        y = F.linear(x, self.weight, self.bias)
+        if self.is_mp and not self.gather_output:
+            y = _constrain(y, self._mesh, P(*([None] * (y.ndim - 1) + [self._mp_axis])))
+        return y
+
+
+class RowSequenceParallelLinear(nn.Layer, _MpLayerBase):
+    """ref :562 — input mp-sharded on features; output reduce-scattered
+    onto the sequence dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._init_mp(mp_group)
+        if self.is_mp and in_features % self.world_size != 0:
+            raise ValueError(f"in_features {in_features} % mp {self.world_size} != 0")
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(shape=[in_features, out_features], attr=weight_attr)
+        self.weight.tp_axis = 0
+        self.weight.is_distributed = self.is_mp
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter(shape=[out_features], is_bias=True)
+
+    def forward(self, x):
+        from jax.sharding import PartitionSpec as P
+
+        if self.is_mp and self.input_is_parallel:
+            x = _constrain(x, self._mesh, P(*([None] * (x.ndim - 1) + [self._mp_axis])))
+        y = F.linear(x, self.weight, self.bias)
+        if self.is_mp:
+            y = _constrain(y, self._mesh, _seq_spec(y.ndim, self._mp_axis))  # reduce-scatter
+        return y

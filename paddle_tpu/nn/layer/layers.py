@@ -117,6 +117,7 @@ class Layer:
         if parameter is not None and not isinstance(parameter, Parameter):
             raise TypeError(f"add_parameter expects Parameter, got {type(parameter)}")
         self._parameters[name] = parameter
+        self.__dict__.pop(name, None)  # a prior plain value would shadow
         return parameter
 
     def register_buffer(self, name: str, tensor: Optional[Tensor], persistable: bool = True):
@@ -125,6 +126,7 @@ class Layer:
         if tensor is not None and not isinstance(tensor, Tensor):
             tensor = Tensor(tensor, _internal=True)
         self._buffers[name] = tensor
+        self.__dict__.pop(name, None)  # a prior plain value would shadow
         if persistable:
             self._non_persistable_buffer_names.discard(name)
         else:
@@ -135,6 +137,7 @@ class Layer:
         if sublayer is not None and not isinstance(sublayer, Layer):
             raise TypeError(f"add_sublayer expects Layer, got {type(sublayer)}")
         self._sub_layers[name] = sublayer
+        self.__dict__.pop(name, None)
         return sublayer
 
     # ------------------------------------------------------------------
@@ -148,12 +151,14 @@ class Layer:
             if params is None:
                 raise RuntimeError("call super().__init__() before assigning parameters")
             params[name] = value
+            self.__dict__.pop(name, None)  # a prior plain value would shadow
             layers is not None and layers.pop(name, None)
             buffers is not None and buffers.pop(name, None)
         elif isinstance(value, Layer):
             if layers is None:
                 raise RuntimeError("call super().__init__() before assigning sublayers")
             layers[name] = value
+            self.__dict__.pop(name, None)
             params is not None and params.pop(name, None)
             buffers is not None and buffers.pop(name, None)
         elif buffers is not None and name in buffers:
