@@ -66,6 +66,7 @@ from . import optimizer  # noqa: F401
 from . import jit  # noqa: F401
 from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import amp  # noqa: F401
 
 
 def disable_static(place=None):

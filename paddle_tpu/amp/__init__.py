@@ -1,0 +1,41 @@
+"""paddle_tpu.amp — automatic mixed precision (ref: python/paddle/amp/).
+
+bf16-first for TPU: ``auto_cast`` defaults to dtype='bfloat16' (the MXU's
+native input precision; fp32 accumulation is implicit), while float16 is
+supported for reference parity. See auto_cast.py / grad_scaler.py.
+"""
+from . import amp_lists  # noqa: F401
+from .amp_lists import AutoCastLists, AutoMixedPrecisionLists  # noqa: F401
+from .auto_cast import (  # noqa: F401
+    amp_decorate,
+    amp_guard,
+    auto_cast,
+    decorate,
+    is_bfloat16_supported,
+    is_float16_supported,
+)
+from .grad_scaler import AmpScaler, GradScaler, OptimizerState  # noqa: F401
+
+__all__ = [
+    "auto_cast",
+    "amp_guard",
+    "decorate",
+    "amp_decorate",
+    "GradScaler",
+    "AmpScaler",
+    "OptimizerState",
+    "AutoCastLists",
+    "AutoMixedPrecisionLists",
+    "is_float16_supported",
+    "is_bfloat16_supported",
+]
+
+# debugging helpers (ref: python/paddle/amp/debugging.py)
+from ..base.flags import flag as _flag  # noqa: E402
+
+
+def debugging_enable_operator_stats_collection():  # pragma: no cover - thin shim
+    raise NotImplementedError(
+        "operator stats collection relies on the eager kernel registry; "
+        "use jax.profiler traces on TPU instead"
+    )

@@ -1,0 +1,144 @@
+"""auto_cast / decorate (ref: python/paddle/amp/auto_cast.py:899, :983).
+
+bf16-first policy for TPU: the MXU computes natively in bf16, so
+``dtype='bfloat16'`` is the default (the reference defaults to float16
+for CUDA). Casting happens at the tape dispatch point
+(base/tape.py apply -> base/amp_state.cast_target), mirroring the
+reference's generated-ad_func AMP block.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..base import amp_state, dtype as _dtypes
+from .amp_lists import AutoCastLists
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "is_bfloat16_supported", "is_float16_supported"]
+
+_SUPPORTED_LEVELS = ("O0", "OD", "O1", "O2")
+
+
+def is_float16_supported(device=None) -> bool:
+    """fp16 compute is supported through XLA on every backend we target."""
+    return True
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    """bf16 is the TPU-native precision (MXU accumulates fp32)."""
+    return True
+
+
+@contextlib.contextmanager
+def amp_guard(
+    enable: bool = True,
+    custom_white_list: Optional[Sequence[str]] = None,
+    custom_black_list: Optional[Sequence[str]] = None,
+    level: str = "O1",
+    dtype: str = "bfloat16",
+    use_promote: bool = True,
+):
+    """Context manager enabling per-op auto-casting (ref: auto_cast.py:899)."""
+    if level not in _SUPPORTED_LEVELS:
+        raise ValueError(f"level should be one of {_SUPPORTED_LEVELS}, got {level}")
+    if dtype not in ("float16", "bfloat16"):
+        raise ValueError(f"dtype should be float16 or bfloat16, got {dtype}")
+    if level == "O0":
+        enable = False
+
+    tls = amp_state.amp_attrs()
+    prev = (tls.enable, tls.dtype, tls.level, tls.white, tls.black)
+    if enable:
+        lists = AutoCastLists(custom_white_list, custom_black_list, dtype, level)
+        tls.enable = True
+        tls.dtype = np.dtype(_dtypes.canonical_dtype(dtype))
+        tls.level = level
+        tls.white = lists.white_list
+        tls.black = lists.black_list
+    else:
+        tls.enable = False
+    try:
+        yield
+    finally:
+        tls.enable, tls.dtype, tls.level, tls.white, tls.black = prev
+
+
+# public name (paddle.amp.auto_cast); amp_guard is the legacy alias
+auto_cast = amp_guard
+
+
+def decorate(
+    models,
+    optimizers=None,
+    level: str = "O1",
+    dtype: str = "bfloat16",
+    master_weight: Optional[bool] = None,
+    save_dtype: Optional[str] = None,
+    master_grad: bool = False,
+    excluded_layers=None,
+):
+    """Cast models for pure-low-precision training (ref: auto_cast.py:983).
+
+    O1: no-op on the model (casting is per-op in auto_cast).
+    O2: parameters/buffers cast to ``dtype`` (floating only, excluding
+    normalization layers' params kept fp32 like the reference), and
+    optimizers get fp32 master weights.
+    """
+    from ..nn.layer.layers import Layer
+    from ..nn.layer import norm as _norm
+
+    if level not in _SUPPORTED_LEVELS:
+        raise ValueError(f"level should be one of {_SUPPORTED_LEVELS}, got {level}")
+
+    models_in = models
+    if isinstance(models, Layer):
+        models = [models]
+    opts_in = optimizers
+    if optimizers is None:
+        optimizers = []
+    elif not isinstance(optimizers, (list, tuple)):
+        optimizers = [optimizers]
+
+    if level == "O2":
+        excluded_types = tuple(
+            t for t in (
+                getattr(_norm, "BatchNorm", None),
+                getattr(_norm, "BatchNorm1D", None),
+                getattr(_norm, "BatchNorm2D", None),
+                getattr(_norm, "BatchNorm3D", None),
+                getattr(_norm, "LayerNorm", None),
+                getattr(_norm, "InstanceNorm1D", None),
+                getattr(_norm, "InstanceNorm2D", None),
+                getattr(_norm, "InstanceNorm3D", None),
+                getattr(_norm, "GroupNorm", None),
+                getattr(_norm, "SyncBatchNorm", None),
+            ) if t is not None
+        )
+        if excluded_layers:
+            extra = tuple(excluded_layers) if isinstance(excluded_layers, (list, tuple)) else (excluded_layers,)
+            excluded_types = excluded_types + tuple(t for t in extra if isinstance(t, type))
+        dt = _dtypes.canonical_dtype(dtype)
+        for model in models:
+            for sub in model.sublayers(include_self=True):
+                if isinstance(sub, excluded_types):
+                    continue
+                for t in list(sub._parameters.values()) + list(sub._buffers.values()):
+                    if t is not None and _dtypes.is_floating_point(t.dtype):
+                        t._data = t._data.astype(dt)
+                sub._dtype = dt
+        use_master = master_weight if master_weight is not None else True
+        for opt in optimizers:
+            opt._multi_precision = bool(use_master)
+
+    if save_dtype is not None:
+        for model in models:
+            model._save_dtype = _dtypes.canonical_dtype(save_dtype)
+
+    if opts_in is None:
+        return models_in
+    return models_in, opts_in
+
+
+amp_decorate = decorate

@@ -1,0 +1,64 @@
+"""Thread-local AMP (automatic mixed precision) state.
+
+Lives in ``base`` so the tape dispatch point (base/tape.py apply) can
+consult it without importing the user-facing ``paddle_tpu.amp`` package
+(which imports base — this module breaks the cycle).
+
+The reference performs per-op auto-casting inside the generated
+``*_ad_func`` layer (ref: fluid/eager/auto_code_generator/generator/
+eager_gen.py AMP block, fluid/eager/amp_auto_cast.h). Here the single
+dispatch point is ``tape.apply``, so the cast decision is a pure lookup:
+op name -> target dtype (or None for "leave inputs alone").
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Set
+
+import numpy as np
+
+
+class _AmpTLS(threading.local):
+    def __init__(self):
+        self.enable = False
+        self.dtype = None  # np.dtype of the low-precision type
+        self.level = "O1"  # "OD" | "O1" | "O2"
+        self.white: Set[str] = set()
+        self.black: Set[str] = set()
+
+
+_tls = _AmpTLS()
+_FP32 = np.dtype(np.float32)
+
+
+def amp_attrs() -> _AmpTLS:
+    return _tls
+
+
+def amp_enabled() -> bool:
+    return _tls.enable
+
+
+def amp_dtype() -> Optional[np.dtype]:
+    return _tls.dtype if _tls.enable else None
+
+
+def cast_target(op_name: str) -> Optional[np.dtype]:
+    """Target dtype for the floating inputs of ``op_name`` under the
+    active amp state, or None when no casting applies."""
+    if not _tls.enable or not op_name or op_name == "cast":
+        return None
+    if op_name.startswith("grad_"):
+        # backward-pass vjp calls (run_backward dispatches them through
+        # apply with op_name="grad_<op>"): cotangent dtypes must match the
+        # forward residuals exactly — never auto-cast them
+        return None
+    if op_name in _tls.black:
+        return _FP32
+    if _tls.level == "O2":
+        return _tls.dtype
+    if op_name in _tls.white:
+        return _tls.dtype
+    if _tls.level == "OD":
+        return _FP32
+    return None  # O1: ops in neither list keep their input dtypes

@@ -30,6 +30,7 @@ import jax
 import numpy as np
 from jax import tree_util
 
+from . import amp_state
 from . import dtype as dtypes
 from .flags import flag
 
@@ -137,6 +138,23 @@ def apply(fn: Callable, *args, op_name: str = "", **kwargs):
     from .tensor import Tensor
 
     flat, treedef = tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+
+    # AMP: per-op input casting at the single dispatch point (the
+    # reference does this in every generated ad_func; ref eager_gen.py
+    # AMP block). cast itself dispatches through apply with
+    # op_name="cast", which amp_state maps to None — no recursion.
+    amp_target = amp_state.cast_target(op_name)
+    if amp_target is not None:
+        flat = [
+            x.astype(amp_target)
+            if isinstance(x, Tensor)
+            and dtypes.is_floating_point(x.dtype)
+            and np.dtype(x.dtype) != amp_target
+            and np.dtype(x.dtype) != np.dtype(np.float64)
+            else x
+            for x in flat
+        ]
+
     raw = [x._data if isinstance(x, Tensor) else x for x in flat]
 
     diff_idx: List[int] = []

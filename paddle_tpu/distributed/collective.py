@@ -105,7 +105,10 @@ def init_default_group(mesh: Optional[jax.sharding.Mesh] = None) -> Group:
     global _default_group
     if mesh is None:
         mesh = _default_mesh()
-    axis = mesh.axis_names[0]
+    # the world group spans EVERY mesh axis — on a hybrid mesh a psum
+    # over only the first axis would silently reduce a fraction of ranks
+    axes = mesh.axis_names
+    axis = axes[0] if len(axes) == 1 else tuple(axes)
     n = int(np.prod(list(mesh.shape.values())))
     _default_group = Group(list(range(n)), axis, mesh=mesh, pg_id=0, name="default")
     _groups[0] = _default_group

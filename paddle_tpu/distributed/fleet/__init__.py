@@ -59,6 +59,10 @@ def distributed_model(model):
         from .meta_parallel import TensorParallel
 
         return TensorParallel(model, hcg, _strategy)
+    if hcg.get_sep_parallel_world_size() > 1:
+        from .meta_parallel import SegmentParallel
+
+        return SegmentParallel(model, hcg, _strategy)
     if hcg.get_sharding_parallel_world_size() > 1:
         return model  # sharding handled by the sharded optimizer placement
     if hcg.get_data_parallel_world_size() > 1:

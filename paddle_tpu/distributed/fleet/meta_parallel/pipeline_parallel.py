@@ -230,19 +230,25 @@ class PipelineLayer(nn.Layer):
         return body(param_arrays, x)
 
     def _forward_body_sequential(self, h: Tensor) -> Tensor:
-        """Correct fallback: run the S stages in order (no pipelining)."""
+        """Correct fallback: run the S stages in order (no pipelining).
+
+        One tape.apply over (x, *stacked) so cotangents reach the
+        registered stacked Parameters — slicing them into the template
+        params outside the tape would silently drop their grads."""
         if self._num_stages <= 1:
             for l in self._body_layers:
                 h = l(h)
             return h
         S = self._num_stages
-        for s in range(S):
-            arrays = [
-                tape.apply(lambda a, _s=s: a[_s], p, op_name="stage_slice")
-                for p in self._stacked
-            ]
-            h = self._run_stage(arrays, h)
-        return h
+        stage_fn = self._stage_fn_pure
+
+        def seq(x, *stacked):
+            hh = x
+            for s in range(S):
+                hh = stage_fn([st[s] for st in stacked], hh)
+            return hh
+
+        return tape.apply(seq, h, *self._stacked, op_name="pipeline_sequential")
 
     def _forward_body_pipelined(self, h: Tensor, mesh, num_micro: int) -> Tensor:
         """SPMD pipeline over the pp axis; ``h`` is [M*mb, ...]."""
@@ -369,7 +375,10 @@ class PipelineParallel:
         import paddle_tpu.jit as pjit
 
         x, y = data
-        key = ("train", tuple(x.shape), tuple(y.shape))
+        key = (
+            "train", tuple(x.shape), tuple(y.shape),
+            id(optimizer), id(scaler), id(lr_scheduler),
+        )
         if key not in self._compiled:
             layers, opt = self._layers, optimizer
 

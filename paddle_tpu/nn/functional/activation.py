@@ -23,8 +23,10 @@ __all__ = [
 
 
 def _unary(fn, name):
-    def wrapper(x, name=None):
-        return apply(fn, x, op_name=name)
+    # NB: the user-facing ``name=None`` kwarg must not shadow the op name
+    # (amp list lookup keys on op_name at the dispatch point)
+    def wrapper(x, name=None, _op=name):
+        return apply(fn, x, op_name=_op)
 
     wrapper.__name__ = name
     return wrapper

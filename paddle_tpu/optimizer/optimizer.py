@@ -73,6 +73,10 @@ class Optimizer:
         self._multi_precision = multi_precision
         # accumulators: name -> param.name -> jnp array  (a pytree)
         self._accumulators: Dict[str, Dict[str, jnp.ndarray]] = {}
+        # when set (by amp.GradScaler around step()), records the init
+        # value of every accumulator created during that step so a
+        # skipped step can roll them back traceably
+        self._accum_creation_log = None
         self._global_step = 0
 
     # ------------------------------------------------------------------
@@ -124,6 +128,8 @@ class Optimizer:
                 store[key] = jnp.zeros(param._data.shape, dt)
             else:
                 store[key] = init
+            if self._accum_creation_log is not None:
+                self._accum_creation_log[(name, key)] = store[key]
         return store[key]
 
     def _set_accum(self, name: str, param, value):
@@ -141,6 +147,8 @@ class Optimizer:
         store = self._accumulators.setdefault("master_weight", {})
         if param.name not in store:
             store[param.name] = param._data.astype(jnp.float32)
+            if self._accum_creation_log is not None:
+                self._accum_creation_log[("master_weight", param.name)] = store[param.name]
         return store[param.name]
 
     # ------------------------------------------------------------------
@@ -461,7 +469,11 @@ class NAdam(_AdamBase):
         lr = self._lr() * lr_scale
         pv = self._param_value(p)
         g = g.astype(pv.dtype)
-        t = self._global_step
+        # traced step counter (NOT the host _global_step: it would be
+        # baked in at trace time under jit and can't be rolled back by a
+        # GradScaler-skipped step)
+        t = self._get_accum("step", p, init=jnp.zeros((), jnp.float32)) + 1
+        self._set_accum("step", p, t)
         mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._momentum_decay))
         mu_next = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._momentum_decay))
         mu_prod = self._get_accum("mu_product", p, init=jnp.ones((), pv.dtype))
@@ -482,16 +494,22 @@ class RAdam(_AdamBase):
     def _update_param(self, p, g, lr_scale, group):
         lr = self._lr() * lr_scale
         pv, g, m, v, b1p, b2p = self._moments(p, g)
-        t = self._global_step
+        # traced step counter; beta2**t == b2p (already a traced accum)
+        t = self._get_accum("step", p, init=jnp.zeros((), jnp.float32)) + 1
+        self._set_accum("step", p, t)
         rho_inf = 2.0 / (1 - self._beta2) - 1
-        rho_t = rho_inf - 2 * t * (self._beta2 ** t) / (1 - self._beta2 ** t)
+        rho_t = rho_inf - 2 * t * b2p / (1 - b2p)
         m_hat = m / (1 - b1p)
-        if rho_t > 5:
-            v_hat = jnp.sqrt(v / (1 - b2p))
-            r = np.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
-            self._apply(p, pv - lr * r * m_hat / (v_hat + self._epsilon))
-        else:
-            self._apply(p, pv - lr * m_hat)
+        # rectification gate as a select so the step stays traceable;
+        # clamp inside the sqrt to keep the untaken branch finite
+        rho_s = jnp.maximum(rho_t, 5.0)
+        r = jnp.sqrt(
+            ((rho_s - 4) * (rho_s - 2) * rho_inf) / ((rho_inf - 4) * (rho_inf - 2) * rho_s)
+        ).astype(pv.dtype)
+        v_hat = jnp.sqrt(v / (1 - b2p))
+        rect = pv - lr * r * m_hat / (v_hat + self._epsilon)
+        plain = pv - lr * m_hat
+        self._apply(p, jnp.where(rho_t > 5, rect, plain))
 
 
 class Rprop(Optimizer):

@@ -261,3 +261,23 @@ class TestPipelineParallel:
             np.testing.assert_allclose(
                 float(loss_pp), float(loss_serial), rtol=2e-5, atol=1e-6
             )
+
+    def test_pp_sequential_fallback_grads_reach_stacked_params(self):
+        """Regression: the no-mesh fallback must route grads to the
+        registered stacked Parameters (they are what the optimizer sees)."""
+        from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+        paddle.seed(5)
+        pipe = PipelineLayer(
+            layers=[LayerDesc(Block, 8) for _ in range(4)] + [nn.Linear(8, 3)],
+            num_stages=2,
+            loss_fn=lambda lo, y: F.cross_entropy(lo, y),
+        )
+        x = paddle.randn([4, 8])
+        y = paddle.to_tensor(np.array([0, 1, 2, 0], np.int64))
+        logits = pipe(x)  # sequential fallback (no mesh/num_micro given)
+        loss = F.cross_entropy(logits, y)
+        loss.backward()
+        for p in pipe._stacked:
+            assert p.grad is not None, "stacked param got no grad via fallback"
+            assert float(np.abs(np.asarray(p.grad._data)).sum()) > 0
