@@ -67,6 +67,9 @@ from . import jit  # noqa: F401
 from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import amp  # noqa: F401
+from . import framework  # noqa: F401
+from .framework.io import load, save  # noqa: F401
+from . import io  # noqa: F401
 
 
 def disable_static(place=None):

@@ -44,3 +44,5 @@ from .parallel import (  # noqa: F401
     shard_map,
 )
 from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401

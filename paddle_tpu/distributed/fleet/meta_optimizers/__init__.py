@@ -46,3 +46,43 @@ class HybridParallelOptimizer:
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_inner_opt"], name)
+
+
+class DygraphShardingOptimizer:
+    """Stage-1 sharding optimizer (ref: fleet/meta_optimizers/
+    dygraph_optimizer/dygraph_sharding_optimizer.py:44).
+
+    The reference partitions the param list across sharding ranks and
+    broadcasts updated shards each step; here the partition is a
+    NamedSharding on the optimizer accumulators over the topology's
+    ``sharding`` axis — installed via the same placement hook
+    distributed.sharding uses — and GSPMD keeps updates shard-local.
+    """
+
+    def __init__(self, optimizer, hcg=None):
+        from ...sharding import _place, _sharding_mesh_axis
+
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        group = hcg.get_sharding_parallel_group() if hcg is not None else None
+        mesh, axis = _sharding_mesh_axis(group)
+        optimizer._accum_placement_fn = lambda arr: _place(arr, mesh, axis)
+        # re-place accumulators that already exist (resumed / pre-stepped)
+        for store in optimizer._accumulators.values():
+            for key in store:
+                store[key] = _place(store[key], mesh, axis)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)

@@ -1,0 +1,12 @@
+"""paddle_tpu.framework — framework-level utilities (io, dtype helpers).
+
+ref: python/paddle/framework/__init__.py. Most of the reference's
+framework package (Program/Block machinery, monkey-patched Variable) has
+no TPU counterpart — the jaxpr is the program. What remains user-facing
+is serialization (``paddle.save/load``) and a few mode/dtype helpers
+re-exported at top level.
+"""
+from __future__ import annotations
+
+from . import io  # noqa: F401
+from .io import load, save  # noqa: F401

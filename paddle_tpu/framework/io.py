@@ -1,0 +1,101 @@
+"""paddle.save / paddle.load — object serialization.
+
+ref: python/paddle/framework/io.py:740 (save), :982 (load). The
+reference walks nested containers converting Tensor→LoDTensor and
+pickles with a custom protocol; here Tensors serialize as numpy arrays
+tagged so load can rebuild them (on host — the caller re-places onto
+the mesh, or set_state_dict does). Layer.state_dict / Optimizer
+.state_dict round-trip losslessly, including bf16 (via ml_dtypes numpy
+arrays) and the nested dict/list/tuple structures io.py supports.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+class _TensorPayload:
+    """Pickle-stable tag for a Tensor leaf (keeps the saved file free of
+    framework classes, so files load in any future version)."""
+
+    __slots__ = ("array", "stop_gradient", "name")
+
+    def __init__(self, array, stop_gradient, name):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _to_serializable(obj: Any) -> Any:
+    from ..base.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        return _TensorPayload(
+            np.asarray(jax.device_get(obj._data)), obj.stop_gradient, obj.name
+        )
+    if isinstance(obj, jax.Array):
+        return _TensorPayload(np.asarray(jax.device_get(obj)), True, None)
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_serializable(v) for v in obj)
+    # anything else (scalars, strings, LRScheduler instances, …) pickles
+    # directly; Optimizer.state_dict already flattens schedulers to dicts
+    return obj
+
+
+def _from_serializable(obj: Any, return_numpy: bool) -> Any:
+    from ..base.tensor import Tensor
+
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor(obj.array, stop_gradient=obj.stop_gradient, _internal=True)
+        if obj.name:
+            t.name = obj.name
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_serializable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, pickle_protocol: int = _PROTOCOL, **configs):
+    """Save a Tensor / state_dict / nested container to ``path``.
+
+    ref: framework/io.py:740. Paddle conventions honored: parent dirs
+    are created; saving to a bare directory raises; ``.pdparams`` /
+    ``.pdopt`` suffixes are the caller's choice.
+    """
+    if os.path.isdir(path):
+        raise ValueError(f"path must be a file name, got directory: {path}")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = _to_serializable(obj)
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=pickle_protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    """Load an object saved by :func:`save` (ref: framework/io.py:982).
+
+    ``return_numpy=True`` yields raw ndarrays instead of Tensors
+    (parity with the reference's kwarg of the same name).
+    """
+    if not os.path.exists(path):
+        raise ValueError(f"path not found: {path}")
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    return _from_serializable(payload, return_numpy)

@@ -1,0 +1,46 @@
+"""paddle_tpu.io — datasets, samplers, DataLoader.
+
+ref: python/paddle/io/ — Dataset/IterableDataset (dataset.py),
+Sampler/RandomSampler/BatchSampler (batch_sampler.py, sampler.py),
+DataLoader (reader.py:216, dataloader/dataloader_iter.py).
+
+TPU-native redesign: the reference's multiprocess worker pool exists to
+hide CPU decode latency behind GPU kernels launched from the same
+process. On TPU the input pipeline instead needs (a) per-host sharding
+(each host feeds its own chips — DistributedBatchSampler), (b) batches
+landing as device arrays ready for jit donation, and (c) background
+prefetch so host step N+1 overlaps device step N. Threads suffice for
+(c) because the work is numpy/IO, which releases the GIL; a
+C-extension ring buffer is unnecessary where there is no CUDA stream
+to synchronize with.
+"""
+from __future__ import annotations
+
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, default_convert_fn  # noqa: F401
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+    "Sampler", "SequenceSampler", "RandomSampler", "SubsetRandomSampler",
+    "WeightedRandomSampler", "BatchSampler", "DistributedBatchSampler",
+    "DataLoader", "default_collate_fn", "default_convert_fn",
+]

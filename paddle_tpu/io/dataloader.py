@@ -1,0 +1,291 @@
+"""DataLoader (ref: python/paddle/io/reader.py:216 DataLoader,
+io/dataloader/dataloader_iter.py, collate.py, worker.py).
+
+TPU-native redesign. The reference's iterator zoo (single-process,
+multi-process with shared-memory LoDTensor queues, pin-memory threads)
+exists to feed CUDA streams; on TPU the pipeline is:
+
+    sampler → fetch+collate (numpy, worker threads) → [device_put] →
+    bounded prefetch queue → training step
+
+Worker *threads* (not processes) run the fetch: decode/augment code is
+numpy/PIL/IO-bound and releases the GIL, and threads share the dataset
+object so there is no fork/pickle tax. ``prefetch_factor`` batches are
+staged ahead so host work overlaps device steps — the role of the
+reference's `_DataLoaderIterMultiProcess` double-buffering.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler, RandomSampler, SequenceSampler
+
+__all__ = ["DataLoader", "default_collate_fn", "default_convert_fn"]
+
+
+def _is_scalar(x):
+    return isinstance(x, (int, float, np.integer, np.floating, bool, np.bool_))
+
+
+def default_convert_fn(batch):
+    """Identity for already-batched data (ref: collate.py
+    default_convert_fn)."""
+    return batch
+
+
+def default_collate_fn(batch: List[Any]):
+    """Stack a list of samples into batched arrays (ref: collate.py
+    default_collate_fn — same structure cases: ndarray, number, string,
+    Mapping, Sequence)."""
+    sample = batch[0]
+    from ..base.tensor import Tensor
+
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s.numpy()) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if _is_scalar(sample):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(fields)) for fields in transposed)
+    raise TypeError(f"batch data can not be collated: {type(sample)}")
+
+
+class _PrefetchIter:
+    """Background-thread pipeline over batch indices.
+
+    Workers pull batch-index lists from the shared sampler iterator,
+    fetch+collate, and deposit results keyed by sequence number; the
+    consumer emits them in sampler order (the reference preserves order
+    the same way via its _task_infos reordering, dataloader_iter.py).
+    A condition variable bounds the number of staged batches.
+    """
+
+    def __init__(self, loader, batch_iter):
+        self._loader = loader
+        self._batch_iter = batch_iter
+        self._capacity = max(1, loader.num_workers) * loader.prefetch_factor
+        self._cv = threading.Condition()
+        self._results: dict = {}
+        self._next_seq = 0  # next sequence number to hand out
+        self._next_out = 0  # next sequence number to emit
+        self._error = None
+        self._exhausted = False
+        self._shutdown = False
+        self._live = max(1, loader.num_workers)
+        self._workers = [
+            threading.Thread(target=self._worker_loop, daemon=True)
+            for _ in range(self._live)
+        ]
+        for w in self._workers:
+            w.start()
+
+    def _worker_loop(self):
+        loader = self._loader
+        while True:
+            with self._cv:
+                while (
+                    not self._shutdown
+                    and not self._exhausted
+                    and self._next_seq - self._next_out >= self._capacity
+                ):
+                    self._cv.wait()
+                if self._shutdown or self._exhausted or self._error is not None:
+                    break
+                try:
+                    indices = next(self._batch_iter)
+                except StopIteration:
+                    self._exhausted = True
+                    self._cv.notify_all()
+                    break
+                except Exception as e:
+                    self._error = e
+                    self._cv.notify_all()
+                    break
+                seq = self._next_seq
+                self._next_seq += 1
+            try:
+                out = loader.collate_fn([loader.dataset[i] for i in indices])
+                err = None
+            except Exception as e:
+                out, err = None, e
+            with self._cv:
+                if err is not None:
+                    self._error = err
+                else:
+                    self._results[seq] = out
+                self._cv.notify_all()
+        with self._cv:
+            self._live -= 1
+            self._cv.notify_all()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        with self._cv:
+            while True:
+                if self._error is not None:
+                    self._shutdown = True
+                    self._cv.notify_all()
+                    raise self._error
+                if self._next_out in self._results:
+                    item = self._results.pop(self._next_out)
+                    self._next_out += 1
+                    self._cv.notify_all()
+                    break
+                # done when no pending seq can still arrive
+                if self._live == 0 and self._next_out >= self._next_seq:
+                    raise StopIteration
+                self._cv.wait()
+        return self._loader._to_output(item)
+
+    def close(self):
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    def __del__(self):
+        self.close()
+
+
+class _SyncIter:
+    def __init__(self, loader, batch_iter):
+        self._loader = loader
+        self._batch_iter = batch_iter
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        indices = next(self._batch_iter)
+        samples = [self._loader.dataset[i] for i in indices]
+        return self._loader._to_output(self._loader.collate_fn(samples))
+
+
+class _IterableIter:
+    """Iterator over an IterableDataset: group into batches + collate."""
+
+    def __init__(self, loader):
+        self._loader = loader
+        self._it = iter(loader.dataset)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        loader = self._loader
+        batch = list(itertools.islice(self._it, loader.batch_size))
+        if not batch or (loader.drop_last and len(batch) < loader.batch_size):
+            raise StopIteration
+        collate = loader.collate_fn or default_collate_fn
+        return loader._to_output(collate(batch))
+
+
+class DataLoader:
+    """Batched iterator over a Dataset (ref: io/reader.py:216).
+
+    Differences from the reference, by design:
+    - ``num_workers`` spawns prefetch *threads* (see module docstring);
+      0 means synchronous in-loop fetching.
+    - ``return_list`` defaults True (dygraph semantics); outputs are
+      Tensors on the default device unless ``return_numpy=True``.
+    - ``use_shared_memory``/``use_buffer_reader`` accepted as no-ops
+      (CUDA-specific plumbing).
+    """
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        feed_list=None,
+        places=None,
+        return_list: bool = True,
+        batch_sampler: Optional[BatchSampler] = None,
+        batch_size: Optional[int] = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        num_workers: int = 0,
+        use_buffer_reader: bool = True,
+        prefetch_factor: int = 2,
+        use_shared_memory: bool = True,
+        timeout: int = 0,
+        worker_init_fn: Optional[Callable] = None,
+        persistent_workers: bool = False,
+        return_numpy: bool = False,
+    ):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.num_workers = max(0, int(num_workers))
+        self.prefetch_factor = max(1, int(prefetch_factor))
+        self.return_numpy = return_numpy
+        self._iterable = isinstance(dataset, IterableDataset)
+
+        if self._iterable:
+            if batch_sampler is not None or shuffle:
+                raise ValueError(
+                    "IterableDataset does not support batch_sampler/shuffle"
+                )
+            self.batch_size = batch_size or 1
+            self.drop_last = drop_last
+            self.batch_sampler = None
+            self.collate_fn = collate_fn or default_collate_fn
+            return
+
+        if batch_sampler is not None:
+            if batch_size not in (1, None) or shuffle or drop_last:
+                raise ValueError(
+                    "batch_sampler is mutually exclusive with "
+                    "batch_size/shuffle/drop_last"
+                )
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            if batch_size is None:
+                raise ValueError("batch_size=None requires a batch_sampler")
+            self.batch_size = int(batch_size)
+            self.batch_sampler = BatchSampler(
+                dataset=dataset, shuffle=shuffle,
+                batch_size=self.batch_size, drop_last=drop_last,
+            )
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate_fn
+
+    def _to_output(self, batch):
+        if self.return_numpy:
+            return batch
+        from ..base.tensor import Tensor
+
+        def conv(x):
+            if isinstance(x, np.ndarray):
+                return Tensor(x, stop_gradient=True, _internal=True)
+            if isinstance(x, dict):
+                return {k: conv(v) for k, v in x.items()}
+            if isinstance(x, (tuple, list)):
+                return type(x)(conv(v) for v in x)
+            return x
+
+        return conv(batch)
+
+    def __iter__(self):
+        if self._iterable:
+            return _IterableIter(self)
+        batch_iter = iter(self.batch_sampler)
+        if self.num_workers > 0:
+            return _PrefetchIter(self, batch_iter)
+        return _SyncIter(self, batch_iter)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("DataLoader over IterableDataset has no len()")
+        return len(self.batch_sampler)

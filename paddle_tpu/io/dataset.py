@@ -1,0 +1,169 @@
+"""Dataset abstractions (ref: python/paddle/io/dataset.py).
+
+Map-style datasets implement ``__getitem__``/``__len__``; iterable
+datasets implement ``__iter__``. Composition helpers mirror the
+reference set exactly.
+"""
+from __future__ import annotations
+
+import bisect
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+]
+
+
+class Dataset:
+    """Map-style dataset base (ref: io/dataset.py Dataset)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement __getitem__"
+        )
+
+    def __len__(self):
+        raise NotImplementedError(f"{type(self).__name__} must implement __len__")
+
+
+class IterableDataset(Dataset):
+    """Stream-style dataset base (ref: io/dataset.py IterableDataset)."""
+
+    def __iter__(self):
+        raise NotImplementedError(f"{type(self).__name__} must implement __iter__")
+
+    def __getitem__(self, idx):
+        raise TypeError("IterableDataset does not support indexing")
+
+    def __len__(self):
+        # TypeError (not RuntimeError) so list()/length_hint probing
+        # treats this as "no length" instead of propagating
+        raise TypeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    """Wraps equal-length tensors; item i is the tuple of row i
+    (ref: io/dataset.py TensorDataset)."""
+
+    def __init__(self, tensors: Sequence):
+        from ..base.tensor import Tensor
+
+        if not tensors:
+            raise ValueError("TensorDataset needs at least one tensor")
+        self.tensors = list(tensors)
+        self._arrays = [
+            np.asarray(t.numpy() if isinstance(t, Tensor) else t) for t in tensors
+        ]
+        n = len(self._arrays[0])
+        if any(len(a) != n for a in self._arrays):
+            raise ValueError("all tensors must have the same first dimension")
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self._arrays)
+
+    def __len__(self):
+        return len(self._arrays[0])
+
+
+class ComposeDataset(Dataset):
+    """Zip datasets: item i concatenates each dataset's fields
+    (ref: io/dataset.py ComposeDataset)."""
+
+    def __init__(self, datasets: Sequence[Dataset]):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ComposeDataset needs at least one dataset")
+        n = len(self.datasets[0])
+        if any(len(d) != n for d in self.datasets):
+            raise ValueError("all datasets must have the same length")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, (tuple, list)) else [item])
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    """Concatenate iterable datasets (ref: io/dataset.py ChainDataset)."""
+
+    def __init__(self, datasets: Sequence[IterableDataset]):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ConcatDataset(Dataset):
+    """Concatenate map-style datasets (ref: io/dataset.py ConcatDataset)."""
+
+    def __init__(self, datasets: Sequence[Dataset]):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ConcatDataset needs at least one dataset")
+        self.cumulative_sizes: List[int] = []
+        s = 0
+        for d in self.datasets:
+            s += len(d)
+            self.cumulative_sizes.append(s)
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        di = bisect.bisect_right(self.cumulative_sizes, idx)
+        start = self.cumulative_sizes[di - 1] if di > 0 else 0
+        return self.datasets[di][idx - start]
+
+
+class Subset(Dataset):
+    """View of a dataset at selected indices (ref: io/dataset.py Subset)."""
+
+    def __init__(self, dataset: Dataset, indices: Sequence[int]):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset: Dataset, lengths: Sequence, generator=None):
+    """Split into non-overlapping subsets (ref: io/dataset.py
+    random_split). Accepts absolute lengths or fractions summing to 1."""
+    n = len(dataset)
+    lengths = list(lengths)
+    if all(0 < l < 1 for l in lengths) and abs(sum(lengths) - 1.0) < 1e-6:
+        sizes = [int(np.floor(n * frac)) for frac in lengths]
+        for i in range(n - sum(sizes)):
+            sizes[i % len(sizes)] += 1
+        lengths = sizes
+    if sum(lengths) != n:
+        raise ValueError(
+            f"sum of lengths {sum(lengths)} != dataset length {n}"
+        )
+    from ..base import random as _random
+
+    if generator is not None:
+        perm = np.asarray(generator.permutation(n))
+    else:
+        import jax
+
+        perm = np.asarray(jax.random.permutation(_random.next_key(), n))
+    out, offset = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[offset : offset + l].tolist()))
+        offset += l
+    return out

@@ -1,0 +1,231 @@
+"""Samplers and batch samplers (ref: python/paddle/io/sampler.py,
+batch_sampler.py).
+
+DistributedBatchSampler is the TPU input-sharding primitive: each host
+(or each data-parallel rank on a virtual mesh) reads only its slice, the
+same role the reference gives it for multi-GPU (batch_sampler.py
+DistributedBatchSampler).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Sampler", "SequenceSampler", "RandomSampler", "SubsetRandomSampler",
+    "WeightedRandomSampler", "BatchSampler", "DistributedBatchSampler",
+]
+
+
+def _np_rng():
+    """Host-side numpy RNG seeded from the framework generator, so
+    paddle.seed() reproduces shuffles without consuming device RNG."""
+    import jax
+
+    from ..base import random as _random
+
+    key_data = np.asarray(jax.random.key_data(_random.next_key()))
+    return np.random.default_rng(key_data.astype(np.uint32))
+
+
+class Sampler:
+    """Index-sequence base (ref: sampler.py Sampler)."""
+
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    """0..n-1 in order (ref: sampler.py SequenceSampler)."""
+
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    """Uniform permutation, optionally with replacement
+    (ref: sampler.py RandomSampler)."""
+
+    def __init__(self, data_source, replacement: bool = False,
+                 num_samples: Optional[int] = None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+        if not replacement and num_samples is not None and num_samples > len(data_source):
+            raise ValueError("num_samples > dataset size requires replacement=True")
+
+    @property
+    def num_samples(self) -> int:
+        return self._num_samples if self._num_samples is not None else len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.generator is not None:
+            it = iter(self.generator)
+            for _ in range(self.num_samples):
+                try:
+                    yield int(next(it))
+                except StopIteration:
+                    return
+            return
+        rng = _np_rng()
+        if self.replacement:
+            yield from rng.integers(0, n, self.num_samples).tolist()
+        else:
+            yield from rng.permutation(n)[: self.num_samples].tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """Permutation of a fixed index set (ref: sampler.py)."""
+
+    def __init__(self, indices: Sequence[int]):
+        super().__init__(None)
+        self.indices = list(indices)
+
+    def __iter__(self):
+        rng = _np_rng()
+        for i in rng.permutation(len(self.indices)):
+            yield self.indices[i]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class WeightedRandomSampler(Sampler):
+    """Draw by weight (ref: sampler.py WeightedRandomSampler)."""
+
+    def __init__(self, weights: Sequence[float], num_samples: int, replacement: bool = True):
+        super().__init__(None)
+        self.weights = np.asarray(weights, np.float64)
+        if self.weights.ndim != 1 or (self.weights < 0).any():
+            raise ValueError("weights must be a 1-D non-negative sequence")
+        self.num_samples = int(num_samples)
+        self.replacement = bool(replacement)
+        if not self.replacement and self.num_samples > len(self.weights):
+            raise ValueError("num_samples > len(weights) requires replacement")
+
+    def __iter__(self):
+        rng = _np_rng()
+        p = self.weights / self.weights.sum()
+        idx = rng.choice(len(self.weights), self.num_samples,
+                         replace=self.replacement, p=p)
+        yield from idx.tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+def _group_batches(indices, batch_size: int, drop_last: bool) -> Iterator[List[int]]:
+    batch: List[int] = []
+    for idx in indices:
+        batch.append(idx)
+        if len(batch) == batch_size:
+            yield batch
+            batch = []
+    if batch and not drop_last:
+        yield batch
+
+
+class BatchSampler(Sampler):
+    """Group sampler indices into batches (ref: batch_sampler.py:23).
+
+    Accepts either a dataset (with shuffle flag) or an explicit sampler,
+    mirroring the reference's dual constructor.
+    """
+
+    def __init__(self, dataset=None, sampler=None, shuffle: bool = False,
+                 batch_size: int = 1, drop_last: bool = False):
+        if (dataset is None) == (sampler is None):
+            raise ValueError("exactly one of dataset / sampler must be given")
+        if sampler is not None:
+            self.sampler = sampler
+        else:
+            self.sampler = RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.batch_size = int(batch_size)
+        self.drop_last = bool(drop_last)
+
+    def __iter__(self) -> Iterator[List[int]]:
+        yield from _group_batches(self.sampler, self.batch_size, self.drop_last)
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Per-rank slice of the (optionally shuffled) index space
+    (ref: batch_sampler.py DistributedBatchSampler:179).
+
+    ``set_epoch`` reseeds the shuffle so every epoch has a distinct but
+    rank-consistent permutation — identical semantics to the reference.
+    """
+
+    def __init__(self, dataset, batch_size: int, num_replicas: Optional[int] = None,
+                 rank: Optional[int] = None, shuffle: bool = False, drop_last: bool = False):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.dataset = dataset
+        self.sampler = None  # index stream is computed per-epoch in __iter__
+        self.batch_size = int(batch_size)
+        self.shuffle = bool(shuffle)
+        self.drop_last = bool(drop_last)
+        if num_replicas is None or rank is None:
+            from ..distributed.parallel import ParallelEnv
+
+            env = ParallelEnv()
+            num_replicas = env.world_size if num_replicas is None else num_replicas
+            rank = env.rank if rank is None else rank
+        if not 0 <= rank < num_replicas:
+            raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+        self.nranks = int(num_replicas)
+        self.local_rank = int(rank)
+        self.epoch = 0
+        n = len(dataset)
+        if self.drop_last:
+            self.num_samples = n // self.nranks
+        else:
+            self.num_samples = (n + self.nranks - 1) // self.nranks
+        self.total_size = self.num_samples * self.nranks
+
+    def set_epoch(self, epoch: int):
+        self.epoch = int(epoch)
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.epoch)
+            indices = rng.permutation(n)
+        indices = indices.tolist()
+        if not indices:
+            return
+        if not self.drop_last:
+            # pad to total_size by wrapping (reference pads with head);
+            # loop because tiny datasets may need multiple wraps
+            while len(indices) < self.total_size:
+                indices += indices[: self.total_size - len(indices)]
+        else:
+            indices = indices[: self.total_size]
+        local = indices[self.local_rank : self.total_size : self.nranks]
+        assert len(local) == self.num_samples
+        yield from _group_batches(local, self.batch_size, self.drop_last)
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size

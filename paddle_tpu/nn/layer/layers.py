@@ -339,6 +339,7 @@ class Layer:
     def set_state_dict(self, state_dict, use_structured_name: bool = True):
         """Load; returns (missing_keys, unexpected_keys) like the reference."""
         own = self.state_dict()
+        own_names = {t.name for t in own.values() if getattr(t, "name", None)}
         missing, matched = [], set()
         for name, target in own.items():
             if name not in state_dict:
@@ -346,6 +347,21 @@ class Layer:
                 continue
             value = state_dict[name]
             if isinstance(value, Tensor):
+                # adopt the persistent name so optimizer state (keyed by
+                # param name, ref optimizer.py _accumulators) re-attaches
+                # after load — the reference gets this for free from its
+                # deterministic per-class name generator. Never adopt a
+                # name another live param of this layer already holds:
+                # that would merge their accumulator slots.
+                if (
+                    value.name
+                    and value is not target
+                    and value.name != target.name
+                    and value.name not in own_names
+                ):
+                    own_names.discard(target.name)
+                    target.name = value.name
+                    own_names.add(value.name)
                 value = value._data
             value = np.asarray(value)
             if tuple(value.shape) != tuple(target.shape):

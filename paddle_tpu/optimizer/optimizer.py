@@ -77,6 +77,11 @@ class Optimizer:
         # value of every accumulator created during that step so a
         # skipped step can roll them back traceably
         self._accum_creation_log = None
+        # placement hooks installed by distributed.sharding (stage 1/2/3):
+        # accum hook shards new optimizer state over the sharding axis,
+        # grad hook constrains gradient layout (stage-2 reduce-scatter)
+        self._accum_placement_fn = None
+        self._grad_placement_fn = None
         self._global_step = 0
 
     # ------------------------------------------------------------------
@@ -128,6 +133,8 @@ class Optimizer:
                 store[key] = jnp.zeros(param._data.shape, dt)
             else:
                 store[key] = init
+            if self._accum_placement_fn is not None:
+                store[key] = self._accum_placement_fn(store[key])
             if self._accum_creation_log is not None:
                 self._accum_creation_log[(name, key)] = store[key]
         return store[key]
@@ -147,6 +154,8 @@ class Optimizer:
         store = self._accumulators.setdefault("master_weight", {})
         if param.name not in store:
             store[param.name] = param._data.astype(jnp.float32)
+            if self._accum_placement_fn is not None:
+                store[param.name] = self._accum_placement_fn(store[param.name])
             if self._accum_creation_log is not None:
                 self._accum_creation_log[("master_weight", param.name)] = store[param.name]
         return store[param.name]
@@ -161,6 +170,11 @@ class Optimizer:
             params_grads = [
                 (p, p.grad) for p in group["params"] if not p.stop_gradient and p.grad is not None
             ]
+            if self._grad_placement_fn is not None:
+                params_grads = [
+                    (p, Tensor(self._grad_placement_fn(g._data), _internal=True))
+                    for p, g in params_grads
+                ]
             # reference order (ref: optimizer.py:1519-1525): grad clip FIRST,
             # then regularization — the decay term is not clipped
             grad_clip = group.get("grad_clip", self._grad_clip)

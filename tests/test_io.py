@@ -1,0 +1,199 @@
+"""io package tests: datasets, samplers, DataLoader (sync + threaded).
+
+Reference patterns: test/legacy_test/test_dataloader_dataset.py,
+test_batch_sampler.py, test_multiprocess_dataloader_*.py — coverage of
+ordering, drop_last arithmetic, per-rank sharding, and worker-error
+propagation.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (
+    BatchSampler,
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    DataLoader,
+    Dataset,
+    DistributedBatchSampler,
+    IterableDataset,
+    RandomSampler,
+    SequenceSampler,
+    Subset,
+    TensorDataset,
+    WeightedRandomSampler,
+    random_split,
+)
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+class StreamDataset(IterableDataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.float32(i)
+
+
+class TestDatasets:
+    def test_tensor_dataset(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+        y = paddle.to_tensor(np.arange(6))
+        ds = TensorDataset([x, y])
+        assert len(ds) == 6
+        xi, yi = ds[2]
+        np.testing.assert_array_equal(xi, [4.0, 5.0])
+        assert yi == 2
+
+    def test_concat_and_subset(self):
+        ds = ConcatDataset([RangeDataset(3), RangeDataset(4)])
+        assert len(ds) == 7
+        assert ds[5][0] == 2.0  # second dataset, index 2
+        sub = Subset(ds, [0, 5])
+        assert len(sub) == 2 and sub[1][0] == 2.0
+
+    def test_compose(self):
+        ds = ComposeDataset([RangeDataset(3), RangeDataset(3)])
+        item = ds[1]
+        assert len(item) == 4
+
+    def test_chain(self):
+        ds = ChainDataset([StreamDataset(2), StreamDataset(3)])
+        assert len(list(ds)) == 5
+
+    def test_random_split(self):
+        a, b = random_split(RangeDataset(10), [7, 3])
+        assert len(a) == 7 and len(b) == 3
+        seen = sorted([a.indices[i] for i in range(7)] + [b.indices[i] for i in range(3)])
+        assert seen == list(range(10))
+        c, d = random_split(RangeDataset(10), [0.5, 0.5])
+        assert len(c) == 5 and len(d) == 5
+
+
+class TestSamplers:
+    def test_sequence(self):
+        assert list(SequenceSampler(RangeDataset(4))) == [0, 1, 2, 3]
+
+    def test_random_is_permutation(self):
+        idx = list(RandomSampler(RangeDataset(10)))
+        assert sorted(idx) == list(range(10))
+
+    def test_weighted(self):
+        ws = WeightedRandomSampler([0.0, 1.0, 0.0], num_samples=5)
+        assert list(ws) == [1] * 5
+
+    def test_batch_sampler_drop_last(self):
+        bs = BatchSampler(dataset=RangeDataset(10), batch_size=3, drop_last=True)
+        batches = list(bs)
+        assert len(bs) == 3 and all(len(b) == 3 for b in batches)
+        bs2 = BatchSampler(dataset=RangeDataset(10), batch_size=3, drop_last=False)
+        assert len(bs2) == 4 and len(list(bs2)[-1]) == 1
+
+    def test_distributed_sharding_covers_all(self):
+        n, ranks = 11, 4
+        all_idx = []
+        for r in range(ranks):
+            s = DistributedBatchSampler(
+                RangeDataset(n), batch_size=2, num_replicas=ranks, rank=r
+            )
+            for b in s:
+                all_idx.extend(b)
+        assert len(all_idx) == 12  # padded to 3 per rank
+        assert set(all_idx) == set(range(n))
+
+    def test_distributed_set_epoch_changes_order(self):
+        s = DistributedBatchSampler(
+            RangeDataset(16), batch_size=4, num_replicas=2, rank=0, shuffle=True
+        )
+        s.set_epoch(0)
+        e0 = [i for b in s for i in b]
+        s.set_epoch(1)
+        e1 = [i for b in s for i in b]
+        assert e0 != e1
+
+
+class TestDataLoader:
+    @pytest.mark.parametrize("num_workers", [0, 2])
+    def test_order_and_content(self, num_workers):
+        dl = DataLoader(
+            RangeDataset(10), batch_size=4, num_workers=num_workers, shuffle=False
+        )
+        batches = list(dl)
+        assert len(batches) == 3
+        xs = np.concatenate([b[0].numpy() for b in batches])
+        np.testing.assert_array_equal(xs, np.arange(10, dtype=np.float32))
+        assert batches[0][1].dtype == "int64"
+
+    def test_shuffle_epoch(self):
+        dl = DataLoader(RangeDataset(16), batch_size=16, shuffle=True)
+        a = next(iter(dl))[0].numpy()
+        b = next(iter(dl))[0].numpy()
+        assert sorted(a.tolist()) == list(range(16))
+        assert not np.array_equal(a, b)
+
+    def test_worker_error_propagates(self):
+        class Bad(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(DataLoader(Bad(), batch_size=2, num_workers=2))
+
+    def test_iterable_dataset(self):
+        dl = DataLoader(StreamDataset(7), batch_size=3)
+        sizes = [len(b.numpy()) for b in dl]
+        assert sizes == [3, 3, 1]
+        dl2 = DataLoader(StreamDataset(7), batch_size=3, drop_last=True)
+        assert [len(b.numpy()) for b in dl2] == [3, 3]
+
+    def test_dict_collate(self):
+        class DictDS(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return {"x": np.float32(i), "y": np.ones(2, np.float32) * i}
+
+        b = next(iter(DataLoader(DictDS(), batch_size=4)))
+        assert b["x"].shape == [4] and b["y"].shape == [4, 2]
+
+    def test_return_numpy(self):
+        dl = DataLoader(RangeDataset(4), batch_size=2, return_numpy=True)
+        b = next(iter(dl))
+        assert isinstance(b[0], np.ndarray)
+
+    def test_feeds_training_loop(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+
+        model = nn.Linear(2, 3)
+        optimizer = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        ds = TensorDataset(
+            [
+                paddle.to_tensor(np.random.RandomState(0).randn(8, 2).astype(np.float32)),
+                paddle.to_tensor(np.random.RandomState(1).randint(0, 3, (8,))),
+            ]
+        )
+        dl = DataLoader(ds, batch_size=4, num_workers=2)
+        for x, y in dl:
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+        assert np.isfinite(float(loss.numpy()))
