@@ -55,7 +55,7 @@ def main():
             num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=8,
             max_position_embeddings=1024,
         )
-        batch, seq, steps, warmup = 8, 512, 10, 3
+        batch, seq, steps, warmup = 8, 512, 512, 3
     else:  # CPU fallback so the bench is runnable anywhere
         config = LlamaConfig.tiny()
         batch, seq, steps, warmup = 2, 64, 3, 1
@@ -88,16 +88,34 @@ def main():
 
     for _ in range(warmup):
         loss = compiled(ids, labels)
-    loss._data.block_until_ready()
+    np.asarray(loss._data)  # force full execution (block_until_ready may
+    # be a no-op through remote-device tunnels)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = compiled(ids, labels)
-    loss._data.block_until_ready()
-    dt = time.perf_counter() - t0
+    # Timing methodology for high-latency device links: run K steps in a
+    # SINGLE dispatch (lax.scan inside jit, StaticFunction.multi_step),
+    # fetch the result to force execution, and difference two run
+    # lengths so the constant dispatch+fetch round-trip cancels:
+    #   per_step = (T(K2) - T(K1)) / (K2 - K1)
+    k1, k2 = (32, steps) if on_tpu else (1, steps)
+    # warm/compile both scan lengths outside the timed region
+    np.asarray(compiled.multi_step(ids, labels, steps=k1)._data)
+    np.asarray(compiled.multi_step(ids, labels, steps=k2)._data)
+
+    def timed(k):
+        best = float("inf")
+        for _ in range(3 if on_tpu else 1):
+            t0 = time.perf_counter()
+            loss = compiled.multi_step(ids, labels, steps=k)
+            last = float(np.asarray(loss._data)[-1])
+            best = min(best, time.perf_counter() - t0)
+        return best, last
+
+    t_k1, _ = timed(k1)
+    t_k2, final_loss = timed(k2)
+    dt = max(t_k2 - t_k1, 1e-9)
 
     tokens_per_step = batch * seq
-    tokens_per_sec = tokens_per_step * steps / dt
+    tokens_per_sec = tokens_per_step * (k2 - k1) / dt
     flops_per_token = model.flops_per_token(seq)
     achieved = tokens_per_sec * flops_per_token
     mfu = achieved / _peak_flops(dev)
@@ -112,8 +130,8 @@ def main():
                 "vs_baseline": round(vs_baseline, 4),
                 "extra": {
                     "mfu": round(mfu, 4),
-                    "step_ms": round(1000 * dt / steps, 2),
-                    "loss": round(float(np.asarray(loss._data)), 4),
+                    "step_ms": round(1000 * dt / (k2 - k1), 2),
+                    "loss": round(final_loss, 4),
                     "device": getattr(dev, "device_kind", str(dev)),
                     "params": model.num_params(),
                     "batch": batch,

@@ -70,6 +70,9 @@ from . import amp  # noqa: F401
 from . import framework  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
 
 
 def disable_static(place=None):

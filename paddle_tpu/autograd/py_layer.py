@@ -20,14 +20,36 @@ class PyLayerContext:
 
     def __init__(self):
         self._saved = ()
+        self._unpack_hook = None
         self.materialize_grads = True
         self.not_inplace_tensors = ()
 
     def save_for_backward(self, *tensors):
-        self._saved = tuple(tensors)
+        """Stash tensors for backward. Honors active
+        ``paddle.autograd.saved_tensors_hooks``: pack runs now, unpack at
+        retrieval (ref: py_layer.py save_for_backward + the reference's
+        TensorWrapper hook path, saved_tensors_hooks.py)."""
+        from .saved_tensors_hooks import current_hooks
+
+        hooks = current_hooks()
+        if hooks is not None:
+            pack, self._unpack_hook = hooks
+            self._packed_mask = tuple(isinstance(t, Tensor) for t in tensors)
+            self._saved = tuple(
+                pack(t) if isinstance(t, Tensor) else t for t in tensors
+            )
+        else:
+            self._unpack_hook = None
+            self._saved = tuple(tensors)
 
     @property
     def saved_tensor(self):
+        if self._unpack_hook is not None:
+            unpack = self._unpack_hook
+            return tuple(
+                unpack(h) if packed else h
+                for h, packed in zip(self._saved, self._packed_mask)
+            )
         return self._saved
 
     # paddle exposes both names

@@ -1,10 +1,14 @@
 """saved_tensors_hooks (ref: python/paddle/autograd/saved_tensors_hooks.py).
 
-In the reference this intercepts TensorWrapper save/restore (used by
-reentrant-free recompute). Here residuals are captured inside jax.vjp
-closures, so pack/unpack hooks are applied at the Tensor level by the
-recompute machinery; this context manager exposes the same API surface
-and is honored by paddle_tpu.distributed.fleet.recompute.
+In the reference this intercepts TensorWrapper save/restore for every
+op. Here most residuals live inside jax.vjp closures (not addressable
+objects — XLA manages them), so the hookable surface is the place where
+user-visible tensors are explicitly saved: ``PyLayerContext.
+save_for_backward`` packs through the active hooks and ``saved_tensor``
+unpacks (see autograd/py_layer.py). For framework-level activation
+memory control, use ``paddle_tpu.distributed.fleet.utils.recompute`` —
+jax.checkpoint drops residuals wholesale, subsuming the reference's
+pack-to-CPU offload recipes.
 """
 from __future__ import annotations
 

@@ -48,6 +48,11 @@ def cast_target(op_name: str) -> Optional[np.dtype]:
     active amp state, or None when no casting applies."""
     if not _tls.enable or not op_name or op_name == "cast":
         return None
+    if op_name == "recompute":
+        # container op: its body dispatches through apply per-op, where
+        # amp policy applies with the right op names — casting the whole
+        # argument set here would override the inner per-op decisions
+        return None
     if op_name.startswith("grad_"):
         # backward-pass vjp calls (run_backward dispatches them through
         # apply with op_name="grad_<op>"): cotangent dtypes must match the

@@ -1,0 +1,8 @@
+"""fleet.utils — recompute + hybrid-parallel helpers.
+
+ref: python/paddle/distributed/fleet/utils/__init__.py (recompute
+re-export), fleet/utils/sequence_parallel_utils.py.
+"""
+from .recompute import recompute, recompute_sequential  # noqa: F401
+
+__all__ = ["recompute", "recompute_sequential"]

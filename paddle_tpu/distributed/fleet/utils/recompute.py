@@ -1,0 +1,134 @@
+"""Activation recomputation (gradient checkpointing).
+
+ref: python/paddle/distributed/fleet/recompute/recompute.py:109
+(RecomputeFunction), recompute_sequential — the reference implements
+recompute as a PyLayer that saves only the inputs + RNG state in
+forward and replays the user function under grad in backward.
+
+TPU-native redesign: ``jax.checkpoint`` IS that mechanism at jaxpr
+level. The user function is functionalized over (params, args) and
+wrapped in ``jax.checkpoint``; one tape node is recorded whose vjp —
+courtesy of checkpoint — saves only the inputs and rematerializes the
+segment's activations during the backward pass. RNG draws made inside
+the segment are part of the captured jaxpr, so the replay reuses the
+identical dropout masks (the reference needs explicit CUDA RNG
+state-stashing for this; here it falls out of tracing —
+``preserve_rng_state`` is therefore always-on).
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+from jax import tree_util
+
+from ....base import tape as _tape
+from ....base.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _discover_params(function) -> List:
+    """Trainable parameters reachable from ``function``: the Layer itself,
+    a bound method's Layer, or Layers in a lambda/closure."""
+    from ....nn.layer.layers import Layer
+
+    layers: List[Any] = []
+    if isinstance(function, Layer):
+        layers.append(function)
+    self_obj = getattr(function, "__self__", None)
+    if isinstance(self_obj, Layer):
+        layers.append(self_obj)
+    for cell in getattr(function, "__closure__", None) or ():
+        obj = cell.cell_contents
+        if isinstance(obj, Layer):
+            layers.append(obj)
+    params, seen = [], set()
+    for l in layers:
+        for p in l.parameters():
+            if id(p) not in seen and not p.stop_gradient:
+                seen.add(id(p))
+                params.append(p)
+    return params
+
+
+def recompute(function, *args, use_reentrant: bool = True, preserve_rng_state: bool = True, **kwargs):
+    """Run ``function(*args, **kwargs)``, recomputing its activations
+    during backward instead of storing them.
+
+    ``function`` may be a Layer, a bound method of a Layer, or a closure
+    over Layers — trainable parameters are discovered so their gradients
+    flow. ``use_reentrant`` is accepted for parity; both reference
+    variants map to the same jax.checkpoint mechanism here.
+    """
+    params = _discover_params(function)
+    saved_data = [p._data for p in params]
+
+    def raw_fn(param_arrays, raw_args, raw_kwargs):
+        for p, a in zip(params, param_arrays):
+            p._data = a
+
+        def wrap(x):
+            return (
+                Tensor(x, stop_gradient=True, _internal=True)
+                if isinstance(x, jax.Array)
+                else x
+            )
+
+        a2, k2 = tree_util.tree_map(wrap, (tuple(raw_args), raw_kwargs))
+        # inner ops need no tape nodes: differentiation happens at jaxpr
+        # level through jax.checkpoint's vjp
+        with _tape.no_grad():
+            out = function(*a2, **k2)
+        return tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out, is_leaf=_is_tensor
+        )
+
+    # RNG hygiene: draws inside the checkpointed trace mutate the host
+    # tracker with trace-local values. Snapshot the states, then advance
+    # them deterministically afterwards (fold_in gives an independent
+    # stream) so (a) no trace-local key leaks into later ops and (b) two
+    # sequential recompute segments never reuse a key.
+    from ....base import random as _random
+
+    gen = _random.default_generator()
+    tracker = _random.get_rng_state_tracker()
+    g_state = gen.get_state()
+    t_states = dict(tracker.get_states_dict())
+
+    ckpt = jax.checkpoint(raw_fn)
+    try:
+        return _tape.apply(ckpt, list(params), args, kwargs, op_name="recompute")
+    finally:
+        # tracing set p._data to tracers; restore the real arrays
+        for p, d in zip(params, saved_data):
+            p._data = d
+        gen.set_state(jax.random.fold_in(g_state, 0x5EED))
+        tracker.set_states_dict(
+            {k: jax.random.fold_in(v, 0x5EED) for k, v in t_states.items()}
+        )
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Recompute a Sequential in segments (ref: recompute_sequential,
+    fleet/recompute/recompute.py). ``ctx`` supports {"segments": N}."""
+    segments = int(ctx.get("segments", 1)) if isinstance(ctx, dict) else int(ctx or 1)
+    sublayers = list(functions)
+    if segments <= 1:
+        chunks = [sublayers]
+    else:
+        size = (len(sublayers) + segments - 1) // segments
+        chunks = [sublayers[i : i + size] for i in range(0, len(sublayers), size)]
+
+    from ....nn.layer.container import Sequential
+
+    out = args
+    for chunk in chunks:
+        seg = Sequential(*chunk)
+        res = recompute(seg, *out, **kwargs)
+        out = res if isinstance(res, tuple) else (res,)
+    return out[0] if len(out) == 1 else out

@@ -21,29 +21,35 @@ __all__ = ["save", "load"]
 
 _PROTOCOL = 4
 
+# Tensor leaves are tagged with a plain dict, not a framework class, so
+# saved files contain only builtins + numpy and load in any future
+# version (or without paddle_tpu installed, via pickle alone)
+_TENSOR_TAG = "__paddle_tpu_tensor__"
 
-class _TensorPayload:
-    """Pickle-stable tag for a Tensor leaf (keeps the saved file free of
-    framework classes, so files load in any future version)."""
 
-    __slots__ = ("array", "stop_gradient", "name")
-
-    def __init__(self, array, stop_gradient, name):
-        self.array = array
-        self.stop_gradient = stop_gradient
-        self.name = name
+def _tensor_payload(array, stop_gradient, name):
+    return {
+        _TENSOR_TAG: 1,
+        "array": array,
+        "stop_gradient": stop_gradient,
+        "name": name,
+    }
 
 
 def _to_serializable(obj: Any) -> Any:
     from ..base.tensor import Tensor
 
     if isinstance(obj, Tensor):
-        return _TensorPayload(
+        return _tensor_payload(
             np.asarray(jax.device_get(obj._data)), obj.stop_gradient, obj.name
         )
     if isinstance(obj, jax.Array):
-        return _TensorPayload(np.asarray(jax.device_get(obj)), True, None)
+        return _tensor_payload(np.asarray(jax.device_get(obj)), True, None)
     if isinstance(obj, dict):
+        if _TENSOR_TAG in obj:
+            raise ValueError(
+                f"cannot save a dict containing the reserved key {_TENSOR_TAG!r}"
+            )
         return {k: _to_serializable(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         t = type(obj)
@@ -56,12 +62,12 @@ def _to_serializable(obj: Any) -> Any:
 def _from_serializable(obj: Any, return_numpy: bool) -> Any:
     from ..base.tensor import Tensor
 
-    if isinstance(obj, _TensorPayload):
+    if isinstance(obj, dict) and obj.get(_TENSOR_TAG) == 1:
         if return_numpy:
-            return obj.array
-        t = Tensor(obj.array, stop_gradient=obj.stop_gradient, _internal=True)
-        if obj.name:
-            t.name = obj.name
+            return obj["array"]
+        t = Tensor(obj["array"], stop_gradient=obj["stop_gradient"], _internal=True)
+        if obj["name"]:
+            t.name = obj["name"]
         return t
     if isinstance(obj, dict):
         return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}

@@ -1,0 +1,13 @@
+"""paddle_tpu.hapi — high-level Model API (fit/evaluate/predict).
+
+ref: python/paddle/hapi/ — model.py (Model :874), callbacks.py,
+model_summary.py. The reference keeps dual dygraph/static engines
+inside Model; here there is one engine: the eager tape, optionally
+compiled per train/eval step via paddle_tpu.jit.to_static (the
+``jit_compile`` knob in prepare()).
+"""
+from .model import Model  # noqa: F401
+from .model_summary import summary  # noqa: F401
+from . import callbacks  # noqa: F401
+
+__all__ = ["Model", "summary", "callbacks"]

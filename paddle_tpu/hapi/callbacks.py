@@ -1,0 +1,230 @@
+"""Training callbacks (ref: python/paddle/hapi/callbacks.py — Callback
+:109, ProgBarLogger :261, ModelCheckpoint :507, LRScheduler :572,
+EarlyStopping :643, ReduceLROnPlateau-style lives in optimizer.lr).
+"""
+from __future__ import annotations
+
+import numbers
+import time
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = [
+    "Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+    "LRScheduler", "EarlyStopping", "config_callbacks",
+]
+
+
+class Callback:
+    """ref: callbacks.py:109 — hooks over train/eval/predict phases."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+    def on_predict_batch_begin(self, step, logs=None): ...
+    def on_predict_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cbk):
+        self.callbacks.append(cbk)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def __getattr__(self, name):
+        if not name.startswith("on_"):
+            raise AttributeError(name)
+
+        def call(*args, **kwargs):
+            for c in self.callbacks:
+                getattr(c, name)(*args, **kwargs)
+
+        return call
+
+
+class ProgBarLogger(Callback):
+    """Console logger (ref: callbacks.py:261). verbose: 0 silent,
+    1 per-epoch, 2 per-log_freq-steps."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+
+    def _fmt(self, logs):
+        items = []
+        for k, v in (logs or {}).items():
+            if k in ("step", "batch_size"):
+                continue
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else None
+            if isinstance(v, numbers.Number):
+                items.append(f"{k}: {v:.4f}")
+        return " - ".join(items)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose >= 2 and self.log_freq and (step + 1) % self.log_freq == 0:
+            print(f"Epoch {self._epoch + 1}/{self.epochs} step {step + 1}: {self._fmt(logs)}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose >= 1:
+            dt = time.time() - self._t0
+            print(f"Epoch {epoch + 1}/{self.epochs} - {dt:.1f}s - {self._fmt(logs)}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose >= 1:
+            print(f"Eval: {self._fmt(logs)}")
+
+
+class ModelCheckpoint(Callback):
+    """Periodic save (ref: callbacks.py:507)."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/{epoch}")
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(f"{self.save_dir}/final")
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (ref: callbacks.py:572)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler as Sched
+
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if isinstance(lr, Sched) else None
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step and (s := self._sched()) is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch and (s := self._sched()) is not None:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    """Stop when a monitored metric stops improving (ref:
+    callbacks.py:643 — same monitor/mode/patience/min_delta/baseline
+    semantics, acting on eval logs)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.baseline = baseline
+        self.min_delta = abs(min_delta)
+        self.wait_epoch = 0
+        self.best_weights = None
+        self.stopped_epoch = 0
+        self.save_best_model = save_best_model
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "min" or (mode == "auto" and "acc" not in self.monitor):
+            self.monitor_op = np.less
+            self.min_delta *= -1
+        else:
+            self.monitor_op = np.greater
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        if self.baseline is not None:
+            self.best_value = self.baseline
+        else:
+            self.best_value = np.inf if self.monitor_op == np.less else -np.inf
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if self.monitor_op(current - self.min_delta, self.best_value):
+            self.best_value = current
+            self.wait_epoch = 0
+            return
+        self.wait_epoch += 1
+        if self.wait_epoch > self.patience:
+            self.model.stop_training = True
+            if self.verbose:
+                print(f"EarlyStopping: no improvement in {self.monitor}")
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=2, verbose=2, save_freq=1,
+                     save_dir=None, metrics=None, mode="train"):
+    """Assemble the default callback stack (ref: callbacks.py:44)."""
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks = cbks + [LRScheduler()]
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    cbk_list = CallbackList(cbks)
+    cbk_list.set_model(model)
+    cbk_list.set_params(
+        {
+            "batch_size": batch_size,
+            "epochs": epochs,
+            "steps": steps,
+            "verbose": verbose,
+            "metrics": metrics or ["loss"],
+        }
+    )
+    return cbk_list

@@ -1,0 +1,309 @@
+"""High-level Model wrapper (ref: python/paddle/hapi/model.py:874).
+
+Train/eval/predict loops over io.DataLoader with callbacks + metrics.
+TPU notes: the train and eval steps are (optionally) compiled whole —
+forward+loss+backward+update as one XLA program — via
+``prepare(..., jit_compile=True)`` (default), the role the reference's
+static-graph Model engine plays, without a second engine.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..base.tensor import Tensor
+from ..metric import Metric
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    """ref: hapi/model.py Model — same public surface
+    (prepare/fit/evaluate/predict/save/load/parameters/summary)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._jit = True
+        self._train_step = None
+        self._eval_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit_compile: bool = True):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric must be a paddle.metric.Metric, got {type(m)}")
+        self._jit = jit_compile
+        self._train_step = None
+        self._eval_step = None
+
+    # ------------------------------------------------------------------
+    def _split_batch(self, batch):
+        """(inputs..., label) convention: last element is the label when a
+        loss is configured (ref: model.py _update_inputs handling)."""
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), batch[-1]
+        return [batch], None
+
+    def _build_train_step(self):
+        network, loss_fn, optimizer = self.network, self._loss, self._optimizer
+
+        def step(*args):
+            *xs, y = args
+            out = network(*xs)
+            loss = loss_fn(out, y)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            return loss, out
+
+        if self._jit:
+            from .. import jit
+
+            step = jit.to_static(step, layers=[network], optimizers=[optimizer])
+        return step
+
+    def _build_eval_step(self):
+        network, loss_fn = self.network, self._loss
+
+        def step(*args):
+            *xs, y = args
+            out = network(*xs)
+            loss = loss_fn(out, y) if loss_fn is not None else None
+            return loss, out
+
+        if self._jit:
+            from .. import jit
+
+            step = jit.to_static(step, layers=[network])
+        return step
+
+    # ------------------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        self.network.train()
+        args = _to_list(inputs) + _to_list(labels)
+        loss, out = self._train_step(*args)
+        metrics = self._update_metrics(out, _to_list(labels)[0] if labels else None)
+        return [float(np.asarray(loss.numpy()))], metrics
+
+    def eval_batch(self, inputs, labels=None):
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        self.network.eval()
+        args = _to_list(inputs) + _to_list(labels)
+        loss, out = self._eval_step(*args)
+        metrics = self._update_metrics(out, _to_list(labels)[0] if labels else None)
+        losses = [float(np.asarray(loss.numpy()))] if loss is not None else []
+        return losses, metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..base.tape import no_grad
+
+        with no_grad():
+            out = self.network(*_to_list(inputs))
+        return [np.asarray(o.numpy()) for o in _to_list(out)]
+
+    def _update_metrics(self, out, label):
+        vals = []
+        first = out[0] if isinstance(out, (list, tuple)) else out
+        for m in self._metrics:
+            computed = m.compute(first, label)
+            vals.append(m.update(*computed) if isinstance(computed, tuple) else m.update(computed))
+        return vals
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        from .callbacks import CallbackList, config_callbacks
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(
+                train_data, batch_size=batch_size, shuffle=shuffle,
+                drop_last=drop_last, num_workers=num_workers,
+            )
+        else:
+            train_loader = train_data
+        if isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            verbose=verbose, metrics=self._metrics_name(),
+        )
+
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        logs = {}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step_i, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step_i)
+                xs, y = self._split_batch(batch)
+                losses, metrics = self.train_batch(xs, [y] if y is not None else None)
+                logs = self._make_logs(losses, metrics)
+                logs["step"] = step_i
+                logs["batch_size"] = (
+                    y.shape[0] if isinstance(y, Tensor) else batch_size
+                )
+                cbks.on_train_batch_end(step_i, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(
+                    eval_loader, verbose=0, callbacks=None, _cbks=cbks
+                )
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None, _cbks=None):
+        from ..io import DataLoader, Dataset
+        from .callbacks import config_callbacks
+
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        else:
+            loader = eval_data
+        cbks = _cbks or config_callbacks(
+            callbacks, model=self, log_freq=log_freq, verbose=verbose,
+            metrics=self._metrics_name(),
+        )
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        losses_sum, n = 0.0, 0
+        for step_i, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step_i)
+            xs, y = self._split_batch(batch)
+            losses, metrics = self.eval_batch(xs, [y] if y is not None else None)
+            if losses:
+                losses_sum += losses[0]
+                n += 1
+            logs = self._make_logs(losses, metrics)
+            cbks.on_eval_batch_end(step_i, logs)
+            if num_iters is not None and step_i + 1 >= num_iters:
+                break
+        if n:
+            logs["loss"] = [losses_sum / n]
+        for m in self._metrics:
+            logs[_name_str(m)] = m.accumulate()
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            if self._loss is not None:
+                # dataset yields (inputs..., label): drop the label, as the
+                # reference's input-spec slicing does (model.py _run_one_epoch)
+                xs, _ = self._split_batch(batch)
+            else:
+                xs = batch if isinstance(batch, (list, tuple)) else [batch]
+            outputs.append(self.predict_batch(list(xs)))
+        # transpose: list over batches of list over outputs → per-output
+        per_out = list(zip(*outputs))
+        if stack_outputs:
+            return [np.concatenate(o, axis=0) for o in per_out]
+        return [list(o) for o in per_out]
+
+    # ------------------------------------------------------------------
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, (list, tuple)) else [n])
+        return names
+
+    def _make_logs(self, losses, metric_vals):
+        logs = {}
+        if losses:
+            logs["loss"] = losses
+        for m, v in zip(self._metrics, metric_vals):
+            logs[_name_str(m)] = v
+        return logs
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        """ref: model.py save — training=True saves .pdparams/.pdopt;
+        False exports for inference via jit.save."""
+        from .. import framework, jit
+
+        if training:
+            framework.io.save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                framework.io.save(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            jit.save(self.network, path)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import framework
+
+        self.network.set_state_dict(framework.io.load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if (
+            not reset_optimizer
+            and self._optimizer is not None
+            and os.path.exists(opt_path)
+        ):
+            self._optimizer.set_state_dict(framework.io.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def _name_str(m: Metric) -> str:
+    n = m.name()
+    return n[0] if isinstance(n, (list, tuple)) else n

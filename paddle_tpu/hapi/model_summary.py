@@ -1,0 +1,81 @@
+"""paddle.summary (ref: python/paddle/hapi/model_summary.py:36).
+
+Walks the layer tree with forward hooks recording output shapes and
+parameter counts, printing the familiar table. Runs the forward on
+zeros of the given input_size (host-side shapes only — a single tiny
+eager forward, no compile).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Returns {'total_params': N, 'trainable_params': M} and prints the
+    per-layer table (ref: model_summary.py summary)."""
+    from ..base.tensor import Tensor
+    from .. import to_tensor
+
+    if input is None:
+        if input_size is None:
+            raise ValueError("either input_size or input must be given")
+        sizes = [input_size] if isinstance(input_size, tuple) else list(input_size)
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else [dtypes] * len(sizes)
+        inputs = [
+            to_tensor(np.zeros([d if d and d > 0 else 1 for d in s],
+                               np.dtype(dt or "float32")))
+            for s, dt in zip(sizes, dts)
+        ]
+    else:
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    records: List[Tuple[str, str, list, int]] = []
+    hooks = []
+
+    def make_hook(name, cls):
+        def hook(layer, inp, out):
+            out0 = out[0] if isinstance(out, (list, tuple)) else out
+            shape = list(out0.shape) if isinstance(out0, Tensor) else []
+            n_params = sum(
+                int(np.prod(p.shape)) for p in layer.parameters(include_sublayers=False)
+            )
+            records.append((name, cls, shape, n_params))
+
+        return hook
+
+    for name, sub in net.named_sublayers(include_self=False):
+        hooks.append(sub.register_forward_post_hook(make_hook(name, type(sub).__name__)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(
+        int(np.prod(p.shape)) for p in net.parameters() if not p.stop_gradient
+    )
+
+    w_name, w_shape = 28, 24
+    line = "-" * (w_name + w_shape + 34)
+    print(line)
+    print(f"{'Layer (type)':<{w_name}}{'Output Shape':<{w_shape}}{'Param #':>10}")
+    print(line)
+    for name, cls, shape, n in records:
+        label = f"{name} ({cls})"
+        print(f"{label:<{w_name}}{str(shape):<{w_shape}}{n:>10,}")
+    print(line)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

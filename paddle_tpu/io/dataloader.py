@@ -84,14 +84,23 @@ class _PrefetchIter:
         self._shutdown = False
         self._live = max(1, loader.num_workers)
         self._workers = [
-            threading.Thread(target=self._worker_loop, daemon=True)
-            for _ in range(self._live)
+            threading.Thread(target=self._worker_loop, args=(i,), daemon=True)
+            for i in range(self._live)
         ]
         for w in self._workers:
             w.start()
 
-    def _worker_loop(self):
+    def _worker_loop(self, worker_id):
         loader = self._loader
+        if loader.worker_init_fn is not None:
+            try:
+                loader.worker_init_fn(worker_id)
+            except Exception as e:
+                with self._cv:
+                    self._error = e
+                    self._cv.notify_all()
+                    self._live -= 1
+                return
         while True:
             with self._cv:
                 while (
@@ -133,6 +142,7 @@ class _PrefetchIter:
         return self
 
     def __next__(self):
+        timeout = self._loader.timeout or None
         with self._cv:
             while True:
                 if self._error is not None:
@@ -147,7 +157,12 @@ class _PrefetchIter:
                 # done when no pending seq can still arrive
                 if self._live == 0 and self._next_out >= self._next_seq:
                     raise StopIteration
-                self._cv.wait()
+                if not self._cv.wait(timeout) and timeout:
+                    self._shutdown = True
+                    self._cv.notify_all()
+                    raise RuntimeError(
+                        f"DataLoader worker timed out after {timeout}s"
+                    )
         return self._loader._to_output(item)
 
     def close(self):
@@ -171,6 +186,52 @@ class _SyncIter:
         indices = next(self._batch_iter)
         samples = [self._loader.dataset[i] for i in indices]
         return self._loader._to_output(self._loader.collate_fn(samples))
+
+
+class _StreamPrefetchIter:
+    """Single-reader prefetch over an order-sensitive stream iterator."""
+
+    _DONE = object()
+
+    def __init__(self, loader, inner):
+        import queue
+
+        self._loader = loader
+        self._q: "queue.Queue" = queue.Queue(maxsize=loader.prefetch_factor)
+        self._inner = inner
+        self._error = None
+        if loader.worker_init_fn is not None:
+            loader.worker_init_fn(0)
+        self._thread = threading.Thread(target=self._reader, daemon=True)
+        self._thread.start()
+
+    def _reader(self):
+        try:
+            for item in self._inner:
+                self._q.put(item)
+        except Exception as e:
+            self._error = e
+        finally:
+            self._q.put(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import queue
+
+        timeout = self._loader.timeout or None
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            raise RuntimeError(
+                f"DataLoader stream reader timed out after {timeout}s"
+            ) from None
+        if item is self._DONE:
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
 
 
 class _IterableIter:
@@ -229,6 +290,10 @@ class DataLoader:
         self.num_workers = max(0, int(num_workers))
         self.prefetch_factor = max(1, int(prefetch_factor))
         self.return_numpy = return_numpy
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self.persistent_workers = persistent_workers  # threads are cheap;
+        # accepted for parity, workers are (re)spawned per epoch
         self._iterable = isinstance(dataset, IterableDataset)
 
         if self._iterable:
@@ -279,7 +344,10 @@ class DataLoader:
 
     def __iter__(self):
         if self._iterable:
-            return _IterableIter(self)
+            it = _IterableIter(self)
+            # stream order must be preserved: one background reader
+            # thread stages batches ahead (host/device overlap)
+            return _StreamPrefetchIter(self, it) if self.num_workers > 0 else it
         batch_iter = iter(self.batch_sampler)
         if self.num_workers > 0:
             return _PrefetchIter(self, batch_iter)

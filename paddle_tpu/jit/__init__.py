@@ -210,6 +210,89 @@ class StaticFunction:
             c._grad_node = None
             c._consumer_nodes = []
 
+    # -- multi-step: K train steps in ONE device dispatch ----------------
+    def multi_step(self, *stacked_args, steps: Optional[int] = None, lr_schedule=None):
+        """Run K steps under a single ``lax.scan`` dispatch.
+
+        Each leaf of ``stacked_args`` must carry a leading axis of length
+        K (per-step data), or pass un-stacked args with ``steps=K`` to
+        reuse the same batch each step. One dispatch = no per-step host
+        round-trip — essential on high-latency links and the idiom the
+        reference approximates with dataloader prefetch + async executors
+        (SURVEY §3.1). Call the function normally once first so lazy
+        state (optimizer accumulators) exists and the carry structure is
+        stable.
+
+        LR semantics: by default the current learning rate is held
+        constant across the K steps (host-side LRScheduler.step() cannot
+        run inside the scan). Pass ``lr_schedule`` — a length-K array, or
+        a list of them (one per optimizer) — to vary the LR per step.
+
+        Returns the K-stacked outputs.
+        """
+        if not self._cells:
+            raise RuntimeError(
+                "multi_step requires one regular call first (to create "
+                "optimizer state and cache the carry structure)"
+            )
+        if steps is not None:
+            stacked_args = tree_util.tree_map(
+                lambda a: jnp.broadcast_to(
+                    (a._data if isinstance(a, Tensor) else jnp.asarray(a))[None],
+                    (steps,) + tuple((a._data if isinstance(a, Tensor) else jnp.asarray(a)).shape),
+                ),
+                stacked_args,
+                is_leaf=_is_tensor,
+            )
+        flat, arg_treedef = tree_util.tree_flatten((stacked_args, {}), is_leaf=_is_tensor)
+        flat_arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in flat]
+        n = len(flat_arrays[0]) if flat_arrays else (steps or 0)
+
+        if lr_schedule is None:
+            lrs_stacked = [
+                jnp.full((n,), o.get_lr(), jnp.float32) for o in self._optimizers
+            ]
+        else:
+            if not isinstance(lr_schedule, (list, tuple)):
+                lr_schedule = [lr_schedule]
+            if len(lr_schedule) != len(self._optimizers):
+                raise ValueError(
+                    f"lr_schedule needs {len(self._optimizers)} entries, "
+                    f"got {len(lr_schedule)}"
+                )
+            lrs_stacked = [jnp.asarray(s, jnp.float32).reshape(n) for s in lr_schedule]
+
+        state = self._read_state()
+
+        key = ("__multi_step__", arg_treedef)
+        jitted = self._jit_cache.get(key)
+        if jitted is None:
+            pure = self._make_pure(arg_treedef)
+
+            def scanned(state, lrs_stacked, flat_stacked):
+                def body(st, xs):
+                    lrs_t, data = xs
+                    out, new_st = pure(st, list(lrs_t), list(data))
+                    return new_st, out
+
+                new_state, outs = jax.lax.scan(
+                    body, state, (tuple(lrs_stacked), tuple(flat_stacked))
+                )
+                return outs, new_state
+
+            jitted = jax.jit(
+                scanned, donate_argnums=(0,) if self._donate_state else ()
+            )
+            self._jit_cache[key] = jitted
+        outs, new_state = jitted(state, lrs_stacked, flat_arrays)
+        self._write_state(new_state)
+        self._sanitize_grads()
+        for o in self._optimizers:
+            o._global_step += n
+        return tree_util.tree_map(
+            lambda a: Tensor(a, _internal=True) if isinstance(a, jax.Array) else a, outs
+        )
+
     # -- inspection -----------------------------------------------------
     def concrete_program(self):
         return self._last_lowered

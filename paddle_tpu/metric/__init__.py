@@ -1,0 +1,217 @@
+"""paddle_tpu.metric — streaming metrics.
+
+ref: python/paddle/metric/metrics.py (Metric base :46, Accuracy :175,
+Precision :310, Recall :407, Auc :504). Same streaming contract:
+``update`` consumes per-batch results, ``accumulate`` reports the
+running value, ``reset`` clears state. Computation is host-side numpy —
+metrics are consumed between steps, so keeping them off-device avoids
+blocking the TPU pipeline on tiny reductions.
+"""
+from __future__ import annotations
+
+import abc
+from typing import List, Sequence, Union
+
+import numpy as np
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+def _to_np(x):
+    from ..base.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Streaming metric base (ref: metrics.py:46)."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def update(self, *args):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def accumulate(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        """Optional pre-processing done on device outputs; default
+        passthrough (ref: metrics.py Metric.compute)."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (ref: metrics.py:175)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = _to_np(pred)
+        label = _to_np(label)
+        idx = np.argsort(-pred, axis=-1)[..., : self.maxk]
+        if label.ndim == pred.ndim:
+            if label.shape[-1] == 1:  # (N, 1) class-index column
+                label = label[..., 0]
+            else:  # one-hot / soft labels
+                label = np.argmax(label, axis=-1)
+        correct = idx == label[..., None]
+        return correct
+
+    def update(self, correct, *args):
+        correct = _to_np(correct)
+        num_samples = int(np.prod(correct.shape[:-1]))
+        accs = []
+        for k in self.topk:
+            num_corrects = int(correct[..., :k].sum())
+            accs.append(float(num_corrects) / max(num_samples, 1))
+            self.total[self.topk.index(k)] += num_corrects
+            self.count[self.topk.index(k)] += num_samples
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        out = [t / c if c > 0 else 0.0 for t, c in zip(self.total, self.count)]
+        return out[0] if len(out) == 1 else out
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision (ref: metrics.py:310)."""
+
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds).flatten()
+        labels = _to_np(labels).flatten()
+        pred_pos = np.rint(preds).astype(np.int64) == 1
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fp += int(np.sum(pred_pos & (labels != 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall (ref: metrics.py:407)."""
+
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds).flatten()
+        labels = _to_np(labels).flatten()
+        pred_pos = np.rint(preds).astype(np.int64) == 1
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fn += int(np.sum(~pred_pos & (labels == 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via thresholded confusion histogram (ref: metrics.py:504 —
+    same num_thresholds bucketing algorithm)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self._curve = curve
+        self._num_thresholds = int(num_thresholds)
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds)
+        labels = _to_np(labels).flatten()
+        if preds.ndim == 2 and preds.shape[1] >= 2:
+            pos_prob = preds[:, 1]
+        else:
+            pos_prob = preds.flatten()
+        buckets = np.minimum(
+            (pos_prob * self._num_thresholds).astype(np.int64),
+            self._num_thresholds,
+        )
+        pos = labels.astype(bool)
+        self._stat_pos += np.bincount(
+            buckets[pos], minlength=self._num_thresholds + 1
+        )
+        self._stat_neg += np.bincount(
+            buckets[~pos], minlength=self._num_thresholds + 1
+        )
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self._num_thresholds + 1, np.int64)
+
+    def accumulate(self):
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        for i in range(self._num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        denom = tot_pos * tot_neg
+        return float(auc / denom) if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (ref: python/paddle/metric/metrics.py:
+    accuracy functional)."""
+    from .. import to_tensor
+
+    pred = _to_np(input)
+    lab = _to_np(label)
+    idx = np.argsort(-pred, axis=-1)[..., :k]
+    if lab.ndim == pred.ndim and lab.shape[-1] == 1:
+        lab = lab[..., 0]
+    hit = (idx == lab[..., None]).any(axis=-1)
+    return to_tensor(np.asarray(hit.mean(), np.float32))

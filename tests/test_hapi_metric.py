@@ -1,0 +1,127 @@
+"""hapi Model.fit / metric package tests.
+
+Reference pattern: test/legacy_test/test_model.py (fit/evaluate/predict
+round-trip on a small classifier) + test_metrics.py (streaming metric
+math against sklearn-style hand computations).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.io import Dataset, TensorDataset
+from paddle_tpu.metric import Accuracy, Auc, Metric, Precision, Recall
+
+
+class TestMetrics:
+    def test_accuracy_stream(self):
+        m = Accuracy()
+        pred = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], np.float32)
+        label = np.array([0, 1, 1])
+        m.update(m.compute(pred, label))
+        assert abs(m.accumulate() - 2 / 3) < 1e-6
+        m.update(m.compute(np.array([[0.1, 0.9]], np.float32), np.array([1])))
+        assert abs(m.accumulate() - 3 / 4) < 1e-6
+        m.reset()
+        assert m.accumulate() == 0.0
+
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.5, 0.3, 0.2], [0.1, 0.4, 0.5]], np.float32)
+        label = np.array([1, 1])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert abs(top1 - 0.0) < 1e-6 and abs(top2 - 1.0) < 1e-6
+        assert m.name() == ["acc_top1", "acc_top2"]
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6  # tp=2 fp=1
+        assert abs(r.accumulate() - 2 / 3) < 1e-6  # tp=2 fn=1
+
+    def test_auc_perfect_and_random(self):
+        m = Auc()
+        preds = np.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7], [0.8, 0.2]])
+        labels = np.array([1, 0, 1, 0])
+        m.update(preds, labels)
+        assert abs(m.accumulate() - 1.0) < 1e-3
+
+
+class _RandomDS(Dataset):
+    """Linearly separable 2-class problem."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 8).astype(np.float32)
+        w = np.random.RandomState(42).randn(8)  # same task for all splits
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _model():
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+    m = paddle.Model(net)
+    m.prepare(
+        optimizer=opt.Adam(learning_rate=1e-2, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy(),
+    )
+    return m
+
+
+class TestHapiModel:
+    def test_fit_evaluate_predict(self, capsys):
+        m = _model()
+        logs = m.fit(_RandomDS(), epochs=6, batch_size=16, verbose=0)
+        assert "loss" in logs
+        ev = m.evaluate(_RandomDS(n=32, seed=1), batch_size=16, verbose=0)
+        assert ev["acc"] > 0.7
+        preds = m.predict(_RandomDS(n=32, seed=1), batch_size=16, stack_outputs=True)
+        assert preds[0].shape == (32, 2)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = _model()
+        m.fit(_RandomDS(), epochs=1, batch_size=16, verbose=0)
+        path = str(tmp_path / "ckpt")
+        m.save(path)
+        m2 = _model()
+        m2.load(path)
+        e1 = m.evaluate(_RandomDS(n=16, seed=2), batch_size=16, verbose=0)
+        e2 = m2.evaluate(_RandomDS(n=16, seed=2), batch_size=16, verbose=0)
+        np.testing.assert_allclose(e1["loss"], e2["loss"], rtol=1e-5)
+
+    def test_early_stopping(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+
+        m = _model()
+        cb = EarlyStopping(monitor="loss", patience=0, verbose=0, mode="min",
+                           baseline=0.0)  # nothing beats 0 -> stop after 1st eval
+        m.fit(_RandomDS(), eval_data=_RandomDS(n=16, seed=1), epochs=5,
+              batch_size=16, verbose=0, callbacks=[cb])
+        assert m.stop_training
+
+    def test_lr_scheduler_callback_steps(self):
+        net = nn.Sequential(nn.Linear(8, 2))
+        sched = opt.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+        optimizer = opt.SGD(learning_rate=sched, parameters=net.parameters())
+        m = paddle.Model(net)
+        m.prepare(optimizer=optimizer, loss=nn.CrossEntropyLoss())
+        m.fit(_RandomDS(n=8), epochs=1, batch_size=4, verbose=0)
+        assert sched.last_epoch >= 2  # stepped per train batch
+
+    def test_summary(self, capsys):
+        net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+        info = paddle.summary(net, (1, 8))
+        out = capsys.readouterr().out
+        assert info["total_params"] == 8 * 32 + 32 + 32 * 2 + 2
+        assert "Linear" in out and "Total params" in out

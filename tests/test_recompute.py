@@ -1,0 +1,174 @@
+"""Recompute (gradient checkpointing) + saved_tensors_hooks tests.
+
+Reference pattern: test/collective/fleet/test_dygraph_recompute*.py —
+recomputed runs must produce identical losses AND identical grads to
+the plain run, including with dropout (RNG state must not correlate
+segments), and must compose with to_static.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.fleet.utils import recompute, recompute_sequential
+
+
+def _block(hidden=32):
+    return nn.Sequential(
+        nn.Linear(hidden, hidden * 4),
+        nn.GELU(),
+        nn.Linear(hidden * 4, hidden),
+    )
+
+
+class Net(nn.Layer):
+    def __init__(self, use_recompute, segments=0):
+        super().__init__()
+        self.blocks = nn.LayerList([_block() for _ in range(3)])
+        self.head = nn.Linear(32, 4)
+        self.use_recompute = use_recompute
+        self.segments = segments
+
+    def forward(self, x):
+        for b in self.blocks:
+            if self.use_recompute:
+                x = recompute(b, x)
+            else:
+                x = b(x)
+        return self.head(x)
+
+
+def _grads_and_loss(use_recompute):
+    paddle.seed(11)
+    net = Net(use_recompute)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 32).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 4, (8,)))
+    loss = F.cross_entropy(net(x), y)
+    loss.backward()
+    grads = {k: np.asarray(p.grad.numpy()) for k, p in net.named_parameters()}
+    return float(loss.numpy()), grads
+
+
+class TestRecompute:
+    def test_matches_plain_backward(self):
+        l0, g0 = _grads_and_loss(False)
+        l1, g1 = _grads_and_loss(True)
+        assert abs(l0 - l1) < 1e-6
+        assert g0.keys() == g1.keys()
+        for k in g0:
+            np.testing.assert_allclose(g1[k], g0[k], rtol=1e-5, atol=1e-6, err_msg=k)
+
+    def test_under_to_static_trains(self):
+        paddle.seed(11)
+        net = Net(True)
+        optimizer = opt.AdamW(learning_rate=1e-2, parameters=net.parameters())
+
+        def step(x, y):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            return loss
+
+        compiled = paddle.jit.to_static(step, layers=[net], optimizers=[optimizer])
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 32).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (8,)))
+        losses = [float(compiled(x, y).numpy()) for _ in range(6)]
+        assert losses[-1] < losses[0]
+
+    def test_dropout_segments_not_correlated(self):
+        """Two recomputed dropout blocks must not reuse the same mask."""
+        paddle.seed(5)
+        drop = nn.Dropout(0.5)
+        x = paddle.to_tensor(np.ones((4, 64), np.float32))
+        a = recompute(drop, x)
+        b = recompute(drop, x)
+        assert not np.array_equal(a.numpy(), b.numpy())
+
+    def test_recompute_sequential(self):
+        paddle.seed(11)
+        seq = nn.Sequential(*[_block() for _ in range(4)])
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 32).astype(np.float32))
+        ref = seq(x)
+        out = recompute_sequential({"segments": 2}, seq, x)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5, atol=1e-6)
+        loss = out.sum()
+        loss.backward()
+        assert seq[0][0].weight.grad is not None
+
+    def test_kwargs_and_multi_arg(self):
+        class TwoIn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            def forward(self, a, b, scale=1.0):
+                return self.fc(a) * scale + b
+
+        paddle.seed(0)
+        m = TwoIn()
+        a = paddle.to_tensor(np.random.RandomState(0).randn(2, 8).astype(np.float32))
+        a.stop_gradient = False
+        b = paddle.to_tensor(np.random.RandomState(1).randn(2, 8).astype(np.float32))
+        out = recompute(m, a, b, scale=2.0)
+        ref = m(a, b, scale=2.0)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+        out.sum().backward()
+        assert a.grad is not None and m.fc.weight.grad is not None
+
+
+class TestSavedTensorsHooks:
+    def test_pylayer_pack_unpack_roundtrip(self):
+        from paddle_tpu.autograd import PyLayer
+        from paddle_tpu.autograd.saved_tensors_hooks import saved_tensors_hooks
+
+        events = []
+
+        def pack(t):
+            events.append("pack")
+            return np.asarray(t.numpy())  # e.g. offload to host
+
+        def unpack(h):
+            events.append("unpack")
+            return paddle.to_tensor(h)
+
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor
+                return dy * 2.0 * x
+
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        x.stop_gradient = False
+        with saved_tensors_hooks(pack, unpack):
+            y = Square.apply(x)
+        y.backward()
+        assert events == ["pack", "unpack"]
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_no_hooks_passthrough(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor
+                return dy * 3.0 * x * x
+
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        Cube.apply(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
