@@ -72,6 +72,13 @@ from .framework.io import load, save  # noqa: F401
 from . import io  # noqa: F401
 from . import metric  # noqa: F401
 from . import hapi  # noqa: F401
+from . import vision  # noqa: F401
+from . import distribution  # noqa: F401
+from . import fft  # noqa: F401
+from . import sparse  # noqa: F401
+from . import device  # noqa: F401
+from . import profiler  # noqa: F401
+from . import utils  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 
 

@@ -32,27 +32,61 @@ def _is_tensor(x):
     return isinstance(x, Tensor)
 
 
-def _discover_params(function) -> List:
-    """Trainable parameters reachable from ``function``: the Layer itself,
-    a bound method's Layer, or Layers in a lambda/closure."""
+def _collect_layers(obj, layers, depth=2):
     from ....nn.layer.layers import Layer
 
+    if isinstance(obj, Layer):
+        layers.append(obj)
+        return
+    if depth <= 0:
+        return
+    if isinstance(obj, (list, tuple, set)):
+        for v in obj:
+            _collect_layers(v, layers, depth - 1)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _collect_layers(v, layers, depth - 1)
+
+
+def _discover_params(function) -> List:
+    """Trainable parameters reachable from ``function``: the Layer
+    itself, a bound method's Layer, Layers in closure cells (including
+    one container level deep), or a functools.partial over those."""
+    import functools
+    import warnings
+
     layers: List[Any] = []
-    if isinstance(function, Layer):
-        layers.append(function)
-    self_obj = getattr(function, "__self__", None)
-    if isinstance(self_obj, Layer):
-        layers.append(self_obj)
-    for cell in getattr(function, "__closure__", None) or ():
-        obj = cell.cell_contents
-        if isinstance(obj, Layer):
-            layers.append(obj)
+    _collect_layers(function, layers)
+    seen_fns = set()
+    stack = [function]
+    while stack:
+        fn = stack.pop()
+        if id(fn) in seen_fns:
+            continue
+        seen_fns.add(id(fn))
+        _collect_layers(getattr(fn, "__self__", None), layers)
+        for cell in getattr(fn, "__closure__", None) or ():
+            _collect_layers(cell.cell_contents, layers)
+        if isinstance(fn, functools.partial):
+            stack.append(fn.func)
+            _collect_layers(list(fn.args), layers)
+            _collect_layers(fn.keywords, layers)
+        if (wrapped := getattr(fn, "__wrapped__", None)) is not None:
+            stack.append(wrapped)
     params, seen = [], set()
     for l in layers:
         for p in l.parameters():
             if id(p) not in seen and not p.stop_gradient:
                 seen.add(id(p))
                 params.append(p)
+    if not layers:
+        warnings.warn(
+            "recompute: no Layer was discovered from the given function; "
+            "gradients will only flow to its tensor arguments. Pass the "
+            "Layer itself (recompute(layer, *args)) if the segment has "
+            "weights.",
+            stacklevel=3,
+        )
     return params
 
 

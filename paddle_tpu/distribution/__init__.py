@@ -1,0 +1,33 @@
+"""paddle_tpu.distribution — probability distributions.
+
+ref: python/paddle/distribution/ — distribution.py (Distribution base),
+normal.py, uniform.py, bernoulli.py, categorical.py, beta.py,
+dirichlet.py, exponential.py, gamma.py, geometric.py, gumbel.py,
+laplace.py, lognormal.py, multinomial.py, kl.py (kl_divergence +
+register_kl).
+
+TPU-native: sampling draws keys from the framework generator and lowers
+to jax.random (every sampler is jit-traceable); log_prob/entropy are
+pure jnp through the tape, so they differentiate like any other op.
+"""
+from .distribution import Distribution  # noqa: F401
+from .normal import LogNormal, Normal  # noqa: F401
+from .uniform import Uniform  # noqa: F401
+from .bernoulli import Bernoulli  # noqa: F401
+from .categorical import Categorical  # noqa: F401
+from .multinomial import Multinomial  # noqa: F401
+from .beta import Beta  # noqa: F401
+from .dirichlet import Dirichlet  # noqa: F401
+from .gamma import Gamma  # noqa: F401
+from .exponential import Exponential  # noqa: F401
+from .geometric import Geometric  # noqa: F401
+from .gumbel import Gumbel  # noqa: F401
+from .laplace import Laplace  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+
+__all__ = [
+    "Distribution", "Normal", "LogNormal", "Uniform", "Bernoulli",
+    "Categorical", "Multinomial", "Beta", "Dirichlet", "Gamma",
+    "Exponential", "Geometric", "Gumbel", "Laplace",
+    "kl_divergence", "register_kl",
+]

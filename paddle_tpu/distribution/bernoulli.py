@@ -1,0 +1,71 @@
+"""Bernoulli (ref: python/paddle/distribution/bernoulli.py:35)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base.tape import apply
+from .distribution import Distribution, _as_array
+
+__all__ = ["Bernoulli"]
+
+_EPS = 1e-7
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        def clip(p):
+            return jnp.clip(p, _EPS, 1 - _EPS)
+
+        self.probs_arr = apply(clip, _as_array(probs), op_name="clip")
+        super().__init__(batch_shape=tuple(self.probs_arr.shape))
+
+    @property
+    def mean(self):
+        def f(p):
+            return p
+
+        return apply(f, self.probs_arr, op_name="bernoulli_mean")
+
+    @property
+    def variance(self):
+        def f(p):
+            return p * (1 - p)
+
+        return apply(f, self.probs_arr, op_name="bernoulli_var")
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            return jax.random.bernoulli(key, p, out_shape).astype(jnp.float32)
+
+        out = apply(f, self.probs_arr, op_name="bernoulli_sample")
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=(), temperature: float = 1.0):
+        """Gumbel-softmax relaxation (ref: bernoulli.py rsample)."""
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            u = jax.random.uniform(key, out_shape, jnp.float32, _EPS, 1 - _EPS)
+            logits = jnp.log(p) - jnp.log1p(-p)
+            g = jnp.log(u) - jnp.log1p(-u)
+            return jax.nn.sigmoid((logits + g) / temperature)
+
+        return apply(f, self.probs_arr, op_name="bernoulli_rsample")
+
+    def log_prob(self, value):
+        def f(v, p):
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return apply(f, value, self.probs_arr, op_name="bernoulli_log_prob")
+
+    def entropy(self):
+        def f(p):
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+        return apply(f, self.probs_arr, op_name="bernoulli_entropy")

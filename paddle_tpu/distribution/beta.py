@@ -1,0 +1,66 @@
+"""Beta (ref: python/paddle/distribution/beta.py:25)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln, digamma
+
+from ..base.tape import apply
+from .distribution import Distribution, _as_array
+
+__all__ = ["Beta"]
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha_arr = _as_array(alpha)
+        self.beta_arr = _as_array(beta)
+        shape = jnp.broadcast_shapes(tuple(self.alpha_arr.shape), tuple(self.beta_arr.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        def f(a, b):
+            return a / (a + b)
+
+        return apply(f, self.alpha_arr, self.beta_arr, op_name="beta_mean")
+
+    @property
+    def variance(self):
+        def f(a, b):
+            s = a + b
+            return a * b / (s * s * (s + 1))
+
+        return apply(f, self.alpha_arr, self.beta_arr, op_name="beta_var")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        k1, k2 = jax.random.split(key)
+        out_shape = self._extend_shape(shape)
+
+        def f(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, out_shape))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, out_shape))
+            return ga / (ga + gb)
+
+        return apply(f, self.alpha_arr, self.beta_arr, op_name="beta_rsample")
+
+    sample = Distribution.sample
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - betaln(a, b)
+
+        return apply(f, value, self.alpha_arr, self.beta_arr, op_name="beta_log_prob")
+
+    def entropy(self):
+        def f(a, b):
+            s = a + b
+            return (
+                betaln(a, b)
+                - (a - 1) * digamma(a)
+                - (b - 1) * digamma(b)
+                + (s - 2) * digamma(s)
+            )
+
+        return apply(f, self.alpha_arr, self.beta_arr, op_name="beta_entropy")

@@ -1,0 +1,61 @@
+"""Categorical (ref: python/paddle/distribution/categorical.py:35 —
+logits-as-unnormalized-probs semantics preserved)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base.tape import apply
+from .distribution import Distribution, _as_array
+
+__all__ = ["Categorical"]
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        # paddle semantics: `logits` are unnormalized PROBABILITIES
+        self.logits_arr = _as_array(logits)
+        super().__init__(batch_shape=self.logits_arr.shape[:-1])
+        self._n = self.logits_arr.shape[-1]
+
+    def _probs(self, arr):
+        return arr / jnp.sum(arr, axis=-1, keepdims=True)
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        out_shape = tuple(shape) + self._batch_shape
+
+        def f(logits):
+            logp = jnp.log(self._probs(logits))
+            return jax.random.categorical(key, logp, shape=out_shape)
+
+        out = apply(f, self.logits_arr, op_name="categorical_sample")
+        out.stop_gradient = True
+        return out
+
+    def probs(self, value):
+        def f(logits, v):
+            p = self._probs(logits)
+            return jnp.take_along_axis(p, v.astype(jnp.int32)[..., None], -1)[..., 0]
+
+        return apply(f, self.logits_arr, value, op_name="categorical_probs")
+
+    def log_prob(self, value):
+        def f(logits, v):
+            p = self._probs(logits)
+            sel = jnp.take_along_axis(p, v.astype(jnp.int32)[..., None], -1)[..., 0]
+            return jnp.log(sel)
+
+        return apply(f, self.logits_arr, value, op_name="categorical_log_prob")
+
+    def entropy(self):
+        def f(logits):
+            p = self._probs(logits)
+            return -jnp.sum(p * jnp.log(p), axis=-1)
+
+        return apply(f, self.logits_arr, op_name="categorical_entropy")
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)

@@ -1,0 +1,69 @@
+"""Dirichlet (ref: python/paddle/distribution/dirichlet.py:25)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammaln
+
+from ..base.tape import apply
+from .distribution import Distribution, _as_array
+
+__all__ = ["Dirichlet"]
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.conc_arr = _as_array(concentration)
+        super().__init__(
+            batch_shape=self.conc_arr.shape[:-1],
+            event_shape=self.conc_arr.shape[-1:],
+        )
+
+    @property
+    def mean(self):
+        def f(a):
+            return a / jnp.sum(a, -1, keepdims=True)
+
+        return apply(f, self.conc_arr, op_name="dirichlet_mean")
+
+    @property
+    def variance(self):
+        def f(a):
+            a0 = jnp.sum(a, -1, keepdims=True)
+            m = a / a0
+            return m * (1 - m) / (a0 + 1)
+
+        return apply(f, self.conc_arr, op_name="dirichlet_var")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(a):
+            g = jax.random.gamma(key, jnp.broadcast_to(a, out_shape))
+            return g / jnp.sum(g, -1, keepdims=True)
+
+        return apply(f, self.conc_arr, op_name="dirichlet_rsample")
+
+    def log_prob(self, value):
+        def f(v, a):
+            return (
+                jnp.sum((a - 1) * jnp.log(v), -1)
+                + gammaln(jnp.sum(a, -1))
+                - jnp.sum(gammaln(a), -1)
+            )
+
+        return apply(f, value, self.conc_arr, op_name="dirichlet_log_prob")
+
+    def entropy(self):
+        def f(a):
+            a0 = jnp.sum(a, -1)
+            k = a.shape[-1]
+            return (
+                jnp.sum(gammaln(a), -1)
+                - gammaln(a0)
+                + (a0 - k) * digamma(a0)
+                - jnp.sum((a - 1) * digamma(a), -1)
+            )
+
+        return apply(f, self.conc_arr, op_name="dirichlet_entropy")

@@ -1,0 +1,90 @@
+"""Distribution base (ref: python/paddle/distribution/distribution.py:57).
+
+Shared plumbing: arg broadcasting to Tensors, key drawing, and the
+sample/rsample/log_prob/probs/entropy/kl contract.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import random as _random
+from ..base.tape import apply
+from ..base.tensor import Tensor
+
+__all__ = ["Distribution"]
+
+
+def _as_array(x, dtype=jnp.float32):
+    """Parameter → Tensor, preserving the caller's Tensor identity so
+    gradients from log_prob/rsample flow back to it (the reference keeps
+    the original Variable for the same reason)."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, dtype), stop_gradient=True, _internal=True)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    # -- sampling ------------------------------------------------------
+    def _next_key(self):
+        return _random.next_key()
+
+    def sample(self, shape: Sequence[int] = ()):
+        """Non-reparameterized draw (gradients blocked)."""
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape: Sequence[int] = ()):
+        raise NotImplementedError
+
+    # -- densities -----------------------------------------------------
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        def f(lp):
+            return jnp.exp(lp)
+
+        return apply(f, self.log_prob(value), op_name="exp")
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other) -> Tensor:
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    # -- helpers -------------------------------------------------------
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self._batch_shape})"

@@ -1,0 +1,63 @@
+"""Exponential / Geometric / Gumbel / Laplace (ref: python/paddle/
+distribution/{exponential,geometric,gumbel,laplace}.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from .distribution import Distribution, _as_array
+
+__all__ = ["Exponential"]
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate_arr = _as_array(rate)
+        super().__init__(batch_shape=self.rate_arr.shape)
+
+    @property
+    def rate(self):
+        return self.rate_arr
+
+    @property
+    def mean(self):
+        def f(r):
+            return 1.0 / r
+
+        return apply(f, self.rate_arr, op_name="exponential_mean")
+
+    @property
+    def variance(self):
+        def f(r):
+            return 1.0 / (r * r)
+
+        return apply(f, self.rate_arr, op_name="exponential_var")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(r):
+            return jax.random.exponential(key, out_shape, jnp.float32) / r
+
+        return apply(f, self.rate_arr, op_name="exponential_rsample")
+
+    def log_prob(self, value):
+        def f(v, r):
+            return jnp.log(r) - r * v
+
+        return apply(f, value, self.rate_arr, op_name="exponential_log_prob")
+
+    def entropy(self):
+        def f(r):
+            return 1.0 - jnp.log(r)
+
+        return apply(f, self.rate_arr, op_name="exponential_entropy")
+
+    def cdf(self, value):
+        def f(v, r):
+            return 1 - jnp.exp(-r * v)
+
+        return apply(f, value, self.rate_arr, op_name="exponential_cdf")

@@ -1,0 +1,63 @@
+"""Gamma (ref: python/paddle/distribution/gamma.py:25)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammaln
+
+from ..base.tape import apply
+from .distribution import Distribution, _as_array
+
+__all__ = ["Gamma"]
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.conc_arr = _as_array(concentration)
+        self.rate_arr = _as_array(rate)
+        shape = jnp.broadcast_shapes(tuple(self.conc_arr.shape), tuple(self.rate_arr.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def concentration(self):
+        return self.conc_arr
+
+    @property
+    def rate(self):
+        return self.rate_arr
+
+    @property
+    def mean(self):
+        def f(a, b):
+            return a / b
+
+        return apply(f, self.conc_arr, self.rate_arr, op_name="gamma_mean")
+
+    @property
+    def variance(self):
+        def f(a, b):
+            return a / (b * b)
+
+        return apply(f, self.conc_arr, self.rate_arr, op_name="gamma_var")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(a, b):
+            g = jax.random.gamma(key, jnp.broadcast_to(a, out_shape))
+            return g / b
+
+        return apply(f, self.conc_arr, self.rate_arr, op_name="gamma_rsample")
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            return a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - gammaln(a)
+
+        return apply(f, value, self.conc_arr, self.rate_arr, op_name="gamma_log_prob")
+
+    def entropy(self):
+        def f(a, b):
+            return a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a)
+
+        return apply(f, self.conc_arr, self.rate_arr, op_name="gamma_entropy")

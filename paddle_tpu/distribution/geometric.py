@@ -1,0 +1,79 @@
+"""Geometric (ref: python/paddle/distribution/geometric.py:30 — counts
+failures before first success, support {0, 1, 2, ...})."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base.tape import apply
+from .distribution import Distribution, _as_array
+
+__all__ = ["Geometric"]
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_arr = _as_array(probs)
+        super().__init__(batch_shape=self.probs_arr.shape)
+
+    @property
+    def mean(self):
+        def f(p):
+            return (1 - p) / p
+
+        return apply(f, self.probs_arr, op_name="geometric_mean")
+
+    @property
+    def variance(self):
+        def f(p):
+            return (1 - p) / (p * p)
+
+        return apply(f, self.probs_arr, op_name="geometric_var")
+
+    @property
+    def stddev(self):
+        def f(p):
+            return jnp.sqrt((1 - p) / (p * p))
+
+        return apply(f, self.probs_arr, op_name="geometric_std")
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            u = jax.random.uniform(key, out_shape, jnp.float32, 1e-7, 1.0)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+
+        out = apply(f, self.probs_arr, op_name="geometric_sample")
+        out.stop_gradient = True
+        return out
+
+    rsample = sample
+
+    def pmf(self, k):
+        def f(k_, p):
+            return p * (1 - p) ** k_
+
+        return apply(f, k, self.probs_arr, op_name="geometric_pmf")
+
+    def log_pmf(self, k):
+        def f(k_, p):
+            return jnp.log(p) + k_ * jnp.log1p(-p)
+
+        return apply(f, k, self.probs_arr, op_name="geometric_log_pmf")
+
+    log_prob = log_pmf
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+
+        return apply(f, self.probs_arr, op_name="geometric_entropy")
+
+    def cdf(self, k):
+        def f(k_, p):
+            return 1 - (1 - p) ** (k_ + 1)
+
+        return apply(f, k, self.probs_arr, op_name="geometric_cdf")

@@ -1,0 +1,71 @@
+"""Gumbel (ref: python/paddle/distribution/gumbel.py:30)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from .distribution import Distribution, _as_array
+
+__all__ = ["Gumbel"]
+
+_EULER = float(np.euler_gamma)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc_arr = _as_array(loc)
+        self.scale_arr = _as_array(scale)
+        shape = jnp.broadcast_shapes(tuple(self.loc_arr.shape), tuple(self.scale_arr.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        def f(loc, scale):
+            return loc + scale * _EULER
+
+        return apply(f, self.loc_arr, self.scale_arr, op_name="gumbel_mean")
+
+    @property
+    def variance(self):
+        def f(scale):
+            return (np.pi**2 / 6.0) * scale * scale
+
+        return apply(f, self.scale_arr, op_name="gumbel_var")
+
+    @property
+    def stddev(self):
+        def f(scale):
+            return (np.pi / np.sqrt(6.0)) * scale
+
+        return apply(f, self.scale_arr, op_name="gumbel_std")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(loc, scale):
+            g = jax.random.gumbel(key, out_shape, jnp.float32)
+            return loc + scale * g
+
+        return apply(f, self.loc_arr, self.scale_arr, op_name="gumbel_rsample")
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            z = (v - loc) / scale
+            return -(z + jnp.exp(-z)) - jnp.log(scale)
+
+        return apply(f, value, self.loc_arr, self.scale_arr, op_name="gumbel_log_prob")
+
+    def entropy(self):
+        def f(scale):
+            return jnp.log(scale) + 1 + _EULER
+
+        return apply(f, self.scale_arr, op_name="gumbel_entropy")
+
+    def cdf(self, value):
+        def f(v, loc, scale):
+            return jnp.exp(-jnp.exp(-(v - loc) / scale))
+
+        return apply(f, value, self.loc_arr, self.scale_arr, op_name="gumbel_cdf")

@@ -1,0 +1,179 @@
+"""kl_divergence + register_kl dispatch (ref: python/paddle/
+distribution/kl.py:33 — same double-dispatch registry resolving the
+most specific (type(p), type(q)) pair)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple, Type
+
+import jax.numpy as jnp
+
+from ..base.tape import apply
+from .bernoulli import Bernoulli
+from .beta import Beta
+from .categorical import Categorical
+from .dirichlet import Dirichlet
+from .distribution import Distribution
+from .exponential import Exponential
+from .gamma import Gamma
+from .geometric import Geometric
+from .laplace import Laplace
+from .normal import LogNormal, Normal
+from .uniform import Uniform
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_REGISTRY: Dict[Tuple[Type, Type], Callable] = {}
+
+
+def register_kl(p_cls: Type, q_cls: Type):
+    """Decorator registering a KL implementation (ref: kl.py register_kl)."""
+
+    def wrap(fn):
+        _REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return wrap
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    best, match = None, None
+    for (pc, qc), fn in _REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            # most specific match wins (mro distance)
+            score = type(p).__mro__.index(pc) + type(q).__mro__.index(qc)
+            if best is None or score < best:
+                best, match = score, fn
+    if match is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})"
+        )
+    return match(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def f(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+    return apply(f, p.loc_arr, p.scale_arr, q.loc_arr, q.scale_arr, op_name="kl_normal")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def f(pl, ph, ql, qh):
+        res = jnp.log((qh - ql) / (ph - pl))
+        return jnp.where((ql <= pl) & (ph <= qh), res, jnp.inf)
+
+    return apply(f, p.low_arr, p.high_arr, q.low_arr, q.high_arr, op_name="kl_uniform")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    def f(pp, qp):
+        return pp * (jnp.log(pp) - jnp.log(qp)) + (1 - pp) * (
+            jnp.log1p(-pp) - jnp.log1p(-qp)
+        )
+
+    return apply(f, p.probs_arr, q.probs_arr, op_name="kl_bernoulli")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    def f(pa, qa):
+        pn = pa / jnp.sum(pa, -1, keepdims=True)
+        qn = qa / jnp.sum(qa, -1, keepdims=True)
+        return jnp.sum(pn * (jnp.log(pn) - jnp.log(qn)), -1)
+
+    return apply(f, p.logits_arr, q.logits_arr, op_name="kl_categorical")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    from jax.scipy.special import digamma, gammaln
+
+    def f(pa, qa):
+        p0 = jnp.sum(pa, -1)
+        return (
+            gammaln(p0)
+            - jnp.sum(gammaln(pa), -1)
+            - gammaln(jnp.sum(qa, -1))
+            + jnp.sum(gammaln(qa), -1)
+            + jnp.sum((pa - qa) * (digamma(pa) - digamma(p0)[..., None]), -1)
+        )
+
+    return apply(f, p.conc_arr, q.conc_arr, op_name="kl_dirichlet")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+
+    def f(pa, pb, qa, qb):
+        s = pa + pb
+        return (
+            betaln(qa, qb)
+            - betaln(pa, pb)
+            + (pa - qa) * digamma(pa)
+            + (pb - qb) * digamma(pb)
+            + (qa - pa + qb - pb) * digamma(s)
+        )
+
+    return apply(f, p.alpha_arr, p.beta_arr, q.alpha_arr, q.beta_arr, op_name="kl_beta")
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    from jax.scipy.special import digamma, gammaln
+
+    def f(pa, pb, qa, qb):
+        return (
+            (pa - qa) * digamma(pa)
+            - gammaln(pa)
+            + gammaln(qa)
+            + qa * (jnp.log(pb) - jnp.log(qb))
+            + pa * (qb / pb - 1)
+        )
+
+    return apply(f, p.conc_arr, p.rate_arr, q.conc_arr, q.rate_arr, op_name="kl_gamma")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    def f(pr, qr):
+        ratio = qr / pr
+        return jnp.log(pr) - jnp.log(qr) + ratio - 1
+
+    return apply(f, p.rate_arr, q.rate_arr, op_name="kl_exponential")
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    def f(pp, qp):
+        return (
+            jnp.log(pp)
+            - jnp.log(qp)
+            + (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp))
+        )
+
+    return apply(f, p.probs_arr, q.probs_arr, op_name="kl_geometric")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    def f(pl, ps, ql, qs):
+        d = jnp.abs(pl - ql)
+        return (
+            jnp.log(qs)
+            - jnp.log(ps)
+            + (ps * jnp.exp(-d / ps) + d) / qs
+            - 1
+        )
+
+    return apply(f, p.loc_arr, p.scale_arr, q.loc_arr, q.scale_arr, op_name="kl_laplace")
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p._base, q._base)

@@ -1,0 +1,76 @@
+"""Laplace (ref: python/paddle/distribution/laplace.py:27)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from .distribution import Distribution, _as_array
+
+__all__ = ["Laplace"]
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc_arr = _as_array(loc)
+        self.scale_arr = _as_array(scale)
+        shape = jnp.broadcast_shapes(tuple(self.loc_arr.shape), tuple(self.scale_arr.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        def f(loc):
+            return jnp.broadcast_to(loc, self._batch_shape)
+
+        return apply(f, self.loc_arr, op_name="laplace_mean")
+
+    @property
+    def variance(self):
+        def f(scale):
+            return jnp.broadcast_to(2 * scale * scale, self._batch_shape)
+
+        return apply(f, self.scale_arr, op_name="laplace_var")
+
+    @property
+    def stddev(self):
+        def f(scale):
+            return jnp.broadcast_to(np.sqrt(2.0) * scale, self._batch_shape)
+
+        return apply(f, self.scale_arr, op_name="laplace_std")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(loc, scale):
+            u = jax.random.uniform(key, out_shape, jnp.float32, -0.5 + 1e-7, 0.5)
+            return loc - scale * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+
+        return apply(f, self.loc_arr, self.scale_arr, op_name="laplace_rsample")
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            return -jnp.abs(v - loc) / scale - jnp.log(2 * scale)
+
+        return apply(f, value, self.loc_arr, self.scale_arr, op_name="laplace_log_prob")
+
+    def entropy(self):
+        def f(scale):
+            return jnp.broadcast_to(1 + jnp.log(2 * scale), self._batch_shape)
+
+        return apply(f, self.scale_arr, op_name="laplace_entropy")
+
+    def cdf(self, value):
+        def f(v, loc, scale):
+            z = (v - loc) / scale
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+
+        return apply(f, value, self.loc_arr, self.scale_arr, op_name="laplace_cdf")
+
+    def icdf(self, value):
+        def f(p, loc, scale):
+            a = p - 0.5
+            return loc - scale * jnp.sign(a) * jnp.log1p(-2 * jnp.abs(a))
+
+        return apply(f, value, self.loc_arr, self.scale_arr, op_name="laplace_icdf")

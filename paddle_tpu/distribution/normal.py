@@ -1,0 +1,147 @@
+"""Normal / LogNormal (ref: python/paddle/distribution/normal.py:36,
+lognormal.py:25)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from ..base.tensor import Tensor
+from .distribution import Distribution, _as_array
+
+__all__ = ["Normal", "LogNormal"]
+
+_LOG_2PI = float(np.log(2.0 * np.pi))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc_arr = _as_array(loc)
+        self.scale_arr = _as_array(scale)
+        shape = jnp.broadcast_shapes(tuple(self.loc_arr.shape), tuple(self.scale_arr.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def loc(self):
+        def f(l):
+            return jnp.broadcast_to(l, self._batch_shape)
+
+        return apply(f, self.loc_arr, op_name="normal_loc")
+
+    mean = loc
+
+    @property
+    def scale(self):
+        def f(s):
+            return jnp.broadcast_to(s, self._batch_shape)
+
+        return apply(f, self.scale_arr, op_name="normal_scale")
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    @property
+    def variance(self):
+        def f(s):
+            return jnp.broadcast_to(s * s, self._batch_shape)
+
+        return apply(f, self.scale_arr, op_name="normal_var")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(loc, scale):
+            eps = jax.random.normal(key, out_shape, jnp.float32)
+            return loc + scale * eps
+
+        return apply(f, self.loc_arr, self.scale_arr, op_name="normal_rsample")
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            var = scale * scale
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) - 0.5 * _LOG_2PI
+
+        return apply(f, value, self.loc_arr, self.scale_arr, op_name="normal_log_prob")
+
+    def entropy(self):
+        def f(scale):
+            return jnp.broadcast_to(
+                0.5 + 0.5 * _LOG_2PI + jnp.log(scale), self._batch_shape
+            )
+
+        return apply(f, self.scale_arr, op_name="normal_entropy")
+
+    def cdf(self, value):
+        def f(v, loc, scale):
+            return 0.5 * (1 + jax.scipy.special.erf((v - loc) / (scale * np.sqrt(2.0))))
+
+        return apply(f, value, self.loc_arr, self.scale_arr, op_name="normal_cdf")
+
+    def icdf(self, value):
+        def f(v, loc, scale):
+            return loc + scale * jnp.sqrt(2.0) * jax.scipy.special.erfinv(2 * v - 1)
+
+        return apply(f, value, self.loc_arr, self.scale_arr, op_name="normal_icdf")
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+
+class LogNormal(Distribution):
+    """exp(Normal(loc, scale)) (ref: lognormal.py — TransformedDistribution
+    with ExpTransform, flattened here)."""
+
+    def __init__(self, loc, scale, name=None):
+        self._base = Normal(loc, scale)
+        super().__init__(batch_shape=self._base._batch_shape)
+
+    @property
+    def mean(self):
+        def f(loc, scale):
+            return jnp.exp(loc + scale * scale / 2)
+
+        return apply(f, self._base.loc_arr, self._base.scale_arr, op_name="lognormal_mean")
+
+    @property
+    def variance(self):
+        def f(loc, scale):
+            s2 = scale * scale
+            return (jnp.exp(s2) - 1) * jnp.exp(2 * loc + s2)
+
+        return apply(f, self._base.loc_arr, self._base.scale_arr, op_name="lognormal_var")
+
+    def rsample(self, shape=()):
+        base = self._base.rsample(shape)
+
+        def f(x):
+            return jnp.exp(x)
+
+        return apply(f, base, op_name="exp")
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            logv = jnp.log(v)
+            var = scale * scale
+            return (
+                -((logv - loc) ** 2) / (2 * var)
+                - jnp.log(scale)
+                - 0.5 * _LOG_2PI
+                - logv
+            )
+
+        return apply(f, value, self._base.loc_arr, self._base.scale_arr,
+                     op_name="lognormal_log_prob")
+
+    def entropy(self):
+        def f(loc, scale):
+            return jnp.broadcast_to(
+                0.5 + 0.5 * _LOG_2PI + jnp.log(scale) + loc, self._batch_shape
+            )
+
+        return apply(f, self._base.loc_arr, self._base.scale_arr,
+                     op_name="lognormal_entropy")

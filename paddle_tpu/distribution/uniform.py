@@ -1,0 +1,56 @@
+"""Uniform (ref: python/paddle/distribution/uniform.py:32)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base.tape import apply
+from .distribution import Distribution, _as_array
+
+__all__ = ["Uniform"]
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low_arr = _as_array(low)
+        self.high_arr = _as_array(high)
+        shape = jnp.broadcast_shapes(tuple(self.low_arr.shape), tuple(self.high_arr.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        def f(lo, hi):
+            return (lo + hi) / 2
+
+        return apply(f, self.low_arr, self.high_arr, op_name="uniform_mean")
+
+    @property
+    def variance(self):
+        def f(lo, hi):
+            return (hi - lo) ** 2 / 12
+
+        return apply(f, self.low_arr, self.high_arr, op_name="uniform_var")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(lo, hi):
+            u = jax.random.uniform(key, out_shape, jnp.float32)
+            return lo + (hi - lo) * u
+
+        return apply(f, self.low_arr, self.high_arr, op_name="uniform_rsample")
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            lp = -jnp.log(hi - lo)
+            return jnp.where(inside, lp, -jnp.inf)
+
+        return apply(f, value, self.low_arr, self.high_arr, op_name="uniform_log_prob")
+
+    def entropy(self):
+        def f(lo, hi):
+            return jnp.log(hi - lo)
+
+        return apply(f, self.low_arr, self.high_arr, op_name="uniform_entropy")

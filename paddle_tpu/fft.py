@@ -1,0 +1,158 @@
+"""paddle_tpu.fft — discrete Fourier transforms.
+
+ref: python/paddle/fft.py — same API surface (fft/ifft/rfft/irfft/
+hfft/ihfft, 2-D and N-D variants, fftfreq/rfftfreq/fftshift/ifftshift)
+with paddle's norm semantics ('backward' | 'ortho' | 'forward').
+All lowered to jnp.fft (XLA implements FFT natively on TPU); grads flow
+through the tape like any other op.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from .base.tape import apply
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm: Optional[str]) -> str:
+    if norm is None:
+        return "backward"
+    if norm not in ("backward", "ortho", "forward"):
+        raise ValueError(f"norm must be backward/ortho/forward, got {norm!r}")
+    return norm
+
+
+def _wrap1(jnp_fn, x, n, axis, norm, op_name):
+    def f(a):
+        return jnp_fn(a, n=n, axis=axis, norm=_norm(norm))
+
+    return apply(f, x, op_name=op_name)
+
+
+def _wrapn(jnp_fn, x, s, axes, norm, op_name):
+    def f(a):
+        return jnp_fn(a, s=s, axes=axes, norm=_norm(norm))
+
+    return apply(f, x, op_name=op_name)
+
+
+def fft(x, n=None, axis=-1, norm=None, name=None):
+    return _wrap1(jnp.fft.fft, x, n, axis, norm, "fft")
+
+
+def ifft(x, n=None, axis=-1, norm=None, name=None):
+    return _wrap1(jnp.fft.ifft, x, n, axis, norm, "ifft")
+
+
+def rfft(x, n=None, axis=-1, norm=None, name=None):
+    return _wrap1(jnp.fft.rfft, x, n, axis, norm, "rfft")
+
+
+def irfft(x, n=None, axis=-1, norm=None, name=None):
+    return _wrap1(jnp.fft.irfft, x, n, axis, norm, "irfft")
+
+
+def hfft(x, n=None, axis=-1, norm=None, name=None):
+    return _wrap1(jnp.fft.hfft, x, n, axis, norm, "hfft")
+
+
+def ihfft(x, n=None, axis=-1, norm=None, name=None):
+    return _wrap1(jnp.fft.ihfft, x, n, axis, norm, "ihfft")
+
+
+def fft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    return _wrapn(jnp.fft.fft2, x, s, axes, norm, "fft2")
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    return _wrapn(jnp.fft.ifft2, x, s, axes, norm, "ifft2")
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    return _wrapn(jnp.fft.rfft2, x, s, axes, norm, "rfft2")
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    return _wrapn(jnp.fft.irfft2, x, s, axes, norm, "irfft2")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    def f(a):
+        return jnp.fft.hfft2(a, s=s, axes=axes, norm=_norm(norm))
+
+    return apply(f, x, op_name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    def f(a):
+        return jnp.fft.ihfft2(a, s=s, axes=axes, norm=_norm(norm))
+
+    return apply(f, x, op_name="ihfft2")
+
+
+def fftn(x, s=None, axes=None, norm=None, name=None):
+    return _wrapn(jnp.fft.fftn, x, s, axes, norm, "fftn")
+
+
+def ifftn(x, s=None, axes=None, norm=None, name=None):
+    return _wrapn(jnp.fft.ifftn, x, s, axes, norm, "ifftn")
+
+
+def rfftn(x, s=None, axes=None, norm=None, name=None):
+    return _wrapn(jnp.fft.rfftn, x, s, axes, norm, "rfftn")
+
+
+def irfftn(x, s=None, axes=None, norm=None, name=None):
+    return _wrapn(jnp.fft.irfftn, x, s, axes, norm, "irfftn")
+
+
+def hfftn(x, s=None, axes=None, norm=None, name=None):
+    def f(a):
+        return jnp.fft.hfftn(a, s=s, axes=axes, norm=_norm(norm))
+
+    return apply(f, x, op_name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm=None, name=None):
+    def f(a):
+        return jnp.fft.ihfftn(a, s=s, axes=axes, norm=_norm(norm))
+
+    return apply(f, x, op_name="ihfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    def f():
+        out = jnp.fft.fftfreq(n, d)
+        return out.astype(dtype) if dtype is not None else out
+
+    return apply(f, op_name="fftfreq")
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    def f():
+        out = jnp.fft.rfftfreq(n, d)
+        return out.astype(dtype) if dtype is not None else out
+
+    return apply(f, op_name="rfftfreq")
+
+
+def fftshift(x, axes=None, name=None):
+    def f(a):
+        return jnp.fft.fftshift(a, axes=axes)
+
+    return apply(f, x, op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    def f(a):
+        return jnp.fft.ifftshift(a, axes=axes)
+
+    return apply(f, x, op_name="ifftshift")

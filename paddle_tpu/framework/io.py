@@ -27,6 +27,14 @@ _PROTOCOL = 4
 _TENSOR_TAG = "__paddle_tpu_tensor__"
 
 
+class _TensorPayload:
+    """Back-compat unpickle shim for files saved by the earlier format
+    that pickled this class directly. Kept so old checkpoints load;
+    new saves use the plain-dict tag."""
+
+    __slots__ = ("array", "stop_gradient", "name")
+
+
 def _tensor_payload(array, stop_gradient, name):
     return {
         _TENSOR_TAG: 1,
@@ -62,6 +70,8 @@ def _to_serializable(obj: Any) -> Any:
 def _from_serializable(obj: Any, return_numpy: bool) -> Any:
     from ..base.tensor import Tensor
 
+    if isinstance(obj, _TensorPayload):  # legacy-format files
+        obj = _tensor_payload(obj.array, obj.stop_gradient, obj.name)
     if isinstance(obj, dict) and obj.get(_TENSOR_TAG) == 1:
         if return_numpy:
             return obj["array"]

@@ -197,10 +197,24 @@ class EarlyStopping(Callback):
         if self.monitor_op(current - self.min_delta, self.best_value):
             self.best_value = current
             self.wait_epoch = 0
+            if self.save_best_model:
+                save_dir = self.params.get("save_dir")
+                if save_dir:  # ref: callbacks.py — persist best_model
+                    self.model.save(f"{save_dir}/best_model")
+                else:  # keep an in-memory snapshot to restore on stop
+                    import numpy as np
+
+                    self.best_weights = {
+                        k: np.asarray(v.numpy())
+                        for k, v in self.model.network.state_dict().items()
+                    }
             return
         self.wait_epoch += 1
         if self.wait_epoch > self.patience:
             self.model.stop_training = True
+            self.stopped_epoch = self.wait_epoch
+            if self.best_weights is not None:
+                self.model.network.set_state_dict(self.best_weights)
             if self.verbose:
                 print(f"EarlyStopping: no improvement in {self.monitor}")
 
@@ -225,6 +239,7 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
             "steps": steps,
             "verbose": verbose,
             "metrics": metrics or ["loss"],
+            "save_dir": save_dir,
         }
     )
     return cbk_list

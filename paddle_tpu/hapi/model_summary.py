@@ -23,7 +23,14 @@ def summary(net, input_size=None, dtypes=None, input=None):
     if input is None:
         if input_size is None:
             raise ValueError("either input_size or input must be given")
-        sizes = [input_size] if isinstance(input_size, tuple) else list(input_size)
+        if isinstance(input_size, tuple) or (
+            isinstance(input_size, list)
+            and input_size
+            and isinstance(input_size[0], int)
+        ):  # a single shape, possibly given as a list
+            sizes = [input_size]
+        else:
+            sizes = list(input_size)
         dts = dtypes if isinstance(dtypes, (list, tuple)) else [dtypes] * len(sizes)
         inputs = [
             to_tensor(np.zeros([d if d and d > 0 else 1 for d in s],

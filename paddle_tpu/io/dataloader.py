@@ -200,19 +200,32 @@ class _StreamPrefetchIter:
         self._q: "queue.Queue" = queue.Queue(maxsize=loader.prefetch_factor)
         self._inner = inner
         self._error = None
+        self._shutdown = False
         if loader.worker_init_fn is not None:
             loader.worker_init_fn(0)
         self._thread = threading.Thread(target=self._reader, daemon=True)
         self._thread.start()
 
+    def _put(self, item) -> bool:
+        import queue
+
+        while not self._shutdown:
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _reader(self):
         try:
             for item in self._inner:
-                self._q.put(item)
+                if not self._put(item):
+                    return  # consumer abandoned the iterator
         except Exception as e:
             self._error = e
         finally:
-            self._q.put(self._DONE)
+            self._put(self._DONE)
 
     def __iter__(self):
         return self
@@ -232,6 +245,20 @@ class _StreamPrefetchIter:
                 raise self._error
             raise StopIteration
         return item
+
+    def close(self):
+        self._shutdown = True
+        # drain so a blocked reader can observe shutdown promptly
+        import queue
+
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __del__(self):
+        self.close()
 
 
 class _IterableIter:

@@ -266,7 +266,8 @@ class StaticFunction:
 
         key = ("__multi_step__", arg_treedef)
         jitted = self._jit_cache.get(key)
-        if jitted is None:
+        traced_now = jitted is None
+        if traced_now:
             pure = self._make_pure(arg_treedef)
 
             def scanned(state, lrs_stacked, flat_stacked):
@@ -287,8 +288,10 @@ class StaticFunction:
         outs, new_state = jitted(state, lrs_stacked, flat_arrays)
         self._write_state(new_state)
         self._sanitize_grads()
+        # host-side step counter: tracing already advanced it by 1
+        # (optimizer.step() ran once at trace time), same as __call__
         for o in self._optimizers:
-            o._global_step += n
+            o._global_step += n - 1 if traced_now else n
         return tree_util.tree_map(
             lambda a: Tensor(a, _internal=True) if isinstance(a, jax.Array) else a, outs
         )

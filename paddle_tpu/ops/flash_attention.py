@@ -54,7 +54,7 @@ def _interpret_default() -> bool:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale: float, causal: bool, bq: int, bk: int):
+                *, scale: float, causal: bool, bq: int, bk: int, off: int):
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -66,7 +66,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     # causal: skip k-blocks strictly above the diagonal — ~2x on long seq
     iq = pl.program_id(2)
-    live = (iq * bq + bq - 1 >= ik * bk) if causal else (ik >= 0)
+    live = (iq * bq + bq - 1 + off >= ik * bk) if causal else (ik >= 0)
 
     @pl.when(live)
     def _body():
@@ -79,7 +79,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         if causal:
             q_abs = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_abs = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s_masked = jnp.where(q_abs >= k_abs, s, NEG_INF)
+            s_masked = jnp.where(q_abs + off >= k_abs, s, NEG_INF)
         else:
             s_masked = s
 
@@ -117,7 +117,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, interpret: bool):
     grid = (batch, hq, sq // bq, sk // bk)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, off=sk - sq
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -156,7 +156,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, interpret: bool):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_scr, *, scale: float, causal: bool, bq: int, bk: int):
+                   acc_scr, *, scale: float, causal: bool, bq: int, bk: int, off: int):
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -165,7 +165,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     iq = pl.program_id(2)
-    live = (iq * bq + bq - 1 >= ik * bk) if causal else (ik >= 0)
+    live = (iq * bq + bq - 1 + off >= ik * bk) if causal else (ik >= 0)
 
     @pl.when(live)
     def _body():
@@ -177,7 +177,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         if causal:
             q_abs = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_abs = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_abs >= k_abs, s, NEG_INF)
+            s = jnp.where(q_abs + off >= k_abs, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0, 0][:, None])          # [bq, bk]
         dp = jax.lax.dot_general(
             do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
@@ -196,7 +196,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale: float, causal: bool, bq: int, bk: int):
+                    *, scale: float, causal: bool, bq: int, bk: int, off: int):
     iq = pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -206,7 +206,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     ik = pl.program_id(2)
-    live = (iq * bq + bq - 1 >= ik * bk) if causal else (iq >= 0)
+    live = (iq * bq + bq - 1 + off >= ik * bk) if causal else (iq >= 0)
 
     @pl.when(live)
     def _body():
@@ -218,7 +218,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             q_abs = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_abs = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_abs >= k_abs, s, NEG_INF)
+            s = jnp.where(q_abs + off >= k_abs, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0, 0][:, None])           # [bq, bk]
         do = do_ref[0, 0]
         dv_scr[:] += jax.lax.dot_general(
@@ -251,7 +251,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale: float, causal: bool, interpret: boo
     delta3 = delta[:, :, None, :]  # [B, H, 1, Sq]
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, off=sk - sq),
         grid=(batch, h, sq // bq, sk // bk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b, hh, iq, ik: (b, hh, iq, 0)),
@@ -273,7 +273,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale: float, causal: bool, interpret: boo
     )(q, k, v, do, lse3, delta3)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, off=sk - sq),
         grid=(batch, h, sk // bk, sq // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b, hh, ik, iq: (b, hh, iq, 0)),

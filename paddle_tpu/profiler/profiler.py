@@ -1,0 +1,216 @@
+"""Profiler core (ref: python/paddle/profiler/profiler.py:346)."""
+from __future__ import annotations
+
+import enum
+import os
+import time
+from typing import Callable, Iterable, Optional, Union
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+    "make_scheduler", "export_chrome_tracing",
+]
+
+
+class ProfilerState(enum.Enum):
+    """ref: profiler.py ProfilerState."""
+
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    """ref: profiler.py ProfilerTarget — GPU/XPU become the TPU target."""
+
+    CPU = 0
+    GPU = 1
+    TPU = 1  # alias: the device tracer is one XLA trace
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """ref: profiler.py make_scheduler — same state machine."""
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        period = closed + ready + record
+        if repeat > 0 and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """ref: profiler.py export_chrome_tracing — returns an
+    on_trace_ready callback; the jax trace directory is TensorBoard's
+    profile format (open via tensorboard --logdir or Perfetto)."""
+
+    def handler(prof: "Profiler"):
+        prof._exported_dir = dir_name
+
+    handler._dir = dir_name
+    return handler
+
+
+class RecordEvent:
+    """User span annotation (ref: profiler/utils.py RecordEvent) —
+    shows up in the XLA device trace via TraceAnnotation."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ctx = None
+        self.begin_ns = None
+        self.end_ns = None
+
+    def begin(self):
+        import jax.profiler
+
+        self.begin_ns = time.perf_counter_ns()
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+            self.end_ns = time.perf_counter_ns()
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    """ref: profiler.py:346 Profiler — start/stop/step/export surface.
+
+    The XLA trace captures device + host activity between start and
+    stop; scheduler transitions drive jax.profiler.start_trace /
+    stop_trace so only RECORD windows hit the (expensive) tracer.
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready=None,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 timer_only: bool = False, emit_nvtx: bool = False,
+                 custom_device_types=None, with_flops: bool = False):
+        if scheduler is None:
+            self._scheduler = _default_state_scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(start, 0), ready=0, record=end - start, repeat=1
+            )
+        else:
+            self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._dir = getattr(on_trace_ready, "_dir", None) or "./profiler_log"
+        self.step_num = 0
+        self._state = ProfilerState.CLOSED
+        self._tracing = False
+        self._exported_dir = None
+        self._step_times = []
+        self._last_step_t = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self):
+        self._state = self._scheduler(self.step_num)
+        self._transition()
+        self._last_step_t = time.perf_counter()
+
+    def stop(self):
+        if self._tracing:
+            self._stop_trace()
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+        self._state = ProfilerState.CLOSED
+
+    def step(self, num_steps: int = 1):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append((now - self._last_step_t) / num_steps)
+        self._last_step_t = now
+        self.step_num += num_steps
+        new_state = self._scheduler(self.step_num)
+        if new_state != self._state:
+            self._state = new_state
+            self._transition()
+
+    def _transition(self):
+        should_trace = self._state in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN
+        ) and not self._timer_only
+        if should_trace and not self._tracing:
+            self._start_trace()
+        elif not should_trace and self._tracing:
+            self._stop_trace()
+
+    def _start_trace(self):
+        import jax.profiler
+
+        os.makedirs(self._dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(self._dir)
+            self._tracing = True
+        except RuntimeError:
+            # tracer already active (nested profilers) — skip
+            self._tracing = False
+
+    def _stop_trace(self):
+        import jax.profiler
+
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._tracing = False
+            self._exported_dir = self._dir
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- reporting -----------------------------------------------------
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Host-side step-time summary; the op-level breakdown lives in
+        the exported XLA trace (TensorBoard), which supersedes the
+        reference's table printer."""
+        if not self._step_times:
+            print("Profiler: no steps recorded")
+            return
+        import numpy as np
+
+        ts = np.asarray(self._step_times) * 1000.0
+        print(
+            f"Profiler summary over {len(ts)} steps: "
+            f"mean {ts.mean():.3f} ms, p50 {np.percentile(ts, 50):.3f} ms, "
+            f"p99 {np.percentile(ts, 99):.3f} ms"
+            + (f"; trace exported to {self._exported_dir}" if self._exported_dir else "")
+        )
+
+    def export(self, path: Optional[str] = None, format: str = "json"):
+        return self._exported_dir

@@ -1,0 +1,76 @@
+"""Benchmark timer (ref: python/paddle/profiler/timer.py:394 —
+paddle.profiler.benchmark() singleton with step()/ips semantics, used
+by hapi and launch to report throughput)."""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+__all__ = ["benchmark", "Benchmark"]
+
+
+class _Event:
+    def __init__(self):
+        self.reader_cost_avg = 0.0
+        self.batch_cost_avg = 0.0
+        self.ips_avg = 0.0
+        self.steps = 0
+
+
+class Benchmark:
+    """Throughput tracker: call before_reader/after_reader around data
+    loading and step(batch_size) per iteration."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._event = _Event()
+        self._reader_t0 = None
+        self._step_t0 = None
+        self._reader_cost = 0.0
+        self._warmup = 2
+
+    def before_reader(self):
+        self._reader_t0 = time.perf_counter()
+
+    def after_reader(self):
+        if self._reader_t0 is not None:
+            self._reader_cost = time.perf_counter() - self._reader_t0
+
+    def step(self, batch_size: Optional[int] = None):
+        now = time.perf_counter()
+        e = self._event
+        if self._step_t0 is not None:
+            cost = now - self._step_t0
+            e.steps += 1
+            if e.steps > self._warmup:
+                n = e.steps - self._warmup
+                e.batch_cost_avg += (cost - e.batch_cost_avg) / n
+                e.reader_cost_avg += (self._reader_cost - e.reader_cost_avg) / n
+                if batch_size and e.batch_cost_avg > 0:
+                    e.ips_avg = batch_size / e.batch_cost_avg
+        self._step_t0 = now
+
+    def step_info(self, unit: str = "samples") -> str:
+        e = self._event
+        return (
+            f"reader_cost: {e.reader_cost_avg:.5f} s, "
+            f"batch_cost: {e.batch_cost_avg:.5f} s, "
+            f"ips: {e.ips_avg:.2f} {unit}/s"
+        )
+
+    @property
+    def ips(self) -> float:
+        return self._event.ips_avg
+
+
+_instance: Optional[Benchmark] = None
+
+
+def benchmark() -> Benchmark:
+    """ref: timer.py benchmark() — process singleton."""
+    global _instance
+    if _instance is None:
+        _instance = Benchmark()
+    return _instance

@@ -1,0 +1,236 @@
+"""paddle_tpu.sparse — sparse tensors (COO / CSR).
+
+ref: python/paddle/sparse/ — creation.py (sparse_coo_tensor :54,
+sparse_csr_tensor :233), unary ops, matmul, nn.sparse layers (subset).
+
+TPU-native design note: the TPU has no scatter-gather sparse units; XLA
+lowers sparse work to dense-ish gathers. JAX's BCOO (jax.experimental.
+sparse) is the native format — SparseCooTensor wraps it, so every op
+here is jit-compatible and differentiates. CSR is stored as the
+(crows, cols, values) triple for format parity and converted to COO for
+compute, mirroring how the reference's TPU-less kernels would behave.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..base.tensor import Tensor
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape", "matmul", "add", "multiply",
+    "relu", "abs", "sin", "tanh", "coalesce",
+]
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor over jax.experimental.sparse.BCOO."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle Tensor-like surface ------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T, _internal=True)
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data, _internal=True)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense(), _internal=True)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self._bcoo.shape) != 2:
+            raise ValueError("CSR requires a 2-D tensor")
+        dense = self._bcoo.todense()
+        return _dense_to_csr(dense)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz})"
+
+
+class SparseCsrTensor:
+    """CSR triple (crows, cols, values); converts to BCOO for compute."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows_arr = jnp.asarray(_unwrap(crows), jnp.int32)
+        self.cols_arr = jnp.asarray(_unwrap(cols), jnp.int32)
+        self.values_arr = _unwrap(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self.values_arr.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self.values_arr.shape[0])
+
+    def crows(self) -> Tensor:
+        return Tensor(self.crows_arr, _internal=True)
+
+    def cols(self) -> Tensor:
+        return Tensor(self.cols_arr, _internal=True)
+
+    def values(self) -> Tensor:
+        return Tensor(self.values_arr, _internal=True)
+
+    def _to_bcoo(self) -> jsparse.BCOO:
+        counts = jnp.diff(self.crows_arr)
+        rows = jnp.repeat(jnp.arange(self._shape[0]), counts,
+                          total_repeat_length=self.nnz)
+        idx = jnp.stack([rows, self.cols_arr], axis=1)
+        return jsparse.BCOO((self.values_arr, idx), shape=self._shape)
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None) -> SparseCooTensor:
+        return SparseCooTensor(self._to_bcoo())
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._to_bcoo().todense(), _internal=True)
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz})"
+
+
+def _dense_to_csr(dense) -> SparseCsrTensor:
+    d = np.asarray(jax.device_get(dense))
+    rows, cols = np.nonzero(d)
+    values = d[rows, cols]
+    crows = np.zeros(d.shape[0] + 1, np.int32)
+    np.add.at(crows[1:], rows, 1)
+    crows = np.cumsum(crows).astype(np.int32)
+    return SparseCsrTensor(crows, cols.astype(np.int32), values, d.shape)
+
+
+# ---------------------------------------------------------------------------
+# creation (ref: sparse/creation.py)
+# ---------------------------------------------------------------------------
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """ref: creation.py:54 — indices [ndim, nnz], values [nnz]."""
+    idx = jnp.asarray(_unwrap(indices), jnp.int32)
+    vals = _unwrap(values)
+    if dtype is not None:
+        from ..base.dtype import canonical_dtype
+
+        vals = vals.astype(canonical_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(jax.device_get(idx)).max(1))
+    return SparseCooTensor(jsparse.BCOO((vals, idx.T), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """ref: creation.py:233."""
+    vals = _unwrap(values)
+    if dtype is not None:
+        from ..base.dtype import canonical_dtype
+
+        vals = vals.astype(canonical_dtype(dtype))
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+# ---------------------------------------------------------------------------
+# ops (ref: sparse/binary.py, unary.py, matmul.py)
+# ---------------------------------------------------------------------------
+
+
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x._to_bcoo(), "csr"
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo, "coo"
+    raise TypeError(f"expected a sparse tensor, got {type(x)}")
+
+
+def _rewrap(bcoo, kind):
+    coo = SparseCooTensor(bcoo)
+    return coo.to_sparse_csr() if kind == "csr" else coo
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense → dense (ref: sparse/matmul.py)."""
+    b, _ = _coo(x)
+    yd = _unwrap(y)
+    return Tensor(b @ yd, _internal=True)
+
+
+def add(x, y, name=None):
+    # sparse+sparse via dense and re-sparsify (XLA keeps this fused;
+    # BCOO concat+sum_duplicates is equivalent but slower on TPU)
+    bx, kind = _coo(x)
+    by, _ = _coo(y)
+    return _rewrap(jsparse.BCOO.fromdense(bx.todense() + by.todense()), kind)
+
+
+def multiply(x, y, name=None):
+    bx, kind = _coo(x)
+    by, _ = _coo(y)
+    return _rewrap(jsparse.BCOO.fromdense(bx.todense() * by.todense()), kind)
+
+
+def _unary(fn):
+    def op(x, name=None):
+        b, kind = _coo(x)
+        out = jsparse.BCOO((fn(b.data), b.indices), shape=b.shape)
+        return _rewrap(out, kind)
+
+    return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0))
+abs = _unary(jnp.abs)  # noqa: A001
+sin = _unary(jnp.sin)
+tanh = _unary(jnp.tanh)
+
+
+def coalesce(x, name=None):
+    return x.coalesce()

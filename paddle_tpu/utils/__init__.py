@@ -1,0 +1,48 @@
+"""paddle_tpu.utils — logging, lazy import, misc helpers.
+
+ref: python/paddle/utils/ — the reference bundles cpp_extension,
+download, gast…; the TPU build needs the observability pieces: VLOG
+logging (utils/log.py here, backing FLAGS_log_level), deprecated-API
+decorator, and unique_name (re-exported from base).
+"""
+from . import log  # noqa: F401
+from .log import get_logger  # noqa: F401
+
+
+def try_import(module_name: str):
+    """ref: utils/lazy_import.py try_import — import or raise with a
+    helpful message (no pip in this environment)."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            f"{module_name} is required but not installed in this "
+            "environment (package installs are unavailable)"
+        ) from e
+
+
+def deprecated(since: str = "", update_to: str = "", level: int = 0, reason: str = ""):
+    """ref: utils/deprecated.py — warn once per call site."""
+    import functools
+    import warnings
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            if level > 1:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator

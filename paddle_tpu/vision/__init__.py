@@ -1,0 +1,50 @@
+"""paddle_tpu.vision — transforms, datasets, model zoo.
+
+ref: python/paddle/vision/ — transforms/ (functional + class
+transforms), datasets/ (MNIST/FashionMNIST/Cifar...), models/ (LeNet,
+AlexNet, VGG, ResNet, MobileNet...). Host-side image code is numpy/PIL
+(it runs in dataloader workers, not on the TPU); models are nn.Layers
+whose compute lowers to XLA convs on the MXU.
+"""
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from .models import (  # noqa: F401
+    LeNet,
+    AlexNet,
+    VGG,
+    ResNet,
+    MobileNetV1,
+    MobileNetV2,
+    alexnet,
+    mobilenet_v1,
+    mobilenet_v2,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
+)
+
+__all__ = ["transforms", "datasets", "models", "ops"]
+
+
+def set_image_backend(backend: str):
+    """ref: vision/image.py set_image_backend — 'pil' | 'cv2' | 'tensor'.
+    Only pil/numpy are meaningful here; recorded for get_image_backend."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+_image_backend = "pil"
+
+
+def get_image_backend() -> str:
+    return _image_backend

@@ -1,0 +1,9 @@
+"""vision.datasets (ref: python/paddle/vision/datasets/ — mnist.py,
+cifar.py). File-format parsers are faithful (MNIST idx-ubyte, CIFAR
+pickle batches); automatic download is unavailable (no egress), so
+``download=True`` raises with the expected file layout instead.
+"""
+from .mnist import MNIST, FashionMNIST  # noqa: F401
+from .cifar import Cifar10, Cifar100  # noqa: F401
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]

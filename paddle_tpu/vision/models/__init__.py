@@ -1,0 +1,40 @@
+"""vision.models — the reference model zoo re-expressed as nn.Layers.
+
+ref: python/paddle/vision/models/ (lenet.py, alexnet.py, vgg.py,
+resnet.py, mobilenetv1.py, mobilenetv2.py). Pretrained-weight download
+is not available (no egress); ``pretrained=True`` raises with guidance
+to load a converted state_dict via set_state_dict.
+"""
+from .lenet import LeNet  # noqa: F401
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .resnet import (  # noqa: F401
+    BasicBlock,
+    BottleneckBlock,
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    wide_resnet50_2,
+    wide_resnet101_2,
+)
+from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2  # noqa: F401
+
+__all__ = [
+    "LeNet", "AlexNet", "alexnet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "ResNet", "BasicBlock", "BottleneckBlock", "resnet18", "resnet34",
+    "resnet50", "resnet101", "resnet152", "wide_resnet50_2",
+    "wide_resnet101_2", "MobileNetV1", "MobileNetV2", "mobilenet_v1",
+    "mobilenet_v2",
+]
+
+
+def _no_pretrained(name: str, pretrained: bool):
+    if pretrained:
+        raise ValueError(
+            f"pretrained weights for {name} are not bundled (no network "
+            "egress); convert the reference checkpoint and use "
+            "set_state_dict instead"
+        )

@@ -1,0 +1,146 @@
+"""vision.ops — detection primitives (ref: python/paddle/vision/ops.py).
+
+nms / box utilities are jnp-lowered with static shapes where possible;
+nms keeps the score-sorted O(N²) mask form (the reference's CUDA kernel
+does the same bitmask sweep) so it compiles under jit with a fixed box
+count.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from ..base.tensor import Tensor
+
+__all__ = ["nms", "box_area", "box_iou", "roi_align", "roi_pool"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_area(boxes):
+    """[N, 4] xyxy → [N] (ref: ops.py box utilities)."""
+
+    def f(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+
+    return apply(f, boxes, op_name="box_area")
+
+
+def box_iou(boxes1, boxes2):
+    """[N, 4] x [M, 4] → [N, M] IoU."""
+
+    def f(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+
+    return apply(f, boxes1, boxes2, op_name="box_iou")
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: Optional[int] = None):
+    """Greedy NMS (ref: ops.py nms — same semantics incl. categorical
+    batching via a per-category coordinate offset trick). Returns kept
+    indices sorted by descending score. Host-synced (data-dependent
+    output size, like the reference's returned LoD)."""
+    b = np.asarray(jax.device_get(_unwrap(boxes)), np.float32)
+    n = b.shape[0]
+    if scores is None:
+        s = np.arange(n, 0, -1, dtype=np.float32)  # keep input order
+    else:
+        s = np.asarray(jax.device_get(_unwrap(scores)), np.float32)
+    if category_idxs is not None:
+        # offset boxes per category so cross-category pairs never overlap
+        cats = np.asarray(jax.device_get(_unwrap(category_idxs)))
+        offset = (b.max() + 1.0) * cats.astype(np.float32)
+        b = b + offset[:, None]
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(n, bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(int(i))
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        iou = inter / (areas[i] + areas - inter + 1e-10)
+        suppressed |= iou > iou_threshold
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(np.asarray(keep, np.int64), _internal=True)
+
+
+def _roi_pool_common(x, boxes, boxes_num, output_size, spatial_scale, mode):
+    """Shared RoI pooling body: crop-and-resize per box.
+
+    RoIAlign is implemented as jax.image bilinear crop-resize (the
+    sampling-point average converges to this; XLA fuses it); RoIPool is
+    the max over the resized bins' nearest samples.
+    """
+    import jax.image
+
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    xa = _unwrap(x)  # [N, C, H, W]
+    ba = np.asarray(jax.device_get(_unwrap(boxes)), np.float32)
+    bn = np.asarray(jax.device_get(_unwrap(boxes_num)), np.int64)
+    c, h, w = xa.shape[1], xa.shape[2], xa.shape[3]
+    outs = []
+    img_idx = np.repeat(np.arange(len(bn)), bn)
+    for k, box in enumerate(ba):
+        x1, y1, x2, y2 = box * spatial_scale
+        img = xa[img_idx[k]]
+        # sample a (2*oh, 2*ow) grid then reduce 2x2 bins
+        gy = jnp.linspace(y1, y2, 2 * oh)
+        gx = jnp.linspace(x1, x2, 2 * ow)
+        gy = jnp.clip(gy, 0, h - 1)
+        gx = jnp.clip(gx, 0, w - 1)
+        if mode == "align":
+            y0f = jnp.floor(gy).astype(jnp.int32)
+            x0f = jnp.floor(gx).astype(jnp.int32)
+            y1f = jnp.minimum(y0f + 1, h - 1)
+            x1f = jnp.minimum(x0f + 1, w - 1)
+            wy = (gy - y0f)[None, :, None]
+            wx = (gx - x0f)[None, None, :]
+            v00 = img[:, y0f][:, :, x0f]
+            v01 = img[:, y0f][:, :, x1f]
+            v10 = img[:, y1f][:, :, x0f]
+            v11 = img[:, y1f][:, :, x1f]
+            grid = (
+                v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx
+            )
+            pooled = grid.reshape(c, oh, 2, ow, 2).mean(axis=(2, 4))
+        else:
+            yi = jnp.round(gy).astype(jnp.int32)
+            xi = jnp.round(gx).astype(jnp.int32)
+            grid = img[:, yi][:, :, xi]
+            pooled = grid.reshape(c, oh, 2, ow, 2).max(axis=(2, 4))
+        outs.append(pooled)
+    return Tensor(jnp.stack(outs), _internal=True)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """ref: ops.py roi_align."""
+    return _roi_pool_common(x, boxes, boxes_num, output_size, spatial_scale, "align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """ref: ops.py roi_pool."""
+    return _roi_pool_common(x, boxes, boxes_num, output_size, spatial_scale, "pool")

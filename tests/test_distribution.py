@@ -1,0 +1,193 @@
+"""distribution package tests.
+
+Reference pattern: test/distribution/test_distribution_*.py — moments
+and log_prob against scipy.stats, sample-mean convergence, KL identities
+(KL(p,p)=0, analytic pairs), and rsample gradient flow.
+"""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+class TestMomentsAndLogProb:
+    def test_normal(self):
+        d = D.Normal(_t([0.0, 1.0]), _t([1.0, 2.0]))
+        np.testing.assert_allclose(d.mean.numpy(), [0, 1], atol=1e-6)
+        np.testing.assert_allclose(d.variance.numpy(), [1, 4], rtol=1e-5)
+        v = np.array([0.3, -1.2], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(_t(v)).numpy(),
+            st.norm(loc=[0, 1], scale=[1, 2]).logpdf(v),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            d.entropy().numpy(), st.norm(scale=[1, 2]).entropy(), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            d.cdf(_t(v)).numpy(), st.norm([0, 1], [1, 2]).cdf(v), rtol=1e-5, atol=1e-6
+        )
+
+    def test_uniform(self):
+        d = D.Uniform(_t(1.0), _t(3.0))
+        np.testing.assert_allclose(float(d.mean.numpy()), 2.0)
+        np.testing.assert_allclose(
+            float(d.log_prob(_t(2.0)).numpy()), st.uniform(1, 2).logpdf(2.0), rtol=1e-6
+        )
+        assert float(d.log_prob(_t(5.0)).numpy()) == -np.inf
+
+    def test_gamma_beta_dirichlet(self):
+        g = D.Gamma(_t(2.0), _t(3.0))
+        np.testing.assert_allclose(float(g.mean.numpy()), 2 / 3, rtol=1e-6)
+        np.testing.assert_allclose(
+            float(g.log_prob(_t(0.5)).numpy()),
+            st.gamma(2.0, scale=1 / 3).logpdf(0.5),
+            rtol=1e-5,
+        )
+        b = D.Beta(_t(2.0), _t(5.0))
+        np.testing.assert_allclose(
+            float(b.log_prob(_t(0.3)).numpy()), st.beta(2, 5).logpdf(0.3), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(b.entropy().numpy()), st.beta(2, 5).entropy(), rtol=1e-4
+        )
+        dd = D.Dirichlet(_t([1.0, 2.0, 3.0]))
+        x = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(
+            float(dd.log_prob(_t(x)).numpy()),
+            st.dirichlet([1, 2, 3]).logpdf(x),
+            rtol=1e-5,
+        )
+
+    def test_discrete(self):
+        be = D.Bernoulli(_t(0.3))
+        np.testing.assert_allclose(
+            float(be.log_prob(_t(1.0)).numpy()), np.log(0.3), rtol=1e-5
+        )
+        c = D.Categorical(_t([2.0, 6.0, 2.0]))  # unnormalized probs
+        np.testing.assert_allclose(
+            float(c.log_prob(_t(1)).numpy()), np.log(0.6), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(c.entropy().numpy()),
+            st.entropy([0.2, 0.6, 0.2]),
+            rtol=1e-5,
+        )
+        ge = D.Geometric(_t(0.25))
+        np.testing.assert_allclose(
+            float(ge.log_pmf(_t(3.0)).numpy()),
+            st.geom(0.25, loc=-1).logpmf(3),
+            rtol=1e-5,
+        )
+        m = D.Multinomial(4, _t([0.2, 0.8]))
+        np.testing.assert_allclose(
+            float(m.log_prob(_t([1.0, 3.0])).numpy()),
+            st.multinomial(4, [0.2, 0.8]).logpmf([1, 3]),
+            rtol=1e-5,
+        )
+
+    def test_laplace_gumbel_exponential(self):
+        l = D.Laplace(_t(1.0), _t(2.0))
+        np.testing.assert_allclose(
+            float(l.log_prob(_t(0.0)).numpy()),
+            st.laplace(1, 2).logpdf(0.0),
+            rtol=1e-5,
+        )
+        gu = D.Gumbel(_t(0.5), _t(2.0))
+        np.testing.assert_allclose(
+            float(gu.log_prob(_t(1.0)).numpy()),
+            st.gumbel_r(0.5, 2).logpdf(1.0),
+            rtol=1e-5,
+        )
+        ex = D.Exponential(_t(2.0))
+        np.testing.assert_allclose(
+            float(ex.log_prob(_t(0.7)).numpy()),
+            st.expon(scale=0.5).logpdf(0.7),
+            rtol=1e-5,
+        )
+
+
+class TestSampling:
+    @pytest.mark.parametrize("dist,mean,tol", [
+        (lambda: D.Normal(_t(2.0), _t(1.0)), 2.0, 0.1),
+        (lambda: D.Uniform(_t(0.0), _t(4.0)), 2.0, 0.1),
+        (lambda: D.Gamma(_t(3.0), _t(1.5)), 2.0, 0.15),
+        (lambda: D.Exponential(_t(0.5)), 2.0, 0.15),
+        (lambda: D.Laplace(_t(2.0), _t(0.5)), 2.0, 0.1),
+    ])
+    def test_sample_mean_converges(self, dist, mean, tol):
+        paddle.seed(0)
+        s = dist().sample((4000,))
+        assert abs(float(s.numpy().mean()) - mean) < tol
+
+    def test_bernoulli_categorical_counts(self):
+        paddle.seed(0)
+        b = D.Bernoulli(_t(0.7)).sample((2000,))
+        assert abs(float(b.numpy().mean()) - 0.7) < 0.05
+        c = D.Categorical(_t([1.0, 3.0])).sample((2000,))
+        assert abs(float((c.numpy() == 1).mean()) - 0.75) < 0.05
+
+    def test_rsample_gradient_flows(self):
+        loc = _t(0.5)
+        loc.stop_gradient = False
+        d = D.Normal(loc, _t(1.0))
+        paddle.seed(0)
+        s = d.rsample((64,))
+        s.mean().backward()
+        np.testing.assert_allclose(float(loc.grad.numpy()), 1.0, rtol=1e-5)
+
+    def test_multinomial_sums_to_n(self):
+        paddle.seed(0)
+        m = D.Multinomial(10, _t([0.3, 0.3, 0.4])).sample((5,))
+        np.testing.assert_array_equal(m.numpy().sum(-1), [10] * 5)
+
+
+class TestKL:
+    def test_kl_self_zero(self):
+        for d in [
+            D.Normal(_t(1.0), _t(2.0)),
+            D.Bernoulli(_t(0.4)),
+            D.Gamma(_t(2.0), _t(3.0)),
+            D.Beta(_t(2.0), _t(3.0)),
+            D.Laplace(_t(0.0), _t(1.0)),
+        ]:
+            np.testing.assert_allclose(
+                float(D.kl_divergence(d, d).numpy()), 0.0, atol=1e-5
+            )
+
+    def test_kl_normal_analytic(self):
+        p = D.Normal(_t(0.0), _t(1.0))
+        q = D.Normal(_t(1.0), _t(2.0))
+        expected = np.log(2) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(
+            float(D.kl_divergence(p, q).numpy()), expected, rtol=1e-5
+        )
+
+    def test_kl_categorical_matches_scipy(self):
+        p = D.Categorical(_t([0.2, 0.8]))
+        q = D.Categorical(_t([0.5, 0.5]))
+        np.testing.assert_allclose(
+            float(D.kl_divergence(p, q).numpy()),
+            st.entropy([0.2, 0.8], [0.5, 0.5]),
+            rtol=1e-5,
+        )
+
+    def test_register_kl_custom(self):
+        class MyDist(D.Normal):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl_my(p, q):
+            return _t(42.0)
+
+        assert float(D.kl_divergence(MyDist(_t(0.0), _t(1.0)), MyDist(_t(0.0), _t(1.0))).numpy()) == 42.0
+
+    def test_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(_t(0.0), _t(1.0)), D.Gamma(_t(1.0), _t(1.0)))

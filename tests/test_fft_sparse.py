@@ -1,0 +1,107 @@
+"""fft + sparse package tests.
+
+Reference pattern: test/legacy_test/test_fft.py (parity vs numpy.fft
+across norms), test/legacy_test/test_sparse_*.py (COO/CSR round-trips,
+sparse matmul vs dense).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, sparse
+
+
+class TestFFT:
+    @pytest.mark.parametrize("norm", [None, "ortho", "forward"])
+    def test_fft_ifft_roundtrip_and_numpy_parity(self, norm):
+        x = np.random.RandomState(0).randn(8).astype(np.float32)
+        out = fft.fft(paddle.to_tensor(x), norm=norm)
+        ref = np.fft.fft(x, norm=norm or "backward")
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+        back = fft.ifft(out, norm=norm)
+        np.testing.assert_allclose(back.numpy().real, x, rtol=1e-4, atol=1e-5)
+
+    def test_rfft_irfft(self):
+        x = np.random.RandomState(1).randn(16).astype(np.float32)
+        out = fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), np.fft.rfft(x), rtol=1e-4, atol=1e-5)
+        back = fft.irfft(out, n=16)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-4, atol=1e-5)
+
+    def test_fft2_and_shift(self):
+        x = np.random.RandomState(2).randn(4, 6).astype(np.float32)
+        out = fft.fft2(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+        sh = fft.fftshift(paddle.to_tensor(x))
+        np.testing.assert_allclose(sh.numpy(), np.fft.fftshift(x))
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(
+            fft.fftfreq(8, d=0.5).numpy(), np.fft.fftfreq(8, 0.5), rtol=1e-6
+        )
+
+    def test_grad_through_rfft(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8).astype(np.float32))
+        x.stop_gradient = False
+        y = fft.rfft(x)
+        loss = (y.real() ** 2 + y.imag() ** 2).sum()
+        loss.backward()
+        assert x.grad is not None and x.grad.shape == [8]
+
+    def test_bad_norm_raises(self):
+        with pytest.raises(ValueError):
+            fft.fft(paddle.to_tensor(np.ones(4, np.float32)), norm="bogus")
+
+
+class TestSparse:
+    def _coo(self):
+        indices = [[0, 1, 2], [1, 2, 0]]
+        values = [1.0, 2.0, 3.0]
+        return sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+
+    def test_coo_create_and_dense(self):
+        s = self._coo()
+        assert s.shape == [3, 3] and s.nnz == 3
+        dense = s.to_dense().numpy()
+        expect = np.zeros((3, 3), np.float32)
+        expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+        np.testing.assert_array_equal(dense, expect)
+
+    def test_csr_roundtrip(self):
+        s = self._coo()
+        csr = s.to_sparse_csr()
+        assert csr.is_sparse_csr()
+        np.testing.assert_array_equal(csr.crows().numpy(), [0, 1, 2, 3])
+        back = csr.to_sparse_coo()
+        np.testing.assert_array_equal(back.to_dense().numpy(), s.to_dense().numpy())
+
+    def test_csr_create(self):
+        csr = sparse.sparse_csr_tensor(
+            [0, 2, 3, 5], [1, 3, 2, 0, 1], [1.0, 2, 3, 4, 5], [3, 4]
+        )
+        d = csr.to_dense().numpy()
+        assert d[0, 1] == 1 and d[0, 3] == 2 and d[2, 1] == 5
+
+    def test_matmul_vs_dense(self):
+        s = self._coo()
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        out = sparse.matmul(s, paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, s.to_dense().numpy() @ x, rtol=1e-5)
+
+    def test_unary_and_binary(self):
+        s = self._coo()
+        r = sparse.relu(sparse.sparse_coo_tensor([[0], [0]], [-5.0], [3, 3]))
+        assert float(r.to_dense().numpy().sum()) == 0.0
+        summed = sparse.add(s, s)
+        np.testing.assert_array_equal(
+            summed.to_dense().numpy(), 2 * s.to_dense().numpy()
+        )
+        prod = sparse.multiply(s, s)
+        np.testing.assert_array_equal(
+            prod.to_dense().numpy(), s.to_dense().numpy() ** 2
+        )
+
+    def test_coalesce(self):
+        s = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 2.0], [2, 2])
+        c = s.coalesce()
+        assert c.to_dense().numpy()[0, 1] == 3.0

@@ -82,12 +82,16 @@ class TestFlashKernel:
             np.asarray(out, np.float32), np.asarray(ref), atol=5e-2
         )
 
-    def test_cross_attention_lengths(self):
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_cross_attention_lengths(self, causal):
+        """sq != sk: causal must use bottom-right alignment (query i sees
+        keys <= i + sk - sq), matching the jnp fallback and FA2 — the
+        KV-cache decode case."""
         q = _rand((1, 128, 2, 64), seed=0)
         k = _rand((1, 256, 2, 64), seed=1)
         v = _rand((1, 256, 2, 64), seed=2)
-        out = flash_attention(q, k, v, False, None, True)
-        ref = _naive(q, k, v, False)
+        out = flash_attention(q, k, v, causal, None, True)
+        ref = _naive(q, k, v, causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
     def test_under_jit(self):

@@ -1,0 +1,123 @@
+"""profiler / device / utils package tests.
+
+Reference pattern: test/legacy_test/test_profiler.py (scheduler state
+machine, RecordEvent nesting), test_cuda_* device API tests mapped to
+TPU semantics.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import (
+    Profiler,
+    ProfilerState,
+    RecordEvent,
+    benchmark,
+    make_scheduler,
+)
+
+
+class TestScheduler:
+    def test_state_machine(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1, skip_first=1)
+        states = [sched(i) for i in range(6)]
+        assert states[0] == ProfilerState.CLOSED  # skip_first
+        assert states[1] == ProfilerState.CLOSED
+        assert states[2] == ProfilerState.READY
+        assert states[3] == ProfilerState.RECORD
+        assert states[4] == ProfilerState.RECORD_AND_RETURN
+        assert states[5] == ProfilerState.CLOSED  # repeat exhausted
+
+    def test_timer_only_profiler_summary(self, capsys):
+        p = Profiler(timer_only=True)
+        p.start()
+        for _ in range(3):
+            x = paddle.to_tensor(np.ones((8, 8), np.float32))
+            (x @ x).numpy()
+            p.step()
+        p.stop()
+        p.summary()
+        out = capsys.readouterr().out
+        assert "mean" in out and "steps" in out
+
+    def test_record_event_nests(self):
+        with RecordEvent("outer"):
+            with RecordEvent("inner") as e:
+                assert e.name == "inner"
+
+    def test_trace_records_to_dir(self, tmp_path):
+        from paddle_tpu.profiler import export_chrome_tracing
+
+        d = str(tmp_path / "prof")
+        p = Profiler(on_trace_ready=export_chrome_tracing(d))
+        p.start()
+        x = paddle.to_tensor(np.ones((16, 16), np.float32))
+        (x @ x).numpy()
+        p.step()
+        p.stop()
+        import os
+
+        assert os.path.isdir(d) and len(os.listdir(d)) > 0
+
+
+class TestBenchmarkTimer:
+    def test_ips(self):
+        b = benchmark()
+        b.reset()
+        import time
+
+        for _ in range(6):
+            b.before_reader()
+            b.after_reader()
+            b.step(batch_size=32)
+            time.sleep(0.001)
+        assert b.ips > 0
+        assert "ips" in b.step_info()
+
+
+class TestDevice:
+    def test_synchronize_and_stats(self):
+        paddle.device.synchronize()
+        assert paddle.device.memory_allocated() >= 0
+        assert paddle.device.max_memory_allocated() >= 0
+        props = paddle.device.get_device_properties()
+        assert props.name
+
+    def test_stream_event(self):
+        s = paddle.device.current_stream()
+        e = s.record_event()
+        e.synchronize()
+        assert e.query()
+        s.synchronize()
+        with paddle.device.stream_guard(paddle.device.Stream()):
+            pass
+
+    def test_event_timing(self):
+        e1 = paddle.device.Event(enable_timing=True)
+        e2 = paddle.device.Event(enable_timing=True)
+        e1.record()
+        e2.record()
+        assert e1.elapsed_time(e2) >= 0
+
+
+class TestUtils:
+    def test_vlog_respects_flag(self, capsys):
+        from paddle_tpu.utils import log
+
+        paddle.set_flags({"log_level": 0})
+        log.vlog(3, "hidden")
+        paddle.set_flags({"log_level": 3})
+        log.vlog(3, "shown %d", 42)
+        err = capsys.readouterr().err
+        assert "shown 42" in err and "hidden" not in err
+        paddle.set_flags({"log_level": 0})
+
+    def test_deprecated_warns(self):
+        from paddle_tpu.utils import deprecated
+
+        @deprecated(since="2.0", update_to="new_fn")
+        def old_fn():
+            return 1
+
+        with pytest.warns(DeprecationWarning, match="new_fn"):
+            assert old_fn() == 1

@@ -1,0 +1,216 @@
+"""vision package tests: transforms, models, dataset parsers, ops.
+
+Reference pattern: test/legacy_test/test_transforms.py (shape/value
+checks per transform), test_vision_models.py (forward shape of each
+zoo model), test_datasets.py (parser round-trip on generated files).
+"""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models, ops, transforms as T
+
+
+def _img(h=32, w=24, c=3, seed=0):
+    return np.random.RandomState(seed).randint(0, 256, (h, w, c), np.uint8)
+
+
+class TestTransforms:
+    def test_to_tensor_scales_and_chw(self):
+        t = T.to_tensor(_img())
+        assert t.shape == [3, 32, 24]
+        assert float(t.max().numpy()) <= 1.0
+
+    def test_resize_and_center_crop(self):
+        out = T.resize(_img(), 16)
+        assert min(np.asarray(out).shape[:2]) == 16
+        out = T.center_crop(_img(), (8, 10))
+        assert np.asarray(out).shape[:2] == (8, 10)
+
+    def test_flip_pad_crop(self):
+        img = _img()
+        np.testing.assert_array_equal(np.asarray(T.hflip(img)), img[:, ::-1])
+        np.testing.assert_array_equal(np.asarray(T.vflip(img)), img[::-1])
+        padded = T.pad(img, 2)
+        assert np.asarray(padded).shape == (36, 28, 3)
+        cropped = T.crop(img, 1, 2, 5, 6)
+        np.testing.assert_array_equal(np.asarray(cropped), img[1:6, 2:8])
+
+    def test_normalize(self):
+        arr = T.to_tensor(_img())
+        out = T.normalize(arr, [0.5, 0.5, 0.5], [0.5, 0.5, 0.5])
+        assert abs(float(out.mean().numpy())) < 1.5
+
+    def test_pil_roundtrip(self):
+        from PIL import Image
+
+        pil = Image.fromarray(_img())
+        out = T.resize(pil, (10, 12))
+        assert out.size == (12, 10)  # PIL size is (w, h)
+        gray = T.to_grayscale(pil)
+        assert np.asarray(gray).ndim == 2 or np.asarray(gray).shape[-1] == 1
+
+    def test_compose_pipeline_deterministic_under_seed(self):
+        pipe = T.Compose([
+            T.RandomResizedCrop(16),
+            T.RandomHorizontalFlip(),
+            T.ColorJitter(brightness=0.2, contrast=0.2),
+            T.ToTensor(),
+            T.Normalize([0.5] * 3, [0.5] * 3),
+        ])
+        img = _img(40, 40)
+        paddle.seed(7)
+        a = pipe(img).numpy()
+        paddle.seed(7)
+        b = pipe(img).numpy()
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == (3, 16, 16)
+
+    def test_random_erasing(self):
+        t = T.RandomErasing(prob=1.0, value=0)
+        x = paddle.to_tensor(np.ones((3, 16, 16), np.float32))
+        out = t(x)
+        assert float(out.min().numpy()) == 0.0
+
+
+class TestModels:
+    def test_lenet(self):
+        m = models.LeNet()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32))
+        assert m(x).shape == [2, 10]
+
+    @pytest.mark.parametrize("factory,depth_params", [
+        (models.resnet18, 11_689_512),
+        (models.resnet50, 25_557_032),
+    ])
+    def test_resnet_shapes_and_params(self, factory, depth_params):
+        m = factory(num_classes=1000)
+        n = sum(int(np.prod(p.shape)) for p in m.parameters())
+        assert n == depth_params  # exact torchvision/paddle parity
+        x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32))
+        m.eval()
+        assert m(x).shape == [1, 1000]
+
+    def test_mobilenet_v2_params(self):
+        m = models.mobilenet_v2(num_classes=1000)
+        n = sum(int(np.prod(p.shape)) for p in m.parameters())
+        assert n == 3_504_872
+        x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32))
+        m.eval()
+        assert m(x).shape == [1, 1000]
+
+    def test_vgg11_forward(self):
+        m = models.vgg11(num_classes=10)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 32, 32).astype(np.float32))
+        m.eval()
+        assert m(x).shape == [1, 10]
+
+    def test_resnet_trains(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+
+        paddle.seed(0)
+        m = models.ResNet(depth=18, num_classes=4)
+        o = opt.SGD(learning_rate=0.01, parameters=m.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 3, 32, 32).astype(np.float32))
+        y = paddle.to_tensor(np.random.RandomState(1).randint(0, 4, (4,)))
+        losses = []
+        for _ in range(3):
+            loss = nn.functional.cross_entropy(m(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_pretrained_raises(self):
+        with pytest.raises(ValueError, match="egress"):
+            models.resnet18(pretrained=True)
+
+
+class TestDatasets:
+    def test_mnist_parser(self, tmp_path):
+        from paddle_tpu.vision.datasets import MNIST
+
+        rng = np.random.RandomState(0)
+        images = rng.randint(0, 256, (5, 28, 28), np.uint8)
+        labels = rng.randint(0, 10, (5,), np.uint8)
+        ip = str(tmp_path / "train-images-idx3-ubyte.gz")
+        lp = str(tmp_path / "train-labels-idx1-ubyte.gz")
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 5, 28, 28) + images.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, 5) + labels.tobytes())
+        ds = MNIST(image_path=ip, label_path=lp, mode="train")
+        assert len(ds) == 5
+        img, lab = ds[3]
+        np.testing.assert_array_equal(img, images[3])
+        assert lab == labels[3]
+
+    def test_cifar10_parser(self, tmp_path):
+        from paddle_tpu.vision.datasets import Cifar10
+
+        rng = np.random.RandomState(0)
+        archive = str(tmp_path / "cifar-10-python.tar.gz")
+        with tarfile.open(archive, "w:gz") as tf:
+            for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+                batch = {
+                    b"data": rng.randint(0, 256, (4, 3072), np.uint8),
+                    b"labels": rng.randint(0, 10, (4,)).tolist(),
+                }
+                import io as _io
+
+                payload = pickle.dumps(batch)
+                info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+                info.size = len(payload)
+                tf.addfile(info, _io.BytesIO(payload))
+        train = Cifar10(data_file=archive, mode="train")
+        test = Cifar10(data_file=archive, mode="test")
+        assert len(train) == 20 and len(test) == 4
+        img, lab = train[0]
+        assert img.shape == (32, 32, 3) and 0 <= lab < 10
+
+    def test_missing_raises_helpful(self, tmp_path):
+        from paddle_tpu.vision.datasets import MNIST
+
+        with pytest.raises(RuntimeError, match="egress"):
+            MNIST(image_path=str(tmp_path / "x.gz"), label_path=str(tmp_path / "y.gz"))
+
+
+class TestOps:
+    def test_nms_suppresses_overlaps(self):
+        boxes = paddle.to_tensor(np.array([
+            [0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+        ], np.float32))
+        scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+        keep = ops.nms(boxes, iou_threshold=0.5, scores=scores)
+        assert keep.numpy().tolist() == [0, 2]
+
+    def test_nms_categorical(self):
+        boxes = paddle.to_tensor(np.array([
+            [0, 0, 10, 10], [1, 1, 11, 11],
+        ], np.float32))
+        scores = paddle.to_tensor(np.array([0.9, 0.8], np.float32))
+        cats = paddle.to_tensor(np.array([0, 1]))
+        keep = ops.nms(boxes, 0.5, scores, category_idxs=cats, categories=[0, 1])
+        assert sorted(keep.numpy().tolist()) == [0, 1]  # different cats kept
+
+    def test_box_iou(self):
+        a = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+        b = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32))
+        iou = ops.box_iou(a, b).numpy()
+        np.testing.assert_allclose(iou[0, 0], 1.0)
+        np.testing.assert_allclose(iou[0, 1], 25 / 175, rtol=1e-5)
+
+    def test_roi_align_shape(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(1, 4, 16, 16).astype(np.float32))
+        boxes = paddle.to_tensor(np.array([[0, 0, 8, 8], [4, 4, 12, 12]], np.float32))
+        bn = paddle.to_tensor(np.array([2]))
+        out = ops.roi_align(x, boxes, bn, output_size=4)
+        assert out.shape == [2, 4, 4, 4]
