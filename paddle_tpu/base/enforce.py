@@ -1,0 +1,116 @@
+"""Error enforcement machinery.
+
+ref: paddle/common/enforce.h (PADDLE_ENFORCE_* macros, error codes
+paddle/common/errors.h / phi/core/errors.h) and
+python/paddle/base/error.py. The reference attaches a typed error code
+(InvalidArgument, NotFound, OutOfRange, …) + call-site summary to every
+check; Python surfaces them as typed exceptions. Here the same
+taxonomy maps onto Python exception subclasses so user code can catch
+by category, and ``enforce``/check helpers give ops one-line guards
+with consistent messages.
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "PermissionDeniedError",
+    "UnimplementedError", "UnavailableError", "PreconditionNotMetError",
+    "ExecutionTimeoutError", "enforce", "check_type", "check_dtype",
+    "check_shape_match",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of all enforcement failures (ref: enforce.h EnforceNotMet)."""
+
+    code = "LEGACY"
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    code = "ALREADY_EXISTS"
+
+
+class PermissionDeniedError(EnforceNotMet):
+    code = "PERMISSION_DENIED"
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceNotMet):
+    code = "UNAVAILABLE"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    code = "PRECONDITION_NOT_MET"
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    code = "EXECUTION_TIMEOUT"
+
+
+def enforce(cond: Any, message: str,
+            exc: type = InvalidArgumentError):
+    """PADDLE_ENFORCE parity: raise ``exc`` with ``message`` unless
+    ``cond`` is truthy."""
+    if not cond:
+        raise exc(f"[{exc.code}] {message}")
+
+
+def check_type(value, name: str, expected_types, op_name: str = ""):
+    """ref: python/paddle/base/data_feeder.py check_type."""
+    if not isinstance(value, expected_types):
+        names = (
+            expected_types.__name__
+            if isinstance(expected_types, type)
+            else "/".join(t.__name__ for t in expected_types)
+        )
+        raise InvalidArgumentError(
+            f"[INVALID_ARGUMENT] {op_name or 'op'}: argument '{name}' must "
+            f"be {names}, got {type(value).__name__}"
+        )
+
+
+def check_dtype(dtype, name: str, allowed: Sequence[str], op_name: str = ""):
+    """ref: data_feeder.py check_dtype — dtype whitelist per op."""
+    import numpy as np
+
+    from . import dtype as _dtypes
+
+    dt = _dtypes.canonical_dtype(dtype)
+    allowed_np = [np.dtype(_dtypes.canonical_dtype(a)) for a in allowed]
+    if np.dtype(dt) not in allowed_np:
+        raise InvalidArgumentError(
+            f"[INVALID_ARGUMENT] {op_name or 'op'}: argument '{name}' dtype "
+            f"{_dtypes.dtype_name(dt)} not in allowed set {list(allowed)}"
+        )
+
+
+def check_shape_match(shape_a, shape_b, name_a: str, name_b: str,
+                      op_name: str = ""):
+    """InferMeta-style broadcast-compatibility check (ref:
+    phi/infermeta/binary.cc patterns) — catches shape errors with op
+    context instead of a raw XLA error."""
+    a, b = tuple(shape_a), tuple(shape_b)
+    for da, db in zip(a[::-1], b[::-1]):
+        if da != db and da != 1 and db != 1:
+            raise InvalidArgumentError(
+                f"[INVALID_ARGUMENT] {op_name or 'op'}: shapes of '{name_a}' "
+                f"{list(a)} and '{name_b}' {list(b)} are not "
+                "broadcast-compatible"
+            )

@@ -1,0 +1,103 @@
+"""Auditable op registry — the single-source op table.
+
+ref: the reference generates its op surface from
+paddle/phi/ops/yaml/ops.yaml (+ backward.yaml) via build-time codegen
+(SURVEY §2.1 item 8). Here the op surface is plain Python functions
+dispatching through ``tape.apply``, so the single source is built by
+introspection instead of codegen: ``registry()`` walks the public op
+namespaces and returns one record per op — name, module, signature,
+and doc reference — giving the same auditability (diffable op
+inventory, coverage checks in tests) without a parallel YAML that
+could drift from the code.
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Dict, List, Optional
+
+__all__ = ["OpRecord", "registry", "op_names", "lookup"]
+
+# namespaces that constitute the public op surface
+_OP_NAMESPACES = [
+    "paddle_tpu.tensor.creation",
+    "paddle_tpu.tensor.math",
+    "paddle_tpu.tensor.linalg",
+    "paddle_tpu.tensor.manipulation",
+    "paddle_tpu.tensor.logic",
+    "paddle_tpu.tensor.random",
+    "paddle_tpu.tensor.search",
+    "paddle_tpu.tensor.stat",
+    "paddle_tpu.tensor.einsum",
+    "paddle_tpu.nn.functional.activation",
+    "paddle_tpu.nn.functional.common",
+    "paddle_tpu.nn.functional.conv",
+    "paddle_tpu.nn.functional.loss",
+    "paddle_tpu.nn.functional.norm",
+    "paddle_tpu.nn.functional.pooling",
+    "paddle_tpu.nn.functional.attention",
+    "paddle_tpu.fft",
+    "paddle_tpu.vision.ops",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class OpRecord:
+    name: str
+    module: str
+    signature: str
+    doc_ref: Optional[str]  # first "ref:" line from the docstring
+
+
+_cache: Optional[Dict[str, OpRecord]] = None
+
+
+def _doc_ref(fn) -> Optional[str]:
+    doc = inspect.getdoc(fn) or ""
+    for line in doc.splitlines():
+        if "ref:" in line:
+            return line.strip()
+    return None
+
+
+def registry(refresh: bool = False) -> Dict[str, OpRecord]:
+    """name → OpRecord for every public op function."""
+    global _cache
+    if _cache is not None and not refresh:
+        return _cache
+    import importlib
+
+    out: Dict[str, OpRecord] = {}
+    for mod_name in _OP_NAMESPACES:
+        mod = importlib.import_module(mod_name)
+        mod_ref = None
+        for line in (mod.__doc__ or "").splitlines():
+            if "ref:" in line:
+                mod_ref = line.strip()
+                break
+        public = getattr(mod, "__all__", None) or [
+            n for n in dir(mod) if not n.startswith("_")
+        ]
+        for name in public:
+            fn = getattr(mod, name, None)
+            if not inspect.isfunction(fn):
+                continue
+            # ops defined elsewhere and re-exported count once, at home
+            if fn.__module__ != mod_name:
+                continue
+            try:
+                sig = str(inspect.signature(fn))
+            except (TypeError, ValueError):
+                sig = "(...)"
+            key = name if name not in out else f"{mod_name.rsplit('.', 1)[-1]}.{name}"
+            out[key] = OpRecord(name, mod_name, sig, _doc_ref(fn) or mod_ref)
+    _cache = out
+    return out
+
+
+def op_names() -> List[str]:
+    return sorted(registry().keys())
+
+
+def lookup(name: str) -> Optional[OpRecord]:
+    return registry().get(name)

@@ -123,6 +123,18 @@ class Tensor:
     def dist_attr(self):
         return self._dist_attr
 
+    @property
+    def process_mesh(self):
+        """ProcessMesh for DistTensors (ref: dist_tensor.h process_mesh);
+        None for ordinary tensors."""
+        return self._dist_attr["mesh"] if self._dist_attr else None
+
+    @property
+    def placements(self):
+        """Per-mesh-axis placements for DistTensors (ref:
+        dist_tensor.h placements); None for ordinary tensors."""
+        return self._dist_attr["placements"] if self._dist_attr else None
+
     # ------------------------------------------------------------------
     # autograd surface
     # ------------------------------------------------------------------

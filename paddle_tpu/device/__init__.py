@@ -185,14 +185,17 @@ class Stream:
         return True
 
 
-_current_stream = None
+_current_streams: dict = {}
+_stream_override: Optional[Stream] = None
 
 
 def current_stream(device=None) -> Stream:
-    global _current_stream
-    if _current_stream is None:
-        _current_stream = Stream(device)
-    return _current_stream
+    if _stream_override is not None:
+        return _stream_override
+    d = _jax_device(device)
+    if d.id not in _current_streams:
+        _current_streams[d.id] = Stream(d)
+    return _current_streams[d.id]
 
 
 class stream_guard:
@@ -204,14 +207,14 @@ class stream_guard:
         self._prev = None
 
     def __enter__(self):
-        global _current_stream
-        self._prev = _current_stream
-        _current_stream = self._stream
+        global _stream_override
+        self._prev = _stream_override
+        _stream_override = self._stream
         return self._stream
 
     def __exit__(self, *exc):
-        global _current_stream
-        _current_stream = self._prev
+        global _stream_override
+        _stream_override = self._prev
         return False
 
 

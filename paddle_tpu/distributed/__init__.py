@@ -46,3 +46,19 @@ from .parallel import (  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import launch  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+)

@@ -1,0 +1,182 @@
+"""Distributed checkpoint (ref: python/paddle/distributed/checkpoint/
+save_state_dict.py:104, load_state_dict.py, metadata.py).
+
+The reference writes per-rank shard files plus a global metadata plan
+(dedup across ranks, cross-topology resharding on load). Under JAX's
+single-controller model every array is globally addressable, so:
+
+- save: each tensor is written as one or more **shard files** keyed by
+  its global offset (one per addressable shard — on multi-host TPU each
+  host writes only the shards it owns), plus ``metadata`` mapping
+  tensor → [(offset, shape, file)].
+- load: shards are read, assembled by offset, and re-placed with the
+  CURRENT tensor's sharding — which is exactly cross-topology
+  resharding: save on a (dp=2, mp=4) mesh, load on (dp=4, mp=2) works.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...base.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict"]
+
+_META_FILE = "0.metadata"
+
+
+@dataclasses.dataclass
+class _ShardInfo:
+    """One saved shard of one tensor (ref: metadata.py LocalTensorMetadata)."""
+
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    file_name: str
+
+
+def _flatten(state_dict, prefix=""):
+    flat = {}
+    for k, v in state_dict.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            flat.update(_flatten(v, key))
+        else:
+            flat[key] = v
+    return flat
+
+
+def save_state_dict(state_dict: Dict, path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    unique_id: Optional[int] = None,
+                    async_save: bool = False):
+    """Write a (possibly sharded) state_dict to ``path`` directory."""
+    os.makedirs(path, exist_ok=True)
+    flat = _flatten(state_dict)
+    rank = jax.process_index()
+    metadata: Dict[str, dict] = {"tensors": {}, "scalars": {}}
+    payload: Dict[str, np.ndarray] = {}
+    file_name = f"{rank}_0.distcp"
+
+    for key, val in flat.items():
+        if isinstance(val, Tensor):
+            arr = val._data
+        elif isinstance(val, jax.Array):
+            arr = val
+        else:
+            metadata["scalars"][key] = val
+            continue
+        shards: List[_ShardInfo] = []
+        if isinstance(arr, jax.Array) and hasattr(arr, "addressable_shards"):
+            # enumerate the GLOBAL shard map (not just addressable
+            # shards) so the coordinator's metadata covers shards owned
+            # by other hosts; each offset records its owner's file
+            imap = arr.sharding.devices_indices_map(tuple(arr.shape))
+            seen_offsets = set()
+            for dev, idx in imap.items():
+                offset = tuple(
+                    (s.start or 0) if isinstance(s, slice) else 0 for s in idx
+                )
+                if offset in seen_offsets:  # replicated copies: keep one
+                    continue
+                seen_offsets.add(offset)
+                shape = tuple(
+                    ((s.stop if s.stop is not None else dim) - (s.start or 0))
+                    if isinstance(s, slice)
+                    else 1
+                    for s, dim in zip(idx, arr.shape)
+                )
+                owner_file = f"{dev.process_index}_0.distcp"
+                shards.append(_ShardInfo(offset, shape, owner_file))
+            local_offsets_written = set()
+            for sh in arr.addressable_shards:
+                offset = tuple(
+                    (s.start or 0) if isinstance(s, slice) else 0
+                    for s in sh.index
+                )
+                if (
+                    sh.device.process_index == rank
+                    and offset not in local_offsets_written
+                ):
+                    local_offsets_written.add(offset)
+                    payload[f"{key}@{'_'.join(map(str, offset))}"] = np.asarray(
+                        sh.data
+                    )
+        else:
+            data = np.asarray(arr)
+            payload[f"{key}@0"] = data
+            shards.append(
+                _ShardInfo((0,) * data.ndim, tuple(data.shape), file_name)
+            )
+        metadata["tensors"][key] = {
+            "global_shape": tuple(int(s) for s in arr.shape),
+            "dtype": str(np.dtype(arr.dtype)) if np.dtype(arr.dtype).kind != "V" else str(arr.dtype),
+            "shards": [dataclasses.asdict(s) for s in shards],
+        }
+
+    with open(os.path.join(path, file_name), "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+    if rank == coordinator_rank:
+        with open(os.path.join(path, _META_FILE), "wb") as f:
+            pickle.dump(metadata, f, protocol=4)
+
+
+def load_state_dict(state_dict: Dict, path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    unique_id: Optional[int] = None,
+                    offload: bool = False):
+    """Fill ``state_dict``'s tensors in-place from ``path``; each tensor
+    keeps its CURRENT sharding (cross-topology reshard on load)."""
+    meta_path = os.path.join(path, _META_FILE)
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(f"no checkpoint metadata at {meta_path}")
+    with open(meta_path, "rb") as f:
+        metadata = pickle.load(f)
+
+    payloads: Dict[str, np.ndarray] = {}
+    for fn in sorted(os.listdir(path)):
+        if fn.endswith(".distcp"):
+            with open(os.path.join(path, fn), "rb") as f:
+                payloads.update(pickle.load(f))
+
+    flat = _flatten(state_dict)
+    missing = []
+    for key, target in flat.items():
+        if not isinstance(target, (Tensor, jax.Array)):
+            continue
+        info = metadata["tensors"].get(key)
+        if info is None:
+            missing.append(key)
+            continue
+        import ml_dtypes  # noqa: F401  (numpy dtype registry for bf16)
+
+        full = np.zeros(info["global_shape"], np.dtype(info["dtype"]))
+        for sh in info["shards"]:
+            off = sh["global_offset"]
+            shape = sh["local_shape"]
+            shard_key = f"{key}@{'_'.join(map(str, off))}"
+            data = payloads[shard_key]
+            slices = tuple(slice(o, o + s) for o, s in zip(off, shape))
+            full[slices] = data
+        if isinstance(target, Tensor):
+            src = target._data
+            if tuple(full.shape) != tuple(src.shape):
+                raise ValueError(
+                    f"shape mismatch for {key}: saved {full.shape} vs "
+                    f"current {tuple(src.shape)}"
+                )
+            sharding = getattr(src, "sharding", None)
+            arr = (
+                jax.device_put(full, sharding)
+                if sharding is not None
+                else jax.device_put(full)
+            )
+            target._data = arr.astype(src.dtype)
+        else:
+            raise TypeError(f"state_dict value for {key} must be a Tensor")
+    if missing:
+        raise KeyError(f"keys missing from checkpoint: {missing}")

@@ -1,0 +1,23 @@
+"""paddle_tpu.distributed.launch — multi-process/multi-host launcher.
+
+ref: python/paddle/distributed/launch/ — main.py:21 (CLI), controllers/
+collective.py (Pod/Container process management, env injection, log
+capture, restart), controllers/master.py (HTTP/etcd rendezvous).
+
+TPU-native mapping: JAX is single-controller-per-host — one process
+drives all local chips, so ``--nproc_per_node`` defaults to 1 and the
+launcher's job is per-HOST process management + wiring the JAX
+coordination service (the TCPStore/rendezvous equivalent):
+
+    JAX_COORDINATOR_ADDRESS / process count / process id
+    + the reference's PADDLE_* env surface for ported user code.
+
+Run: ``python -m paddle_tpu.distributed.launch [--nnodes N]
+[--master host:port] [--rank R] train.py args...``. On a single host
+with ``--nproc 2`` (CPU testing) it spawns, monitors, restarts on
+failure up to ``--max_restart``, and captures per-rank logs — the
+collective controller's loop.
+"""
+from .main import launch, main  # noqa: F401
+
+__all__ = ["launch", "main"]

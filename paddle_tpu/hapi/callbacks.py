@@ -189,6 +189,7 @@ class EarlyStopping(Callback):
             self.best_value = np.inf if self.monitor_op == np.less else -np.inf
 
     def on_eval_end(self, logs=None):
+        self._eval_count = getattr(self, "_eval_count", 0) + 1
         if logs is None or self.monitor not in logs:
             return
         current = logs[self.monitor]
@@ -201,18 +202,19 @@ class EarlyStopping(Callback):
                 save_dir = self.params.get("save_dir")
                 if save_dir:  # ref: callbacks.py — persist best_model
                     self.model.save(f"{save_dir}/best_model")
-                else:  # keep an in-memory snapshot to restore on stop
-                    import numpy as np
+                # always keep an in-memory snapshot so the stop can
+                # restore the best weights regardless of save_dir
+                import numpy as np
 
-                    self.best_weights = {
-                        k: np.asarray(v.numpy())
-                        for k, v in self.model.network.state_dict().items()
-                    }
+                self.best_weights = {
+                    k: np.asarray(v.numpy())
+                    for k, v in self.model.network.state_dict().items()
+                }
             return
         self.wait_epoch += 1
         if self.wait_epoch > self.patience:
             self.model.stop_training = True
-            self.stopped_epoch = self.wait_epoch
+            self.stopped_epoch = self._eval_count
             if self.best_weights is not None:
                 self.model.network.set_state_dict(self.best_weights)
             if self.verbose:

@@ -264,7 +264,11 @@ class StaticFunction:
 
         state = self._read_state()
 
-        key = ("__multi_step__", arg_treedef)
+        # key includes shapes/dtypes: a new K retraces inside the same
+        # jax.jit, and the trace runs optimizer.step() once host-side —
+        # the step-count correction below must see that as a trace
+        abstract = tuple((tuple(a.shape), str(a.dtype)) for a in flat_arrays)
+        key = ("__multi_step__", arg_treedef, abstract, n)
         jitted = self._jit_cache.get(key)
         traced_now = jitted is None
         if traced_now:

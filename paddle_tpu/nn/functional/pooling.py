@@ -69,12 +69,12 @@ def _pool(x, kernel, stride, padding, n, reducer, init, data_format, ceil_mode, 
                 if rem:
                     pads[ax] = (pads[ax][0], pads[ax][1] + st[i] - rem)
         if average:
-            summed = jax.lax.reduce_window(a, 0.0 if np.dtype(a.dtype).kind == "f" else 0, jax.lax.add, window, strides, pads)
+            summed = jax.lax.reduce_window(a, 0.0 if jnp.issubdtype(a.dtype, jnp.floating) else 0, jax.lax.add, window, strides, pads)
             if count_include_pad and not isinstance(pads, str):
                 denom = np.prod(ks)
                 return summed / jnp.asarray(denom, a.dtype)
             ones = jnp.ones_like(a)
-            counts = jax.lax.reduce_window(ones, 0.0 if np.dtype(a.dtype).kind == "f" else 0, jax.lax.add, window, strides, pads)
+            counts = jax.lax.reduce_window(ones, 0.0 if jnp.issubdtype(a.dtype, jnp.floating) else 0, jax.lax.add, window, strides, pads)
             return summed / counts
         return jax.lax.reduce_window(a, init(a.dtype), reducer, window, strides, pads)
 
@@ -83,7 +83,7 @@ def _pool(x, kernel, stride, padding, n, reducer, init, data_format, ceil_mode, 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
     out = _pool(x, kernel_size, stride, padding, 2, jax.lax.max,
-                lambda dt: -jnp.inf if np.dtype(dt).kind == "f" else int(np.iinfo(dt).min),
+                lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(np.iinfo(dt).min),
                 data_format, ceil_mode, "max_pool2d")
     if return_mask:
         idx = _max_pool_indices(x, kernel_size, stride, padding, data_format)
@@ -130,7 +130,7 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
     out = _pool(x, kernel_size, stride, padding, 1, jax.lax.max,
-                lambda dt: -jnp.inf if np.dtype(dt).kind == "f" else int(np.iinfo(dt).min),
+                lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(np.iinfo(dt).min),
                 "NCH", ceil_mode, "max_pool1d")
     return out
 
@@ -142,7 +142,7 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
     return _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
-                 lambda dt: -jnp.inf if np.dtype(dt).kind == "f" else int(np.iinfo(dt).min),
+                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(np.iinfo(dt).min),
                  data_format, ceil_mode, "max_pool3d")
 
 

@@ -79,8 +79,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         if causal:
             q_abs = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_abs = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s_masked = jnp.where(q_abs + off >= k_abs, s, NEG_INF)
+            mask = q_abs + off >= k_abs
+            s_masked = jnp.where(mask, s, NEG_INF)
         else:
+            mask = None
             s_masked = s
 
         m_prev = m_scr[:, :1]                             # [bq, 1]
@@ -88,6 +90,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s_masked - m_new)                     # [bq, bk] f32
+        if mask is not None:
+            # fully-masked rows: m_new == NEG_INF makes exp(s-m) == 1;
+            # zero them so such rows emit 0 (and l stays 0)
+            p = jnp.where(mask, p, 0.0)
         l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
@@ -177,8 +183,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         if causal:
             q_abs = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_abs = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_abs + off >= k_abs, s, NEG_INF)
+            mask = q_abs + off >= k_abs
+            s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0, 0][:, None])          # [bq, bk]
+        if causal:
+            # fully-masked rows have lse == NEG_INF -> exp(0) == 1
+            p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -218,8 +228,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             q_abs = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_abs = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_abs + off >= k_abs, s, NEG_INF)
+            mask = q_abs + off >= k_abs
+            s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0, 0][:, None])           # [bq, bk]
+        if causal:
+            p = jnp.where(mask, p, 0.0)
         do = do_ref[0, 0]
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),

@@ -7,8 +7,9 @@ TPU-native design note: the TPU has no scatter-gather sparse units; XLA
 lowers sparse work to dense-ish gathers. JAX's BCOO (jax.experimental.
 sparse) is the native format — SparseCooTensor wraps it, so every op
 here is jit-compatible and differentiates. CSR is stored as the
-(crows, cols, values) triple for format parity and converted to COO for
-compute, mirroring how the reference's TPU-less kernels would behave.
+(crows, cols, values) triple for format parity; unary ops transform
+values in place (traceable), matmul converts to BCOO (traceable), and
+only CSR-output binary ops rebuild structure host-side.
 """
 from __future__ import annotations
 
@@ -191,11 +192,6 @@ def _coo(x):
     raise TypeError(f"expected a sparse tensor, got {type(x)}")
 
 
-def _rewrap(bcoo, kind):
-    coo = SparseCooTensor(bcoo)
-    return coo.to_sparse_csr() if kind == "csr" else coo
-
-
 def matmul(x, y, name=None):
     """sparse @ dense → dense (ref: sparse/matmul.py)."""
     b, _ = _coo(x)
@@ -204,24 +200,42 @@ def matmul(x, y, name=None):
 
 
 def add(x, y, name=None):
-    # sparse+sparse via dense and re-sparsify (XLA keeps this fused;
-    # BCOO concat+sum_duplicates is equivalent but slower on TPU)
+    # sparse+sparse via dense and re-sparsify with a static nse bound
+    # (traceable); COO output. CSR inputs yield CSR via a host-side
+    # conversion — re-sparsifying to CSR needs concrete row counts.
     bx, kind = _coo(x)
     by, _ = _coo(y)
-    return _rewrap(jsparse.BCOO.fromdense(bx.todense() + by.todense()), kind)
+    dense = bx.todense() + by.todense()
+    out = jsparse.BCOO.fromdense(dense, nse=int(bx.nse) + int(by.nse))
+    return _rewrap_dense_aware(out, kind, dense)
 
 
 def multiply(x, y, name=None):
     bx, kind = _coo(x)
     by, _ = _coo(y)
-    return _rewrap(jsparse.BCOO.fromdense(bx.todense() * by.todense()), kind)
+    dense = bx.todense() * by.todense()
+    out = jsparse.BCOO.fromdense(dense, nse=min(int(bx.nse), int(by.nse)))
+    return _rewrap_dense_aware(out, kind, dense)
+
+
+def _rewrap_dense_aware(bcoo, kind, dense):
+    if kind == "csr":
+        return _dense_to_csr(dense)  # host sync; CSR structure is host-built
+    return SparseCooTensor(bcoo)
 
 
 def _unary(fn):
+    """Zero-preserving elementwise op: transforms values only, so both
+    formats keep their structure with no densify/host sync (fully
+    jit-compatible)."""
+
     def op(x, name=None):
-        b, kind = _coo(x)
-        out = jsparse.BCOO((fn(b.data), b.indices), shape=b.shape)
-        return _rewrap(out, kind)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(
+                x.crows_arr, x.cols_arr, fn(x.values_arr), x._shape
+            )
+        b, _ = _coo(x)
+        return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
 
     return op
 
