@@ -190,9 +190,12 @@ _stream_override: Optional[Stream] = None
 
 
 def current_stream(device=None) -> Stream:
-    if _stream_override is not None:
-        return _stream_override
     d = _jax_device(device)
+    # a stream_guard override applies only to its own device
+    if _stream_override is not None and (
+        device is None or _stream_override._device.id == d.id
+    ):
+        return _stream_override
     if d.id not in _current_streams:
         _current_streams[d.id] = Stream(d)
     return _current_streams[d.id]

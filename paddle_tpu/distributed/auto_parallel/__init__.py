@@ -337,21 +337,14 @@ def shard_optimizer(optimizer, shard_fn=None):
                 return jax.device_put(arr, src.sharding)
         return arr
 
-    # accumulators are keyed per-param at creation; wrap the creation
-    # hook with param awareness via a closure over the optimizer
-    orig_get = optimizer._get_accum
+    # the optimizer's placement hook covers every lazy state creation —
+    # accumulators AND multi-precision master weights (optimizer.py
+    # _get_accum/_master_weight both route new state through it)
+    def placement(arr, param, name):
+        if shard_fn is not None:
+            out = shard_fn(name, param, Tensor(arr, _internal=True))
+            return out._data if isinstance(out, Tensor) else out
+        return place_like_param(arr, param)
 
-    def wrapped_get(name, param, init=None):
-        created = param.name not in optimizer._accumulators.get(name, {})
-        out = orig_get(name, param, init)
-        if created:
-            if shard_fn is not None:
-                out = shard_fn(name, param, Tensor(out, _internal=True))
-                out = out._data if isinstance(out, Tensor) else out
-            else:
-                out = place_like_param(out, param)
-            optimizer._accumulators[name][param.name] = out
-        return out
-
-    optimizer._get_accum = wrapped_get
+    optimizer._accum_placement_fn = placement
     return optimizer

@@ -50,6 +50,19 @@ def _flatten(state_dict, prefix=""):
     return flat
 
 
+def _flatten_with_parents(state_dict, prefix=""):
+    """Like _flatten but yields (key, value, parent_dict, parent_key) so
+    loads can rebind immutable leaves (scalars, raw arrays) in place."""
+    out = {}
+    for k, v in state_dict.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten_with_parents(v, key))
+        else:
+            out[key] = (v, state_dict, k)
+    return out
+
+
 def save_state_dict(state_dict: Dict, path: str,
                     process_group=None, coordinator_rank: int = 0,
                     unique_id: Optional[int] = None,
@@ -143,10 +156,14 @@ def load_state_dict(state_dict: Dict, path: str,
             with open(os.path.join(path, fn), "rb") as f:
                 payloads.update(pickle.load(f))
 
-    flat = _flatten(state_dict)
+    flat = _flatten_with_parents(state_dict)
     missing = []
-    for key, target in flat.items():
+    for key, (target, parent, pkey) in flat.items():
         if not isinstance(target, (Tensor, jax.Array)):
+            if key in metadata["scalars"]:
+                parent[pkey] = metadata["scalars"][key]
+            else:
+                missing.append(key)
             continue
         info = metadata["tensors"].get(key)
         if info is None:
@@ -176,7 +193,14 @@ def load_state_dict(state_dict: Dict, path: str,
                 else jax.device_put(full)
             )
             target._data = arr.astype(src.dtype)
-        else:
-            raise TypeError(f"state_dict value for {key} must be a Tensor")
+        else:  # raw jax.Array: rebind through the parent dict
+            if tuple(full.shape) != tuple(target.shape):
+                raise ValueError(
+                    f"shape mismatch for {key}: saved {full.shape} vs "
+                    f"current {tuple(target.shape)}"
+                )
+            sharding = getattr(target, "sharding", None)
+            arr = jax.device_put(full, sharding)
+            parent[pkey] = arr.astype(target.dtype)
     if missing:
         raise KeyError(f"keys missing from checkpoint: {missing}")

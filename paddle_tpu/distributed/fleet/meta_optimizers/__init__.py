@@ -66,7 +66,9 @@ class DygraphShardingOptimizer:
         self._hcg = hcg
         group = hcg.get_sharding_parallel_group() if hcg is not None else None
         mesh, axis = _sharding_mesh_axis(group)
-        optimizer._accum_placement_fn = lambda arr: _place(arr, mesh, axis)
+        optimizer._accum_placement_fn = (
+            lambda arr, param=None, name=None: _place(arr, mesh, axis)
+        )
         # re-place accumulators that already exist (resumed / pre-stepped)
         for store in optimizer._accumulators.values():
             for key in store:

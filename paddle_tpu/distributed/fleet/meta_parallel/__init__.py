@@ -105,3 +105,9 @@ from .pipeline_parallel import (  # noqa: E402,F401
     PipelineParallel,
     SharedLayerDesc,
 )
+from .moe import (  # noqa: E402,F401
+    ExpertMLP,
+    MoELayer,
+    TopKGate,
+    place_experts_on_mesh,
+)

@@ -1,0 +1,220 @@
+"""Mixture-of-Experts with expert parallelism.
+
+ref: python/paddle/incubate/distributed/models/moe/ — moe_layer.py
+(MoELayer: all-to-all scatter/gather of tokens to experts),
+gate/{gshard_gate,switch_gate,naive_gate}.py, grad_clip.py; plus
+fleet's expert-parallel group plumbing (SURVEY §2.7 EP).
+
+TPU-native redesign (GShard-style dense dispatch instead of the
+reference's index-based scatter + NCCL all-to-all):
+
+- gating produces a **dispatch mask** [tokens, E, capacity] and
+  combine weights; token routing becomes two einsums — XLA turns the
+  expert-sharded einsum into the all-to-all the reference hand-codes
+  (`moe_layer.py MoEScatter/MoEGather` + global_scatter/global_gather
+  collectives).
+- experts are **stacked**: one parameter holding all E experts with
+  dim 0 sharded over the ``ep`` mesh axis (attribute ``ep_axis=0``),
+  so each device holds E/ep experts — the same memory partition the
+  reference achieves with per-rank expert instances.
+- capacity_factor bounds per-expert tokens; overflow tokens drop
+  combine weight to 0 (gshard semantics).
+- the load-balancing auxiliary loss (gshard_gate) is stored on the
+  layer as ``l_aux`` for the trainer to add.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....base import random as _random
+from ....base.tape import apply
+from ....base.tensor import Tensor
+from ....nn.layer.layers import Layer
+
+__all__ = ["ExpertMLP", "TopKGate", "MoELayer"]
+
+
+class ExpertMLP(Layer):
+    """E stacked feed-forward experts: w1 [E, H, F], w2 [E, F, H].
+
+    dim 0 carries ``ep_axis`` metadata so hybrid placement shards the
+    expert dimension over the ``ep`` mesh axis.
+    """
+
+    def __init__(self, num_experts: int, d_model: int, d_hidden: int,
+                 activation: str = "gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        scale1 = 1.0 / math.sqrt(d_model)
+        scale2 = 1.0 / math.sqrt(d_hidden)
+        key = _random.next_key()
+        k1, k2 = jax.random.split(key)
+        self.w1 = self.create_parameter(
+            [num_experts, d_model, d_hidden],
+            default_initializer=lambda s, d: jax.random.uniform(
+                k1, s, d, -scale1, scale1
+            ),
+        )
+        self.w2 = self.create_parameter(
+            [num_experts, d_hidden, d_model],
+            default_initializer=lambda s, d: jax.random.uniform(
+                k2, s, d, -scale2, scale2
+            ),
+        )
+        self.w1.ep_axis = 0
+        self.w2.ep_axis = 0
+        self.activation = activation
+
+    def forward(self, x):
+        """x: [E, C, H] → [E, C, H] (per-expert batched)."""
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[self.activation]
+
+        def f(xe, w1, w2):
+            h = act(jnp.einsum("ech,ehf->ecf", xe, w1))
+            return jnp.einsum("ecf,efh->ech", h, w2)
+
+        return apply(f, x, self.w1, self.w2, op_name="expert_mlp")
+
+
+class TopKGate(Layer):
+    """Top-k softmax gate with gshard load-balance loss
+    (ref: gate/gshard_gate.py, gate/naive_gate.py)."""
+
+    def __init__(self, d_model: int, num_experts: int, top_k: int = 2,
+                 capacity_factor: float = 1.25):
+        super().__init__()
+        if top_k not in (1, 2):
+            raise ValueError("top_k must be 1 or 2 (gshard/switch gating)")
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        scale = 1.0 / math.sqrt(d_model)
+        key = _random.next_key()
+        self.weight = self.create_parameter(
+            [d_model, num_experts],
+            default_initializer=lambda s, d: jax.random.uniform(
+                key, s, d, -scale, scale
+            ),
+        )
+
+    def capacity(self, num_tokens: int) -> int:
+        return max(
+            self.top_k,
+            int(math.ceil(num_tokens / self.num_experts * self.capacity_factor)),
+        )
+
+    def forward(self, x):
+        """x: [N, H] → (dispatch [N,E,C] bool-ish, combine [N,E,C],
+        l_aux scalar)."""
+        cap = self.capacity(int(x.shape[0]))
+        e = self.num_experts
+        top_k = self.top_k
+
+        def f(tokens, wg):
+            logits = tokens @ wg  # [N, E]
+            gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            n = tokens.shape[0]
+
+            # top-1 expert
+            idx1 = jnp.argmax(gates, axis=-1)  # [N]
+            mask1 = jax.nn.one_hot(idx1, e, dtype=gates.dtype)  # [N, E]
+
+            # gshard aux loss: E * sum_e mean(gates_e) * mean(mask1_e)
+            me = jnp.mean(gates, axis=0)
+            ce = jnp.mean(mask1, axis=0)
+            l_aux = jnp.sum(me * ce) * e
+
+            if top_k == 2:
+                gates2 = jnp.where(mask1 > 0, -jnp.inf, gates)
+                idx2 = jnp.argmax(gates2, axis=-1)
+                mask2 = jax.nn.one_hot(idx2, e, dtype=gates.dtype)
+            else:
+                mask2 = jnp.zeros_like(mask1)
+
+            # position of each token within its expert's capacity
+            pos1 = jnp.cumsum(mask1, axis=0) - mask1  # [N, E]
+            within1 = pos1 < cap
+            mask1 = mask1 * within1
+            pos2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0)
+            within2 = pos2 < cap
+            mask2 = mask2 * within2
+
+            g1 = jnp.sum(gates * mask1, axis=-1)  # [N]
+            g2 = jnp.sum(gates * mask2, axis=-1)
+            denom = g1 + g2
+            denom = jnp.where(denom > 0, denom, 1.0)
+            g1, g2 = g1 / denom, g2 / denom
+
+            loc1 = jnp.sum(pos1 * mask1, axis=-1).astype(jnp.int32)  # [N]
+            loc2 = jnp.sum(pos2 * mask2, axis=-1).astype(jnp.int32)
+            cap1 = jax.nn.one_hot(loc1, cap, dtype=gates.dtype)  # [N, C]
+            cap2 = jax.nn.one_hot(loc2, cap, dtype=gates.dtype)
+
+            combine = (
+                g1[:, None, None] * mask1[:, :, None] * cap1[:, None, :]
+                + g2[:, None, None] * mask2[:, :, None] * cap2[:, None, :]
+            )  # [N, E, C]
+            dispatch = (combine > 0).astype(tokens.dtype)
+            return dispatch, combine.astype(tokens.dtype), l_aux
+
+        return apply(f, x, self.weight, op_name="moe_gate")
+
+
+class MoELayer(Layer):
+    """ref: incubate moe_layer.py MoELayer — drop-in FFN replacement.
+
+    forward: [B, S, H] → [B, S, H]; sets ``self.l_aux`` each call.
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 gate: Optional[TopKGate] = None,
+                 experts: Optional[Layer] = None,
+                 activation: str = "gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.gate = gate or TopKGate(d_model, num_experts, top_k, capacity_factor)
+        self.experts = experts or ExpertMLP(num_experts, d_model, d_hidden, activation)
+        self.l_aux = None
+
+    def forward(self, x):
+        b, s, h = x.shape
+        from ....tensor.manipulation import reshape
+
+        tokens = reshape(x, [b * s, h])
+        dispatch, combine, l_aux = self.gate(tokens)
+        self.l_aux = l_aux
+
+        def route_in(t, d):
+            # [N,H],[N,E,C] → [E,C,H]; expert-sharded out → all-to-all
+            return jnp.einsum("nh,nec->ech", t, d)
+
+        expert_in = apply(route_in, tokens, dispatch, op_name="moe_dispatch")
+        expert_out = self.experts(expert_in)  # [E, C, H]
+
+        def route_out(eo, c):
+            return jnp.einsum("ech,nec->nh", eo, c)
+
+        out = apply(route_out, expert_out, combine, op_name="moe_combine")
+        return reshape(out, [b, s, h])
+
+
+def place_experts_on_mesh(layer: Layer, mesh, ep_axis: str = "ep"):
+    """Shard every ``ep_axis``-annotated parameter dim over the expert
+    mesh axis (the EP partition; ref: moe expert-parallel groups)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    size = dict(mesh.shape)[ep_axis]
+    for p in layer.parameters():
+        ax = getattr(p, "ep_axis", None)
+        if ax is not None and p._data.shape[ax] % size == 0:
+            spec = [None] * len(p._data.shape)
+            spec[ax] = ep_axis
+            p._data = jax.device_put(
+                p._data, NamedSharding(mesh, PartitionSpec(*spec))
+            )

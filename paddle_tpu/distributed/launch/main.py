@@ -47,6 +47,8 @@ class Container:
 
     def start(self):
         os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        if getattr(self, "_log", None) is not None:
+            self._log.close()
         self._log = open(self.log_path, "ab")
         self.proc = subprocess.Popen(
             self.cmd, env=self.env, stdout=self._log, stderr=subprocess.STDOUT
@@ -62,6 +64,9 @@ class Container:
                 self.proc.wait(10)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
+        if getattr(self, "_log", None) is not None:
+            self._log.close()
+            self._log = None
 
 
 def _build_env(args, local_rank: int) -> dict:

@@ -116,7 +116,9 @@ def group_sharded_parallel(
     mesh, axis = _sharding_mesh_axis(group)
 
     # stage 1: shard optimizer state (all levels include it)
-    optimizer._accum_placement_fn = lambda arr: _place(arr, mesh, axis)
+    optimizer._accum_placement_fn = (
+        lambda arr, param=None, name=None: _place(arr, mesh, axis)
+    )
     for store in optimizer._accumulators.values():
         for key in store:
             store[key] = _place(store[key], mesh, axis)

@@ -31,7 +31,7 @@ class Parameter(Tensor):
 
     __slots__ = (
         "optimize_attr", "regularizer", "do_model_average", "need_clip",
-        "is_distributed", "tp_axis", "no_weight_decay",
+        "is_distributed", "tp_axis", "ep_axis", "no_weight_decay",
     )
 
     def __init__(self, data, trainable=True, name=None, **kw):
@@ -42,6 +42,7 @@ class Parameter(Tensor):
         self.need_clip = kw.get("need_clip", True)
         self.is_distributed = False
         self.tp_axis = None  # TP sharding hint consumed by distributed wrappers
+        self.ep_axis = None  # expert-parallel sharding hint (MoE stacks)
         self.no_weight_decay = False
 
     @property

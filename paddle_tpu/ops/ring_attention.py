@@ -1,0 +1,134 @@
+"""Ring attention — sequence/context-parallel attention over a mesh axis.
+
+ref: the reference's SEP (sequence-expert-parallel) context parallelism
+(SURVEY §2.7, §5.7: fleet sep utilities + the RingFlashAttention used
+by PaddleNLP long-context training). The reference moves K/V around an
+NCCL ring with explicit send/recv; here the ring is ``lax.ppermute``
+over a named mesh axis inside ``shard_map``, so the schedule is visible
+to the XLA latency-hiding scheduler (compute of chunk i overlaps the
+permute bringing chunk i+1).
+
+Math: per-device q block attends to every kv block as it passes by;
+blocks merge with the streaming log-sum-exp recurrence (same as flash
+attention's inter-block merge):
+
+    m' = max(m, lse_i);  l' = l·e^{m-m'} + e^{lse_i-m'}
+    acc' = acc·e^{m-m'} + out_i·e^{lse_i-m'}
+
+Causal uses the block-triangular schedule: ring step t brings the kv
+block of rank (r - t) mod P — skip if it is ahead of our q block,
+full-attend if behind, diagonal-mask if equal.
+
+Everything is jnp + lax (differentiable through ppermute/scan); on TPU
+the within-block math hits the MXU and XLA fuses the merge.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ring_attention", "sep_parallel_attention"]
+
+_NEG = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Sequence-sharded attention; call inside shard_map/pjit over a
+    mesh with ``axis_name``. q/k/v: [B, S_local, H, D] (paddle layout).
+    Returns [B, S_local, H, D]."""
+    p_size = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    q_off = rank * s_local
+
+    # carry (m, l, acc) in the "unnormalized" space: per block,
+    # out_t = sum_k exp(s - m_t)·v and l_t = sum_k exp(s - m_t). Merge:
+    #   m' = max(m, m_t); acc' = acc·e^{m-m'} + out_t·e^{m_t-m'}
+    #   l'  = l·e^{m-m'} + l_t·e^{m_t-m'}
+    def block(q, k_t, v_t, src_rank):
+        kv_off = src_rank * s_local
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k_t, 1, 2)
+        vh = jnp.swapaxes(v_t, 1, 2)
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32
+        ) * sc
+        if causal:
+            q_abs = q_off + jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 0)
+            k_abs = kv_off + jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 1)
+            s = jnp.where(q_abs >= k_abs, s, _NEG)
+        m_t = jnp.max(s, axis=-1)  # [B, H, Sq]
+        p = jnp.exp(s - m_t[..., None])
+        if causal:
+            p = jnp.where(s <= _NEG / 2, 0.0, p)
+        l_t = jnp.sum(p, axis=-1)
+        out_t = jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vh.astype(jnp.float32)
+        )
+        return out_t, m_t, l_t
+
+    def scan_step(carry, t):
+        k_t, v_t, m, l, acc = carry
+        src_rank = (rank - t) % p_size
+        out_t, m_t, l_t = block(q, k_t, v_t, src_rank)
+        if causal:
+            live = (src_rank <= rank).astype(jnp.float32)
+            l_t = l_t * live
+            out_t = out_t * live
+            m_t = jnp.where(live > 0, m_t, _NEG)
+
+        m_new = jnp.maximum(m, m_t)
+        a = jnp.where(m > _NEG / 2, jnp.exp(m - m_new), 0.0)
+        b_ = jnp.where(m_t > _NEG / 2, jnp.exp(m_t - m_new), 0.0)
+        l = l * a + l_t * b_
+        acc = acc * a[..., None] + out_t * b_[..., None]
+
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        k_t = jax.lax.ppermute(k_t, axis_name, perm)
+        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        return (k_t, v_t, m_new, l, acc), None
+
+    # initial carries must be marked device-varying for shard_map's scan
+    m0 = jax.lax.pvary(jnp.full((b, h, s_local), _NEG, jnp.float32), (axis_name,))
+    l0 = jax.lax.pvary(jnp.zeros((b, h, s_local), jnp.float32), (axis_name,))
+    acc0 = jax.lax.pvary(jnp.zeros((b, h, s_local, d), jnp.float32), (axis_name,))
+    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
+        scan_step, (k, v, m0, l0, acc0), jnp.arange(p_size)
+    )
+    safe_l = jnp.where(l > 0, l, 1.0)
+    out = acc / safe_l[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, S_local, H, D]
+
+
+def sep_parallel_attention(q, k, v, mesh, axis_name: str = "sep",
+                           causal: bool = False,
+                           scale: Optional[float] = None):
+    """User entry: q/k/v are GLOBAL [B, S, H, D] Tensors/arrays; shards
+    the sequence over ``axis_name`` of ``mesh``, runs ring attention,
+    returns the global result (ref: the sep_parallel attention path in
+    fleet meta_parallel)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..base.tape import apply
+
+    spec = P(None, axis_name, None, None)
+
+    def f(qq, kk, vv):
+        fn = shard_map(
+            partial(ring_attention, axis_name=axis_name, causal=causal,
+                    scale=scale),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+        return fn(qq, kk, vv)
+
+    return apply(f, q, k, v, op_name="sep_parallel_attention")

@@ -77,9 +77,11 @@ class Optimizer:
         # value of every accumulator created during that step so a
         # skipped step can roll them back traceably
         self._accum_creation_log = None
-        # placement hooks installed by distributed.sharding (stage 1/2/3):
-        # accum hook shards new optimizer state over the sharding axis,
-        # grad hook constrains gradient layout (stage-2 reduce-scatter)
+        # placement hooks installed by distributed.sharding (stage 1/2/3)
+        # and auto_parallel.shard_optimizer: the accum hook
+        # fn(array, param, accum_name) places new optimizer state
+        # (including master weights); the grad hook constrains gradient
+        # layout (stage-2 reduce-scatter)
         self._accum_placement_fn = None
         self._grad_placement_fn = None
         self._global_step = 0
@@ -134,7 +136,7 @@ class Optimizer:
             else:
                 store[key] = init
             if self._accum_placement_fn is not None:
-                store[key] = self._accum_placement_fn(store[key])
+                store[key] = self._accum_placement_fn(store[key], param, name)
             if self._accum_creation_log is not None:
                 self._accum_creation_log[(name, key)] = store[key]
         return store[key]
@@ -155,7 +157,9 @@ class Optimizer:
         if param.name not in store:
             store[param.name] = param._data.astype(jnp.float32)
             if self._accum_placement_fn is not None:
-                store[param.name] = self._accum_placement_fn(store[param.name])
+                store[param.name] = self._accum_placement_fn(
+                    store[param.name], param, "master_weight"
+                )
             if self._accum_creation_log is not None:
                 self._accum_creation_log[("master_weight", param.name)] = store[param.name]
         return store[param.name]
