@@ -50,12 +50,15 @@ def main():
     on_tpu = dev.platform == "tpu"
 
     if on_tpu:
+        # 542M-param Llama at seq 2048: large enough to be MXU-bound
+        # (v5e measures ~0.74 MFU), small enough to fit params + fp32
+        # master/moments in one chip's HBM
         config = LlamaConfig(
-            vocab_size=8192, hidden_size=512, intermediate_size=1408,
-            num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=8,
-            max_position_embeddings=1024,
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=16,
+            max_position_embeddings=2048,
         )
-        batch, seq, steps, warmup = 8, 512, 512, 3
+        batch, seq, steps, warmup = 4, 2048, 132, 2
     else:  # CPU fallback so the bench is runnable anywhere
         config = LlamaConfig.tiny()
         batch, seq, steps, warmup = 2, 64, 3, 1
@@ -96,7 +99,7 @@ def main():
     # fetch the result to force execution, and difference two run
     # lengths so the constant dispatch+fetch round-trip cancels:
     #   per_step = (T(K2) - T(K1)) / (K2 - K1)
-    k1, k2 = (32, steps) if on_tpu else (1, steps)
+    k1, k2 = (4, steps) if on_tpu else (1, steps)
     # warm/compile both scan lengths outside the timed region
     np.asarray(compiled.multi_step(ids, labels, steps=k1)._data)
     np.asarray(compiled.multi_step(ids, labels, steps=k2)._data)

@@ -79,6 +79,11 @@ from . import sparse  # noqa: F401
 from . import device  # noqa: F401
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
+from . import signal  # noqa: F401
+from . import static  # noqa: F401
+from . import quantization  # noqa: F401
+from . import geometric  # noqa: F401
+from . import audio  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 
 

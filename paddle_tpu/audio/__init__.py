@@ -1,0 +1,14 @@
+"""paddle_tpu.audio — audio feature extraction.
+
+ref: python/paddle/audio/ — functional/functional.py (hz_to_mel,
+mel_to_hz, mel_frequencies, fft_frequencies, compute_fbank_matrix,
+power_to_db, create_dct), features/layers.py (Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC). Backends (file IO) are
+omitted — no soundfile in this environment; features compute from
+waveform Tensors via paddle_tpu.signal.stft.
+"""
+from . import functional  # noqa: F401
+from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

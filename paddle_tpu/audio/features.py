@@ -1,0 +1,124 @@
+"""Audio feature layers (ref: python/paddle/audio/features/layers.py —
+Spectrogram :33, MelSpectrogram :123, LogMelSpectrogram :244,
+MFCC :347). Window tensors and filterbanks are precomputed buffers;
+compute runs through signal.stft, so features are jit-able and
+differentiable (for e.g. vocoder losses).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _window(name: str, n: int) -> np.ndarray:
+    if name in ("hann", "hanning"):
+        return np.hanning(n).astype(np.float32)
+    if name in ("hamming",):
+        return np.hamming(n).astype(np.float32)
+    if name in ("blackman",):
+        return np.blackman(n).astype(np.float32)
+    if name in ("rect", "rectangular", "boxcar", "ones"):
+        return np.ones(n, np.float32)
+    raise ValueError(f"unsupported window {name!r}")
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "window", Tensor(jnp.asarray(_window(window, self.win_length)), _internal=True)
+        )
+
+    def forward(self, x):
+        from .. import signal
+
+        spec = signal.stft(
+            x, n_fft=self.n_fft, hop_length=self.hop_length,
+            win_length=self.win_length, window=self.window,
+            center=self.center, pad_mode=self.pad_mode,
+        )
+        mag = (spec.real() ** 2 + spec.imag() ** 2)
+        if self.power == 2.0:
+            return mag
+        return mag ** (self.power / 2.0)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(
+            n_fft, hop_length, win_length, window, power, center, pad_mode
+        )
+        fbank = AF.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm,
+        )
+        self.register_buffer("fbank", Tensor(jnp.asarray(fbank), _internal=True))
+
+    def forward(self, x):
+        from .. import matmul
+
+        spec = self._spectrogram(x)  # [..., freq, time]
+        return matmul(self.fbank, spec)  # [..., n_mels, time]
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 **mel_kwargs):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(sr=sr, **mel_kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_mels: int = 64,
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, **mel_kwargs):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr=sr, ref_value=ref_value, amin=amin, top_db=top_db,
+            n_mels=n_mels, **mel_kwargs,
+        )
+        dct = AF.create_dct(n_mfcc, n_mels)
+        self.register_buffer("dct", Tensor(jnp.asarray(dct), _internal=True))
+
+    def forward(self, x):
+        from .. import matmul
+        from ..tensor.manipulation import transpose
+
+        logmel = self._log_melspectrogram(x)  # [..., n_mels, time]
+        ndim = len(logmel.shape)
+        perm = list(range(ndim - 2)) + [ndim - 1, ndim - 2]
+        swapped = transpose(logmel, perm)  # [..., time, n_mels]
+        out = matmul(swapped, self.dct)  # [..., time, n_mfcc]
+        return transpose(out, perm)  # [..., n_mfcc, time]

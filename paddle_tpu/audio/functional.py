@@ -1,0 +1,116 @@
+"""Audio functional ops (ref: python/paddle/audio/functional/
+functional.py — same htk/slaney conventions)."""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from ..base.tensor import Tensor
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "power_to_db", "create_dct",
+]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """ref: functional.py hz_to_mel."""
+    scalar = not isinstance(freq, (Tensor, np.ndarray))
+    f = np.asarray(freq.numpy() if isinstance(freq, Tensor) else freq, np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(
+            f >= min_log_hz, min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep, mel
+        )
+    return float(mel) if scalar else mel.astype(np.float32)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    scalar = not isinstance(mel, (Tensor, np.ndarray))
+    m = np.asarray(mel.numpy() if isinstance(mel, Tensor) else mel, np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
+    return float(hz) if scalar else hz.astype(np.float32)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype="float32"):
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    mels = np.linspace(low, high, n_mels)
+    return mel_to_hz(mels, htk).astype(dtype)
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype="float32"):
+    return np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney",
+                         dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]
+    (ref: functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft, np.float64)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk, np.float64)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2 : n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        weights /= np.maximum(
+            np.linalg.norm(weights, ord=norm, axis=-1, keepdims=True), 1e-10
+        )
+    return weights.astype(dtype)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0, name=None):
+    """10·log10 with floor + dynamic-range clip (ref: power_to_db)."""
+
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(jnp.asarray(ref_value), amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+
+    return apply(f, spect, op_name="power_to_db")
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (ref: create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return dct.astype(dtype)

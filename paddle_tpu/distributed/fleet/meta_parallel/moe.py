@@ -212,9 +212,16 @@ def place_experts_on_mesh(layer: Layer, mesh, ep_axis: str = "ep"):
     size = dict(mesh.shape)[ep_axis]
     for p in layer.parameters():
         ax = getattr(p, "ep_axis", None)
-        if ax is not None and p._data.shape[ax] % size == 0:
-            spec = [None] * len(p._data.shape)
-            spec[ax] = ep_axis
-            p._data = jax.device_put(
-                p._data, NamedSharding(mesh, PartitionSpec(*spec))
+        if ax is None:
+            continue
+        if p._data.shape[ax] % size != 0:
+            raise ValueError(
+                f"expert dim of parameter {p.name} ({p._data.shape[ax]}) "
+                f"is not divisible by the '{ep_axis}' mesh axis size "
+                f"{size}; choose num_experts divisible by the EP degree"
             )
+        spec = [None] * len(p._data.shape)
+        spec[ax] = ep_axis
+        p._data = jax.device_put(
+            p._data, NamedSharding(mesh, PartitionSpec(*spec))
+        )

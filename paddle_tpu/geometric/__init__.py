@@ -1,0 +1,127 @@
+"""paddle_tpu.geometric — graph message-passing primitives.
+
+ref: python/paddle/geometric/ — message_passing/send_recv.py
+(send_u_recv :33, send_ue_recv :142, send_uv :312), math.py
+(segment_sum/mean/min/max), sampling/.
+
+TPU-native: gather/segment-reduce lower to jax.ops.segment_sum-style
+primitives with a **static** ``out_size`` (pass it for jit; defaults to
+the data-dependent max+1 eagerly, matching the reference's dynamic
+shape behavior in dygraph).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from ..base.tensor import Tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "send_u_recv", "send_ue_recv", "send_uv",
+]
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    arr = np.asarray(jax.device_get(ids._data if isinstance(ids, Tensor) else ids))
+    return int(arr.max()) + 1 if arr.size else 0
+
+
+def _segment(op_name, reducer_fill):
+    jax_op = {
+        "sum": jax.ops.segment_sum,
+        "min": jax.ops.segment_min,
+        "max": jax.ops.segment_max,
+    }
+
+    def op(data, segment_ids, name=None, out_size=None):
+        n = _num_segments(segment_ids, out_size)
+
+        def f(d, ids):
+            if op_name == "mean":
+                s = jax.ops.segment_sum(d, ids, num_segments=n)
+                cnt = jax.ops.segment_sum(jnp.ones_like(ids, d.dtype), ids,
+                                          num_segments=n)
+                shape = (n,) + (1,) * (d.ndim - 1)
+                return s / jnp.maximum(cnt.reshape(shape), 1)
+            out = jax_op[op_name](d, ids, num_segments=n)
+            if reducer_fill is not None:
+                # empty segments: the reference yields 0, jax yields ±inf
+                cnt = jax.ops.segment_sum(jnp.ones_like(ids, jnp.int32), ids,
+                                          num_segments=n)
+                shape = (n,) + (1,) * (d.ndim - 1)
+                out = jnp.where(cnt.reshape(shape) > 0, out, 0)
+            return out
+
+        return apply(f, data, segment_ids, op_name=f"segment_{op_name}")
+
+    return op
+
+
+segment_sum = _segment("sum", None)
+segment_mean = _segment("mean", None)
+segment_min = _segment("min", 0)
+segment_max = _segment("max", 0)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None, name=None):
+    """Gather x at src, reduce onto dst (ref: send_recv.py:33)."""
+    reduce_op = reduce_op.lower()
+    seg = {"sum": segment_sum, "mean": segment_mean,
+           "min": segment_min, "max": segment_max}[reduce_op]
+    n = out_size if out_size is not None else int(x.shape[0])
+
+    def gather(a, idx):
+        return a[idx]
+
+    msgs = apply(gather, x, src_index, op_name="gather")
+    return seg(msgs, dst_index, out_size=n)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None,
+                 name=None):
+    """Gather x at src, combine with edge feature y, reduce onto dst
+    (ref: send_recv.py:142)."""
+    ops = {
+        "add": lambda a, b: a + b,
+        "sub": lambda a, b: a - b,
+        "mul": lambda a, b: a * b,
+        "div": lambda a, b: a / b,
+    }
+    combine = ops[message_op.lower()]
+    n = out_size if out_size is not None else int(x.shape[0])
+
+    def f(a, e, idx):
+        m = a[idx]
+        if e.ndim < m.ndim:
+            e = e.reshape(e.shape + (1,) * (m.ndim - e.ndim))
+        return combine(m, e)
+
+    msgs = apply(f, x, y, src_index, op_name="send_ue")
+    seg = {"sum": segment_sum, "mean": segment_mean,
+           "min": segment_min, "max": segment_max}[reduce_op.lower()]
+    return seg(msgs, dst_index, out_size=n)
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    """Per-edge message from both endpoints (ref: send_recv.py:312)."""
+    ops = {
+        "add": lambda a, b: a + b,
+        "sub": lambda a, b: a - b,
+        "mul": lambda a, b: a * b,
+        "div": lambda a, b: a / b,
+    }
+    combine = ops[message_op.lower()]
+
+    def f(a, b, si, di):
+        return combine(a[si], b[di])
+
+    return apply(f, x, y, src_index, dst_index, op_name="send_uv")

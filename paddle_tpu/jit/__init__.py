@@ -394,12 +394,25 @@ def save(layer, path, input_spec=None, **config):
             from ..base import dtype as _dt
 
             specs.append(jax.ShapeDtypeStruct(tuple(shape), _dt.canonical_dtype(dtype)))
-        exp = jax_export.export(jax.jit(pure_forward))(
-            [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in param_arrays],
-            [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in buffer_arrays],
-            *specs,
-        )
-        exported_bytes = exp.serialize()
+        was_training = layer.training
+        try:
+            exp = jax_export.export(jax.jit(pure_forward))(
+                [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in param_arrays],
+                [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in buffer_arrays],
+                *specs,
+            )
+            exported_bytes = exp.serialize()
+        finally:
+            # export tracing rebinds p._data to tracers and flips the
+            # layer to eval; restore both so the live layer keeps working
+            for (_, p), a in zip(layer.named_parameters(), param_arrays):
+                p._data = a
+                p._grad_node = None
+                p._consumer_nodes = []
+            for (_, b), a in zip(layer.named_buffers(), buffer_arrays):
+                b._data = a
+            if was_training:
+                layer.train()
 
     meta = {
         "format": "paddle_tpu.jit.v1",

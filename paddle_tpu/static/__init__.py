@@ -1,0 +1,111 @@
+"""paddle_tpu.static — static-graph compatibility surface.
+
+ref: python/paddle/static/ (25k LoC: Program/Executor/data feeding,
+save/load_inference_model, static nn). In the reference this is a whole
+second execution engine; here the jaxpr IS the program, so the static
+API collapses to:
+
+- ``InputSpec`` — the shape/dtype declaration used by jit.save export
+  and to_static input signatures (the genuinely load-bearing piece).
+- ``save/load_inference_model`` — thin wrappers over jit.save/load.
+- mode toggles (enable/disable_static) re-exported for parity; the
+  framework is always "dynamic with compilation", so enable_static only
+  flips the flag the reference APIs consult.
+
+Everything else (Program, Executor, feed/fetch) intentionally raises a
+guidance error pointing at the jit path rather than silently
+pretending to build graphs.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model",
+           "Program", "Executor", "default_main_program"]
+
+
+class InputSpec:
+    """Shape/dtype/name declaration (ref: python/paddle/static/
+    input.py:38 InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        from ..base.dtype import canonical_dtype
+
+        self.dtype = canonical_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), tensor.dtype, name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def batch(self, batch_size: int):
+        self.shape = (int(batch_size),) + tuple(self.shape)
+        return self
+
+    def unbatch(self):
+        if not self.shape:
+            raise ValueError("cannot unbatch a 0-d InputSpec")
+        self.shape = tuple(self.shape[1:])
+        return self
+
+    def __repr__(self):
+        return (
+            f"InputSpec(shape={list(self.shape)}, dtype={self.dtype}, "
+            f"name={self.name})"
+        )
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, InputSpec)
+            and self.shape == other.shape
+            and np.dtype(self.dtype) == np.dtype(other.dtype)
+            and self.name == other.name
+        )
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """ref: static/io.py save_inference_model → jit.save with the
+    layer found on fetch_vars (the dygraph idiom this build supports)."""
+    raise NotImplementedError(
+        "static-graph save_inference_model is subsumed by paddle_tpu.jit."
+        "save(layer, path, input_spec=[InputSpec(...)]) — the jaxpr is "
+        "the inference program"
+    )
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    from .. import jit
+
+    return jit.load(path_prefix)
+
+
+class _StaticStub:
+    _msg = (
+        "the Program/Executor machinery has no TPU counterpart: code under "
+        "jit.to_static is traced to a jaxpr and compiled by XLA. Port "
+        "static-graph code to the dygraph API + paddle_tpu.jit."
+    )
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(self._msg)
+
+
+class Program(_StaticStub):
+    """ref: static Program — intentionally unsupported (see _StaticStub)."""
+
+
+class Executor(_StaticStub):
+    """ref: static Executor — intentionally unsupported (see _StaticStub)."""
+
+
+def default_main_program():
+    raise NotImplementedError(_StaticStub._msg)

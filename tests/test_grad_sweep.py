@@ -1,0 +1,168 @@
+"""Parametrized numeric-gradient sweep across the op surface.
+
+ref pattern: test/legacy_test/op_test.py:418 check_grad +
+get_numeric_gradient — every listed op's tape gradient is checked
+against central finite differences, plus bf16 dtype coverage and the
+TPU matmul HIGHEST-precision path (tensor/linalg.py), and error-path
+checks (backward twice, allow_unused, non-scalar backward).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.base.tensor import Tensor
+
+
+def numeric_grad(fn, x_np, eps=1e-3):
+    g = np.zeros_like(x_np, dtype=np.float64)
+    flat = x_np.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        f1 = float(fn(Tensor(x_np.copy().astype(np.float32))).numpy())
+        flat[i] = orig - eps
+        f0 = float(fn(Tensor(x_np.copy().astype(np.float32))).numpy())
+        flat[i] = orig
+        gf[i] = (f1 - f0) / (2 * eps)
+    return g
+
+
+def check_grad(op, x_np, rtol=1e-2, atol=1e-3):
+    x = Tensor(x_np.copy().astype(np.float32), stop_gradient=False, _internal=True)
+    loss = op(x).sum()
+    loss.backward()
+    analytic = np.asarray(x.grad.numpy(), np.float64)
+    numeric = numeric_grad(lambda t: op(t).sum(), x_np.astype(np.float64))
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+_POSITIVE = np.abs(np.random.RandomState(7).randn(3, 4)) + 0.5
+_GENERIC = np.random.RandomState(7).randn(3, 4)
+# for ops with kinks at 0 (relu-family, where, abs): keep finite
+# differences away from the non-differentiable point
+_OFF_ZERO = np.sign(_GENERIC) * (np.abs(_GENERIC) + 0.3)
+
+# (name, op, input) — ops taking a single differentiable input
+_SWEEP = [
+    ("exp", lambda x: paddle.exp(x), _GENERIC),
+    ("log", lambda x: paddle.log(x), _POSITIVE),
+    ("sqrt", lambda x: paddle.sqrt(x), _POSITIVE),
+    ("rsqrt", lambda x: paddle.rsqrt(x), _POSITIVE),
+    ("tanh", lambda x: paddle.tanh(x), _GENERIC),
+    ("sigmoid", lambda x: F.sigmoid(x), _GENERIC),
+    ("sin", lambda x: paddle.sin(x), _GENERIC),
+    ("cos", lambda x: paddle.cos(x), _GENERIC),
+    ("abs", lambda x: paddle.abs(x), _POSITIVE),
+    ("square", lambda x: paddle.square(x), _GENERIC),
+    ("pow", lambda x: paddle.pow(x, 3), _GENERIC),
+    ("reciprocal", lambda x: paddle.reciprocal(x), _POSITIVE),
+    ("mean", lambda x: paddle.mean(x), _GENERIC),
+    ("sum_axis", lambda x: paddle.sum(x, axis=1), _GENERIC),
+    ("max", lambda x: paddle.max(x, axis=1), _GENERIC),
+    ("min", lambda x: paddle.min(x, axis=0), _GENERIC),
+    ("prod", lambda x: paddle.prod(x, axis=1), _POSITIVE),
+    ("logsumexp", lambda x: paddle.logsumexp(x, axis=1), _GENERIC),
+    ("softmax", lambda x: F.softmax(x, axis=-1), _GENERIC),
+    ("log_softmax", lambda x: F.log_softmax(x, axis=-1), _GENERIC),
+    ("relu", lambda x: F.relu(x), _POSITIVE),
+    ("gelu", lambda x: F.gelu(x), _GENERIC),
+    ("silu", lambda x: F.silu(x), _GENERIC),
+    ("elu", lambda x: F.elu(x), _GENERIC),
+    ("softplus", lambda x: F.softplus(x), _GENERIC),
+    ("hardswish", lambda x: F.hardswish(x), _OFF_ZERO),
+    ("leaky_relu", lambda x: F.leaky_relu(x), _OFF_ZERO),
+    ("mish", lambda x: F.mish(x), _GENERIC),
+    ("reshape", lambda x: x.reshape([4, 3]) * x.reshape([4, 3]), _GENERIC),
+    ("transpose", lambda x: paddle.transpose(x, [1, 0]).sum(axis=0), _GENERIC),
+    ("concat", lambda x: paddle.concat([x, x * 2], axis=0), _GENERIC),
+    ("split", lambda x: paddle.split(x, 2, axis=1)[0], _GENERIC),
+    ("slice", lambda x: x[1:, :2] * 3, _GENERIC),
+    ("pad", lambda x: F.pad(x, [1, 1, 1, 1]), _GENERIC),
+    ("clip", lambda x: paddle.clip(x, -0.5, 0.5), _GENERIC),
+    ("cumsum", lambda x: paddle.cumsum(x, axis=1), _GENERIC),
+    ("matmul", lambda x: paddle.matmul(x, paddle.to_tensor(_GENERIC.T.astype(np.float32))), _GENERIC),
+    ("norm", lambda x: paddle.linalg.norm(x), _GENERIC),
+    ("einsum", lambda x: paddle.einsum("ij,kj->ik", x, x), _GENERIC),
+    ("layer_norm", lambda x: F.layer_norm(x, (4,)), _GENERIC),
+    ("stack", lambda x: paddle.stack([x, x], axis=0), _GENERIC),
+    ("where", lambda x: paddle.where(x > 0, x * 2, x * 3), _OFF_ZERO),
+    ("tile", lambda x: paddle.tile(x, [2, 1]), _GENERIC),
+    ("squeeze_unsqueeze", lambda x: paddle.unsqueeze(x, 0).squeeze(0) * x, _GENERIC),
+    ("gather", lambda x: paddle.gather(x, paddle.to_tensor([0, 2])), _GENERIC),
+    ("expm1", lambda x: paddle.expm1(x), _GENERIC),
+    ("log1p", lambda x: paddle.log1p(x), _POSITIVE),
+    ("atan", lambda x: paddle.atan(x), _GENERIC),
+    ("asinh", lambda x: paddle.asinh(x), _GENERIC),
+    ("erf", lambda x: paddle.erf(x), _GENERIC),
+]
+
+
+@pytest.mark.parametrize("name,op,data", _SWEEP, ids=[s[0] for s in _SWEEP])
+def test_numeric_grad(name, op, data):
+    check_grad(op, data)
+
+
+class TestDtypePaths:
+    def test_bf16_matmul_grad_flows(self):
+        x = paddle.to_tensor(_GENERIC.astype(np.float32)).astype("bfloat16")
+        x.stop_gradient = False
+        w = paddle.to_tensor(_GENERIC.T.astype(np.float32)).astype("bfloat16")
+        w.stop_gradient = False
+        loss = paddle.matmul(x, w).astype("float32").sum()
+        loss.backward()
+        assert x.grad.dtype == "bfloat16" and w.grad.dtype == "bfloat16"
+        # parity vs f32 computation at bf16 tolerance
+        xf = paddle.to_tensor(_GENERIC.astype(np.float32))
+        xf.stop_gradient = False
+        wf = paddle.to_tensor(_GENERIC.T.astype(np.float32))
+        paddle.matmul(xf, wf).sum().backward()
+        np.testing.assert_allclose(
+            x.grad.astype("float32").numpy(), xf.grad.numpy(), rtol=0.05, atol=0.05
+        )
+
+    def test_matmul_f32_uses_highest_precision(self):
+        """tensor/linalg.py forces HIGHEST for f32 on TPU; on CPU the
+        result must equal the numpy product to f32 accuracy (would fail
+        if inputs were silently truncated to bf16)."""
+        rng = np.random.RandomState(0)
+        a = rng.randn(64, 64).astype(np.float32)
+        b = rng.randn(64, 64).astype(np.float32)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(out, a @ b, rtol=1e-5, atol=1e-5)
+
+    def test_fp16_activation_grad(self):
+        x = paddle.to_tensor(_GENERIC.astype(np.float16))
+        x.stop_gradient = False
+        F.gelu(x).sum().backward()
+        assert x.grad is not None and x.grad.dtype == "float16"
+
+
+class TestErrorPaths:
+    def test_backward_twice_raises(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        with pytest.raises(RuntimeError, match="second time|retain_graph"):
+            y.backward()
+
+    def test_backward_twice_with_retain_graph(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 8.0])
+
+    def test_non_scalar_backward_raises(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        with pytest.raises(RuntimeError, match="scalar"):
+            (x * 2).backward()
+
+    def test_allow_unused(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        z = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (x * 3).sum()
+        gx, gz = paddle.grad([y], [x, z], allow_unused=True)
+        np.testing.assert_allclose(gx.numpy(), [3.0])
+        assert gz is None

@@ -1,0 +1,174 @@
+"""quantization / geometric / audio package tests.
+
+Reference patterns: test/quantization/test_qat.py (quantize swaps
+layers, training still converges, convert folds weights),
+test/legacy_test/test_graph_send_recv_op.py (segment reduce semantics),
+test/legacy_test/test_audio_functions.py (librosa-parity fbank/dct).
+"""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+class TestQuantization:
+    def test_fake_quant_roundtrip_and_ste(self):
+        from paddle_tpu.quantization import fake_quantize_dequantize_abs_max
+
+        x = paddle.to_tensor(np.linspace(-1, 1, 9).astype(np.float32))
+        x.stop_gradient = False
+        q = fake_quantize_dequantize_abs_max(x, bit_length=8)
+        # quantized values stay within one step of the original
+        assert float((q - x).abs().max().numpy()) < 1 / 127 + 1e-6
+        q.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(9), rtol=1e-6)  # STE
+
+    def test_qat_quantize_train_convert(self):
+        from paddle_tpu.nn import Linear
+        from paddle_tpu.quantization import (
+            QAT,
+            FakeQuanterWithAbsMaxObserver,
+            QuantConfig,
+            QuantedLinear,
+            quanter,
+        )
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        cfg = QuantConfig(activation=quanter(moving_rate=0.9),
+                          weight=quanter(moving_rate=0.9))
+        qat = QAT(cfg)
+        model = qat.quantize(model)
+        assert isinstance(model[0], QuantedLinear)
+        assert isinstance(model[2], QuantedLinear)
+
+        optimizer = opt.SGD(learning_rate=0.05, parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 2, (16,)))
+        losses = []
+        for _ in range(8):
+            loss = nn.functional.cross_entropy(model(x), y)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+        q_out = model(x).numpy()
+        model = qat.convert(model)
+        assert isinstance(model[0], Linear)
+        conv_out = model(x).numpy()
+        # converted (weight-folded) model ~ QAT model minus act quant noise
+        np.testing.assert_allclose(conv_out, q_out, atol=0.1)
+
+
+class TestGeometric:
+    def test_segment_reduce(self):
+        from paddle_tpu.geometric import (
+            segment_max,
+            segment_mean,
+            segment_min,
+            segment_sum,
+        )
+
+        data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1]))
+        np.testing.assert_allclose(
+            segment_sum(data, ids).numpy(), [[4, 6], [5, 6]]
+        )
+        np.testing.assert_allclose(
+            segment_mean(data, ids).numpy(), [[2, 3], [5, 6]]
+        )
+        np.testing.assert_allclose(
+            segment_min(data, ids).numpy(), [[1, 2], [5, 6]]
+        )
+        np.testing.assert_allclose(
+            segment_max(data, ids).numpy(), [[3, 4], [5, 6]]
+        )
+
+    def test_empty_segment_fills_zero(self):
+        from paddle_tpu.geometric import segment_max
+
+        data = paddle.to_tensor(np.ones((2, 3), np.float32))
+        ids = paddle.to_tensor(np.array([0, 2]))
+        out = segment_max(data, ids, out_size=4).numpy()
+        np.testing.assert_allclose(out[1], 0)  # empty segment
+        np.testing.assert_allclose(out[3], 0)
+
+    def test_send_u_recv(self):
+        from paddle_tpu.geometric import send_u_recv
+
+        x = paddle.to_tensor(np.array([[0.], [1.], [2.], [3.]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+        out = send_u_recv(x, src, dst, reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(), [[0.], [2.], [1.], [0.]])
+
+    def test_send_ue_recv_and_uv(self):
+        from paddle_tpu.geometric import send_ue_recv, send_uv
+
+        x = paddle.to_tensor(np.array([[1.], [2.], [3.]], np.float32))
+        e = paddle.to_tensor(np.array([10., 20.], np.float32))
+        src = paddle.to_tensor(np.array([0, 1]))
+        dst = paddle.to_tensor(np.array([2, 2]))
+        out = send_ue_recv(x, e, src, dst, message_op="add", reduce_op="sum")
+        np.testing.assert_allclose(out.numpy()[2], [33.0])
+        uv = send_uv(x, x, src, dst, message_op="mul")
+        np.testing.assert_allclose(uv.numpy(), [[3.], [6.]])
+
+    def test_grad_through_segment_sum(self):
+        from paddle_tpu.geometric import segment_sum
+
+        data = paddle.to_tensor(np.ones((3, 2), np.float32))
+        data.stop_gradient = False
+        ids = paddle.to_tensor(np.array([0, 1, 0]))
+        segment_sum(data, ids).sum().backward()
+        np.testing.assert_allclose(data.grad.numpy(), np.ones((3, 2)))
+
+
+class TestAudio:
+    def test_mel_conversions_roundtrip(self):
+        from paddle_tpu.audio.functional import hz_to_mel, mel_to_hz
+
+        for htk in (False, True):
+            f = 440.0
+            assert abs(mel_to_hz(hz_to_mel(f, htk), htk) - f) < 1e-3
+
+    def test_fbank_shape_and_rowsum(self):
+        from paddle_tpu.audio.functional import compute_fbank_matrix
+
+        fb = compute_fbank_matrix(sr=16000, n_fft=512, n_mels=40)
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all() and fb.sum() > 0
+
+    def test_create_dct_orthonormal(self):
+        from paddle_tpu.audio.functional import create_dct
+
+        d = create_dct(n_mfcc=13, n_mels=13, norm="ortho").astype(np.float64)
+        np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-6)
+
+    def test_feature_layers(self):
+        from paddle_tpu.audio import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram
+
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 2048).astype(np.float32)
+        )
+        spec = Spectrogram(n_fft=256)(x)
+        assert spec.shape[0] == 2 and spec.shape[1] == 129
+        mel = MelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+        assert mel.shape[1] == 32
+        logmel = LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+        assert np.isfinite(logmel.numpy()).all()
+        mfcc = MFCC(sr=8000, n_mfcc=13, n_mels=32, n_fft=256)(x)
+        assert mfcc.shape[1] == 13
+
+    def test_power_to_db_topdb(self):
+        from paddle_tpu.audio.functional import power_to_db
+
+        s = paddle.to_tensor(np.array([1e-12, 1.0], np.float32))
+        out = power_to_db(s, top_db=30.0).numpy()
+        assert out.max() - out.min() <= 30.0 + 1e-5

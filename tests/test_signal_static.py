@@ -1,0 +1,113 @@
+"""signal (stft/istft) + static.InputSpec tests.
+
+Reference pattern: test/legacy_test/test_stft_op.py / test_istft_op.py
+(round-trip + scipy parity), test_input_spec.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import signal
+from paddle_tpu.static import InputSpec
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip_no_overlap(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32))
+        f = signal.frame(x, frame_length=4, hop_length=4)
+        assert f.shape == [4, 3]  # reference layout: [frame_length, num]
+        back = signal.overlap_add(f, hop_length=4)
+        np.testing.assert_allclose(back.numpy(), x.numpy())
+
+    def test_frame_axis0_layout(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32))
+        f = signal.frame(x, frame_length=4, hop_length=4, axis=0)
+        assert f.shape == [3, 4]  # [num_frames, frame_length]
+        np.testing.assert_allclose(f.numpy()[1], [4, 5, 6, 7])
+        back = signal.overlap_add(f, hop_length=4, axis=0)
+        np.testing.assert_allclose(back.numpy(), x.numpy())
+
+    def test_stft_matches_scipy(self):
+        import scipy.signal as ss
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(512).astype(np.float32)
+        n_fft, hop = 64, 16
+        win = np.hanning(n_fft).astype(np.float32)
+        out = signal.stft(
+            paddle.to_tensor(x), n_fft=n_fft, hop_length=hop,
+            window=paddle.to_tensor(win), center=True,
+        ).numpy()
+        freqs, times, ref = ss.stft(
+            x, nperseg=n_fft, noverlap=n_fft - hop, window=win,
+            boundary="even", padded=False, return_onesided=True,
+        )
+        # scipy normalizes by win.sum(); undo for raw-DFT comparison
+        ref = ref * win.sum()
+        n = min(out.shape[-1], ref.shape[-1])
+        np.testing.assert_allclose(out[:, :n], ref[:, :n], atol=1e-3)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 400).astype(np.float32)
+        n_fft, hop = 64, 16
+        win = np.hanning(n_fft).astype(np.float32)
+        spec = signal.stft(
+            paddle.to_tensor(x), n_fft=n_fft, hop_length=hop,
+            window=paddle.to_tensor(win),
+        )
+        back = signal.istft(
+            spec, n_fft=n_fft, hop_length=hop, window=paddle.to_tensor(win),
+            length=400,
+        ).numpy()
+        np.testing.assert_allclose(back, x, atol=1e-4)
+
+    def test_stft_grad_flows(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(256).astype(np.float32))
+        x.stop_gradient = False
+        spec = signal.stft(x, n_fft=64)
+        (spec.real() ** 2 + spec.imag() ** 2).sum().backward()
+        assert x.grad is not None and x.grad.shape == [256]
+
+
+class TestInputSpec:
+    def test_basic_and_none_shape(self):
+        spec = InputSpec([None, 784], "float32", "x")
+        assert spec.shape == (-1, 784)
+        assert "InputSpec" in repr(spec)
+
+    def test_from_tensor_and_numpy(self):
+        t = paddle.to_tensor(np.ones((2, 3), np.float32))
+        s = InputSpec.from_tensor(t, name="t")
+        assert s.shape == (2, 3) and s.name == "t"
+        s2 = InputSpec.from_numpy(np.ones((4,), np.int64))
+        # framework canonicalization: 64-bit ints map to int32 (x64 off)
+        assert s2.shape == (4,) and np.dtype(s2.dtype) == np.int32
+
+    def test_batch_unbatch(self):
+        s = InputSpec([784], "float32")
+        assert s.batch(32).shape == (32, 784)
+        assert s.unbatch().shape == (784,)
+
+    def test_jit_save_with_input_spec(self, tmp_path):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 3), nn.ReLU())
+        path = str(tmp_path / "m")
+        paddle.jit.save(net, path, input_spec=[InputSpec([1, 4], "float32")])
+        loaded = paddle.jit.load(path)
+        x = np.random.RandomState(0).randn(1, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            loaded(paddle.to_tensor(x)).numpy(),
+            net(paddle.to_tensor(x)).numpy(),
+            rtol=1e-5,
+        )
+
+    def test_program_raises_guidance(self):
+        from paddle_tpu.static import Executor, Program
+
+        with pytest.raises(NotImplementedError, match="jaxpr"):
+            Program()
+        with pytest.raises(NotImplementedError, match="jaxpr"):
+            Executor()
