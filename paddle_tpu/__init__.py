@@ -82,6 +82,10 @@ from . import utils  # noqa: F401
 from . import signal  # noqa: F401
 from . import static  # noqa: F401
 from . import quantization  # noqa: F401
+from . import incubate  # noqa: F401
+from . import text  # noqa: F401
+from . import reader  # noqa: F401
+from . import hub  # noqa: F401
 from . import geometric  # noqa: F401
 from . import audio  # noqa: F401
 from .hapi import Model, summary  # noqa: F401

@@ -1,0 +1,74 @@
+"""paddle_tpu.hub — hubconf-based model loading (ref: python/paddle/
+hub.py — list/help/load over github|gitee|local sources).
+
+This environment has no network egress, so the remote sources raise
+with guidance; the ``local`` source (a directory containing
+``hubconf.py``) is fully functional — same entrypoint contract as the
+reference: callables not prefixed with '_' are models, ``dependencies``
+is an optional requirements list.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List, Optional
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _check_source(source: str):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError("source must be github/gitee/local")
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network egress, which this "
+            "environment does not have; clone the repo and use "
+            "source='local' with its directory path"
+        )
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf (ref: hub.py list)."""
+    _check_source(source)
+    module = _load_hubconf(repo_dir)
+    return [
+        name
+        for name, obj in vars(module).items()
+        if callable(obj) and not name.startswith("_")
+    ]
+
+
+def help(repo_dir: str, model: str, source: str = "github",  # noqa: A001
+         force_reload: bool = False):
+    """Docstring of an entrypoint (ref: hub.py help)."""
+    _check_source(source)
+    module = _load_hubconf(repo_dir)
+    fn = getattr(module, model, None)
+    if fn is None or model.startswith("_"):
+        raise ValueError(f"model {model!r} not found in {repo_dir}/{_HUBCONF}")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Instantiate an entrypoint (ref: hub.py load)."""
+    _check_source(source)
+    module = _load_hubconf(repo_dir)
+    fn = getattr(module, model, None)
+    if fn is None or model.startswith("_"):
+        raise ValueError(f"model {model!r} not found in {repo_dir}/{_HUBCONF}")
+    return fn(**kwargs)

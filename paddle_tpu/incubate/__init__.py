@@ -1,0 +1,16 @@
+"""paddle_tpu.incubate — incubating APIs kept for parity.
+
+ref: python/paddle/incubate/ (40.9k LoC). The pieces with real usage in
+training stacks are surfaced here, each mapped to its TPU-native
+engine rather than re-implemented:
+
+- ``nn.functional`` fused ops → the same fused XLA/Pallas paths the
+  core framework uses (fusion is the compiler's job on TPU; the
+  reference needed hand-fused CUDA kernels);
+- ``asp`` 2:4 semi-structured sparsity masking (numpy mask math is
+  identical to the reference's);
+- ``distributed.models.moe`` → fleet's MoELayer.
+"""
+from . import asp  # noqa: F401
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401

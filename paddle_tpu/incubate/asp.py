@@ -1,0 +1,126 @@
+"""2:4 semi-structured sparsity (ASP) (ref: python/paddle/incubate/asp/
+— utils.py check_mask_2d/get_mask_2d_best, asp.py prune_model/
+decorate).
+
+The mask math is numerically identical to the reference's; application
+is a weight-mask hook instead of the reference's optimizer decoration
+(masked weights stay masked because the mask re-applies after every
+step). TPU note: XLA has no sparse-MXU path, so 2:4 here preserves
+model-quality semantics (pruned training) rather than speed.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "calculate_density", "create_mask", "check_sparsity", "prune_model",
+    "decorate", "reset_excluded_layers", "set_excluded_layers",
+]
+
+_excluded: set = set()
+_masks: Dict[int, np.ndarray] = {}
+
+
+def calculate_density(x) -> float:
+    """ref: asp/utils.py calculate_density."""
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _mask_1d(row: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest |values| of every m (ref: get_mask_1d)."""
+    size = row.size
+    pad = (-size) % m
+    padded = np.pad(np.abs(row), (0, pad))
+    groups = padded.reshape(-1, m)
+    order = np.argsort(-groups, axis=1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[:, :n], 1.0, axis=1)
+    return mask.reshape(-1)[:size]
+
+
+def _as_2d(arr: np.ndarray) -> np.ndarray:
+    """Conv weights [out, in, kh, kw] flatten to [out, in*kh*kw] before
+    masking (ref: asp/utils.py — same reshape discipline)."""
+    return arr.reshape(arr.shape[0], -1) if arr.ndim > 2 else arr
+
+
+def create_mask(x, func_name: str = "mask_1d", n: int = 2, m: int = 4):
+    """n:m mask with the same shape as x (ref: asp/utils.py create_mask)."""
+    if func_name not in ("mask_1d",):
+        raise NotImplementedError(
+            f"mask algorithm {func_name!r} is not implemented; use 'mask_1d'"
+        )
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    flat = _as_2d(arr)
+    mask = np.stack([_mask_1d(r, n, m) for r in flat])
+    return mask.reshape(arr.shape).astype(arr.dtype)
+
+
+def check_sparsity(x, n: int = 2, m: int = 4) -> bool:
+    """Every group of m along the (conv-flattened) last dim has ≤ n
+    nonzeros (ref: utils.py check_mask_1d)."""
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    flat = np.abs(_as_2d(arr))
+    pad = (-flat.shape[1]) % m
+    padded = np.pad(flat, ((0, 0), (0, pad)))
+    groups = padded.reshape(flat.shape[0], -1, m)
+    return bool((np.count_nonzero(groups > 0, axis=-1) <= n).all())
+
+
+def set_excluded_layers(param_names: List[str], main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _prunable(layer) -> List:
+    from ..nn import Conv2D, Linear
+
+    params = []
+    for name, sub in layer.named_sublayers(include_self=True):
+        if isinstance(sub, (Linear, Conv2D)):
+            w = sub.weight
+            flat_cols = int(np.prod(w.shape[1:])) if len(w.shape) > 2 else w.shape[-1]
+            if w.name not in _excluded and flat_cols % 4 == 0:
+                params.append(w)
+    return params
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Apply 2:4 masks to prunable weights (ref: asp.py prune_model).
+    Returns {param_name: mask}."""
+    import jax.numpy as jnp
+
+    out = {}
+    for w in _prunable(model):
+        mask = create_mask(w, mask_algo, n, m)
+        w.set_value(np.asarray(w.numpy()) * mask)
+        if with_mask:
+            _masks[id(w)] = mask
+        out[w.name] = mask
+    return out
+
+
+def decorate(optimizer):
+    """Keep masks applied across optimizer steps (ref: asp.py decorate
+    — the reference decorates the optimizer the same way)."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        import jax.numpy as jnp
+
+        for group in optimizer._param_groups:
+            for p in group["params"]:
+                mask = _masks.get(id(p))
+                if mask is not None:
+                    p._data = p._data * jnp.asarray(mask, p._data.dtype)
+
+    optimizer.step = step
+    return optimizer

@@ -1,0 +1,9 @@
+"""MoE parity namespace (ref: python/paddle/incubate/distributed/models/
+moe/moe_layer.py) — the implementation lives in
+paddle_tpu.distributed.fleet.meta_parallel.moe."""
+from .....distributed.fleet.meta_parallel.moe import (  # noqa: F401
+    ExpertMLP,
+    MoELayer,
+    TopKGate,
+    place_experts_on_mesh,
+)

@@ -1,0 +1,2 @@
+"""incubate.nn (ref: python/paddle/incubate/nn)."""
+from . import functional  # noqa: F401

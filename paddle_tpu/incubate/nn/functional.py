@@ -1,0 +1,181 @@
+"""incubate.nn.functional fused ops (ref: python/paddle/incubate/nn/
+functional/ — fused_multi_head_attention, fused_feedforward,
+fused_linear, fused_rms_norm, fused_rotary_position_embedding).
+
+On TPU "fused" is the default: XLA fuses these chains and the flash
+kernel covers attention, so each API maps to the already-fused path —
+the parity value is the call signature, not a new kernel.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...base.tape import apply
+from ...nn import functional as F
+
+__all__ = [
+    "fused_linear", "fused_feedforward", "fused_multi_head_attention",
+    "fused_rms_norm", "fused_rotary_position_embedding",
+]
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """ref: incubate fused_linear → one XLA dot+bias."""
+    if transpose_weight:
+        from ...tensor.linalg import matmul
+
+        out = matmul(x, weight, transpose_y=True)
+        return out + bias if bias is not None else out
+    return F.linear(x, weight, bias)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode="upscale_in_train",
+                      name=None):
+    """ref: incubate fused_feedforward — pre/post-LN FFN block."""
+    h = int(x.shape[-1])
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, (h,), weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    act = {"relu": F.relu, "gelu": F.gelu}[activation]
+    y = act(F.linear(x, linear1_weight, linear1_bias))
+    y = F.dropout(y, dropout1_rate, training=training, mode=mode)
+    y = F.linear(y, linear2_weight, linear2_bias)
+    y = F.dropout(y, dropout2_rate, training=training, mode=mode)
+    out = residual + y
+    if not pre_layer_norm:
+        out = F.layer_norm(out, (h,), weight=ln2_scale, bias=ln2_bias,
+                           epsilon=ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               name=None):
+    """ref: incubate fused_multi_head_attention — qkv pack + sdpa +
+    out-proj (+ residual/LN), riding the Pallas flash kernel."""
+    from ...tensor import manipulation as M
+
+    b, s, h = x.shape
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, (h,), weight=pre_ln_scale, bias=pre_ln_bias,
+                         epsilon=pre_ln_epsilon)
+    # qkv_weight [3*h, h] (reference packs [3, nheads, hdim, h])
+    qkv_w2d = qkv_weight
+    if len(qkv_weight.shape) == 4:
+        qkv_w2d = M.reshape(qkv_weight, [3 * h, h])
+        if num_heads is None:
+            num_heads = int(qkv_weight.shape[1])
+    if num_heads is None:
+        raise ValueError("num_heads required with 2-D qkv_weight")
+    qkv = F.linear(x, M.transpose(qkv_w2d, [1, 0]), qkv_bias)
+    qkv = M.reshape(qkv, [b, s, 3, num_heads, h // num_heads])
+    out = F.scaled_dot_product_attention(
+        qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate, training=training,
+    )
+    out = F.linear(M.reshape(out, [b, s, h]), linear_weight, linear_bias)
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, (h,), weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    return out
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, name=None):
+    """ref: fused_rms_norm — normalizes over axes
+    [begin_norm_axis:]; XLA fuses the chain into one kernel."""
+    import jax
+
+    ndim = len(x.shape)
+    axis = begin_norm_axis if begin_norm_axis >= 0 else begin_norm_axis + ndim
+    norm_axes = tuple(range(axis, ndim))
+
+    def f(a, w, *maybe_b):
+        var = jnp.mean(
+            jnp.square(a.astype(jnp.float32)), axis=norm_axes, keepdims=True
+        )
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        out = out * w
+        if maybe_b:
+            out = out + maybe_b[0]
+        return out
+
+    args = (x, norm_weight) + ((norm_bias,) if norm_bias is not None else ())
+    return apply(f, *args, op_name="fused_rms_norm")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    name=None):
+    """ref: fused_rotary_position_embedding — applies RoPE to q/k
+    ([B, S, H, D] layout)."""
+
+    def rope(x, sin_a, cos_a):
+        if use_neox_rotary_style:
+            half = x.shape[-1] // 2
+            x1, x2 = x[..., :half], x[..., half:]
+            rotated = jnp.concatenate([-x2, x1], axis=-1)
+        else:
+            x1 = x[..., 0::2]
+            x2 = x[..., 1::2]
+            rotated = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+        return x * cos_a + rotated * sin_a
+
+    def build_trig(seq, dim, dtype):
+        pos = jnp.arange(seq, dtype=jnp.float32)
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, dim, 2, jnp.float32) / dim))
+        freqs = pos[:, None] * inv[None, :]
+        if use_neox_rotary_style:
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+        else:
+            emb = jnp.repeat(freqs, 2, axis=-1)
+        return jnp.sin(emb).astype(dtype), jnp.cos(emb).astype(dtype)
+
+    def f(qq, *rest):
+        seq, dim = qq.shape[1], qq.shape[-1]
+        it = iter(rest)
+        kk = next(it) if k is not None else None
+        vv = next(it) if v is not None else None
+        s_a = next(it) if sin is not None else None
+        c_a = next(it) if cos is not None else None
+        pos = next(it) if position_ids is not None else None
+        if s_a is None:
+            # build over max position so gather by position_ids is valid
+            max_pos = seq
+            s_a, c_a = build_trig(max_pos, dim, qq.dtype)
+        else:
+            s_a = s_a.reshape(-1, dim)
+            c_a = c_a.reshape(-1, dim)
+        if pos is not None:
+            # per-batch positions [B, S] (KV-cache decode / packed seqs)
+            s_a = s_a[pos.astype(jnp.int32)][:, :, None, :]  # [B, S, 1, D]
+            c_a = c_a[pos.astype(jnp.int32)][:, :, None, :]
+        else:
+            s_a = s_a[:seq].reshape(1, seq, 1, dim)
+            c_a = c_a[:seq].reshape(1, seq, 1, dim)
+        outs = [rope(qq, s_a, c_a)]
+        if kk is not None:
+            outs.append(rope(kk, s_a, c_a))
+        if vv is not None:
+            outs.append(vv)
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    args = [q] + [t for t in (k, v, sin, cos, position_ids) if t is not None]
+    return apply(f, *args, op_name="fused_rope")

@@ -17,3 +17,4 @@ from .bert import (  # noqa: F401
     BertForSequenceClassification,
     BertModel,
 )
+from .unet import UNet2DConditionModel, UNetConfig  # noqa: F401

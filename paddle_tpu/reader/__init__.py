@@ -1,0 +1,155 @@
+"""paddle_tpu.reader — legacy reader combinators.
+
+ref: python/paddle/reader/decorator.py — map_readers :40, shuffle :132,
+chain :169, compose :259, buffered :319, firstn :368, xmap_readers
+:401, cache :80. A "reader" is a zero-arg callable returning an
+iterable of samples; combinators compose them. Kept for porting old
+pipelines; new code should use paddle_tpu.io.DataLoader.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Callable, Iterable
+
+__all__ = [
+    "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
+    "cache", "xmap_readers",
+]
+
+
+def cache(reader):
+    """Materialize once, replay thereafter (ref: decorator.py cache)."""
+    all_data = tuple(reader())
+
+    def new_reader():
+        return iter(all_data)
+
+    return new_reader
+
+
+def map_readers(func, *readers):
+    """Apply func over zipped reader outputs (ref: map_readers)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        return map(func, *rs)
+
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """Buffered shuffle (ref: decorator.py shuffle — numpy RNG, same
+    buffer semantics)."""
+    import numpy as np
+
+    def new_reader():
+        rng = np.random.default_rng()
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+
+    return new_reader
+
+
+def chain(*readers):
+    """Concatenate readers (ref: decorator.py chain)."""
+
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Zip outputs of several readers into flat tuples (ref: compose)."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for items in itertools.zip_longest(*rs):
+                if any(i is None for i in items):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned in length"
+                    )
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in zip(*rs):
+                yield sum((make_tuple(i) for i in items), ())
+
+    return reader
+
+
+def buffered(reader, size: int):
+    """Background-thread prefetch buffer (ref: decorator.py buffered)."""
+    _end = object()
+
+    def new_reader():
+        q: "queue.Queue" = queue.Queue(maxsize=size)
+        error = []
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            except BaseException as e:  # surface in the consumer
+                error.append(e)
+            finally:
+                q.put(_end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _end:
+                if error:
+                    raise error[0]
+                return
+            yield item
+
+    return new_reader
+
+
+def firstn(reader, n: int):
+    """Limit to the first n samples (ref: decorator.py firstn)."""
+
+    def new_reader():
+        return itertools.islice(reader(), n)
+
+    return new_reader
+
+
+def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """Parallel map with worker threads (ref: xmap_readers — thread
+    pool instead of the reference's process pool; mappers are
+    numpy/IO-bound and release the GIL)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def new_reader():
+        with ThreadPoolExecutor(process_num) as pool:
+            it = reader()
+            pending = []
+            for sample in it:
+                pending.append(pool.submit(mapper, sample))
+                if len(pending) >= buffer_size:
+                    yield pending.pop(0).result()
+            for f in pending:
+                yield f.result()
+
+    return new_reader

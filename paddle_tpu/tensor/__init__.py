@@ -166,3 +166,6 @@ def _install_methods():
 
 
 _install_methods()
+
+from . import array  # noqa: F401
+from .array import array_length, array_read, array_write, create_array  # noqa: F401

@@ -1,0 +1,68 @@
+"""TensorArray ops (ref: python/paddle/tensor/array.py — create_array,
+array_write, array_read, array_length; backed in the reference by the
+LoDTensorArray specialized tensor, SURVEY §2.1).
+
+TPU-native: in eager/dygraph the array is a plain Python list of
+Tensors (exactly what the reference does in dynamic mode,
+array.py in_dygraph_mode branches); inside jit-traced code a Python
+list of traced Tensors composes fine because indices there must be
+static anyway — dynamic-index accumulation is what lax.scan is for,
+which paddle_tpu.jit users reach via multi_step/scan directly.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..base.tensor import Tensor
+
+__all__ = ["create_array", "array_write", "array_read", "array_length"]
+
+
+def create_array(dtype: str = "float32", initialized_list=None):
+    """ref: array.py create_array."""
+    arr: List[Tensor] = []
+    if initialized_list is not None:
+        for t in initialized_list:
+            if not isinstance(t, Tensor):
+                raise TypeError(
+                    f"initialized_list items must be Tensors, got {type(t)}"
+                )
+            arr.append(t)
+    return arr
+
+
+def _index(i) -> int:
+    if isinstance(i, Tensor):
+        return int(i.numpy())
+    return int(i)
+
+
+def array_write(x, i, array: Optional[list] = None):
+    """Write x at index i, growing the array (ref: array.py array_write)."""
+    if array is None:
+        array = create_array()
+    idx = _index(i)
+    if idx < len(array):
+        array[idx] = x
+    elif idx == len(array):
+        array.append(x)
+    else:
+        raise IndexError(
+            f"array_write index {idx} beyond array length {len(array)}"
+        )
+    return array
+
+
+def array_read(array: list, i):
+    """ref: array.py array_read."""
+    idx = _index(i)
+    if not 0 <= idx < len(array):
+        raise IndexError(f"array_read index {idx} out of range [0, {len(array)})")
+    return array[idx]
+
+
+def array_length(array: list):
+    """ref: array.py array_length."""
+    from .. import to_tensor
+
+    return to_tensor(len(array))

@@ -1,0 +1,155 @@
+"""paddle_tpu.text — sequence-labeling ops + text dataset parsers.
+
+ref: python/paddle/text/ — viterbi_decode.py (ViterbiDecoder,
+viterbi_decode), datasets/imdb.py etc. Dataset download is unavailable
+(no egress), so Imdb parses a local archive; viterbi decoding is a
+lax.scan dynamic program (jit-able, static lengths masked).
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from ..base.tensor import Tensor
+from ..io import Dataset
+from ..nn.layer.layers import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """CRF Viterbi decoding (ref: text/viterbi_decode.py:24 — same
+    semantics incl. the BOS/EOS convention: with tags appended as the
+    last two transition rows/cols, start scores add trans[-2, tag] and
+    final scores add trans[tag, -1]).
+
+    potentials [B, L, C] unary scores, transition_params [C(+2), C(+2)],
+    lengths [B] → (scores [B], paths [B, L] padded with 0 past length).
+    """
+
+    def f(pot, trans, lens):
+        b, l, c = pot.shape
+        if include_bos_eos_tag:
+            start = trans[-2, :c]
+            stop = trans[:c, -1]
+            tr = trans[:c, :c]
+        else:
+            start = jnp.zeros((c,), pot.dtype)
+            stop = jnp.zeros((c,), pot.dtype)
+            tr = trans
+
+        alpha0 = pot[:, 0] + start[None, :]
+
+        def step(carry, t):
+            alpha, = carry
+            # scores[b, i, j] = alpha[b, i] + tr[i, j] + pot[b, t, j]
+            scores = alpha[:, :, None] + tr[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)  # [B, C]
+            best_score = jnp.max(scores, axis=1) + pot[:, t]
+            # positions past a sequence's length keep the old alpha
+            active = (t < lens)[:, None]
+            new_alpha = jnp.where(active, best_score, alpha)
+            return (new_alpha,), jnp.where(active, best_prev, -1)
+
+        (alpha,), backptrs = jax.lax.scan(
+            step, (alpha0,), jnp.arange(1, l)
+        )  # backptrs [L-1, B, C]
+        final = alpha + stop[None, :]
+        scores = jnp.max(final, axis=-1)
+        last_tag = jnp.argmax(final, axis=-1)  # [B]
+
+        def backtrack(carry, bp_t):
+            tag, t = carry
+            # bp_t corresponds to transition into step t+1
+            prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+            active = (t + 1) < lens
+            new_tag = jnp.where(active & (prev >= 0), prev, tag)
+            return (new_tag, t - 1), new_tag
+
+        (first_tag, _), rev_path = jax.lax.scan(
+            backtrack, (last_tag, l - 2), backptrs[::-1]
+        )
+        path = jnp.concatenate(
+            [rev_path[::-1].T, last_tag[:, None]], axis=1
+        )  # [B, L] with path[:, 0] from the deepest backtrack
+        # mask positions past each length with 0 (reference pads)
+        mask = jnp.arange(l)[None, :] < lens[:, None]
+        path = jnp.where(mask, path, 0)
+        return scores, path.astype(jnp.int64)
+
+    return apply(f, potentials, transition_params, lengths, op_name="viterbi_decode")
+
+
+class ViterbiDecoder(Layer):
+    """ref: text/viterbi_decode.py ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(
+            potentials, self.transitions, lengths, self.include_bos_eos_tag
+        )
+
+
+class Imdb(Dataset):
+    """IMDB sentiment dataset from a local aclImdb tar archive
+    (ref: text/datasets/imdb.py — same tokenization: lowercase,
+    punctuation-stripped split)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150):
+        if mode not in ("train", "test"):
+            raise ValueError("mode must be 'train' or 'test'")
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "Imdb archive not found; automatic download is unavailable "
+                "(no network egress) — pass data_file=<path to aclImdb tar>"
+            )
+        self._pattern = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        self.word_idx = {}
+        self.docs, self.labels = self._load(data_file, cutoff)
+
+    def _tokenize(self, text: str):
+        return re.sub(r"[^a-z0-9\s]", "", text.lower()).split()
+
+    def _load(self, data_file, cutoff):
+        from collections import Counter
+
+        texts, labels = [], []
+        freq = Counter()
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                m = self._pattern.match(member.name)
+                if not m:
+                    continue
+                with tf.extractfile(member) as f:
+                    toks = self._tokenize(f.read().decode("utf-8", "ignore"))
+                texts.append(toks)
+                labels.append(0 if m.group(1) == "pos" else 1)
+                freq.update(toks)
+        kept = [w for w, c in freq.most_common() if c >= cutoff]
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        unk = len(self.word_idx)
+        self.word_idx["<unk>"] = unk
+        docs = [
+            np.asarray([self.word_idx.get(t, unk) for t in toks], np.int64)
+            for toks in texts
+        ]
+        return docs, np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)

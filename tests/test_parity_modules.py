@@ -1,0 +1,199 @@
+"""hub / reader / text.viterbi / TensorArray / incubate parity tests.
+
+Reference patterns: test/legacy_test/test_viterbi_decode_op.py (brute
+force DP comparison), test_reader_decorators, test_asp_*, incubate
+fused-op parity vs the unfused composition.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestHub:
+    def test_local_hubconf(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(scale=1):\n"
+            '    "A tiny model."\n'
+            "    return {'scale': scale}\n"
+            "def _private():\n    return None\n"
+        )
+        from paddle_tpu import hub
+
+        assert hub.list(str(tmp_path), source="local") == ["tiny"]
+        assert "tiny model" in hub.help(str(tmp_path), "tiny", source="local")
+        assert hub.load(str(tmp_path), "tiny", source="local", scale=3) == {"scale": 3}
+
+    def test_remote_raises(self):
+        from paddle_tpu import hub
+
+        with pytest.raises(RuntimeError, match="egress"):
+            hub.list("user/repo", source="github")
+
+
+class TestReader:
+    def test_combinators(self):
+        from paddle_tpu import reader as R
+
+        base = lambda: iter(range(10))
+        assert list(R.firstn(base, 3)()) == [0, 1, 2]
+        assert list(R.map_readers(lambda a: a * 2, base)()) == [i * 2 for i in range(10)]
+        assert list(R.chain(base, lambda: iter([100]))()) == list(range(10)) + [100]
+        assert sorted(R.shuffle(base, 5)()) == list(range(10))
+        assert list(R.buffered(base, 2)()) == list(range(10))
+        comp = R.compose(base, lambda: iter(range(10, 20)))
+        assert list(comp())[0] == (0, 10)
+        cached = R.cache(base)
+        assert list(cached()) == list(cached())
+        out = sorted(R.xmap_readers(lambda s: s + 1, base, 2, 4)())
+        assert out == list(range(1, 11))
+
+    def test_compose_misaligned_raises(self):
+        from paddle_tpu import reader as R
+
+        comp = R.compose(lambda: iter(range(3)), lambda: iter(range(5)))
+        with pytest.raises(R.ComposeNotAligned):
+            list(comp())
+
+
+class TestViterbi:
+    def _brute_force(self, pot, trans, length, bos_eos):
+        import itertools
+
+        c = pot.shape[1]
+        if bos_eos:
+            start, stop, tr = trans[-2, :c], trans[:c, -1], trans[:c, :c]
+        else:
+            start = stop = np.zeros(c)
+            tr = trans
+        best, best_path = -1e30, None
+        for path in itertools.product(range(c), repeat=length):
+            s = start[path[0]] + pot[0, path[0]]
+            for t in range(1, length):
+                s += tr[path[t - 1], path[t]] + pot[t, path[t]]
+            s += stop[path[-1]]
+            if s > best:
+                best, best_path = s, path
+        return best, list(best_path)
+
+    @pytest.mark.parametrize("bos_eos", [True, False])
+    def test_matches_brute_force(self, bos_eos):
+        from paddle_tpu.text import viterbi_decode
+
+        rng = np.random.RandomState(0)
+        C, L = 4, 5
+        size = C + 2 if bos_eos else C
+        pot = rng.randn(2, L, C).astype(np.float32)
+        trans = rng.randn(size, size).astype(np.float32)
+        lengths = np.array([L, 3])
+        scores, paths = viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=bos_eos,
+        )
+        for b in range(2):
+            ref_s, ref_p = self._brute_force(pot[b], trans, lengths[b], bos_eos)
+            np.testing.assert_allclose(float(scores.numpy()[b]), ref_s, rtol=1e-5)
+            got = paths.numpy()[b][: lengths[b]].tolist()
+            assert got == ref_p, (b, got, ref_p)
+
+
+class TestTensorArray:
+    def test_write_read_length(self):
+        from paddle_tpu.tensor.array import (
+            array_length,
+            array_read,
+            array_write,
+            create_array,
+        )
+
+        arr = create_array("float32")
+        x0 = paddle.to_tensor([1.0])
+        arr = array_write(x0, paddle.to_tensor(0), arr)
+        arr = array_write(paddle.to_tensor([2.0]), 1, arr)
+        assert int(array_length(arr).numpy()) == 2
+        np.testing.assert_allclose(array_read(arr, 1).numpy(), [2.0])
+        with pytest.raises(IndexError):
+            array_read(arr, 5)
+        with pytest.raises(IndexError):
+            array_write(x0, 7, arr)
+
+
+class TestIncubate:
+    def test_fused_rms_norm_matches_composition(self):
+        from paddle_tpu.incubate.nn.functional import fused_rms_norm
+
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(8).astype(np.float32))
+        out = fused_rms_norm(x, w).numpy()
+        xa = x.numpy()
+        ref = xa / np.sqrt((xa**2).mean(-1, keepdims=True) + 1e-6) * w.numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_fused_rope_rotation_norm_preserving(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_rotary_position_embedding,
+        )
+
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(1, 6, 2, 8).astype(np.float32))
+        k = paddle.to_tensor(rng.randn(1, 6, 2, 8).astype(np.float32))
+        q2, k2 = fused_rotary_position_embedding(q, k)
+        # rotation preserves pairwise norms
+        np.testing.assert_allclose(
+            np.linalg.norm(q2.numpy(), axis=-1),
+            np.linalg.norm(q.numpy(), axis=-1),
+            rtol=1e-5,
+        )
+        # position 0 is unrotated
+        np.testing.assert_allclose(q2.numpy()[:, 0], q.numpy()[:, 0], atol=1e-6)
+        assert not np.allclose(q2.numpy()[:, 1], q.numpy()[:, 1])
+
+    def test_fused_mha_matches_unfused(self):
+        from paddle_tpu.incubate.nn.functional import fused_multi_head_attention
+
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        b, s, h, heads = 2, 8, 16, 4
+        x = paddle.to_tensor(rng.randn(b, s, h).astype(np.float32))
+        qkv_w = paddle.to_tensor(rng.randn(3 * h, h).astype(np.float32) * 0.1)
+        out_w = paddle.to_tensor(rng.randn(h, h).astype(np.float32) * 0.1)
+        out = fused_mha = fused_multi_head_attention(
+            x, qkv_w, out_w, num_heads=heads, training=False,
+            pre_layer_norm=True,
+            pre_ln_scale=paddle.to_tensor(np.ones(h, np.float32)),
+        )
+        assert out.shape == [b, s, h]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_asp_2_4(self):
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+        masks = asp.prune_model(model)
+        assert len(masks) == 2
+        w = model[0].weight
+        assert asp.check_sparsity(w)
+        assert abs(asp.calculate_density(w) - 0.5) < 1e-6
+
+        import paddle_tpu.optimizer as opt
+
+        optimizer = asp.decorate(
+            opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        )
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 16).astype(np.float32))
+        y = paddle.to_tensor(np.random.RandomState(1).randint(0, 4, (4,)))
+        for _ in range(2):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+        assert asp.check_sparsity(model[0].weight)  # mask survives steps
+
+    def test_moe_reexport(self):
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+        assert MoELayer is not None
