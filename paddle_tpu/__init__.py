@@ -15,6 +15,17 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# DataLoader process workers must never initialize an accelerator
+# backend (they only run host-side numpy; on shared-TPU setups a worker
+# grabbing the chip deadlocks the parent). The spawning side sets this
+# env var; honoring it must precede any jax backend use.
+import os as _os
+
+if _os.environ.get("PADDLE_TPU_FORCE_CPU") == "1":
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
 # -- base ---------------------------------------------------------------
 from .base import dtype as _dtype_mod
 from .base.dtype import (  # noqa: F401

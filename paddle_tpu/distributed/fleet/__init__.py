@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+from . import elastic  # noqa: F401
+
 from .base.distributed_strategy import DistributedStrategy  # noqa: F401
 from .base.topology import (  # noqa: F401
     CommunicateTopology,

@@ -1,0 +1,160 @@
+"""Elastic training manager — node liveness, scale events, rank
+reassignment.
+
+ref: python/paddle/distributed/fleet/elastic/manager.py:124
+(ElasticManager: etcd leases + watches, rank reassignment, relaunch via
+ELASTIC_EXIT_CODE) and elastic/collective.py.
+
+TPU-native redesign: the rendezvous store is a **shared directory**
+(NFS/GCS-fuse — present on TPU pods; etcd is not) holding one
+heartbeat file per node. Each node renews its file's mtime; the
+manager derives the alive set, detects scale-up/down against the
+expected world, and reassigns dense ranks deterministically
+(lexicographic by node id — every node computes the same assignment
+with no coordinator). On a membership change the watchdog reports
+ELASTIC_EXIT_CODE so the launcher (distributed.launch, which already
+restarts on nonzero exits) relaunches with the new world — same
+division of labor as the reference.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["ElasticManager", "ELASTIC_EXIT_CODE"]
+
+ELASTIC_EXIT_CODE = 101  # ref: manager.py ELASTIC_EXIT_CODE
+
+
+class ElasticManager:
+    """Heartbeat + membership over a shared directory.
+
+    Parameters mirror the reference where meaningful: ``np`` is the
+    expected node count ("min:max" accepted), ``elastic_timeout`` the
+    grace period for the world to assemble or a dead node to be
+    declared.
+    """
+
+    def __init__(self, store_dir: str, node_id: Optional[str] = None,
+                 np=1, heartbeat_interval: float = 2.0,
+                 elastic_timeout: float = 30.0):
+        self.store_dir = store_dir
+        os.makedirs(os.path.join(store_dir, "nodes"), exist_ok=True)
+        self.node_id = node_id or f"{os.uname().nodename}-{os.getpid()}"
+        if isinstance(np, str) and ":" in np:
+            lo, hi = np.split(":")
+            self.min_np, self.max_np = int(lo), int(hi)
+        else:
+            self.min_np = self.max_np = int(np)
+        self.heartbeat_interval = heartbeat_interval
+        self.elastic_timeout = elastic_timeout
+        self._hb_path = os.path.join(store_dir, "nodes", self.node_id)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._registered_world: Optional[List[str]] = None
+        self.need_sync = False
+
+    # -- membership ----------------------------------------------------
+    def _beat(self):
+        tmp = self._hb_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"node": self.node_id, "ts": time.time()}, f)
+        os.replace(tmp, self._hb_path)
+
+    def alive_nodes(self) -> List[str]:
+        """Alive members, capped at max_np (surplus joiners are held
+        out deterministically — lexicographically first max_np win,
+        ref: manager.py world-size ceiling)."""
+        now = time.time()
+        out = []
+        ndir = os.path.join(self.store_dir, "nodes")
+        for name in sorted(os.listdir(ndir)):
+            path = os.path.join(ndir, name)
+            try:
+                if now - os.path.getmtime(path) <= self.elastic_timeout:
+                    out.append(name)
+            except OSError:
+                continue
+        return out[: self.max_np]
+
+    def rank_mapping(self) -> Dict[str, int]:
+        """Deterministic dense ranks over the REGISTERED world snapshot
+        (sorted node ids → 0..N-1). Ranks never shift mid-run; a
+        membership change instead triggers watch() → relaunch, after
+        which every node re-registers and re-derives the new mapping
+        (ref: manager._update_hosts)."""
+        world = self._registered_world or self.alive_nodes()
+        return {n: i for i, n in enumerate(world)}
+
+    def rank(self) -> int:
+        return self.rank_mapping().get(self.node_id, -1)
+
+    # -- lifecycle -----------------------------------------------------
+    def register(self):
+        """Join + start heartbeating (ref: manager.py start).
+
+        Blocks until ≥ min_np nodes are alive AND the alive set is
+        stable across two consecutive reads one heartbeat apart, so
+        concurrently-joining nodes converge on the same world snapshot.
+        """
+        self._beat()
+        deadline = time.time() + self.elastic_timeout
+        prev = None
+        while True:
+            cur = self.alive_nodes()
+            if len(cur) >= self.min_np and cur == prev:
+                break
+            if time.time() > deadline:
+                if len(cur) < self.min_np:
+                    raise TimeoutError(
+                        f"only {len(cur)}/{self.min_np} nodes joined "
+                        f"within {self.elastic_timeout}s"
+                    )
+                break  # settled-enough: membership kept churning
+            prev = cur
+            time.sleep(self.heartbeat_interval)
+            self._beat()
+        # adopt the snapshot the stability loop validated — a re-read
+        # here could race a late joiner and diverge across nodes
+        self._registered_world = cur
+
+        def loop():
+            while not self._stop.wait(self.heartbeat_interval):
+                self._beat()
+                if self.world_changed():
+                    self.need_sync = True
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self._registered_world
+
+    def world_changed(self) -> bool:
+        return self._registered_world is not None and (
+            self.alive_nodes() != self._registered_world
+        )
+
+    def watch(self) -> int:
+        """Block until membership changes; returns ELASTIC_EXIT_CODE
+        (ref: manager.py watch → exit for relaunch)."""
+        while not self.world_changed():
+            if self._stop.is_set():
+                return 0
+            time.sleep(self.heartbeat_interval)
+        return ELASTIC_EXIT_CODE
+
+    def should_shrink(self) -> bool:
+        return len(self.alive_nodes()) < self.min_np
+
+    def exit(self):
+        """Leave cleanly (ref: manager.py exit): stop beating, remove
+        the heartbeat so peers see the departure immediately."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.heartbeat_interval * 2)
+        try:
+            os.remove(self._hb_path)
+        except OSError:
+            pass

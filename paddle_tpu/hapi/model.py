@@ -53,6 +53,51 @@ class Model:
         self._jit = jit_compile
         self._train_step = None
         self._eval_step = None
+        # AMP (ref: hapi/model.py _prepare_amp): amp_configs is 'O1'/'O2'
+        # or {'level', 'dtype', 'custom_white_list', 'custom_black_list',
+        # 'use_loss_scaling', 'init_loss_scaling'}
+        self._amp_level = "O0"
+        self._amp_kwargs = {}
+        self._scaler = None
+        if amp_configs is not None:
+            if isinstance(amp_configs, str):
+                amp_configs = {"level": amp_configs}
+            cfg = dict(amp_configs)
+            self._amp_level = cfg.pop("level", "O1")
+            if self._amp_level not in ("O0", "O1", "O2"):
+                raise ValueError(
+                    f"amp level must be O0/O1/O2, got {self._amp_level}"
+                )
+            use_scaling = cfg.pop(
+                "use_loss_scaling",
+                cfg.get("dtype", "bfloat16") == "float16",
+            )
+            # scaler knobs go to GradScaler; the rest feed auto_cast
+            scaler_kwargs = {
+                k: cfg.pop(k)
+                for k in (
+                    "init_loss_scaling", "incr_ratio", "decr_ratio",
+                    "incr_every_n_steps", "decr_every_n_nan_or_inf",
+                    "use_dynamic_loss_scaling",
+                )
+                if k in cfg
+            }
+            allowed = {"dtype", "custom_white_list", "custom_black_list",
+                       "use_promote"}
+            unknown = set(cfg) - allowed
+            if unknown:
+                raise ValueError(f"unknown amp_configs keys: {sorted(unknown)}")
+            self._amp_kwargs = cfg
+            if self._amp_level != "O0":
+                from ..amp import GradScaler, decorate
+
+                if self._amp_level == "O2" and optimizer is not None:
+                    self.network, self._optimizer = decorate(
+                        models=self.network, optimizers=optimizer,
+                        level="O2", dtype=cfg.get("dtype", "bfloat16"),
+                    )
+                if use_scaling:
+                    self._scaler = GradScaler(**scaler_kwargs)
 
     # ------------------------------------------------------------------
     def _split_batch(self, batch):
@@ -64,28 +109,53 @@ class Model:
 
     def _build_train_step(self):
         network, loss_fn, optimizer = self.network, self._loss, self._optimizer
+        amp_level, amp_kwargs, scaler = (
+            self._amp_level, self._amp_kwargs, self._scaler
+        )
 
         def step(*args):
             *xs, y = args
-            out = network(*xs)
-            loss = loss_fn(out, y)
-            loss.backward()
-            optimizer.step()
+            if amp_level != "O0":
+                from ..amp import auto_cast
+
+                with auto_cast(level=amp_level, **amp_kwargs):
+                    out = network(*xs)
+                    loss = loss_fn(out, y)
+            else:
+                out = network(*xs)
+                loss = loss_fn(out, y)
+            if scaler is not None:
+                scaler.scale(loss).backward()
+                scaler.step(optimizer)
+                scaler.update()
+            else:
+                loss.backward()
+                optimizer.step()
             optimizer.clear_grad()
             return loss, out
 
         if self._jit:
             from .. import jit
 
-            step = jit.to_static(step, layers=[network], optimizers=[optimizer])
+            step = jit.to_static(
+                step, layers=[network], optimizers=[optimizer],
+                scalers=[scaler] if scaler is not None else (),
+            )
         return step
 
     def _build_eval_step(self):
         network, loss_fn = self.network, self._loss
+        amp_level, amp_kwargs = self._amp_level, self._amp_kwargs
 
         def step(*args):
             *xs, y = args
-            out = network(*xs)
+            if amp_level != "O0":
+                from ..amp import auto_cast
+
+                with auto_cast(level=amp_level, **amp_kwargs):
+                    out = network(*xs)
+            else:
+                out = network(*xs)
             loss = loss_fn(out, y) if loss_fn is not None else None
             return loss, out
 

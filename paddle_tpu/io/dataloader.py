@@ -288,8 +288,10 @@ class DataLoader:
       0 means synchronous in-loop fetching.
     - ``return_list`` defaults True (dygraph semantics); outputs are
       Tensors on the default device unless ``return_numpy=True``.
-    - ``use_shared_memory``/``use_buffer_reader`` accepted as no-ops
-      (CUDA-specific plumbing).
+    - ``worker_type='process'`` spawns worker processes that stream
+      collated batches through a native C++ POSIX-shm ring
+      (io/shm_ring.py); requires ``use_shared_memory=True`` (default).
+    - ``use_buffer_reader`` is accepted as a no-op (CUDA plumbing).
     """
 
     def __init__(
@@ -311,6 +313,7 @@ class DataLoader:
         worker_init_fn: Optional[Callable] = None,
         persistent_workers: bool = False,
         return_numpy: bool = False,
+        worker_type: str = "thread",
     ):
         self.dataset = dataset
         self.return_list = return_list
@@ -319,14 +322,27 @@ class DataLoader:
         self.return_numpy = return_numpy
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
-        self.persistent_workers = persistent_workers  # threads are cheap;
-        # accepted for parity, workers are (re)spawned per epoch
+        # accepted for parity; workers are (re)spawned per epoch in both
+        # modes. Thread workers make that free; process workers pay a
+        # spawn+import per epoch — prefer thread workers for small
+        # datasets/epochs until persistent process pools land
+        self.persistent_workers = persistent_workers
+        if worker_type not in ("thread", "process"):
+            raise ValueError("worker_type must be 'thread' or 'process'")
+        self.worker_type = worker_type
+        self.use_shared_memory = use_shared_memory
         self._iterable = isinstance(dataset, IterableDataset)
 
         if self._iterable:
             if batch_sampler is not None or shuffle:
                 raise ValueError(
                     "IterableDataset does not support batch_sampler/shuffle"
+                )
+            if worker_type == "process":
+                raise ValueError(
+                    "worker_type='process' is not supported for "
+                    "IterableDataset (streams cannot be index-partitioned); "
+                    "use worker_type='thread'"
                 )
             self.batch_size = batch_size or 1
             self.drop_last = drop_last
@@ -376,6 +392,23 @@ class DataLoader:
             # thread stages batches ahead (host/device overlap)
             return _StreamPrefetchIter(self, it) if self.num_workers > 0 else it
         batch_iter = iter(self.batch_sampler)
+        if self.num_workers > 0 and self.worker_type == "process":
+            # spawned workers + C++ shared-memory ring transport (the
+            # reference's multiprocess mode; see io/shm_ring.py)
+            if not self.use_shared_memory:
+                raise ValueError(
+                    "worker_type='process' requires use_shared_memory=True "
+                    "(the shm ring is the only process transport); use "
+                    "worker_type='thread' where POSIX shm is unavailable"
+                )
+            from .shm_ring import ProcessPrefetchIter, native_available
+
+            if not native_available():
+                raise RuntimeError(
+                    "worker_type='process' needs the native shm ring "
+                    "(g++ + POSIX shm); fall back to worker_type='thread'"
+                )
+            return ProcessPrefetchIter(self, [list(b) for b in batch_iter])
         if self.num_workers > 0:
             return _PrefetchIter(self, batch_iter)
         return _SyncIter(self, batch_iter)

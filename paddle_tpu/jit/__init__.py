@@ -64,6 +64,7 @@ class StaticFunction:
         fn: Callable,
         layers: Sequence = (),
         optimizers: Sequence = (),
+        scalers: Sequence = (),
         donate_state: bool = True,
         state_shardings=None,
         in_shardings=None,
@@ -77,6 +78,7 @@ class StaticFunction:
             layers = [layers]
         self._layers = list(layers)
         self._optimizers = list(optimizers)
+        self._scalers = list(scalers)
         if not self._layers and not self._optimizers:
             self._auto_discover(fn)
         self._donate_state = donate_state
@@ -92,6 +94,7 @@ class StaticFunction:
         """Find Layers/Optimizers in the function's closure + globals
         (the SOT front-end does this at bytecode level; here a direct
         object scan suffices for the supported idiom)."""
+        from ..amp.grad_scaler import AmpScaler
         from ..nn.layer.layers import Layer
         from ..optimizer.optimizer import Optimizer
 
@@ -105,6 +108,8 @@ class StaticFunction:
                 self._layers.append(obj)
             elif isinstance(obj, Optimizer) and obj not in self._optimizers:
                 self._optimizers.append(obj)
+            elif isinstance(obj, AmpScaler) and obj not in self._scalers:
+                self._scalers.append(obj)
 
     def _collect_cells(self):
         cells, seen = [], set()
@@ -129,6 +134,10 @@ class StaticFunction:
         return {
             "cells": [c._data for c in self._cells],
             "accums": [o._accumulators for o in self._optimizers],
+            "scalers": [
+                (s._scale, s._good_steps, s._bad_steps, s._found_inf)
+                for s in self._scalers
+            ],
             "rng": _random.default_generator().get_state(),
             "tracker": _random.get_rng_state_tracker().get_states_dict(),
         }
@@ -138,6 +147,8 @@ class StaticFunction:
             c._data = arr
         for o, acc in zip(self._optimizers, state["accums"]):
             o._accumulators = acc
+        for sc, vals in zip(self._scalers, state.get("scalers", [])):
+            sc._scale, sc._good_steps, sc._bad_steps, sc._found_inf = vals
         _random.default_generator().set_state(state["rng"])
         _random.get_rng_state_tracker().set_states_dict(state["tracker"])
 
@@ -312,6 +323,7 @@ def to_static(
     backend=None,
     layers=(),
     optimizers=(),
+    scalers=(),
     full_graph=True,
     **kwargs,
 ):
@@ -330,7 +342,9 @@ def to_static(
             sf = StaticFunction(obj.forward, layers=[obj], **kwargs)
             obj.forward = sf
             return obj
-        return StaticFunction(obj, layers=layers, optimizers=optimizers, **kwargs)
+        return StaticFunction(
+            obj, layers=layers, optimizers=optimizers, scalers=scalers, **kwargs
+        )
 
     if function is not None:
         return decorate(function)

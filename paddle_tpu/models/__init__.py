@@ -18,3 +18,4 @@ from .bert import (  # noqa: F401
     BertModel,
 )
 from .unet import UNet2DConditionModel, UNetConfig  # noqa: F401
+from .generation import generate  # noqa: F401

@@ -1,0 +1,206 @@
+"""Autoregressive generation with KV caches.
+
+ref: generation lives downstream of the reference (PaddleNLP
+generation_utils: greedy/sampling loops over cached decoders); the
+in-repo surface it depends on is the cached attention path this module
+drives.
+
+TPU-native design: KV caches are **buffers of a cache-state Layer**, so
+``jit.to_static`` threads and DONATES them with the rest of the model
+state — each decode step updates the caches in place on device (no
+per-token cache copy) and the compiled prefill/decode programs are
+cached on the model and reused across ``generate`` calls (static
+shapes, no per-length retrace). Sampling keys draw from the framework
+RNG (threaded through the compiled step).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import random as _random
+from ..base.tape import apply
+from ..base.tensor import Tensor
+
+__all__ = ["alloc_kv_caches", "update_kv_cache", "generate"]
+
+
+def alloc_kv_caches(num_layers, batch, max_len, num_kv_heads, head_dim, dtype):
+    caches = []
+    for _ in range(num_layers):
+        k = Tensor(jnp.zeros((batch, max_len, num_kv_heads, head_dim), dtype),
+                   _internal=True)
+        v = Tensor(jnp.zeros((batch, max_len, num_kv_heads, head_dim), dtype),
+                   _internal=True)
+        caches.append((k, v))
+    return caches
+
+
+def update_kv_cache(kk, vv, kc, vc, cl, s: int):
+    """Shared cache-write + causal-mask protocol (raw jnp arrays; used
+    by both Llama and GPT attention): writes the new [B, s, H, D] block
+    at position ``cl`` and returns (k_cache, v_cache, mask) where mask
+    is the [1, 1, s, max_len] bool mask letting query i see keys
+    <= cl + i."""
+    max_len = kc.shape[1]
+    kc = jax.lax.dynamic_update_slice(kc, kk.astype(kc.dtype), (0, cl, 0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, vv.astype(vc.dtype), (0, cl, 0, 0))
+    k_idx = jnp.arange(max_len)[None, :]
+    q_idx = cl + jnp.arange(s)[:, None]
+    return kc, vc, (k_idx <= q_idx)[None, None]
+
+
+class _KVCacheState:
+    """Holds cache tensors as non-persistable buffers of a Layer so the
+    compiled step threads + donates them (see module docstring)."""
+
+    def __init__(self, model, batch, max_len):
+        from ..nn.layer.layers import Layer
+
+        class Holder(Layer):
+            pass
+
+        self.holder = Holder()
+        caches = model.init_cache(batch, max_len)
+        self.n = len(caches)
+        self.shapes_dtypes = []
+        for i, (k, v) in enumerate(caches):
+            self.holder.register_buffer(f"k{i}", k, persistable=False)
+            self.holder.register_buffer(f"v{i}", v, persistable=False)
+            self.shapes_dtypes.append((tuple(k.shape), k._data.dtype))
+
+    def caches(self):
+        return [
+            (self.holder._buffers[f"k{i}"], self.holder._buffers[f"v{i}"])
+            for i in range(self.n)
+        ]
+
+    def set(self, new_caches):
+        for i, (k, v) in enumerate(new_caches):
+            self.holder._buffers[f"k{i}"]._data = k._data
+            self.holder._buffers[f"v{i}"]._data = v._data
+
+    def reset(self):
+        for i, (shape, dt) in enumerate(self.shapes_dtypes):
+            self.holder._buffers[f"k{i}"]._data = jnp.zeros(shape, dt)
+            self.holder._buffers[f"v{i}"]._data = jnp.zeros(shape, dt)
+
+
+def _sample(logits, temperature: float, top_k: int):
+    """logits [B, V] → token ids [B]; greedy when temperature == 0."""
+
+    def f(lg):
+        if temperature == 0:
+            return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        lg = lg.astype(jnp.float32) / temperature
+        if top_k > 0:
+            kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        key = _random.next_key()
+        return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+    return apply(f, logits, op_name="sample_token")
+
+
+def _get_compiled(model, b, s, max_len, temperature, top_k, use_jit):
+    """Build (or fetch) the prefill/decode programs + cache state for
+    this (batch, prompt-len, max-len, sampling) signature."""
+    from .. import jit
+
+    key = (b, s, max_len, temperature, top_k, use_jit)
+    store = getattr(model, "_generation_programs", None)
+    if store is None:
+        store = model._generation_programs = {}
+    if key in store:
+        state, prefill, decode = store.pop(key)  # re-insert as newest
+        store[key] = (state, prefill, decode)
+        state.reset()
+        return state, prefill, decode
+    # bound the program cache: each entry pins full KV buffers + two
+    # compiled programs; varying prompt lengths would otherwise grow
+    # device memory without limit (LRU, insertion-ordered dict)
+    while len(store) >= 4:
+        store.pop(next(iter(store)))
+
+    state = _KVCacheState(model, b, max_len)
+
+    def prefill(ids, cur_len):
+        logits, new = model.forward_with_cache(ids, state.caches(), cur_len)
+        state.set(new)
+        return _sample(logits[:, -1], temperature, top_k)
+
+    def decode(tok, cur_len):
+        logits, new = model.forward_with_cache(
+            tok.reshape([b, 1]), state.caches(), cur_len
+        )
+        state.set(new)
+        return _sample(logits[:, -1], temperature, top_k)
+
+    if use_jit:
+        prefill = jit.to_static(prefill, layers=[model, state.holder])
+        decode = jit.to_static(decode, layers=[model, state.holder])
+    store[key] = (state, prefill, decode)
+    return state, prefill, decode
+
+
+def generate(model, input_ids, max_new_tokens: int = 32,
+             temperature: float = 0.0, top_k: int = 0,
+             eos_token_id: Optional[int] = None, use_jit: bool = True):
+    """Generate ``max_new_tokens`` continuations of ``input_ids``
+    ([B, S] int Tensor) with KV caching. Returns [B, S + new] ids.
+
+    ``model`` must provide ``init_cache(batch, max_len)`` and
+    ``forward_with_cache(ids, caches, cur_len) -> (logits, caches)``
+    (models.LlamaForCausalLM / GPTForCausalLM do).
+    """
+    from .. import to_tensor
+    from ..base.tape import no_grad
+
+    b, s = input_ids.shape
+    if max_new_tokens <= 0:
+        return input_ids
+    max_len = s + max_new_tokens
+    limit = getattr(getattr(model, "config", None), "max_position_embeddings", None)
+    if limit is not None and max_len > limit:
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) = {max_len} "
+            f"exceeds the model's max_position_embeddings ({limit})"
+        )
+
+    was_training = model.training
+    model.eval()
+    try:
+        with no_grad():
+            state, prefill, decode = _get_compiled(
+                model, b, s, max_len, temperature, top_k, use_jit
+            )
+            zero = to_tensor(np.asarray(0, np.int32))
+            tok = prefill(input_ids, zero)
+            out = [tok]
+            finished = apply(
+                lambda t: jnp.zeros(t.shape, bool), tok, op_name="zeros_like"
+            )
+            for step_i in range(1, max_new_tokens):
+                cur = to_tensor(np.asarray(s + step_i - 1, np.int32))
+                tok = decode(tok, cur)
+                if eos_token_id is not None:
+                    # once a row emits eos, freeze it to eos thereafter
+                    finished = apply(
+                        lambda f, p: f | (p == eos_token_id),
+                        finished, out[-1], op_name="eos_track",
+                    )
+                    tok = apply(
+                        lambda t, f: jnp.where(f, eos_token_id, t),
+                        tok, finished, op_name="eos_mask",
+                    )
+                out.append(tok)
+            from ..tensor.manipulation import concat, stack
+
+            new_tokens = stack(out, axis=1)  # [B, new]
+            return concat([input_ids, new_tokens.astype(input_ids.dtype)], axis=1)
+    finally:
+        if was_training:
+            model.train()

@@ -71,18 +71,36 @@ class GPTAttention(nn.Layer):
         self.out_proj.weight.tp_axis = 0  # row parallel
         self.dropout = config.dropout
 
-    def forward(self, x):
+    def forward(self, x, cache=None, cur_len=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)  # [B, S, 3H]
         qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q = qkv[:, :, 0]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.dropout,
-            training=self.training,
+        if cache is None:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.dropout,
+                training=self.training,
+            )
+            return self.out_proj(M.reshape(out, [b, s, h]))
+
+        from ..base.tape import apply
+        from .generation import update_kv_cache
+
+        k_cache, v_cache = cache
+
+        def step(kk, vv, kc, vc, cl):
+            return update_kv_cache(kk, vv, kc, vc, cl, s)
+
+        k_cache, v_cache, mask = apply(
+            step, k, v, k_cache, v_cache, cur_len, op_name="kv_cache_update"
         )
-        return self.out_proj(M.reshape(out, [b, s, h]))
+        out = F.scaled_dot_product_attention(
+            q, k_cache, v_cache, attn_mask=mask, is_causal=False,
+            dropout_p=self.dropout, training=self.training,
+        )
+        return self.out_proj(M.reshape(out, [b, s, h])), (k_cache, v_cache)
 
 
 class GPTBlock(nn.Layer):
@@ -97,10 +115,15 @@ class GPTBlock(nn.Layer):
         self.fc2.weight.tp_axis = 0
         self.dropout = nn.Dropout(config.dropout)
 
-    def forward(self, x):
-        x = x + self.attn(self.ln_1(x))
+    def forward(self, x, cache=None, cur_len=None):
+        if cache is None:
+            x = x + self.attn(self.ln_1(x))
+        else:
+            attn_out, cache = self.attn(self.ln_1(x), cache=cache, cur_len=cur_len)
+            x = x + attn_out
         h = self.fc2(F.gelu(self.fc1(self.ln_2(x))))
-        return x + self.dropout(h)
+        out = x + self.dropout(h)
+        return out if cache is None else (out, cache)
 
 
 class GPTModel(nn.Layer):
@@ -114,18 +137,30 @@ class GPTModel(nn.Layer):
         self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
         self.drop = nn.Dropout(config.dropout)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, cur_len=None):
         b, s = input_ids.shape
         import jax.numpy as jnp
 
         from ..base.tape import apply
 
-        pos = apply(lambda: jnp.arange(s, dtype=jnp.int32)[None, :], op_name="arange")
+        if caches is None:
+            pos = apply(lambda: jnp.arange(s, dtype=jnp.int32)[None, :], op_name="arange")
+        else:
+            pos = apply(
+                lambda cl: (cl + jnp.arange(s, dtype=jnp.int32))[None, :],
+                cur_len, op_name="arange_offset",
+            )
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
-        for block in self.h:
-            x = block(x)
-        return self.ln_f(x)
+        if caches is None:
+            for block in self.h:
+                x = block(x)
+            return self.ln_f(x)
+        new_caches = []
+        for block, cache in zip(self.h, caches):
+            x, cache = block(x, cache=cache, cur_len=cur_len)
+            new_caches.append(cache)
+        return self.ln_f(x), new_caches
 
 
 class GPTForCausalLM(nn.Layer):
@@ -138,6 +173,20 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids):
         return self.lm_head(self.transformer(input_ids))
+
+    def init_cache(self, batch: int, max_len: int, dtype=None):
+        from .generation import alloc_kv_caches
+
+        c = self.config
+        return alloc_kv_caches(
+            c.num_hidden_layers, batch, max_len, c.num_attention_heads,
+            c.hidden_size // c.num_attention_heads,
+            dtype or self.transformer.wte.weight.dtype,
+        )
+
+    def forward_with_cache(self, input_ids, caches, cur_len):
+        h, caches = self.transformer(input_ids, caches=caches, cur_len=cur_len)
+        return self.lm_head(h), caches
 
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())

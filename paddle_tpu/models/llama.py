@@ -57,10 +57,11 @@ class LlamaConfig:
 
 
 def _rope(q, k, theta, position_offset=0):
-    """Rotary position embedding on [B, S, H, D] (half-split layout)."""
+    """Rotary position embedding on [B, S, H, D] (half-split layout).
+    ``position_offset`` may be a traced scalar (KV-cache decode)."""
     d = q.shape[-1]
     s = q.shape[1]
-    pos = jnp.arange(position_offset, position_offset + s, dtype=jnp.float32)
+    pos = jnp.arange(s, dtype=jnp.float32) + position_offset
     inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
     angles = pos[:, None] * inv_freq[None, :]  # [S, D/2]
     cos = jnp.cos(angles)[None, :, None, :]
@@ -93,7 +94,10 @@ class LlamaAttention(nn.Layer):
         self.v_proj.weight.tp_axis = 1
         self.o_proj.weight.tp_axis = 0  # row parallel
 
-    def forward(self, x, position_offset=0):
+    def forward(self, x, position_offset=0, cache=None, cur_len=None):
+        """cache: optional (k_cache, v_cache) Tensors [B, max_len, Hkv, D]
+        with ``cur_len`` (scalar Tensor) valid entries; returns
+        (out, new_cache) when caching (KV-cache decode path)."""
         b, s = x.shape[0], x.shape[1]
         from ..tensor import manipulation as M
 
@@ -102,12 +106,32 @@ class LlamaAttention(nn.Layer):
         v = M.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
         theta = self.config.rope_theta
 
-        q, k = apply(
-            lambda qq, kk: _rope(qq, kk, theta, position_offset), q, k, op_name="rope"
+        if cache is None:
+            q, k = apply(
+                lambda qq, kk: _rope(qq, kk, theta, position_offset), q, k, op_name="rope"
+            )
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True, training=self.training)
+            out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out)
+
+        k_cache, v_cache = cache
+
+        def step(qq, kk, vv, kc, vc, cl):
+            from .generation import update_kv_cache
+
+            qq, kk = _rope(qq, kk, theta, cl.astype(jnp.float32))
+            kc, vc, mask = update_kv_cache(kk, vv, kc, vc, cl, s)
+            return qq, kc, vc, mask
+
+        q, k_cache, v_cache, mask = apply(
+            step, q, k, v, k_cache, v_cache, cur_len, op_name="kv_cache_update"
         )
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True, training=self.training)
+        out = F.scaled_dot_product_attention(
+            q, k_cache, v_cache, attn_mask=mask, is_causal=False,
+            training=self.training,
+        )
         out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
-        return self.o_proj(out)
+        return self.o_proj(out), (k_cache, v_cache)
 
 
 class LlamaMLP(nn.Layer):
@@ -135,10 +159,16 @@ class LlamaDecoderLayer(nn.Layer):
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x):
-        x = x + self.self_attn(self.input_layernorm(x))
+    def forward(self, x, cache=None, cur_len=None):
+        if cache is None:
+            x = x + self.self_attn(self.input_layernorm(x))
+        else:
+            attn_out, cache = self.self_attn(
+                self.input_layernorm(x), cache=cache, cur_len=cur_len
+            )
+            x = x + attn_out
         x = x + self.mlp(self.post_attention_layernorm(x))
-        return x
+        return x if cache is None else (x, cache)
 
 
 class LlamaModel(nn.Layer):
@@ -150,11 +180,17 @@ class LlamaModel(nn.Layer):
         self.layers = nn.LayerList([LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, cur_len=None):
         x = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            x = layer(x)
-        return self.norm(x)
+        if caches is None:
+            for layer in self.layers:
+                x = layer(x)
+            return self.norm(x)
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            x, cache = layer(x, cache=cache, cur_len=cur_len)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
 
 
 class LlamaForCausalLM(nn.Layer):
@@ -170,10 +206,28 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids):
         h = self.llama(input_ids)
+        return self._head(h)
+
+    def _head(self, h):
         if self.lm_head is None:
             w = self.llama.embed_tokens.weight
             return apply(lambda a, ww: a @ ww.T, h, w, op_name="tied_lm_head")
         return self.lm_head(h)
+
+    # -- KV-cache generation (see models/generation.py) -----------------
+    def init_cache(self, batch: int, max_len: int, dtype=None):
+        from .generation import alloc_kv_caches
+
+        c = self.config
+        return alloc_kv_caches(
+            c.num_hidden_layers, batch, max_len, c.num_key_value_heads,
+            c.hidden_size // c.num_attention_heads,
+            dtype or self.llama.embed_tokens.weight.dtype,
+        )
+
+    def forward_with_cache(self, input_ids, caches, cur_len):
+        h, caches = self.llama(input_ids, caches=caches, cur_len=cur_len)
+        return self._head(h), caches
 
     def loss(self, input_ids, labels):
         logits = self(input_ids)

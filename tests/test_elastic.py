@@ -1,0 +1,84 @@
+"""Elastic manager tests (ref pattern: test/collective/fleet/
+test_fleet_elastic_manager.py — membership, rank assignment, scale
+detection, clean exit)."""
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import ELASTIC_EXIT_CODE, ElasticManager
+
+
+def _mgr(tmp_path, node, **kw):
+    kw.setdefault("heartbeat_interval", 0.1)
+    kw.setdefault("elastic_timeout", 2.0)
+    return ElasticManager(str(tmp_path), node_id=node, **kw)
+
+
+class TestElastic:
+    def test_register_and_ranks(self, tmp_path):
+        a = _mgr(tmp_path, "node-a", np=2)
+        b = _mgr(tmp_path, "node-b", np=2)
+        b._beat()
+        world = a.register()
+        b.register()
+        assert world == ["node-a", "node-b"]
+        assert a.rank() == 0 and b.rank() == 1
+        a.exit()
+        b.exit()
+
+    def test_register_timeout_when_under_min(self, tmp_path):
+        a = _mgr(tmp_path, "solo", np=3, elastic_timeout=0.5)
+        with pytest.raises(TimeoutError):
+            a.register()
+
+    def test_scale_down_detected(self, tmp_path):
+        a = _mgr(tmp_path, "node-a", np="1:2")
+        b = _mgr(tmp_path, "node-b", np="1:2")
+        b._beat()
+        a.register()
+        b.register()
+        assert not a.world_changed()
+        b.exit()  # removes heartbeat immediately
+        assert a.world_changed()
+        assert a.watch() == ELASTIC_EXIT_CODE
+        assert not a.should_shrink()  # min 1, one node still alive
+        a.exit()
+
+    def test_scale_up_detected(self, tmp_path):
+        a = _mgr(tmp_path, "node-a", np="1:4")
+        a.register()
+        c = _mgr(tmp_path, "node-c", np="1:4")
+        c._beat()
+        assert a.world_changed()
+        # ranks are pinned to the registered snapshot until relaunch
+        assert a.rank_mapping() == {"node-a": 0}
+        a.exit()
+        # after the relaunch both nodes re-register and agree
+        a2 = _mgr(tmp_path, "node-a", np="1:4")
+        a2.register()
+        c.register()
+        assert a2.rank_mapping() == c.rank_mapping() == {
+            "node-a": 0, "node-c": 1,
+        }
+        a2.exit()
+        c.exit()
+
+    def test_max_np_holds_out_surplus(self, tmp_path):
+        for name in ("node-a", "node-b", "node-c"):
+            _mgr(tmp_path, name, np="1:2")._beat()
+        a = _mgr(tmp_path, "node-a", np="1:2")
+        world = a.register()
+        assert world == ["node-a", "node-b"]  # max 2, lexicographic
+        assert a.rank_mapping() == {"node-a": 0, "node-b": 1}
+        held_out = _mgr(tmp_path, "node-c", np="1:2")
+        assert held_out.rank() == -1
+        a.exit()
+
+    def test_dead_node_expires(self, tmp_path):
+        a = _mgr(tmp_path, "node-a", np=1, elastic_timeout=0.3)
+        ghost = _mgr(tmp_path, "node-ghost", np=1, elastic_timeout=0.3)
+        ghost._beat()  # beats once, never again (simulated crash)
+        a.register()
+        time.sleep(0.5)
+        assert "node-ghost" not in a.alive_nodes()
+        a.exit()

@@ -119,6 +119,31 @@ class TestHapiModel:
         m.fit(_RandomDS(n=8), epochs=1, batch_size=4, verbose=0)
         assert sched.last_epoch >= 2  # stepped per train batch
 
+    @pytest.mark.parametrize("amp_cfg", [
+        "O1",
+        {"level": "O2", "dtype": "bfloat16"},
+        {"level": "O1", "dtype": "float16", "use_loss_scaling": True},
+    ])
+    def test_fit_with_amp(self, amp_cfg):
+        """prepare(amp_configs=...) — O1/O2 casting and fp16 GradScaler
+        state threaded through the compiled step (ref: hapi model
+        _prepare_amp)."""
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        m = paddle.Model(net)
+        m.prepare(
+            optimizer=opt.Adam(learning_rate=1e-2, parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=Accuracy(),
+            amp_configs=amp_cfg,
+        )
+        m.fit(_RandomDS(), epochs=4, batch_size=16, verbose=0)
+        ev = m.evaluate(_RandomDS(n=32, seed=1), batch_size=16, verbose=0)
+        assert ev["acc"] > 0.7, (amp_cfg, ev)
+        if isinstance(amp_cfg, dict) and amp_cfg.get("use_loss_scaling"):
+            assert m._scaler is not None
+            assert float(m._scaler.get_scale_value()) > 0
+
     def test_summary(self, capsys):
         net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
         info = paddle.summary(net, (1, 8))

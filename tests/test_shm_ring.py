@@ -1,0 +1,128 @@
+"""Native shared-memory ring + process-worker DataLoader tests.
+
+ref pattern: test/legacy_test/test_multiprocess_dataloader_static.py —
+transport correctness, ordering, multi-epoch reuse, worker error
+surfacing. The ring itself is exercised cross-process.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io.shm_ring import RingBuffer, native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native shm ring not buildable here"
+)
+
+
+class RowsDS(Dataset):
+    def __len__(self):
+        return 20
+
+    def __getitem__(self, i):
+        return np.full((4,), i, np.float32), np.int64(i % 3)
+
+
+class TestRingBuffer:
+    def test_roundtrip_and_wrap(self):
+        rb = RingBuffer(capacity=1 << 12)
+        try:
+            for i in range(64):  # forces multiple wraps of the 4K ring
+                msg = bytes([i]) * (i * 7 % 300 + 1)
+                rb.push(msg)
+                assert rb.pop() == msg
+        finally:
+            rb.detach()
+            rb.unlink()
+
+    def test_close_drains(self):
+        rb = RingBuffer(capacity=1 << 12)
+        try:
+            rb.push(b"a")
+            rb.close()
+            assert rb.pop() == b"a"
+            assert rb.pop() is None
+        finally:
+            rb.detach()
+            rb.unlink()
+
+    def test_oversized_message_raises(self):
+        rb = RingBuffer(capacity=1 << 10)
+        try:
+            with pytest.raises(ValueError):
+                rb.push(b"x" * (1 << 11))
+        finally:
+            rb.detach()
+            rb.unlink()
+
+    def test_pop_timeout(self):
+        rb = RingBuffer(capacity=1 << 10)
+        try:
+            with pytest.raises(TimeoutError):
+                rb.pop(timeout=0.1)
+        finally:
+            rb.detach()
+            rb.unlink()
+
+    def test_cross_process(self):
+        import multiprocessing as mp
+
+        rb = RingBuffer(capacity=1 << 16)
+        try:
+            ctx = mp.get_context("spawn")
+            p = ctx.Process(target=_producer, args=(rb.name,))
+            p.start()
+            got = [rb.pop(timeout=60.0) for _ in range(5)]
+            p.join(30)
+            assert got == [f"msg{i}".encode() for i in range(5)]
+        finally:
+            rb.detach()
+            rb.unlink()
+
+
+def _producer(name):
+    rb = RingBuffer(name, create=False)
+    for i in range(5):
+        rb.push(f"msg{i}".encode())
+    rb.detach()
+
+
+class TestProcessDataLoader:
+    def test_order_and_content(self):
+        dl = DataLoader(RowsDS(), batch_size=4, num_workers=2,
+                        worker_type="process")
+        batches = list(dl)
+        assert len(batches) == 5
+        xs = np.concatenate([b[0].numpy()[:, 0] for b in batches])
+        np.testing.assert_array_equal(xs, np.arange(20, dtype=np.float32))
+
+    def test_second_epoch(self):
+        dl = DataLoader(RowsDS(), batch_size=5, num_workers=2,
+                        worker_type="process")
+        assert len(list(dl)) == 4
+        assert len(list(dl)) == 4
+
+    def test_worker_error_surfaces_traceback(self):
+        dl = DataLoader(BadDS(), batch_size=2, num_workers=2,
+                        worker_type="process")
+        with pytest.raises(RuntimeError, match="boom"):
+            list(dl)
+
+    def test_iterable_process_rejected(self):
+        from paddle_tpu.io import IterableDataset
+
+        class S(IterableDataset):
+            def __iter__(self):
+                yield np.float32(0)
+
+        with pytest.raises(ValueError, match="IterableDataset"):
+            DataLoader(S(), batch_size=1, num_workers=2, worker_type="process")
+
+
+class BadDS(Dataset):
+    def __len__(self):
+        return 4
+
+    def __getitem__(self, i):
+        raise ValueError("boom")
