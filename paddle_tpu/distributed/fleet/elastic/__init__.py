@@ -72,6 +72,8 @@ class ElasticManager:
         out = []
         ndir = os.path.join(self.store_dir, "nodes")
         for name in sorted(os.listdir(ndir)):
+            if name.endswith(".tmp"):
+                continue  # in-flight _beat() write, not a member
             path = os.path.join(ndir, name)
             try:
                 if now - os.path.getmtime(path) <= self.elastic_timeout:

@@ -13,10 +13,22 @@ public scaling-book recipe) is:
 - stage parameters are STACKED along a leading ``pp`` dim and sharded
   over the ``pp`` mesh axis — each device group holds exactly its
   stage's weights (true PP memory scaling);
-- the schedule is a ``lax.scan`` over M + S - 1 ticks inside
-  ``shard_map``: every tick each stage applies its block to its current
+- the schedule is a ``lax.scan`` over M·V + S - 1 ticks inside
+  ``shard_map``: every tick each stage applies one chunk to its current
   activation, then a ``lax.ppermute`` ring-shift hands activations to
   the next stage (the p2p of the reference, compiled onto ICI);
+- ``num_virtual_pipeline_stages=V > 1`` gives the interleaved (VPP)
+  schedule (ref: pp_layers.py get_stage_from_index interleave
+  assignment; pipeline_parallel.py forward_backward_pipeline
+  virtual-pp branch): each device holds V non-contiguous chunks
+  (device s owns logical chunks {v·S+s}), activations lap the ring V
+  times, and the bubble shrinks from (S-1)/(M+S-1) to
+  (S-1)/(M·V+S-1) because a tick is now one chunk (1/V of a stage).
+  The conflict-free tick map is: device s at tick t computes
+  n = t - s; group g = n // (S·V); chunk v = (n mod S·V) // S;
+  microbatch m = g·S + (n mod S) — injective per device, and every
+  producer's output is consumed exactly one tick later, so a single
+  ring ppermute carries all inter-chunk traffic;
 - backward is NOT hand-scheduled: jax.vjp transposes the scan and the
   ppermute, yielding the reverse pipeline automatically (the schedule
   the reference implements by hand in _backward_step).
@@ -115,6 +127,7 @@ class PipelineLayer(nn.Layer):
         loss_fn: Optional[Callable] = None,
         seg_method: str = "uniform",
         recompute_interval: int = 0,
+        num_virtual_pipeline_stages: int = 1,
         **kwargs,
     ):
         super().__init__()
@@ -123,7 +136,13 @@ class PipelineLayer(nn.Layer):
 
             hcg = get_hybrid_communicate_group()
             num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        V = int(num_virtual_pipeline_stages or 1)
+        if V < 1:
+            raise ValueError("num_virtual_pipeline_stages must be >= 1")
+        if V > 1 and num_stages <= 1:
+            V = 1  # interleaving is meaningless on a single stage
         self._num_stages = num_stages
+        self._num_virtual = V
         self._loss_fn = loss_fn
         self._recompute_interval = recompute_interval
         self._topo = topology
@@ -144,7 +163,8 @@ class PipelineLayer(nn.Layer):
 
     # -- segmentation --------------------------------------------------
     def _segment(self, built: List[nn.Layer]):
-        S = self._num_stages
+        S, V = self._num_stages, self._num_virtual
+        L = S * V  # logical chunks
         sigs = [_param_sig(l) for l in built]
         # maximal uniform run of layers with identical (non-empty) signature
         best = (0, 0)  # (length, start)
@@ -160,51 +180,72 @@ class PipelineLayer(nn.Layer):
                 best = (j - i, i)
             i = j
         run_len, start = best
-        body_len = (run_len // S) * S if S > 1 else run_len
+        body_len = (run_len // L) * L if S > 1 else run_len
         if S > 1 and body_len == 0:
             raise ValueError(
-                f"PipelineLayer: need a run of >= {S} structurally identical "
-                f"layers to form {S} stages; longest run is {run_len}"
+                f"PipelineLayer: need a run of >= {L} structurally identical "
+                f"layers to form {S} stages x {V} virtual chunks; longest "
+                f"run is {run_len}"
             )
         self._pre = nn.LayerList(built[: start])
         body = built[start : start + body_len]
         self._post = nn.LayerList(built[start + body_len :])
-        # stages: S groups of body_len // S layers
-        per = body_len // S if S else body_len
-        self._stage_groups = [body[s * per : (s + 1) * per] for s in range(S)] if S > 1 else [body]
-        # template = stage 0's layers; held out of sublayer registration
-        object.__setattr__(self, "_template", self._stage_groups[0])
+        # chunks: L groups of body_len // L layers, logical order
+        per = body_len // L if S > 1 else body_len
+        self._chunk_groups = (
+            [body[c * per : (c + 1) * per] for c in range(L)] if S > 1 else [body]
+        )
+        # template = logical chunk 0's layers; held out of sublayer registration
+        object.__setattr__(self, "_template", self._chunk_groups[0])
 
     # -- stacking ------------------------------------------------------
+    def _stacked_index(self, chunk: int) -> int:
+        """Logical chunk l = v*S + s lives at stacked row s*V + v, so a
+        P('pp') sharding of the leading [S*V] dim hands device s exactly
+        its V interleave-assigned chunks (ref: pp_layers.py
+        get_stage_from_index)."""
+        S, V = self._num_stages, self._num_virtual
+        v, s = divmod(chunk, S)
+        return s * V + v
+
     def _stack_body(self):
-        """Stack per-stage params into [S, ...] Parameters sharded over pp."""
-        S = self._num_stages
+        """Stack per-chunk params into [S*V, ...] Parameters sharded over pp
+        (row s*V+v = logical chunk v*S+s; V=1 reduces to [S, ...] with
+        row s = stage s)."""
+        S, V = self._num_stages, self._num_virtual
         self._stacked: List[Parameter] = []
         if S <= 1:
             # single stage: register body layers normally
-            self._body_layers = nn.LayerList(self._stage_groups[0])
+            self._body_layers = nn.LayerList(self._chunk_groups[0])
             return
+        L = S * V
         template_params = [p for l in self._template for _, p in l.named_parameters()]
-        per_stage = [
+        per_chunk = [
             [p for l in grp for _, p in l.named_parameters()]
-            for grp in self._stage_groups
+            for grp in self._chunk_groups
         ]
+        # row j of the stack holds logical chunk l where j = _stacked_index(l)
+        row_to_chunk = [0] * L
+        for l in range(L):
+            row_to_chunk[self._stacked_index(l)] = l
         for k, tp in enumerate(template_params):
-            stacked = jnp.stack([per_stage[s][k]._data for s in range(S)], axis=0)
+            stacked = jnp.stack(
+                [per_chunk[row_to_chunk[j]][k]._data for j in range(L)], axis=0
+            )
             param = Parameter(stacked)
             param.tp_axis = getattr(tp, "tp_axis", None)
             self.add_parameter(f"pipeline_stacked_{k}", param)
             self._stacked.append(param)
         object.__setattr__(self, "_template_params", template_params)
         # the stacked arrays are now the single source of truth: drop the
-        # per-stage originals so init doesn't hold a second full copy
+        # per-chunk originals so init doesn't hold a second full copy
         # (template params get rebound with stacked slices on first use)
-        for grp in self._stage_groups[1:]:
+        for grp in self._chunk_groups[1:]:
             for l in grp:
                 for _, p in l.named_parameters():
                     p._data = jnp.zeros((), p.dtype)
-        self._num_layers_per_stage = len(self._stage_groups[0])
-        object.__setattr__(self, "_stage_groups", None)
+        self._num_layers_per_stage = len(self._chunk_groups[0]) * V
+        object.__setattr__(self, "_chunk_groups", None)
 
     def get_num_stages(self) -> int:
         return self._num_stages
@@ -239,20 +280,27 @@ class PipelineLayer(nn.Layer):
             for l in self._body_layers:
                 h = l(h)
             return h
-        S = self._num_stages
+        S, V = self._num_stages, self._num_virtual
         stage_fn = self._stage_fn_pure
 
         def seq(x, *stacked):
             hh = x
-            for s in range(S):
-                hh = stage_fn([st[s] for st in stacked], hh)
+            for l in range(S * V):
+                j = self._stacked_index(l)
+                hh = stage_fn([st[j] for st in stacked], hh)
             return hh
 
         return tape.apply(seq, h, *self._stacked, op_name="pipeline_sequential")
 
     def _forward_body_pipelined(self, h: Tensor, mesh, num_micro: int) -> Tensor:
-        """SPMD pipeline over the pp axis; ``h`` is [M*mb, ...]."""
-        S = self._num_stages
+        """SPMD pipeline over the pp axis; ``h`` is [M*mb, ...].
+
+        Interleaved tick schedule (reduces to classic fill-drain at V=1):
+        device s at tick t computes n = t - s; chunk v = (n mod S*V)//S,
+        microbatch m = (n // (S*V))*S + (n mod S). Every output is
+        consumed by its successor chunk exactly one tick later, so one
+        ring ppermute per tick is the only communication."""
+        S, V = self._num_stages, self._num_virtual
         M = num_micro
         mb = h.shape[0] // M
         h_stream = tape.apply(
@@ -264,24 +312,37 @@ class PipelineLayer(nn.Layer):
 
         def pipeline(xs, *stacked):
             def spmd(local_xs, *local_stacked):
-                params = [s[0] for s in local_stacked]  # this stage's slice
+                # P('pp') over the [S*V] dim leaves this device's V chunk
+                # rows (j = s*V + v, v = 0..V-1) as a local [V, ...] block
+                chunks = list(local_stacked)
                 stage = lax.axis_index("pp")
                 state = jnp.zeros_like(local_xs[0])
                 outputs = jnp.zeros_like(local_xs)
+                SV = S * V
+                # last tick = last microbatch's last chunk on the last
+                # stage: n = g_last*SV + (V-1)*S + i_last, at t = n + S-1.
+                # Reduces to M + S - 1 at V = 1.
+                T = ((M - 1) // S) * SV + (V - 1) * S + ((M - 1) % S) + S
 
                 def tick(carry, t):
                     state, outputs = carry
-                    feed = lax.dynamic_index_in_dim(
-                        local_xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
-                    )
-                    inp = jnp.where(stage == 0, feed, state)
+                    n = t - stage
+                    r = n % SV  # jnp mod: in [0, SV) even for n < 0
+                    v = r // S
+                    m = (n // SV) * S + (r % S)
+                    valid = (n >= 0) & (m >= 0) & (m < M)
+                    mc = jnp.clip(m, 0, M - 1)
+                    feed = lax.dynamic_index_in_dim(local_xs, mc, 0, keepdims=False)
+                    inp = jnp.where((stage == 0) & (v == 0), feed, state)
+                    params = [
+                        lax.dynamic_index_in_dim(c, v, 0, keepdims=False)
+                        for c in chunks
+                    ]
                     out = stage_fn(params, inp)
-                    m_idx = t - (S - 1)
-                    cidx = jnp.clip(m_idx, 0, M - 1)
-                    valid = (stage == S - 1) & (m_idx >= 0) & (m_idx < M)
-                    cur = lax.dynamic_index_in_dim(outputs, cidx, 0, keepdims=False)
+                    done = valid & (stage == S - 1) & (v == V - 1)
+                    cur = lax.dynamic_index_in_dim(outputs, mc, 0, keepdims=False)
                     outputs = lax.dynamic_update_index_in_dim(
-                        outputs, jnp.where(valid, out, cur), cidx, 0
+                        outputs, jnp.where(done, out, cur), mc, 0
                     )
                     state = lax.ppermute(
                         out, "pp", [(i, (i + 1) % S) for i in range(S)]
@@ -289,7 +350,7 @@ class PipelineLayer(nn.Layer):
                     return (state, outputs), None
 
                 (state, outputs), _ = lax.scan(
-                    tick, (state, outputs), jnp.arange(M + S - 1)
+                    tick, (state, outputs), jnp.arange(T)
                 )
                 # only the last stage wrote non-zeros; replicate via psum
                 return lax.psum(

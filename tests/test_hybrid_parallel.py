@@ -262,6 +262,95 @@ class TestPipelineParallel:
                 float(loss_pp), float(loss_serial), rtol=2e-5, atol=1e-6
             )
 
+    def test_vpp_interleaved_train_matches_serial(self, pp_env):
+        """V=2 interleaved schedule: device s holds chunks {s, S+s};
+        losses must match serial execution exactly like the V=1 path
+        (ref: pipeline_parallel.py forward_backward_pipeline VPP branch)."""
+        hcg, strategy = pp_env
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc,
+            PipelineLayer,
+            PipelineParallel,
+        )
+
+        H, C, MB, M, S, V = 16, 4, 2, 4, 4, 2
+
+        def loss_fn(logits, y):
+            return F.cross_entropy(logits, y)
+
+        paddle.seed(21)
+        pipe = PipelineLayer(
+            layers=[LayerDesc(Block, H) for _ in range(8)] + [nn.Linear(H, C)],
+            num_stages=S,
+            num_virtual_pipeline_stages=V,
+            loss_fn=loss_fn,
+        )
+        assert pipe._stacked[0].shape[0] == S * V
+        # serial twin: logical chunk l (= layer l, 1 layer per chunk)
+        # lives at stacked row s*V + v where l = v*S + s
+        serial_blocks = [Block(H) for _ in range(8)]
+        for l in range(8):
+            j = pipe._stacked_index(l)
+            serial_blocks[l].fc.weight.set_value(
+                paddle.to_tensor(np.asarray(pipe._stacked[0]._data[j]))
+            )
+            serial_blocks[l].fc.bias.set_value(
+                paddle.to_tensor(np.asarray(pipe._stacked[1]._data[j]))
+            )
+        serial_head = nn.Linear(H, C)
+        serial_head.weight.set_value(pipe._post[0].weight)
+        serial_head.bias.set_value(pipe._post[0].bias)
+
+        pp_model = PipelineParallel(pipe, hcg, strategy)
+        assert pp_model._mesh is not None
+        pp_opt = opt.SGD(learning_rate=0.1, parameters=pipe.parameters())
+        serial_params = [p for b in serial_blocks for p in b.parameters()] + list(
+            serial_head.parameters()
+        )
+        serial_opt = opt.SGD(learning_rate=0.1, parameters=serial_params)
+
+        rng = np.random.RandomState(7)
+        for step in range(3):
+            x_np = rng.randn(M * MB, H).astype(np.float32)
+            y_np = rng.randint(0, C, (M * MB,)).astype(np.int64)
+
+            loss_pp = pp_model.train_batch(
+                (paddle.to_tensor(x_np), paddle.to_tensor(y_np)), pp_opt
+            )
+
+            h = paddle.to_tensor(x_np)
+            for b in serial_blocks:
+                h = b(h)
+            loss_serial = loss_fn(serial_head(h), paddle.to_tensor(y_np))
+            loss_serial.backward()
+            serial_opt.step()
+            serial_opt.clear_grad()
+
+            np.testing.assert_allclose(
+                float(loss_pp), float(loss_serial), rtol=2e-5, atol=1e-6
+            )
+
+    def test_vpp_segmentation_roundtrip(self):
+        """Stacked-row mapping is a bijection and the sequential
+        fallback applies chunks in logical order."""
+        from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+        paddle.seed(9)
+        pipe = PipelineLayer(
+            layers=[LayerDesc(Block, 8) for _ in range(8)],
+            num_stages=2,
+            num_virtual_pipeline_stages=2,
+            loss_fn=None,
+        )
+        S, V = 2, 2
+        rows = sorted(pipe._stacked_index(l) for l in range(S * V))
+        assert rows == list(range(S * V))
+        # 8 layers / 4 chunks = 2 layers per chunk; 2 chunks per stage
+        assert pipe._num_layers_per_stage == 4
+        x = paddle.randn([4, 8])
+        y = pipe(x)  # sequential fallback must run without a mesh
+        assert tuple(y.shape) == (4, 8)
+
     def test_pp_sequential_fallback_grads_reach_stacked_params(self):
         """Regression: the no-mesh fallback must route grads to the
         registered stacked Parameters (they are what the optimizer sees)."""
