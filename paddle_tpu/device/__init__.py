@@ -59,8 +59,11 @@ def synchronize(device=None):
     d = _jax_device(device)
     import jax.numpy as jnp
 
+    from ..distributed.communication.watchdog import watch
+
     # a trivial computation ordered after everything in-flight
-    jax.device_put(jnp.zeros(()), d).block_until_ready()
+    with watch(f"device.synchronize({d})"):
+        jax.device_put(jnp.zeros(()), d).block_until_ready()
 
 
 # ---------------------------------------------------------------------------

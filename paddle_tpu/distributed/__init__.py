@@ -48,6 +48,7 @@ from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import ps  # noqa: F401
 from . import launch  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .auto_parallel import (  # noqa: F401

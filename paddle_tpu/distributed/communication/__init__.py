@@ -277,13 +277,16 @@ def ppermute(tensor: Tensor, perm: Sequence, group: Optional[Group] = None) -> T
 def barrier(group: Optional[Group] = None):
     """Host barrier. Single-process: device sync; multi-host: coordination
     service barrier (jax.experimental.multihost_utils)."""
-    g = _resolve(group)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    from .watchdog import watch
 
-        multihost_utils.sync_global_devices(f"pg_barrier_{g.id}")
-    else:
-        jnp.zeros(()).block_until_ready()
+    g = _resolve(group)
+    with watch(f"barrier(group={g.id})"):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"pg_barrier_{g.id}")
+        else:
+            jnp.zeros(()).block_until_ready()
 
 
 def get_rank_in_trace(group: Optional[Group] = None):

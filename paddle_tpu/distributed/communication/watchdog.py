@@ -1,0 +1,147 @@
+"""Collective watchdog.
+
+Redesign of the reference's comm-task watchdog (ref:
+paddle/fluid/distributed/collective/process_group_nccl.cc NCCL watchdog
+thread; common/flags.cc FLAGS_pg_timeout): there, a daemon polls each
+enqueued NCCL kernel's state and tears the process down when one exceeds
+the process-group timeout, so the launcher can relaunch.
+
+On TPU, XLA owns kernel scheduling and there is no per-kernel host
+handle to poll — a stuck collective surfaces as a *blocking host wait on
+device results*: a barrier, a device synchronize, or fetching a jit
+step's outputs while a peer host is dead (multi-host programs stall in
+dispatch until every process arrives). The watchdog therefore monitors
+host-side waits:
+
+- every monitored wait runs under :func:`watch`, which registers
+  ``(description, start_time)`` in a table;
+- a daemon thread wakes every few seconds; any wait older than
+  ``FLAGS comm_timeout_s`` triggers a report — all-thread stack dump
+  (the analogue of the reference dumping its comm trace buffer) — and,
+  if ``FLAGS comm_abort_on_timeout`` is set, ``os._exit(124)`` so the
+  launcher / elastic manager relaunches the job (the reference's
+  async-error-handling teardown path).
+
+``paddle_tpu.distributed.barrier`` and ``paddle_tpu.device.synchronize``
+run their blocking waits under :func:`watch`.
+"""
+from __future__ import annotations
+
+import faulthandler
+import itertools
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional, Tuple
+
+from ...base import flags as _flags
+
+_EXIT_CODE = 124  # conventional timeout exit; elastic treats any death as a scale event
+
+
+class CommWatchdog:
+    """Singleton daemon watching registered host-side collective waits."""
+
+    _instance: Optional["CommWatchdog"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._waits: Dict[int, Tuple[str, float]] = {}
+        self._ids = itertools.count()
+        self._mu = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._kick = threading.Event()  # wakes the daemon on new registrations
+        self._reported: set = set()
+        # test seam: replaces the dump+abort action
+        self._on_timeout: Optional[Callable[[str, float], None]] = None
+
+    @classmethod
+    def instance(cls) -> "CommWatchdog":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = CommWatchdog()
+            return cls._instance
+
+    # -- registration --------------------------------------------------
+    @contextmanager
+    def watch(self, desc: str):
+        """Run a blocking wait under watchdog supervision."""
+        wid = next(self._ids)
+        with self._mu:
+            self._waits[wid] = (desc, time.monotonic())
+        self._ensure_thread()
+        self._kick.set()  # re-evaluate the poll interval for this wait
+        try:
+            yield
+        finally:
+            with self._mu:
+                self._waits.pop(wid, None)
+                self._reported.discard(wid)
+
+    # -- daemon --------------------------------------------------------
+    def _ensure_thread(self):
+        with self._mu:  # two first-waiters racing here must not fork two daemons
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._run, name="paddle_tpu_comm_watchdog", daemon=True
+                )
+                self._thread.start()
+
+    def _poll_interval(self) -> float:
+        timeout = float(_flags.flag("comm_timeout_s"))
+        return max(0.05, min(5.0, timeout / 4.0))
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._kick.wait(self._poll_interval())
+            self._kick.clear()
+            if self._stop.is_set():
+                break
+            timeout = float(_flags.flag("comm_timeout_s"))
+            now = time.monotonic()
+            with self._mu:
+                expired = [
+                    (wid, desc, now - start)
+                    for wid, (desc, start) in self._waits.items()
+                    if now - start > timeout and wid not in self._reported
+                ]
+                for wid, _, _ in expired:
+                    self._reported.add(wid)
+            for _, desc, age in expired:
+                self._fire(desc, age)
+
+    def _fire(self, desc: str, age: float):
+        if self._on_timeout is not None:
+            self._on_timeout(desc, age)
+            return
+        from ...utils import log as _log
+
+        msg = (
+            f"CommWatchdog: wait '{desc}' exceeded comm_timeout_s "
+            f"({age:.1f}s); a peer host is likely dead or the device hung."
+        )
+        _log.warning(msg)
+        sys.stderr.write(msg + "\n")
+        faulthandler.dump_traceback(all_threads=True, file=sys.stderr)
+        if bool(_flags.flag("comm_abort_on_timeout")):
+            sys.stderr.write(
+                f"CommWatchdog: aborting (exit {_EXIT_CODE}) for relaunch\n"
+            )
+            sys.stderr.flush()
+            os._exit(_EXIT_CODE)
+
+    def stop(self):
+        self._stop.set()
+        self._kick.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+            self._thread = None
+
+
+def watch(desc: str):
+    """Context manager: supervise a blocking wait (module-level sugar)."""
+    return CommWatchdog.instance().watch(desc)

@@ -1,0 +1,210 @@
+"""Parameter-server analogue, TPU-native.
+
+The reference's PS stack (ref: paddle/fluid/distributed/ps/service/
+brpc_ps_server.cc, ps/table/memory_sparse_table.cc,
+python/paddle/distributed/fleet/runtime/the_one_ps.py) shards huge
+sparse embedding tables by row across dedicated *server processes*;
+workers pull rows by id, push per-row gradients back over RPC, and the
+server applies a row-wise optimizer (sparse SGD/Adagrad, plus
+CtrAccessor frequency/eviction policies).
+
+A TPU pod has no server/worker split — the idiomatic equivalent keeps
+the sharding and the row-wise update semantics but maps them onto the
+mesh (SURVEY §2.3 note that PS has no direct TPU analogue; this module
+carries the *capability* over):
+
+- the table is ONE array row-sharded over a mesh axis via NamedSharding
+  (each device group holds its row shard — the "server" memory model;
+  total capacity scales with devices exactly like adding PS shards);
+- **pull** is a gather compiled by GSPMD onto ICI (no RPC);
+- **push** is a row-wise update applied only to touched ids:
+  duplicate ids in the batch are combined with segment-sum (the
+  reference's merge-by-key in push_sparse), then scattered into the
+  table and its per-row optimizer state — the table's dense weight
+  never materializes a full gradient;
+- **accessor policies** (ref: ps/table/ctr_accessor.cc): per-row
+  show counters fed by pulls, and ``shrink(threshold)`` evicting
+  stale rows (re-initializing them to zero), matching the reference's
+  shrink/save cycle;
+- sync/async/GEO modes collapse: a single SPMD program is "sync" by
+  construction.
+
+`DistributedEmbedding` wraps the table as an nn.Layer for ordinary
+autograd training (grad flows dense but row-sharded, i.e. per-device
+memory = table/N like a PS shard); `SparseTable.pull/push` is the
+explicit PS flow for custom loops.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+__all__ = ["SparseTable", "DistributedEmbedding", "sparse_embedding"]
+
+
+def _mesh_and_axis(mesh_axis: Optional[str]):
+    """Resolve the sharding mesh: explicit axis on the hybrid topology
+    mesh, else None (single-device table)."""
+    from ..fleet.base.topology import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return None, None
+    mesh = hcg.mesh
+    axes = dict(mesh.shape)
+    if mesh_axis is None:
+        # default: shard rows over the largest axis (the reference
+        # spreads shards over all servers)
+        mesh_axis = max(axes, key=axes.get)
+    if axes.get(mesh_axis, 1) <= 1:
+        return None, None
+    return mesh, mesh_axis
+
+
+class SparseTable:
+    """Row-sharded embedding table with PS pull/push semantics
+    (ref: ps/table/memory_sparse_table.cc, ctr_accessor.cc).
+
+    Rows live in a [num_rows, dim] array sharded over ``mesh_axis``;
+    optimizer state (adagrad accumulators) and show-counters are
+    sharded identically, so every "server" update is shard-local.
+    """
+
+    def __init__(
+        self,
+        num_rows: int,
+        dim: int,
+        optimizer: str = "adagrad",
+        learning_rate: float = 0.05,
+        initial_range: float = 0.01,
+        mesh_axis: Optional[str] = None,
+        seed: int = 0,
+    ):
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"unsupported sparse optimizer {optimizer!r}")
+        self.num_rows, self.dim = num_rows, dim
+        self.optimizer = optimizer
+        self.learning_rate = learning_rate
+        key = jax.random.PRNGKey(seed)
+        self.weight = (
+            jax.random.uniform(key, (num_rows, dim), jnp.float32) * 2 - 1
+        ) * initial_range
+        self.accum = jnp.zeros((num_rows,), jnp.float32)  # adagrad G (per row)
+        self.shows = jnp.zeros((num_rows,), jnp.int32)  # CtrAccessor show count
+        self._place(mesh_axis)
+
+    def _place(self, mesh_axis):
+        mesh, axis = _mesh_and_axis(mesh_axis)
+        self.mesh, self.axis = mesh, axis
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            row_sharded = NamedSharding(mesh, P(axis, None))
+            row_vec = NamedSharding(mesh, P(axis))
+            self.weight = jax.device_put(self.weight, row_sharded)
+            self.accum = jax.device_put(self.accum, row_vec)
+            self.shows = jax.device_put(self.shows, row_vec)
+
+    # -- PS worker API --------------------------------------------------
+    def pull(self, ids) -> jnp.ndarray:
+        """Fetch rows by id (ref: brpc worker pull_sparse). GSPMD turns
+        the gather on the row-sharded table into ICI traffic; show
+        counters increment for the touched ids."""
+        ids = jnp.asarray(ids, jnp.int32)
+        self.shows = self.shows.at[ids.reshape(-1)].add(1)
+        return jnp.take(self.weight, ids, axis=0)
+
+    def push(self, ids, grads) -> None:
+        """Apply per-row gradients (ref: push_sparse → server
+        sparse-optimizer). Duplicate ids are merged by sum first, then
+        one scatter updates weight + accumulator rows."""
+        ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+        grads = jnp.asarray(grads, jnp.float32).reshape(-1, self.dim)
+        uniq, inv = jnp.unique(ids, return_inverse=True, size=ids.shape[0], fill_value=-1)
+        merged = jax.ops.segment_sum(grads, inv.reshape(-1), num_segments=uniq.shape[0])
+        valid = (uniq >= 0)[:, None]
+        merged = jnp.where(valid, merged, 0.0)
+        safe = jnp.clip(uniq, 0, self.num_rows - 1)
+        if self.optimizer == "adagrad":
+            g2 = jnp.sum(merged * merged, axis=-1)
+            # scatter-ADD, not set: clipped padding slots collide with the
+            # real row 0 and a duplicate-index set would drop its update
+            self.accum = self.accum.at[safe].add(jnp.where(valid[:, 0], g2, 0.0))
+            new_accum = self.accum[safe]
+            scale = self.learning_rate / (jnp.sqrt(new_accum) + 1e-8)
+        else:
+            scale = jnp.full((uniq.shape[0],), self.learning_rate)
+        delta = jnp.where(valid, merged * scale[:, None], 0.0)
+        self.weight = self.weight.at[safe].add(-delta)
+
+    # -- server lifecycle ----------------------------------------------
+    def shrink(self, show_threshold: int = 1) -> int:
+        """Evict rows whose show count is below threshold (ref:
+        CtrAccessor::Shrink): evicted rows reset to zero and counters
+        clear. Returns the number of evicted rows."""
+        keep = self.shows >= show_threshold
+        evicted = int(jnp.sum(~keep))
+        self.weight = jnp.where(keep[:, None], self.weight, 0.0)
+        self.accum = jnp.where(keep, self.accum, 0.0)
+        self.shows = jnp.where(keep, self.shows, 0)
+        if self.mesh is not None:
+            self._place(self.axis)
+        return evicted
+
+    def state_dict(self):
+        return {
+            "weight": np.asarray(self.weight),
+            "accum": np.asarray(self.accum),
+            "shows": np.asarray(self.shows),
+        }
+
+    def set_state_dict(self, sd):
+        self.weight = jnp.asarray(sd["weight"])
+        self.accum = jnp.asarray(sd["accum"])
+        self.shows = jnp.asarray(sd["shows"])
+        self._place(self.axis)
+
+
+class DistributedEmbedding(nn.Layer):
+    """nn.Layer face of a row-sharded table for autograd training
+    (ref: python/paddle/static/nn/common.py sparse_embedding). The
+    weight Parameter carries a row NamedSharding, so its gradient and
+    optimizer state are row-sharded too — per-device memory is
+    table/N, the PS shard memory model."""
+
+    def __init__(
+        self,
+        num_embeddings: int,
+        embedding_dim: int,
+        mesh_axis: Optional[str] = None,
+        weight_attr=None,
+        name=None,
+    ):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr
+        )
+        mesh, axis = _mesh_and_axis(mesh_axis)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.weight._data = jax.device_put(
+                self.weight._data, NamedSharding(mesh, P(axis, None))
+            )
+            self.weight.is_distributed = True
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+def sparse_embedding(x, size, mesh_axis: Optional[str] = None, param_attr=None):
+    """Functional parity shim for paddle.static.nn.sparse_embedding:
+    builds a DistributedEmbedding and applies it."""
+    layer = DistributedEmbedding(size[0], size[1], mesh_axis=mesh_axis, weight_attr=param_attr)
+    return layer(x), layer

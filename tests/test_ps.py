@@ -1,0 +1,129 @@
+"""Parameter-server analogue: row-sharded tables, pull/push row-wise
+updates, accessor shrink (ref: ps/table/memory_sparse_table.cc,
+ctr_accessor.cc semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.ps import DistributedEmbedding, SparseTable
+
+
+@pytest.fixture
+def dp_env():
+    import paddle_tpu.distributed as dist
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    hcg = fleet.init(strategy=strategy)
+    yield hcg
+    dist.destroy_process_group()
+    fleet.set_hybrid_communicate_group(None)
+
+
+class TestSparseTable:
+    def test_pull_returns_rows_and_counts_shows(self):
+        t = SparseTable(64, 8, seed=1)
+        ids = np.array([[3, 5], [3, 9]], np.int32)
+        rows = t.pull(ids)
+        assert rows.shape == (2, 2, 8)
+        np.testing.assert_allclose(np.asarray(rows[0, 0]), np.asarray(t.weight[3]))
+        shows = np.asarray(t.shows)
+        assert shows[3] == 2 and shows[5] == 1 and shows[9] == 1 and shows[0] == 0
+
+    def test_push_sgd_matches_dense_formula(self):
+        t = SparseTable(32, 4, optimizer="sgd", learning_rate=0.5, seed=2)
+        w0 = np.asarray(t.weight).copy()
+        ids = np.array([7, 7, 11], np.int32)  # duplicate id merges by sum
+        g = np.arange(12, dtype=np.float32).reshape(3, 4)
+        t.push(ids, g)
+        w1 = np.asarray(t.weight)
+        np.testing.assert_allclose(w1[7], w0[7] - 0.5 * (g[0] + g[1]), rtol=1e-6)
+        np.testing.assert_allclose(w1[11], w0[11] - 0.5 * g[2], rtol=1e-6)
+        untouched = [i for i in range(32) if i not in (7, 11)]
+        np.testing.assert_allclose(w1[untouched], w0[untouched])
+
+    def test_push_adagrad_accumulates(self):
+        t = SparseTable(16, 4, optimizer="adagrad", learning_rate=0.1, seed=3)
+        w0 = np.asarray(t.weight).copy()
+        g = np.ones((1, 4), np.float32)
+        t.push(np.array([5], np.int32), g)
+        G = 4.0  # sum of squares
+        expect = w0[5] - 0.1 / (np.sqrt(G) + 1e-8) * 1.0
+        np.testing.assert_allclose(np.asarray(t.weight)[5], expect, rtol=1e-6)
+        # second push sees the accumulated G
+        t.push(np.array([5], np.int32), g)
+        expect2 = expect - 0.1 / (np.sqrt(2 * G) + 1e-8) * 1.0
+        np.testing.assert_allclose(np.asarray(t.weight)[5], expect2, rtol=1e-6)
+
+    def test_push_adagrad_row_zero_with_duplicates(self):
+        """Regression: unique() padding slots clip to row 0; its
+        accumulator update must survive the scatter collision."""
+        t = SparseTable(16, 4, optimizer="adagrad", learning_rate=0.1, seed=8)
+        g = np.ones((3, 4), np.float32)
+        t.push(np.array([0, 5, 5], np.int32), g)
+        assert float(np.asarray(t.accum)[0]) == pytest.approx(4.0)
+        assert float(np.asarray(t.accum)[5]) == pytest.approx(16.0)  # merged (2g)^2
+
+    def test_shrink_evicts_cold_rows(self):
+        t = SparseTable(8, 2, seed=4)
+        t.pull(np.array([1, 1, 2], np.int32))
+        evicted = t.shrink(show_threshold=1)
+        assert evicted == 6
+        w = np.asarray(t.weight)
+        assert np.abs(w[1]).sum() > 0 and np.abs(w[2]).sum() > 0
+        assert np.abs(w[0]).sum() == 0 and np.abs(w[7]).sum() == 0
+
+    def test_state_dict_roundtrip(self):
+        t = SparseTable(8, 2, seed=5)
+        t.pull(np.array([3], np.int32))
+        sd = t.state_dict()
+        t2 = SparseTable(8, 2, seed=99)
+        t2.set_state_dict(sd)
+        np.testing.assert_allclose(np.asarray(t2.weight), np.asarray(t.weight))
+        assert np.asarray(t2.shows)[3] == 1
+
+    def test_row_sharded_on_mesh(self, dp_env):
+        t = SparseTable(64, 8, mesh_axis="dp", seed=6)
+        assert t.mesh is not None
+        # sharding spec places rows over the dp axis
+        spec = t.weight.sharding.spec
+        assert spec[0] == "dp"
+        rows = t.pull(np.array([0, 63], np.int32))
+        assert rows.shape == (2, 8)
+        t.push(np.array([0], np.int32), np.ones((1, 8), np.float32))
+
+
+class TestDistributedEmbedding:
+    def test_matches_dense_embedding_training(self, dp_env):
+        paddle.seed(7)
+        emb = DistributedEmbedding(32, 16, mesh_axis="dp")
+        assert emb.weight.is_distributed
+        dense = nn.Embedding(32, 16)
+        dense.weight.set_value(emb.weight)
+        head = nn.Linear(16, 4)
+        head2 = nn.Linear(16, 4)
+        head2.weight.set_value(head.weight)
+        head2.bias.set_value(head.bias)
+
+        o1 = opt.SGD(learning_rate=0.1, parameters=[emb.weight] + list(head.parameters()))
+        o2 = opt.SGD(learning_rate=0.1, parameters=[dense.weight] + list(head2.parameters()))
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            ids = paddle.to_tensor(rng.randint(0, 32, (8,)).astype(np.int64))
+            y = paddle.to_tensor(rng.randint(0, 4, (8,)).astype(np.int64))
+            l1 = F.cross_entropy(head(emb(ids)), y)
+            l1.backward()
+            o1.step()
+            o1.clear_grad()
+            l2 = F.cross_entropy(head2(dense(ids)), y)
+            l2.backward()
+            o2.step()
+            o2.clear_grad()
+            np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(emb.weight._data), np.asarray(dense.weight._data), rtol=1e-5, atol=1e-6
+        )
