@@ -58,7 +58,10 @@ def main():
             num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=16,
             max_position_embeddings=2048,
         )
-        batch, seq, steps, warmup = 4, 2048, 132, 2
+        import os as _os
+        batch = int(_os.environ.get("BENCH_BATCH", 4))
+        seq = int(_os.environ.get("BENCH_SEQ", 2048))
+        steps, warmup = int(_os.environ.get("BENCH_STEPS", 132)), 2
     else:  # CPU fallback so the bench is runnable anywhere
         config = LlamaConfig.tiny()
         batch, seq, steps, warmup = 2, 64, 3, 1

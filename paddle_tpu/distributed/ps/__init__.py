@@ -62,6 +62,13 @@ def _mesh_and_axis(mesh_axis: Optional[str]):
         # default: shard rows over the largest axis (the reference
         # spreads shards over all servers)
         mesh_axis = max(axes, key=axes.get)
+    elif mesh_axis not in axes:
+        # fail fast: a silently replicated "sharded" table defeats the
+        # PS memory model and OOMs later instead of erroring here
+        raise ValueError(
+            f"mesh_axis {mesh_axis!r} is not an axis of the hybrid mesh "
+            f"{tuple(axes)}"
+        )
     if axes.get(mesh_axis, 1) <= 1:
         return None, None
     return mesh, mesh_axis
@@ -117,14 +124,28 @@ class SparseTable:
         the gather on the row-sharded table into ICI traffic; show
         counters increment for the touched ids."""
         ids = jnp.asarray(ids, jnp.int32)
+        self._reject_trace(ids, "pull")
         self.shows = self.shows.at[ids.reshape(-1)].add(1)
         return jnp.take(self.weight, ids, axis=0)
+
+    @staticmethod
+    def _reject_trace(x, op):
+        # pull/push mutate host-held table state; under jit the updates
+        # would be traced once and silently dropped across steps
+        if isinstance(x, jax.core.Tracer):
+            raise RuntimeError(
+                f"SparseTable.{op} mutates host-side table state and cannot "
+                "run under jit/to_static; call it eagerly (the gather/scatter "
+                "itself is still compiled), or use DistributedEmbedding inside "
+                "jitted train steps."
+            )
 
     def push(self, ids, grads) -> None:
         """Apply per-row gradients (ref: push_sparse → server
         sparse-optimizer). Duplicate ids are merged by sum first, then
         one scatter updates weight + accumulator rows."""
         ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+        self._reject_trace(ids, "push")
         grads = jnp.asarray(grads, jnp.float32).reshape(-1, self.dim)
         uniq, inv = jnp.unique(ids, return_inverse=True, size=ids.shape[0], fill_value=-1)
         merged = jax.ops.segment_sum(grads, inv.reshape(-1), num_segments=uniq.shape[0])
@@ -204,7 +225,9 @@ class DistributedEmbedding(nn.Layer):
 
 
 def sparse_embedding(x, size, mesh_axis: Optional[str] = None, param_attr=None):
-    """Functional parity shim for paddle.static.nn.sparse_embedding:
-    builds a DistributedEmbedding and applies it."""
+    """Functional parity shim for paddle.static.nn.sparse_embedding —
+    returns the lookup result only, like the reference (the built layer
+    is reachable via the result's grad graph; construct
+    DistributedEmbedding directly to keep a handle)."""
     layer = DistributedEmbedding(size[0], size[1], mesh_axis=mesh_axis, weight_attr=param_attr)
-    return layer(x), layer
+    return layer(x)

@@ -1,0 +1,491 @@
+"""Recurrent layers — SimpleRNN/LSTM/GRU cells and multi-layer wrappers.
+
+ref: python/paddle/nn/layer/rnn.py (SimpleRNNCell:741, LSTMCell:918,
+GRUCell:1144, RNN:1339, BiRNN:1421, RNNBase:1514). Formulas, weight
+layouts ((gates*hidden, input) / (gates*hidden, hidden), gate order
+i,f,g,o for LSTM and r,z,c for GRU), state shapes and the
+(outputs, final_states) contract follow the reference exactly.
+
+TPU-native design: the reference lowers to a fused rnn CUDNN kernel or
+a python while-loop over time steps (_rnn_dynamic/_rnn_static). Here
+the whole sequence runs as ONE ``lax.scan`` over time inside a single
+tape.apply — XLA unrolls nothing, compiles one step body (two fused
+gate matmuls on the MXU) and the backward is the transposed scan, so
+eager per-step dispatch overhead (SURVEY §3.1) never appears. Variable
+lengths (``sequence_length``) are handled with masked state carries
+inside the scan instead of the reference's sequence reversal ops.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...base import tape
+from ...base.tensor import Tensor
+from .. import initializer as I
+from .layers import Layer
+from .container import LayerList
+
+__all__ = [
+    "RNNCellBase",
+    "SimpleRNNCell",
+    "LSTMCell",
+    "GRUCell",
+    "RNN",
+    "BiRNN",
+    "SimpleRNN",
+    "LSTM",
+    "GRU",
+]
+
+
+class RNNCellBase(Layer):
+    """Base for single-step recurrent cells (ref: rnn.py:590)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0):
+        batch = batch_ref.shape[0]
+        n = getattr(self, "state_components", 1)
+        shapes = [[batch, self.hidden_size]] * n if shape is None else shape
+        outs = tuple(
+            Tensor(jnp.full(tuple(s), init_value, dtype or jnp.float32), _internal=True)
+            for s in shapes
+        )
+        return outs if n > 1 else outs[0]
+
+    def _uniform_init(self):
+        std = 1.0 / math.sqrt(self.hidden_size)
+        return I.Uniform(-std, std)
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh) (ref: rnn.py:741)."""
+
+    state_components = 1
+
+    def __init__(
+        self,
+        input_size,
+        hidden_size,
+        activation="tanh",
+        weight_ih_attr=None,
+        weight_hh_attr=None,
+        bias_ih_attr=None,
+        bias_hh_attr=None,
+        name=None,
+    ):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        init = self._uniform_init()
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr, default_initializer=init
+        )
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=init
+        )
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=init
+        )
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=init
+        )
+
+    def _params(self):
+        return [p for p in (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh) if p is not None]
+
+    def _step(self, x, state, wih, whh, bih=None, bhh=None):
+        """Pure jnp one-step body; state is a 1-tuple."""
+        (h,) = state
+        pre = x @ wih.T + h @ whh.T
+        if bih is not None:
+            pre = pre + bih
+        if bhh is not None:
+            pre = pre + bhh
+        h = jnp.tanh(pre) if self.activation == "tanh" else jnp.maximum(pre, 0)
+        return h, (h,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = tape.apply(
+            lambda x, h, *ps: self._step(x, (h,), *ps),
+            inputs, states, *self._params(), op_name="simple_rnn_cell",
+        )
+        y, (h,) = out
+        return y, h
+
+
+class LSTMCell(RNNCellBase):
+    """Gate order i,f,g,o; c' = f*c + i*g; h' = o*tanh(c') (ref: rnn.py:918)."""
+
+    state_components = 2
+
+    def __init__(
+        self,
+        input_size,
+        hidden_size,
+        weight_ih_attr=None,
+        weight_hh_attr=None,
+        bias_ih_attr=None,
+        bias_hh_attr=None,
+        proj_size=None,
+        name=None,
+    ):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.proj_size = proj_size or 0
+        if self.proj_size and self.proj_size >= hidden_size:
+            raise ValueError("proj_size must be smaller than hidden_size")
+        init = self._uniform_init()
+        h_in = self.proj_size or hidden_size
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr, default_initializer=init
+        )
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, h_in], attr=weight_hh_attr, default_initializer=init
+        )
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=init
+        )
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=init
+        )
+        self.weight_ho = (
+            self.create_parameter([hidden_size, self.proj_size], default_initializer=init)
+            if self.proj_size
+            else None
+        )
+
+    def _params(self):
+        ps = [self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh]
+        if self.weight_ho is not None:
+            ps.append(self.weight_ho)
+        return [p for p in ps if p is not None]
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0):
+        batch = batch_ref.shape[0]
+        h_size = self.proj_size or self.hidden_size
+        mk = lambda n: Tensor(jnp.full((batch, n), init_value, dtype or jnp.float32), _internal=True)
+        return (mk(h_size), mk(self.hidden_size))
+
+    def _step(self, x, state, wih, whh, bih=None, bhh=None, who=None):
+        h, c = state
+        gates = x @ wih.T + h @ whh.T
+        if bih is not None:
+            gates = gates + bih
+        if bhh is not None:
+            gates = gates + bhh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        if who is not None:
+            h = h @ who
+        return h, (h, c)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = tape.apply(
+            lambda x, h, c, *ps: self._step(x, (h, c), *ps),
+            inputs, states[0], states[1], *self._params(), op_name="lstm_cell",
+        )
+        y, (h, c) = out
+        return y, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    """Gate order r,z,c; h' = z*h + (1-z)*c~ (ref: rnn.py:1144)."""
+
+    state_components = 1
+
+    def __init__(
+        self,
+        input_size,
+        hidden_size,
+        weight_ih_attr=None,
+        weight_hh_attr=None,
+        bias_ih_attr=None,
+        bias_hh_attr=None,
+        name=None,
+    ):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        init = self._uniform_init()
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr, default_initializer=init
+        )
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=init
+        )
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=init
+        )
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=init
+        )
+
+    def _params(self):
+        return [p for p in (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh) if p is not None]
+
+    def _step(self, x, state, wih, whh, bih=None, bhh=None):
+        (h,) = state
+        xg = x @ wih.T
+        hg = h @ whh.T
+        if bih is not None:
+            xg = xg + bih
+        if bhh is not None:
+            hg = hg + bhh
+        xr, xz, xc = jnp.split(xg, 3, axis=-1)
+        hr, hz, hc = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        c = jnp.tanh(xc + r * hc)
+        h = z * h + (1.0 - z) * c
+        return h, (h,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = tape.apply(
+            lambda x, h, *ps: self._step(x, (h,), *ps),
+            inputs, states, *self._params(), op_name="gru_cell",
+        )
+        y, (h,) = out
+        return y, h
+
+
+def _scan_rnn(cell, inputs, init_state, params, is_reverse, seq_len):
+    """Pure jnp: scan ``cell._step`` over time-major [T, B, ...] inputs.
+
+    seq_len masking: steps at-or-beyond a sequence's length leave its
+    state unchanged and emit zeros (the reference zero-pads outputs
+    past the valid region)."""
+    T = inputs.shape[0]
+
+    def body(carry, xt):
+        t, state = carry
+        y, new_state = cell._step(xt, state, *params)
+        if seq_len is not None:
+            step = (T - 1 - t) if is_reverse else t
+            alive = (step < seq_len)[:, None]
+            new_state = tuple(
+                jnp.where(alive, ns, s) for ns, s in zip(new_state, state)
+            )
+            y = jnp.where(alive, y, jnp.zeros_like(y))
+        return (t + 1, new_state), y
+
+    xs = jnp.flip(inputs, 0) if is_reverse else inputs
+    (_, final), ys = lax.scan(body, (0, init_state), xs)
+    if is_reverse:
+        ys = jnp.flip(ys, 0)
+    return ys, final
+
+
+class RNN(Layer):
+    """Wrap a cell into a full-sequence layer via one lax.scan
+    (ref: rnn.py:1339)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            b = inputs.shape[1] if self.time_major else inputs.shape[0]
+            fake = Tensor(jnp.zeros((b, 1)), _internal=True)
+            initial_states = self.cell.get_initial_states(fake)
+        states = initial_states if isinstance(initial_states, (tuple, list)) else (initial_states,)
+        params = self.cell._params()
+        n_state = len(states)
+
+        def f(x, *rest):
+            sts = rest[:n_state]
+            if sequence_length is not None:
+                sl = rest[n_state]
+                ps = rest[n_state + 1:]
+            else:
+                sl = None
+                ps = rest[n_state:]
+            xt = x if self.time_major else jnp.swapaxes(x, 0, 1)
+            ys, final = _scan_rnn(self.cell, xt, tuple(sts), ps, self.is_reverse, sl)
+            if not self.time_major:
+                ys = jnp.swapaxes(ys, 0, 1)
+            return ys, final
+
+        args = (inputs,) + tuple(states)
+        if sequence_length is not None:
+            args = args + (sequence_length,)
+        out = tape.apply(f, *args, *params, op_name="rnn_scan")
+        ys, final = out
+        if n_state == 1:
+            return ys, final[0]
+        return ys, tuple(final)
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (ref: rnn.py:1421)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw, self.cell_bw = cell_fw, cell_bw
+        self.time_major = time_major
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            fw_states = bw_states = None
+        else:
+            fw_states, bw_states = initial_states
+        y_fw, s_fw = self.rnn_fw(inputs, fw_states, sequence_length)
+        y_bw, s_bw = self.rnn_bw(inputs, bw_states, sequence_length)
+        from ... import tensor as T
+
+        y = T.concat([y_fw, y_bw], axis=-1)
+        return y, (s_fw, s_bw)
+
+
+class RNNBase(LayerList):
+    """Multi-layer, optionally bidirectional stack (ref: rnn.py:1514)."""
+
+    def __init__(
+        self,
+        mode,
+        input_size,
+        hidden_size,
+        num_layers=1,
+        direction="forward",
+        time_major=False,
+        dropout=0.0,
+        weight_ih_attr=None,
+        weight_hh_attr=None,
+        bias_ih_attr=None,
+        bias_hh_attr=None,
+        proj_size=0,
+        activation="tanh",
+    ):
+        super().__init__()
+        bidirectional = direction in ("bidirectional", "bidirect")
+        if not bidirectional and direction != "forward":
+            raise ValueError(f"direction should be forward or bidirect, got {direction}")
+        self.mode = mode
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.num_directions = 2 if bidirectional else 1
+        self.time_major = time_major
+        self.dropout = dropout
+        self.state_components = 2 if mode == "LSTM" else 1
+        self.proj_size = proj_size
+
+        kwargs = dict(
+            weight_ih_attr=weight_ih_attr,
+            weight_hh_attr=weight_hh_attr,
+            bias_ih_attr=bias_ih_attr,
+            bias_hh_attr=bias_hh_attr,
+        )
+        if mode == "LSTM":
+            mk = lambda i: LSTMCell(i, hidden_size, proj_size=proj_size or None, **kwargs)
+        elif mode == "GRU":
+            mk = lambda i: GRUCell(i, hidden_size, **kwargs)
+        else:
+            act = "relu" if mode == "RNN_RELU" else ("tanh" if mode == "RNN_TANH" else activation)
+            mk = lambda i: SimpleRNNCell(i, hidden_size, activation=act, **kwargs)
+
+        out_size = (proj_size or hidden_size) * self.num_directions
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 else out_size
+            if bidirectional:
+                self.append(BiRNN(mk(in_size), mk(in_size), time_major))
+            else:
+                self.append(RNN(mk(in_size), False, time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        """Returns (outputs, final_states); final h/c are stacked to
+        [num_layers * num_directions, B, size] like the reference."""
+        from ... import tensor as T
+        from .. import functional as F
+
+        L, D = self.num_layers, self.num_directions
+        per_layer_states = [None] * L
+        if initial_states is not None:
+            if self.state_components == 2:
+                h0, c0 = initial_states
+                for l in range(L):
+                    if D == 2:
+                        per_layer_states[l] = (
+                            (h0[2 * l], c0[2 * l]),
+                            (h0[2 * l + 1], c0[2 * l + 1]),
+                        )
+                    else:
+                        per_layer_states[l] = (h0[l], c0[l])
+            else:
+                h0 = initial_states
+                for l in range(L):
+                    per_layer_states[l] = (
+                        (h0[2 * l], h0[2 * l + 1]) if D == 2 else h0[l]
+                    )
+
+        x = inputs
+        finals = []
+        for l, rnn in enumerate(self):
+            x, fin = rnn(x, per_layer_states[l], sequence_length)
+            finals.append(fin)
+            if self.dropout and l < L - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+
+        # stack finals: [L*D, B, size] per state component
+        def collect(comp):
+            outs = []
+            for l in range(L):
+                fin = finals[l]
+                if D == 2:
+                    fw, bw = fin
+                    outs.append(fw[comp] if self.state_components == 2 else fw)
+                    outs.append(bw[comp] if self.state_components == 2 else bw)
+                else:
+                    outs.append(fin[comp] if self.state_components == 2 else fin)
+            return T.stack(outs, axis=0)
+
+        if self.state_components == 2:
+            state = (collect(0), collect(1))
+        else:
+            state = collect(0)
+        return x, state
+
+
+class SimpleRNN(RNNBase):
+    """ref: rnn.py:1859."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kwargs)
+
+
+class LSTM(RNNBase):
+    """ref: rnn.py:1982."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, proj_size=0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, proj_size=proj_size, **kwargs)
+
+
+class GRU(RNNBase):
+    """ref: rnn.py:2119."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)

@@ -130,7 +130,7 @@ def sep_parallel_attention(q, k, v, mesh, axis_name: str = "sep",
     the sequence over ``axis_name`` of ``mesh``, runs ring attention,
     returns the global result (ref: the sep_parallel attention path in
     fleet meta_parallel)."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..base.tape import apply

@@ -1,0 +1,163 @@
+"""Recurrent layers: cell formulas vs hand-rolled numpy, scan-vs-step
+consistency, bidirectional shapes, sequence_length masking, training.
+(ref test pattern: test/legacy_test/test_rnn_op.py / rnn numpy oracles)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestCells:
+    def test_lstm_cell_matches_numpy(self):
+        paddle.seed(0)
+        cell = nn.LSTMCell(8, 16)
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        h0 = np.random.RandomState(1).randn(4, 16).astype(np.float32)
+        c0 = np.random.RandomState(2).randn(4, 16).astype(np.float32)
+        y, (h, c) = cell(
+            paddle.to_tensor(x), (paddle.to_tensor(h0), paddle.to_tensor(c0))
+        )
+        wih = np.asarray(cell.weight_ih._data)
+        whh = np.asarray(cell.weight_hh._data)
+        bih = np.asarray(cell.bias_ih._data)
+        bhh = np.asarray(cell.bias_hh._data)
+        gates = x @ wih.T + h0 @ whh.T + bih + bhh
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        cn = sigmoid(f) * c0 + sigmoid(i) * np.tanh(g)
+        hn = sigmoid(o) * np.tanh(cn)
+        np.testing.assert_allclose(h.numpy(), hn, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), cn, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(y.numpy(), hn, rtol=1e-5, atol=1e-5)
+
+    def test_gru_cell_matches_numpy(self):
+        paddle.seed(1)
+        cell = nn.GRUCell(6, 10)
+        x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+        h0 = np.random.RandomState(1).randn(3, 10).astype(np.float32)
+        y, h = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+        wih = np.asarray(cell.weight_ih._data)
+        whh = np.asarray(cell.weight_hh._data)
+        bih = np.asarray(cell.bias_ih._data)
+        bhh = np.asarray(cell.bias_hh._data)
+        xg = x @ wih.T + bih
+        hg = h0 @ whh.T + bhh
+        xr, xz, xc = np.split(xg, 3, axis=-1)
+        hr, hz, hc = np.split(hg, 3, axis=-1)
+        r, z = sigmoid(xr + hr), sigmoid(xz + hz)
+        cand = np.tanh(xc + r * hc)
+        hn = z * h0 + (1 - z) * cand
+        np.testing.assert_allclose(h.numpy(), hn, rtol=1e-5, atol=1e-5)
+
+    def test_simple_rnn_cell_relu(self):
+        paddle.seed(2)
+        cell = nn.SimpleRNNCell(5, 7, activation="relu")
+        x = np.random.RandomState(0).randn(2, 5).astype(np.float32)
+        y, h = cell(paddle.to_tensor(x))
+        wih = np.asarray(cell.weight_ih._data)
+        bih = np.asarray(cell.bias_ih._data)
+        bhh = np.asarray(cell.bias_hh._data)
+        hn = np.maximum(x @ wih.T + bih + bhh, 0)
+        np.testing.assert_allclose(h.numpy(), hn, rtol=1e-5, atol=1e-5)
+
+
+class TestRNNWrapper:
+    def test_scan_matches_stepwise(self):
+        """The lax.scan path must equal manual per-step cell calls."""
+        paddle.seed(3)
+        cell = nn.LSTMCell(4, 8)
+        rnn = nn.RNN(cell)
+        x_np = np.random.RandomState(0).randn(2, 5, 4).astype(np.float32)
+        x = paddle.to_tensor(x_np)
+        ys, (h, c) = rnn(x)
+        # manual loop
+        state = cell.get_initial_states(paddle.to_tensor(x_np[:, 0]))
+        outs = []
+        for t in range(5):
+            y, state = cell(paddle.to_tensor(x_np[:, t]), state)
+            outs.append(y.numpy())
+        np.testing.assert_allclose(ys.numpy(), np.stack(outs, 1), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), state[0].numpy(), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), state[1].numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_reverse_equals_flipped_forward(self):
+        paddle.seed(4)
+        cell = nn.GRUCell(4, 6)
+        fwd = nn.RNN(cell)
+        rev = nn.RNN(cell, is_reverse=True)
+        x_np = np.random.RandomState(1).randn(3, 7, 4).astype(np.float32)
+        ys_r, h_r = rev(paddle.to_tensor(x_np))
+        ys_f, h_f = fwd(paddle.to_tensor(x_np[:, ::-1].copy()))
+        np.testing.assert_allclose(
+            ys_r.numpy(), ys_f.numpy()[:, ::-1], rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(h_r.numpy(), h_f.numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_sequence_length_masks_state_and_output(self):
+        paddle.seed(5)
+        cell = nn.SimpleRNNCell(3, 4)
+        rnn = nn.RNN(cell)
+        x_np = np.random.RandomState(2).randn(2, 6, 3).astype(np.float32)
+        sl = paddle.to_tensor(np.array([6, 3], np.int32))
+        ys, h = rnn(paddle.to_tensor(x_np), sequence_length=sl)
+        # short sequence: outputs past t=3 are zero; final state == state at t=3
+        np.testing.assert_allclose(ys.numpy()[1, 3:], 0.0)
+        ys_short, h_short = rnn(paddle.to_tensor(x_np[1:2, :3]))
+        np.testing.assert_allclose(h.numpy()[1], h_short.numpy()[0], rtol=1e-5, atol=1e-5)
+
+
+class TestRNNBase:
+    @pytest.mark.parametrize("cls", [nn.SimpleRNN, nn.LSTM, nn.GRU])
+    def test_shapes_and_training(self, cls):
+        paddle.seed(6)
+        m = cls(8, 16, num_layers=2, direction="bidirectional")
+        x = paddle.randn([4, 10, 8])
+        y, state = m(x)
+        assert tuple(y.shape) == (4, 10, 32)
+        if cls is nn.LSTM:
+            h, c = state
+            assert tuple(h.shape) == (4, 4, 16)  # [L*D, B, H]
+            assert tuple(c.shape) == (4, 4, 16)
+        else:
+            assert tuple(state.shape) == (4, 4, 16)
+        # trains: loss decreases
+        target = paddle.randn([4, 10, 32])
+        o = opt.Adam(learning_rate=1e-2, parameters=m.parameters())
+        losses = []
+        for _ in range(8):
+            y, _ = m(x)
+            loss = ((y - target) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_lstm_proj_size(self):
+        paddle.seed(7)
+        m = nn.LSTM(8, 16, proj_size=4)
+        x = paddle.randn([2, 5, 8])
+        y, (h, c) = m(x)
+        assert tuple(y.shape) == (2, 5, 4)
+        assert tuple(h.shape) == (1, 2, 4) and tuple(c.shape) == (1, 2, 16)
+
+    def test_time_major(self):
+        paddle.seed(8)
+        m = nn.GRU(4, 8, time_major=True)
+        x = paddle.randn([9, 3, 4])  # [T, B, in]
+        y, h = m(x)
+        assert tuple(y.shape) == (9, 3, 8)
+        assert tuple(h.shape) == (1, 3, 8)
+
+    def test_initial_states_roundtrip(self):
+        paddle.seed(9)
+        m = nn.LSTM(4, 8, num_layers=2)
+        x = paddle.randn([2, 5, 4])
+        _, (h, c) = m(x)
+        y2, (h2, c2) = m(x, (h, c))
+        assert tuple(h2.shape) == tuple(h.shape)
