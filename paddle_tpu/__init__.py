@@ -119,3 +119,116 @@ def in_dynamic_mode() -> bool:
 
 def is_grad_enabled_():  # legacy alias
     return is_grad_enabled()
+
+
+# ---------------------------------------------------------------------------
+# top-level parity utilities (ref: python/paddle/__init__.py __all__ entries)
+# ---------------------------------------------------------------------------
+import numpy as _np
+
+# paddle.dtype is the type of paddle.float32 & friends; dtypes here are
+# numpy dtype objects (ref: paddle/framework/dtype.py)
+dtype = _np.dtype
+
+from .base.device import CUDAPinnedPlace  # noqa: F401
+from .base.random import (  # noqa: F401  (CUDA names kept for parity)
+    get_rng_state as get_cuda_rng_state,
+    set_rng_state as set_cuda_rng_state,
+)
+from .distributed.parallel import DataParallel  # noqa: F401
+from .reader import batch  # noqa: F401
+
+
+def rank(input):
+    """0-D int Tensor holding ndim (ref: tensor/attribute.py rank)."""
+    return to_tensor(_np.asarray(input.ndim, _np.int32))
+
+
+def shape(input):
+    """1-D int Tensor holding the shape (ref: tensor/attribute.py shape)."""
+    return to_tensor(_np.asarray(tuple(input.shape), _np.int32))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, sci_mode=None, linewidth=None):
+    """Tensor repr formatting (ref: python/paddle/tensor/to_string.py
+    set_printoptions); Tensor repr renders through numpy, so this maps
+    onto numpy's print options."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None):
+    """Standalone Parameter factory (ref: python/paddle/tensor/creation.py
+    create_parameter via LayerHelper)."""
+    from .base.param_attr import ParamAttr
+    from .nn import initializer as _I
+    from .nn.layer.layers import Parameter as _Param
+
+    attr = ParamAttr._to_attr(attr)
+    init = (attr.initializer if attr else None) or default_initializer
+    if init is None:
+        init = _I._default_bias_init() if is_bias else _I._default_weight_init()
+    data = init(list(shape), _dtype_mod.canonical_dtype(dtype))
+    return _Param(data, name=(attr.name if attr else name))
+
+
+def check_shape(shape):
+    """Validate a shape argument (ref: utils check_shape): ints or a
+    1-D integer Tensor; -1 allowed at most once."""
+    import builtins as _b
+
+    if isinstance(shape, Tensor):
+        if shape.ndim != 1 or not str(shape.dtype).startswith("int"):
+            raise TypeError("shape Tensor must be 1-D integer")
+        shape = [int(v) for v in shape.numpy()]
+    if _b.any(int(s) < -1 or int(s) == 0 for s in shape):
+        raise ValueError(f"invalid dim in shape {list(shape)}")
+    if _b.sum(1 for s in shape if int(s) == -1) > 1:
+        raise ValueError("only one dim may be -1")
+    return list(int(s) for s in shape)
+
+
+def disable_signal_handler():
+    """The reference unhooks its C++ fatal-signal dumpers; here Python/jax
+    own signal handling already, so this only disables faulthandler."""
+    import faulthandler
+
+    if faulthandler.is_enabled():
+        faulthandler.disable()
+
+
+class LazyGuard:
+    """Defer parameter initialization inside the context (ref:
+    python/paddle/fluid/lazy_init.py LazyGuard): layers built under the
+    guard record their initializers; weights materialize on first
+    forward (Layer.__call__ checks _lazy_uninitialized)."""
+
+    def __enter__(self):
+        from .nn.layer import layers as _L
+
+        _L._lazy_init_state["enabled"] = True
+        return self
+
+    def __exit__(self, *exc):
+        from .nn.layer import layers as _L
+
+        _L._lazy_init_state["enabled"] = False
+        return False
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Static per-layer FLOP count via forward hooks (ref:
+    python/paddle/hapi/dynamic_flops.py flops)."""
+    from .hapi.dynamic_flops import dynamic_flops
+
+    return dynamic_flops(net, input_size, custom_ops=custom_ops, print_detail=print_detail)

@@ -63,6 +63,11 @@ class CUDAPlace(TPUPlace):
     the accelerator place on TPU."""
 
 
+class CUDAPinnedPlace(CPUPlace):
+    """Compat alias: pinned host memory has no TPU analogue (transfers
+    stage through the PJRT host buffer); behaves as CPUPlace."""
+
+
 def _kind_of(d: jax.Device) -> str:
     plat = d.platform
     if plat in ("tpu", "axon"):

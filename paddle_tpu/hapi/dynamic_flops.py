@@ -1,0 +1,123 @@
+"""Per-layer FLOP counting via forward hooks.
+
+ref: python/paddle/hapi/dynamic_flops.py — flops(net, input_size)
+registers a count hook per leaf layer, runs one dummy forward, and sums
+multiply-accumulate counts (their convention: 1 MAC = 1 FLOP, bias adds
+counted, activations counted at one op/element).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.nn as nn
+
+
+def _numel(t):
+    n = 1
+    for s in t.shape:
+        n *= int(s)
+    return n
+
+
+def _count_conv(m, x, y):
+    kernel = getattr(m, "_kernel_size", None) or getattr(m, "kernel_size", None)
+    groups = getattr(m, "_groups", None) or getattr(m, "groups", 1) or 1
+    w = m.weight
+    # weight [out, in/groups, *k]
+    kernel_ops = _numel(w) // int(w.shape[0])
+    bias_ops = 1 if getattr(m, "bias", None) is not None else 0
+    out = y[0] if isinstance(y, (tuple, list)) else y
+    m._flops = _numel(out) * (kernel_ops + bias_ops)
+
+
+def _count_linear(m, x, y):
+    out = y[0] if isinstance(y, (tuple, list)) else y
+    in_f = int(m.weight.shape[0])
+    bias_ops = 1 if getattr(m, "bias", None) is not None else 0
+    m._flops = _numel(out) * (in_f + bias_ops)
+
+
+def _count_norm(m, x, y):
+    out = y[0] if isinstance(y, (tuple, list)) else y
+    m._flops = 2 * _numel(out)
+
+
+def _count_act(m, x, y):
+    out = y[0] if isinstance(y, (tuple, list)) else y
+    m._flops = _numel(out)
+
+
+def _count_pool(m, x, y):
+    out = y[0] if isinstance(y, (tuple, list)) else y
+    m._flops = _numel(out)
+
+
+_HANDLERS = [
+    ((nn.Conv1D, nn.Conv2D, nn.Conv3D, nn.Conv1DTranspose, nn.Conv2DTranspose, nn.Conv3DTranspose), _count_conv),
+    ((nn.Linear,), _count_linear),
+    ((nn.BatchNorm, nn.BatchNorm1D, nn.BatchNorm2D, nn.BatchNorm3D, nn.LayerNorm,
+      nn.GroupNorm, nn.InstanceNorm1D, nn.InstanceNorm2D, nn.InstanceNorm3D, nn.RMSNorm), _count_norm),
+    ((nn.ReLU, nn.ReLU6, nn.GELU, nn.Sigmoid, nn.Tanh, nn.LeakyReLU, nn.Silu,
+      nn.Hardswish, nn.Hardsigmoid, nn.PReLU, nn.ELU, nn.Softmax), _count_act),
+    ((nn.AvgPool1D, nn.AvgPool2D, nn.AvgPool3D, nn.MaxPool1D, nn.MaxPool2D,
+      nn.MaxPool3D, nn.AdaptiveAvgPool1D, nn.AdaptiveAvgPool2D, nn.AdaptiveAvgPool3D), _count_pool),
+]
+
+
+def dynamic_flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count one forward's FLOPs for ``net`` on zeros of ``input_size``.
+
+    custom_ops: {LayerType: fn(layer, inputs, output)} setting
+    layer._flops, merged over the built-in table (ref dynamic_flops
+    custom_ops)."""
+    import paddle_tpu as paddle
+
+    handles = []
+    rows = []
+
+    def _hook_for(layer):
+        if custom_ops:
+            for t, fn in custom_ops.items():
+                if isinstance(layer, t):
+                    return fn
+        for types, fn in _HANDLERS:
+            if isinstance(layer, types):
+                return fn
+        return None
+
+    for name, layer in net.named_sublayers():
+        if len(list(layer.children())) > 0:
+            continue
+        fn = _hook_for(layer)
+        if fn is None:
+            continue
+
+        def make(f, lname):
+            def hook(l, inp, out):
+                f(l, inp, out)
+                rows.append((lname, type(l).__name__, int(getattr(l, "_flops", 0))))
+
+            return hook
+
+        handles.append(layer.register_forward_post_hook(make(fn, name)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        x = paddle.zeros(list(input_size))
+        with paddle.no_grad():
+            net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in handles:
+            h.remove()
+
+    total = sum(r[2] for r in rows)
+    if print_detail:
+        width = max((len(r[0]) for r in rows), default=10) + 2
+        print(f"{'layer':<{width}}{'type':<20}{'FLOPs':>14}")
+        for r in rows:
+            print(f"{r[0]:<{width}}{r[1]:<20}{r[2]:>14,}")
+        print(f"Total FLOPs: {total:,}")
+    return total

@@ -25,6 +25,11 @@ from .. import initializer as I
 
 __all__ = ["Layer", "Parameter"]
 
+# LazyGuard (paddle.LazyGuard) state: "enabled" defers initializers in
+# create_parameter; "pending" counts deferred params process-wide so
+# Layer.__call__ only pays the materialization scan while some exist
+_lazy_init_state = {"enabled": False, "pending": 0}
+
 
 class Parameter(Tensor):
     """Trainable tensor (ref: EagerParamBase, python/paddle/base/framework.py)."""
@@ -32,6 +37,7 @@ class Parameter(Tensor):
     __slots__ = (
         "optimize_attr", "regularizer", "do_model_average", "need_clip",
         "is_distributed", "tp_axis", "ep_axis", "no_weight_decay",
+        "_lazy_init",
     )
 
     def __init__(self, data, trainable=True, name=None, **kw):
@@ -102,6 +108,23 @@ class Layer:
         init = attr.initializer or default_initializer
         if init is None:
             init = I._default_bias_init() if is_bias else I._default_weight_init()
+        if _lazy_init_state["enabled"]:
+            # LazyGuard: record the initializer, materialize on first call
+            import jax.numpy as _jnp
+
+            data = _jnp.zeros((), dtype)
+            p = Parameter(
+                data,
+                trainable=attr.trainable,
+                name=attr.name,
+                optimize_attr={"learning_rate": attr.learning_rate},
+                regularizer=attr.regularizer,
+                do_model_average=attr.do_model_average,
+                need_clip=attr.need_clip,
+            )
+            p._lazy_init = (init, list(shape), dtype)
+            _lazy_init_state["pending"] += 1
+            return p
         data = init(shape, dtype)
         p = Parameter(
             data,
@@ -113,6 +136,15 @@ class Layer:
             need_clip=attr.need_clip,
         )
         return p
+
+    def _materialize_lazy(self):
+        for p in self.parameters():
+            lazy = getattr(p, "_lazy_init", None)
+            if lazy is not None:
+                init, shape, dtype = lazy
+                p._data = init(shape, dtype)
+                p._lazy_init = None
+                _lazy_init_state["pending"] -= 1
 
     def add_parameter(self, name: str, parameter: Optional[Parameter]):
         if parameter is not None and not isinstance(parameter, Parameter):
@@ -301,6 +333,8 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        if _lazy_init_state["pending"] and not _lazy_init_state["enabled"]:
+            self._materialize_lazy()
         for hook in list(self._forward_pre_hooks.values()):
             result = hook(self, inputs)
             if result is not None:

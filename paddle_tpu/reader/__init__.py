@@ -14,6 +14,7 @@ import threading
 from typing import Callable, Iterable
 
 __all__ = [
+    "batch",
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
     "cache", "xmap_readers",
 ]
@@ -153,3 +154,22 @@ def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
                 yield f.result()
 
     return new_reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Batch combinator (ref: python/paddle/reader/decorator.py batch /
+    paddle.batch): groups a sample reader's items into lists."""
+
+    def batch_reader():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    if batch_size <= 0:
+        raise ValueError("batch_size should be a positive integer")
+    return batch_reader

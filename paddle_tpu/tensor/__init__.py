@@ -167,5 +167,43 @@ def _install_methods():
 
 _install_methods()
 
+
+def _install_inplace_sweep():
+    """Generate the reference's ``op_`` in-place variants for every op
+    whose functional form exists (ref: python/paddle/tensor/__init__.py
+    inplace_apis listing; functional rebinding via Tensor._inplace_from)."""
+    import sys
+
+    mod = sys.modules[__name__]
+    names = [
+        # math
+        "cumsum", "cumprod", "logit", "cos", "tan", "sin", "acos", "asin",
+        "atan", "cosh", "sinh", "expm1", "lgamma", "square", "gcd", "lcm",
+        "erf", "log", "log2", "log10", "log1p", "trunc", "frac", "digamma",
+        "renorm", "nan_to_num", "i0", "polygamma", "copysign", "hypot",
+        "ldexp", "multigammaln", "gammainc", "gammaincc", "gammaln", "sinc",
+        "pow", "mod", "floor_divide", "remainder", "floor_mod", "addmm",
+        "logical_and", "logical_or", "logical_xor", "logical_not",
+        "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+        "bitwise_left_shift", "bitwise_right_shift",
+        # comparisons (reference defines in-place forms of these too)
+        "equal", "not_equal", "less_than", "less_equal", "greater_than",
+        "greater_equal",
+        # manipulation / indexing
+        "t", "flatten", "triu", "tril", "cast", "index_add", "index_put",
+        "index_fill", "masked_scatter",
+    ]
+    for base in names:
+        fn = getattr(mod, base, None)
+        if fn is None or hasattr(mod, base + "_"):
+            continue
+        ip = math._make_inplace(fn)
+        setattr(mod, base + "_", ip)
+        if not hasattr(Tensor, base + "_"):
+            setattr(Tensor, base + "_", ip)
+
+
+_install_inplace_sweep()
+
 from . import array  # noqa: F401
 from .array import array_length, array_read, array_write, create_array  # noqa: F401

@@ -77,3 +77,21 @@ def isin(x, test_x, assume_unique=False, invert=False, name=None):
         test_x,
         op_name="isin",
     )
+
+
+def is_complex(x):
+    """ref: python/paddle/tensor/attribute.py is_complex."""
+    return np.issubdtype(np.dtype(x.dtype), np.complexfloating)
+
+
+def is_integer(x):
+    """ref: attribute.py is_integer."""
+    return np.issubdtype(np.dtype(x.dtype), np.integer)
+
+
+def is_floating_point(x):
+    """ref: attribute.py is_floating_point."""
+    d = np.dtype(x.dtype)
+    import ml_dtypes
+
+    return np.issubdtype(d, np.floating) or d == np.dtype(ml_dtypes.bfloat16) or d == np.dtype(ml_dtypes.float8_e4m3fn)

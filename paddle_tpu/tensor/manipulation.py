@@ -571,3 +571,134 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: 
         return jnp.where(in_shard, a - lo, ignore_value)
 
     return apply(_f, input, op_name="shard_index")
+
+
+# -- parity sweep: stack/split conveniences & scatter variants --------------
+# (ref: python/paddle/tensor/manipulation.py torch-parity additions)
+
+
+def hstack(x, name=None):
+    return apply(lambda *xs: jnp.hstack(xs), *x, op_name="hstack")
+
+
+def vstack(x, name=None):
+    return apply(lambda *xs: jnp.vstack(xs), *x, op_name="vstack")
+
+
+def dstack(x, name=None):
+    return apply(lambda *xs: jnp.dstack(xs), *x, op_name="dstack")
+
+
+def column_stack(x, name=None):
+    return apply(lambda *xs: jnp.column_stack(xs), *x, op_name="column_stack")
+
+
+def row_stack(x, name=None):
+    return vstack(x, name)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """Uneven-capable split (ref manipulation.py tensor_split)."""
+    n = x.shape[axis if axis >= 0 else x.ndim + axis]
+    if isinstance(num_or_indices, int):
+        k = num_or_indices
+        base, rem = divmod(n, k)
+        bounds = []
+        pos = 0
+        for i in range(k - 1):
+            pos += base + (1 if i < rem else 0)
+            bounds.append(pos)
+    else:
+        bounds = list(num_or_indices)
+    outs = apply(
+        lambda a: tuple(jnp.split(a, bounds, axis=axis)), x, op_name="tensor_split"
+    )
+    return list(outs)
+
+
+def hsplit(x, num_or_indices, name=None):
+    if x.ndim < 1:
+        raise ValueError("hsplit expects at least 1-D input")
+    return tensor_split(x, num_or_indices, axis=0 if x.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    if x.ndim < 2:
+        raise ValueError("vsplit expects at least 2-D input")
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    if x.ndim < 3:
+        raise ValueError("dsplit expects at least 3-D input")
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def unflatten(x, axis, shape, name=None):
+    """Expand one axis into the given shape (ref manipulation.py unflatten)."""
+    ax = axis if axis >= 0 else x.ndim + axis
+    shape = [int(s) for s in shape]
+
+    def _f(a):
+        new = list(a.shape[:ax]) + shape + list(a.shape[ax + 1:])
+        # one -1 allowed
+        return a.reshape(new)
+
+    return apply(_f, x, op_name="unflatten")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Write ``value`` into a strided slice of x (ref manipulation.py)."""
+    import builtins as _b
+
+    def _f(a, v):
+        idx = [_b.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = _b.slice(st, en, sd)
+        return a.at[tuple(idx)].set(v)
+
+    return apply(_f, x, value, op_name="slice_scatter")
+
+
+def select_scatter(x, value, axis, index, name=None):
+    """Write ``value`` into x at ``index`` along ``axis``."""
+    import builtins as _b
+
+    def _f(a, v):
+        idx = [_b.slice(None)] * a.ndim
+        idx[axis if axis >= 0 else a.ndim + axis] = index
+        return a.at[tuple(idx)].set(v)
+
+    return apply(_f, x, value, op_name="select_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write y onto a diagonal of x (ref manipulation.py diagonal_scatter)."""
+    import builtins as _b
+
+    def _f(a, v):
+        n = _b.min(
+            a.shape[axis1] - _b.max(-offset, 0),
+            a.shape[axis2] - _b.max(offset, 0),
+        )
+        i = jnp.arange(n)
+        idx = [_b.slice(None)] * a.ndim
+        idx[axis1] = i + _b.max(-offset, 0)
+        idx[axis2] = i + _b.max(offset, 0)
+        # y follows x.diagonal()'s layout (diag dim LAST); advanced
+        # indexing puts the diag dim first, so align v
+        if v.ndim > 1:
+            v = jnp.moveaxis(v, -1, 0)
+        return a.at[tuple(idx)].set(v)
+
+    return apply(_f, x, y, op_name="diagonal_scatter")
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (ref manipulation.py reverse)."""
+    return flip(x, axis)
+
+
+def tolist(x):
+    """Nested python list of values (ref tensor_patch_methods tolist)."""
+    return np.asarray(x._data if isinstance(x, Tensor) else x).tolist()

@@ -391,3 +391,173 @@ ceil_ = _make_inplace(ceil)
 tanh_ = _make_inplace(tanh)
 abs_ = _make_inplace(abs)
 neg_ = _make_inplace(neg)
+
+
+# -- parity sweep: special functions & reductions ---------------------------
+# (ref: python/paddle/tensor/math.py entries added for torch-parity APIs)
+
+sinc = _unary(jnp.sinc, "sinc")
+signbit = _unary(jnp.signbit, "signbit")
+gammaln = _unary(jax.scipy.special.gammaln, "gammaln")
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) (ref math.py gammainc)."""
+    return apply(jax.scipy.special.gammainc, x, y, op_name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y)."""
+    return apply(jax.scipy.special.gammaincc, x, y, op_name="gammaincc")
+
+
+def multigammaln(x, p, name=None):
+    """log multivariate gamma: sum_i gammaln(x - i/2) + const (ref math.py)."""
+
+    def _f(a):
+        a = a.astype(jnp.float32) if a.dtype not in (jnp.float32, jnp.float64) else a
+        const = 0.25 * p * (p - 1) * np.log(np.pi)
+        i = jnp.arange(p, dtype=a.dtype)
+        return const + jnp.sum(
+            jax.scipy.special.gammaln(a[..., None] - i / 2.0), axis=-1
+        )
+
+    return apply(_f, x, op_name="multigammaln")
+
+
+def polygamma(x, n, name=None):
+    """n-th derivative of digamma (ref math.py polygamma)."""
+    return apply(lambda a: jax.scipy.special.polygamma(n, a), x, op_name="polygamma")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """Cumulative logsumexp (ref math.py logcumsumexp)."""
+
+    def _f(a):
+        if axis is None:
+            return jax.lax.cumlogsumexp(a.reshape(-1), axis=0)
+        return jax.lax.cumlogsumexp(a, axis=axis)
+
+    out = apply(_f, x, op_name="logcumsumexp")
+    return out.astype(dtype) if dtype is not None else out
+
+
+def frexp(x, name=None):
+    """Mantissa/exponent decomposition (ref math.py frexp)."""
+    return apply(lambda a: jnp.frexp(a), x, op_name="frexp")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal integration (ref math.py trapezoid)."""
+    if x is not None:
+        return apply(
+            lambda yy, xx: jnp.trapezoid(yy, xx, axis=axis), y, x, op_name="trapezoid"
+        )
+    step = 1.0 if dx is None else dx
+    return apply(lambda yy: jnp.trapezoid(yy, dx=step, axis=axis), y, op_name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoidal integration (ref math.py)."""
+
+    def _with_x(yy, xx):
+        d = jnp.diff(xx, axis=axis) if xx.ndim > 1 else jnp.diff(xx)
+        if xx.ndim == 1 and yy.ndim > 1:
+            shape = [1] * yy.ndim
+            shape[axis] = d.shape[0]
+            d = d.reshape(shape)
+        avg = (_take_slice(yy, 1, None) + _take_slice(yy, None, -1)) / 2.0
+        return jnp.cumsum(d * avg, axis=axis)
+
+    def _take_slice(a, lo, hi):
+        idx = [slice(None)] * a.ndim
+        idx[axis if axis >= 0 else a.ndim + axis] = slice(lo, hi)
+        return a[tuple(idx)]
+
+    if x is not None:
+        return apply(_with_x, y, x, op_name="cumulative_trapezoid")
+    step = 1.0 if dx is None else dx
+
+    def _no_x(yy):
+        avg = (_take_slice(yy, 1, None) + _take_slice(yy, None, -1)) / 2.0
+        return jnp.cumsum(step * avg, axis=axis)
+
+    return apply(_no_x, y, op_name="cumulative_trapezoid")
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to target's shape (ref math.py reduce_as)."""
+
+    def _f(a, t):
+        extra = a.ndim - t.ndim
+        if extra:
+            a = a.sum(axis=tuple(range(extra)))
+        axes = tuple(i for i, (s, ts) in enumerate(zip(a.shape, t.shape)) if s != ts)
+        return a.sum(axis=axes, keepdims=True) if axes else a
+
+    return apply(_f, x, target, op_name="reduce_as")
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (ref math.py add_n)."""
+    import functools
+    import operator
+
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply(
+        lambda *xs: functools.reduce(operator.add, xs), *inputs, op_name="add_n"
+    )
+
+
+def block_diag(inputs, name=None):
+    """Block-diagonal matrix from a list (ref math.py block_diag)."""
+    return apply(
+        lambda *xs: jax.scipy.linalg.block_diag(*[jnp.atleast_2d(x) for x in xs]),
+        *inputs,
+        op_name="block_diag",
+    )
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors (ref math.py cartesian_prod)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+
+    def _f(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    out = apply(_f, *xs, op_name="cartesian_prod")
+    return out
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """r-length combinations of a 1-D tensor (ref math.py combinations)."""
+    import itertools
+
+    n = x.shape[0]
+    pool = (
+        itertools.combinations_with_replacement(range(n), r)
+        if with_replacement
+        else itertools.combinations(range(n), r)
+    )
+    idx = np.array(list(pool), np.int32).reshape(-1, r)
+
+    def _f(a):
+        return a[jnp.asarray(idx)]
+
+    return apply(_f, x, op_name="combinations")
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distance of [N, D] rows (ref math.py pdist)."""
+    n = x.shape[0]
+    iu = np.triu_indices(n, k=1)
+
+    def _f(a):
+        diff = a[jnp.asarray(iu[0])] - a[jnp.asarray(iu[1])]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+    return apply(_f, x, op_name="pdist")

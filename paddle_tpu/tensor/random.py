@@ -160,3 +160,13 @@ def geometric_(x, probs, name=None):
 def log_normal_(x, mean=1.0, std=2.0, name=None):
     key = _random.next_key()
     return x.set_value(jnp.exp(mean + std * jax.random.normal(key, tuple(x.shape), x._data.dtype)))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """Sample exp(N(mean, std)) (ref: python/paddle/tensor/random.py
+    log_normal)."""
+    key = _random.next_key()
+    return Tensor(
+        jnp.exp(mean + std * jax.random.normal(key, _shape(shape), _dt(None))),
+        _internal=True,
+    )
