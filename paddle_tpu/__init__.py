@@ -179,7 +179,7 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_
     if init is None:
         init = _I._default_bias_init() if is_bias else _I._default_weight_init()
     data = init(list(shape), _dtype_mod.canonical_dtype(dtype))
-    return _Param(data, name=(attr.name if attr else name))
+    return _Param(data, name=((attr.name if attr else None) or name))
 
 
 def check_shape(shape):

@@ -49,6 +49,9 @@ from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: 
 from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import ps  # noqa: F401
+from . import io  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .parity import *  # noqa: F401,F403
 from . import launch  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .auto_parallel import (  # noqa: F401

@@ -1,0 +1,33 @@
+"""paddle.distributed.io parity (ref: python/paddle/distributed/io.py):
+persistables save/load for distributed programs. On the single-controller
+runtime these delegate to the framework checkpoint path — sharded params
+are gathered by jax.device_get exactly once on save."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", True))
+
+
+def save_persistables(executor=None, dirname="", main_program=None, filename=None):
+    """ref: io.py save_persistables — main_program here is a Layer (the
+    jit runtime has no ProgramDesc); saves its state_dict."""
+    from ..framework.io import save
+
+    if main_program is None or not hasattr(main_program, "state_dict"):
+        raise ValueError("save_persistables expects a Layer as main_program")
+    os.makedirs(dirname, exist_ok=True)
+    save(main_program.state_dict(), os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor=None, dirname="", main_program=None, filename=None):
+    from ..framework.io import load
+
+    if main_program is None or not hasattr(main_program, "set_state_dict"):
+        raise ValueError("load_persistables expects a Layer as main_program")
+    sd = load(os.path.join(dirname, filename or "persistables.pdparams"))
+    main_program.set_state_dict(sd)

@@ -1,0 +1,483 @@
+"""Distributed namespace parity additions.
+
+ref: python/paddle/distributed/__init__.py __all__ entries not covered
+by the core modules — TP split op, object collectives, fleet dataset
+shells, PS entry policies, auto-parallel Strategy/DistModel/to_static,
+sharding-stage tags, and misc aliases. Each maps the reference's
+behavior onto the SPMD/XLA runtime (notes inline).
+"""
+from __future__ import annotations
+
+import pickle
+from enum import Enum
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tensor import Tensor
+from .collective import Group, _get_global_group
+
+__all__ = [
+    "gather", "scatter_object_list", "broadcast_object_list", "wait",
+    "isend", "irecv", "is_available", "get_backend", "ParallelMode",
+    "ReduceType", "split", "gloo_init_parallel_env", "gloo_barrier",
+    "gloo_release", "CountFilterEntry", "ShowClickEntry",
+    "ProbabilityEntry", "QueueDataset", "InMemoryDataset", "DistAttr",
+    "Strategy", "DistModel", "to_static", "shard_dataloader",
+    "shard_scaler", "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "unshard_dtensor",
+]
+
+
+# ---------------------------------------------------------------------------
+# small collectives / aliases
+# ---------------------------------------------------------------------------
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """ref: communication/gather.py — rank dst receives all shards. The
+    single-controller SPMD model sees every shard, so this is
+    all_gather with the reference's dst-only contract relaxed (every
+    rank's list is filled; matches dst's view)."""
+    from .communication import all_gather
+
+    out: List = gather_list if gather_list is not None else []
+    all_gather(out, tensor, group=group)
+    return out
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    """ref: communication/scatter.py scatter_object_list. Group-of-one:
+    identity; the single controller owns every rank's objects."""
+    g = group or _get_global_group()
+    if g.nranks == 1:
+        out_object_list.clear()
+        out_object_list.extend(in_object_list[:1] if in_object_list else [])
+        return
+    raise RuntimeError(
+        "scatter_object_list: eager multi-rank object scatter is not "
+        "representable in the single-controller model; pass host objects "
+        "directly (every process sees the full program)."
+    )
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """ref: communication/broadcast.py broadcast_object_list. On a
+    single controller every process already holds src's objects; multi-
+    host uses the JAX coordination service."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        data = np.frombuffer(pickle.dumps(object_list), np.uint8)
+        # fixed-size broadcast: length first, then payload
+        n = multihost_utils.broadcast_one_to_all(np.asarray([data.size], np.int64))
+        buf = np.zeros(int(n[0]), np.uint8)
+        if jax.process_index() == 0:
+            buf[: data.size] = data
+        out = multihost_utils.broadcast_one_to_all(buf)
+        object_list[:] = pickle.loads(out.tobytes())
+    return object_list
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """ref: communication/wait.py — block until the tensor's pending
+    work is done (XLA: block_until_ready)."""
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    jax.block_until_ready(arr)
+
+
+class _Work:
+    def __init__(self, result=None):
+        self._result = result
+
+    def wait(self):
+        if self._result is not None:
+            jax.block_until_ready(
+                self._result._data if isinstance(self._result, Tensor) else self._result
+            )
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    """ref: communication/send.py isend — async send returning Work."""
+    from .communication import send
+
+    send(tensor, dst=dst, group=group, sync_op=False)
+    return _Work(tensor)
+
+
+def irecv(tensor, src=0, group=None):
+    from .communication import recv
+
+    recv(tensor, src=src, group=group, sync_op=False)
+    return _Work(tensor)
+
+
+def is_available() -> bool:
+    """ref: parallel.py is_available — collectives are always available
+    (XLA ships them)."""
+    return True
+
+
+def get_backend(group=None) -> str:
+    """ref: communication/group.py get_backend; 'XCCL' stands in for
+    NCCL on TPU (XLA collectives over ICI)."""
+    return "XCCL"
+
+
+class ParallelMode:
+    """ref: parallel.py ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """ref: auto_parallel ReduceType (Partial reduce kinds)."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """ref: fleet/layers/mpu/mp_ops.py split — build a row/column-
+    parallel linear or vocab-parallel embedding over the mp group.
+    Returns the layer output (the reference's functional form)."""
+    from .fleet.layers.mpu.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"split: unknown operation {operation!r}")
+
+
+# gloo (CPU rendezvous) — the JAX coordination service owns host
+# coordination; these keep the reference's API alive (ref:
+# parallel.py gloo_init_parallel_env / collective gloo wrappers)
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    if rank_num > 1 and jax.process_count() <= 1:
+        raise RuntimeError(
+            "gloo_init_parallel_env: start processes via paddle_tpu."
+            "distributed.launch (JAX coordination service) instead of gloo."
+        )
+
+
+def gloo_barrier():
+    from .communication import barrier
+
+    barrier()
+
+
+def gloo_release():
+    pass  # coordination service lifetime is owned by jax.distributed
+
+
+# ---------------------------------------------------------------------------
+# PS entry policies + fleet datasets (ref: distributed/entry_attr.py,
+# fleet/dataset/dataset.py)
+# ---------------------------------------------------------------------------
+
+
+class ProbabilityEntry:
+    """ref: entry_attr.py ProbabilityEntry — admit new rows with prob p."""
+
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+class CountFilterEntry:
+    """ref: entry_attr.py CountFilterEntry — admit rows seen >= count
+    times (maps to SparseTable.shrink(show_threshold=count))."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = count_filter
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+
+class ShowClickEntry:
+    """ref: entry_attr.py ShowClickEntry — show/click statistic names."""
+
+    def __init__(self, show_name, click_name):
+        self.show_name = show_name
+        self.click_name = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"
+
+
+class InMemoryDataset:
+    """ref: fleet/dataset InMemoryDataset — loads sample files into
+    memory, supports shuffle and iteration. File format: one sample per
+    line (the reference's pipe_command preprocessing is a host concern;
+    pass parse_fn instead)."""
+
+    def __init__(self):
+        self._files: List[str] = []
+        self._samples: List = []
+        self._parse = None
+        self.batch_size = 1
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None, parse_fn=None, **kw):
+        self.batch_size = batch_size
+        self._parse = parse_fn
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        self._samples = []
+        for f in self._files:
+            with open(f) as fh:
+                for line in fh:
+                    line = line.rstrip("\n")
+                    self._samples.append(self._parse(line) if self._parse else line)
+
+    def local_shuffle(self, seed=0):
+        rng = np.random.RandomState(seed)
+        rng.shuffle(self._samples)
+
+    global_shuffle = local_shuffle  # single controller: one memory image
+
+    def get_memory_data_size(self):
+        return len(self._samples)
+
+    def __iter__(self):
+        batch = []
+        for s in self._samples:
+            batch.append(s)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+    def release_memory(self):
+        self._samples = []
+
+
+class QueueDataset(InMemoryDataset):
+    """ref: fleet/dataset QueueDataset — streaming variant: iterates
+    files lazily instead of loading into memory."""
+
+    def load_into_memory(self):
+        pass  # streaming: nothing to preload
+
+    def __iter__(self):
+        batch = []
+        for f in self._files:
+            with open(f) as fh:
+                for line in fh:
+                    line = line.rstrip("\n")
+                    batch.append(self._parse(line) if self._parse else line)
+                    if len(batch) == self.batch_size:
+                        yield batch
+                        batch = []
+        if batch:
+            yield batch
+
+
+# ---------------------------------------------------------------------------
+# auto-parallel front door (ref: distributed/auto_parallel/api.py
+# Strategy/DistModel/to_static, high_level_api shard_dataloader)
+# ---------------------------------------------------------------------------
+
+
+class DistAttr:
+    """ref: DistAttr(mesh, sharding_specs) — legacy spelling of
+    (mesh, placements)."""
+
+    def __init__(self, mesh, sharding_specs):
+        from .auto_parallel import Replicate, Shard
+
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs
+        # placements are per MESH dim: mesh axis a shards the tensor dim
+        # whose spec names a, else replicates
+        names = list(getattr(mesh, "dim_names", []) or [])
+        self.placements = [
+            next(
+                (Shard(i) for i, spec in enumerate(sharding_specs) if spec == a),
+                Replicate(),
+            )
+            for a in names
+        ]
+
+
+class Strategy:
+    """ref: auto_parallel/strategy.py Strategy — config bag; the GSPMD
+    compiler consumes the sharding/gradient-merge knobs that matter."""
+
+    def __init__(self, config=None):
+        config = config or {}
+        self.sharding = type("C", (), {"enable": False, "stage": 1, "degree": 8})()
+        self.fused_passes = type("C", (), {"enable": False, "fused_passes_list": []})()
+        self.gradient_merge = type("C", (), {"enable": False, "k_steps": 1, "avg": True})()
+        self.pipeline = type("C", (), {"enable": False, "schedule_mode": "1F1B", "micro_batch_size": 1, "accumulate_steps": 1})()
+        for k, v in config.items():
+            setattr(self, k, v)
+
+
+class ShardingStage1:
+    """Tag for dist.to_static sharding level (ref: api.py ShardingStage1)."""
+
+
+class ShardingStage2:
+    pass
+
+
+class ShardingStage3:
+    pass
+
+
+class DistModel:
+    """ref: api.py DistModel — the to_static product: a compiled
+    train/eval step over the mesh. Modes follow the reference: call
+    train()/eval() then invoke with (inputs, labels)."""
+
+    def __init__(self, layer, loader, loss=None, optimizer=None, strategy=None):
+        self._layer = layer
+        self._loader = loader
+        self._loss = loss
+        self._opt = optimizer
+        self._strategy = strategy
+        self._mode = "train"
+        self._compiled = {}
+
+    def train(self):
+        self._mode = "train"
+        self._layer.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self._layer.eval()
+
+    def __call__(self, *args):
+        import paddle_tpu.jit as pjit
+
+        mode = self._mode
+        if mode not in self._compiled:
+            layer, loss_fn, opt = self._layer, self._loss, self._opt
+
+            if mode == "train":
+                def step(*xs):
+                    *inputs, label = xs
+                    out = layer(*inputs)
+                    loss = loss_fn(out, label)
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                    return loss
+
+                self._compiled[mode] = pjit.to_static(step, layers=[layer], optimizers=[opt])
+            else:
+                def step(*xs):
+                    *inputs, label = xs
+                    out = layer(*inputs)
+                    return loss_fn(out, label) if loss_fn else out
+
+                self._compiled[mode] = pjit.to_static(step, layers=[layer])
+        return self._compiled[mode](*args)
+
+    def state_dict(self, mode="all"):
+        sd = self._layer.state_dict()
+        if mode in ("all", "opt") and self._opt is not None:
+            sd.update({f"opt.{k}": v for k, v in self._opt.state_dict().items()
+                       if hasattr(v, "shape")})
+        return sd
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """ref: api.py to_static — returns a DistModel running the layer's
+    step compiled under GSPMD with the current mesh's shardings."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """ref: high_level_api shard_dataloader — places each batch on the
+    mesh, sharding the batch dim over the dp axis. Single-controller:
+    wrap the loader, device_put each batch with a NamedSharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    jmesh = getattr(mesh, "_jax_mesh", None) or getattr(mesh, "mesh", None) or mesh
+
+    class _Sharded:
+        def __init__(self, dl):
+            self._dl = dl
+
+        def __iter__(self):
+            axis = shard_dims if isinstance(shard_dims, str) else (
+                jmesh.axis_names[0] if hasattr(jmesh, "axis_names") else None
+            )
+            for batch in self._dl:
+                def place(t):
+                    if isinstance(t, Tensor) and axis is not None:
+                        spec = P(*((axis,) + (None,) * (t.ndim - 1)))
+                        t._data = jax.device_put(t._data, NamedSharding(jmesh, spec))
+                    return t
+
+                yield jax.tree.map(
+                    place, batch,
+                    is_leaf=lambda x: isinstance(x, Tensor),
+                )
+
+        def __len__(self):
+            return len(self._dl)
+
+    return _Sharded(dataloader)
+
+
+def shard_scaler(scaler):
+    """ref: api.py shard_scaler — make a GradScaler aware of sharded
+    grads. Sharded arrays reduce with jnp.isfinite across shards under
+    GSPMD automatically, so the scaler works as-is."""
+    return scaler
+
+
+def unshard_dtensor(dist_tensor):
+    """ref: api.py unshard_dtensor — gather to a replicated dense
+    tensor."""
+    arr = dist_tensor._data if isinstance(dist_tensor, Tensor) else dist_tensor
+    gathered = jax.device_get(arr)
+    out = Tensor(jnp.asarray(gathered), _internal=True)
+    if isinstance(dist_tensor, Tensor):
+        out.stop_gradient = dist_tensor.stop_gradient
+    return out

@@ -12,5 +12,20 @@ engine rather than re-implemented:
 - ``distributed.models.moe`` → fleet's MoELayer.
 """
 from . import asp  # noqa: F401
+from .ops import (  # noqa: F401
+    LookAhead,
+    ModelAverage,
+    graph_khop_sampler,
+    graph_reindex,
+    graph_sample_neighbors,
+    graph_send_recv,
+    identity_loss,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401

@@ -1,0 +1,282 @@
+"""Incubate free functions: segment/graph ops, fused-softmax masks,
+wrapper optimizers (ref: python/paddle/incubate/__init__.py __all__;
+python/paddle/incubate/tensor/math.py segment ops;
+python/paddle/incubate/operators/ graph_* ; optimizer/lookahead.py,
+modelaverage.py).
+
+TPU design notes: segment reductions are jax.ops.segment_* (one XLA
+scatter, the phi segment_pool CUDA kernel's analogue); graph message
+passing composes them; the neighbor samplers run host-side on numpy CSR
+(sampling is data-dependent control flow that does not belong under
+jit — the reference also runs them on CPU ints)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from ..base.tensor import Tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "graph_send_recv", "graph_khop_sampler", "graph_sample_neighbors",
+    "graph_reindex", "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+    "identity_loss", "LookAhead", "ModelAverage",
+]
+
+
+def _num_segments(segment_ids):
+    ids = np.asarray(jax.device_get(segment_ids._data if isinstance(segment_ids, Tensor) else segment_ids))
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def _segment(jfn, empty_fill):
+    def op(data, segment_ids, name=None):
+        n = _num_segments(segment_ids)
+
+        def _f(d, ids):
+            out = jfn(d, ids.reshape(-1), num_segments=n)
+            # paddle fills empty segments with 0 (sum/mean) — jax max/min
+            # fill with -inf/+inf; normalize to 0 like the reference
+            if empty_fill is not None:
+                counts = jax.ops.segment_sum(jnp.ones_like(ids, jnp.float32), ids, num_segments=n)
+                out = jnp.where((counts > 0).reshape((-1,) + (1,) * (out.ndim - 1)), out, empty_fill)
+            return out
+
+        return apply(_f, data, segment_ids, op_name="segment")
+
+    return op
+
+
+segment_sum = _segment(jax.ops.segment_sum, None)
+segment_mean = _segment(
+    lambda d, ids, num_segments: jax.ops.segment_sum(d, ids, num_segments=num_segments)
+    / jnp.maximum(
+        jax.ops.segment_sum(jnp.ones(ids.shape + (1,) * (d.ndim - 1), d.dtype), ids, num_segments=num_segments),
+        1,
+    ),
+    0.0,
+)
+segment_max = _segment(jax.ops.segment_max, 0.0)
+segment_min = _segment(jax.ops.segment_min, 0.0)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None, name=None):
+    """Gather x at src, reduce onto dst (ref:
+    incubate/operators/graph_send_recv.py — the message-passing
+    primitive). pool_type: sum/mean/max/min."""
+    n = out_size or x.shape[0]
+    red = {
+        "sum": jax.ops.segment_sum,
+        "mean": None,
+        "max": jax.ops.segment_max,
+        "min": jax.ops.segment_min,
+    }[pool_type.lower()]
+
+    def _f(xx, src, dst):
+        msgs = xx[src.reshape(-1)]
+        dsts = dst.reshape(-1)
+        if pool_type.lower() == "mean":
+            s = jax.ops.segment_sum(msgs, dsts, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones((dsts.shape[0],) + (1,) * (msgs.ndim - 1), msgs.dtype), dsts, num_segments=n)
+            return s / jnp.maximum(c, 1)
+        out = red(msgs, dsts, num_segments=n)
+        if pool_type.lower() in ("max", "min"):
+            c = jax.ops.segment_sum(jnp.ones_like(dsts, jnp.float32), dsts, num_segments=n)
+            out = jnp.where((c > 0).reshape((-1,) + (1,) * (out.ndim - 1)), out, 0.0)
+        return out
+
+    return apply(_f, x, src_index, dst_index, op_name="graph_send_recv")
+
+
+def _csr_from_edges(row, colptr_nodes):
+    """Host CSR build for samplers."""
+    row = np.asarray(row)
+    order = np.argsort(row, kind="stable")
+    return order
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           flag_perm_buffer=False, name=None):
+    """Uniform neighbor sampling on a CSC graph (ref:
+    incubate/operators/graph_sample_neighbors.py). Host-side numpy."""
+    rng = np.random.RandomState(0)
+    rowv = np.asarray(jax.device_get(row._data if isinstance(row, Tensor) else row)).reshape(-1)
+    cp = np.asarray(jax.device_get(colptr._data if isinstance(colptr, Tensor) else colptr)).reshape(-1)
+    nodes = np.asarray(jax.device_get(input_nodes._data if isinstance(input_nodes, Tensor) else input_nodes)).reshape(-1)
+    out_nb, out_cnt, out_eids = [], [], []
+    for v in nodes:
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        nbrs = rowv[lo:hi]
+        idx = np.arange(lo, hi)
+        if sample_size > 0 and nbrs.shape[0] > sample_size:
+            pick = rng.choice(nbrs.shape[0], sample_size, replace=False)
+            nbrs, idx = nbrs[pick], idx[pick]
+        out_nb.append(nbrs)
+        out_eids.append(idx)
+        out_cnt.append(len(nbrs))
+    from ..base.tensor import to_tensor
+
+    nb = to_tensor(np.concatenate(out_nb).astype(np.int64) if out_nb else np.zeros(0, np.int64))
+    cnt = to_tensor(np.asarray(out_cnt, np.int64))
+    if return_eids:
+        ev = np.concatenate(out_eids).astype(np.int64) if out_eids else np.zeros(0, np.int64)
+        if eids is not None:
+            earr = np.asarray(jax.device_get(eids._data if isinstance(eids, Tensor) else eids)).reshape(-1)
+            ev = earr[ev]
+        return nb, cnt, to_tensor(ev)
+    return nb, cnt
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a sampled subgraph to local ids (ref:
+    incubate/operators/graph_reindex.py). Host-side numpy."""
+    xs = np.asarray(jax.device_get(x._data if isinstance(x, Tensor) else x)).reshape(-1)
+    nb = np.asarray(jax.device_get(neighbors._data if isinstance(neighbors, Tensor) else neighbors)).reshape(-1)
+    cnt = np.asarray(jax.device_get(count._data if isinstance(count, Tensor) else count)).reshape(-1)
+    mapping = {}
+    for v in xs.tolist():
+        mapping.setdefault(int(v), len(mapping))
+    for v in nb.tolist():
+        mapping.setdefault(int(v), len(mapping))
+    nodes = np.fromiter(mapping.keys(), np.int64, len(mapping))
+    reindex_nb = np.asarray([mapping[int(v)] for v in nb], np.int64)
+    # reindexed dst: each center i repeated count[i]
+    reindex_dst = np.repeat(np.asarray([mapping[int(v)] for v in xs], np.int64), cnt)
+    from ..base.tensor import to_tensor
+
+    return to_tensor(reindex_nb), to_tensor(reindex_dst), to_tensor(nodes)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling: repeated sample_neighbors + reindex (ref:
+    incubate/operators/graph_khop_sampler.py)."""
+    frontier = input_nodes
+    all_nb, all_cnt = [], []
+    for size in sample_sizes:
+        nb, cnt = graph_sample_neighbors(row, colptr, frontier, sample_size=size)
+        all_nb.append(nb)
+        all_cnt.append(cnt)
+        frontier = nb
+    nb_cat = np.concatenate([np.asarray(jax.device_get(t._data)).reshape(-1) for t in all_nb])
+    cnt_cat = np.concatenate([np.asarray(jax.device_get(t._data)).reshape(-1) for t in all_cnt])
+    from ..base.tensor import to_tensor
+
+    reindex_nb, reindex_dst, nodes = graph_reindex(
+        input_nodes, to_tensor(nb_cat.astype(np.int64)), to_tensor(cnt_cat.astype(np.int64))
+    )
+    return reindex_nb, reindex_dst, nodes, to_tensor(cnt_cat.astype(np.int64))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused kernel (ref:
+    incubate/operators/softmax_mask_fuse.py; XLA fuses the chain)."""
+    return apply(
+        lambda a, m: jax.nn.softmax((a + m).astype(jnp.float32), axis=-1).astype(a.dtype),
+        x, mask, op_name="softmax_mask_fuse",
+    )
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax (ref softmax_mask_fuse_upper_triangle.py):
+    masks strictly-upper entries of the last two dims."""
+
+    def _f(a):
+        s = a.shape[-1]
+        causal = jnp.tril(jnp.ones((a.shape[-2], s), bool))
+        logits = jnp.where(causal, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(logits, axis=-1).astype(a.dtype)
+
+    return apply(_f, x, op_name="softmax_mask_fuse_upper_triangle")
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss (ref incubate identity_loss); reduction
+    in {none, mean, sum} / {0,1,2}."""
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    if red == "mean":
+        return x.mean()
+    if red == "sum":
+        return x.sum()
+    return x
+
+
+class LookAhead:
+    """Lookahead wrapper optimizer (ref:
+    python/paddle/incubate/optimizer/lookahead.py): every k steps the
+    slow weights move alpha of the way toward the fast weights and the
+    fast weights reset to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = None
+
+    @property
+    def _params(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._slow is None:
+            self._slow = [p._data for p in self._params]
+        if self._step_num % self.k == 0:
+            for i, p in enumerate(self._params):
+                slow = self._slow[i] + self.alpha * (p._data - self._slow[i])
+                self._slow[i] = slow
+                p._data = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_num
+        return sd
+
+
+class ModelAverage:
+    """Exponential window average of parameters for eval (ref:
+    python/paddle/incubate/optimizer/modelaverage.py): accumulates
+    running sums; apply() swaps averaged weights in, restore() swaps
+    back."""
+
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self.rate = average_window_rate
+        self.min_w, self.max_w = min_average_window, max_average_window
+        self._params = list(parameters or [])
+        self._sum = [jnp.zeros_like(p._data) for p in self._params]
+        self._cnt = 0
+        self._backup = None
+
+    def step(self):
+        self._cnt += 1
+        window = max(self.min_w, min(self.max_w, int(self._cnt * self.rate) or 1))
+        decay = max(0.0, 1.0 - 1.0 / window)
+        self._sum = [s * decay + p._data * (1 - decay) for s, p in zip(self._sum, self._params)]
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = [p._data for p in self._params]
+        for p, s in zip(self._params, self._sum):
+            p._data = s
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._data = b
+            self._backup = None

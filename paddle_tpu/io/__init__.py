@@ -38,9 +38,30 @@ from .sampler import (  # noqa: F401
 from .dataloader import DataLoader, default_collate_fn, default_convert_fn  # noqa: F401
 
 __all__ = [
+    "get_worker_info", "WorkerInfo",
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "ConcatDataset", "Subset", "random_split",
     "Sampler", "SequenceSampler", "RandomSampler", "SubsetRandomSampler",
     "WeightedRandomSampler", "BatchSampler", "DistributedBatchSampler",
     "DataLoader", "default_collate_fn", "default_convert_fn",
 ]
+
+
+class WorkerInfo:
+    """ref: io/dataloader/worker.py WorkerInfo."""
+
+    def __init__(self, id, num_workers, dataset=None, seed=0):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker returns its WorkerInfo, else None
+    (ref: io/dataloader/worker.py get_worker_info). The shm-ring
+    process workers set this before running the worker loop."""
+    return _worker_info

@@ -155,7 +155,7 @@ class RingBuffer:
 
 
 def _worker_main(ring_name, dataset, my_batches, worker_id,
-                 collate_fn, worker_init_fn):
+                 collate_fn, worker_init_fn, num_workers=1):
     """Worker process: produces its stride-slice of batches IN ORDER on
     its own ring — the parent pops ring (seq % N) so sampler order is
     preserved with no reordering buffer, and each ring's capacity
@@ -165,6 +165,10 @@ def _worker_main(ring_name, dataset, my_batches, worker_id,
 
     ring = RingBuffer(ring_name, create=False)
     try:
+        # publish WorkerInfo so datasets can shard via get_worker_info()
+        from .. import io as _io_mod
+
+        _io_mod._worker_info = _io_mod.WorkerInfo(worker_id, num_workers, dataset)
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
         for indices in my_batches:
@@ -206,7 +210,8 @@ class ProcessPrefetchIter:
                 target=_worker_main,
                 args=(self._rings[w].name, loader.dataset,
                       batch_indices[w::self._live], w,
-                      loader.collate_fn, loader.worker_init_fn),
+                      loader.collate_fn, loader.worker_init_fn,
+                      self._live),
                 daemon=True,
             )
             for w in range(self._live)

@@ -19,6 +19,7 @@ from .layer.loss import *  # noqa: F401,F403
 from .layer.norm import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
+from .layer.extension import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 
 from . import utils  # noqa: F401

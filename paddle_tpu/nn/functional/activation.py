@@ -19,6 +19,7 @@ __all__ = [
     "maxout", "mish", "prelu", "relu", "relu6", "relu_", "rrelu", "selu",
     "sigmoid", "silu", "softmax", "softmax_", "softplus", "softshrink",
     "softsign", "swish", "tanh", "tanh_", "tanhshrink", "thresholded_relu",
+    "elu_", "hardtanh_", "leaky_relu_", "thresholded_relu_",
 ]
 
 
@@ -221,3 +222,21 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
         return y
 
     return apply(_f, x, op_name="gumbel_softmax")
+
+
+# in-place activation variants (functional rebinding, ref: the
+# `@inplace_apis_in_dygraph_only` activations in nn/functional/activation.py)
+def elu_(x, alpha=1.0, name=None):
+    return x._inplace_from(elu(x, alpha))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return x._inplace_from(hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    return x._inplace_from(leaky_relu(x, negative_slope))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    return x._inplace_from(thresholded_relu(x, threshold, value))

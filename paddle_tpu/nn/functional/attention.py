@@ -20,7 +20,7 @@ import numpy as np
 from ...base.tape import apply
 from ...base.tensor import Tensor
 
-__all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_kernel", "flash_attn_qkvpacked"]
+__all__ = ["scaled_dot_product_attention", "flash_attention", "sdp_kernel", "flash_attn_qkvpacked", "flash_attention_with_sparse_mask", "flash_attn_varlen_qkvpacked"]
 
 
 def _naive_attention(q, k, v, mask, dropout_p, causal, scale, key):
@@ -131,3 +131,61 @@ class sdp_kernel:
 
     def __exit__(self, *exc):
         return False
+
+
+def flash_attention_with_sparse_mask(query, key, value, attn_mask_start_row_indices=None,
+                                     attn_mask_start_row=0, dropout_p=0.0,
+                                     is_causal=True, training=True, name=None):
+    """ref: flash_attention.py flash_attention_with_sparse_mask — causal
+    attention where row i additionally masks keys before
+    start_row_indices[i]. Lowered to SDPA with the composed mask."""
+    if attn_mask_start_row_indices is None:
+        return scaled_dot_product_attention(query, key, value, None, dropout_p, is_causal, training)
+
+    def _f(q, k, v, start_rows):
+        b, s, h, d = q.shape
+        r = jnp.arange(s)
+        causal = r[None, :] <= r[:, None]
+        # start_rows: [B, H, S] or [B, S]; key j masked for rows >= start_rows[j]
+        sr = start_rows if start_rows.ndim == 3 else start_rows[:, None, :]
+        # row i attends key j iff j <= i AND i < start_rows[..., j]
+        mask = causal[None, None] & (r[None, None, :, None] < sr[:, :, None, :])
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(d)
+        logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+        probs = jax.nn.softmax(logits, -1).astype(q.dtype)
+        return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", probs, vh), 1, 2)
+
+    return apply(_f, query, key, value, attn_mask_start_row_indices, op_name="flash_attention_with_sparse_mask")
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k,
+                                scale=None, dropout=0.0, causal=False, return_softmax=False,
+                                fixed_seed_offset=None, rng_name="", varlen_padded=True,
+                                training=True, name=None):
+    """ref: flash_attention.py flash_attn_varlen_qkvpacked — packed
+    variable-length batches. Segment ids from cu_seqlens mask
+    cross-sequence attention; one SDPA over the packed [total, ...]."""
+
+    def _f(packed, cu_q):
+        # packed: [total, 3, H, D] (varlen_padded packs all seqs)
+        total = packed.shape[0]
+        q = packed[:, 0]
+        k = packed[:, 1]
+        v = packed[:, 2]
+        pos = jnp.arange(total)
+        seg = jnp.searchsorted(cu_q, pos, side="right")  # segment id per token
+        same = seg[:, None] == seg[None, :]
+        if causal:
+            same = same & (pos[None, :] <= pos[:, None])
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / np.sqrt(d)
+        logits = jnp.einsum("qhd,khd->hqk", q, k) * s
+        logits = jnp.where(same[None], logits.astype(jnp.float32), -1e30)
+        probs = jax.nn.softmax(logits, -1).astype(q.dtype)
+        return jnp.einsum("hqk,khd->qhd", probs, v)
+
+    out = apply(_f, qkv, cu_seqlens_q, op_name="flash_attn_varlen_qkvpacked")
+    return (out, None) if return_softmax else out

@@ -21,6 +21,9 @@ __all__ = [
     "embedding", "one_hot", "interpolate", "upsample", "cosine_similarity",
     "normalize", "unfold", "fold", "pixel_shuffle", "pixel_unshuffle",
     "channel_shuffle", "label_smooth", "bilinear", "class_center_sample",
+    "pairwise_distance", "sequence_mask", "zeropad2d", "feature_alpha_dropout",
+    "temporal_shift", "affine_grid", "grid_sample", "gather_tree",
+    "sparse_attention",
 ]
 
 
@@ -407,3 +410,227 @@ def class_center_sample(label, num_classes, num_samples, group=None):
         "class_center_sample is a PartialFC training op; use full-class "
         "margin softmax on TPU (MXU-friendly) instead."
     )
+
+
+# -- parity sweep (ref: nn/functional/ common/extension/vision entries) ------
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """ref: nn/functional/distance.py pairwise_distance."""
+
+    def _f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return apply(_f, x, y, op_name="pairwise_distance")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """ref: nn/functional/extension.py sequence_mask — mask[i, j] =
+    j < x[i]."""
+    from ...base.dtype import canonical_dtype
+
+    if maxlen is None:
+        import jax as _jax
+
+        maxlen = int(np.asarray(_jax.device_get(x._data if isinstance(x, Tensor) else x)).max())
+
+    def _f(lens):
+        r = jnp.arange(maxlen, dtype=jnp.int32)
+        return (r < lens[..., None].astype(jnp.int32)).astype(canonical_dtype(dtype))
+
+    return apply(_f, x, op_name="sequence_mask")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """ref: common.py zeropad2d."""
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Channel-wise alpha dropout (ref: common.py feature_alpha_dropout):
+    whole channels are dropped to the SELU negative saturation value."""
+    if not training or p == 0:
+        return x if isinstance(x, Tensor) else Tensor(x, _internal=True)
+    from ...base import random as _random
+
+    key = _random.next_key()
+    alpha_p = -1.7580993408473766
+
+    def _f(a):
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        ap = jnp.asarray(alpha_p, jnp.float32)
+        kept = jnp.where(keep, a.astype(jnp.float32), ap)
+        # affine correction keeps zero mean / unit variance (the SELU
+        # self-normalizing contract): out = coef_a * masked + coef_b
+        coef_a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return (kept * coef_a + coef_b).astype(a.dtype)
+
+    return apply(_f, x, op_name="feature_alpha_dropout")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """ref: nn/functional/extension.py temporal_shift (TSM): shift a
+    slice of channels one step along time within each segment."""
+
+    def _f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], 1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], 1)
+        out = jnp.concatenate([back, fwd, v[:, :, c2:]], 2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply(_f, x, op_name="temporal_shift")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """ref: nn/functional/vision.py affine_grid — 2D only ([N,2,3])."""
+    n, c, h, w = [int(s) for s in out_shape]
+
+    def _lin(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    def _f(th):
+        ys = _lin(h)
+        xs = _lin(w)
+        gx, gy = jnp.meshgrid(xs, ys)  # [h, w]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], -1).reshape(-1, 3)  # [h*w, 3]
+        out = jnp.einsum("nij,pj->npi", th, base)  # [n, h*w, 2]
+        return out.reshape(n, h, w, 2)
+
+    return apply(_f, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """ref: nn/functional/vision.py grid_sample — NCHW, 2D bilinear /
+    nearest with zeros/border/reflection padding."""
+
+    def _unnorm(coord, size):
+        if align_corners:
+            return (coord + 1.0) * (size - 1) / 2.0
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    def _f(a, g):
+        n, c, h, w = a.shape
+        gx = _unnorm(g[..., 0], w)  # [n, gh, gw]
+        gy = _unnorm(g[..., 1], h)
+
+        def sample(ix, iy):
+            # gather with padding handling; ix/iy int32 [n, gh, gw]
+            inb = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+            cx = jnp.clip(ix, 0, w - 1)
+            cy = jnp.clip(iy, 0, h - 1)
+            bidx = jnp.arange(n)[:, None, None]
+            vals = a[bidx, :, cy, cx]  # [n, gh, gw, c]
+            if padding_mode == "zeros":
+                vals = jnp.where(inb[..., None], vals, 0.0)
+            return vals
+
+        def reflect(coord, size):
+            if align_corners:
+                span = 2 * (size - 1)
+                m = jnp.mod(jnp.abs(coord), span)
+                return jnp.where(m > size - 1, span - m, m)
+            span = 2 * size
+            m = jnp.mod(jnp.abs(coord + 0.5), span)
+            return jnp.clip(jnp.where(m > size - 0.5, span - m, m) - 0.5, 0, size - 1)
+
+        if padding_mode == "reflection":
+            gx = reflect(gx, w)
+            gy = reflect(gy, h)
+        elif padding_mode == "border":
+            gx = jnp.clip(gx, 0, w - 1)
+            gy = jnp.clip(gy, 0, h - 1)
+
+        if mode == "nearest":
+            out = sample(jnp.round(gx).astype(jnp.int32), jnp.round(gy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(gx).astype(jnp.int32)
+            y0 = jnp.floor(gy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = gx - x0
+            wy = gy - y0
+            v00 = sample(x0, y0)
+            v01 = sample(x1, y0)
+            v10 = sample(x0, y1)
+            v11 = sample(x1, y1)
+            out = (
+                v00 * ((1 - wx) * (1 - wy))[..., None]
+                + v01 * (wx * (1 - wy))[..., None]
+                + v10 * ((1 - wx) * wy)[..., None]
+                + v11 * (wx * wy)[..., None]
+            )
+        return jnp.transpose(out, (0, 3, 1, 2))  # -> NCHW
+
+    return apply(_f, x, grid, op_name="grid_sample")
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (ref: nn/functional/extension.py
+    gather_tree): walk parent pointers from the last step back,
+    re-gathering each step's ids. ids/parents: [T, B, beam]."""
+
+    def _f(seq, par):
+        T = seq.shape[0]
+
+        def step(beams, t):
+            # beams: current beam index per [B, beam]
+            idx = jnp.take_along_axis(seq[t], beams, axis=-1)
+            nxt = jnp.take_along_axis(par[t], beams, axis=-1)
+            return nxt, idx
+
+        init = jnp.broadcast_to(
+            jnp.arange(seq.shape[2], dtype=seq.dtype), seq.shape[1:]
+        )
+        _, out_rev = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(out_rev, 0)
+
+    return apply(_f, ids, parents, op_name="gather_tree")
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention (ref: nn/functional/sparse_attention.py,
+    CUDA-only there). TPU path: materialize the CSR sparsity as a mask
+    over an SDPA call — XLA's fused attention handles the rest; for
+    genuinely long sequences use ops.ring_attention or flash attention
+    with block masking instead."""
+    import jax as _jax
+
+    offs = np.asarray(_jax.device_get(sparse_csr_offset._data if isinstance(sparse_csr_offset, Tensor) else sparse_csr_offset))
+    cols = np.asarray(_jax.device_get(sparse_csr_columns._data if isinstance(sparse_csr_columns, Tensor) else sparse_csr_columns))
+
+    def _f(q, k, v):
+        b, h, s, d = q.shape
+        # offsets/columns are per (batch, head): [B, H, S+1] / [B, H, nnz]
+        o = np.broadcast_to(offs, (b, h) + offs.shape[-1:]) if offs.ndim < 3 else offs
+        cc = np.broadcast_to(cols, (b, h) + cols.shape[-1:]) if cols.ndim < 3 else cols
+        mask = np.zeros((b, h, s, s), bool)
+        for bi in range(b):
+            for hi in range(h):
+                ro = o[bi, hi]
+                cl = cc[bi, hi]
+                for r in range(s):
+                    mask[bi, hi, r, cl[ro[r]:ro[r + 1]]] = True
+        m = jnp.asarray(mask)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        logits = jnp.where(m, logits, -1e30)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+    return apply(_f, query, key, value, op_name="sparse_attention")

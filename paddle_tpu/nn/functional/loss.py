@@ -22,6 +22,9 @@ __all__ = [
     "hinge_embedding_loss", "triplet_margin_loss", "log_loss", "square_error_cost",
     "sigmoid_focal_loss", "softmax_with_cross_entropy", "poisson_nll_loss",
     "multi_label_soft_margin_loss", "soft_margin_loss",
+    "ctc_loss", "rnnt_loss", "dice_loss", "npair_loss", "multi_margin_loss",
+    "gaussian_nll_loss", "triplet_margin_with_distance_loss", "hsigmoid_loss",
+    "margin_cross_entropy", "adaptive_log_softmax_with_loss",
 ]
 
 
@@ -286,3 +289,345 @@ def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", na
 
     args = (input, label) + ((weight,) if weight is not None else ())
     return apply(_f, *args, op_name="multi_label_soft_margin_loss")
+
+
+# -- parity sweep (ref: nn/functional/loss.py remaining entries) ------------
+
+
+def _reduce_t(v, reduction):
+    if reduction == "mean":
+        return v.mean()
+    if reduction == "sum":
+        return v.sum()
+    return v
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC loss (ref: loss.py ctc_loss binding warpctc).
+
+    TPU-native: the forward-alpha recursion runs as one lax.scan over
+    time on the padded [B, 2*L+1] extended-label lattice — no host loop,
+    batch-vectorized, works under jit. log_probs: [T, B, C] log-softmaxed
+    (the reference applies log_softmax inside; we do too for parity)."""
+
+    def _f(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        NEG = -1e30
+        # extended labels: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        s_len = 2 * lab_len.astype(jnp.int32) + 1
+        # can skip from s-2 to s when ext[s] != blank and ext[s] != ext[s-2]
+        ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], 1)
+        can_skip = (ext != blank) & (ext != ext_m2)
+
+        def emit(t):
+            return jnp.take_along_axis(lp[t], ext, axis=-1)  # [B, S]
+
+        alpha0 = jnp.full((B, S), NEG)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.where(lab_len > 0, lab[:, 0].astype(jnp.int32), blank)
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, lp[0, jnp.arange(B), first_lab], NEG)
+        )
+
+        def step(alpha, t):
+            stay = alpha
+            prev1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], 1)
+            prev2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], 1)
+            prev2 = jnp.where(can_skip, prev2, NEG)
+            merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+            new = merged + emit(t)
+            # sequences already past their length keep old alpha
+            alive = (t < in_len)[:, None]
+            return jnp.where(alive, new, alpha), None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        idx_last = jnp.clip(s_len - 1, 0, S - 1)
+        idx_prev = jnp.clip(s_len - 2, 0, S - 1)
+        ar = jnp.arange(B)
+        ll = jnp.logaddexp(alpha[ar, idx_last], alpha[ar, idx_prev])
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1)
+        return loss
+
+    out = apply(_f, log_probs, labels, input_lengths, label_lengths, op_name="ctc_loss")
+    return _reduce_t(out, reduction)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,  # noqa: A002
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss (ref: loss.py rnnt_loss binding warprnnt).
+
+    Forward variable over the [T, U+1] grid, computed as one lax.scan
+    over T with a cumulative inner recursion over U (vectorized with an
+    associative scan via logaddexp cumulation). input: [B, T, U+1, C]
+    raw logits (log_softmax applied here, as the reference does)."""
+
+    def _f(acts, lab, t_len, u_len):
+        lp = jax.nn.log_softmax(acts.astype(jnp.float32), axis=-1)
+        B, T, U1, C = lp.shape
+        U = U1 - 1
+        NEG = -1e30
+        ar = jnp.arange(B)
+        # emit[b,t,u] = lp[b,t,u,label[b,u]] (emit label u+1), null = blank
+        lab_i = lab.astype(jnp.int32)
+        emit = jnp.take_along_axis(
+            lp[:, :, :U, :], lab_i[:, None, :, None], axis=-1
+        )[..., 0]  # [B, T, U]
+        null = lp[..., blank]  # [B, T, U+1]
+
+        def time_step(alpha_prev, t):
+            # alpha_prev: [B, U+1] = alpha[t-1, :]
+            # horizontal (time) move: alpha[t, u] += alpha[t-1, u] + null[t-1, u]
+            from_top = alpha_prev + null[:, t - 1, :]
+            # then vertical (label) moves within row t:
+            # alpha[t, u] = logaddexp(from_top[u], alpha[t, u-1] + emit[t, u-1])
+            def vert(carry, u):
+                cur = jnp.logaddexp(from_top[:, u], carry + emit[:, t, u - 1])
+                return cur, cur
+
+            first = from_top[:, 0]
+            _, rest = jax.lax.scan(vert, first, jnp.arange(1, U1))
+            row = jnp.concatenate([first[:, None], rest.T], axis=1)
+            return row, row
+
+        # row 0: only vertical moves from alpha[0,0]=0
+        def vert0(carry, u):
+            cur = carry + emit[:, 0, u - 1]
+            return cur, cur
+
+        first0 = jnp.zeros((B,))
+        _, rest0 = jax.lax.scan(vert0, first0, jnp.arange(1, U1))
+        alpha0 = jnp.concatenate([first0[:, None], rest0.T], axis=1)
+
+        def scan_t(alpha, t):
+            row = time_step(alpha, t)[0]
+            alive = (t < t_len)[:, None]
+            row = jnp.where(alive, row, alpha)
+            return row, row
+
+        alpha_last, rows = jax.lax.scan(scan_t, alpha0, jnp.arange(1, T))
+        all_rows = jnp.concatenate([alpha0[None], rows], 0)  # [T, B, U+1]
+        # ll = alpha[t_len-1, u_len] + null[t_len-1, u_len]
+        tt = jnp.clip(t_len.astype(jnp.int32) - 1, 0, T - 1)
+        uu = jnp.clip(u_len.astype(jnp.int32), 0, U)
+        ll = all_rows[tt, ar, uu] + null[ar, tt, uu]
+        return -ll
+
+    out = apply(_f, input, label, input_lengths, label_lengths, op_name="rnnt_loss")
+    return _reduce_t(out, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """ref: loss.py dice_loss — 1 - 2|X∩Y| / (|X|+|Y|)."""
+
+    def _f(x, y):
+        y1 = jax.nn.one_hot(y.reshape(y.shape[:-1]), x.shape[-1], dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * y1, axis=red)
+        union = jnp.sum(x, axis=red) + jnp.sum(y1, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return apply(_f, input, label, op_name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """ref: loss.py npair_loss — softmax CE over anchor·positiveᵀ plus
+    L2 on embeddings."""
+
+    def _f(a, p, y):
+        reg = l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / a.shape[0]
+        sim = a @ p.T
+        same = (y[:, None] == y[None, :]).astype(sim.dtype)
+        tgt = same / jnp.maximum(same.sum(-1, keepdims=True), 1)
+        ce = jnp.mean(jnp.sum(-tgt * jax.nn.log_softmax(sim, -1), -1))
+        return ce + reg
+
+    return apply(_f, anchor, positive, labels, op_name="npair_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,  # noqa: A002
+                      reduction="mean", name=None):
+    """ref: loss.py multi_margin_loss."""
+
+    def _f(x, y, *maybe_w):
+        n, c = x.shape
+        correct = x[jnp.arange(n), y]
+        m = jnp.maximum(margin - correct[:, None] + x, 0.0) ** p
+        if maybe_w:
+            m = m * maybe_w[0][y][:, None]
+        m = m.at[jnp.arange(n), y].set(0.0)
+        return m.sum(-1) / c
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return _reduce_t(apply(_f, *args, op_name="multi_margin_loss"), reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,  # noqa: A002
+                      reduction="mean", name=None):
+    """ref: loss.py gaussian_nll_loss."""
+
+    def _f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * np.log(2 * np.pi)
+        return loss
+
+    return _reduce_t(apply(_f, input, label, variance, op_name="gaussian_nll_loss"), reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,  # noqa: A002
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    """ref: loss.py triplet_margin_with_distance_loss."""
+    from ...tensor import linalg as _linalg
+
+    def _dist(a, b):
+        return jnp.sqrt(jnp.sum((a - b) ** 2, axis=-1) + 1e-12)
+
+    if distance_function is not None:
+        # user fn operates on Tensors; run eagerly through the tape
+        d_pos = distance_function(input, positive)
+        d_neg = distance_function(input, negative)
+        if swap:
+            d_pn = distance_function(positive, negative)
+            d_neg = _minimum_t(d_neg, d_pn)
+        loss = _relu_t(d_pos - d_neg + margin)
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    def _f(a, p, n):
+        d_pos = _dist(a, p)
+        d_neg = _dist(a, n)
+        if swap:
+            d_neg = jnp.minimum(d_neg, _dist(p, n))
+        return jnp.maximum(d_pos - d_neg + margin, 0.0)
+
+    return _reduce_t(apply(_f, input, positive, negative, op_name="triplet_margin_with_distance_loss"), reduction)
+
+
+def _minimum_t(a, b):
+    from ...tensor import math as _m
+
+    return _m.minimum(a, b)
+
+
+def _relu_t(x):
+    return apply(lambda a: jnp.maximum(a, 0.0), x, op_name="relu")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss (ref: loss.py hsigmoid_loss). Default
+    complete-binary-tree coding (no custom path): class c's path is the
+    bit decomposition of c + num_classes in the implicit Huffman-style
+    tree the reference builds; depth = ceil(log2(num_classes))."""
+    depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+
+    def _f(x, y, w, *maybe_b):
+        # node index walk: node = y + num_classes (leaf), parents = node//2
+        leaf = y.astype(jnp.int32) + num_classes
+        nodes = []
+        codes = []
+        cur = leaf
+        for _ in range(depth):
+            codes.append(cur % 2)
+            cur = cur // 2
+            nodes.append(cur)
+        nodes = jnp.stack(nodes, -1)  # [N, depth] internal nodes (1-rooted)
+        codes = jnp.stack(codes, -1).astype(x.dtype)
+        # internal node k (1-rooted) owns weight row k-1 (table is
+        # [num_classes-1, D] in the reference)
+        rows = jnp.clip(nodes - 1, 0, w.shape[0] - 1)
+        w_nodes = w[rows]  # [N, depth, D]
+        logits = jnp.einsum("nd,nkd->nk", x, w_nodes)
+        if maybe_b:
+            logits = logits + maybe_b[0][jnp.clip(nodes - 1, 0, maybe_b[0].shape[0] - 1)]
+        # code 1 -> sigmoid(logit), code 0 -> 1 - sigmoid
+        logp = -jax.nn.softplus(-logits) * codes + -jax.nn.softplus(logits) * (1 - codes)
+        return -(logp.sum(-1))
+
+    args = (input, label, weight) + ((bias,) if bias is not None else ())
+    out = apply(_f, *args, op_name="hsigmoid_loss")
+    return out.mean()
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """ArcFace/CosFace-style margin softmax (ref: loss.py
+    margin_cross_entropy; single-group — the model-parallel sharded
+    variant composes with mp via the TP layers)."""
+
+    def _f(z, y):
+        n = z.shape[0]
+        ar = jnp.arange(n)
+        target = z[ar, y]
+        theta = jnp.arccos(jnp.clip(target, -1.0, 1.0))
+        target_m = jnp.cos(margin1 * theta + margin2) - margin3
+        z2 = z.at[ar, y].set(target_m) * scale
+        logp = jax.nn.log_softmax(z2, -1)
+        loss = -logp[ar, y]
+        return (loss, jax.nn.softmax(z2, -1)) if return_softmax else loss
+
+    out = apply(_f, logits, label, op_name="margin_cross_entropy")
+    if return_softmax:
+        loss, sm = out
+        return _reduce_t(loss, reduction), sm
+    return _reduce_t(out, reduction)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,  # noqa: A002
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (ref: loss.py adaptive_log_softmax_with_loss):
+    head covers [0, cutoff0) + one logit per tail cluster; each tail
+    cluster has a two-matrix projection."""
+
+    def _f(x, y, hw, *rest):
+        n_clusters = len(cutoffs)
+        hb = rest[-1] if head_bias is not None else None
+        tails = rest[: 2 * n_clusters]
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_logp = jax.nn.log_softmax(head_logits, -1)
+        shortlist = cutoffs[0]
+        out = jnp.zeros(x.shape[0], x.dtype)
+        in_short = y < shortlist
+        safe_y = jnp.where(in_short, y, 0)
+        out = jnp.where(in_short, head_logp[jnp.arange(x.shape[0]), safe_y], out)
+        low = shortlist
+        for i in range(n_clusters):
+            high = cutoffs[i + 1] if i + 1 < len(cutoffs) else None
+            hi = high if high is not None else 10 ** 9
+            mask = (y >= low) & (y < hi)
+            proj, cls_w = tails[2 * i], tails[2 * i + 1]
+            tail_logp = jax.nn.log_softmax((x @ proj) @ cls_w, -1)
+            cluster_logp = head_logp[:, shortlist + i]
+            rel = jnp.clip(y - low, 0, cls_w.shape[1] - 1)
+            val = cluster_logp + tail_logp[jnp.arange(x.shape[0]), rel]
+            out = jnp.where(mask, val, out)
+            low = hi
+        return out, -out.mean()
+
+    flat_tails = []
+    for tw in tail_weights:
+        if isinstance(tw, (list, tuple)):
+            flat_tails.extend(tw)  # [projection, cluster_weight] pairs
+        else:
+            flat_tails.append(tw)
+    args = [input, label, head_weight] + flat_tails
+    if head_bias is not None:
+        args.append(head_bias)
+    out, loss = apply(_f, *args, op_name="adaptive_log_softmax_with_loss")
+    return out, loss

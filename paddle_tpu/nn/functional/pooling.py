@@ -19,6 +19,7 @@ __all__ = [
     "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
     "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
     "lp_pool1d", "lp_pool2d", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "fractional_max_pool2d", "fractional_max_pool3d",
 ]
 
 
@@ -251,3 +252,116 @@ def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="N
 
 def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW", output_size=None, name=None):
     raise NotImplementedError("max_unpool3d not yet provided")
+
+
+def _fractional_bounds(in_size, out_size, u):
+    """Fractional pooling boundaries (Graham 2014, the reference's
+    fractional_max_pool formulation): row i spans
+    [ceil(a*(i+u))-1, ceil(a*(i+1+u))-1) with a = in/out."""
+    a = in_size / out_size
+    idx = np.arange(out_size + 1)
+    b = np.ceil(a * (idx + u)).astype(np.int64) - 1
+    b[0] = 0
+    b[-1] = in_size
+    return b
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """ref: nn/functional/pooling.py fractional_max_pool2d."""
+    import random as _pyrandom
+
+    u = random_u if random_u is not None else _pyrandom.random()
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else tuple(output_size)
+
+    def _f(a):
+        n, c, h, w = a.shape
+        rb = _fractional_bounds(h, oh, u)
+        cb = _fractional_bounds(w, ow, u)
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                patch = a[:, :, rb[i]:rb[i + 1], cb[j]:cb[j + 1]]
+                cols.append(patch.max(axis=(2, 3)))
+            rows.append(jnp.stack(cols, -1))
+        return jnp.stack(rows, -2)
+
+    out = apply(_f, x, op_name="fractional_max_pool2d")
+    if return_mask:
+        # mask = flat input index of each max (recomputed on request)
+        def _m(a):
+            n, c, h, w = a.shape
+            rb = _fractional_bounds(h, oh, u)
+            cb = _fractional_bounds(w, ow, u)
+            rows = []
+            for i in range(oh):
+                cols = []
+                for j in range(ow):
+                    patch = a[:, :, rb[i]:rb[i + 1], cb[j]:cb[j + 1]]
+                    ph = patch.shape[2]
+                    pw = patch.shape[3]
+                    flat = patch.reshape(n, c, ph * pw)
+                    k = flat.argmax(-1)
+                    cols.append((rb[i] + k // pw) * w + (cb[j] + k % pw))
+                rows.append(jnp.stack(cols, -1))
+            return jnp.stack(rows, -2)
+
+        return out, apply(_m, x, op_name="fractional_max_pool2d_mask")
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """ref: pooling.py fractional_max_pool3d."""
+    import random as _pyrandom
+
+    u = random_u if random_u is not None else _pyrandom.random()
+    if isinstance(output_size, int):
+        od = oh = ow = output_size
+    else:
+        od, oh, ow = tuple(output_size)
+
+    def _f(a):
+        n, c, d, h, w = a.shape
+        db = _fractional_bounds(d, od, u)
+        rb = _fractional_bounds(h, oh, u)
+        cb = _fractional_bounds(w, ow, u)
+        planes = []
+        for z in range(od):
+            rows = []
+            for i in range(oh):
+                cols = []
+                for j in range(ow):
+                    patch = a[:, :, db[z]:db[z + 1], rb[i]:rb[i + 1], cb[j]:cb[j + 1]]
+                    cols.append(patch.max(axis=(2, 3, 4)))
+                rows.append(jnp.stack(cols, -1))
+            planes.append(jnp.stack(rows, -2))
+        return jnp.stack(planes, -3)
+
+    out = apply(_f, x, op_name="fractional_max_pool3d")
+    if return_mask:
+        def _m(a):
+            n, c, d, h, w = a.shape
+            db = _fractional_bounds(d, od, u)
+            rb = _fractional_bounds(h, oh, u)
+            cb = _fractional_bounds(w, ow, u)
+            planes = []
+            for z in range(od):
+                rows = []
+                for i in range(oh):
+                    cols = []
+                    for j in range(ow):
+                        patch = a[:, :, db[z]:db[z + 1], rb[i]:rb[i + 1], cb[j]:cb[j + 1]]
+                        pd, ph, pw = patch.shape[2], patch.shape[3], patch.shape[4]
+                        k = patch.reshape(n, c, pd * ph * pw).argmax(-1)
+                        zz = db[z] + k // (ph * pw)
+                        yy = rb[i] + (k // pw) % ph
+                        xx = cb[j] + k % pw
+                        cols.append((zz * h + yy) * w + xx)
+                    rows.append(jnp.stack(cols, -1))
+                planes.append(jnp.stack(rows, -2))
+            return jnp.stack(planes, -3)
+
+        return out, apply(_m, x, op_name="fractional_max_pool3d_mask")
+    return out

@@ -37,7 +37,7 @@ __all__ = [
     "Dirac",
     "calculate_gain",
     "set_global_initializer",
-]
+ "Bilinear",]
 
 
 def _compute_fans(shape):
@@ -235,3 +235,28 @@ def _default_weight_init():
 
 def _default_bias_init():
     return _global_bias_init if _global_bias_init is not None else Constant(0.0)
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel for transposed-conv upsampling
+    (ref: python/paddle/nn/initializer/Bilinear). Weight shape
+    [C_out, C_in, k, k]; each spatial slice gets the classic bilinear
+    tent filter."""
+
+    def __init__(self, name=None):
+        pass
+
+    def _generate(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        k = shape[3]
+        if shape[2] != k:
+            raise ValueError("Bilinear initializer needs square kernels")
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        grid = np.arange(k)
+        tent = (1 - np.abs(grid / f - c))
+        filt = np.outer(tent, tent).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        w[:, :, :, :] = filt
+        return jnp.asarray(w, dtype)

@@ -1,0 +1,353 @@
+"""Parity-sweep layers wrapping the extended functionals.
+
+ref: python/paddle/nn/layer/{common,loss,pooling,distance}.py entries
+and python/paddle/nn/decode.py (BeamSearchDecoder / dynamic_decode).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base.tensor import Tensor
+from .. import functional as F
+from .layers import Layer
+
+__all__ = [
+    "Unflatten", "Softmax2D", "ZeroPad1D", "ZeroPad3D", "FeatureAlphaDropout",
+    "CTCLoss", "RNNTLoss", "HSigmoidLoss", "MultiMarginLoss",
+    "TripletMarginWithDistanceLoss", "GaussianNLLLoss",
+    "AdaptiveLogSoftmaxWithLoss", "MaxUnPool1D", "MaxUnPool3D",
+    "FractionalMaxPool2D", "FractionalMaxPool3D",
+    "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+class Unflatten(Layer):
+    """ref: nn/layer/common.py Unflatten."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        return T.unflatten(x, self.axis, self.shape)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW (ref: activation.py Softmax2D)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError("Softmax2D expects 3-D or 4-D input")
+        return F.softmax(x, axis=-3)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding, self.data_format = padding, data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0, data_format=self.data_format)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding, self.data_format = padding, data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode="constant", value=0.0, data_format=self.data_format)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)
+
+
+class CTCLoss(Layer):
+    """ref: nn/layer/loss.py CTCLoss."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths, norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction, norm_by_times)
+
+
+class RNNTLoss(Layer):
+    """ref: nn/layer/loss.py RNNTLoss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean", name=None):
+        super().__init__()
+        self.blank, self.fastemit_lambda, self.reduction = blank, fastemit_lambda, reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):  # noqa: A002
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """ref: nn/layer/loss.py HSigmoidLoss — holds the [num_classes-1, D]
+    internal-node table."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None, bias_attr=None,
+                 is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter([num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter([num_classes - 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight, self.bias,
+                               path_table, path_code)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.p, self.margin, self.weight, self.reduction = p, margin, weight, reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.multi_margin_loss(input, label, self.p, self.margin, self.weight, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False, reduction="mean", name=None):
+        super().__init__()
+        self.distance_function, self.margin = distance_function, margin
+        self.swap, self.reduction = swap, reduction
+
+    def forward(self, input, positive, negative):  # noqa: A002
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction,
+        )
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):  # noqa: A002
+        return F.gaussian_nll_loss(input, label, variance, self.full, self.epsilon, self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """ref: nn/layer/loss.py AdaptiveLogSoftmaxWithLoss — head table +
+    factorized tail projections per cluster."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if any(c <= 0 or c >= n_classes for c in cutoffs) or sorted(set(cutoffs)) != cutoffs:
+            raise ValueError("cutoffs must be increasing, in (0, n_classes)")
+        self.cutoffs = cutoffs + [n_classes]
+        self.n_clusters = len(cutoffs)
+        head_size = cutoffs[0] + self.n_clusters
+        self.head_weight = self.create_parameter([in_features, head_size])
+        self.head_bias_p = self.create_parameter([head_size], is_bias=True) if head_bias else None
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = self.create_parameter([in_features, hsz])
+            cls_w = self.create_parameter([hsz, osz])
+            self.add_parameter(f"tail_proj_{i}", proj)
+            self.add_parameter(f"tail_cls_{i}", cls_w)
+            self.tail_weights.append([proj, cls_w])
+
+    def forward(self, input, label):  # noqa: A002
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs[:-1], self.head_bias_p,
+        )
+
+    def log_prob(self, input):  # noqa: A002
+        import jax.numpy as jnp
+
+        from ...base.tape import apply
+
+        def _f(x, hw, *rest):
+            hb = rest[-1] if self.head_bias_p is not None else None
+            tails = rest[: 2 * self.n_clusters]
+            head_logits = x @ hw
+            if hb is not None:
+                head_logits = head_logits + hb
+            import jax
+
+            head_logp = jax.nn.log_softmax(head_logits, -1)
+            short = self.cutoffs[0]
+            outs = [head_logp[:, :short]]
+            for i in range(self.n_clusters):
+                tail_logp = jax.nn.log_softmax((x @ tails[2 * i]) @ tails[2 * i + 1], -1)
+                outs.append(head_logp[:, short + i:short + i + 1] + tail_logp)
+            return jnp.concatenate(outs, -1)
+
+        args = [input, self.head_weight] + [w for pair in self.tail_weights for w in pair]
+        if self.head_bias_p is not None:
+            args.append(self.head_bias_p)
+        return apply(_f, *args, op_name="adaptive_log_softmax")
+
+    def predict(self, input):  # noqa: A002
+        return self.log_prob(input).argmax(-1)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format, self.output_size = data_format, output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format, self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCDHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format, self.output_size = data_format, output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format, self.output_size)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size, self.kernel_size = output_size, kernel_size
+        self.random_u, self.return_mask = random_u, return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size, self.kernel_size = output_size, kernel_size
+        self.random_u, self.return_mask = random_u, return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)
+
+
+# ---------------------------------------------------------------------------
+# decoding (ref: python/paddle/nn/decode.py)
+# ---------------------------------------------------------------------------
+
+
+class BeamSearchDecoder:
+    """Beam-search decoder over an RNN cell (ref: decode.py
+    BeamSearchDecoder). Works with the greedy/eager dynamic_decode loop
+    below — each step expands beam_size hypotheses with length-normalized
+    log-prob scores."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token, self.end_token = start_token, end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states, batch_size):
+        import numpy as _np
+
+        from ...base.tensor import to_tensor
+
+        ids = to_tensor(_np.full((batch_size, self.beam_size), self.start_token, _np.int64))
+        scores = _np.full((batch_size, self.beam_size), -1e9, _np.float32)
+        scores[:, 0] = 0.0
+        return ids, to_tensor(scores), initial_cell_states
+
+    def step(self, inputs, states):
+        """One cell step + projection; returns log-probs over vocab."""
+        cell_out, new_states = self.cell(inputs, states)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logp = F.log_softmax(cell_out, axis=-1)
+        return logp, new_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, batch_size=None,
+                   is_test=False, return_length=False, **kwargs):
+    """Run a BeamSearchDecoder to completion (ref: decode.py
+    dynamic_decode). Host-driven loop (decode length is data-dependent);
+    each step's compute is compiled. Returns (ids, scores) like the
+    reference ([B, T, beam] ids)."""
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from ...base.tensor import to_tensor
+
+    if batch_size is None:
+        raise ValueError("dynamic_decode needs batch_size")
+    B, K = batch_size, decoder.beam_size
+    ids, scores, states = decoder.initialize(inits, B)
+    # flatten beams into the batch dim for the cell
+    collected = []
+    fin = _np.zeros((B, K), bool)
+    scores_np = _np.asarray(scores.numpy(), _np.float32)
+    cur_tok = _np.asarray(ids.numpy())
+    for step in range(max_step_num):
+        if decoder.embedding_fn is not None:
+            inp = decoder.embedding_fn(to_tensor(cur_tok.reshape(B * K)))
+        else:
+            inp = to_tensor(cur_tok.reshape(B * K).astype(_np.int64))
+        logp, states = decoder.step(inp, states)
+        lp = _np.asarray(logp.numpy(), _np.float32).reshape(B, K, -1)
+        V = lp.shape[-1]
+        # finished beams only extend with end_token at score 0
+        lp_masked = lp.copy()
+        lp_masked[fin] = -1e9
+        lp_masked[fin, decoder.end_token] = 0.0
+        total = scores_np[:, :, None] + lp_masked  # [B, K, V]
+        flat = total.reshape(B, K * V)
+        top = _np.argsort(-flat, axis=1)[:, :K]
+        beam_idx = top // V
+        tok = top % V
+        scores_np = _np.take_along_axis(flat, top, 1)
+        fin = _np.take_along_axis(fin, beam_idx, 1) | (tok == decoder.end_token)
+        collected.append(tok)
+        cur_tok = tok
+        # reorder cell states along the beam dim
+        states = _reorder_states(states, beam_idx, B, K)
+        if fin.all():
+            break
+    out_ids = _np.stack(collected, 1)  # [B, T, K]
+    return to_tensor(out_ids.astype(_np.int64)), to_tensor(scores_np)
+
+
+def _reorder_states(states, beam_idx, B, K):
+    import numpy as _np
+
+    from ...base.tensor import to_tensor
+
+    def reorder(t):
+        arr = _np.asarray(t.numpy())
+        arr = arr.reshape(B, K, -1)
+        g = _np.take_along_axis(arr, beam_idx[:, :, None], 1)
+        return to_tensor(g.reshape(B * K, -1).astype(arr.dtype))
+
+    if isinstance(states, (tuple, list)):
+        return type(states)(reorder(s) for s in states)
+    return reorder(states)

@@ -1,5 +1,6 @@
 """paddle_tpu.optimizer (ref: python/paddle/optimizer/__init__.py)."""
 from . import lr  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizer import (  # noqa: F401
     ASGD,
     Adadelta,

@@ -26,6 +26,13 @@ __all__ = [
     "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
     "sparse_csr_tensor", "is_same_shape", "matmul", "add", "multiply",
     "relu", "abs", "sin", "tanh", "coalesce",
+    # value-map unaries (zero-preserving)
+    "tan", "asin", "atan", "sinh", "asinh", "atanh", "sqrt", "square",
+    "log1p", "pow", "neg", "deg2rad", "rad2deg", "expm1", "cast", "isnan",
+    # binary / matmul family
+    "subtract", "divide", "mv", "addmm", "masked_matmul", "mask_as",
+    # structure ops
+    "transpose", "reshape", "sum", "slice", "pca_lowrank",
 ]
 
 
@@ -248,3 +255,175 @@ tanh = _unary(jnp.tanh)
 
 def coalesce(x, name=None):
     return x.coalesce()
+
+
+# ---------------------------------------------------------------------------
+# parity sweep (ref: python/paddle/sparse/__init__.py full op list)
+# ---------------------------------------------------------------------------
+
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+log1p = _unary(jnp.log1p)
+neg = _unary(jnp.negative)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+expm1 = _unary(jnp.expm1)
+isnan = _unary(jnp.isnan)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    """Zero-preserving for factor > 0 (ref sparse/unary.py pow)."""
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """ref sparse/unary.py cast — changes value (and index) dtypes."""
+    from ..base.dtype import canonical_dtype
+
+    vd = canonical_dtype(value_dtype) if value_dtype is not None else None
+    idt = jnp.int64 if index_dtype in ("int64",) else (jnp.int32 if index_dtype else None)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(
+            x.crows_arr.astype(idt) if idt else x.crows_arr,
+            x.cols_arr.astype(idt) if idt else x.cols_arr,
+            x.values_arr.astype(vd) if vd else x.values_arr,
+            x._shape,
+        )
+    b, _ = _coo(x)
+    idx = b.indices.astype(idt) if idt else b.indices
+    vals = b.data.astype(vd) if vd else b.data
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=b.shape))
+
+
+def subtract(x, y, name=None):
+    bx, kind = _coo(x)
+    by, _ = _coo(y)
+    dense = bx.todense() - by.todense()
+    out = jsparse.BCOO.fromdense(dense, nse=int(bx.nse) + int(by.nse))
+    return _rewrap_dense_aware(out, kind, dense)
+
+
+def divide(x, y, name=None):
+    """Dense-semantics divide (0/0 -> nan), matching the reference."""
+    bx, kind = _coo(x)
+    by, _ = _coo(y)
+    dense = bx.todense() / by.todense()
+    out = jsparse.BCOO.fromdense(dense, nse=int(bx.nse) + int(by.nse))
+    return _rewrap_dense_aware(out, kind, dense)
+
+
+def mv(x, vec, name=None):
+    """sparse [M,N] @ dense [N] -> dense [M] (ref sparse/matmul.py mv)."""
+    b, _ = _coo(x)
+    return Tensor(b @ _unwrap(vec), _internal=True)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y) (ref sparse/matmul.py addmm)."""
+    b, _ = _coo(x)
+    yd = _unwrap(y)
+    return Tensor(beta * _unwrap(input) + alpha * (b @ yd), _internal=True)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Dense@dense evaluated only at mask's sparsity (ref matmul.py
+    masked_matmul; the cuSPARSE SDDMM analogue). Computes per-nonzero
+    row·col dot products — never materializes the dense product."""
+    xd, yd = _unwrap(x), _unwrap(y)
+    if isinstance(mask, SparseCsrTensor):
+        b = mask._to_bcoo()
+        rows, cols = b.indices[:, 0], b.indices[:, 1]
+        vals = jnp.einsum("nk,nk->n", xd[rows, :], yd[:, cols].T)
+        dense = jnp.zeros(mask.shape, vals.dtype).at[rows, cols].set(vals)
+        return _dense_to_csr(dense)
+    b, _ = _coo(mask)
+    rows, cols = b.indices[:, 0], b.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xd[rows, :], yd[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=tuple(mask.shape)))
+
+
+def mask_as(x, mask, name=None):
+    """Take dense x's values at mask's nonzero positions (ref
+    sparse/unary.py mask_as)."""
+    xd = _unwrap(x)
+    if isinstance(mask, SparseCsrTensor):
+        b = mask._to_bcoo()
+        dense = jnp.zeros(mask.shape, xd.dtype).at[b.indices[:, 0], b.indices[:, 1]].set(
+            xd[b.indices[:, 0], b.indices[:, 1]]
+        )
+        return _dense_to_csr(dense)
+    b, _ = _coo(mask)
+    idx = tuple(b.indices[:, i] for i in range(b.indices.shape[1]))
+    vals = xd[idx]
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=tuple(mask.shape)))
+
+
+def _via_dense(x, fn, out_shape=None):
+    """Structure-changing op through a dense round-trip (XLA fuses the
+    densify/re-sparsify; nse bound = input nnz)."""
+    b, kind = _coo(x)
+    dense = fn(b.todense())
+    out = jsparse.BCOO.fromdense(dense, nse=int(b.nse))
+    return _rewrap_dense_aware(out, kind, dense)
+
+
+def transpose(x, perm, name=None):
+    return _via_dense(x, lambda d: jnp.transpose(d, perm))
+
+
+def reshape(x, shape, name=None):
+    return _via_dense(x, lambda d: jnp.reshape(d, shape))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """Reduce over axis; returns sparse like the reference."""
+    b, kind = _coo(x)
+    dense = b.todense().sum(axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..base.dtype import canonical_dtype
+
+        dense = dense.astype(canonical_dtype(dtype))
+    if dense.ndim == 0:
+        return Tensor(dense, _internal=True)
+    out = jsparse.BCOO.fromdense(dense, nse=min(int(b.nse), int(np.prod(dense.shape))))
+    return _rewrap_dense_aware(out, kind, dense)
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    import builtins as _b
+
+    def _f(d):
+        idx = [_b.slice(None)] * d.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = _b.slice(st, en)
+        return d[tuple(idx)]
+
+    return _via_dense(x, _f)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (ref sparse/multiary.py pca_lowrank): subspace
+    iteration on the (centered) matrix; sparse matmuls stay sparse."""
+    b, _ = _coo(x) if not isinstance(x, Tensor) else (None, None)
+    d = _unwrap(x.to_dense() if hasattr(x, "to_dense") else x)
+    m, n = d.shape
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        d = d - d.mean(axis=0, keepdims=True)
+    key = jax.random.PRNGKey(0)
+    omega = jax.random.normal(key, (n, q), d.dtype)
+    y = d @ omega
+    for _ in range(niter):
+        y = d @ (d.T @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    bmat = qmat.T @ d
+    u_hat, s, vt = jnp.linalg.svd(bmat, full_matrices=False)
+    u = qmat @ u_hat
+    return Tensor(u, _internal=True), Tensor(s, _internal=True), Tensor(vt.T, _internal=True)

@@ -109,3 +109,421 @@ class Executor(_StaticStub):
 
 def default_main_program():
     raise NotImplementedError(_StaticStub._msg)
+
+
+def default_startup_program():
+    raise NotImplementedError(_StaticStub._msg)
+
+
+# ---------------------------------------------------------------------------
+# parity sweep (ref: python/paddle/static/__init__.py __all__). Names that
+# map onto the dygraph+jit runtime are REAL; only ProgramDesc/IPU-bound
+# machinery keeps the guided error (see _StaticStub).
+# ---------------------------------------------------------------------------
+import contextlib as _contextlib
+import os as _os
+
+import jax as _jax
+import jax.numpy as _jnp
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Placeholder declaration (ref: static/input.py data). In the jit
+    runtime a placeholder IS an InputSpec — feed it to
+    paddle_tpu.jit.to_static(input_spec=...)."""
+    return InputSpec(shape, dtype, name)
+
+
+Variable = None  # assigned below (Tensor alias, ref static Variable)
+
+
+def _init_variable_alias():
+    global Variable
+    from ..base.tensor import Tensor as _T
+
+    Variable = _T
+
+
+_init_variable_alias()
+
+
+@_contextlib.contextmanager
+def name_scope(prefix=None):
+    """ref: framework name_scope — prefixes layer/op names (cosmetic in
+    the jit runtime; kept as a real stack for tooling)."""
+    _name_scope_stack.append(prefix or "")
+    try:
+        yield
+    finally:
+        _name_scope_stack.pop()
+
+
+_name_scope_stack: list = []
+
+
+@_contextlib.contextmanager
+def program_guard(main_program=None, startup_program=None):
+    """ref: static program_guard. The jit runtime has one implicit
+    program; the guard is a no-op context kept so ported code runs."""
+    yield
+
+
+@_contextlib.contextmanager
+def device_guard(device=None):
+    """ref: static device_guard — pins ops to a device; XLA owns
+    placement, so this is advisory (kept for ported code)."""
+    yield
+
+
+class _Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    """ref: executor global_scope — the name->value store; here a plain
+    host dict fed by load_program_state."""
+    return _global_scope
+
+
+@_contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+    """ref: backward.py append_backward — returns [(param, grad)] after
+    running the tape backward (the dygraph engine IS the backward
+    builder here)."""
+    loss.backward()
+    params = parameter_list
+    if params is None:
+        from ..nn.layer.layers import Parameter
+
+        params = [t for t in loss._all_leaf_inputs()] if hasattr(loss, "_all_leaf_inputs") else []
+    return [(p, p.grad) for p in params if getattr(p, "grad", None) is not None]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """ref: backward.py gradients → autograd.grad."""
+    from ..autograd import grad as _grad
+
+    outs = _grad(targets, inputs, grad_outputs=target_gradients, allow_unused=True)
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """ref: static/nn/common.py py_func — run a host python function as
+    an op; with backward_func it becomes a PyLayer."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    if backward_func is None:
+        return func(*xs)
+    from ..autograd import PyLayer
+
+    class _PyFunc(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            ctx.save_for_backward(*args)
+            return func(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            saved = ctx.saved_tensor()
+            return backward_func(*saved, *grads)
+
+    return _PyFunc.apply(*xs)
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,  # noqa: A002
+          print_tensor_type=True, print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """ref: static/nn/control_flow.py Print — debug-print that survives
+    jit (jax.debug.print)."""
+    from ..base.tape import apply as _apply
+
+    msg = message or ""
+
+    def _f(a):
+        _jax.debug.print(msg + " {x}", x=a)
+        return a
+
+    return _apply(_f, input, op_name="print")
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False, name=None):
+    """ref: tensor/creation.py create_global_var — a named persistent
+    tensor registered in the global scope."""
+    from ..base.tensor import Tensor as _T
+
+    t = _T(_jnp.full(tuple(shape), value, dtype=_np_dtype(dtype)), _internal=True)
+    t.persistable = persistable
+    if name:
+        _global_scope[name] = t
+    return t
+
+
+def _np_dtype(d):
+    from ..base.dtype import canonical_dtype
+
+    return canonical_dtype(d)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None):
+    import paddle_tpu as _p
+
+    return _p.create_parameter(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+                               default_initializer=default_initializer)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    """ref: static/nn/metric.py accuracy (top-k)."""
+    from ..base.tape import apply as _apply
+
+    def _f(logits, y):
+        topk = _jnp.argsort(-logits, axis=-1)[:, :k]
+        hit = (topk == y.reshape(-1, 1)).any(axis=-1)
+        return hit.astype(_jnp.float32).mean()
+
+    return _apply(_f, input, label, op_name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1, slide_steps=1, ins_tag_weight=None):  # noqa: A002
+    """ref: static/nn/metric.py auc — batch AUC via the metric package's
+    threshold-bucket estimator."""
+    from ..metric import Auc as _Auc
+
+    m = _Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(preds=_np_pair(input), labels=_np_label(label))
+    import numpy as _np
+
+    from ..base.tensor import to_tensor as _tt
+
+    val = m.accumulate()
+    return _tt(_np.asarray(val, _np.float32)), None, None
+
+
+def _np_pair(t):
+    import numpy as _np
+
+    arr = _np.asarray(_jax.device_get(t._data))
+    if arr.ndim == 1 or arr.shape[-1] == 1:
+        p1 = arr.reshape(-1, 1)
+        arr = _np.concatenate([1 - p1, p1], axis=-1)
+    return arr
+
+
+def _np_label(t):
+    import numpy as _np
+
+    return _np.asarray(_jax.device_get(t._data)).reshape(-1, 1)
+
+
+class ExponentialMovingAverage:
+    """ref: static/ema.py ExponentialMovingAverage — shadow variables
+    with bias-corrected decay; apply()/restore() swap them in and out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._step = 0
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def update(self, parameters=None):
+        params = parameters or self._params
+        if not self._params:
+            self._params = list(params)
+        self._step += 1
+        decay = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            pid = id(p)
+            prev = self._shadow.get(pid, p._data)
+            self._shadow[pid] = decay * prev + (1 - decay) * p._data
+
+    @_contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            if id(p) in self._shadow:
+                p._data = self._shadow[id(p)]
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+        self._backup = {}
+
+
+from ..base.param_attr import ParamAttr as _ParamAttr
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """ref: static WeightNormParamAttr — ParamAttr carrying the weight-
+    norm dim; nn.utils.weight_norm consumes it."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+# -- program (de)serialization over the jit/state-dict runtime ---------------
+
+
+def save(program, path_prefix, **kwargs):
+    """ref: static/io.py save — program here is a Layer (jit runtime);
+    persists its state dict."""
+    from ..framework.io import save as _save
+
+    _save(program.state_dict(), path_prefix + ".pdparams")
+
+
+def load(program, path_prefix, executor=None, var_list=None):
+    from ..framework.io import load as _load
+
+    program.set_state_dict(_load(path_prefix + ".pdparams"))
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, program=None, **kw):
+    import pickle as _pickle
+
+    import numpy as _np
+
+    layer = program if program is not None else kw.get("layer")
+    sd = {k: _np.asarray(_jax.device_get(v._data)) for k, v in layer.state_dict().items()}
+    return _pickle.dumps(sd)
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kw):
+    """The jit runtime's 'program' is the StableHLO export produced by
+    paddle_tpu.jit.save; serialize the fetch signature."""
+    import pickle as _pickle
+
+    return _pickle.dumps({"feed": [getattr(v, "name", None) for v in (feed_vars or [])],
+                          "fetch": [getattr(v, "name", None) for v in (fetch_vars or [])]})
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle as _pickle
+
+    sd = _pickle.loads(data)
+    program.set_state_dict(sd)
+    return program
+
+
+def deserialize_program(data):
+    import pickle as _pickle
+
+    return _pickle.loads(data)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """ref: static/io.py normalize_program — prunes to the feed/fetch
+    closure; the jit trace already is that closure."""
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    """ref: static/io.py load_program_state — returns {name: ndarray}."""
+    import numpy as _np
+
+    from ..framework.io import load as _load
+
+    sd = _load(model_path + ".pdparams" if not model_path.endswith(".pdparams") else model_path)
+    return {k: _np.asarray(v.numpy() if hasattr(v, "numpy") else v) for k, v in sd.items()}
+
+
+def set_program_state(program, state):
+    program.set_state_dict(state)
+
+
+def cpu_places(device_count=None):
+    from ..base.device import CPUPlace
+
+    n = device_count or int(_os.environ.get("CPU_NUM", 1))
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """ref: static cuda_places → accelerator places on TPU."""
+    from ..base.device import CUDAPlace
+
+    ids = device_ids if device_ids is not None else range(len(_jax.devices()))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+class BuildStrategy:
+    """ref: BuildStrategy — fusion/memory knobs. XLA owns all of these;
+    the attributes are accepted and recorded so ported setup code runs,
+    and the jit pipeline reads none of them (documented no-ops)."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.build_cuda_graph = False
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class CompiledProgram(_StaticStub):
+    """ref: CompiledProgram — ProgramDesc-bound; unsupported (use
+    paddle_tpu.jit.to_static)."""
+
+
+class IpuStrategy(_StaticStub):
+    """IPU-only machinery — no TPU counterpart."""
+
+
+class IpuCompiledProgram(_StaticStub):
+    """IPU-only machinery — no TPU counterpart."""
+
+
+@_contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("IPU sharding has no TPU counterpart")
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("IPU sharding has no TPU counterpart")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
+    """ref: static/nn/metric.py ctr_metric_bundle — use metric.Auc +
+    the accuracy/auc functions above in the dygraph runtime."""
+    raise NotImplementedError(
+        "ctr_metric_bundle is ProgramDesc-bound; compose paddle_tpu.metric."
+        "Auc with static.accuracy/static.auc instead."
+    )

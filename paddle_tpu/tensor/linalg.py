@@ -346,3 +346,83 @@ def householder_product(x, tau, name=None):
         return Q[..., :, :n]
 
     return apply(_f, x, tau, op_name="householder_product")
+
+
+# -- parity sweep (ref: python/paddle/linalg.py remaining entries) ----------
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse from a Cholesky factor (ref tensor/linalg.py
+    cholesky_inverse): A^-1 where A = LL^T (or U^T U)."""
+
+    def _f(a):
+        eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+        return jax.scipy.linalg.cho_solve((a, not upper), eye)
+
+    return apply(_f, x, op_name="cholesky_inverse")
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (ref tensor/linalg.py matrix_exp)."""
+    return apply(jax.scipy.linalg.expm, x, op_name="matrix_exp")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (ref tensor/linalg.py svd_lowrank):
+    subspace iteration, returns (U, S, V) with q columns."""
+
+    def _f(a, *m):
+        d = a - m[0] if m else a
+        n = d.shape[-1]
+        key = jax.random.PRNGKey(0)
+        omega = jax.random.normal(key, d.shape[:-2] + (n, q), d.dtype)
+        y = d @ omega
+        for _ in range(niter):
+            y = d @ (jnp.swapaxes(d, -1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ d
+        u_hat, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_hat, s, jnp.swapaxes(vt, -1, -2)
+
+    args = (x,) + ((M,) if M is not None else ())
+    return apply(_f, *args, op_name="svd_lowrank")
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by Q from a householder factorization (ref
+    tensor/linalg.py ormqr)."""
+
+    def _f(a, t, other):
+        qmat = jax.lax.linalg.householder_product(a, t)
+        qm = jnp.swapaxes(qmat, -1, -2) if transpose else qmat
+        return qm @ other if left else other @ qm
+
+    return apply(_f, x, tau, y, op_name="ormqr")
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False, transpose_y=False,
+                            scale=1.0, output_dtype="float16", name=None):
+    """fp8 x fp8 -> half GEMM (ref: incubate fp8 gemm). On TPU this is a
+    dot_general with fp8 inputs and a wider accumulator — the MXU path
+    XLA emits for float8_e4m3fn operands."""
+    import ml_dtypes
+
+    out_dt = jnp.bfloat16 if output_dtype in ("bfloat16",) else jnp.float16
+
+    def _f(a, b, *mb):
+        a = a.astype(ml_dtypes.float8_e4m3fn)
+        b = b.astype(ml_dtypes.float8_e4m3fn)
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jax.lax.dot_general(
+            a, b, (((a.ndim - 1,), (b.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if mb:
+            out = out + mb[0]
+        return out.astype(out_dt)
+
+    args = (x, y) + ((bias,) if bias is not None else ())
+    return apply(_f, *args, op_name="fp8_fp8_half_gemm_fused")

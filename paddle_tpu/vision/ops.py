@@ -144,3 +144,574 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
     """ref: ops.py roi_pool."""
     return _roi_pool_common(x, boxes, boxes_num, output_size, spatial_scale, "pool")
+
+
+# ---------------------------------------------------------------------------
+# parity sweep (ref: python/paddle/vision/ops.py remaining entries)
+# ---------------------------------------------------------------------------
+from ..base.tape import apply as _apply
+from ..nn.layer.layers import Layer as _Layer
+
+
+class RoIPool(_Layer):
+    """ref: ops.py RoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size, self.spatial_scale = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+class RoIAlign(_Layer):
+    """ref: ops.py RoIAlign."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size, self.spatial_scale = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size, self.spatial_scale,
+                         aligned=aligned)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pooling (ref: ops.py psroi_pool): input
+    channels C = out_channels * oh * ow; output bin (i, j) average-pools
+    its own channel group."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    xa = _unwrap(x)
+    n, c, h, w = xa.shape
+    if c % (oh * ow) != 0:
+        raise ValueError(f"psroi_pool: channels {c} must divide {oh}x{ow}")
+    oc = c // (oh * ow)
+    ba = np.asarray(jax.device_get(_unwrap(boxes)), np.float32)
+    bn = np.asarray(jax.device_get(_unwrap(boxes_num)), np.int64)
+    img_idx = np.repeat(np.arange(len(bn)), bn)
+    outs = []
+    for k, box in enumerate(ba):
+        x1, y1, x2, y2 = box * spatial_scale
+        img = xa[img_idx[k]].reshape(oc, oh, ow, h, w)
+        bins = []
+        bh = max((y2 - y1) / oh, 1e-6)
+        bw = max((x2 - x1) / ow, 1e-6)
+        for i in range(oh):
+            row = []
+            for j in range(ow):
+                ys = int(np.clip(np.floor(y1 + i * bh), 0, h - 1))
+                ye = int(np.clip(np.ceil(y1 + (i + 1) * bh), ys + 1, h))
+                xs = int(np.clip(np.floor(x1 + j * bw), 0, w - 1))
+                xe = int(np.clip(np.ceil(x1 + (j + 1) * bw), xs + 1, w))
+                row.append(img[:, i, j, ys:ye, xs:xe].mean(axis=(1, 2)))
+            bins.append(jnp.stack(row, -1))
+        outs.append(jnp.stack(bins, -2))
+    return Tensor(jnp.stack(outs), _internal=True)
+
+
+class PSRoIPool(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size, self.spatial_scale = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    """Deformable conv v1/v2 (ref: ops.py deform_conv2d): bilinear-sample
+    the input at offset kernel taps, then contract with the weight — a
+    gather + einsum, which XLA maps onto the MXU."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def _f(xa, off, wt, *rest):
+        n, c, h, w = xa.shape
+        co, cpg, kh, kw = wt.shape
+        oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        ow = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        # base sampling grid per output position and kernel tap
+        oy = jnp.arange(oh) * s[0] - p[0]
+        ox = jnp.arange(ow) * s[1] - p[1]
+        ky = jnp.arange(kh) * d[0]
+        kx = jnp.arange(kw) * d[1]
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]  # [oh,1,kh,1]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]  # [1,ow,1,kw]
+        off = off.reshape(n, deformable_groups, kh, kw, 2, oh, ow)
+        dy = jnp.moveaxis(off[:, :, :, :, 0], (2, 3), (4, 5))  # [n,dg,oh,ow,kh,kw]
+        dx = jnp.moveaxis(off[:, :, :, :, 1], (2, 3), (4, 5))
+        sy = base_y[None, None] + dy  # [n,dg,oh,ow,kh,kw]
+        sx = base_x[None, None] + dx
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = sy - y0
+        wx = sx - x0
+
+        def gather(yy, xx):
+            yi = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
+            inb = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+            # per deformable group: channels split into dg groups
+            cg = c // deformable_groups
+            xg = xa.reshape(n, deformable_groups, cg, h, w)
+            # advanced indexing: build [n, dg, oh, ow, kh, kw, cg]
+            vals = xg[
+                jnp.arange(n)[:, None, None, None, None, None],
+                jnp.arange(deformable_groups)[None, :, None, None, None, None],
+                :,
+                yi, xi,
+            ]
+            return jnp.where(inb[..., None], vals, 0.0)
+
+        v00 = gather(y0, x0)
+        v01 = gather(y0, x0 + 1)
+        v10 = gather(y0 + 1, x0)
+        v11 = gather(y0 + 1, x0 + 1)
+        sampled = (
+            v00 * ((1 - wy) * (1 - wx))[..., None]
+            + v01 * ((1 - wy) * wx)[..., None]
+            + v10 * (wy * (1 - wx))[..., None]
+            + v11 * (wy * wx)[..., None]
+        )  # [n, dg, oh, ow, kh, kw, cg]
+        if rest and mask is not None:
+            m = rest[0].reshape(n, deformable_groups, kh, kw, oh, ow)
+            m = jnp.moveaxis(m, (2, 3), (4, 5))
+            sampled = sampled * m[..., None]
+        # reassemble channels [n, oh, ow, c, kh, kw]
+        samp = jnp.moveaxis(sampled, 1, 3)  # [n, oh, ow, dg, kh, kw, cg]
+        samp = jnp.moveaxis(samp, (3, 6), (3, 4))  # [n, oh, ow, dg, cg, kh, kw]
+        samp = samp.reshape(n, ohh := samp.shape[1], oww := samp.shape[2], c, kh, kw)
+        # grouped contraction with weight [co, c/groups, kh, kw]
+        cg2 = c // groups
+        samp_g = samp.reshape(n, ohh, oww, groups, cg2, kh, kw)
+        wt_g = wt.reshape(groups, co // groups, cg2, kh, kw)
+        out = jnp.einsum("nhwgckl,gockl->ngohw", samp_g, wt_g)
+        out = out.reshape(n, co, ohh, oww)
+        if rest and bias is not None:
+            b = rest[-1]
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    args = (x, offset, weight)
+    if mask is not None:
+        args = args + (mask,)
+    if bias is not None:
+        args = args + (bias,)
+    return _apply(_f, *args, op_name="deform_conv2d")
+
+
+class DeformConv2D(_Layer):
+    """ref: ops.py DeformConv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, deformable_groups=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self._attrs = (stride, padding, dilation, deformable_groups, groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, k[0], k[1]], attr=weight_attr
+        )
+        self.bias = self.create_parameter([out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        stride, padding, dilation, dg, groups = self._attrs
+        return deform_conv2d(x, offset, self.weight, self.bias, stride, padding,
+                             dilation, dg, groups, mask)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """SSD box encode/decode (ref: ops.py box_coder)."""
+
+    def _f(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            dw = jnp.log(tw[:, None] / pw[None, :])
+            dh = jnp.log(th[:, None] / ph[None, :])
+            out = jnp.stack([dx, dy, dw, dh], -1)
+            if pbv is not None:
+                out = out / pbv[None, :, :]
+            return out
+        # decode_center_size: tb [N, M, 4] deltas
+        deltas = tb
+        if pbv is not None:
+            deltas = deltas * (pbv[None, :, :] if pbv.ndim == 2 else pbv)
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = pw[None, :], ph[None, :], pcx[None, :], pcy[None, :]
+        else:
+            pw_, ph_, pcx_, pcy_ = pw[:, None], ph[:, None], pcx[:, None], pcy[:, None]
+        cx = deltas[..., 0] * pw_ + pcx_
+        cy = deltas[..., 1] * ph_ + pcy_
+        bw = jnp.exp(deltas[..., 2]) * pw_
+        bh = jnp.exp(deltas[..., 3]) * ph_
+        return jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - norm, cy + bh * 0.5 - norm], -1)
+
+    args = [prior_box, prior_box_var, target_box]
+    if prior_box_var is None:
+        def _f2(pb, tb):
+            return _f(pb, None, tb)
+
+        return _apply(_f2, prior_box, target_box, op_name="box_coder")
+    return _apply(_f, *args, op_name="box_coder")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),  # noqa: A002
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior anchors (ref: ops.py prior_box)."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                if min_max_aspect_ratios_order:
+                    cell.append((cx, cy, ms, ms))
+                    if max_sizes:
+                        sq = np.sqrt(ms * max_sizes[k])
+                        cell.append((cx, cy, sq, sq))
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        cell.append((cx, cy, ms * np.sqrt(ar), ms / np.sqrt(ar)))
+                else:
+                    for ar in ars:
+                        cell.append((cx, cy, ms * np.sqrt(ar), ms / np.sqrt(ar)))
+                    if max_sizes:
+                        sq = np.sqrt(ms * max_sizes[k])
+                        cell.append((cx, cy, sq, sq))
+            boxes.extend(cell)
+    arr = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    out = np.stack([
+        (arr[..., 0] - arr[..., 2] / 2) / iw,
+        (arr[..., 1] - arr[..., 3] / 2) / ih,
+        (arr[..., 0] + arr[..., 2] / 2) / iw,
+        (arr[..., 1] + arr[..., 3] / 2) / ih,
+    ], -1)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32), out.shape).copy()
+    return Tensor(jnp.asarray(out), _internal=True), Tensor(jnp.asarray(var), _internal=True)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """Decode a YOLOv3 head (ref: ops.py yolo_box)."""
+    na = len(anchors) // 2
+
+    def _f(xa, imgs):
+        n, c, h, w = xa.shape
+        xa = xa.reshape(n, na, -1, h, w)
+        grid_x = jnp.arange(w, dtype=jnp.float32)
+        grid_y = jnp.arange(h, dtype=jnp.float32)
+        sig = jax.nn.sigmoid
+        bx = (sig(xa[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + grid_x[None, None, None, :]) / w
+        by = (sig(xa[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + grid_y[None, None, :, None]) / h
+        aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+        bw = jnp.exp(xa[:, :, 2]) * aw / in_w
+        bh = jnp.exp(xa[:, :, 3]) * ah / in_h
+        obj = sig(xa[:, :, 4])
+        cls = sig(xa[:, :, 5:5 + class_num])
+        scores = obj[:, :, None] * cls
+        ih = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        iw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * iw
+        y1 = (by - bh / 2) * ih
+        x2 = (bx + bw / 2) * iw
+        y2 = (by + bh / 2) * ih
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+            x2 = jnp.clip(x2, 0, iw - 1)
+            y2 = jnp.clip(y2, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        scores = jnp.moveaxis(scores, 2, -1).reshape(n, -1, class_num)
+        keep = (obj.reshape(n, -1) > conf_thresh)[..., None]
+        boxes = jnp.where(keep, boxes, 0.0)
+        scores = jnp.where(keep, scores, 0.0)
+        return boxes, scores
+
+    return _apply(_f, x, img_size, op_name="yolo_box")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (ref: ops.py yolo_loss): coordinate +
+    objectness + classification terms with best-anchor target
+    assignment per gt box."""
+    na = len(anchor_mask)
+
+    def _f(xa, gtb, gtl, *maybe_score):
+        n, c, h, w = xa.shape
+        pred = xa.reshape(n, na, 5 + class_num, h, w)
+        sig = jax.nn.sigmoid
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+        masked = [(anchors[2 * m], anchors[2 * m + 1]) for m in anchor_mask]
+        aw = jnp.asarray([a[0] for a in masked], jnp.float32)
+        ah = jnp.asarray([a[1] for a in masked], jnp.float32)
+        all_aw = jnp.asarray(anchors[0::2], jnp.float32)
+        all_ah = jnp.asarray(anchors[1::2], jnp.float32)
+
+        # target assignment (per gt: best anchor over ALL anchors by IoU
+        # of centered boxes; the gt lands in this head iff best in mask)
+        B = gtb.shape[1]
+        gw = gtb[:, :, 2]
+        gh = gtb[:, :, 3]
+        inter = jnp.minimum(gw[:, :, None], all_aw[None, None, :] / in_w) * \
+                jnp.minimum(gh[:, :, None], all_ah[None, None, :] / in_h)
+        union = gw[:, :, None] * gh[:, :, None] + (all_aw / in_w * all_ah / in_h)[None, None, :] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)  # [n, B]
+        valid = (gw > 0) & (gh > 0)
+
+        gi = jnp.clip((gtb[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gtb[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+
+        obj_target = jnp.zeros((n, na, h, w))
+        loss = 0.0
+        mask_arr = jnp.asarray(anchor_mask)
+        for k in range(na):
+            sel = valid & (best == mask_arr[k])  # [n, B]
+            weight = sel.astype(jnp.float32)
+            if maybe_score:
+                weight = weight * maybe_score[0]
+            tx = gtb[:, :, 0] * w - gi
+            ty = gtb[:, :, 1] * h - gj
+            tw = jnp.log(jnp.maximum(gw * in_w / aw[k], 1e-9))
+            th = jnp.log(jnp.maximum(gh * in_h / ah[k], 1e-9))
+            px = sig(pred[:, k, 0])[jnp.arange(n)[:, None], gj, gi]
+            py = sig(pred[:, k, 1])[jnp.arange(n)[:, None], gj, gi]
+            pw_ = pred[:, k, 2][jnp.arange(n)[:, None], gj, gi]
+            ph_ = pred[:, k, 3][jnp.arange(n)[:, None], gj, gi]
+            box_scale = 2.0 - gw * gh
+            coord = ((px - tx) ** 2 + (py - ty) ** 2 + (pw_ - tw) ** 2 + (ph_ - th) ** 2)
+            loss = loss + jnp.sum(weight * box_scale * coord)
+            obj_target = obj_target.at[jnp.arange(n)[:, None], k, gj, gi].max(weight)
+            # class loss at assigned cells
+            tgt = jax.nn.one_hot(gtl, class_num)
+            if use_label_smooth:
+                delta = 1.0 / class_num
+                tgt = tgt * (1 - delta) + delta / class_num
+            pc = sig(pred[:, k, 5:])[jnp.arange(n)[:, None], :, gj, gi]
+            bce = -(tgt * jnp.log(jnp.clip(pc, 1e-9, 1)) + (1 - tgt) * jnp.log(jnp.clip(1 - pc, 1e-9, 1)))
+            loss = loss + jnp.sum(weight[..., None] * bce)
+        # ignore region: unassigned predictions whose best IoU with any
+        # gt exceeds ignore_thresh are excluded from the no-object BCE
+        # (the reference's ignore mask)
+        grid_x = (jnp.arange(w, dtype=jnp.float32))[None, None, None, :]
+        grid_y = (jnp.arange(h, dtype=jnp.float32))[None, None, :, None]
+        pcx = (sig(pred[:, :, 0]) + grid_x) / w  # [n, na, h, w]
+        pcy = (sig(pred[:, :, 1]) + grid_y) / h
+        pww = jnp.exp(pred[:, :, 2]) * aw[None, :, None, None] / in_w
+        phh = jnp.exp(pred[:, :, 3]) * ah[None, :, None, None] / in_h
+        gcx = gtb[:, :, 0][:, None, None, None, :]  # [n, 1, 1, 1, B]
+        gcy = gtb[:, :, 1][:, None, None, None, :]
+        gww = gw[:, None, None, None, :]
+        ghh = gh[:, None, None, None, :]
+        lx = jnp.maximum(pcx[..., None] - pww[..., None] / 2, gcx - gww / 2)
+        rx = jnp.minimum(pcx[..., None] + pww[..., None] / 2, gcx + gww / 2)
+        ty_ = jnp.maximum(pcy[..., None] - phh[..., None] / 2, gcy - ghh / 2)
+        by_ = jnp.minimum(pcy[..., None] + phh[..., None] / 2, gcy + ghh / 2)
+        inter_ = jnp.clip(rx - lx, 0) * jnp.clip(by_ - ty_, 0)
+        union_ = pww[..., None] * phh[..., None] + gww * ghh - inter_
+        iou_all = jnp.where(valid[:, None, None, None, :],
+                            inter_ / jnp.maximum(union_, 1e-9), 0.0)
+        best_iou = iou_all.max(-1)  # [n, na, h, w]
+        noobj_w = jnp.where((obj_target == 0) & (best_iou > ignore_thresh), 0.0, 1.0)
+
+        pobj = sig(pred[:, :, 4])
+        obj_bce = -(obj_target * jnp.log(jnp.clip(pobj, 1e-9, 1)) +
+                    noobj_w * (1 - obj_target) * jnp.log(jnp.clip(1 - pobj, 1e-9, 1)))
+        loss = loss + jnp.sum(obj_bce)
+        return loss / n
+
+    args = (x, gt_box, gt_label) + ((gt_score,) if gt_score is not None else ())
+    return _apply(_f, *args, op_name="yolo_loss")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by scale (ref: ops.py
+    distribute_fpn_proposals). Host-side restructuring (list outputs)."""
+    rois = np.asarray(jax.device_get(_unwrap(fpn_rois)), np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum((rois[:, 2] - rois[:, 0] + off) *
+                               (rois[:, 3] - rois[:, 1] + off), 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    order = []
+    for L in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == L)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel]), _internal=True))
+        order.append(sel)
+    restore = np.argsort(np.concatenate(order)).astype(np.int32)
+    rois_num_per = None
+    if rois_num is not None:
+        rois_num_per = [Tensor(jnp.asarray(np.asarray([len(o)], np.int32)), _internal=True) for o in order]
+    return outs, Tensor(jnp.asarray(restore), _internal=True), rois_num_per
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, pixel_offset=False,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation (ref: ops.py generate_proposals): decode
+    anchors with deltas, clip, filter small, NMS. Host-driven (output
+    count is data-dependent), per image."""
+    sc = np.asarray(jax.device_get(_unwrap(scores)), np.float32)
+    bd = np.asarray(jax.device_get(_unwrap(bbox_deltas)), np.float32)
+    ims = np.asarray(jax.device_get(_unwrap(img_size)), np.float32)
+    an = np.asarray(jax.device_get(_unwrap(anchors)), np.float32).reshape(-1, 4)
+    va = np.asarray(jax.device_get(_unwrap(variances)), np.float32).reshape(-1, 4)
+    n = sc.shape[0]
+    off = 1.0 if pixel_offset else 0.0
+    out_rois, out_probs, out_nums = [], [], []
+    for b in range(n):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = bd[b].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order % len(an)], va[order % len(va)]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        bw = np.exp(np.minimum(v[:, 2] * d[:, 2], np.log(1000 / 16))) * aw
+        bh = np.exp(np.minimum(v[:, 3] * d[:, 3], np.log(1000 / 16))) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2 - off, cy + bh / 2 - off], -1)
+        H, W = ims[b][0], ims[b][1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, W - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, H - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size) &
+                (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        if len(boxes):
+            k = nms(Tensor(jnp.asarray(boxes), _internal=True), nms_thresh,
+                    Tensor(jnp.asarray(s), _internal=True), top_k=post_nms_top_n)
+            ki = np.asarray(jax.device_get(_unwrap(k)))
+            boxes, s = boxes[ki], s[ki]
+        out_rois.append(boxes)
+        out_probs.append(s)
+        out_nums.append(len(boxes))
+    rois = Tensor(jnp.asarray(np.concatenate(out_rois) if out_rois else np.zeros((0, 4), np.float32)), _internal=True)
+    probs = Tensor(jnp.asarray(np.concatenate(out_probs) if out_probs else np.zeros(0, np.float32)), _internal=True)
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(out_nums, np.int32)), _internal=True)
+    return rois, probs
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """SOLO matrix NMS (ref: ops.py matrix_nms): decay scores by max-IoU
+    against higher-scored peers instead of hard suppression."""
+    bb = np.asarray(jax.device_get(_unwrap(bboxes)), np.float32)
+    sc = np.asarray(jax.device_get(_unwrap(scores)), np.float32)
+    n, num_cls = sc.shape[0], sc.shape[1]
+    outs, idxs, nums = [], [], []
+    for b in range(n):
+        dets, keep_idx = [], []
+        for c in range(num_cls):
+            if c == background_label:
+                continue
+            s = sc[b, c]
+            sel = np.nonzero(s > score_threshold)[0]
+            if not len(sel):
+                continue
+            order = sel[np.argsort(-s[sel])][:nms_top_k]
+            boxes, ss = bb[b][order], s[order]
+            # IoU matrix (upper triangle)
+            x1 = np.maximum(boxes[:, None, 0], boxes[None, :, 0])
+            y1 = np.maximum(boxes[:, None, 1], boxes[None, :, 1])
+            x2 = np.minimum(boxes[:, None, 2], boxes[None, :, 2])
+            y2 = np.minimum(boxes[:, None, 3], boxes[None, :, 3])
+            inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+            area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+            iou = inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-9)
+            iou = np.triu(iou, 1)
+            max_iou = iou.max(0)  # per det: max IoU vs higher scored
+            comp_iou = np.array([iou[:i, :i].max(0).max() if i else 0.0 for i in range(len(boxes))])
+            if use_gaussian:
+                decay = np.exp(-(max_iou ** 2 - comp_iou ** 2) / gaussian_sigma)
+            else:
+                decay = (1 - max_iou) / np.maximum(1 - comp_iou, 1e-9)
+            ds = ss * decay
+            keep = ds > post_threshold
+            for i in np.nonzero(keep)[0]:
+                dets.append([c, ds[i], *boxes[i]])
+                keep_idx.append(order[i])
+        dets = sorted(dets, key=lambda r: -r[1])[:keep_top_k]
+        outs.extend(dets)
+        nums.append(len(dets))
+        idxs.extend(keep_idx[:keep_top_k])
+    out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)), _internal=True)
+    rois_num = Tensor(jnp.asarray(np.asarray(nums, np.int32)), _internal=True)
+    index = Tensor(jnp.asarray(np.asarray(idxs, np.int32)), _internal=True)
+    if return_index:
+        return (out, index, rois_num) if return_rois_num else (out, index)
+    return (out, rois_num) if return_rois_num else out
+
+
+def read_file(filename, name=None):
+    """ref: ops.py read_file — raw bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data), _internal=True)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """ref: ops.py decode_jpeg — CHW uint8 via PIL (host decode; the
+    nvjpeg GPU path has no TPU analogue)."""
+    import io as _io
+
+    from PIL import Image
+
+    data = bytes(np.asarray(jax.device_get(_unwrap(x)), np.uint8))
+    img = Image.open(_io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr), _internal=True)
