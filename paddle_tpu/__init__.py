@@ -98,6 +98,9 @@ from . import text  # noqa: F401
 from . import reader  # noqa: F401
 from . import hub  # noqa: F401
 from . import geometric  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import onnx  # noqa: F401
+from . import inference  # noqa: F401
 from . import audio  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 

@@ -1,0 +1,17 @@
+"""paddle.callbacks parity (ref: python/paddle/callbacks.py re-exporting
+hapi.callbacks)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    ReduceLROnPlateau,
+    VisualDL,
+    WandbCallback,
+)
+
+__all__ = [
+    "Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL", "LRScheduler",
+    "EarlyStopping", "ReduceLROnPlateau", "WandbCallback",
+]

@@ -241,3 +241,78 @@ class cuda:
     @staticmethod
     def device_count():
         return device_count()
+
+
+# -- parity sweep (ref: python/paddle/device/__init__.py remaining) ---------
+from ..base.device import CPUPlace as _CPUPlace
+
+
+class XPUPlace(_CPUPlace):
+    """XPU has no TPU analogue; kept as a CPU place for ported code."""
+
+
+class IPUPlace(_CPUPlace):
+    """IPU has no TPU analogue; kept as a CPU place for ported code."""
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU (ref device get_cudnn_version -> None when absent)."""
+    return None
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    """XLA plays CINN's role; the CINN-specific API reports False."""
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    """Distributed is always built in (XLA collectives)."""
+    return True
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    """TPU is the 'custom device' of this build (ref custom_device query)."""
+    return device_type in ("tpu", "axon")
+
+
+def get_all_device_type():
+    import jax as _jax
+
+    kinds = {"cpu"}
+    try:
+        kinds.update(d.platform for d in _jax.devices())
+    except Exception:
+        pass
+    return sorted(kinds)
+
+
+def get_all_custom_device_type():
+    return [t for t in get_all_device_type() if t not in ("cpu", "gpu")]
+
+
+def get_available_device():
+    import jax as _jax
+
+    return [f"{d.platform}:{d.id}" for d in _jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device() if not d.startswith(("cpu", "gpu"))]
+
+
+def set_stream(stream=None):
+    """XLA orders work per-device automatically; returns the current
+    stream for parity (ref device set_stream)."""
+    return current_stream()

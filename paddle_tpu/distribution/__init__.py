@@ -24,10 +24,26 @@ from .geometric import Geometric  # noqa: F401
 from .gumbel import Gumbel  # noqa: F401
 from .laplace import Laplace  # noqa: F401
 from .kl import kl_divergence, register_kl  # noqa: F401
+from .extension import (  # noqa: F401
+    Binomial,
+    Cauchy,
+    Chi2,
+    ContinuousBernoulli,
+    ExponentialFamily,
+    Independent,
+    LKJCholesky,
+    MultivariateNormal,
+    Poisson,
+    StudentT,
+    TransformedDistribution,
+)
 
 __all__ = [
     "Distribution", "Normal", "LogNormal", "Uniform", "Bernoulli",
     "Categorical", "Multinomial", "Beta", "Dirichlet", "Gamma",
     "Exponential", "Geometric", "Gumbel", "Laplace",
+    "Cauchy", "Chi2", "ContinuousBernoulli", "ExponentialFamily",
+    "MultivariateNormal", "Independent", "TransformedDistribution",
+    "LKJCholesky", "Binomial", "Poisson", "StudentT",
     "kl_divergence", "register_kl",
 ]

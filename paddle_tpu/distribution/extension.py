@@ -1,0 +1,560 @@
+"""Remaining distribution families (ref: python/paddle/distribution/
+{cauchy,chi2,continuous_bernoulli,exponential_family,multivariate_normal,
+independent,transformed_distribution,lkj_cholesky,binomial,poisson,
+student_t}.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from .distribution import Distribution, _as_array
+from .gamma import Gamma
+
+__all__ = [
+    "Cauchy", "Chi2", "ContinuousBernoulli", "ExponentialFamily",
+    "MultivariateNormal", "Independent", "TransformedDistribution",
+    "LKJCholesky", "Binomial", "Poisson", "StudentT",
+]
+
+
+class ExponentialFamily(Distribution):
+    """ref: exponential_family.py — base class: subclasses expose
+    natural parameters + log-normalizer; entropy falls out via the
+    Bregman identity H = A(θ) - <θ, ∇A(θ)> + E[-h(x)], computed here
+    with jax.grad on the log-normalizer."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = [n._data if hasattr(n, "_data") else jnp.asarray(n) for n in self._natural_parameters]
+
+        def f(*ns):
+            a = self._log_normalizer(*ns)
+            grads = jax.grad(lambda *xs: jnp.sum(self._log_normalizer(*xs)), argnums=tuple(range(len(ns))))(*ns)
+            ent = a - sum(n * g for n, g in zip(ns, grads))
+            return ent - self._mean_carrier_measure
+
+        from ..base.tensor import Tensor
+
+        return Tensor(f(*nat), _internal=True)
+
+
+class Cauchy(Distribution):
+    """ref: cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        shape = np.broadcast_shapes(tuple(self.loc.shape), tuple(self.scale.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy has no stddev")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(m, s):
+            return m + s * jax.random.cauchy(key, out_shape, jnp.float32)
+
+        return apply(f, self.loc, self.scale, op_name="cauchy_rsample")
+
+    def log_prob(self, value):
+        def f(v, m, s):
+            z = (v - m) / s
+            return -jnp.log(np.pi * s * (1 + z * z))
+
+        return apply(f, value, self.loc, self.scale, op_name="cauchy_log_prob")
+
+    def cdf(self, value):
+        def f(v, m, s):
+            return jnp.arctan((v - m) / s) / np.pi + 0.5
+
+        return apply(f, value, self.loc, self.scale, op_name="cauchy_cdf")
+
+    def entropy(self):
+        def f(s):
+            return jnp.log(4 * np.pi * s)
+
+        return apply(f, self.scale, op_name="cauchy_entropy")
+
+    def kl_divergence(self, other):
+        def f(m0, s0, m1, s1):
+            return jnp.log(((s0 + s1) ** 2 + (m0 - m1) ** 2) / (4 * s0 * s1))
+
+        return apply(f, self.loc, self.scale, other.loc, other.scale, op_name="cauchy_kl")
+
+
+class Chi2(Gamma):
+    """ref: chi2.py — Gamma(df/2, rate=1/2)."""
+
+    def __init__(self, df, name=None):
+        df_t = _as_array(df)
+        half = apply(lambda d: d * 0.5, df_t, op_name="chi2_half_df")
+        rate = apply(lambda d: jnp.full_like(d, 0.5), df_t, op_name="chi2_rate")
+        super().__init__(half, rate)
+        self.df = df_t
+
+
+class ContinuousBernoulli(Distribution):
+    """ref: continuous_bernoulli.py — CB(λ) with normalizer C(λ)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _as_array(probs)
+        self._lims = lims
+        super().__init__(batch_shape=tuple(self.probs.shape))
+
+    def _safe_p(self, p):
+        lo, hi = self._lims
+        cut = (p > lo) & (p < hi)
+        return jnp.where(cut, lo, p), cut
+
+    def _log_C(self, p):
+        ps, cut = self._safe_p(p)
+        out = jnp.log((2.0 * jnp.arctanh(1.0 - 2.0 * ps)) / (1.0 - 2.0 * ps))
+        # Taylor expansion at 1/2 for the unstable window
+        x = p - 0.5
+        taylor = math.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x * x) * x * x
+        return jnp.where(cut, taylor, out)
+
+    @property
+    def mean(self):
+        def f(p):
+            ps, cut = self._safe_p(p)
+            m = ps / (2.0 * ps - 1.0) + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * ps))
+            x = p - 0.5
+            taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x * x) * x
+            return jnp.where(cut, taylor, m)
+
+        return apply(f, self.probs, op_name="cb_mean")
+
+    @property
+    def variance(self):
+        def f(p):
+            ps, _ = self._safe_p(p)
+            m = ps / (2.0 * ps - 1.0) + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * ps))
+            v = ps * (ps - 1.0) / (1.0 - 2.0 * ps) ** 2 + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * ps)) ** 2
+            return v
+
+        return apply(f, self.probs, op_name="cb_variance")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(p):
+            u = jax.random.uniform(key, out_shape, jnp.float32, 1e-6, 1 - 1e-6)
+            ps, cut = self._safe_p(p)
+            icdf = (jnp.log1p(u * (2.0 * ps - 1.0) / (1.0 - ps)) /
+                    (jnp.log(ps) - jnp.log1p(-ps)))
+            return jnp.where(cut, u, icdf)
+
+        return apply(f, self.probs, op_name="cb_rsample")
+
+    def log_prob(self, value):
+        def f(v, p):
+            return (v * jnp.log(p) + (1.0 - v) * jnp.log1p(-p)) + self._log_C(p)
+
+        return apply(f, value, self.probs, op_name="cb_log_prob")
+
+    def entropy(self):
+        """-E[log p(x)] computed from mean and log C."""
+
+        def f(p):
+            ps, _ = self._safe_p(p)
+            mean = ps / (2.0 * ps - 1.0) + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * ps))
+            return -(mean * jnp.log(ps) + (1.0 - mean) * jnp.log1p(-ps)) - self._log_C(p)
+
+        return apply(f, self.probs, op_name="cb_entropy")
+
+
+class MultivariateNormal(Distribution):
+    """ref: multivariate_normal.py — parameterized by covariance_matrix,
+    precision_matrix, or scale_tril."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _as_array(loc)
+        given = [a is not None for a in (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError("give exactly one of covariance_matrix / precision_matrix / scale_tril")
+        if scale_tril is not None:
+            self._tril = _as_array(scale_tril)
+        elif covariance_matrix is not None:
+            cov = _as_array(covariance_matrix)
+            self._tril = apply(jnp.linalg.cholesky, cov, op_name="mvn_chol")
+        else:
+            prec = _as_array(precision_matrix)
+
+            def f(pm):
+                return jnp.linalg.cholesky(jnp.linalg.inv(pm))
+
+            self._tril = apply(f, prec, op_name="mvn_chol_from_prec")
+        d = self.loc.shape[-1]
+        super().__init__(batch_shape=tuple(self.loc.shape[:-1]), event_shape=(d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def scale_tril(self):
+        return self._tril
+
+    @property
+    def covariance_matrix(self):
+        def f(L):
+            return L @ jnp.swapaxes(L, -1, -2)
+
+        return apply(f, self._tril, op_name="mvn_cov")
+
+    @property
+    def variance(self):
+        def f(L):
+            return jnp.sum(L * L, axis=-1)
+
+        return apply(f, self._tril, op_name="mvn_var")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        d = self._event_shape[0]
+        out_shape = tuple(shape) + self._batch_shape + (d,)
+
+        def f(m, L):
+            eps = jax.random.normal(key, out_shape, jnp.float32)
+            return m + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return apply(f, self.loc, self._tril, op_name="mvn_rsample")
+
+    def log_prob(self, value):
+        d = self._event_shape[0]
+
+        def f(v, m, L):
+            diff = v - m
+            sol = jax.scipy.linalg.solve_triangular(L, diff[..., None], lower=True)[..., 0]
+            maha = jnp.sum(sol * sol, -1)
+            logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            return -0.5 * (d * np.log(2 * np.pi) + maha) - logdet
+
+        return apply(f, value, self.loc, self._tril, op_name="mvn_log_prob")
+
+    def entropy(self):
+        d = self._event_shape[0]
+
+        def f(L):
+            logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            return 0.5 * d * (1 + np.log(2 * np.pi)) + logdet
+
+        return apply(f, self._tril, op_name="mvn_entropy")
+
+
+class Independent(Distribution):
+    """ref: independent.py — reinterpret batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = tuple(base.batch_shape)
+        if self.rank > len(bshape):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        super().__init__(
+            batch_shape=bshape[: len(bshape) - self.rank],
+            event_shape=bshape[len(bshape) - self.rank:] + tuple(base.event_shape),
+        )
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        if self.rank == 0:
+            return lp
+        return lp.sum(axis=tuple(range(-self.rank, 0)))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        if self.rank == 0:
+            return ent
+        return ent.sum(axis=tuple(range(-self.rank, 0)))
+
+
+class TransformedDistribution(Distribution):
+    """ref: transformed_distribution.py — push a base distribution
+    through invertible transforms (objects with forward/inverse/
+    forward_log_det_jacobian; see distribution.transform)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(batch_shape=tuple(base.batch_shape),
+                         event_shape=tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = 0.0
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            lp = lp - t.forward_log_det_jacobian(x)
+            y = x
+        return self.base.log_prob(y) + lp
+
+
+class LKJCholesky(Distribution):
+    """ref: lkj_cholesky.py — prior over Cholesky factors of correlation
+    matrices; onion-method sampling."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion", name=None):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        self.dim = int(dim)
+        self.concentration = _as_array(concentration)
+        self.sample_method = sample_method
+        super().__init__(batch_shape=tuple(self.concentration.shape),
+                         event_shape=(dim, dim))
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        d = self.dim
+        eta = float(np.asarray(jax.device_get(self.concentration._data)).reshape(-1)[0])
+        out_shape = tuple(shape)
+        k1, k2 = jax.random.split(key)
+        # onion method (Lewandowski et al. 2009)
+        beta0 = eta + (d - 2) / 2.0
+        L = np.zeros(out_shape + (d, d), np.float32)
+        L[..., 0, 0] = 1.0
+        rng_keys = jax.random.split(k2, d)
+        for i in range(1, d):
+            beta = beta0 - (i - 1) / 2.0
+            ki, kn = jax.random.split(rng_keys[i])
+            y = np.asarray(jax.random.beta(ki, i / 2.0, beta, out_shape))
+            u = np.asarray(jax.random.normal(kn, out_shape + (i,)))
+            u = u / np.linalg.norm(u, axis=-1, keepdims=True)
+            w = np.sqrt(y)[..., None] * u
+            L[..., i, :i] = w
+            L[..., i, i] = np.sqrt(np.clip(1 - y, 0, 1))
+        from ..base.tensor import Tensor
+
+        return Tensor(jnp.asarray(L), _internal=True)
+
+    def log_prob(self, value):
+        d = self.dim
+
+        def f(L, eta):
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            orders = jnp.arange(2, d + 1, dtype=jnp.float32)
+            unnorm = jnp.sum((d - orders + 2.0 * eta[..., None] - 2.0) * jnp.log(diag), -1)
+            # normalizer (ref lkj_cholesky.py _log_normalizer)
+            alpha = eta[..., None] + 0.5 * (d - orders)
+            lognorm = jnp.sum(
+                0.5 * (orders - 1) * np.log(np.pi)
+                + jax.scipy.special.gammaln(alpha - 0.5 * (orders - 1))
+                - jax.scipy.special.gammaln(alpha),
+                -1,
+            )
+            return unnorm - lognorm
+
+        return apply(f, value, self.concentration, op_name="lkj_log_prob")
+
+
+class Binomial(Distribution):
+    """ref: binomial.py — counts in n trials."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _as_array(total_count, jnp.int32)
+        self.probs = _as_array(probs)
+        shape = np.broadcast_shapes(tuple(self.total_count.shape), tuple(self.probs.shape))
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return apply(lambda n, p: n * p, self.total_count, self.probs, op_name="binom_mean")
+
+    @property
+    def variance(self):
+        return apply(lambda n, p: n * p * (1 - p), self.total_count, self.probs, op_name="binom_var")
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(n, p):
+            return jax.random.binomial(key, n.astype(jnp.float32), p, shape=out_shape)
+
+        out = apply(f, self.total_count, self.probs, op_name="binom_sample")
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        def f(v, n, p):
+            n = n.astype(jnp.float32)
+            comb = (jax.scipy.special.gammaln(n + 1)
+                    - jax.scipy.special.gammaln(v + 1)
+                    - jax.scipy.special.gammaln(n - v + 1))
+            return comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+
+        return apply(f, value, self.total_count, self.probs, op_name="binom_log_prob")
+
+    def entropy(self):
+        """Exact entropy by summing over the support (reference does the
+        same O(n) sum)."""
+
+        def f(n, p):
+            nmax = int(np.asarray(jax.device_get(n)).max())
+            k = jnp.arange(nmax + 1, dtype=jnp.float32)
+            nf = n.astype(jnp.float32)[..., None]
+            comb = (jax.scipy.special.gammaln(nf + 1)
+                    - jax.scipy.special.gammaln(k + 1)
+                    - jax.scipy.special.gammaln(nf - k + 1))
+            logp = comb + k * jnp.log(p[..., None]) + (nf - k) * jnp.log1p(-p[..., None])
+            valid = k <= nf
+            pmf = jnp.where(valid, jnp.exp(logp), 0.0)
+            return -jnp.sum(pmf * jnp.where(valid, logp, 0.0), -1)
+
+        return apply(f, self.total_count, self.probs, op_name="binom_entropy")
+
+
+class Poisson(Distribution):
+    """ref: poisson.py."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _as_array(rate)
+        super().__init__(batch_shape=tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(r):
+            return jax.random.poisson(key, r, shape=out_shape).astype(jnp.float32)
+
+        out = apply(f, self.rate, op_name="poisson_sample")
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        def f(v, r):
+            return v * jnp.log(r) - r - jax.scipy.special.gammaln(v + 1)
+
+        return apply(f, value, self.rate, op_name="poisson_log_prob")
+
+    def entropy(self):
+        """Truncated-support sum (the reference sums to a cutoff too)."""
+
+        def f(r):
+            nmax = int(np.asarray(jax.device_get(r)).max() * 10 + 30)
+            k = jnp.arange(nmax, dtype=jnp.float32)
+            logp = k * jnp.log(r[..., None]) - r[..., None] - jax.scipy.special.gammaln(k + 1)
+            pmf = jnp.exp(logp)
+            return -jnp.sum(pmf * logp, -1)
+
+        return apply(f, self.rate, op_name="poisson_entropy")
+
+
+class StudentT(Distribution):
+    """ref: student_t.py."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _as_array(df)
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        shape = np.broadcast_shapes(
+            tuple(self.df.shape), tuple(self.loc.shape), tuple(self.scale.shape)
+        )
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        def f(df, m):
+            return jnp.where(df > 1, m, jnp.nan)
+
+        return apply(f, self.df, self.loc, op_name="t_mean")
+
+    @property
+    def variance(self):
+        def f(df, s):
+            v = s * s * df / (df - 2)
+            return jnp.where(df > 2, v, jnp.where(df > 1, jnp.inf, jnp.nan))
+
+        return apply(f, self.df, self.scale, op_name="t_var")
+
+    def rsample(self, shape=()):
+        key = self._next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(df, m, s):
+            return m + s * jax.random.t(key, df, out_shape, jnp.float32)
+
+        return apply(f, self.df, self.loc, self.scale, op_name="t_rsample")
+
+    def log_prob(self, value):
+        def f(v, df, m, s):
+            z = (v - m) / s
+            return (jax.scipy.special.gammaln((df + 1) / 2)
+                    - jax.scipy.special.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * np.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+
+        return apply(f, value, self.df, self.loc, self.scale, op_name="t_log_prob")
+
+    def entropy(self):
+        def f(df, s):
+            half = (df + 1) / 2
+            return (jnp.log(s) + 0.5 * jnp.log(df) + 0.5 * np.log(np.pi)
+                    + jax.scipy.special.gammaln(df / 2) - jax.scipy.special.gammaln(half)
+                    + half * (jax.scipy.special.digamma(half) - jax.scipy.special.digamma(df / 2)))
+
+        return apply(f, self.df, self.scale, op_name="t_entropy")

@@ -13,7 +13,7 @@ import numpy as np
 __all__ = [
     "Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
     "LRScheduler", "EarlyStopping", "config_callbacks",
-]
+ "ReduceLROnPlateau", "VisualDL", "WandbCallback",]
 
 
 class Callback:
@@ -245,3 +245,103 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         }
     )
     return cbk_list
+
+
+class ReduceLROnPlateau(Callback):
+    """ref: hapi/callbacks.py ReduceLROnPlateau — scale the optimizer lr
+    when the monitored metric plateaus."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor, self.factor, self.patience = monitor, factor, patience
+        self.verbose, self.min_delta, self.cooldown = verbose, min_delta, cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "min" if "loss" in monitor else "max"
+        self.mode = mode
+        self._best = None
+        self._wait = 0
+        self._cool = 0
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        better = (
+            self._best is None
+            or (self.mode == "min" and cur < self._best - self.min_delta)
+            or (self.mode == "max" and cur > self._best + self.min_delta)
+        )
+        if better:
+            self._best, self._wait = cur, 0
+            return
+        if self._cool > 0:
+            self._cool -= 1
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                new_lr = max(float(opt.get_lr()) * self.factor, self.min_lr)
+                opt.set_lr(new_lr)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
+            self._wait = 0
+            self._cool = self.cooldown
+
+
+class VisualDL(Callback):
+    """ref: hapi/callbacks.py VisualDL. The visualdl package is not
+    bundled; scalars append to <log_dir>/scalars.jsonl (one JSON per
+    step) which visualdl or any plotting tool can ingest."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = {"train": 0, "eval": 0}
+
+    def _write(self, mode, logs):
+        import json as _json
+        import os as _os
+
+        _os.makedirs(self.log_dir, exist_ok=True)
+        rec = {"mode": mode, "step": self._step[mode]}
+        for k, v in (logs or {}).items():
+            try:
+                rec[k] = float(v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+        with open(_os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            f.write(_json.dumps(rec) + "\n")
+        self._step[mode] += 1
+
+    def on_train_batch_end(self, step, logs=None):
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
+class WandbCallback(Callback):
+    """ref: hapi/callbacks.py WandbCallback — requires the wandb
+    package (not bundled); constructing without it raises with
+    guidance."""
+
+    def __init__(self, project=None, run_name=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the 'wandb' package; it is not "
+                "bundled in this environment (no network egress)."
+            ) from e
+        import wandb
+
+        self._run = wandb.init(project=project, name=run_name, **kwargs)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._run.log(dict(logs or {}))

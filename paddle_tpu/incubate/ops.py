@@ -104,7 +104,13 @@ def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                            flag_perm_buffer=False, name=None):
     """Uniform neighbor sampling on a CSC graph (ref:
     incubate/operators/graph_sample_neighbors.py). Host-side numpy."""
-    rng = np.random.RandomState(0)
+    from ..base import random as _random
+
+    # fresh randomness per call, seeded from the framework generator so
+    # paddle.seed reproduces sampling runs
+    rng = np.random.RandomState(
+        int(np.asarray(jax.random.key_data(_random.next_key())).reshape(-1)[-1]) & 0x7FFFFFFF
+    )
     rowv = np.asarray(jax.device_get(row._data if isinstance(row, Tensor) else row)).reshape(-1)
     cp = np.asarray(jax.device_get(colptr._data if isinstance(colptr, Tensor) else colptr)).reshape(-1)
     nodes = np.asarray(jax.device_get(input_nodes._data if isinstance(input_nodes, Tensor) else input_nodes)).reshape(-1)
@@ -155,23 +161,30 @@ def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
 
 def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
                        sorted_eids=None, return_eids=False, name=None):
-    """Multi-hop sampling: repeated sample_neighbors + reindex (ref:
-    incubate/operators/graph_khop_sampler.py)."""
-    frontier = input_nodes
-    all_nb, all_cnt = [], []
-    for size in sample_sizes:
-        nb, cnt = graph_sample_neighbors(row, colptr, frontier, sample_size=size)
-        all_nb.append(nb)
-        all_cnt.append(cnt)
-        frontier = nb
-    nb_cat = np.concatenate([np.asarray(jax.device_get(t._data)).reshape(-1) for t in all_nb])
-    cnt_cat = np.concatenate([np.asarray(jax.device_get(t._data)).reshape(-1) for t in all_cnt])
+    """Multi-hop sampling: repeated sample_neighbors, then one reindex
+    over the union (ref: incubate/operators/graph_khop_sampler.py).
+    The reindex centers are the concatenated per-hop frontiers so every
+    count row has its center."""
     from ..base.tensor import to_tensor
 
+    def _np(t):
+        return np.asarray(jax.device_get(t._data if isinstance(t, Tensor) else t)).reshape(-1)
+
+    frontier = input_nodes
+    centers, all_nb, all_cnt = [], [], []
+    for size in sample_sizes:
+        nb, cnt = graph_sample_neighbors(row, colptr, frontier, sample_size=size)
+        centers.append(_np(frontier))
+        all_nb.append(_np(nb))
+        all_cnt.append(_np(cnt))
+        frontier = nb
+    ctr_cat = np.concatenate(centers).astype(np.int64)
+    nb_cat = np.concatenate(all_nb).astype(np.int64)
+    cnt_cat = np.concatenate(all_cnt).astype(np.int64)
     reindex_nb, reindex_dst, nodes = graph_reindex(
-        input_nodes, to_tensor(nb_cat.astype(np.int64)), to_tensor(cnt_cat.astype(np.int64))
+        to_tensor(ctr_cat), to_tensor(nb_cat), to_tensor(cnt_cat)
     )
-    return reindex_nb, reindex_dst, nodes, to_tensor(cnt_cat.astype(np.int64))
+    return reindex_nb, reindex_dst, nodes, to_tensor(cnt_cat)
 
 
 def softmax_mask_fuse(x, mask, name=None):

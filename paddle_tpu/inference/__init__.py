@@ -1,0 +1,231 @@
+"""paddle.inference parity — the deployment API.
+
+ref: python/paddle/inference/__init__.py (Config/Predictor/
+create_predictor wrapping the C++ AnalysisPredictor). TPU-native
+mapping: a saved model is a StableHLO export (jit.save); Predictor
+loads it (jit.load → TranslatedLayer) and runs it jitted. The
+TensorRT/IR-pass knobs in Config are recorded but XLA owns optimization
+(documented per-method); GPU settings select the accelerator device.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "Config", "DataType", "PlaceType", "PrecisionType", "Tensor",
+    "Predictor", "create_predictor", "get_version", "_get_phi_kernel_name",
+    "get_trt_compile_version", "get_trt_runtime_version",
+    "convert_to_mixed_precision", "get_num_bytes_of_data_type",
+    "PredictorPool", "XpuConfig",
+]
+
+
+class DataType(enum.Enum):
+    FLOAT32 = 0
+    FLOAT16 = 1
+    BFLOAT16 = 2
+    INT8 = 3
+    INT32 = 4
+    INT64 = 5
+    UINT8 = 6
+    BOOL = 7
+
+
+_DT_BYTES = {
+    DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.BFLOAT16: 2,
+    DataType.INT8: 1, DataType.INT32: 4, DataType.INT64: 8,
+    DataType.UINT8: 1, DataType.BOOL: 1,
+}
+
+
+class PlaceType(enum.Enum):
+    CPU = 0
+    GPU = 1  # = the accelerator (TPU) in this build
+    XPU = 2
+    CUSTOM = 3
+
+
+class PrecisionType(enum.Enum):
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+class Config:
+    """ref: inference Config — model path + device/precision knobs."""
+
+    def __init__(self, prog_file: Optional[str] = None, params_file: Optional[str] = None):
+        # jit.save writes a single prefix; accept either spelling
+        self._path = prog_file
+        self._use_accel = False
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+        self._cpu_threads = 1
+        self._enable_memory_optim = True
+
+    def set_prog_file(self, path):
+        self._path = path
+
+    def prog_file(self):
+        return self._path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        """'GPU' selects the accelerator; memory pool sizing is XLA's."""
+        self._use_accel = True
+        self._device_id = device_id
+        self._precision = precision
+
+    def disable_gpu(self):
+        self._use_accel = False
+
+    def use_gpu(self):
+        return self._use_accel
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_threads = n
+
+    def enable_memory_optim(self):
+        self._enable_memory_optim = True
+
+    def enable_tensorrt_engine(self, *a, **k):
+        """TensorRT has no TPU counterpart; XLA already fuses/compiles —
+        recorded as a no-op for ported deployment scripts."""
+
+    def switch_ir_optim(self, flag=True):
+        """IR passes are XLA's job; recorded no-op."""
+
+    def summary(self):
+        return {
+            "model": self._path,
+            "device": "tpu" if self._use_accel else "cpu",
+            "precision": self._precision.name,
+        }
+
+
+class Tensor:
+    """ref: inference Tensor — named feed/fetch handle."""
+
+    def __init__(self, name: str, store: Dict[str, np.ndarray]):
+        self._name = name
+        self._store = store
+
+    def name(self):
+        return self._name
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._store[self._name] = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._store[self._name])
+
+    def reshape(self, shape):
+        if self._name in self._store:
+            self._store[self._name] = self._store[self._name].reshape(shape)
+
+    def shape(self):
+        return list(self._store[self._name].shape)
+
+
+class Predictor:
+    """ref: inference Predictor — run a saved model. Wraps
+    jit.load(TranslatedLayer) with named feed/fetch slots."""
+
+    def __init__(self, config: Config):
+        import paddle_tpu.jit as jit
+
+        if config._path is None:
+            raise ValueError("Config has no model path (set_prog_file)")
+        self._layer = jit.load(config._path)
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+        n_in = getattr(self._layer, "num_inputs", None)
+        self._input_names = [f"x{i}" for i in range(n_in)] if n_in else ["x0"]
+        self._output_names = ["out0"]
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_input_handle(self, name) -> Tensor:
+        return Tensor(name, self._inputs)
+
+    def get_output_handle(self, name) -> Tensor:
+        return Tensor(name, self._outputs)
+
+    def run(self):
+        import paddle_tpu as paddle
+
+        args = [paddle.to_tensor(self._inputs[n]) for n in self._input_names
+                if n in self._inputs]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._output_names = [f"out{i}" for i in range(len(outs))]
+        for n, o in zip(self._output_names, outs):
+            self._outputs[n] = np.asarray(o.numpy())
+        return True
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+class PredictorPool:
+    """ref: inference PredictorPool — N predictors over one model."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._preds = [Predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+
+class XpuConfig:
+    """XPU deployment config — no TPU counterpart; placeholder bag."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def get_version() -> str:
+    import paddle_tpu
+
+    return paddle_tpu.__version__
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    """ref: inference _get_phi_kernel_name — kernels here are XLA
+    fusions; the op name is its own kernel name."""
+    return op_name
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # no TensorRT on TPU
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def get_num_bytes_of_data_type(dtype: DataType) -> int:
+    return _DT_BYTES[dtype]
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=PrecisionType.Half,
+                               backend=PlaceType.GPU, keep_io_types=True,
+                               black_list=None, **kw):
+    """ref: inference convert_to_mixed_precision. StableHLO exports bake
+    dtypes at trace time — re-export the model under amp/bfloat16
+    instead (paddle_tpu.amp.auto_cast + jit.save)."""
+    raise NotImplementedError(
+        "convert_to_mixed_precision operates on ProgramDesc files; with "
+        "StableHLO exports, re-trace the model under paddle_tpu.amp."
+        "auto_cast (bfloat16) and jit.save it instead."
+    )

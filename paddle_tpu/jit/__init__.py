@@ -474,3 +474,33 @@ def load(path, **config):
         buffers = [jnp.asarray(state[k]) for k in meta["buffer_names"]]
         return TranslatedLayer(meta["exported"], params, buffers)
     return state
+
+
+# -- parity sweep (ref: python/paddle/jit/__init__.py remaining) ------------
+_ignored_modules: list = []
+
+
+def ignore_module(modules):
+    """ref: jit/api.py ignore_module — modules whose functions to_static
+    leaves untranslated. jax.jit traces values, not source, so nothing
+    needs rewriting; the list is recorded for introspection parity."""
+    if not isinstance(modules, (list, tuple)):
+        modules = [modules]
+    _ignored_modules.extend(modules)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """ref: jit/dy2static set_code_level — dy2static transformed-code
+    dump verbosity. There is no source transform here (value tracing);
+    maps onto the VLOG level so jit-path logging can be raised."""
+    from ..base import flags as _flags
+
+    _flags.set_flags({"log_level": int(level)})
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """ref: jit/dy2static set_verbosity — same mapping as
+    set_code_level."""
+    from ..base import flags as _flags
+
+    _flags.set_flags({"log_level": int(level)})

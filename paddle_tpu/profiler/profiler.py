@@ -214,3 +214,49 @@ class Profiler:
 
     def export(self, path: Optional[str] = None, format: str = "json"):
         return self._exported_dir
+
+
+class SortedKeys(enum.Enum):
+    """ref: profiler/profiler_statistic.py SortedKeys — summary sort
+    orders."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(enum.Enum):
+    """ref: profiler/profiler.py SummaryView — which summary tables to
+    print."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """ref: profiler.py export_protobuf — on-trace-ready handler writing
+    the profile under ``dir_name``. jax.profiler already emits xplane
+    protobufs, so this is the same handler as export_chrome_tracing with
+    the protobuf layout kept."""
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def load_profiler_result(filename: str):
+    """ref: profiler.py load_profiler_result — load an exported trace.
+    Returns the raw bytes of the xplane/trace file (the reference
+    returns a ProfilerResult handle; the TPU trace is consumed by
+    TensorBoard/Perfetto rather than an in-process reader)."""
+    with open(filename, "rb") as f:
+        return f.read()

@@ -33,7 +33,7 @@ __all__ = [
     "QuantConfig",
     "QAT",
     "QuantedLinear",
-]
+ "BaseQuanter", "BaseObserver", "PTQ",]
 
 
 def fake_quantize_dequantize_abs_max(x, bit_length: int = 8, scale=None):
@@ -227,3 +227,50 @@ class QAT:
                     parent = getattr(parent, p)
                 setattr(parent, parts[-1], lin)
         return model
+
+
+class BaseQuanter(Layer):
+    """ref: quantization/base_quanter.py BaseQuanter — abstract quant
+    transform; subclasses implement forward plus the bit/axis queries."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return 8
+
+
+class BaseObserver(BaseQuanter):
+    """ref: quantization/base_observer.py BaseObserver — a quanter that
+    only collects statistics (PTQ calibration pass)."""
+
+    def forward(self, x):
+        return x
+
+
+class PTQ:
+    """Post-training quantization driver (ref: quantization/ptq.py PTQ):
+    quantize() inserts observers, the user runs calibration batches,
+    convert() folds the observed scales into quant-dequant weights."""
+
+    def __init__(self, q_config: QuantConfig):
+        self.q_config = q_config
+        self._qat = QAT(q_config)
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        m = self._qat.quantize(model, inplace)
+        # observers run in eval mode during calibration
+        m.eval()
+        return m
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        return self._qat.convert(model, inplace)

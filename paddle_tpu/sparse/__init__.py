@@ -310,11 +310,13 @@ def subtract(x, y, name=None):
 
 
 def divide(x, y, name=None):
-    """Dense-semantics divide (0/0 -> nan), matching the reference."""
+    """Dense-semantics divide (0/0 -> nan), matching the reference.
+    Every shared-zero position is NaN, so nse must cover the FULL
+    shape — a tighter bound would silently truncate entries."""
     bx, kind = _coo(x)
     by, _ = _coo(y)
     dense = bx.todense() / by.todense()
-    out = jsparse.BCOO.fromdense(dense, nse=int(bx.nse) + int(by.nse))
+    out = jsparse.BCOO.fromdense(dense, nse=int(np.prod(bx.shape)))
     return _rewrap_dense_aware(out, kind, dense)
 
 

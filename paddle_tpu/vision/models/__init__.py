@@ -38,3 +38,8 @@ def _no_pretrained(name: str, pretrained: bool):
             "egress); convert the reference checkpoint and use "
             "set_state_dict instead"
         )
+from .extra_nets import *  # noqa: F401,F403
+from .resnet import (  # noqa: F401
+    resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+    resnext101_64x4d, resnext152_32x4d, resnext152_64x4d,
+)

@@ -10,7 +10,9 @@ from ... import nn
 __all__ = [
     "ResNet", "BasicBlock", "BottleneckBlock", "resnet18", "resnet34",
     "resnet50", "resnet101", "resnet152", "wide_resnet50_2",
-    "wide_resnet101_2",
+    "wide_resnet101_2", "resnext50_32x4d", "resnext50_64x4d",
+    "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+    "resnext152_64x4d",
 ]
 
 
@@ -184,3 +186,39 @@ def wide_resnet50_2(pretrained=False, **kwargs):
 
 def wide_resnet101_2(pretrained=False, **kwargs):
     return _resnet(101, pretrained, width=128, **kwargs)
+
+
+# -- ResNeXt (ref: python/paddle/vision/models/resnext.py — expressed
+# through ResNet's grouped BottleneckBlock, the reference's own layout) ------
+
+
+def _resnext(depth, groups, width, pretrained, **kwargs):
+    from . import _no_pretrained
+
+    _no_pretrained(f"resnext{depth}_{groups}x{width}d", pretrained)
+    return ResNet(block=BottleneckBlock, depth=depth, groups=groups,
+                  width=width, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnext(50, 32, 4, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext(50, 64, 4, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnext(101, 32, 4, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnext(101, 64, 4, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext(152, 32, 4, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext(152, 64, 4, pretrained, **kwargs)

@@ -647,7 +647,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
     n, num_cls = sc.shape[0], sc.shape[1]
     outs, idxs, nums = [], [], []
     for b in range(n):
-        dets, keep_idx = [], []
+        dets = []
         for c in range(num_cls):
             if c == background_label:
                 continue
@@ -666,21 +666,25 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
             area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
             iou = inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-9)
             iou = np.triu(iou, 1)
-            max_iou = iou.max(0)  # per det: max IoU vs higher scored
-            comp_iou = np.array([iou[:i, :i].max(0).max() if i else 0.0 for i in range(len(boxes))])
+            # SOLO matrix NMS: decay_i = min_j f(iou_ji)/f(comp_j) over
+            # higher-scored j, comp_j = max IoU of j with its own
+            # higher-scored peers — always <= 1
+            m = len(boxes)
+            comp = np.array([iou[:j, j].max() if j else 0.0 for j in range(m)])
             if use_gaussian:
-                decay = np.exp(-(max_iou ** 2 - comp_iou ** 2) / gaussian_sigma)
+                pair = np.exp(-(iou ** 2 - comp[:, None] ** 2) / gaussian_sigma)
             else:
-                decay = (1 - max_iou) / np.maximum(1 - comp_iou, 1e-9)
+                pair = (1 - iou) / np.maximum(1 - comp[:, None], 1e-9)
+            pair = np.where(np.triu(np.ones((m, m), bool), 1), pair, np.inf)
+            decay = np.minimum(pair.min(0), 1.0)
             ds = ss * decay
             keep = ds > post_threshold
             for i in np.nonzero(keep)[0]:
-                dets.append([c, ds[i], *boxes[i]])
-                keep_idx.append(order[i])
-        dets = sorted(dets, key=lambda r: -r[1])[:keep_top_k]
-        outs.extend(dets)
+                dets.append(([c, ds[i], *boxes[i]], order[i]))
+        dets = sorted(dets, key=lambda r: -r[0][1])[:keep_top_k]
+        outs.extend(d for d, _ in dets)
         nums.append(len(dets))
-        idxs.extend(keep_idx[:keep_top_k])
+        idxs.extend(k for _, k in dets)
     out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)), _internal=True)
     rois_num = Tensor(jnp.asarray(np.asarray(nums, np.int32)), _internal=True)
     index = Tensor(jnp.asarray(np.asarray(idxs, np.int32)), _internal=True)

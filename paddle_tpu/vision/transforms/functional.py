@@ -267,3 +267,75 @@ def erase(img, i: int, j: int, h: int, w: int, v, inplace: bool = False):
     out = arr if inplace else arr.copy()
     out[i : i + h, j : j + w] = v
     return _like(img, out)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    """Inverse affine matrix coefficients for PIL (output->input map),
+    matching torchvision/paddle's parameterization."""
+    import math
+
+    rot = math.radians(angle)
+    sx, sy = [math.radians(s) for s in (shear if isinstance(shear, (list, tuple)) else (shear, 0.0))]
+    cx, cy = center
+    tx, ty = translate
+    # RSS = rotation * shear * scale; inverse mapping per torchvision
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    M = [d / scale, -b / scale, 0.0, -c / scale, a / scale, 0.0]
+    M[2] = cx - (M[0] * (cx + tx) + M[1] * (cy + ty))
+    M[5] = cy - (M[3] * (cx + tx) + M[4] * (cy + ty))
+    return M
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine transform (ref: functional.py affine)."""
+    if not _HAS_PIL:
+        raise RuntimeError("affine requires PIL")
+    modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR, "bicubic": Image.BICUBIC}
+    res = modes.get(interpolation, Image.NEAREST)
+
+    def one(im):
+        w, h = im.size
+        c = center if center is not None else (w * 0.5, h * 0.5)
+        M = _affine_matrix(angle, translate, scale, shear, c)
+        return im.transform((w, h), Image.AFFINE, M, resample=res, fillcolor=fill)
+
+    if _is_pil(img):
+        return one(img)
+    arr = _to_np(img)
+    chans = [np.asarray(one(Image.fromarray(arr[:, :, ch]))) for ch in range(arr.shape[2])]
+    return np.stack(chans, axis=2)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Solve the 8-dof homography endpoints -> startpoints (PIL expects
+    the inverse map), ref torchvision _get_perspective_coeffs."""
+    a = np.zeros((8, 8), np.float64)
+    b = np.zeros(8, np.float64)
+    for i, (sp, ep) in enumerate(zip(startpoints, endpoints)):
+        a[2 * i] = [ep[0], ep[1], 1, 0, 0, 0, -sp[0] * ep[0], -sp[0] * ep[1]]
+        a[2 * i + 1] = [0, 0, 0, ep[0], ep[1], 1, -sp[1] * ep[0], -sp[1] * ep[1]]
+        b[2 * i] = sp[0]
+        b[2 * i + 1] = sp[1]
+    return np.linalg.solve(a, b).tolist()
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Perspective transform (ref: functional.py perspective)."""
+    if not _HAS_PIL:
+        raise RuntimeError("perspective requires PIL")
+    modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR, "bicubic": Image.BICUBIC}
+    res = modes.get(interpolation, Image.NEAREST)
+    coeffs = _perspective_coeffs(startpoints, endpoints)
+
+    def one(im):
+        return im.transform(im.size, Image.PERSPECTIVE, coeffs, resample=res, fillcolor=fill)
+
+    if _is_pil(img):
+        return one(img)
+    arr = _to_np(img)
+    chans = [np.asarray(one(Image.fromarray(arr[:, :, ch]))) for ch in range(arr.shape[2])]
+    return np.stack(chans, axis=2)

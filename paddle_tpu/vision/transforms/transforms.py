@@ -316,3 +316,64 @@ class RandomErasing(BaseTransform):
                 left = int(rng.integers(0, w - ew + 1))
                 return F.erase(img, top, left, eh, ew, self.value, self.inplace)
         return img
+
+
+class RandomAffine(BaseTransform):
+    """ref: transforms.py RandomAffine — random rotation/translate/
+    scale/shear."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(degrees, (int, float)) else tuple(degrees)
+        self.translate, self.scale_rng, self.shear = translate, scale, shear
+        self.interpolation, self.fill, self.center = interpolation, fill, center
+
+    def _apply_image(self, img):
+        import random as _r
+
+        from . import functional as F
+
+        angle = _r.uniform(*self.degrees)
+        w, h = (img.size if hasattr(img, "size") else (img.shape[1], img.shape[0]))
+        if self.translate is not None:
+            tx = _r.uniform(-self.translate[0], self.translate[0]) * w
+            ty = _r.uniform(-self.translate[1], self.translate[1]) * h
+        else:
+            tx = ty = 0.0
+        scale = _r.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        if self.shear is not None:
+            sh = self.shear if isinstance(self.shear, (list, tuple)) else (-self.shear, self.shear)
+            shear = _r.uniform(sh[0], sh[1])
+        else:
+            shear = 0.0
+        return F.affine(img, angle, (tx, ty), scale, shear,
+                        self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """ref: transforms.py RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5, interpolation="nearest",
+                 fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.distortion_scale = prob, distortion_scale
+        self.interpolation, self.fill = interpolation, fill
+
+    def _apply_image(self, img):
+        import random as _r
+
+        from . import functional as F
+
+        if _r.random() >= self.prob:
+            return img
+        w, h = (img.size if hasattr(img, "size") else (img.shape[1], img.shape[0]))
+        d = self.distortion_scale
+        half_w, half_h = w // 2, h // 2
+        tl = (_r.randint(0, int(d * half_w)), _r.randint(0, int(d * half_h)))
+        tr = (w - 1 - _r.randint(0, int(d * half_w)), _r.randint(0, int(d * half_h)))
+        br = (w - 1 - _r.randint(0, int(d * half_w)), h - 1 - _r.randint(0, int(d * half_h)))
+        bl = (_r.randint(0, int(d * half_w)), h - 1 - _r.randint(0, int(d * half_h)))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [tl, tr, br, bl]
+        return F.perspective(img, start, end, self.interpolation, self.fill)

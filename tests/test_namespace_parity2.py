@@ -1,0 +1,250 @@
+"""Second namespace batch: distributions vs scipy, model zoo shapes and
+parameter counts, transforms, datasets, device/static/inference utils."""
+import ast
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+from scipy import stats
+
+import paddle_tpu as paddle
+import paddle_tpu.vision.models as M
+
+_MODS = {
+    "vision.transforms": "/root/reference/python/paddle/vision/transforms/__init__.py",
+    "vision.models": "/root/reference/python/paddle/vision/models/__init__.py",
+    "vision.datasets": "/root/reference/python/paddle/vision/datasets/__init__.py",
+    "vision": "/root/reference/python/paddle/vision/__init__.py",
+    "text": "/root/reference/python/paddle/text/__init__.py",
+    "distribution": "/root/reference/python/paddle/distribution/__init__.py",
+    "device": "/root/reference/python/paddle/device/__init__.py",
+    "profiler": "/root/reference/python/paddle/profiler/__init__.py",
+    "callbacks": "/root/reference/python/paddle/callbacks.py",
+    "quantization": "/root/reference/python/paddle/quantization/__init__.py",
+    "jit": "/root/reference/python/paddle/jit/__init__.py",
+    "inference": "/root/reference/python/paddle/inference/__init__.py",
+    "onnx": "/root/reference/python/paddle/onnx/__init__.py",
+    "utils": "/root/reference/python/paddle/utils/__init__.py",
+}
+
+
+def _ref_all(path):
+    names = []
+    for node in ast.walk(ast.parse(open(path).read())):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    try:
+                        names = [ast.literal_eval(e) for e in node.value.elts]
+                    except Exception:
+                        pass
+        if isinstance(node, ast.AugAssign) and getattr(node.target, "id", None) == "__all__":
+            try:
+                names += [ast.literal_eval(e) for e in node.value.elts]
+            except Exception:
+                pass
+    return names
+
+
+@pytest.mark.parametrize("ns,path", sorted(_MODS.items()))
+def test_namespace_complete(ns, path):
+    mod = paddle
+    for part in ns.split("."):
+        mod = getattr(mod, part)
+    missing = [n for n in _ref_all(path) if not hasattr(mod, n)]
+    assert not missing, f"{ns} missing {missing}"
+
+
+class TestDistributions:
+    def test_cauchy_chi2_studentt_match_scipy(self):
+        D = paddle.distribution
+        c = D.Cauchy(1.0, 2.0)
+        np.testing.assert_allclose(
+            float(c.log_prob(paddle.to_tensor(3.0))), stats.cauchy.logpdf(3.0, 1.0, 2.0), rtol=1e-5
+        )
+        np.testing.assert_allclose(float(c.entropy()), stats.cauchy.entropy(1.0, 2.0), rtol=1e-5)
+        chi = D.Chi2(3.0)
+        np.testing.assert_allclose(
+            float(chi.log_prob(paddle.to_tensor(2.0))), stats.chi2.logpdf(2.0, 3), rtol=1e-5
+        )
+        t = D.StudentT(5.0, 1.0, 2.0)
+        np.testing.assert_allclose(
+            float(t.log_prob(paddle.to_tensor(2.0))), stats.t.logpdf(2.0, 5, 1.0, 2.0), rtol=1e-5
+        )
+        np.testing.assert_allclose(float(t.entropy()), stats.t.entropy(5, 1.0, 2.0), rtol=1e-5)
+
+    def test_poisson_binomial_match_scipy(self):
+        D = paddle.distribution
+        po = D.Poisson(3.0)
+        np.testing.assert_allclose(
+            float(po.log_prob(paddle.to_tensor(2.0))), stats.poisson.logpmf(2, 3.0), rtol=1e-5
+        )
+        bi = D.Binomial(10, 0.3)
+        np.testing.assert_allclose(
+            float(bi.log_prob(paddle.to_tensor(4.0))), stats.binom.logpmf(4, 10, 0.3), rtol=1e-5
+        )
+        np.testing.assert_allclose(float(bi.entropy()), stats.binom.entropy(10, 0.3), rtol=1e-4)
+
+    def test_mvn_matches_scipy(self):
+        D = paddle.distribution
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        mvn = D.MultivariateNormal(
+            paddle.to_tensor(np.zeros(2, np.float32)), covariance_matrix=paddle.to_tensor(cov)
+        )
+        np.testing.assert_allclose(
+            float(mvn.log_prob(paddle.to_tensor(np.array([1.0, 0.5], np.float32)))),
+            stats.multivariate_normal.logpdf([1.0, 0.5], np.zeros(2), cov), rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            float(mvn.entropy()), stats.multivariate_normal.entropy(np.zeros(2), cov), rtol=1e-5
+        )
+        paddle.seed(0)
+        s = mvn.rsample([2000])
+        np.testing.assert_allclose(np.cov(s.numpy().T), cov, atol=0.25)
+
+    def test_independent_and_lkj(self):
+        D = paddle.distribution
+        base = D.Normal(
+            paddle.to_tensor(np.zeros((3, 4), np.float32)),
+            paddle.to_tensor(np.ones((3, 4), np.float32)),
+        )
+        ind = D.Independent(base, 1)
+        assert ind.event_shape == [4]
+        lp = ind.log_prob(paddle.to_tensor(np.zeros((3, 4), np.float32)))
+        assert tuple(lp.shape) == (3,)
+        paddle.seed(1)
+        lkj = D.LKJCholesky(3, 1.5)
+        L = lkj.sample()
+        corr = L.numpy() @ L.numpy().T
+        np.testing.assert_allclose(np.diag(corr), 1.0, rtol=1e-5)
+
+    def test_grad_through_mvn_log_prob(self):
+        D = paddle.distribution
+        loc = paddle.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+        mvn = D.MultivariateNormal(loc, covariance_matrix=paddle.to_tensor(np.eye(2, dtype=np.float32)))
+        mvn.log_prob(paddle.to_tensor(np.array([1.0, 2.0], np.float32))).backward()
+        np.testing.assert_allclose(loc.grad.numpy(), [1.0, 2.0], rtol=1e-5)
+
+
+class TestModelZoo:
+    @pytest.mark.parametrize("fn,params_m", [
+        (M.mobilenet_v3_small, 1.53),
+        (M.squeezenet1_1, 0.73),
+        (M.shufflenet_v2_x0_5, 0.35),
+    ])
+    def test_forward_and_param_count(self, fn, params_m):
+        paddle.seed(0)
+        m = fn(num_classes=10)
+        m.eval()
+        y = m(paddle.randn([1, 3, 32, 32]))
+        assert tuple(y.shape) == (1, 10)
+        n = sum(int(np.prod(p.shape)) for p in m.parameters()) / 1e6
+        assert abs(n - params_m) / params_m < 0.05, n
+
+    def test_resnext_is_grouped(self):
+        m = M.resnext50_32x4d(num_classes=10)
+        n = sum(int(np.prod(p.shape)) for p in m.parameters()) / 1e6
+        assert 22 < n < 24  # 23.0M at 10 classes (25.0M at 1000)
+
+    def test_densenet_structure(self):
+        m = M.densenet121(num_classes=10)
+        n = sum(int(np.prod(p.shape)) for p in m.parameters()) / 1e6
+        assert 6.8 < n < 7.1
+
+
+class TestTransformsAndDatasets:
+    def test_affine_perspective(self):
+        from PIL import Image
+
+        import paddle_tpu.vision.transforms as T
+        import paddle_tpu.vision.transforms.functional as F
+
+        img = Image.fromarray(np.arange(192, dtype=np.uint8).reshape(8, 8, 3))
+        out = F.affine(img, 30, (1, 1), 1.2, 5.0, "bilinear")
+        assert np.asarray(out).shape == (8, 8, 3)
+        out = F.perspective(img, [(0, 0), (7, 0), (7, 7), (0, 7)],
+                            [(1, 0), (7, 1), (6, 7), (0, 6)])
+        assert np.asarray(out).shape == (8, 8, 3)
+        ra = T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1), shear=5)
+        assert np.asarray(ra(img)).shape == (8, 8, 3)
+        rp = T.RandomPerspective(prob=1.0)
+        assert np.asarray(rp(img)).shape == (8, 8, 3)
+
+    def test_dataset_folder(self):
+        from PIL import Image
+
+        from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+        root = tempfile.mkdtemp()
+        for cls in ("cat", "dog"):
+            os.makedirs(os.path.join(root, cls))
+            for i in range(2):
+                Image.fromarray(
+                    np.zeros((4, 4, 3), np.uint8)
+                ).save(os.path.join(root, cls, f"{i}.png"))
+        ds = DatasetFolder(root)
+        assert len(ds) == 4 and ds.classes == ["cat", "dog"]
+        img, label = ds[0]
+        assert label == 0
+        flat = ImageFolder(root)
+        assert len(flat) == 4
+
+
+class TestMiscUtils:
+    def test_device_queries(self):
+        import paddle_tpu.device as dev
+
+        assert dev.is_compiled_with_distribute()
+        assert "cpu" in dev.get_all_device_type()
+        assert dev.get_cudnn_version() is None
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert "works" in capsys.readouterr().out
+
+    def test_inference_predictor_roundtrip(self):
+        import paddle_tpu.inference as infer
+        import paddle_tpu.jit as jit
+        import paddle_tpu.nn as nn
+        import paddle_tpu.static as static
+
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        m.eval()
+        d = tempfile.mkdtemp()
+        prefix = os.path.join(d, "model")
+        jit.save(m, prefix, input_spec=[static.InputSpec([1, 4], "float32")])
+        cfg = infer.Config(prefix)
+        pred = infer.create_predictor(cfg)
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        x = np.ones((1, 4), np.float32)
+        h.copy_from_cpu(x)
+        assert pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        want = m(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_lookahead_modelaverage(self):
+        import paddle_tpu.incubate as inc
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+
+        paddle.seed(0)
+        m = nn.Linear(4, 4)
+        la = inc.LookAhead(opt.SGD(learning_rate=0.1, parameters=m.parameters()), k=2)
+        losses = []
+        for _ in range(6):
+            loss = ((m(paddle.ones([2, 4])) - 1.0) ** 2).mean()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        ma = inc.ModelAverage(0.15, parameters=list(m.parameters()))
+        ma.step()
+        before = np.asarray(m.weight._data).copy()
+        ma.apply()
+        ma.restore()
+        np.testing.assert_allclose(np.asarray(m.weight._data), before)
