@@ -78,7 +78,7 @@ def main():
         logits = model(ids)
         b, s, v = logits.shape
         loss = F.cross_entropy(
-            M.reshape(logits, [b * s, v]).astype("float32"), M.reshape(labels, [b * s])
+            M.reshape(logits, [b * s, v]), M.reshape(labels, [b * s])
         )
         loss.backward()
         opt.step()

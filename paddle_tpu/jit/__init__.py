@@ -449,6 +449,10 @@ class TranslatedLayer:
         self._exp = jax_export.deserialize(exported)
         self._params = params
         self._buffers = buffers
+        # data-input arity = exported args minus the params/buffers trees
+        # (the inference Predictor sizes its feed slots from this)
+        n_state = len(tree_util.tree_leaves((params, buffers)))
+        self.num_inputs = max(len(self._exp.in_avals) - n_state, 1)
 
     def __call__(self, *xs):
         arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs]

@@ -51,10 +51,12 @@ def cross_entropy(
     def _f(logits, lbl, *maybe_w):
         ax = axis % logits.ndim
         num_classes = logits.shape[ax]
-        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax else jnp.log(
-            jnp.clip(logits, 1e-15, 1.0)
-        )
-        if soft_label or (lbl.ndim == logits.ndim and lbl.shape[ax] == num_classes and np.dtype(lbl.dtype).kind == "f"):
+        hard = not (soft_label or (lbl.ndim == logits.ndim and lbl.shape[ax] == num_classes and np.dtype(lbl.dtype).kind == "f"))
+        if not hard or not use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax else jnp.log(
+                jnp.clip(logits, 1e-15, 1.0)
+            )
+        if not hard:
             soft = lbl
             if label_smoothing > 0:
                 soft = soft * (1 - label_smoothing) + label_smoothing / num_classes
@@ -67,14 +69,32 @@ def cross_entropy(
             ids = ids.astype(jnp.int32)
             valid = ids != ignore_index
             safe_ids = jnp.where(valid, ids, 0)
-            picked = jnp.take_along_axis(
-                logp, jnp.expand_dims(safe_ids, ax), axis=ax
-            ).squeeze(ax)
-            if label_smoothing > 0:
-                smooth_term = jnp.mean(logp, axis=ax)
-                loss = -(1 - label_smoothing) * picked - label_smoothing * smooth_term
+            if use_softmax:
+                # logsumexp-gather form: -logp[y] = lse - logits[y].
+                # Avoids materializing the [*, num_classes] log-softmax
+                # array (for an LM head that array is tokens x vocab in
+                # f32 — the dominant HBM traffic of the loss)
+                lse = jax.scipy.special.logsumexp(
+                    logits.astype(jnp.float32), axis=ax
+                )
+                picked = jnp.take_along_axis(
+                    logits, jnp.expand_dims(safe_ids, ax), axis=ax
+                ).squeeze(ax).astype(jnp.float32)
+                if label_smoothing > 0:
+                    mean_logit = jnp.mean(logits.astype(jnp.float32), axis=ax)
+                    loss = ((1 - label_smoothing) * (lse - picked)
+                            + label_smoothing * (lse - mean_logit))
+                else:
+                    loss = lse - picked
             else:
-                loss = -picked
+                picked = jnp.take_along_axis(
+                    logp, jnp.expand_dims(safe_ids, ax), axis=ax
+                ).squeeze(ax)
+                if label_smoothing > 0:
+                    smooth_term = jnp.mean(logp, axis=ax)
+                    loss = -(1 - label_smoothing) * picked - label_smoothing * smooth_term
+                else:
+                    loss = -picked
             loss = jnp.where(valid, loss, 0.0)
             if maybe_w:
                 w = maybe_w[0][safe_ids]

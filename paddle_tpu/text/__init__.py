@@ -262,20 +262,26 @@ class Imikolov(_LocalFileDataset):
     def _load(self, data_file, mode, **kw):
         from collections import Counter
 
-        path = f"./simple-examples/data/ptb.{'train' if mode == 'train' else 'valid'}.txt"
-        with tarfile.open(data_file, "r:*") as tf:
-            text = tf.extractfile(path).read().decode()
+        def read(split):
+            path = f"./simple-examples/data/ptb.{split}.txt"
+            with tarfile.open(data_file, "r:*") as tf:
+                text = tf.extractfile(path).read().decode()
+            return [line.strip().split() for line in text.splitlines()]
+
+        # vocab always comes from the TRAIN split (the reference's
+        # build_dict does too) so train/valid instances share ids, and
+        # <s>/<e> are counted once per line so they get real ids
+        train_lines = read("train")
         freq = Counter()
-        lines = []
-        for line in text.splitlines():
-            toks = line.strip().split()
-            lines.append(toks)
+        for toks in train_lines:
             freq.update(toks)
+            freq.update(["<s>", "<e>"])
         vocab = {w: i for i, (w, c) in enumerate(
             sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
         ) if c >= self.min_word_freq}
         unk = len(vocab)
         self.word_idx = vocab
+        lines = train_lines if mode == "train" else read("valid")
         out = []
         for toks in lines:
             ids = [vocab.get(t, unk) for t in ["<s>"] + toks + ["<e>"]]

@@ -399,7 +399,6 @@ class GoogLeNet(nn.Layer):
             self.pool5 = nn.AdaptiveAvgPool2D(1)
         if num_classes > 0:
             self.fc = nn.Linear(1024, num_classes)
-            self.aux1 = nn.Sequential(nn.AdaptiveAvgPool2D(4))
             self.aux1_conv = nn.Conv2D(512, 128, 1)
             self.aux1_fc1 = nn.Linear(128 * 16, 1024)
             self.aux1_fc2 = nn.Linear(1024, num_classes)

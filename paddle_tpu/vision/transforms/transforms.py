@@ -330,21 +330,20 @@ class RandomAffine(BaseTransform):
         self.interpolation, self.fill, self.center = interpolation, fill, center
 
     def _apply_image(self, img):
-        import random as _r
-
         from . import functional as F
 
-        angle = _r.uniform(*self.degrees)
+        r = _rng()  # framework-seeded: paddle.seed reproduces pipelines
+        angle = r.uniform(*self.degrees)
         w, h = (img.size if hasattr(img, "size") else (img.shape[1], img.shape[0]))
         if self.translate is not None:
-            tx = _r.uniform(-self.translate[0], self.translate[0]) * w
-            ty = _r.uniform(-self.translate[1], self.translate[1]) * h
+            tx = r.uniform(-self.translate[0], self.translate[0]) * w
+            ty = r.uniform(-self.translate[1], self.translate[1]) * h
         else:
             tx = ty = 0.0
-        scale = _r.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        scale = r.uniform(*self.scale_rng) if self.scale_rng else 1.0
         if self.shear is not None:
             sh = self.shear if isinstance(self.shear, (list, tuple)) else (-self.shear, self.shear)
-            shear = _r.uniform(sh[0], sh[1])
+            shear = r.uniform(sh[0], sh[1])
         else:
             shear = 0.0
         return F.affine(img, angle, (tx, ty), scale, shear,
@@ -361,19 +360,19 @@ class RandomPerspective(BaseTransform):
         self.interpolation, self.fill = interpolation, fill
 
     def _apply_image(self, img):
-        import random as _r
-
         from . import functional as F
 
-        if _r.random() >= self.prob:
+        r = _rng()
+        if r.random() >= self.prob:
             return img
         w, h = (img.size if hasattr(img, "size") else (img.shape[1], img.shape[0]))
         d = self.distortion_scale
         half_w, half_h = w // 2, h // 2
-        tl = (_r.randint(0, int(d * half_w)), _r.randint(0, int(d * half_h)))
-        tr = (w - 1 - _r.randint(0, int(d * half_w)), _r.randint(0, int(d * half_h)))
-        br = (w - 1 - _r.randint(0, int(d * half_w)), h - 1 - _r.randint(0, int(d * half_h)))
-        bl = (_r.randint(0, int(d * half_w)), h - 1 - _r.randint(0, int(d * half_h)))
+        ri = lambda hi: int(r.integers(0, max(hi, 1)))
+        tl = (ri(int(d * half_w)), ri(int(d * half_h)))
+        tr = (w - 1 - ri(int(d * half_w)), ri(int(d * half_h)))
+        br = (w - 1 - ri(int(d * half_w)), h - 1 - ri(int(d * half_h)))
+        bl = (ri(int(d * half_w)), h - 1 - ri(int(d * half_h)))
         start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
         end = [tl, tr, br, bl]
         return F.perspective(img, start, end, self.interpolation, self.fill)
