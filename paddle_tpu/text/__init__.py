@@ -262,11 +262,18 @@ class Imikolov(_LocalFileDataset):
     def _load(self, data_file, mode, **kw):
         from collections import Counter
 
+        with tarfile.open(data_file, "r:*") as tf:
+            def read(split, _tf=tf):
+                path = f"./simple-examples/data/ptb.{split}.txt"
+                text = _tf.extractfile(path).read().decode()
+                return [line.strip().split() for line in text.splitlines()]
+
+            splits = {"train": read("train")}
+            if mode != "train":
+                splits["valid"] = read("valid")
+
         def read(split):
-            path = f"./simple-examples/data/ptb.{split}.txt"
-            with tarfile.open(data_file, "r:*") as tf:
-                text = tf.extractfile(path).read().decode()
-            return [line.strip().split() for line in text.splitlines()]
+            return splits[split]
 
         # vocab always comes from the TRAIN split (the reference's
         # build_dict does too) so train/valid instances share ids, and

@@ -368,7 +368,7 @@ class RandomPerspective(BaseTransform):
         w, h = (img.size if hasattr(img, "size") else (img.shape[1], img.shape[0]))
         d = self.distortion_scale
         half_w, half_h = w // 2, h // 2
-        ri = lambda hi: int(r.integers(0, max(hi, 1)))
+        ri = lambda hi: int(r.integers(0, hi + 1))  # inclusive, like randint
         tl = (ri(int(d * half_w)), ri(int(d * half_h)))
         tr = (w - 1 - ri(int(d * half_w)), ri(int(d * half_h)))
         br = (w - 1 - ri(int(d * half_w)), h - 1 - ri(int(d * half_h)))

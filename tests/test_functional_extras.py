@@ -1,0 +1,125 @@
+"""Coverage for the long-tail functionals: hsigmoid, adaptive
+log-softmax, sequence_mask, temporal_shift, fractional pooling, varlen
+attention, feature alpha dropout statistics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestHSigmoid:
+    def test_loss_decreases_under_training(self):
+        import paddle_tpu.optimizer as opt
+
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(16, 8)
+        emb = nn.Linear(4, 16)
+        o = opt.Adam(learning_rate=1e-2,
+                     parameters=list(layer.parameters()) + list(emb.parameters()))
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 8, (16,)).astype(np.int64))
+        losses = []
+        for _ in range(15):
+            loss = layer(emb(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+
+class TestAdaptiveLogSoftmax:
+    def test_log_prob_normalizes_and_matches_loss(self):
+        paddle.seed(0)
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, [5, 10])
+        x = paddle.randn([8, 16])
+        y = paddle.to_tensor(np.random.RandomState(0).randint(0, 20, (8,)).astype(np.int64))
+        out, loss = m(x, y)
+        lp = m.log_prob(x)
+        np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1), 1.0, rtol=1e-4)
+        np.testing.assert_allclose(
+            out.numpy(), lp.numpy()[np.arange(8), y.numpy()], rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(float(loss), -out.numpy().mean(), rtol=1e-5)
+        pred = m.predict(x)
+        assert tuple(pred.shape) == (8,)
+
+
+class TestSequenceOps:
+    def test_sequence_mask(self):
+        m = F.sequence_mask(paddle.to_tensor(np.array([1, 3], np.int32)), maxlen=4)
+        assert m.numpy().tolist() == [[1, 0, 0, 0], [1, 1, 1, 0]]
+
+    def test_temporal_shift_moves_channels(self):
+        x = np.zeros((4, 8, 1, 1), np.float32)  # N*T=4 (T=2), C=8
+        x[0, :, 0, 0] = 1.0  # segment 0, t=0
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2, shift_ratio=0.25).numpy()
+        # first quarter channels shift backward: t=0 receives t=1 (zeros)
+        assert out[0, 0, 0, 0] == 0.0
+        # second quarter shift forward: t=1 receives t=0's value
+        assert out[1, 2, 0, 0] == 1.0
+        # the rest stay
+        assert out[0, 4, 0, 0] == 1.0
+
+
+class TestFractionalPool:
+    def test_2d_covers_input_and_matches_manual(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 1, 9, 9).astype(np.float32)
+        out, mask = F.fractional_max_pool2d(
+            paddle.to_tensor(x), 3, random_u=0.4, return_mask=True
+        )
+        assert tuple(out.shape) == (1, 1, 3, 3)
+        # every output value must be the max of some region -> appears in x
+        for v in out.numpy().reshape(-1):
+            assert v in x
+        # mask points at the argmax positions
+        flat = x.reshape(1, 1, -1)
+        np.testing.assert_allclose(
+            np.take_along_axis(flat, mask.numpy().reshape(1, 1, -1), -1).reshape(3, 3),
+            out.numpy().reshape(3, 3),
+        )
+
+    def test_3d_shape(self):
+        x = paddle.randn([1, 2, 8, 8, 8])
+        out = F.fractional_max_pool3d(x, 2, random_u=0.3)
+        assert tuple(out.shape) == (1, 2, 2, 2, 2)
+
+
+class TestVarlenAttention:
+    def test_blocks_cross_sequence_attention(self):
+        paddle.seed(0)
+        total, H, D = 6, 2, 8
+        qkv_np = np.random.RandomState(0).randn(total, 3, H, D).astype(np.float32)
+        cu = paddle.to_tensor(np.array([0, 4, 6], np.int32))
+        out = F.flash_attn_varlen_qkvpacked(paddle.to_tensor(qkv_np), cu, cu, 4, 4)
+        # manual: run the two sequences separately through SDPA
+        def naive(seg):
+            q = qkv_np[seg, 0][None]  # [1, s, H, D]
+            k = qkv_np[seg, 1][None]
+            v = qkv_np[seg, 2][None]
+            o = F.scaled_dot_product_attention(
+                paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v)
+            )
+            return o.numpy()[0]
+
+        want = np.concatenate([naive(slice(0, 4)), naive(slice(4, 6))])
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)
+
+
+class TestFeatureAlphaDropout:
+    def test_preserves_mean_and_variance(self):
+        paddle.seed(0)
+        x = paddle.randn([256, 64, 16])
+        out = F.feature_alpha_dropout(x, 0.5, training=True)
+        # self-normalizing contract: mean ~0, var ~1 for standard input
+        assert abs(float(out.mean())) < 0.05
+        assert abs(float(out.numpy().var()) - 1.0) < 0.15
+
+    def test_eval_is_identity(self):
+        x = paddle.randn([4, 8, 2])
+        out = F.feature_alpha_dropout(x, 0.5, training=False)
+        np.testing.assert_allclose(out.numpy(), x.numpy())
