@@ -292,7 +292,8 @@ class PipelineLayer(nn.Layer):
 
         return tape.apply(seq, h, *self._stacked, op_name="pipeline_sequential")
 
-    def _forward_body_pipelined(self, h: Tensor, mesh, num_micro: int) -> Tensor:
+    def _forward_body_pipelined(self, h: Tensor, mesh, num_micro: int,
+                                dp_axis=None) -> Tensor:
         """SPMD pipeline over the pp axis; ``h`` is [M*mb, ...].
 
         Interleaved tick schedule (reduces to classic fill-drain at V=1):
@@ -303,6 +304,10 @@ class PipelineLayer(nn.Layer):
         S, V = self._num_stages, self._num_virtual
         M = num_micro
         mb = h.shape[0] // M
+        if dp_axis is not None and mb % dict(mesh.shape)[dp_axis] != 0:
+            # this batch's microbatch size doesn't divide dp; run the
+            # pipeline without the dp sharding rather than erroring
+            dp_axis = None
         h_stream = tape.apply(
             lambda x: x.reshape((M, mb) + tuple(x.shape[1:])), h, op_name="microbatch_split"
         )
@@ -357,9 +362,14 @@ class PipelineLayer(nn.Layer):
                     jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)), "pp"
                 )
 
-            in_specs = (P(),) + tuple(P("pp") for _ in stacked)
+            # dp x pp hybrid: batch-within-microbatch dim sharded over
+            # dp; stacked params replicated over dp (their grads psum
+            # over dp via the shard_map transpose)
+            x_spec = P(None, dp_axis) if dp_axis else P()
+            in_specs = (x_spec,) + tuple(P("pp") for _ in stacked)
             return jax.shard_map(
-                spmd, mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False
+                spmd, mesh=mesh, in_specs=in_specs, out_specs=x_spec,
+                check_vma=False,
             )(xs, *stacked)
 
         out_stream = tape.apply(
@@ -371,12 +381,13 @@ class PipelineLayer(nn.Layer):
             op_name="microbatch_merge",
         )
 
-    def forward(self, x, num_micro: Optional[int] = None, mesh=None):
+    def forward(self, x, num_micro: Optional[int] = None, mesh=None,
+                dp_axis=None):
         h = x
         for l in self._pre:
             h = l(h)
         if self._num_stages > 1 and num_micro is not None and mesh is not None:
-            h = self._forward_body_pipelined(h, mesh, num_micro)
+            h = self._forward_body_pipelined(h, mesh, num_micro, dp_axis)
         else:
             h = self._forward_body_sequential(h)
         for l in self._post:
@@ -397,13 +408,21 @@ class PipelineParallel:
         cfg = getattr(strategy, "pipeline_configs", {}) if strategy else {}
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self._mesh = hcg.mesh
-        other = 1
+        self._dp_axis = None
         for name, size in dict(self._mesh.shape).items():
-            if name != "pp":
-                other *= size
-        if other > 1:
-            # pipelined shard_map path currently binds only the pp axis
-            self._mesh = None
+            if name == "pp" or size <= 1:
+                continue
+            if name == "dp":
+                # dp x pp hybrid: the shard_map binds both axes — batch
+                # sharded over dp, stages over pp, grads psum over dp
+                # via the shard_map transpose
+                self._dp_axis = name
+            else:
+                # tp/sep inside the pipelined region would need the
+                # stage body to emit explicit collectives; fall back
+                self._mesh = None
+                self._dp_axis = None
+                break
         self._compiled = {}
         self._place_stacked()
 
@@ -445,7 +464,8 @@ class PipelineParallel:
 
             def step(xx, yy):
                 logits = layers.forward(
-                    xx, num_micro=self.accumulate_steps, mesh=self._mesh
+                    xx, num_micro=self.accumulate_steps, mesh=self._mesh,
+                    dp_axis=self._dp_axis,
                 )
                 loss = layers._loss_fn(logits, yy)
                 if scaler is not None:

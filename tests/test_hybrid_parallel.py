@@ -351,6 +351,105 @@ class TestPipelineParallel:
         y = pipe(x)  # sequential fallback must run without a mesh
         assert tuple(y.shape) == (4, 8)
 
+    def test_dp_pp_hybrid_matches_serial(self):
+        """dp=2 x pp=4 hybrid: batch sharded over dp inside the same
+        shard_map as the pipeline; losses must still match serial."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc,
+            PipelineLayer,
+            PipelineParallel,
+        )
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "pp_degree": 4}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        hcg = fleet.init(strategy=strategy)
+        try:
+            H, C, MB, M = 16, 4, 4, 2  # MB divisible by dp=2
+
+            def loss_fn(logits, y):
+                return F.cross_entropy(logits, y)
+
+            paddle.seed(31)
+            pipe = PipelineLayer(
+                layers=[LayerDesc(Block, H) for _ in range(8)] + [nn.Linear(H, C)],
+                num_stages=4,
+                loss_fn=loss_fn,
+            )
+            serial_blocks = [Block(H) for _ in range(8)]
+            for s in range(4):
+                for i in range(2):
+                    blk = serial_blocks[s * 2 + i]
+                    blk.fc.weight.set_value(
+                        paddle.to_tensor(np.asarray(pipe._stacked[2 * i]._data[s]))
+                    )
+                    blk.fc.bias.set_value(
+                        paddle.to_tensor(np.asarray(pipe._stacked[2 * i + 1]._data[s]))
+                    )
+            serial_head = nn.Linear(H, C)
+            serial_head.weight.set_value(pipe._post[0].weight)
+            serial_head.bias.set_value(pipe._post[0].bias)
+
+            pp_model = PipelineParallel(pipe, hcg, strategy)
+            assert pp_model._mesh is not None and pp_model._dp_axis == "dp"
+            pp_opt = opt.SGD(learning_rate=0.1, parameters=pipe.parameters())
+            serial_params = [p for b in serial_blocks for p in b.parameters()] + list(
+                serial_head.parameters()
+            )
+            serial_opt = opt.SGD(learning_rate=0.1, parameters=serial_params)
+
+            rng = np.random.RandomState(13)
+            for step in range(3):
+                x_np = rng.randn(M * MB, H).astype(np.float32)
+                y_np = rng.randint(0, C, (M * MB,)).astype(np.int64)
+                loss_pp = pp_model.train_batch(
+                    (paddle.to_tensor(x_np), paddle.to_tensor(y_np)), pp_opt
+                )
+                h = paddle.to_tensor(x_np)
+                for b in serial_blocks:
+                    h = b(h)
+                loss_serial = loss_fn(serial_head(h), paddle.to_tensor(y_np))
+                loss_serial.backward()
+                serial_opt.step()
+                serial_opt.clear_grad()
+                np.testing.assert_allclose(
+                    float(loss_pp), float(loss_serial), rtol=2e-5, atol=1e-6
+                )
+        finally:
+            dist.destroy_process_group()
+            fleet.set_hybrid_communicate_group(None)
+
+    def test_dp_pp_hybrid_odd_microbatch_falls_back(self):
+        """mb not divisible by dp must run (unsharded) instead of raising."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc,
+            PipelineLayer,
+            PipelineParallel,
+        )
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "pp_degree": 4}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        hcg = fleet.init(strategy=strategy)
+        try:
+            paddle.seed(41)
+            pipe = PipelineLayer(
+                layers=[LayerDesc(Block, 8) for _ in range(4)] + [nn.Linear(8, 3)],
+                num_stages=4,
+                loss_fn=lambda lo, y: F.cross_entropy(lo, y),
+            )
+            pp_model = PipelineParallel(pipe, hcg, strategy)
+            pp_opt = opt.SGD(learning_rate=0.1, parameters=pipe.parameters())
+            x = paddle.randn([6, 8])  # mb = 3, not divisible by dp=2
+            y = paddle.to_tensor(np.array([0, 1, 2, 0, 1, 2], np.int64))
+            loss = pp_model.train_batch((x, y), pp_opt)
+            assert np.isfinite(float(loss))
+        finally:
+            dist.destroy_process_group()
+            fleet.set_hybrid_communicate_group(None)
+
     def test_pp_sequential_fallback_grads_reach_stacked_params(self):
         """Regression: the no-mesh fallback must route grads to the
         registered stacked Parameters (they are what the optimizer sees)."""
