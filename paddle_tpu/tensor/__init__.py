@@ -192,6 +192,7 @@ def _install_inplace_sweep():
         # manipulation / indexing
         "t", "flatten", "triu", "tril", "cast", "index_add", "index_put",
         "index_fill", "masked_scatter",
+        "atanh", "acosh", "asinh", "lerp", "erfinv", "put_along_axis",
     ]
     for base in names:
         fn = getattr(mod, base, None)
@@ -204,6 +205,44 @@ def _install_inplace_sweep():
 
 
 _install_inplace_sweep()
+
+
+def _install_extra_methods():
+    """Methods the reference patches from outside the tensor package
+    (ref tensor_method_func): signal stft/istft and the top-level
+    create_parameter."""
+    from ..signal import istft as _istft, stft as _stft
+
+    for name, fn in (("stft", _stft), ("istft", _istft)):
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+    # the reference's tensor_method_func also binds these free functions
+    # (self becomes the first positional arg, e.g. x.scatter_nd(updates,
+    # shape) uses x as the index — same binding as the reference)
+    for name in ("scatter_nd", "polar"):
+        for mod in _METHOD_SOURCES:
+            fn = getattr(mod, name, None)
+            if fn is not None and not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+
+    # broadcast_shape takes SHAPES; a tensor self contributes its .shape
+    # (binding the raw function would iterate the Tensor itself, which
+    # never raises IndexError under jax index clipping -> infinite loop)
+    if not hasattr(Tensor, "broadcast_shape"):
+        Tensor.broadcast_shape = lambda self, y_shape: math.broadcast_shape(
+            list(self.shape), y_shape
+        )
+
+    def _create_parameter_method(self, shape, dtype=None, **kw):
+        import paddle_tpu as _p
+
+        return _p.create_parameter(shape, dtype or str(self.dtype), **kw)
+
+    if not hasattr(Tensor, "create_parameter"):
+        Tensor.create_parameter = _create_parameter_method
+
+
+_install_extra_methods()
 
 from . import array  # noqa: F401
 from .array import array_length, array_read, array_write, create_array  # noqa: F401

@@ -210,3 +210,13 @@ def one_hot(x, num_classes, name=None) -> Tensor:
         x,
         op_name="one_hot",
     )
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """ref: tensor/creation.py create_tensor — an empty typed tensor to
+    be filled by assign/set_value."""
+    from ..base.dtype import canonical_dtype
+
+    t = Tensor(jnp.zeros((0,), canonical_dtype(dtype)), _internal=True)
+    t.persistable = persistable
+    return t

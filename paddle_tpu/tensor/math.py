@@ -561,3 +561,31 @@ def pdist(x, p=2.0, name=None):
         return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
 
     return apply(_f, x, op_name="pdist")
+
+
+def sigmoid(x, name=None):
+    """ref: tensor/ops.py sigmoid (also exposed as a Tensor method)."""
+    return apply(jax.nn.sigmoid, x, op_name="sigmoid")
+
+
+sigmoid_ = _make_inplace(sigmoid)
+
+
+def histogram_bin_edges(input, bins=100, min=0.0, max=0.0, name=None):  # noqa: A002
+    """ref: linalg.py histogram_bin_edges — min==max (data-derived OR
+    user-given) widens the range by +-0.5; max < min raises."""
+    if max < min:
+        raise ValueError("max must be larger than min in range parameter")
+
+    def _f(a):
+        if min == 0 and max == 0:
+            lo, hi = jnp.min(a), jnp.max(a)
+        else:
+            lo = jnp.asarray(min, jnp.float32)
+            hi = jnp.asarray(max, jnp.float32)
+        same = lo == hi
+        lo = jnp.where(same, lo - 0.5, lo)
+        hi = jnp.where(same, hi + 0.5, hi)
+        return jnp.linspace(lo, hi, bins + 1).astype(jnp.float32)
+
+    return apply(_f, input, op_name="histogram_bin_edges")

@@ -142,3 +142,39 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=Non
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
     return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus sampling (ref: tensor/search.py top_p_sampling — the
+    phi top_p_sampling CUDA kernel): per row, sample from the smallest
+    prefix of the sorted distribution whose mass exceeds ps. With
+    ``return_top`` also returns the top-k scores/ids like the
+    reference; ``threshold`` drops probabilities below it; ``seed``
+    >= 0 makes the draw reproducible independently of the generator."""
+    from ..base import random as _random
+
+    key = jax.random.PRNGKey(seed) if seed is not None and seed >= 0 else _random.next_key()
+    int_dt = _cint()
+
+    def _f(probs, p, *maybe_thresh):
+        idx = jnp.argsort(-probs, axis=-1)
+        srt = jnp.take_along_axis(probs, idx, axis=-1)
+        cum = jnp.cumsum(srt, axis=-1)
+        # keep tokens while cumulative mass (exclusive) < p
+        keep = (cum - srt) < p.reshape(-1, 1)
+        if maybe_thresh:
+            keep = keep & (srt >= maybe_thresh[0].reshape(-1, 1))
+        masked = jnp.where(keep, srt, 0.0)
+        masked = masked / jnp.maximum(masked.sum(-1, keepdims=True), 1e-9)
+        g = jax.random.categorical(key, jnp.log(jnp.maximum(masked, 1e-30)), axis=-1)
+        tok = jnp.take_along_axis(idx, g[:, None], axis=-1)
+        scr = jnp.take_along_axis(probs, tok, axis=-1)
+        if return_top:
+            kk = k if k and k > 0 else 1
+            return (scr, tok.astype(int_dt),
+                    srt[:, :kk], idx[:, :kk].astype(int_dt))
+        return scr, tok.astype(int_dt)
+
+    args = (x, ps) + ((threshold,) if threshold is not None else ())
+    return apply(_f, *args, op_name="top_p_sampling")

@@ -11,6 +11,41 @@ import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 
 
+def test_reference_tensor_methods_covered():
+    """Every name in the reference's tensor_method_func list must be a
+    Tensor method (ref: python/paddle/tensor/__init__.py)."""
+    from paddle_tpu.base.tensor import Tensor
+
+    src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+    names = None
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "tensor_method_func":
+                    try:
+                        names = [ast.literal_eval(e) for e in node.value.elts]
+                    except Exception:
+                        pass
+    assert names
+    missing = [n for n in names if not hasattr(Tensor, n)]
+    assert not missing, f"missing Tensor methods: {missing}"
+
+
+def test_top_p_sampling_and_new_ops():
+    paddle.seed(0)
+    probs = paddle.to_tensor(np.array([[0.5, 0.3, 0.15, 0.05]], np.float32))
+    scr, tok = paddle.top_p_sampling(probs, paddle.to_tensor(np.array([0.7], np.float32)))
+    assert int(tok.numpy()[0, 0]) in (0, 1)
+    edges = paddle.histogram_bin_edges(
+        paddle.to_tensor(np.array([1.0, 3.0], np.float32)), bins=4
+    )
+    np.testing.assert_allclose(edges.numpy(), [1.0, 1.5, 2.0, 2.5, 3.0])
+    x = paddle.to_tensor(np.array([0.0], np.float32))
+    np.testing.assert_allclose(paddle.sigmoid(x).numpy(), [0.5])
+    t = paddle.create_tensor("float32")
+    assert tuple(t.shape) == (0,)
+
+
 def test_reference_top_level_all_covered():
     src = open("/root/reference/python/paddle/__init__.py").read()
     names = None
