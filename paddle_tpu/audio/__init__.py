@@ -11,4 +11,126 @@ from . import functional  # noqa: F401
 from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401
 
 __all__ = ["functional", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
+           "LogMelSpectrogram", "MFCC", "datasets", "backends", "load", "info", "save",]
+
+
+# -- backends + file I/O (ref: python/paddle/audio/backends/) ---------------
+# The soundfile backend isn't bundled; the stdlib `wave` module gives a
+# real PCM WAV path (the reference's default wave_backend does the same).
+
+
+class AudioInfo:
+    """ref: backends/backend.py AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels, bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_frames = num_samples
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath: str) -> AudioInfo:
+    """ref: backends/wave_backend.py info."""
+    import wave as _wave
+
+    with _wave.open(filepath, "rb") as w:
+        return AudioInfo(
+            w.getframerate(), w.getnframes(), w.getnchannels(),
+            w.getsampwidth() * 8,
+        )
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """ref: backends/wave_backend.py load — (Tensor [C, L] or [L, C],
+    sample_rate)."""
+    import wave as _wave
+
+    import numpy as np
+
+    from ..base.tensor import to_tensor
+
+    with _wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        w.setpos(frame_offset)
+        n = num_frames if num_frames > 0 else w.getnframes() - frame_offset
+        raw = w.readframes(n)
+        width = w.getsampwidth()
+        ch = w.getnchannels()
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dt).reshape(-1, ch)
+    if width == 1:
+        data = data.astype(np.int16) - 128  # 8-bit wav is unsigned
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    out = data.T if channels_first else data
+    return to_tensor(np.ascontiguousarray(out)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16):
+    """ref: backends/wave_backend.py save — float input in [-1, 1];
+    8-bit WAV is unsigned, 16/32-bit are signed little-endian."""
+    import wave as _wave
+
+    import numpy as np
+
+    if bits_per_sample not in (8, 16, 32):
+        raise ValueError("bits_per_sample must be 8, 16 or 32")
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if channels_first:
+        arr = arr.T
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        scaled = arr * (2 ** (bits_per_sample - 1) - 1)
+        if bits_per_sample == 8:
+            arr = (scaled + 128).astype("u1")  # unsigned per the WAV spec
+        elif bits_per_sample == 16:
+            arr = scaled.astype("<i2")
+        else:
+            arr = scaled.astype("<i4")
+    with _wave.open(filepath, "wb") as w:
+        w.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+        w.setsampwidth(bits_per_sample // 8)
+        w.setframerate(sample_rate)
+        w.writeframes(arr.tobytes())
+
+
+class backends:
+    """ref: audio/backends — backend registry (wave only here)."""
+
+    @staticmethod
+    def list_available_backends():
+        return ["wave"]
+
+    @staticmethod
+    def get_current_backend():
+        return "wave"
+
+    @staticmethod
+    def set_backend(backend: str):
+        if backend != "wave":
+            raise ValueError(
+                f"only the stdlib 'wave' backend is bundled, got {backend!r}"
+            )
+
+
+class datasets:
+    """ref: audio/datasets — TESS/ESC50; archives must be local (no
+    egress), mirroring the text dataset loaders."""
+
+    class TESS:
+        def __init__(self, *a, **k):
+            raise RuntimeError(
+                "TESS: automatic download unavailable (no egress); use "
+                "paddle_tpu.vision.datasets.DatasetFolder over a local copy"
+            )
+
+    class ESC50:
+        def __init__(self, *a, **k):
+            raise RuntimeError(
+                "ESC50: automatic download unavailable (no egress); use "
+                "paddle_tpu.vision.datasets.DatasetFolder over a local copy"
+            )

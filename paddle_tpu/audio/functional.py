@@ -14,7 +14,7 @@ from ..base.tensor import Tensor
 __all__ = [
     "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
     "compute_fbank_matrix", "power_to_db", "create_dct",
-]
+ "get_window",]
 
 
 def hz_to_mel(freq, htk: bool = False):
@@ -114,3 +114,46 @@ def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
     else:
         dct *= 2.0
     return dct.astype(dtype)
+
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """ref: audio/functional/window.py get_window — named window factory
+    ('hann', ('gaussian', std), ...)."""
+    import jax.numpy as jnp
+
+    from ..base.dtype import canonical_dtype
+    from ..base.tensor import Tensor
+
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length
+    periodic = fftbins
+    m = n if periodic else n - 1
+    if m <= 0:  # length-1 symmetric window: every formula below hits 0/0
+        from ..base.tensor import Tensor as _T
+
+        return _T(jnp.ones((n,), canonical_dtype(dtype)), _internal=True)
+    i = jnp.arange(n, dtype=jnp.float64)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * jnp.pi * i / m)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * jnp.pi * i / m)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * jnp.pi * i / m)
+             + 0.08 * jnp.cos(4 * jnp.pi * i / m))
+    elif name == "bartlett":
+        w = 1.0 - jnp.abs(2.0 * i / m - 1.0)
+    elif name in ("rect", "boxcar", "ones"):
+        w = jnp.ones((n,))
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = jnp.exp(-0.5 * ((i - m / 2.0) / std) ** 2)
+    elif name == "triang":
+        w = 1.0 - jnp.abs((i - (n - 1) / 2.0) / ((n + (n % 2)) / 2.0))
+    elif name == "cosine":
+        w = jnp.sin(jnp.pi * (i + 0.5) / n)
+    else:
+        raise ValueError(f"unsupported window {name!r}")
+    return Tensor(w.astype(canonical_dtype(dtype)), _internal=True)

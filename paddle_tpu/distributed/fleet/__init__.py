@@ -12,6 +12,15 @@ from __future__ import annotations
 from typing import Optional
 
 from . import elastic  # noqa: F401
+from .base.role_maker import (  # noqa: F401
+    Fleet,
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+    PaddleCloudRoleMaker,
+    Role,
+    UserDefinedRoleMaker,
+    UtilBase,
+)
 
 from .base.distributed_strategy import DistributedStrategy  # noqa: F401
 from .base.topology import (  # noqa: F401

@@ -23,7 +23,7 @@ from ..base.tensor import Tensor
 __all__ = [
     "segment_sum", "segment_mean", "segment_min", "segment_max",
     "send_u_recv", "send_ue_recv", "send_uv",
-]
+ "reindex_graph", "reindex_heter_graph", "sample_neighbors", "weighted_sample_neighbors",]
 
 
 def _num_segments(ids, out_size):
@@ -125,3 +125,51 @@ def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
         return combine(a[si], b[di])
 
     return apply(f, x, y, src_index, dst_index, op_name="send_uv")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    """ref: geometric/reindex.py reindex_graph."""
+    from ..incubate import graph_reindex
+
+    return graph_reindex(x, neighbors, count, value_buffer, index_buffer)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None, index_buffer=None, name=None):
+    """ref: geometric/reindex.py reindex_heter_graph — per-edge-type
+    neighbor lists reindexed against one shared node mapping."""
+    import numpy as np
+
+    from ..incubate import graph_reindex
+
+    nbs = [n for n in neighbors]
+    cnts = [c for c in count]
+    from ..base.tensor import to_tensor
+
+    nb_cat = np.concatenate([np.asarray(n.numpy()).reshape(-1) for n in nbs])
+    cnt_cat = np.concatenate([np.asarray(c.numpy()).reshape(-1) for c in cnts])
+    # centers repeat once per edge type
+    xs = np.asarray(x.numpy()).reshape(-1)
+    ctr = np.tile(xs, len(nbs))
+    return graph_reindex(to_tensor(ctr), to_tensor(nb_cat.astype(np.int64)),
+                         to_tensor(cnt_cat.astype(np.int64)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """ref: geometric/sampling/neighbors.py sample_neighbors."""
+    from ..incubate import graph_sample_neighbors
+
+    return graph_sample_neighbors(row, colptr, input_nodes, sample_size,
+                                  eids, return_eids, perm_buffer)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted variant (ref: neighbors.py weighted_sample_neighbors):
+    neighbors drawn without replacement proportionally to edge weight
+    (zero-weight edges excluded). Shares graph_sample_neighbors' body."""
+    from ..incubate import graph_sample_neighbors
+
+    return graph_sample_neighbors(row, colptr, input_nodes, sample_size,
+                                  eids, return_eids, edge_weight=edge_weight)

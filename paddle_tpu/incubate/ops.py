@@ -101,9 +101,13 @@ def _csr_from_edges(row, colptr_nodes):
 
 def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                            eids=None, return_eids=False, perm_buffer=None,
-                           flag_perm_buffer=False, name=None):
-    """Uniform neighbor sampling on a CSC graph (ref:
-    incubate/operators/graph_sample_neighbors.py). Host-side numpy."""
+                           flag_perm_buffer=False, name=None,
+                           edge_weight=None):
+    """Uniform (or, with ``edge_weight``, weighted-without-replacement)
+    neighbor sampling on a CSC graph (ref:
+    incubate/operators/graph_sample_neighbors.py;
+    geometric/sampling/neighbors.py weighted_sample_neighbors shares
+    this body). Host-side numpy."""
     from ..base import random as _random
 
     # fresh randomness per call, seeded from the framework generator so
@@ -114,13 +118,25 @@ def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
     rowv = np.asarray(jax.device_get(row._data if isinstance(row, Tensor) else row)).reshape(-1)
     cp = np.asarray(jax.device_get(colptr._data if isinstance(colptr, Tensor) else colptr)).reshape(-1)
     nodes = np.asarray(jax.device_get(input_nodes._data if isinstance(input_nodes, Tensor) else input_nodes)).reshape(-1)
+    wts = None
+    if edge_weight is not None:
+        wts = np.asarray(jax.device_get(
+            edge_weight._data if isinstance(edge_weight, Tensor) else edge_weight
+        )).reshape(-1).astype(np.float64)
     out_nb, out_cnt, out_eids = [], [], []
     for v in nodes:
         lo, hi = int(cp[v]), int(cp[v + 1])
         nbrs = rowv[lo:hi]
         idx = np.arange(lo, hi)
+        if wts is not None:
+            # zero-weight edges are legal input: they are excluded from
+            # the draw (and from the pool size check)
+            w = wts[lo:hi]
+            pos = w > 0
+            nbrs, idx, w = nbrs[pos], idx[pos], w[pos]
         if sample_size > 0 and nbrs.shape[0] > sample_size:
-            pick = rng.choice(nbrs.shape[0], sample_size, replace=False)
+            p = (w / w.sum()) if wts is not None else None
+            pick = rng.choice(nbrs.shape[0], sample_size, replace=False, p=p)
             nbrs, idx = nbrs[pick], idx[pick]
         out_nb.append(nbrs)
         out_eids.append(idx)

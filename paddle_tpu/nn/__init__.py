@@ -20,6 +20,7 @@ from .layer.norm import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
 from .layer.extension import *  # noqa: F401,F403
+from . import quant  # noqa: F401
 from .layer.transformer import *  # noqa: F401,F403
 
 from . import utils  # noqa: F401

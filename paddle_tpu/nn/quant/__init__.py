@@ -1,0 +1,94 @@
+"""paddle.nn.quant — weight-only quantization for inference
+(ref: python/paddle/nn/quant/__init__.py: Stub, weight_only_linear,
+llm_int8_linear, weight_quantize, weight_dequantize).
+
+TPU-native: int8/int4 weight-only quantization stores packed int
+weights + per-channel scales; the matmul path dequantizes on the fly
+(XLA fuses the dequant into the MXU feed — the role the cutlass
+weight-only kernels play in the reference)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...base.tape import apply
+from ...base.tensor import Tensor
+from ..layer.layers import Layer
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+           "weight_quantize", "weight_dequantize"]
+
+
+class Stub(Layer):
+    """ref: nn/quant/stub.py Stub — a placeholder the quantizer swaps
+    for an observer/quanter; identity until configured."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return self._observer(x) if self._observer is not None else x
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """Quantize a [in, out] weight to int8/int4 with per-out-channel
+    absmax scales (ref: nn/quant/quantized_linear.py weight_quantize)."""
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
+        raise ValueError(f"unsupported algo {algo!r}")
+    bits = 4 if algo == "weight_only_int4" else 8
+    qmax = (1 << (bits - 1)) - 1
+
+    def _f(w):
+        scale = jnp.max(jnp.abs(w), axis=0) / qmax
+        q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-9)), -qmax - 1, qmax)
+        return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+    return apply(_f, x, op_name="weight_quantize")
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
+    """ref: quantized_linear.py weight_dequantize."""
+    from ...base.dtype import canonical_dtype
+
+    dt = canonical_dtype(out_dtype)
+    return apply(
+        lambda q, s: (q.astype(jnp.float32) * s).astype(dt),
+        x, scale, op_name="weight_dequantize",
+    )
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ dequant(weight) + bias (ref: quantized_linear.py
+    weight_only_linear). The dequant fuses into the matmul under XLA."""
+
+    def _f(a, q, s, *maybe_b):
+        w = q.astype(a.dtype) * s.astype(a.dtype)
+        out = a @ w
+        if maybe_b:
+            out = out + maybe_b[0]
+        return out
+
+    args = (x, weight, weight_scale) + ((bias,) if bias is not None else ())
+    return apply(_f, *args, op_name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
+    """ref: quantized_linear.py llm_int8_linear. The reference splits
+    outlier activation columns onto fp16 weights to avoid int8-arithmetic
+    error; on TPU the weight is dequantized into the matmul anyway (the
+    MXU computes in bf16/f32), so a single dequantized matmul IS the
+    numerically-higher-precision path and the outlier split would only
+    duplicate work — ``threshold`` is accepted for signature parity."""
+
+    def _f(a, q, s, *maybe_b):
+        w = q.astype(a.dtype) * s.astype(a.dtype)
+        out = a @ w
+        if maybe_b:
+            out = out + maybe_b[0]
+        return out
+
+    args = (x, weight, weight_scale) + ((bias,) if bias is not None else ())
+    return apply(_f, *args, op_name="llm_int8_linear")

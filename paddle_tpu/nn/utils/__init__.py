@@ -22,3 +22,47 @@ def weight_norm(layer, name="weight", dim=0):
 
 def remove_weight_norm(layer, name="weight"):
     raise NotImplementedError
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    """Apply spectral normalization to a layer's weight via a forward
+    pre-hook running power iteration (ref: nn/utils/spectral_norm_hook.py
+    spectral_norm)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if dim is None:
+        # reference: output-channel dim is 1 for Linear (weight is
+        # [in, out]) and ConvTranspose ([in, out, ...]), else 0
+        from ..layer.common import Linear as _Linear
+        from ..layer.conv import (
+            Conv1DTranspose as _C1T,
+            Conv2DTranspose as _C2T,
+            Conv3DTranspose as _C3T,
+        )
+
+        dim = 1 if isinstance(layer, (_Linear, _C1T, _C2T, _C3T)) else 0
+    w0 = getattr(layer, name)
+    mat0 = np.asarray(w0._data, np.float32)
+    mat0 = np.moveaxis(mat0, dim, 0).reshape(mat0.shape[dim], -1)
+    state = {"u": np.random.RandomState(0).randn(mat0.shape[0]).astype(np.float32)}
+
+    def _pre_hook(l, inputs):
+        w = getattr(l, name)
+        mat = jnp.moveaxis(w._data, dim, 0)
+        shape = mat.shape
+        mat2 = mat.reshape(shape[0], -1)
+        u = jnp.asarray(state["u"])
+        for _ in range(n_power_iterations):
+            v = mat2.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = mat2 @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        state["u"] = np.asarray(u)
+        sigma = u @ (mat2 @ v)
+        wn = (mat2 / jnp.maximum(sigma, eps)).reshape(shape)
+        w._data = jnp.moveaxis(wn, 0, dim).astype(w._data.dtype)
+        return None
+
+    layer.register_forward_pre_hook(_pre_hook)
+    return layer

@@ -27,6 +27,12 @@ _MODS = {
     "inference": "/root/reference/python/paddle/inference/__init__.py",
     "onnx": "/root/reference/python/paddle/onnx/__init__.py",
     "utils": "/root/reference/python/paddle/utils/__init__.py",
+    "distributed.fleet": "/root/reference/python/paddle/distributed/fleet/__init__.py",
+    "audio": "/root/reference/python/paddle/audio/__init__.py",
+    "audio.functional": "/root/reference/python/paddle/audio/functional/__init__.py",
+    "geometric": "/root/reference/python/paddle/geometric/__init__.py",
+    "nn.utils": "/root/reference/python/paddle/nn/utils/__init__.py",
+    "nn.quant": "/root/reference/python/paddle/nn/quant/__init__.py",
 }
 
 
@@ -190,6 +196,69 @@ class TestTransformsAndDatasets:
         assert label == 0
         flat = ImageFolder(root)
         assert len(flat) == 4
+
+
+class TestAudioQuantFleet:
+    def test_wav_round_trip(self, tmp_path):
+        sr = 8000
+        sig = np.sin(2 * np.pi * 440 * np.arange(sr) / sr).astype(np.float32)[None]
+        path = str(tmp_path / "tone.wav")
+        paddle.audio.save(path, paddle.to_tensor(sig), sr)
+        inf = paddle.audio.info(path)
+        assert inf.sample_rate == sr and inf.num_channels == 1
+        loaded, sr2 = paddle.audio.load(path)
+        assert sr2 == sr
+        np.testing.assert_allclose(loaded.numpy(), sig, atol=2e-4)
+
+    def test_get_window_matches_scipy(self):
+        from scipy.signal import get_window as sp_win
+
+        for name in ("hann", "hamming", "blackman", "bartlett"):
+            got = paddle.audio.functional.get_window(name, 32).numpy()
+            np.testing.assert_allclose(got, sp_win(name, 32, fftbins=True), atol=1e-6)
+
+    def test_weight_only_quant_round_trip(self):
+        paddle.seed(0)
+        w = paddle.randn([16, 8])
+        q, s = paddle.nn.quant.weight_quantize(w)
+        assert str(q.numpy().dtype) == "int8"
+        wd = paddle.nn.quant.weight_dequantize(q, s, out_dtype="float32")
+        err = float(np.abs(wd.numpy() - w.numpy()).max() / np.abs(w.numpy()).max())
+        assert err < 0.02
+        x = paddle.randn([4, 16])
+        y = paddle.nn.quant.weight_only_linear(x, q, weight_scale=s)
+        np.testing.assert_allclose(y.numpy(), x.numpy() @ wd.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_spectral_norm_function(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(8, 8)
+        nn.utils.spectral_norm(lin, n_power_iterations=20)
+        lin(paddle.randn([2, 8]))
+        sv = np.linalg.svd(np.asarray(lin.weight._data), compute_uv=False)
+        assert abs(sv[0] - 1.0) < 1e-2
+
+    def test_fleet_class_and_data_generator(self):
+        f = paddle.distributed.fleet.Fleet()
+        assert f.worker_num() >= 1 and f.is_first_worker() and f.is_worker()
+
+        class Gen(paddle.distributed.fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                yield [("ids", [int(v) for v in line.split()])]
+
+        g = Gen()
+        rows = list(g.run_from_files([]))
+        assert rows == []
+        assert g._format([("ids", [3, 5])]) == "2 3 5"
+
+    def test_weighted_sample_neighbors(self):
+        row = paddle.to_tensor(np.array([1, 2, 0, 2, 0, 1], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 2, 4, 6], np.int64))
+        w = paddle.to_tensor(np.array([1.0, 1.0, 1.0, 1.0, 1.0, 1.0], np.float32))
+        nodes = paddle.to_tensor(np.array([0, 1], np.int64))
+        nb, cnt = paddle.geometric.weighted_sample_neighbors(row, colptr, w, nodes, sample_size=1)
+        assert cnt.numpy().tolist() == [1, 1]
 
 
 class TestMiscUtils:
