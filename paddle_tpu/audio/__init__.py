@@ -82,6 +82,12 @@ def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
     arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
     if channels_first:
         arr = arr.T
+    if arr.dtype.kind in "iu":
+        # integer input: normalize by ITS OWN width so the float path
+        # re-scales to bits_per_sample (avoids writing mismatched-width
+        # frames under a header claiming another width)
+        src_bits = arr.dtype.itemsize * 8
+        arr = arr.astype(np.float64) / float(2 ** (src_bits - 1))
     if arr.dtype.kind == "f":
         arr = np.clip(arr, -1.0, 1.0)
         scaled = arr * (2 ** (bits_per_sample - 1) - 1)
@@ -98,39 +104,5 @@ def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
         w.writeframes(arr.tobytes())
 
 
-class backends:
-    """ref: audio/backends — backend registry (wave only here)."""
-
-    @staticmethod
-    def list_available_backends():
-        return ["wave"]
-
-    @staticmethod
-    def get_current_backend():
-        return "wave"
-
-    @staticmethod
-    def set_backend(backend: str):
-        if backend != "wave":
-            raise ValueError(
-                f"only the stdlib 'wave' backend is bundled, got {backend!r}"
-            )
-
-
-class datasets:
-    """ref: audio/datasets — TESS/ESC50; archives must be local (no
-    egress), mirroring the text dataset loaders."""
-
-    class TESS:
-        def __init__(self, *a, **k):
-            raise RuntimeError(
-                "TESS: automatic download unavailable (no egress); use "
-                "paddle_tpu.vision.datasets.DatasetFolder over a local copy"
-            )
-
-    class ESC50:
-        def __init__(self, *a, **k):
-            raise RuntimeError(
-                "ESC50: automatic download unavailable (no egress); use "
-                "paddle_tpu.vision.datasets.DatasetFolder over a local copy"
-            )
+from . import backends  # noqa: E402,F401
+from . import datasets  # noqa: E402,F401

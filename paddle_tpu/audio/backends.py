@@ -1,0 +1,22 @@
+"""paddle.audio.backends (ref: python/paddle/audio/backends/) — backend
+registry. Only the stdlib-wave backend is bundled (the reference's
+default wave_backend plays the same role); load/save/info live on the
+parent package and are re-exported here like the reference."""
+from __future__ import annotations
+
+__all__ = ["list_available_backends", "get_current_backend", "set_backend"]
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend() -> str:
+    return "wave"
+
+
+def set_backend(backend: str):
+    if backend != "wave":
+        raise ValueError(
+            f"only the stdlib 'wave' backend is bundled, got {backend!r}"
+        )

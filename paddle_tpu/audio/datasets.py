@@ -1,0 +1,81 @@
+"""paddle.audio.datasets (ref: python/paddle/audio/datasets/) — TESS and
+ESC50. The download mirrors are unreachable (no egress); pass
+archive_path= to a pre-downloaded copy, parsed with the reference's
+layout (label from the directory / filename field)."""
+from __future__ import annotations
+
+import os
+
+from ..io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+class TESS(Dataset):
+    """Toronto emotional speech set: <speaker>_<word>_<emotion>.wav
+    files; label = emotion index (ref: datasets/tess.py)."""
+
+    emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5, split: int = 1,
+                 feat_type: str = "raw", archive_path: str = None, **kwargs):
+        if archive_path is None or not os.path.isdir(archive_path):
+            raise RuntimeError(
+                "TESS: automatic download is unavailable (no egress); pass "
+                "archive_path=<dir containing the extracted TESS wav files>"
+            )
+        files = []
+        for dirpath, _, names in sorted(os.walk(archive_path)):
+            for f in sorted(names):
+                if f.lower().endswith(".wav"):
+                    emotion = f.rsplit(".", 1)[0].split("_")[-1].lower()
+                    if emotion in self.emotions:
+                        files.append((os.path.join(dirpath, f), self.emotions.index(emotion)))
+        fold = lambda i: i % n_folds + 1
+        self.files = [
+            (p, y) for i, (p, y) in enumerate(files)
+            if (fold(i) != split if mode == "train" else fold(i) == split)
+        ]
+
+    def __getitem__(self, idx):
+        from . import load
+
+        path, label = self.files[idx]
+        wav, _sr = load(path)
+        return wav, label
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(Dataset):
+    """ESC-50 environmental sounds: <fold>-<id>-<take>-<target>.wav
+    (ref: datasets/esc50.py)."""
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", archive_path: str = None, **kwargs):
+        if archive_path is None or not os.path.isdir(archive_path):
+            raise RuntimeError(
+                "ESC50: automatic download is unavailable (no egress); pass "
+                "archive_path=<dir containing the extracted ESC-50 audio/>"
+            )
+        files = []
+        for dirpath, _, names in sorted(os.walk(archive_path)):
+            for f in sorted(names):
+                if f.lower().endswith(".wav") and f.count("-") >= 3:
+                    fold_s, _, _, target_s = f.rsplit(".", 1)[0].split("-")[:4]
+                    files.append((os.path.join(dirpath, f), int(fold_s), int(target_s)))
+        self.files = [
+            (p, y) for p, fold, y in files
+            if (fold != split if mode == "train" else fold == split)
+        ]
+
+    def __getitem__(self, idx):
+        from . import load
+
+        path, label = self.files[idx]
+        wav, _sr = load(path)
+        return wav, label
+
+    def __len__(self):
+        return len(self.files)

@@ -151,9 +151,12 @@ def get_window(window, win_length, fftbins=True, dtype="float64"):
         std = args[0] if args else 7.0
         w = jnp.exp(-0.5 * ((i - m / 2.0) / std) ** 2)
     elif name == "triang":
-        w = 1.0 - jnp.abs((i - (n - 1) / 2.0) / ((n + (n % 2)) / 2.0))
+        # periodic = symmetric window of n+1 truncated (scipy fftbins)
+        L = n + 1 if periodic else n
+        w = 1.0 - jnp.abs((i - (L - 1) / 2.0) / ((L + (L % 2)) / 2.0))
     elif name == "cosine":
-        w = jnp.sin(jnp.pi * (i + 0.5) / n)
+        L = n + 1 if periodic else n
+        w = jnp.sin(jnp.pi * (i + 0.5) / L)
     else:
         raise ValueError(f"unsupported window {name!r}")
     return Tensor(w.astype(canonical_dtype(dtype)), _internal=True)

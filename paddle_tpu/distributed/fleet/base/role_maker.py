@@ -93,10 +93,14 @@ class PaddleCloudRoleMaker(_RoleMakerBase):
         super().__init__(is_collective)
 
     def _worker_index(self):
-        return int(os.environ.get("PADDLE_TRAINER_ID", super()._worker_index()))
+        if "PADDLE_TRAINER_ID" in os.environ:  # don't touch jax's backend
+            return int(os.environ["PADDLE_TRAINER_ID"])
+        return super()._worker_index()
 
     def _worker_num(self):
-        return int(os.environ.get("PADDLE_TRAINERS_NUM", super()._worker_num()))
+        if "PADDLE_TRAINERS_NUM" in os.environ:
+            return int(os.environ["PADDLE_TRAINERS_NUM"])
+        return super()._worker_num()
 
     worker_index = _worker_index
     worker_num = _worker_num
@@ -111,8 +115,13 @@ class UtilBase:
         import paddle_tpu as paddle
         import paddle_tpu.distributed as dist
 
+        op = {
+            "sum": dist.ReduceOp.SUM,
+            "min": dist.ReduceOp.MIN,
+            "max": dist.ReduceOp.MAX,
+        }[mode.lower()]
         t = paddle.to_tensor(np.asarray(input))
-        out = dist.all_reduce(t) or t
+        out = dist.all_reduce(t, op=op) or t
         return np.asarray(out.numpy() if hasattr(out, "numpy") else out)
 
     def barrier(self, comm_world="worker"):

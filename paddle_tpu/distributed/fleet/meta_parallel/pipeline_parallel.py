@@ -33,6 +33,19 @@ public scaling-book recipe) is:
   ppermute, yielding the reverse pipeline automatically (the schedule
   the reference implements by hand in _backward_step).
 
+Zero-bubble (ZB-H1) is deliberately NOT implemented. ZB fills drain
+bubbles by splitting backward into B (input-grad) and W (weight-grad)
+ticks. Under recompute-based residuals (the only option inside a scan),
+a fused B+W tick costs recompute+dx+dw ≈ 6 matmul-equivalents per
+2-matmul chunk, while split B and W ticks each redo the recompute:
+8 total, a ~33% FLOP tax on the whole pipelined body to reclaim a
+bubble of (S-1)/(M·V+S-1) ticks — for any M·V ≥ ~3(S-1) the tax
+exceeds the bubble. VPP already shrinks the same bubble by V at zero
+FLOP cost, and XLA's latency-hiding scheduler overlaps the ppermute
+with compute, so ZB is a strictly worse trade on this runtime. (The
+reference needs ZB because its MPMD ranks idle on NCCL waits that
+nothing else can fill.)
+
 Numerics are microbatch-exact w.r.t. serial execution; the bubble
 fraction is the classic (S-1)/(M+S-1). ``recompute_interval`` wraps the
 stage body in jax.checkpoint (activation recompute, ref

@@ -53,11 +53,14 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=Non
         shape = mat.shape
         mat2 = mat.reshape(shape[0], -1)
         u = jnp.asarray(state["u"])
+        # v always derives from the current u so 0 iterations is legal
+        v = mat2.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), eps)
         for _ in range(n_power_iterations):
-            v = mat2.T @ u
-            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
             u = mat2 @ v
             u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+            v = mat2.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
         state["u"] = np.asarray(u)
         sigma = u @ (mat2 @ v)
         wn = (mat2 / jnp.maximum(sigma, eps)).reshape(shape)

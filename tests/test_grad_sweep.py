@@ -78,6 +78,19 @@ _SWEEP = [
     ("transpose", lambda x: paddle.transpose(x, [1, 0]).sum(axis=0), _GENERIC),
     ("concat", lambda x: paddle.concat([x, x * 2], axis=0), _GENERIC),
     ("split", lambda x: paddle.split(x, 2, axis=1)[0], _GENERIC),
+    # parity-sweep special functions (round-2 additions)
+    ("gammaln", lambda x: paddle.gammaln(x), _POSITIVE),
+    ("digamma", lambda x: paddle.digamma(x), _POSITIVE),
+    ("sinc", lambda x: paddle.sinc(x), _OFF_ZERO),
+    ("logcumsumexp", lambda x: paddle.logcumsumexp(x, axis=1), _GENERIC),
+    ("logit", lambda x: paddle.logit(x), np.abs(_GENERIC) / (np.abs(_GENERIC).max() * 2) + 0.2),
+    ("erfinv", lambda x: paddle.erfinv(x), _GENERIC / (np.abs(_GENERIC).max() * 2)),
+    ("trapezoid", lambda x: paddle.trapezoid(x, axis=1), _GENERIC),
+    ("cumulative_trapezoid", lambda x: paddle.cumulative_trapezoid(x, axis=1), _GENERIC),
+    ("reduce_as", lambda x: paddle.reduce_as(x, paddle.zeros([3, 1])), _GENERIC),
+    ("unflatten", lambda x: paddle.unflatten(x, 1, [2, 2]) * 2.0, _GENERIC),
+    ("hstack", lambda x: paddle.hstack([x, x * 3.0]), _GENERIC),
+    ("pdist", lambda x: paddle.pdist(x), _OFF_ZERO),
     ("slice", lambda x: x[1:, :2] * 3, _GENERIC),
     ("pad", lambda x: F.pad(x, [1, 1, 1, 1]), _GENERIC),
     ("clip", lambda x: paddle.clip(x, -0.5, 0.5), _GENERIC),
