@@ -370,7 +370,10 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         idx_last = jnp.clip(s_len - 1, 0, S - 1)
         idx_prev = jnp.clip(s_len - 2, 0, S - 1)
         ar = jnp.arange(B)
-        ll = jnp.logaddexp(alpha[ar, idx_last], alpha[ar, idx_prev])
+        # for empty labels (s_len == 1) there is no second terminal state;
+        # idx_prev would clip onto idx_last and double-count the all-blank path
+        prev = jnp.where(s_len >= 2, alpha[ar, idx_prev], NEG)
+        ll = jnp.logaddexp(alpha[ar, idx_last], prev)
         loss = -ll
         if norm_by_times:
             loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1)
@@ -400,6 +403,14 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,  # noqa: A002
         emit = jnp.take_along_axis(
             lp[:, :, :U, :], lab_i[:, None, :, None], axis=-1
         )[..., 0]  # [B, T, U]
+        if fastemit_lambda:
+            # FastEmit (arXiv:2010.11148): scale the gradient through the
+            # label-emission log-probs by (1 + lambda) while leaving the
+            # loss value unchanged — the value-preserving gradient-scaling
+            # identity (1+l)*e - l*stop_grad(e) == e implements exactly the
+            # emission-gradient boost warprnnt applies in its backward.
+            lam = jnp.asarray(fastemit_lambda, emit.dtype)
+            emit = (1.0 + lam) * emit - lam * jax.lax.stop_gradient(emit)
         null = lp[..., blank]  # [B, T, U+1]
 
         def time_step(alpha_prev, t):
@@ -575,7 +586,11 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
             logits = logits + maybe_b[0][jnp.clip(nodes - 1, 0, maybe_b[0].shape[0] - 1)]
         # code 1 -> sigmoid(logit), code 0 -> 1 - sigmoid
         logp = -jax.nn.softplus(-logits) * codes + -jax.nn.softplus(logits) * (1 - codes)
-        return -(logp.sum(-1))
+        # shallow leaves (num_classes not a power of two) reach the root
+        # before `depth` steps; iterations past the root have node < 1 and
+        # must not contribute (they'd re-count row 0)
+        valid = (nodes >= 1).astype(x.dtype)
+        return -((logp * valid).sum(-1))
 
     args = (input, label, weight) + ((bias,) if bias is not None else ())
     out = apply(_f, *args, op_name="hsigmoid_loss")

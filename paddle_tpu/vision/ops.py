@@ -59,10 +59,15 @@ def nms(boxes, iou_threshold: float = 0.3, scores=None,
         s = np.arange(n, 0, -1, dtype=np.float32)  # keep input order
     else:
         s = np.asarray(jax.device_get(_unwrap(scores)), np.float32)
+    if n == 0:
+        return Tensor(np.zeros((0,), np.int64), _internal=True)
     if category_idxs is not None:
         # offset boxes per category so cross-category pairs never overlap
         cats = np.asarray(jax.device_get(_unwrap(category_idxs)))
-        offset = (b.max() + 1.0) * cats.astype(np.float32)
+        # stride must cover the full coordinate span (coords may be
+        # negative), not just the max
+        stride = b.max() - min(b.min(), 0.0) + 1.0
+        offset = stride * cats.astype(np.float32)
         b = b + offset[:, None]
     order = np.argsort(-s)
     keep = []

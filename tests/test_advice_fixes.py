@@ -1,0 +1,129 @@
+"""Edge cases from the round-2 advisor findings: hsigmoid with
+non-power-of-two num_classes, ctc with empty labels, rnnt FastEmit,
+categorical nms with negative coordinates."""
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestHSigmoidShallowLeaves:
+    def test_non_power_of_two_normalizes(self):
+        """The implied class distribution must sum to 1 for num_classes=3
+        (shallow leaves reach the root before the fixed bit-walk depth)."""
+        rng = np.random.RandomState(0)
+        num_classes, d = 3, 6
+        x = rng.randn(1, d).astype(np.float32)
+        w = rng.randn(num_classes - 1, d).astype(np.float32)
+        probs = []
+        for c in range(num_classes):
+            loss = F.hsigmoid_loss(
+                paddle.to_tensor(x),
+                paddle.to_tensor(np.array([c], np.int64)),
+                num_classes,
+                paddle.to_tensor(w),
+            )
+            probs.append(np.exp(-float(loss)))
+        np.testing.assert_allclose(sum(probs), 1.0, rtol=1e-5)
+
+    def test_power_of_two_still_normalizes(self):
+        rng = np.random.RandomState(1)
+        num_classes, d = 8, 5
+        x = rng.randn(1, d).astype(np.float32)
+        w = rng.randn(num_classes - 1, d).astype(np.float32)
+        total = sum(
+            np.exp(-float(F.hsigmoid_loss(
+                paddle.to_tensor(x),
+                paddle.to_tensor(np.array([c], np.int64)),
+                num_classes,
+                paddle.to_tensor(w),
+            )))
+            for c in range(num_classes)
+        )
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+class TestCTCEmptyLabel:
+    def test_zero_label_length_matches_torch(self):
+        rng = np.random.RandomState(0)
+        T, B, C, L = 7, 2, 4, 3
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = rng.randint(1, C, (B, L)).astype(np.int32)
+        in_len = np.array([7, 6], np.int32)
+        lab_len = np.array([0, 2], np.int32)  # first sequence: empty label
+        got = F.ctc_loss(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+            blank=0, reduction="none",
+        )
+        t_lp = torch.nn.functional.log_softmax(torch.tensor(logits), dim=-1)
+        want = torch.nn.functional.ctc_loss(
+            t_lp, torch.tensor(labels.astype(np.int64)),
+            torch.tensor(in_len.astype(np.int64)),
+            torch.tensor(lab_len.astype(np.int64)),
+            blank=0, reduction="none",
+        )
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4)
+
+
+class TestRNNTFastEmit:
+    def _inputs(self):
+        rng = np.random.RandomState(2)
+        B, T, U, C = 2, 5, 3, 4
+        acts = rng.randn(B, T, U + 1, C).astype(np.float32)
+        labels = rng.randint(1, C, (B, U)).astype(np.int32)
+        t_len = np.array([5, 4], np.int32)
+        u_len = np.array([3, 2], np.int32)
+        return acts, labels, t_len, u_len
+
+    def _loss(self, acts_t, lam):
+        acts, labels, t_len, u_len = self._inputs()
+        return F.rnnt_loss(
+            acts_t, paddle.to_tensor(labels),
+            paddle.to_tensor(t_len), paddle.to_tensor(u_len),
+            blank=0, fastemit_lambda=lam, reduction="sum",
+        )
+
+    def test_value_unchanged_grad_scaled(self):
+        acts, _, _, _ = self._inputs()
+        a0 = paddle.to_tensor(acts)
+        a0.stop_gradient = False
+        l0 = self._loss(a0, 0.0)
+        l0.backward()
+        g0 = a0.grad.numpy().copy()
+
+        a1 = paddle.to_tensor(acts)
+        a1.stop_gradient = False
+        l1 = self._loss(a1, 0.5)
+        l1.backward()
+        g1 = a1.grad.numpy()
+
+        # FastEmit leaves the loss value untouched but boosts the
+        # emission-path gradient, so gradients must differ
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        assert np.abs(g0 - g1).max() > 1e-5
+        # and the column-sum-over-vocab of grads still vanishes per node
+        # (log_softmax jacobian rows sum to 0 regardless of the scaling)
+        np.testing.assert_allclose(g1.sum(-1), 0.0, atol=1e-4)
+
+
+class TestNMSNegativeCoords:
+    def test_categories_do_not_cross_suppress(self):
+        # Engineered so the old (b.max()+1)*cat offset lands the cat-1 box
+        # exactly on the cat-0 box: max=2 -> old stride 3; [-13..-11]+3
+        # overlaps [-10..-8]. The span-based stride keeps them apart.
+        boxes = np.array([
+            [-10.0, -10.0, -8.0, -8.0],   # cat 0, high score
+            [-13.0, -13.0, -11.0, -11.0],  # cat 1, low score
+            [0.0, 0.0, 2.0, 2.0],          # cat 0, sets b.max()
+        ], np.float32)
+        scores = np.array([0.9, 0.5, 0.8], np.float32)
+        cats = np.array([0, 1, 0], np.int64)
+        keep = paddle.vision.ops.nms(
+            paddle.to_tensor(boxes), 0.1,
+            scores=paddle.to_tensor(scores),
+            category_idxs=paddle.to_tensor(cats),
+            categories=[0, 1],
+        )
+        assert sorted(keep.numpy().tolist()) == [0, 1, 2]
