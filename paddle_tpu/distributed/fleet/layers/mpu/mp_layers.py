@@ -39,15 +39,24 @@ def _resolve_mesh_axis(mp_group):
 
 def _constrain(t, mesh, spec):
     """Apply a GSPMD sharding constraint through the tape (differentiable,
-    works eagerly and under jit)."""
+    works eagerly and under jit).
+
+    Resolved lazily against the CURRENT abstract mesh when one is active
+    (e.g. inside the pipeline's partial-manual shard_map, where dp/pp are
+    Manual and mp stays Auto) so the constraint's mesh axis types always
+    match the context; falls back to the layer's concrete mesh."""
     if mesh is None:
         return t
     from paddle_tpu.base import tape
 
-    sh = jax.sharding.NamedSharding(mesh, spec)
-    return tape.apply(
-        lambda x: jax.lax.with_sharding_constraint(x, sh), t, op_name="sharding_constraint"
-    )
+    def f(x):
+        am = jax.sharding.get_abstract_mesh()
+        use = am if (am is not None and not am.empty) else mesh
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(use, spec)
+        )
+
+    return tape.apply(f, t, op_name="sharding_constraint")
 
 
 def mark_as_sequence_parallel_parameter(param):

@@ -334,6 +334,14 @@ class PipelineLayer(nn.Layer):
                 # rows (j = s*V + v, v = 0..V-1) as a local [V, ...] block
                 chunks = list(local_stacked)
                 stage = lax.axis_index("pp")
+                # VMA: microbatches and the carried state/outputs vary over
+                # pp (each stage computes different values); mark them so
+                # the scan carry typechecks under check_vma (pcast is the
+                # non-deprecated spelling, pvary the fallback on older jax)
+                if hasattr(lax, "pcast"):
+                    local_xs = lax.pcast(local_xs, ("pp",), to="varying")
+                else:
+                    local_xs = lax.pvary(local_xs, ("pp",))
                 state = jnp.zeros_like(local_xs[0])
                 outputs = jnp.zeros_like(local_xs)
                 SV = S * V
@@ -377,12 +385,24 @@ class PipelineLayer(nn.Layer):
 
             # dp x pp hybrid: batch-within-microbatch dim sharded over
             # dp; stacked params replicated over dp (their grads psum
-            # over dp via the shard_map transpose)
+            # over dp via the shard_map transpose). Only pp (+dp) are
+            # bound manually — every other mesh axis (mp, sep, ...)
+            # stays in GSPMD auto mode, so sharding constraints inside
+            # the stage body (TP layers) keep working and XLA inserts
+            # the mp collectives within each pipeline tick.
             x_spec = P(None, dp_axis) if dp_axis else P()
             in_specs = (x_spec,) + tuple(P("pp") for _ in stacked)
+            manual = frozenset({"pp"} | ({dp_axis} if dp_axis else set()))
+            # partial-manual (auto axes present) requires VMA tracking:
+            # jax's check_vma=False path builds an internal all-axes spec
+            # that partial mode rejects
+            partial = any(
+                size > 1 and name not in manual
+                for name, size in dict(mesh.shape).items()
+            )
             return jax.shard_map(
                 spmd, mesh=mesh, in_specs=in_specs, out_specs=x_spec,
-                check_vma=False,
+                axis_names=manual, check_vma=partial,
             )(xs, *stacked)
 
         out_stream = tape.apply(
@@ -423,7 +443,12 @@ class PipelineParallel:
         self._mesh = hcg.mesh
         self._dp_axis = None
         for name, size in dict(self._mesh.shape).items():
-            if name == "pp" or size <= 1:
+            if name in ("pp", "mp") or size <= 1:
+                # mp stays OUT of the shard_map's manual axis_names, in
+                # GSPMD auto mode: the TP layers' with_sharding_constraint
+                # over "mp" keeps partitioning each stage body's matmuls
+                # and inserting the TP collectives inside the pipelined
+                # region — dp x mp x pp composes in one program.
                 continue
             if name == "dp":
                 # dp x pp hybrid: the shard_map binds both axes — batch
@@ -431,8 +456,9 @@ class PipelineParallel:
                 # via the shard_map transpose
                 self._dp_axis = name
             else:
-                # tp/sep inside the pipelined region would need the
-                # stage body to emit explicit collectives; fall back
+                # sep/sharding inside the pipelined region would nest a
+                # manual shard_map (ring attention) in the partial-manual
+                # context, which jax rejects; fall back to sequential
                 self._mesh = None
                 self._dp_axis = None
                 break
@@ -444,9 +470,17 @@ class PipelineParallel:
 
         if self._mesh is None:
             return
+        mp_size = dict(self._mesh.shape).get("mp", 1)
         for p in self._layers._stacked:
-            spec = P(*(["pp"] + [None] * (p.ndim - 1)))
-            p._data = jax.device_put(p._data, NamedSharding(self._mesh, spec))
+            spec = ["pp"] + [None] * (p.ndim - 1)
+            tp_axis = getattr(p, "tp_axis", None)
+            if (
+                tp_axis is not None and mp_size > 1
+                and p.shape[tp_axis + 1] % mp_size == 0
+            ):
+                # template axis tp_axis is stacked axis tp_axis+1
+                spec[tp_axis + 1] = "mp"
+            p._data = jax.device_put(p._data, NamedSharding(self._mesh, P(*spec)))
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
@@ -499,7 +533,16 @@ class PipelineParallel:
         return self._compiled[key](x, y)
 
     def eval_batch(self, data, compute_loss=True):
+        """Pipelined evaluation (same schedule as train_batch, no grads);
+        falls back to sequential only when the batch doesn't divide into
+        ``accumulate_steps`` microbatches."""
         x, y = data
+        M = self.accumulate_steps
         with tape.no_grad():
-            logits = self._layers.forward(x)
+            if self._mesh is not None and x.shape[0] % M == 0:
+                logits = self._layers.forward(
+                    x, num_micro=M, mesh=self._mesh, dp_axis=self._dp_axis
+                )
+            else:
+                logits = self._layers.forward(x)
             return self._layers._loss_fn(logits, y) if compute_loss else logits

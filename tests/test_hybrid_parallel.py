@@ -420,6 +420,107 @@ class TestPipelineParallel:
             dist.destroy_process_group()
             fleet.set_hybrid_communicate_group(None)
 
+    def test_dp_mp_pp_hybrid_matches_serial(self):
+        """dp=2 x mp=2 x pp=2: TP layers run INSIDE the pipelined
+        shard_map (pp/dp manual, mp left in GSPMD auto mode so the TP
+        sharding constraints keep inserting collectives per stage).
+        Losses must match serial exactly; eval_batch must also pipeline."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            ColumnParallelLinear,
+            LayerDesc,
+            PipelineLayer,
+            PipelineParallel,
+            RowParallelLinear,
+        )
+
+        class TPBlock(nn.Layer):
+            def __init__(self, h):
+                super().__init__()
+                self.fc1 = ColumnParallelLinear(h, 4 * h, has_bias=True, gather_output=False)
+                self.fc2 = RowParallelLinear(4 * h, h, input_is_parallel=True)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        class SBlock(nn.Layer):
+            def __init__(self, h):
+                super().__init__()
+                self.fc1 = nn.Linear(h, 4 * h)
+                self.fc2 = nn.Linear(4 * h, h)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        hcg = fleet.init(strategy=strategy)
+        try:
+            H, C, MB, M = 8, 4, 4, 2
+
+            def loss_fn(logits, y):
+                return F.cross_entropy(logits, y)
+
+            paddle.seed(51)
+            pipe = PipelineLayer(
+                layers=[LayerDesc(TPBlock, H) for _ in range(4)] + [nn.Linear(H, C)],
+                num_stages=2, loss_fn=loss_fn,
+            )
+            pp_model = PipelineParallel(pipe, hcg, strategy)
+            assert pp_model._mesh is not None and pp_model._dp_axis == "dp"
+            # stacked TP params must actually shard over mp on tp_axis+1
+            specs = [p._data.sharding.spec for p in pipe._stacked]
+            assert any("mp" in (s or ()) for spec in specs for s in spec), specs
+
+            serial_blocks = [SBlock(H) for _ in range(4)]
+            for s in range(2):
+                for i in range(2):
+                    blk = serial_blocks[s * 2 + i]
+                    base = i * 4
+                    blk.fc1.weight.set_value(paddle.to_tensor(np.asarray(pipe._stacked[base + 0]._data[s])))
+                    blk.fc1.bias.set_value(paddle.to_tensor(np.asarray(pipe._stacked[base + 1]._data[s])))
+                    blk.fc2.weight.set_value(paddle.to_tensor(np.asarray(pipe._stacked[base + 2]._data[s])))
+                    blk.fc2.bias.set_value(paddle.to_tensor(np.asarray(pipe._stacked[base + 3]._data[s])))
+            serial_head = nn.Linear(H, C)
+            serial_head.weight.set_value(pipe._post[0].weight)
+            serial_head.bias.set_value(pipe._post[0].bias)
+
+            pp_opt = opt.SGD(learning_rate=0.1, parameters=pipe.parameters())
+            serial_params = [p for b in serial_blocks for p in b.parameters()] + list(
+                serial_head.parameters()
+            )
+            serial_opt = opt.SGD(learning_rate=0.1, parameters=serial_params)
+
+            rng = np.random.RandomState(17)
+            for step in range(3):
+                x_np = rng.randn(M * MB, H).astype(np.float32)
+                y_np = rng.randint(0, C, (M * MB,)).astype(np.int64)
+                loss_pp = pp_model.train_batch(
+                    (paddle.to_tensor(x_np), paddle.to_tensor(y_np)), pp_opt
+                )
+                h = paddle.to_tensor(x_np)
+                for b in serial_blocks:
+                    h = b(h)
+                loss_serial = loss_fn(serial_head(h), paddle.to_tensor(y_np))
+                loss_serial.backward()
+                serial_opt.step()
+                serial_opt.clear_grad()
+                np.testing.assert_allclose(
+                    float(loss_pp), float(loss_serial), rtol=2e-5, atol=1e-6
+                )
+
+            # eval_batch pipelines too and agrees with serial
+            ev = pp_model.eval_batch((paddle.to_tensor(x_np), paddle.to_tensor(y_np)))
+            h = paddle.to_tensor(x_np)
+            for b in serial_blocks:
+                h = b(h)
+            ev_serial = loss_fn(serial_head(h), paddle.to_tensor(y_np))
+            np.testing.assert_allclose(float(ev), float(ev_serial), rtol=2e-5, atol=1e-6)
+        finally:
+            dist.destroy_process_group()
+            fleet.set_hybrid_communicate_group(None)
+
     def test_dp_pp_hybrid_odd_microbatch_falls_back(self):
         """mb not divisible by dp must run (unsharded) instead of raising."""
         import paddle_tpu.distributed as dist
