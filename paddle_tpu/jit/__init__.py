@@ -38,7 +38,9 @@ from jax import tree_util
 from ..base import random as _random
 from ..base.tensor import Tensor
 
-__all__ = ["to_static", "not_to_static", "StaticFunction", "save", "load", "TranslatedLayer", "enable_to_static"]
+__all__ = ["to_static", "not_to_static", "StaticFunction", "save", "load", "TranslatedLayer", "enable_to_static", "dy2static"]
+
+from . import dy2static  # noqa: E402  (control-flow conversion submodule)
 
 _jit_enabled = [True]
 
@@ -71,7 +73,6 @@ class StaticFunction:
         static_argnums: Tuple[int, ...] = (),
     ):
         functools.update_wrapper(self, fn, updated=[])
-        self._fn = fn
         from ..nn.layer.layers import Layer
 
         if isinstance(layers, Layer):
@@ -81,6 +82,11 @@ class StaticFunction:
         self._scalers = list(scalers)
         if not self._layers and not self._optimizers:
             self._auto_discover(fn)
+        # dy2static: rewrite tensor-dependent if/while into runtime
+        # dispatch (lax select/while under trace, plain Python eagerly)
+        from . import dy2static as _d2s
+
+        self._fn = _d2s.convert(fn)
         self._donate_state = donate_state
         self._state_shardings = state_shardings
         self._in_shardings = in_shardings
@@ -166,7 +172,16 @@ class StaticFunction:
                     for a in flat_args
                 ]
                 args, kwargs = tree_util.tree_unflatten(arg_treedef, wrapped)
-                out = self._fn(*args, **kwargs)
+                try:
+                    out = self._fn(*args, **kwargs)
+                except (
+                    jax.errors.TracerBoolConversionError,
+                    jax.errors.TracerArrayConversionError,
+                    jax.errors.TracerIntegerConversionError,
+                ) as e:
+                    from . import dy2static as _d2s
+
+                    raise _d2s.graph_break_error(e) from e
             finally:
                 for o in self._optimizers:
                     o._lr_override = None

@@ -1,0 +1,489 @@
+"""Dynamic-to-static control-flow conversion.
+
+TPU-native counterpart of the reference's dy2static AST transforms
+(ref: python/paddle/jit/dy2static/program_translator.py,
+jit/sot/opcode_translator/executor/opcode_executor.py:305,1594 — which
+rewrite tensor-dependent Python ``if``/``while`` into cond/while ops).
+
+Here the rewrite targets XLA's structured control flow:
+
+- ``if`` on a traced tensor: BOTH branches are evaluated and the
+  results merged with an elementwise select (``jnp.where``). This is
+  the TPU idiom — branch divergence is hostile to SPMD and XLA usually
+  lowers small ``lax.cond``s to selects anyway; running both branches
+  keeps the transform differentiable through the tape (lax.cond's vjp
+  would be routed the same way).
+- ``while`` on a traced tensor: ``lax.while_loop`` over the carried
+  variables (the names assigned in the loop body). Gradients do not
+  flow through a traced while (XLA's while has no transpose without
+  checkpointing the trip count); outputs are stop-gradient tensors.
+- Predicates that are NOT traced tensors dispatch to plain Python at
+  runtime — the transform never changes eager semantics.
+
+The transform is conservative: an ``if``/``while`` containing
+``return``/``break``/``continue`` targeting the converted region, a
+``nonlocal``/``global`` declaration anywhere in the function, or
+unavailable source, is left untouched; hitting such a construct with a
+traced predicate raises an actionable graph-break error (see
+``graph_break_error``) instead of a raw tracer error.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_RUNTIME_NAME = "_paddle_tpu_jst"
+_cache: Dict[Any, Callable] = {}
+
+
+class _Undef:
+    """Sentinel for a variable unbound before a converted region; any
+    use raises with the variable's name (mirrors UnboundLocalError)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            f"variable '{self.name}' is used inside converted control flow "
+            "before being assigned on every path"
+        )
+
+    __bool__ = __call__ = __getattr__ = __add__ = __radd__ = _raise
+    __mul__ = __rmul__ = __sub__ = __iter__ = __getitem__ = _raise
+
+
+def _tracer_of(x):
+    from ..base.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        x = x._data
+    return x if isinstance(x, jax.core.Tracer) else None
+
+
+def _as_bool(x):
+    from ..base.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        return bool(x)
+    return bool(x)
+
+
+def _select_leaf(pred, a, b):
+    from ..base import tape
+    from ..base.tensor import Tensor
+
+    if a is b:
+        return a
+    a_undef, b_undef = isinstance(a, _Undef), isinstance(b, _Undef)
+    if a_undef or b_undef:
+        name = (a if a_undef else b).name
+        raise ValueError(
+            f"variable '{name}' is assigned in only one branch of a "
+            "tensor-dependent `if`; both branches must produce it so the "
+            "results can be selected"
+        )
+    tensorish = lambda v: isinstance(v, (Tensor, jax.Array)) or hasattr(v, "dtype")  # noqa: E731
+    if tensorish(a) or tensorish(b):
+        return tape.apply(
+            lambda c, x, y: jnp.where(c, x, y), pred, a, b, op_name="dy2static_select"
+        )
+    if a == b:
+        return a
+    raise ValueError(
+        f"non-tensor value differs between the branches of a "
+        f"tensor-dependent `if` ({a!r} vs {b!r}); only tensor results can "
+        "be selected under trace"
+    )
+
+
+def convert_ifelse(pred, true_fn, false_fn, init_args: Tuple):
+    """Runtime dispatch for a converted ``if``: Python semantics for
+    concrete predicates, evaluate-both + select for traced ones."""
+    if _tracer_of(pred) is None:
+        return true_fn(*init_args) if _as_bool(pred) else false_fn(*init_args)
+    t_out = true_fn(*init_args)
+    f_out = false_fn(*init_args)
+    return tuple(_select_leaf(pred, a, b) for a, b in zip(t_out, f_out))
+
+
+def convert_while_loop(cond_fn, body_fn, init_args: Tuple, var_names: Sequence[str] = ()):
+    """Runtime dispatch for a converted ``while``: Python loop for
+    concrete predicates (unrolls under trace, keeping gradients),
+    ``lax.while_loop`` for traced ones (no grad)."""
+    from ..base import tape
+    from ..base.tensor import Tensor
+
+    first = cond_fn(*init_args)
+    if _tracer_of(first) is None:
+        # concrete predicate: plain Python loop — under trace this
+        # unrolls, which preserves differentiability
+        vars_t = tuple(init_args)
+        cur = first
+        while _as_bool(cur):
+            vars_t = body_fn(*vars_t)
+            cur = cond_fn(*vars_t)
+        return vars_t
+
+    arrays = []
+    for i, v in enumerate(init_args):
+        name = var_names[i] if i < len(var_names) else f"#{i}"
+        if isinstance(v, _Undef):
+            raise ValueError(
+                f"loop variable '{v.name}' must be initialized before a "
+                "tensor-dependent `while`"
+            )
+        if isinstance(v, Tensor):
+            arrays.append(v._data)
+        elif isinstance(v, (jax.Array, int, float, bool)) or hasattr(v, "dtype"):
+            arrays.append(jnp.asarray(v))
+        else:
+            raise ValueError(
+                f"loop variable '{name}' has type {type(v).__name__}, which "
+                "cannot be carried through a traced `while` (tensors and "
+                "numbers only)"
+            )
+
+    def _wrap(carry):
+        return tuple(Tensor(a, _internal=True) for a in carry)
+
+    def _cond(carry):
+        with tape.no_grad():
+            r = cond_fn(*_wrap(carry))
+        r = r._data if isinstance(r, Tensor) else jnp.asarray(r)
+        return r.astype(bool).reshape(())
+
+    def _body(carry):
+        with tape.no_grad():
+            out = body_fn(*_wrap(carry))
+        return tuple(
+            (o._data if isinstance(o, Tensor) else jnp.asarray(o)) for o in out
+        )
+
+    res = jax.lax.while_loop(_cond, _body, tuple(arrays))
+    return tuple(Tensor(a, _internal=True) for a in res)
+
+
+# ---------------------------------------------------------------------------
+# AST transform
+# ---------------------------------------------------------------------------
+
+_NEW_SCOPE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda,
+              ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _assigned_names(stmts: Sequence[ast.stmt]) -> Tuple[List[str], bool]:
+    """Names bound by ``stmts`` in the current scope (ordered, no dups),
+    plus whether the region contains a ``del`` (which blocks conversion:
+    a deleted name cannot appear in the generated epilogue)."""
+    out: List[str] = []
+    has_del = False
+
+    def add(name):
+        # skip this transform's own generated helpers from inner rewrites
+        if not name.startswith("_pt_") and name not in out:
+            out.append(name)
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            nonlocal has_del
+            if isinstance(node.ctx, ast.Del):
+                has_del = True
+            elif isinstance(node.ctx, ast.Store):
+                add(node.id)
+
+        def visit_FunctionDef(self, node):
+            add(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            add(node.name)
+
+        def visit_Lambda(self, node):
+            pass
+
+        def _comp(self, node):  # comprehensions: own scope in py3
+            pass
+
+        visit_ListComp = visit_SetComp = visit_DictComp = visit_GeneratorExp = _comp
+
+        def visit_alias(self, node):
+            add(node.asname or node.name.split(".")[0])
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return out, has_del
+
+
+def _has_abrupt_exit(stmts: Sequence[ast.stmt], top_level_loop: bool) -> bool:
+    """True if the region contains flow that escapes it: return/yield
+    anywhere in this scope, or break/continue not enclosed in a loop
+    inside the region (for `while` conversion the loop itself is the
+    target, so top-level break/continue also count)."""
+    found = False
+
+    def walk(node, loop_depth):
+        nonlocal found
+        if found or isinstance(node, _NEW_SCOPE):
+            return
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            found = True
+            return
+        if isinstance(node, (ast.Break, ast.Continue)) and loop_depth == 0:
+            found = True
+            return
+        inc = 1 if isinstance(node, (ast.For, ast.While, ast.AsyncFor)) else 0
+        for child in ast.iter_child_nodes(node):
+            walk(child, loop_depth + inc)
+
+    depth0 = 0 if not top_level_loop else 0
+    for s in stmts:
+        walk(s, depth0)
+    return found
+
+
+def _name(n, ctx=None):
+    return ast.Name(id=n, ctx=ctx or ast.Load())
+
+
+def _tuple_of(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names], ctx=ctx or ast.Load())
+
+
+def _fn_args(names):
+    return ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=n) for n in names], vararg=None,
+        kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[],
+    )
+
+
+def _init_stmts(names, uid):
+    """try: _pt_init_v = v / except NameError: _pt_init_v = UNDEF('v')"""
+    stmts = []
+    for v in names:
+        tmp = f"_pt_init_{uid}_{v}"
+        undef = ast.Call(
+            func=ast.Attribute(value=_name(_RUNTIME_NAME), attr="_Undef", ctx=ast.Load()),
+            args=[ast.Constant(value=v)], keywords=[],
+        )
+        stmts.append(ast.Try(
+            body=[ast.Assign(targets=[_name(tmp, ast.Store())], value=_name(v))],
+            handlers=[ast.ExceptHandler(
+                type=_name("NameError"), name=None,
+                body=[ast.Assign(targets=[_name(tmp, ast.Store())], value=undef)],
+            )],
+            orelse=[], finalbody=[],
+        ))
+    return stmts, [f"_pt_init_{uid}_{v}" for v in names]
+
+
+class _Transformer(ast.NodeTransformer):
+    def __init__(self):
+        self.changed = False
+        self._uid = 0
+        self._blocked = False  # nonlocal/global present
+
+    def _next(self):
+        self._uid += 1
+        return self._uid
+
+    def visit_Nonlocal(self, node):
+        self._blocked = True
+        return node
+
+    def visit_Global(self, node):
+        self._blocked = True
+        return node
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if self._blocked:
+            return node
+        assigned, has_del = _assigned_names(node.body + node.orelse)
+        if not assigned or has_del:
+            return node
+        if _has_abrupt_exit(node.body, False) or _has_abrupt_exit(node.orelse, False):
+            return node
+        uid = self._next()
+        tname, fname = f"_pt_true_{uid}", f"_pt_false_{uid}"
+        ret = ast.Return(value=_tuple_of(assigned))
+        true_def = ast.FunctionDef(
+            name=tname, args=_fn_args(assigned), body=list(node.body) + [ret],
+            decorator_list=[], returns=None, type_comment=None, type_params=[],
+        )
+        false_body = list(node.orelse) if node.orelse else [ast.Pass()]
+        false_def = ast.FunctionDef(
+            name=fname, args=_fn_args(assigned), body=false_body + [ast.Return(value=_tuple_of(assigned))],
+            decorator_list=[], returns=None, type_comment=None, type_params=[],
+        )
+        inits, init_names = _init_stmts(assigned, uid)
+        call = ast.Assign(
+            targets=[_tuple_of(assigned, ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=_name(_RUNTIME_NAME), attr="convert_ifelse", ctx=ast.Load()),
+                args=[node.test, _name(tname), _name(fname),
+                      ast.Tuple(elts=[_name(n) for n in init_names], ctx=ast.Load())],
+                keywords=[],
+            ),
+        )
+        self.changed = True
+        return [true_def, false_def, *inits, call]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if self._blocked or node.orelse:
+            return node
+        assigned, has_del = _assigned_names(node.body)
+        if not assigned or has_del:
+            return node
+        if _has_abrupt_exit(node.body, True):
+            return node
+        uid = self._next()
+        cname, bname = f"_pt_cond_{uid}", f"_pt_body_{uid}"
+        cond_def = ast.FunctionDef(
+            name=cname, args=_fn_args(assigned),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None, type_comment=None, type_params=[],
+        )
+        body_def = ast.FunctionDef(
+            name=bname, args=_fn_args(assigned),
+            body=list(node.body) + [ast.Return(value=_tuple_of(assigned))],
+            decorator_list=[], returns=None, type_comment=None, type_params=[],
+        )
+        inits, init_names = _init_stmts(assigned, uid)
+        call = ast.Assign(
+            targets=[_tuple_of(assigned, ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=_name(_RUNTIME_NAME), attr="convert_while_loop", ctx=ast.Load()),
+                args=[_name(cname), _name(bname),
+                      ast.Tuple(elts=[_name(n) for n in init_names], ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Constant(value=n) for n in assigned], ctx=ast.Load())],
+                keywords=[],
+            ),
+        )
+        self.changed = True
+        return [cond_def, body_def, *inits, call]
+
+
+def convert(fn: Callable) -> Callable:
+    """AST-convert tensor-dependent ``if``/``while`` in ``fn``.
+
+    Returns ``fn`` unchanged when nothing needs converting or the source
+    is unavailable/unsupported. Safe on any callable; cached per code
+    object. The converted function dispatches at runtime, so Python
+    semantics for concrete predicates are preserved exactly.
+    """
+    if getattr(fn, "_not_to_static", False):
+        return fn
+    if inspect.ismethod(fn):
+        conv = convert(fn.__func__)
+        return conv.__get__(fn.__self__) if conv is not fn.__func__ else fn
+    if getattr(fn, "__wrapped__", None) is not None:
+        # functools.wraps wrappers: inspect.getsource would follow
+        # __wrapped__ and return the INNER function's body while the code
+        # object is the wrapper's — source and cache key would disagree.
+        # Leave wrappers alone; the wrapped function can be converted
+        # explicitly if needed.
+        return fn
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return fn
+    # cache the template FUNCTION CODE per original code object; the
+    # function object is rebuilt per call so each closure keeps its own
+    # live cells and the live module globals (late binding preserved)
+    if code not in _cache:
+        _cache[code] = _compile_transform(fn)
+    new_code = _cache[code]
+    if new_code is None:
+        return fn
+    try:
+        if new_code.co_freevars != code.co_freevars:
+            return fn  # closure layout diverged; don't risk misbinding
+        import sys
+        import types
+
+        fn.__globals__.setdefault(_RUNTIME_NAME, sys.modules[__name__])
+        new_fn = types.FunctionType(
+            new_code, fn.__globals__, fn.__name__, fn.__defaults__, fn.__closure__
+        )
+        new_fn.__kwdefaults__ = fn.__kwdefaults__
+        new_fn.__wrapped_original__ = fn
+        return new_fn
+    except Exception:
+        return fn
+
+
+def _compile_transform(fn):
+    """AST-transform ``fn`` and return the new function CODE object (with
+    co_freevars preserved via a factory wrapper); None when unchanged or
+    unsupported."""
+    try:
+        code = fn.__code__
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fndef = tree.body[0]
+        if not isinstance(fndef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        fndef.decorator_list = []
+        tr = _Transformer()
+        tree = tr.visit(tree)
+        if not tr.changed or tr._blocked:
+            return None
+        ast.fix_missing_locations(tree)
+        filename = f"<dy2static:{inspect.getsourcefile(fn) or '?'}>"
+        if code.co_freevars:
+            # wrap in a factory whose params are the freevars so the
+            # compiled inner function has matching co_freevars; the code
+            # object is then rebound to the ORIGINAL closure cells
+            factory = ast.FunctionDef(
+                name="_pt_factory", args=_fn_args(list(code.co_freevars)),
+                body=[tree.body[0], ast.Return(value=_name(fndef.name))],
+                decorator_list=[], returns=None, type_comment=None, type_params=[],
+            )
+            mod = ast.Module(body=[factory], type_ignores=[])
+            ast.fix_missing_locations(mod)
+            ns: Dict[str, Any] = {}
+            exec(compile(mod, filename, "exec"), {}, ns)
+            template = ns["_pt_factory"](*[None] * len(code.co_freevars))
+        else:
+            ns = {}
+            exec(compile(tree, filename, "exec"), {}, ns)
+            template = ns[fndef.name]
+        return template.__code__
+    except Exception:
+        return None
+
+
+def graph_break_error(exc: BaseException) -> RuntimeError:
+    """Actionable error for a tensor-bool reached under trace, naming the
+    user source line (the reference's SOT emits a graph-break instead;
+    here the failing construct is reported with the rewrite options)."""
+    import traceback
+
+    loc = None
+    for frame in reversed(traceback.extract_tb(exc.__traceback__)):
+        f = frame.filename
+        if "/jax/" in f or "/paddle_tpu/" in f or f.startswith("<dy2static"):
+            continue
+        loc = f"{f}:{frame.lineno} ({frame.line})"
+        break
+    where = f" at {loc}" if loc else ""
+    return RuntimeError(
+        "to_static: tensor-dependent Python control flow (or another "
+        f"bool()/int()/numpy() concretization) reached under trace{where}. "
+        "`if`/`while` in the entry function are "
+        "converted automatically; this one could not be (helper function, "
+        "or a branch containing return/break/continue). Options: apply "
+        "paddle_tpu.jit.dy2static.convert to the helper; rewrite with "
+        "paddle.where / a converted-friendly loop; or mark the function "
+        "@paddle.jit.not_to_static to run it eagerly."
+    )

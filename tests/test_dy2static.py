@@ -1,0 +1,273 @@
+"""Control-flow conversion under to_static (ref: dy2static AST
+transforms / SOT graph breaks — tensor-dependent if/while must compile
+and match eager execution)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as pjit
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit import dy2static
+
+
+class TestTensorIf:
+    def test_if_matches_eager(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        xs_pos = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        xs_neg = paddle.to_tensor(np.array([-3.0, 1.0], np.float32))
+        sf = pjit.to_static(f)
+        for x in (xs_pos, xs_neg):
+            got = sf(x)
+            want = f(x)
+            np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-6)
+
+    def test_if_without_else(self):
+        def f(x):
+            y = x + 1.0
+            if x.mean() > 0:
+                y = y * 10.0
+            return y
+
+        sf = pjit.to_static(f)
+        x = paddle.to_tensor(np.array([0.5, 0.5], np.float32))
+        np.testing.assert_allclose(sf(x).numpy(), f(x).numpy(), rtol=1e-6)
+        x2 = paddle.to_tensor(np.array([-0.5, -0.5], np.float32))
+        np.testing.assert_allclose(sf(x2).numpy(), f(x2).numpy(), rtol=1e-6)
+
+    def test_grad_flows_through_if(self):
+        def step(x):
+            x.stop_gradient = False
+            if x.sum() > 0:
+                y = (x * 3.0).sum()
+            else:
+                y = (x * 5.0).sum()
+            y.backward()
+            return y, x.grad
+
+        sf = pjit.to_static(step)
+        x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+        y, g = sf(x)
+        np.testing.assert_allclose(g.numpy(), [3.0, 3.0], rtol=1e-6)
+        x2 = paddle.to_tensor(np.array([-1.0, -1.0], np.float32))
+        _, g2 = sf(x2)
+        np.testing.assert_allclose(g2.numpy(), [5.0, 5.0], rtol=1e-6)
+
+    def test_python_if_untouched(self):
+        def make(mode):
+            def f(x):
+                if mode == "double":   # plain python predicate
+                    y = x * 2.0
+                else:
+                    y = x * 3.0
+                return y
+
+            return pjit.to_static(f)
+
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(make("double")(x).numpy(), [2.0])
+        np.testing.assert_allclose(make("triple")(x).numpy(), [3.0])
+
+    def test_nested_if(self):
+        def f(x):
+            if x.sum() > 0:
+                if x.max() > 10:
+                    y = x * 100.0
+                else:
+                    y = x * 2.0
+            else:
+                y = -x
+            return y
+
+        sf = pjit.to_static(f)
+        for arr in ([20.0, 1.0], [1.0, 1.0], [-5.0, 1.0]):
+            x = paddle.to_tensor(np.array(arr, np.float32))
+            np.testing.assert_allclose(sf(x).numpy(), f(x).numpy(), rtol=1e-6)
+
+
+class TestTensorWhile:
+    def test_while_matches_eager(self):
+        def f(x):
+            s = paddle.to_tensor(np.float32(0.0))
+            i = paddle.to_tensor(np.float32(0.0))
+            while i < 5.0:
+                s = s + x.sum() * 0.0 + i
+                i = i + 1.0
+            return s
+
+        sf = pjit.to_static(f)
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        got = sf(x)
+        np.testing.assert_allclose(float(got), 10.0, rtol=1e-6)
+
+    def test_data_dependent_trip_count(self):
+        """Collatz-ish halving: trip count depends on the data."""
+
+        def f(x):
+            n = paddle.to_tensor(np.float32(0.0))
+            v = x.sum()
+            while v > 1.0:
+                v = v / 2.0
+                n = n + 1.0
+            return n
+
+        sf = pjit.to_static(f)
+        x = paddle.to_tensor(np.array([8.0], np.float32))
+        assert float(sf(x)) == 3.0
+        x2 = paddle.to_tensor(np.array([32.0], np.float32))
+        assert float(sf(x2)) == 5.0
+
+
+class TestGraphBreakError:
+    def test_helper_function_gets_actionable_error(self):
+        def helper(x):
+            # not converted (called, not the entry fn) AND contains a
+            # return inside the branch -> runtime graph-break message
+            if x.sum() > 0:
+                return x * 2.0
+            return x * 3.0
+
+        def f(x):
+            return helper(x) + 1.0
+
+        sf = pjit.to_static(f)
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        with pytest.raises(RuntimeError, match="tensor-dependent Python control flow"):
+            sf(x)
+
+    def test_error_names_options(self):
+        def f(x):
+            if x.sum() > 0:   # return inside branch -> not converted
+                return x * 2.0
+            return x
+
+        sf = pjit.to_static(f)
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        with pytest.raises(RuntimeError, match="not_to_static"):
+            sf(x)
+
+
+def _module_level_helper(x):
+    return x * 7.0
+
+
+class TestConvertEdgeCases:
+    def test_wrapped_functions_left_alone(self):
+        import functools
+
+        def deco(g):
+            @functools.wraps(g)
+            def inner(*a):
+                return g(*a)
+
+            return inner
+
+        def add_one(x):
+            if x.sum() > 0:
+                y = x + 1.0
+            else:
+                y = x
+            return y
+
+        def mul_ten(x):
+            if x.sum() > 0:
+                y = x * 10.0
+            else:
+                y = x
+            return y
+
+        f1, f2 = dy2static.convert(deco(add_one)), dy2static.convert(deco(mul_ten))
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        np.testing.assert_allclose(f1(x).numpy(), [4.0])
+        np.testing.assert_allclose(f2(x).numpy(), [30.0])
+
+    def test_late_binding_globals(self):
+        def f(x):
+            if x.sum() > 0:
+                y = _module_level_helper(x)
+            else:
+                y = x
+            return y
+
+        conv = dy2static.convert(f)
+        # live globals: monkeypatching the module global is visible
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        np.testing.assert_allclose(conv(x).numpy(), [14.0])
+
+    def test_concrete_counter_loop_keeps_grads(self):
+        def step(x):
+            x.stop_gradient = False
+            i = 0
+            y = x
+            while i < 3:
+                y = y * 2.0
+                i += 1
+            loss = y.sum()
+            loss.backward()
+            return loss, x.grad
+
+        sf = pjit.to_static(step)
+        _, g = sf(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+        np.testing.assert_allclose(g.numpy(), [8.0, 8.0])
+
+    def test_del_in_branch_blocks_conversion(self):
+        def f(x):
+            if True:
+                tmp = x + 1.0
+                y = tmp * 2.0
+                del tmp
+            return y
+
+        conv = dy2static.convert(f)
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        np.testing.assert_allclose(conv(x).numpy(), [8.0])
+
+    def test_closure_cells_stay_live(self):
+        holder = {"scale": 2.0}
+
+        def make():
+            scale = paddle.to_tensor(np.array([2.0], np.float32))
+
+            def f(x):
+                if x.sum() > 0:
+                    y = x * scale
+                else:
+                    y = x
+                return y
+
+            return f, (lambda v: None)
+
+        f, _ = make()
+        conv = dy2static.convert(f)
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        np.testing.assert_allclose(conv(x).numpy(), [6.0])
+
+
+class TestConvertDirect:
+    def test_convert_is_cached_and_identity_safe(self):
+        def plain(x):
+            return x + 1
+
+        assert dy2static.convert(plain) is plain
+        assert dy2static.convert(plain) is plain
+
+    def test_single_branch_assignment_raises_clearly(self):
+        def f(x):
+            if x.sum() > 0:
+                z = x * 2.0
+            else:
+                w = x * 3.0  # noqa: F841 -- different name on purpose
+            return x
+
+        conv = dy2static.convert(f)
+        import jax
+
+        with pytest.raises(ValueError, match="only one branch"):
+            jax.jit(lambda v: conv(paddle.to_tensor(v))._data + 0)(
+                np.array([1.0], np.float32)
+            )
