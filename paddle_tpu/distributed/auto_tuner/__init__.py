@@ -1,0 +1,29 @@
+"""Hybrid-parallel auto-tuner (ref:
+python/paddle/distributed/auto_tuner/ — tuner.py AutoTuner, search.py
+Grid/DpEstimation search, prune.py rule registry, recorder.py
+HistoryRecorder, memory_cost_model.py stub).
+
+TPU-native redesign: the search space is (dp, sharding-degree+stage,
+mp, pp, vpp, micro-batch, recompute) over a jax device mesh; pruning
+uses a REAL analytic HBM model (the reference's memory cost model
+raises NotImplementedError); measurement is an in-process jit compile +
+timed step instead of relaunching distributed jobs, because the TPU
+runtime is single-controller.
+"""
+from .memory_model import ModelGeometry, estimate_memory_bytes  # noqa: F401
+from .prune import register_prune, register_prune_history, run_prunes  # noqa: F401
+from .recorder import HistoryRecorder  # noqa: F401
+from .search import (  # noqa: F401
+    CostModelSearch,
+    GridSearch,
+    cost_score,
+    default_candidates,
+)
+from .tuner import AutoTuner, measured_step_runner, tune  # noqa: F401
+
+__all__ = [
+    "AutoTuner", "ModelGeometry", "HistoryRecorder", "GridSearch",
+    "CostModelSearch", "estimate_memory_bytes", "default_candidates",
+    "cost_score", "tune", "measured_step_runner", "register_prune",
+    "register_prune_history",
+]

@@ -1,0 +1,167 @@
+"""Prune rules for the hybrid-parallel config search.
+
+Registry-based, like the reference (ref:
+python/paddle/distributed/auto_tuner/prune.py:112 register_prune /
+:129 prune_by_mp / :173 prune_by_pp / :307 prune_by_mbs / :395
+prune_by_sharding / :486 prune_by_recompute / :605
+prune_by_memory_estimation). A rule returns a reason string when the
+config should be pruned, else None. History rules see earlier measured
+configs (e.g. OOM at a smaller micro-batch prunes larger ones).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from .memory_model import ModelGeometry, estimate_memory_bytes
+
+_PRUNES: List[Callable] = []
+_HISTORY_PRUNES: List[Callable] = []
+
+
+def register_prune(fn):
+    _PRUNES.append(fn)
+    return fn
+
+
+def register_prune_history(fn):
+    _HISTORY_PRUNES.append(fn)
+    return fn
+
+
+def same_cfgs_beside(attr, cur, history):
+    """History entries equal to ``cur`` except for ``attr`` (ref:
+    prune.py:62)."""
+    keys = ("dp_degree", "mp_degree", "pp_degree", "vpp_degree",
+            "sharding_degree", "sharding_stage", "micro_batch_size",
+            "use_recompute")
+    out = []
+    for h in history:
+        if all(h.get(k) == cur.get(k) for k in keys if k != attr):
+            out.append(h)
+    return out
+
+
+@register_prune
+def prune_by_degree_product(tuner_cfg, cur, history=None) -> Optional[str]:
+    n = tuner_cfg["num_devices"]
+    prod = (cur["dp_degree"] * cur["mp_degree"] * cur["pp_degree"]
+            * cur["sharding_degree"])
+    if prod != n:
+        return f"dp*mp*pp*sharding = {prod} != num_devices {n}"
+    return None
+
+
+@register_prune
+def prune_by_mp(tuner_cfg, cur, history=None) -> Optional[str]:
+    mp = cur["mp_degree"]
+    geom: ModelGeometry = tuner_cfg["geometry"]
+    if mp > 1:
+        if geom.hidden_size % mp or geom.num_attention_heads % mp:
+            return f"mp {mp} does not divide hidden/heads"
+        if geom.vocab_size % mp:
+            return f"mp {mp} does not divide vocab"
+        kvh = geom.num_key_value_heads or geom.num_attention_heads
+        if kvh % mp:
+            return f"mp {mp} does not divide kv heads {kvh}"
+        if mp > tuner_cfg.get("max_mp_degree", 8):
+            return f"mp {mp} beyond one ICI domain"
+    return None
+
+
+@register_prune
+def prune_by_pp(tuner_cfg, cur, history=None) -> Optional[str]:
+    pp, vpp = cur["pp_degree"], cur.get("vpp_degree", 1)
+    geom: ModelGeometry = tuner_cfg["geometry"]
+    if pp > 1:
+        if geom.num_hidden_layers % (pp * vpp):
+            return f"pp*vpp {pp}*{vpp} does not divide layers {geom.num_hidden_layers}"
+        gbs = tuner_cfg["global_batch_size"]
+        micro = gbs // (cur["dp_degree"] * cur["sharding_degree"] * cur["micro_batch_size"])
+        if micro < pp:
+            return f"num_micro {micro} < pp {pp} (bubble-dominated)"
+    elif vpp > 1:
+        return "vpp > 1 requires pp > 1"
+    return None
+
+
+@register_prune
+def prune_by_mbs(tuner_cfg, cur, history=None) -> Optional[str]:
+    gbs = tuner_cfg["global_batch_size"]
+    dp = cur["dp_degree"] * cur["sharding_degree"]
+    mbs = cur["micro_batch_size"]
+    if gbs % dp:
+        return f"global batch {gbs} not divisible by dp*sharding {dp}"
+    local = gbs // dp
+    if local % mbs:
+        return f"local batch {local} not divisible by micro_batch {mbs}"
+    return None
+
+
+@register_prune
+def prune_by_sharding(tuner_cfg, cur, history=None) -> Optional[str]:
+    sd, st = cur["sharding_degree"], cur["sharding_stage"]
+    if sd == 1 and st > 1:
+        return "sharding_stage > 1 with sharding_degree 1"
+    if sd > 1 and cur["pp_degree"] > 1 and st == 3:
+        return "stage-3 param sharding inside pp stages unsupported"
+    return None
+
+
+@register_prune
+def prune_by_memory_estimation(tuner_cfg, cur, history=None) -> Optional[str]:
+    geom: ModelGeometry = tuner_cfg["geometry"]
+    gbs = tuner_cfg["global_batch_size"]
+    num_micro = max(
+        gbs // (cur["dp_degree"] * cur["sharding_degree"] * cur["micro_batch_size"]), 1
+    )
+    est = estimate_memory_bytes(
+        geom,
+        micro_batch_size=cur["micro_batch_size"],
+        mp=cur["mp_degree"], pp=cur["pp_degree"],
+        sharding_degree=cur["sharding_degree"],
+        sharding_stage=cur["sharding_stage"],
+        vpp=cur.get("vpp_degree", 1),
+        use_recompute=cur.get("use_recompute", False),
+        sequence_parallel=tuner_cfg.get("sequence_parallel", False),
+        num_micro=num_micro,
+    )
+    cur["estimated_memory_gb"] = round(est["total_gb"], 3)
+    budget = tuner_cfg.get("hbm_budget_gb", 15.75)
+    if est["total_gb"] > budget:
+        return (f"estimated {est['total_gb']:.2f} GiB exceeds HBM budget "
+                f"{budget} GiB")
+    return None
+
+
+@register_prune_history
+def prune_by_mbs_history(tuner_cfg, cur, history) -> Optional[str]:
+    """A smaller micro-batch that OOMed prunes every larger one (ref:
+    prune.py:361)."""
+    for h in same_cfgs_beside("micro_batch_size", cur, history):
+        if h.get("oom") and h["micro_batch_size"] <= cur["micro_batch_size"]:
+            return (f"micro_batch {h['micro_batch_size']} already OOMed "
+                    "with this placement")
+    return None
+
+
+@register_prune_history
+def prune_by_recompute_history(tuner_cfg, cur, history) -> Optional[str]:
+    """If recompute=True OOMed, recompute=False will too (ref:
+    prune.py:547)."""
+    if not cur.get("use_recompute", False):
+        for h in same_cfgs_beside("use_recompute", cur, history):
+            if h.get("oom") and h.get("use_recompute"):
+                return "recompute=True already OOMed; False needs more memory"
+    return None
+
+
+def run_prunes(tuner_cfg, cur, history) -> Optional[str]:
+    for rule in _PRUNES:
+        reason = rule(tuner_cfg, cur, history)
+        if reason:
+            return reason
+    for rule in _HISTORY_PRUNES:
+        reason = rule(tuner_cfg, cur, history)
+        if reason:
+            return reason
+    return None

@@ -1,0 +1,75 @@
+"""History recorder for measured configs (ref:
+python/paddle/distributed/auto_tuner/recorder.py:23 HistoryRecorder —
+add_cfg / sort_metric / get_best / store_history CSV / load_history)."""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+
+class HistoryRecorder:
+    def __init__(self, metric_name: str = "step_time_ms", direction: str = "min"):
+        self.metric_name = metric_name
+        self.direction = direction
+        self.history: List[dict] = []
+
+    def add_cfg(self, **kwargs):
+        self.history.append(dict(kwargs))
+
+    def sort_metric(self) -> None:
+        def key(c):
+            v = c.get("metric")
+            if v is None:
+                return float("inf")
+            return v if self.direction == "min" else -v
+
+        self.history.sort(key=key)
+
+    def get_best(self) -> Tuple[Optional[dict], bool]:
+        """(best_cfg, found). Pruned/OOM/failed entries never win."""
+        self.sort_metric()
+        for c in self.history:
+            if c.get("metric") is not None and not c.get("oom"):
+                return c, True
+        return None, False
+
+    def store_history(self, path: str = "./history.csv") -> None:
+        if not self.history:
+            return
+        keys: List[str] = []
+        for c in self.history:
+            for k in c:
+                if k not in keys:
+                    keys.append(k)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            for c in self.history:
+                w.writerow(c)
+
+    def load_history(self, path: str = "./history.csv") -> Tuple[List[dict], bool]:
+        if not os.path.exists(path):
+            return [], False
+        with open(path) as f:
+            rows = list(csv.DictReader(f))
+        for r in rows:
+            for k, v in r.items():
+                if v == "":
+                    r[k] = None
+                elif v in ("True", "False"):
+                    r[k] = v == "True"
+                else:
+                    try:
+                        r[k] = int(v)
+                    except (TypeError, ValueError):
+                        try:
+                            r[k] = float(v)
+                        except (TypeError, ValueError):
+                            pass
+        self.history = rows
+        return rows, True
+
+    def clean_history(self) -> None:
+        self.history = []

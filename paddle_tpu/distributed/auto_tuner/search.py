@@ -1,0 +1,129 @@
+"""Candidate generation + search algorithms (ref:
+python/paddle/distributed/auto_tuner/search.py:31 SearchAlgo /
+:48 GridSearch, utils.py default_candidates).
+
+GridSearch enumerates the feasible (dp, sharding, mp, pp, vpp, mbs,
+recompute) lattice; CostModelSearch orders the same lattice by an
+analytic TPU step-time score (MXU FLOPs + pipeline bubble + recompute
+tax + mp collective volume over ICI) so the best few candidates can be
+measured first — the reference's dp_estimation search with a real cost
+model instead of per-dp reuse."""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from .prune import run_prunes
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(tuner_cfg) -> List[dict]:
+    """All (dp, sharding+stage, mp, pp, vpp, mbs, recompute) combos whose
+    degree product equals num_devices (pre-prune)."""
+    n = tuner_cfg["num_devices"]
+    gbs = tuner_cfg["global_batch_size"]
+    geom = tuner_cfg["geometry"]
+    mbs_cands = tuner_cfg.get("micro_batch_size_candidates") or [
+        m for m in (1, 2, 4, 8, 16, 32) if m <= gbs
+    ]
+    vpp_cands = tuner_cfg.get("vpp_candidates") or [1, 2]
+    stage_cands = tuner_cfg.get("sharding_stage_candidates") or [1, 2, 3]
+    recompute_cands = tuner_cfg.get("recompute_candidates") or [False, True]
+    out = []
+    for mp in _divisors(n):
+        for pp in _divisors(n // mp):
+            rest = n // (mp * pp)
+            for sharding in _divisors(rest):
+                dp = rest // sharding
+                stages = stage_cands if sharding > 1 else [1]
+                vpps = [v for v in vpp_cands if pp > 1 or v == 1]
+                for stage in stages:
+                    for vpp in vpps:
+                        for mbs in mbs_cands:
+                            for rc in recompute_cands:
+                                out.append({
+                                    "dp_degree": dp,
+                                    "sharding_degree": sharding,
+                                    "sharding_stage": stage,
+                                    "mp_degree": mp,
+                                    "pp_degree": pp,
+                                    "vpp_degree": vpp,
+                                    "micro_batch_size": mbs,
+                                    "use_recompute": rc,
+                                })
+    return out
+
+
+def cost_score(tuner_cfg, cfg) -> float:
+    """Analytic relative step time (lower is better). Absolute scale is
+    arbitrary; only the ordering matters."""
+    geom = tuner_cfg["geometry"]
+    gbs = tuner_cfg["global_batch_size"]
+    P = geom.param_count()
+    tokens = gbs * geom.seq_length
+    n = tuner_cfg["num_devices"]
+    # compute: 6PT flops, + ~33% fwd tax under full recompute (8PT)
+    flops = (8.0 if cfg.get("use_recompute") else 6.0) * P * tokens / n
+    # pipeline bubble (1F1B with vpp interleave)
+    pp, vpp = cfg["pp_degree"], cfg.get("vpp_degree", 1)
+    num_micro = max(
+        gbs // (cfg["dp_degree"] * cfg["sharding_degree"] * cfg["micro_batch_size"]), 1
+    )
+    bubble = (pp - 1) / (num_micro * vpp + pp - 1) if pp > 1 else 0.0
+    # mp collectives: 4 all-reduces of s*b*h per layer per micro-step,
+    # ring cost ~ 2(mp-1)/mp * volume; fold into a relative penalty
+    # against the matmul flops with an ICI compute/bw ratio knob
+    mp = cfg["mp_degree"]
+    comm = 0.0
+    if mp > 1:
+        vol = 4.0 * geom.seq_length * cfg["micro_batch_size"] * geom.hidden_size \
+            * geom.num_hidden_layers / cfg["pp_degree"] * num_micro
+        comm = tuner_cfg.get("ici_flops_per_byte", 300.0) * 2 * (mp - 1) / mp * vol
+    # stage-3 regather: all-gather params each step
+    if cfg["sharding_stage"] == 3:
+        comm += tuner_cfg.get("ici_flops_per_byte", 300.0) * 2 * P / cfg["sharding_degree"]
+    return (flops + comm) / (1.0 - bubble)
+
+
+class SearchAlgo(ABC):
+    def __init__(self, tuner_cfg):
+        self.tuner_cfg = tuner_cfg
+        self.candidates = list(tuner_cfg["candidates"])
+        self.idx = 0
+
+    @abstractmethod
+    def search_once(self, history_cfgs) -> Optional[dict]:
+        ...
+
+    def _next_unpruned(self, history_cfgs):
+        while self.idx < len(self.candidates):
+            cur = dict(self.candidates[self.idx])
+            self.idx += 1
+            reason = run_prunes(self.tuner_cfg, cur, history_cfgs)
+            if reason is None:
+                return cur
+            if self.tuner_cfg.get("log_pruned"):
+                cur["pruned"] = reason
+                self.tuner_cfg.setdefault("pruned_cfgs", []).append(cur)
+        return None
+
+
+class GridSearch(SearchAlgo):
+    """ref: search.py:48 — enumerate in lattice order."""
+
+    def search_once(self, history_cfgs):
+        return self._next_unpruned(history_cfgs)
+
+
+class CostModelSearch(SearchAlgo):
+    """Candidates ordered best-first by the analytic cost model."""
+
+    def __init__(self, tuner_cfg):
+        super().__init__(tuner_cfg)
+        self.candidates.sort(key=lambda c: cost_score(tuner_cfg, c))
+
+    def search_once(self, history_cfgs):
+        return self._next_unpruned(history_cfgs)

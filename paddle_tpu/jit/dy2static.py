@@ -69,11 +69,18 @@ def _tracer_of(x):
 
 
 def _as_bool(x):
-    from ..base.tensor import Tensor
-
-    if isinstance(x, Tensor):
-        return bool(x)
     return bool(x)
+
+
+def _is_undef(x):
+    return isinstance(x, _Undef)
+
+
+def _epilogue(loc: dict, names: Tuple[str, ...]):
+    """Collect converted-region outputs from a branch/body's locals();
+    names unbound on this path (never assigned, or deleted by a nested
+    region's cleanup) come back as _Undef sentinels."""
+    return tuple(loc.get(n, _Undef(n)) for n in names)
 
 
 def _select_leaf(pred, a, b):
@@ -192,6 +199,11 @@ def _assigned_names(stmts: Sequence[ast.stmt]) -> Tuple[List[str], bool]:
             out.append(name)
 
     class V(ast.NodeVisitor):
+        def visit_If(self, node):
+            if getattr(node, "_pt_cleanup", False):
+                return  # generated undef-cleanup; its del is not user code
+            self.generic_visit(node)
+
         def visit_Name(self, node):
             nonlocal has_del
             if isinstance(node.ctx, ast.Del):
@@ -224,11 +236,12 @@ def _assigned_names(stmts: Sequence[ast.stmt]) -> Tuple[List[str], bool]:
     return out, has_del
 
 
-def _has_abrupt_exit(stmts: Sequence[ast.stmt], top_level_loop: bool) -> bool:
+def _has_abrupt_exit(stmts: Sequence[ast.stmt]) -> bool:
     """True if the region contains flow that escapes it: return/yield
     anywhere in this scope, or break/continue not enclosed in a loop
-    inside the region (for `while` conversion the loop itself is the
-    target, so top-level break/continue also count)."""
+    nested inside the region (for an `if`-region they target an outer
+    loop; for a `while`-region the converted loop itself — either way
+    the generated closure cannot express them)."""
     found = False
 
     def walk(node, loop_depth):
@@ -245,9 +258,8 @@ def _has_abrupt_exit(stmts: Sequence[ast.stmt], top_level_loop: bool) -> bool:
         for child in ast.iter_child_nodes(node):
             walk(child, loop_depth + inc)
 
-    depth0 = 0 if not top_level_loop else 0
     for s in stmts:
-        walk(s, depth0)
+        walk(s, 0)
     return found
 
 
@@ -257,6 +269,19 @@ def _name(n, ctx=None):
 
 def _tuple_of(names, ctx=None):
     return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names], ctx=ctx or ast.Load())
+
+
+def _epilogue_return(names):
+    """``return _paddle_tpu_jst._epilogue(locals(), ('a', 'b'))`` —
+    tolerates names left unbound on this path (returned as _Undef)."""
+    return ast.Return(value=ast.Call(
+        func=ast.Attribute(value=_name(_RUNTIME_NAME), attr="_epilogue", ctx=ast.Load()),
+        args=[
+            ast.Call(func=_name("locals"), args=[], keywords=[]),
+            ast.Tuple(elts=[ast.Constant(value=n) for n in names], ctx=ast.Load()),
+        ],
+        keywords=[],
+    ))
 
 
 def _fn_args(names):
@@ -286,6 +311,26 @@ def _init_stmts(names, uid):
     return stmts, [f"_pt_init_{uid}_{v}" for v in names]
 
 
+def _undef_cleanup_stmts(names):
+    """``if _is_undef(v): del v`` for each converted var, so a variable
+    left unbound on the taken path raises UnboundLocalError on later use
+    exactly as un-transformed Python would."""
+    out = []
+    for v in names:
+        test = ast.Call(
+            func=ast.Attribute(value=_name(_RUNTIME_NAME), attr="_is_undef", ctx=ast.Load()),
+            args=[_name(v)], keywords=[],
+        )
+        node = ast.If(
+            test=test,
+            body=[ast.Delete(targets=[_name(v, ast.Del())])],
+            orelse=[],
+        )
+        node._pt_cleanup = True  # outer passes must ignore this del
+        out.append(node)
+    return out
+
+
 class _Transformer(ast.NodeTransformer):
     def __init__(self):
         self.changed = False
@@ -305,24 +350,27 @@ class _Transformer(ast.NodeTransformer):
         return node
 
     def visit_If(self, node):
+        if getattr(node, "_pt_cleanup", False):
+            return node
         self.generic_visit(node)
         if self._blocked:
             return node
         assigned, has_del = _assigned_names(node.body + node.orelse)
         if not assigned or has_del:
             return node
-        if _has_abrupt_exit(node.body, False) or _has_abrupt_exit(node.orelse, False):
+        if _has_abrupt_exit(node.body) or _has_abrupt_exit(node.orelse):
             return node
         uid = self._next()
         tname, fname = f"_pt_true_{uid}", f"_pt_false_{uid}"
-        ret = ast.Return(value=_tuple_of(assigned))
         true_def = ast.FunctionDef(
-            name=tname, args=_fn_args(assigned), body=list(node.body) + [ret],
+            name=tname, args=_fn_args(assigned),
+            body=list(node.body) + [_epilogue_return(assigned)],
             decorator_list=[], returns=None, type_comment=None, type_params=[],
         )
         false_body = list(node.orelse) if node.orelse else [ast.Pass()]
         false_def = ast.FunctionDef(
-            name=fname, args=_fn_args(assigned), body=false_body + [ast.Return(value=_tuple_of(assigned))],
+            name=fname, args=_fn_args(assigned),
+            body=false_body + [_epilogue_return(assigned)],
             decorator_list=[], returns=None, type_comment=None, type_params=[],
         )
         inits, init_names = _init_stmts(assigned, uid)
@@ -336,7 +384,7 @@ class _Transformer(ast.NodeTransformer):
             ),
         )
         self.changed = True
-        return [true_def, false_def, *inits, call]
+        return [true_def, false_def, *inits, call, *_undef_cleanup_stmts(assigned)]
 
     def visit_While(self, node):
         self.generic_visit(node)
@@ -345,7 +393,7 @@ class _Transformer(ast.NodeTransformer):
         assigned, has_del = _assigned_names(node.body)
         if not assigned or has_del:
             return node
-        if _has_abrupt_exit(node.body, True):
+        if _has_abrupt_exit(node.body):
             return node
         uid = self._next()
         cname, bname = f"_pt_cond_{uid}", f"_pt_body_{uid}"
@@ -356,7 +404,7 @@ class _Transformer(ast.NodeTransformer):
         )
         body_def = ast.FunctionDef(
             name=bname, args=_fn_args(assigned),
-            body=list(node.body) + [ast.Return(value=_tuple_of(assigned))],
+            body=list(node.body) + [_epilogue_return(assigned)],
             decorator_list=[], returns=None, type_comment=None, type_params=[],
         )
         inits, init_names = _init_stmts(assigned, uid)
@@ -371,7 +419,7 @@ class _Transformer(ast.NodeTransformer):
             ),
         )
         self.changed = True
-        return [cond_def, body_def, *inits, call]
+        return [cond_def, body_def, *inits, call, *_undef_cleanup_stmts(assigned)]
 
 
 def convert(fn: Callable) -> Callable:

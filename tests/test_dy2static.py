@@ -227,6 +227,18 @@ class TestConvertEdgeCases:
         x = paddle.to_tensor(np.array([3.0], np.float32))
         np.testing.assert_allclose(conv(x).numpy(), [8.0])
 
+    def test_unbound_after_untaken_branch_raises_like_eager(self):
+        def f(x, flag):
+            if flag:
+                y = x * 2.0
+            return y
+
+        conv = dy2static.convert(f)
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(conv(x, True).numpy(), [2.0])
+        with pytest.raises(UnboundLocalError):
+            conv(x, False)
+
     def test_closure_cells_stay_live(self):
         holder = {"scale": 2.0}
 
