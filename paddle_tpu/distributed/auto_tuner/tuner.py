@@ -45,7 +45,12 @@ class AutoTuner:
             tuner_cfg.get("metric_name", "step_time_ms"),
             tuner_cfg.get("metric_direction", "min"),
         )
-        self.history_cfgs = self.recorder.history
+
+    @property
+    def history_cfgs(self):
+        # live view: load_history/clean_history rebind recorder.history,
+        # so an aliased list would silently detach history-based pruning
+        return self.recorder.history
 
     def search_once(self) -> Optional[dict]:
         if self.cur_task_id >= self.task_limit:

@@ -18,6 +18,7 @@ from ...nn import functional as F
 __all__ = [
     "fused_linear", "fused_feedforward", "fused_multi_head_attention",
     "fused_rms_norm", "fused_rotary_position_embedding",
+    "masked_multihead_attention", "block_multihead_attention",
 ]
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
@@ -179,3 +180,191 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 
     args = [q] + [t for t in (k, v, sin, cos, position_ids) if t is not None]
     return apply(f, *args, op_name="fused_rope")
+
+
+def masked_multihead_attention(
+    x, cache_kv=None, bias=None, src_mask=None, cum_offsets=None,
+    sequence_lengths=None, rotary_tensor=None, beam_cache_offset=None,
+    qkv_out_scale=None, out_shift=None, out_smooth=None, seq_len=1,
+    rotary_emb_dims=0, use_neox_rotary_style=False, compute_dtype="default",
+    out_scale=-1, quant_round_type=1, quant_max_bound=127.0,
+    quant_min_bound=-127.0,
+):
+    """Single-token decode attention over a dense KV cache (ref:
+    python/paddle/incubate/nn/functional/masked_multihead_attention.py —
+    the decoder MMHA kernel in phi/kernels/fusion/gpu).
+
+    x: [B, 3*num_head*head_dim] fused qkv for ONE new token per
+    sequence. cache_kv: [2, B, num_head, max_seq, head_dim];
+    sequence_lengths: [B] current cache lengths (tokens already
+    stored). Returns (out [B, num_head*head_dim], cache_kv updated).
+    Quant/smooth/beam arguments are not supported (raise if set) —
+    quantized execution lives in paddle_tpu.nn.quant.
+    """
+    for name, val in (("qkv_out_scale", qkv_out_scale),
+                      ("out_shift", out_shift), ("out_smooth", out_smooth),
+                      ("beam_cache_offset", beam_cache_offset),
+                      ("rotary_tensor", rotary_tensor),
+                      ("cum_offsets", cum_offsets)):
+        if val is not None:
+            raise NotImplementedError(f"masked_multihead_attention: {name}")
+    if out_scale != -1:
+        raise NotImplementedError(
+            "masked_multihead_attention: out_scale quantization"
+        )
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+
+    def f(xx, ckv, *rest):
+        import jax
+
+        rest = list(rest)
+        b_ = bias is not None and rest.pop(0)
+        mask = src_mask is not None and rest.pop(0)
+        seqlens = sequence_lengths is not None and rest.pop(0)
+        two, b, h, max_s, d = ckv.shape
+        if b_ is not False and b_ is not None:
+            xx = xx + b_
+        qkv = xx.reshape(b, 3, h, d)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [B, H, D]
+        if seqlens is False or seqlens is None:
+            pos = jnp.zeros((b,), jnp.int32)
+        else:
+            pos = seqlens.reshape(b).astype(jnp.int32)
+        bi = jnp.arange(b)
+        ckv = ckv.at[0, bi, :, pos].set(k)
+        ckv = ckv.at[1, bi, :, pos].set(v)
+        kc, vc = ckv[0], ckv[1]  # [B, H, S, D]
+        scores = jnp.einsum("bhd,bhsd->bhs", q, kc) / jnp.sqrt(
+            jnp.asarray(d, jnp.float32)
+        ).astype(q.dtype)
+        valid = jnp.arange(max_s)[None, :] <= pos[:, None]  # [B, S]
+        scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+        if mask is not False and mask is not None:
+            scores = scores + mask.reshape(b, 1, -1)[:, :, :max_s]
+        p = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhs,bhsd->bhd", p, vc).reshape(b, h * d)
+        return out, ckv
+
+    args = [x, cache_kv] + [
+        t for t in (bias, src_mask, sequence_lengths) if t is not None
+    ]
+    return apply(f, *args, op_name="masked_multihead_attention")
+
+
+def block_multihead_attention(
+    qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+    seq_lens_this_time, padding_offsets, cum_offsets, cu_seqlens_q,
+    cu_seqlens_k, block_tables, pre_key_cache=None, pre_value_cache=None,
+    cache_k_quant_scales=None, cache_v_quant_scales=None,
+    cache_k_dequant_scales=None, cache_v_dequant_scales=None,
+    qkv_out_scale=None, qkv_bias=None, out_shift=None, out_smooth=None,
+    max_enc_len_this_time=None, max_dec_len_this_time=None, rope_emb=None,
+    mask=None, tgt_mask=None, max_seq_len=-1, block_size=64,
+    use_neox_style=False, use_dynamic_cachekv_quant=False,
+    quant_round_type=1, quant_max_bound=127.0, quant_min_bound=-127.0,
+    out_scale=-1, compute_dtype="default",
+):
+    """Paged (block-table) attention over mixed prefill/decode batches
+    (ref: python/paddle/incubate/nn/functional/
+    block_multihead_attention.py; kernels in
+    phi/kernels/fusion/gpu/block_multi_head_attention_kernel).
+
+    qkv: [token_num, (q_heads + 2*kv_heads)*head_dim] packed varlen
+    tokens; key_cache/value_cache: [max_block_num, kv_heads,
+    block_size, head_dim] pools (the reference layout); block_tables:
+    [B, max_blocks_per_seq]. Sequences with seq_lens_encoder[i] > 0 are
+    prefills (cache written from position 0); others decode from
+    position seq_lens_decoder[i]. Returns (out, qkv, key_cache,
+    value_cache) like the reference.
+
+    The varlen bookkeeping is host-side (this is the eager serving
+    surface; the jit-compiled production decode path is
+    models.generation.generate(block_size=...) over
+    ops/paged_attention.py). Quant/smooth/pre-cache args unsupported.
+    """
+    import numpy as np
+
+    for name, val in (
+        ("pre_key_cache", pre_key_cache), ("pre_value_cache", pre_value_cache),
+        ("cache_k_quant_scales", cache_k_quant_scales),
+        ("cache_v_quant_scales", cache_v_quant_scales),
+        ("cache_k_dequant_scales", cache_k_dequant_scales),
+        ("cache_v_dequant_scales", cache_v_dequant_scales),
+        ("qkv_out_scale", qkv_out_scale), ("out_shift", out_shift),
+        ("out_smooth", out_smooth), ("rope_emb", rope_emb),
+        ("mask", mask), ("tgt_mask", tgt_mask),
+    ):
+        if val is not None:
+            raise NotImplementedError(f"block_multihead_attention: {name}")
+
+    from ...base.tensor import Tensor
+
+    def _np(t):
+        import jax as _jax
+
+        return np.asarray(_jax.device_get(t._data if isinstance(t, Tensor) else t))
+
+    enc = _np(seq_lens_encoder).reshape(-1).astype(np.int64)
+    dec = _np(seq_lens_decoder).reshape(-1).astype(np.int64)
+    now = _np(seq_lens_this_time).reshape(-1).astype(np.int64)
+    cu_q = _np(cu_seqlens_q).reshape(-1).astype(np.int64)
+    tables = _np(block_tables)
+    bsz = now.shape[0]
+
+    import jax
+
+    qkv_a = qkv._data if isinstance(qkv, Tensor) else jnp.asarray(qkv)
+    kc = key_cache._data if isinstance(key_cache, Tensor) else jnp.asarray(key_cache)
+    vc = value_cache._data if isinstance(value_cache, Tensor) else jnp.asarray(value_cache)
+    if qkv_bias is not None:
+        qkv_a = qkv_a + (qkv_bias._data if isinstance(qkv_bias, Tensor) else jnp.asarray(qkv_bias))
+    kvh, bs, d = kc.shape[1], kc.shape[2], kc.shape[3]
+    qh = qkv_a.shape[-1] // d - 2 * kvh
+
+    # one scatter per pool: sequences own disjoint blocks, so all new
+    # tokens across the batch write in a single .at[].set (a per-sequence
+    # set would copy the whole pool once per sequence)
+    seq_meta, all_phys, all_off, all_k, all_v = [], [], [], [], []
+    for i in range(bsz):
+        s_i = int(now[i])
+        if s_i == 0:
+            continue
+        start = 0 if enc[i] > 0 else int(dec[i])
+        rows = qkv_a[int(cu_q[i]): int(cu_q[i]) + s_i]
+        q = rows[:, : qh * d].reshape(s_i, qh, d)
+        k = rows[:, qh * d: (qh + kvh) * d].reshape(s_i, kvh, d)
+        v = rows[:, (qh + kvh) * d:].reshape(s_i, kvh, d)
+        pos = np.arange(start, start + s_i)
+        all_phys.append(tables[i][pos // bs])
+        all_off.append(pos % bs)
+        all_k.append(k)
+        all_v.append(v)
+        seq_meta.append((i, s_i, start, q))
+    if all_phys:
+        phys_cat = np.concatenate(all_phys)
+        off_cat = np.concatenate(all_off)
+        kc = kc.at[phys_cat, :, off_cat].set(jnp.concatenate(all_k, axis=0))
+        vc = vc.at[phys_cat, :, off_cat].set(jnp.concatenate(all_v, axis=0))
+
+    outs = []
+    for i, s_i, start, q in seq_meta:
+        # gather the sequence's cache back [total, kvh, d]
+        total = start + s_i
+        gpos = np.arange(total)
+        gphys, goff = tables[i][gpos // bs], gpos % bs
+        ks = kc[gphys, :, goff]
+        vs = vc[gphys, :, goff]
+        # GQA: repeat kv heads up to q heads
+        rep = qh // kvh
+        ks_r = jnp.repeat(ks, rep, axis=1)
+        vs_r = jnp.repeat(vs, rep, axis=1)
+        scores = jnp.einsum("qhd,khd->hqk", q, ks_r) / np.sqrt(d).astype(np.float32)
+        causal = (np.arange(total)[None, :] <= (start + np.arange(s_i))[:, None])
+        scores = jnp.where(causal[None], scores, -jnp.inf)
+        p = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+        outs.append(jnp.einsum("hqk,khd->qhd", p, vs_r).reshape(s_i, qh * d))
+
+    out = jnp.concatenate(outs, axis=0) if outs else jnp.zeros((0, qh * d), qkv_a.dtype)
+    mk = lambda a: Tensor(a, _internal=True)  # noqa: E731
+    return mk(out), mk(qkv_a), mk(kc), mk(vc)

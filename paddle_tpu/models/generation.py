@@ -55,31 +55,58 @@ def update_kv_cache(kk, vv, kc, vc, cl, s: int):
 
 class _KVCacheState:
     """Holds cache tensors as non-persistable buffers of a Layer so the
-    compiled step threads + donates them (see module docstring)."""
+    compiled step threads + donates them (see module docstring).
+    ``block_size`` switches to the paged (block-table) cache layout
+    (ops/paged_attention.py)."""
 
-    def __init__(self, model, batch, max_len):
+    def __init__(self, model, batch, max_len, block_size=None):
         from ..nn.layer.layers import Layer
 
         class Holder(Layer):
             pass
 
         self.holder = Holder()
-        caches = model.init_cache(batch, max_len)
+        self.paged = block_size is not None
+        kwargs = {"block_size": block_size} if self.paged else {}
+        caches = model.init_cache(batch, max_len, **kwargs)
         self.n = len(caches)
         self.shapes_dtypes = []
-        for i, (k, v) in enumerate(caches):
-            self.holder.register_buffer(f"k{i}", k, persistable=False)
-            self.holder.register_buffer(f"v{i}", v, persistable=False)
-            self.shapes_dtypes.append((tuple(k.shape), k._data.dtype))
+        if self.paged:
+            from ..ops.paged_attention import PagedLayerCache  # noqa: F401
+
+            self._tables = caches[0].block_tables
+            for i, c in enumerate(caches):
+                self.holder.register_buffer(f"k{i}", c.k_pool, persistable=False)
+                self.holder.register_buffer(f"v{i}", c.v_pool, persistable=False)
+                self.shapes_dtypes.append(
+                    (tuple(c.k_pool.shape), c.k_pool._data.dtype)
+                )
+        else:
+            for i, (k, v) in enumerate(caches):
+                self.holder.register_buffer(f"k{i}", k, persistable=False)
+                self.holder.register_buffer(f"v{i}", v, persistable=False)
+                self.shapes_dtypes.append((tuple(k.shape), k._data.dtype))
 
     def caches(self):
+        if self.paged:
+            from ..ops.paged_attention import PagedLayerCache
+
+            return [
+                PagedLayerCache(
+                    self.holder._buffers[f"k{i}"],
+                    self.holder._buffers[f"v{i}"],
+                    self._tables,
+                )
+                for i in range(self.n)
+            ]
         return [
             (self.holder._buffers[f"k{i}"], self.holder._buffers[f"v{i}"])
             for i in range(self.n)
         ]
 
     def set(self, new_caches):
-        for i, (k, v) in enumerate(new_caches):
+        for i, c in enumerate(new_caches):
+            k, v = (c.k_pool, c.v_pool) if self.paged else (c[0], c[1])
             self.holder._buffers[f"k{i}"]._data = k._data
             self.holder._buffers[f"v{i}"]._data = v._data
 
@@ -105,12 +132,13 @@ def _sample(logits, temperature: float, top_k: int):
     return apply(f, logits, op_name="sample_token")
 
 
-def _get_compiled(model, b, s, max_len, temperature, top_k, use_jit):
+def _get_compiled(model, b, s, max_len, temperature, top_k, use_jit,
+                  block_size=None):
     """Build (or fetch) the prefill/decode programs + cache state for
     this (batch, prompt-len, max-len, sampling) signature."""
     from .. import jit
 
-    key = (b, s, max_len, temperature, top_k, use_jit)
+    key = (b, s, max_len, temperature, top_k, use_jit, block_size)
     store = getattr(model, "_generation_programs", None)
     if store is None:
         store = model._generation_programs = {}
@@ -125,7 +153,7 @@ def _get_compiled(model, b, s, max_len, temperature, top_k, use_jit):
     while len(store) >= 4:
         store.pop(next(iter(store)))
 
-    state = _KVCacheState(model, b, max_len)
+    state = _KVCacheState(model, b, max_len, block_size=block_size)
 
     def prefill(ids, cur_len):
         logits, new = model.forward_with_cache(ids, state.caches(), cur_len)
@@ -148,14 +176,18 @@ def _get_compiled(model, b, s, max_len, temperature, top_k, use_jit):
 
 def generate(model, input_ids, max_new_tokens: int = 32,
              temperature: float = 0.0, top_k: int = 0,
-             eos_token_id: Optional[int] = None, use_jit: bool = True):
+             eos_token_id: Optional[int] = None, use_jit: bool = True,
+             block_size: Optional[int] = None):
     """Generate ``max_new_tokens`` continuations of ``input_ids``
     ([B, S] int Tensor) with KV caching. Returns [B, S + new] ids.
 
     ``model`` must provide ``init_cache(batch, max_len)`` and
     ``forward_with_cache(ids, caches, cur_len) -> (logits, caches)``
-    (models.LlamaForCausalLM / GPTForCausalLM do).
-    """
+    (models.LlamaForCausalLM / GPTForCausalLM do). ``block_size``
+    switches to the paged (block-table) KV cache — same tokens, pool
+    memory layout (ref: block_multihead_attention); the model's
+    ``init_cache`` must accept ``block_size`` and its attention must
+    handle PagedLayerCache (LlamaForCausalLM does; GPT is dense-only)."""
     from .. import to_tensor
     from ..base.tape import no_grad
 
@@ -175,7 +207,8 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     try:
         with no_grad():
             state, prefill, decode = _get_compiled(
-                model, b, s, max_len, temperature, top_k, use_jit
+                model, b, s, max_len, temperature, top_k, use_jit,
+                block_size=block_size,
             )
             zero = to_tensor(np.asarray(0, np.int32))
             tok = prefill(input_ids, zero)

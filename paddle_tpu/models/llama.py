@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
+from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -113,6 +114,33 @@ class LlamaAttention(nn.Layer):
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True, training=self.training)
             out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
             return self.o_proj(out)
+
+        from ..ops.paged_attention import PagedLayerCache
+
+        if isinstance(cache, PagedLayerCache):
+            # paged (block) cache: scatter into pools, attend over the
+            # gathered view — token-for-token identical to dense
+            def pstep(qq, kk, vv, kp, vp, tbl, cl):
+                from ..ops.paged_attention import paged_update_kv_cache
+
+                qq, kk = _rope(qq, kk, theta, cl.astype(jnp.float32))
+                kp, vp, kc, vc, mask = paged_update_kv_cache(
+                    kk, vv, kp, vp, tbl, cl, s
+                )
+                return qq, kp, vp, kc, vc, mask
+
+            q, k_pool, v_pool, kc, vc, mask = apply(
+                pstep, q, k, v, cache.k_pool, cache.v_pool,
+                cache.block_tables, cur_len, op_name="paged_kv_cache_update",
+            )
+            out = F.scaled_dot_product_attention(
+                q, kc, vc, attn_mask=mask, is_causal=False,
+                training=self.training,
+            )
+            out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(out), PagedLayerCache(
+                k_pool, v_pool, cache.block_tables
+            )
 
         k_cache, v_cache = cache
 
@@ -215,14 +243,28 @@ class LlamaForCausalLM(nn.Layer):
         return self.lm_head(h)
 
     # -- KV-cache generation (see models/generation.py) -----------------
-    def init_cache(self, batch: int, max_len: int, dtype=None):
+    def init_cache(self, batch: int, max_len: int, dtype=None,
+                   block_size: Optional[int] = None, num_blocks=None,
+                   tables=None):
+        """Dense caches by default; pass ``block_size`` for a paged
+        (block-table) cache (ref: block_multihead_attention serving
+        layout — see ops/paged_attention.py)."""
+        c = self.config
+        dt = dtype or self.llama.embed_tokens.weight.dtype
+        head_dim = c.hidden_size // c.num_attention_heads
+        if block_size is not None:
+            from ..ops.paged_attention import alloc_paged_kv_caches
+
+            return alloc_paged_kv_caches(
+                c.num_hidden_layers, batch, max_len, c.num_key_value_heads,
+                head_dim, dt, block_size=block_size, num_blocks=num_blocks,
+                tables=tables,
+            )
         from .generation import alloc_kv_caches
 
-        c = self.config
         return alloc_kv_caches(
             c.num_hidden_layers, batch, max_len, c.num_key_value_heads,
-            c.hidden_size // c.num_attention_heads,
-            dtype or self.llama.embed_tokens.weight.dtype,
+            head_dim, dt,
         )
 
     def forward_with_cache(self, input_ids, caches, cur_len):

@@ -1,0 +1,160 @@
+"""Paged (block) KV cache for serving-grade decode.
+
+TPU-native counterpart of the reference's paged-attention serving
+stack (ref: python/paddle/incubate/nn/functional/
+block_multihead_attention.py — key/value caches laid out as
+[max_block_num, num_head, block_size, head_size] pools indexed by
+per-sequence block tables; kernels in
+paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel).
+
+Design:
+- ``k_pool``/``v_pool`` are [num_blocks, block_size, kv_heads, head_dim]
+  pools per layer; ``block_tables`` is a [batch, max_blocks_per_seq]
+  int32 map from a sequence's logical block to a physical pool slot
+  (shared by all layers — each layer has its own pools but the layout
+  is identical). All shapes are static, so the decode step stays one
+  cached XLA program.
+- Writes scatter the new tokens to (table[pos//bs], pos%bs) with
+  ``Array.at[...].set`` — a static-shape scatter XLA fuses into the
+  step. Reads gather the table back into a [batch, max_len] view and
+  run the same masked attention as the dense path, making paged decode
+  token-for-token identical to the dense cache by construction.
+- ``BlockManager`` is the host-side allocator (free list, per-sequence
+  allocation/free) for serving loops where sequences join and leave the
+  batch; ``contiguous_tables`` is the trivial layout ``generate`` uses.
+
+The memory win over the dense [B, max_len, ...] cache: the pool is
+sized by blocks actually needed (sum of ceil(len/bs)), not
+B * max_len, and freed sequences return blocks to the pool.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "PagedLayerCache", "BlockManager", "contiguous_tables",
+    "alloc_paged_kv_caches", "paged_update_kv_cache", "paged_gather_kv",
+]
+
+
+class PagedLayerCache(NamedTuple):
+    """One layer's paged cache: pools + the (shared) block table."""
+
+    k_pool: object  # Tensor [num_blocks, block_size, kv_heads, head_dim]
+    v_pool: object
+    block_tables: object  # Tensor [batch, max_blocks_per_seq] int32
+
+
+def contiguous_tables(batch: int, max_len: int, block_size: int) -> np.ndarray:
+    """Dense layout: sequence b owns blocks [b*n, (b+1)*n)."""
+    per_seq = -(-max_len // block_size)
+    return (
+        np.arange(batch * per_seq, dtype=np.int32).reshape(batch, per_seq)
+    )
+
+
+class BlockManager:
+    """Host-side free-list allocator for serving (ref: the block table
+    management inside the reference's AppendAttention/BlockMHA serving
+    path — here a small Python object, since the single-controller
+    runtime owns the whole batch)."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, -1, -1))
+        self._owned: dict = {}
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, seq_id, num_tokens: int) -> List[int]:
+        """Ensure seq_id owns enough blocks for num_tokens; returns the
+        full block list."""
+        owned = self._owned.setdefault(seq_id, [])
+        need = -(-num_tokens // self.block_size) - len(owned)
+        if need > len(self._free):
+            raise RuntimeError(
+                f"paged KV cache exhausted: need {need} blocks, "
+                f"{len(self._free)} free (of {self.num_blocks})"
+            )
+        for _ in range(max(need, 0)):
+            owned.append(self._free.pop())
+        return list(owned)
+
+    def free_sequence(self, seq_id) -> None:
+        for b in self._owned.pop(seq_id, []):
+            self._free.append(b)
+
+    def table_row(self, seq_id, max_blocks_per_seq: int) -> np.ndarray:
+        row = np.zeros((max_blocks_per_seq,), np.int32)
+        owned = self._owned.get(seq_id, [])
+        row[: len(owned)] = owned
+        return row
+
+
+def alloc_paged_kv_caches(
+    num_layers: int, batch: int, max_len: int, num_kv_heads: int,
+    head_dim: int, dtype, block_size: int = 64,
+    num_blocks: Optional[int] = None,
+    tables: Optional[np.ndarray] = None,
+) -> List[PagedLayerCache]:
+    """Per-layer paged caches with a shared block table."""
+    from ..base.tensor import Tensor
+
+    per_seq = -(-max_len // block_size)
+    if tables is None:
+        tables = contiguous_tables(batch, max_len, block_size)
+    if num_blocks is None:
+        num_blocks = int(tables.max()) + 1
+    tables_t = Tensor(jnp.asarray(tables, jnp.int32), _internal=True)
+    caches = []
+    for _ in range(num_layers):
+        k = Tensor(
+            jnp.zeros((num_blocks, block_size, num_kv_heads, head_dim), dtype),
+            _internal=True,
+        )
+        v = Tensor(
+            jnp.zeros((num_blocks, block_size, num_kv_heads, head_dim), dtype),
+            _internal=True,
+        )
+        caches.append(PagedLayerCache(k, v, tables_t))
+    return caches
+
+
+def paged_update_kv_cache(kk, vv, k_pool, v_pool, tables, cl, s: int):
+    """Scatter s new tokens (starting at position ``cl``) into the pools
+    and return (k_pool, v_pool, kc_view, vc_view, mask) where the views
+    are the gathered [B, max_len, kv_heads, head_dim] caches and the
+    mask is identical to the dense ``update_kv_cache`` mask — raw jnp
+    arrays, same protocol as generation.update_kv_cache."""
+    bs = k_pool.shape[1]
+    b = kk.shape[0]
+    positions = cl + jnp.arange(s)  # [s]
+    logical = positions // bs  # [s]
+    offset = positions % bs  # [s]
+    phys = jnp.take_along_axis(
+        tables, jnp.broadcast_to(logical[None, :], (b, s)), axis=1
+    )  # [B, s]
+    off = jnp.broadcast_to(offset[None, :], (b, s))
+    k_pool = k_pool.at[phys, off].set(kk.astype(k_pool.dtype))
+    v_pool = v_pool.at[phys, off].set(vv.astype(v_pool.dtype))
+    kc, vc = paged_gather_kv(k_pool, v_pool, tables)
+    max_len = kc.shape[1]
+    k_idx = jnp.arange(max_len)[None, :]
+    q_idx = cl + jnp.arange(s)[:, None]
+    return k_pool, v_pool, kc, vc, (k_idx <= q_idx)[None, None]
+
+
+def paged_gather_kv(k_pool, v_pool, tables):
+    """[B, max_blocks] tables -> padded [B, max_blocks*bs, kvh, D] views."""
+    b, nb = tables.shape
+    bs, kvh, d = k_pool.shape[1:]
+    kc = k_pool[tables].reshape(b, nb * bs, kvh, d)
+    vc = v_pool[tables].reshape(b, nb * bs, kvh, d)
+    return kc, vc

@@ -1,0 +1,186 @@
+"""Paged KV cache + block attention (ref:
+incubate/nn/functional/block_multihead_attention.py,
+masked_multihead_attention.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import generate
+from paddle_tpu.ops.paged_attention import (
+    BlockManager,
+    alloc_paged_kv_caches,
+    contiguous_tables,
+)
+
+
+def _model():
+    paddle.seed(7)
+    return LlamaForCausalLM(
+        LlamaConfig.tiny(num_attention_heads=4, num_key_value_heads=2)
+    )
+
+
+class TestPagedGenerate:
+    def test_greedy_matches_dense_token_for_token(self):
+        model = _model()
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rng.randint(0, model.config.vocab_size, (2, 9)).astype(np.int64)
+        )
+        dense = generate(model, ids, max_new_tokens=12, temperature=0.0)
+        paged = generate(model, ids, max_new_tokens=12, temperature=0.0,
+                         block_size=4)
+        np.testing.assert_array_equal(dense.numpy(), paged.numpy())
+
+    def test_sampled_matches_dense_with_same_seed(self):
+        model = _model()
+        rng = np.random.RandomState(1)
+        ids = paddle.to_tensor(
+            rng.randint(0, model.config.vocab_size, (2, 5)).astype(np.int64)
+        )
+        paddle.seed(123)
+        dense = generate(model, ids, max_new_tokens=8, temperature=0.8, top_k=5)
+        paddle.seed(123)
+        paged = generate(model, ids, max_new_tokens=8, temperature=0.8,
+                         top_k=5, block_size=4)
+        np.testing.assert_array_equal(dense.numpy(), paged.numpy())
+
+    def test_eager_matches_jit(self):
+        model = _model()
+        ids = paddle.to_tensor(
+            np.random.RandomState(2).randint(0, 256, (1, 6)).astype(np.int64)
+        )
+        jit = generate(model, ids, max_new_tokens=6, block_size=4, use_jit=True)
+        eager = generate(model, ids, max_new_tokens=6, block_size=4, use_jit=False)
+        np.testing.assert_array_equal(jit.numpy(), eager.numpy())
+
+
+class TestBlockManager:
+    def test_allocate_grow_free(self):
+        bm = BlockManager(num_blocks=8, block_size=4)
+        a = bm.allocate("a", 6)   # 2 blocks
+        assert len(a) == 2 and bm.free_blocks == 6
+        a2 = bm.allocate("a", 9)  # 3 blocks total
+        assert len(a2) == 3 and a2[:2] == a
+        b = bm.allocate("b", 16)  # 4 blocks
+        assert len(b) == 4 and bm.free_blocks == 1
+        with pytest.raises(RuntimeError, match="exhausted"):
+            bm.allocate("c", 10)
+        bm.free_sequence("a")
+        assert bm.free_blocks == 4
+        row = bm.table_row("b", 6)
+        assert list(row[:4]) == b and list(row[4:]) == [0, 0]
+
+    def test_pool_smaller_than_dense(self):
+        """Paged pools sized by allocated blocks, not B * max_len."""
+        caches = alloc_paged_kv_caches(
+            num_layers=1, batch=4, max_len=64, num_kv_heads=2, head_dim=8,
+            dtype=np.float32, block_size=16,
+            tables=contiguous_tables(4, 32, 16),  # only 32 tokens used
+        )
+        k = caches[0].k_pool
+        assert k.shape[0] == 8  # 4 seqs * 2 blocks, not 4 * 4
+
+
+class TestBlockMultiheadAttention:
+    def _ref_attn(self, q, k, v, start):
+        """Dense causal reference: q [s, h, d] attends over k/v [t, h, d]."""
+        import jax
+
+        s, h, d = q.shape
+        t = k.shape[0]
+        scores = np.einsum("qhd,khd->hqk", q, k) / np.sqrt(d)
+        causal = np.arange(t)[None, :] <= (start + np.arange(s))[:, None]
+        scores = np.where(causal[None], scores, -np.inf)
+        p = np.asarray(jax.nn.softmax(scores, axis=-1))
+        return np.einsum("hqk,khd->qhd", p, v).reshape(s, h * d)
+
+    def test_prefill_then_decode(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(3)
+        h, kvh, d, bs = 4, 2, 8, 4
+        max_blocks, s0 = 6, 6
+        kc = paddle.to_tensor(np.zeros((max_blocks, kvh, bs, d), np.float32))
+        vc = paddle.to_tensor(np.zeros((max_blocks, kvh, bs, d), np.float32))
+        tables = paddle.to_tensor(np.arange(6, dtype=np.int32).reshape(1, 6))
+        qkv0 = rng.randn(s0, (h + 2 * kvh) * d).astype(np.float32)
+
+        def lens(*v):
+            return paddle.to_tensor(np.array(v, np.int32).reshape(-1, 1))
+
+        cu = lambda *v: paddle.to_tensor(np.array(v, np.int32))  # noqa: E731
+        out0, _, kc, vc = IF.block_multihead_attention(
+            paddle.to_tensor(qkv0), kc, vc,
+            lens(s0), lens(0), lens(s0),
+            None, None, cu(0, s0), cu(0, s0), tables,
+            block_size=bs,
+        )
+        # reference prefill
+        q0 = qkv0[:, : h * d].reshape(s0, h, d)
+        k0 = np.repeat(qkv0[:, h * d:(h + kvh) * d].reshape(s0, kvh, d), h // kvh, 1)
+        v0 = np.repeat(qkv0[:, (h + kvh) * d:].reshape(s0, kvh, d), h // kvh, 1)
+        np.testing.assert_allclose(
+            out0.numpy(), self._ref_attn(q0, k0, v0, 0), rtol=2e-4, atol=1e-5
+        )
+
+        # decode one token
+        qkv1 = rng.randn(1, (h + 2 * kvh) * d).astype(np.float32)
+        out1, _, kc, vc = IF.block_multihead_attention(
+            paddle.to_tensor(qkv1), kc, vc,
+            lens(0), lens(s0), lens(1),
+            None, None, cu(0, 1), cu(0, 1), tables,
+            block_size=bs,
+        )
+        k_all = np.concatenate(
+            [k0, np.repeat(qkv1[:, h * d:(h + kvh) * d].reshape(1, kvh, d), h // kvh, 1)]
+        )
+        v_all = np.concatenate(
+            [v0, np.repeat(qkv1[:, (h + kvh) * d:].reshape(1, kvh, d), h // kvh, 1)]
+        )
+        q1 = qkv1[:, : h * d].reshape(1, h, d)
+        np.testing.assert_allclose(
+            out1.numpy(), self._ref_attn(q1, k_all, v_all, s0), rtol=2e-4, atol=1e-5
+        )
+
+    def test_quant_args_raise(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        with pytest.raises(NotImplementedError, match="cache_k_quant_scales"):
+            IF.block_multihead_attention(
+                *([None] * 11), cache_k_quant_scales=paddle.to_tensor(np.ones(1))
+            )
+
+
+class TestMaskedMultiheadAttention:
+    def test_decode_matches_dense(self):
+        import jax
+
+        import paddle_tpu.incubate.nn.functional as IF
+
+        rng = np.random.RandomState(5)
+        b, h, d, max_s = 2, 4, 8, 10
+        prior = 3  # tokens already cached
+        cache = np.zeros((2, b, h, max_s, d), np.float32)
+        hist_k = rng.randn(b, h, prior, d).astype(np.float32)
+        hist_v = rng.randn(b, h, prior, d).astype(np.float32)
+        cache[0, :, :, :prior] = hist_k
+        cache[1, :, :, :prior] = hist_v
+        x = rng.randn(b, 3 * h * d).astype(np.float32)
+        out, new_cache = IF.masked_multihead_attention(
+            paddle.to_tensor(x), paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(np.full((b,), prior, np.int32)),
+        )
+        qkv = x.reshape(b, 3, h, d)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        ks = np.concatenate([hist_k, k[:, :, None]], axis=2)
+        vs = np.concatenate([hist_v, v[:, :, None]], axis=2)
+        scores = np.einsum("bhd,bhsd->bhs", q, ks) / np.sqrt(d)
+        p = np.asarray(jax.nn.softmax(scores, axis=-1))
+        want = np.einsum("bhs,bhsd->bhd", p, vs).reshape(b, h * d)
+        np.testing.assert_allclose(out.numpy(), want, rtol=2e-4, atol=1e-5)
+        # cache got the new token at position `prior`
+        np.testing.assert_allclose(
+            np.asarray(new_cache.numpy())[0, :, :, prior], k, rtol=1e-6
+        )
