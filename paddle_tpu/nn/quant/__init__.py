@@ -17,7 +17,7 @@ from ...base.tensor import Tensor
 from ..layer.layers import Layer
 
 __all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
-           "weight_quantize", "weight_dequantize"]
+           "weight_quantize", "weight_dequantize", "int8_dynamic_matmul"]
 
 
 class Stub(Layer):
@@ -75,20 +75,82 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     return apply(_f, *args, op_name="weight_only_linear")
 
 
-def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
-    """ref: quantized_linear.py llm_int8_linear. The reference splits
-    outlier activation columns onto fp16 weights to avoid int8-arithmetic
-    error; on TPU the weight is dequantized into the matmul anyway (the
-    MXU computes in bf16/f32), so a single dequantized matmul IS the
-    numerically-higher-precision path and the outlier split would only
-    duplicate work — ``threshold`` is accepted for signature parity."""
+def int8_dynamic_matmul(a, q, s, outlier_threshold=None, max_outliers=16):
+    """Raw-jnp int8 execution core: dynamically quantize activations per
+    row, run the int8 x int8 -> int32 dot on the MXU, rescale by
+    act_scale * weight_scale. With ``outlier_threshold``, the llm.int8
+    decomposition (arXiv:2208.07339): the top-``max_outliers`` activation
+    feature columns whose magnitude exceeds the threshold are carried in
+    a small float matmul instead (static K — TPU-friendly; the
+    reference gathers a dynamic outlier set into cutlass fp16)."""
+    in_f = a.shape[-1]
+    extra = None
+    if outlier_threshold is not None:
+        k = min(max_outliers, in_f)
+        flat = jnp.abs(a.reshape(-1, in_f))
+        col_max = jnp.max(flat, axis=0)
+        top_vals, idx = jax.lax.top_k(col_max, k)
+        sel = top_vals > outlier_threshold  # [k]
+        outlier_mask = jnp.zeros((in_f,), bool).at[idx].set(sel)
+        a_main = jnp.where(outlier_mask, 0.0, a)
+        a_out = jnp.take(a, idx, axis=-1) * sel.astype(a.dtype)  # [.., k]
+        w_out = q[idx].astype(jnp.float32) * s.astype(jnp.float32)  # [k, out]
+        extra = a_out.astype(jnp.float32) @ w_out
+    else:
+        a_main = a
+    act_scale = jnp.maximum(
+        jnp.max(jnp.abs(a_main), axis=-1, keepdims=True) / 127.0, 1e-9
+    )
+    qa = jnp.clip(jnp.round(a_main / act_scale), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        qa, q, (((qa.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out = acc.astype(jnp.float32) * act_scale.astype(jnp.float32) * s.astype(jnp.float32)
+    if extra is not None:
+        out = out + extra
+    return out.astype(a.dtype)
 
-    def _f(a, q, s, *maybe_b):
-        w = q.astype(a.dtype) * s.astype(a.dtype)
-        out = a @ w
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
+    """llm.int8 linear with REAL int8 arithmetic (ref:
+    quantized_linear.py llm_int8_linear; kernels
+    paddle/phi/kernels/impl/llm_int8_matmul_kernel_impl.h): activations
+    are dynamically quantized per row and the main product runs as an
+    int8 x int8 -> int32 MXU dot; activation feature columns above
+    ``threshold`` take the float path (static top-K decomposition;
+    ``threshold=None`` disables the split).
+
+    Gradients: the int8 round/clip has zero derivative, so when the
+    input requires grad (e.g. LoRA over a frozen int8 base) the op runs
+    a straight-through estimator — value from the int8 dot, gradient
+    from the dequantized float matmul (one extra matmul, paid only in
+    differentiating contexts). Pure inference stays int8-only."""
+    from ...base import tape as _tape
+
+    def _int8(a, q, s, *maybe_b):
+        out = int8_dynamic_matmul(a, q, s, outlier_threshold=threshold)
         if maybe_b:
             out = out + maybe_b[0]
         return out
 
+    def _ste(a, q, s, *maybe_b):
+        out_i = int8_dynamic_matmul(a, q, s, outlier_threshold=threshold)
+        w = q.astype(jnp.float32) * s.astype(jnp.float32)
+        out_f = (a.astype(jnp.float32) @ w).astype(a.dtype)
+        # value == int8 result exactly; gradient == float matmul's
+        out = out_f + jax.lax.stop_gradient(out_i - out_f)
+        if maybe_b:
+            out = out + maybe_b[0]
+        return out
+
+    def _diff(t):
+        return (
+            isinstance(t, Tensor) and not t.stop_gradient
+        )
+
+    needs_grad = _tape.is_grad_enabled() and any(
+        _diff(t) for t in (x, weight, weight_scale, bias)
+    )
     args = (x, weight, weight_scale) + ((bias,) if bias is not None else ())
-    return apply(_f, *args, op_name="llm_int8_linear")
+    return apply(_ste if needs_grad else _int8, *args, op_name="llm_int8_linear")

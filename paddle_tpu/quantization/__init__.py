@@ -33,7 +33,7 @@ __all__ = [
     "QuantConfig",
     "QAT",
     "QuantedLinear",
- "BaseQuanter", "BaseObserver", "PTQ",]
+ "BaseQuanter", "BaseObserver", "PTQ", "Int8InferenceLinear"]
 
 
 def fake_quantize_dequantize_abs_max(x, bit_length: int = 8, scale=None):
@@ -210,23 +210,81 @@ class QAT:
                 setattr(parent, parts[-1], QuantedLinear(sub, cfg))
         return target
 
-    def convert(self, model: Layer, inplace: bool = False) -> Layer:
-        """Fold quanters into the weights for inference (ref: qat.py
-        convert): weights are replaced by their quant-dequant images and
-        the wrappers removed."""
+    def convert(self, model: Layer, inplace: bool = False,
+                execute_dtype: str | None = None) -> Layer:
+        """Finalize for inference (ref: qat.py convert).
+
+        Default: fold the quanters into the weights (quant-dequant
+        image, float execution) and strip the wrappers — the reference
+        behavior. ``execute_dtype="int8"`` instead produces
+        Int8InferenceLinear layers holding int8 weights and executing a
+        REAL int8 x int8 -> int32 MXU dot with dynamic activation
+        quantization (the int8 deploy path the reference lowers to its
+        cutlass/llm.int8 kernels)."""
         for name, sub in list(model.named_sublayers(include_self=False)):
             if isinstance(sub, QuantedLinear):
-                lin = sub.linear
-                if sub.weight_quanter is not None:
-                    sub.weight_quanter.eval()
-                    wq = sub.weight_quanter(lin.weight)
-                    lin.weight.set_value(wq._data)
+                if execute_dtype == "int8":
+                    new = Int8InferenceLinear(sub.linear, sub.weight_quanter)
+                else:
+                    new = sub.linear
+                    if sub.weight_quanter is not None:
+                        sub.weight_quanter.eval()
+                        wq = sub.weight_quanter(new.weight)
+                        new.weight.set_value(wq._data)
                 parent = model
                 parts = name.split(".")
                 for p in parts[:-1]:
                     parent = getattr(parent, p)
-                setattr(parent, parts[-1], lin)
+                setattr(parent, parts[-1], new)
         return model
+
+
+class Int8InferenceLinear(Layer):
+    """Inference linear executing with int8 arithmetic: per-out-channel
+    int8 weights + scales stored as buffers; forward quantizes
+    activations dynamically and runs the int8 dot
+    (nn.quant.int8_dynamic_matmul).
+
+    When built from a QAT layer, the weight is first projected onto the
+    grid the weight quanter trained against (its fake-quant image) and
+    only then int8-encoded, so deployed numerics track the calibrated
+    model instead of silently re-quantizing the raw float weight."""
+
+    def __init__(self, linear, weight_quanter=None):
+        super().__init__()
+        from ..base.tape import no_grad
+        from ..nn.quant import weight_quantize
+
+        if weight_quanter is not None:
+            bits = getattr(weight_quanter, "bit_length", 8)
+            if bits != 8:
+                raise ValueError(
+                    f"execute_dtype='int8' needs an 8-bit weight config; "
+                    f"the QAT weight quanter used bit_length={bits}"
+                )
+        with no_grad():
+            w = linear.weight
+            if weight_quanter is not None:
+                weight_quanter.eval()
+                w = weight_quanter(w)
+            qw, scale = weight_quantize(w, algo="weight_only_int8")
+        # detach: deployment buffers must not keep the float weight alive
+        # through tape nodes, nor be differentiable
+        qw._grad_node = None
+        scale._grad_node = None
+        qw.stop_gradient = True
+        scale.stop_gradient = True
+        self.register_buffer("qweight", qw)
+        self.register_buffer("scale", scale)
+        self.bias = linear.bias
+
+    def forward(self, x):
+        from ..nn.quant import llm_int8_linear
+
+        return llm_int8_linear(
+            x, self.qweight, bias=self.bias, weight_scale=self.scale,
+            threshold=None,
+        )
 
 
 class BaseQuanter(Layer):
@@ -272,5 +330,6 @@ class PTQ:
         m.eval()
         return m
 
-    def convert(self, model: Layer, inplace: bool = False) -> Layer:
-        return self._qat.convert(model, inplace)
+    def convert(self, model: Layer, inplace: bool = False,
+                execute_dtype: str | None = None) -> Layer:
+        return self._qat.convert(model, inplace, execute_dtype=execute_dtype)

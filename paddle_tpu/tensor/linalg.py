@@ -401,12 +401,17 @@ def ormqr(x, tau, y, left=True, transpose=False, name=None):
 
 
 def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False, transpose_y=False,
-                            scale=1.0, output_dtype="float16", name=None):
-    """fp8 x fp8 -> half GEMM (ref: incubate fp8 gemm). On TPU this is a
+                            scale=1.0, output_dtype="float16", act="identity",
+                            name=None):
+    """fp8 x fp8 -> half GEMM (ref: tensor/linalg.py:327
+    fp8_fp8_half_gemm_fused, cutlass fp8 kernels). On TPU this is a
     dot_general with fp8 inputs and a wider accumulator — the MXU path
-    XLA emits for float8_e4m3fn operands."""
+    XLA emits for float8_e4m3fn operands. ``act`` fuses the epilogue
+    activation like the reference (identity | relu | gelu)."""
     import ml_dtypes
 
+    if act not in ("identity", "relu", "gelu"):
+        raise ValueError(f"fp8_fp8_half_gemm_fused: unsupported act {act!r}")
     out_dt = jnp.bfloat16 if output_dtype in ("bfloat16",) else jnp.float16
 
     def _f(a, b, *mb):
@@ -422,6 +427,10 @@ def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False, transpose_y=Fals
         ) * scale
         if mb:
             out = out + mb[0]
+        if act == "relu":
+            out = jnp.maximum(out, 0.0)
+        elif act == "gelu":
+            out = jax.nn.gelu(out)
         return out.astype(out_dt)
 
     args = (x, y) + ((bias,) if bias is not None else ())

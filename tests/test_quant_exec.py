@@ -1,0 +1,183 @@
+"""Quantized EXECUTION paths: real int8 dots (llm.int8, converted QAT)
+and fp8 GEMM — not fake-quant float (ref:
+paddle/phi/kernels/impl/llm_int8_matmul_kernel_impl.h,
+phi/kernels/fusion/cutlass fp8_gemm)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _jaxpr_has_int8_dot(fn, *args):
+    jaxpr = str(jax.make_jaxpr(fn)(*args))
+    return "i8[" in jaxpr and "preferred_element_type=int32" in jaxpr
+
+
+class TestLlmInt8Linear:
+    def test_executes_int8_dot(self):
+        from paddle_tpu.nn.quant import int8_dynamic_matmul, weight_quantize
+
+        rng = np.random.RandomState(0)
+        w = paddle.to_tensor(rng.randn(32, 16).astype(np.float32) * 0.1)
+        q, s = weight_quantize(w)
+        a = rng.randn(4, 32).astype(np.float32)
+
+        def raw(av):
+            return int8_dynamic_matmul(av, q._data, s._data, outlier_threshold=6.0)
+
+        assert _jaxpr_has_int8_dot(raw, a)
+
+    def test_accuracy_vs_float(self):
+        from paddle_tpu.nn.quant import llm_int8_linear, weight_quantize
+
+        rng = np.random.RandomState(1)
+        w = paddle.to_tensor(rng.randn(64, 32).astype(np.float32) * 0.05)
+        x = paddle.to_tensor(rng.randn(8, 64).astype(np.float32))
+        q, s = weight_quantize(w)
+        got = llm_int8_linear(x, q, weight_scale=s).numpy()
+        want = (x.numpy() @ w.numpy())
+        # int8 weights + int8 activations: ~1% relative error on gaussians
+        rel = np.abs(got - want).mean() / np.abs(want).mean()
+        assert rel < 0.02, rel
+
+    def test_outlier_split_beats_plain_int8(self):
+        """A huge activation outlier column wrecks plain int8 dynamic
+        quantization; the llm.int8 top-K float split must recover it."""
+        from paddle_tpu.nn.quant import llm_int8_linear, weight_quantize
+
+        rng = np.random.RandomState(2)
+        w = paddle.to_tensor(rng.randn(64, 32).astype(np.float32) * 0.05)
+        x_np = rng.randn(8, 64).astype(np.float32)
+        x_np[:, 7] = 80.0  # outlier feature
+        x = paddle.to_tensor(x_np)
+        q, s = weight_quantize(w)
+        want = x_np @ w.numpy()
+        with_split = llm_int8_linear(x, q, weight_scale=s, threshold=6.0).numpy()
+        no_split = llm_int8_linear(x, q, weight_scale=s, threshold=1e9).numpy()
+        err_split = np.abs(with_split - want).mean()
+        err_plain = np.abs(no_split - want).mean()
+        assert err_split < err_plain / 2, (err_split, err_plain)
+
+    def test_bias(self):
+        from paddle_tpu.nn.quant import llm_int8_linear, weight_quantize
+
+        rng = np.random.RandomState(3)
+        w = paddle.to_tensor(rng.randn(16, 8).astype(np.float32) * 0.1)
+        b = paddle.to_tensor(rng.randn(8).astype(np.float32))
+        x = paddle.to_tensor(rng.randn(2, 16).astype(np.float32))
+        q, s = weight_quantize(w)
+        got = llm_int8_linear(x, q, bias=b, weight_scale=s).numpy()
+        want = x.numpy() @ w.numpy() + b.numpy()
+        assert np.abs(got - want).mean() / np.abs(want).mean() < 0.05
+
+
+class TestLlmInt8Grads:
+    def test_ste_gradient_matches_float_matmul(self):
+        from paddle_tpu.nn.quant import llm_int8_linear, weight_quantize
+
+        rng = np.random.RandomState(6)
+        w = paddle.to_tensor(rng.randn(32, 16).astype(np.float32) * 0.1)
+        q, s = weight_quantize(w)
+        x = paddle.to_tensor(rng.randn(4, 32).astype(np.float32))
+        x.stop_gradient = False
+        out = llm_int8_linear(x, q, weight_scale=s)
+        out.sum().backward()
+        # straight-through: grad == float-matmul grad = row-sum of W_dequant
+        w_deq = q.numpy().astype(np.float32) * s.numpy()
+        want = np.broadcast_to(w_deq.sum(axis=1), x.shape)
+        np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-4, atol=1e-5)
+
+
+class TestQATInt8Convert:
+    def test_convert_int8_runs_int8_and_matches(self):
+        from paddle_tpu.quantization import (
+            QAT, Int8InferenceLinear, QuantConfig, quanter,
+        )
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+        float_out = model(x).numpy()
+
+        cfg = QuantConfig(activation=quanter(moving_rate=0.9),
+                          weight=quanter(moving_rate=0.9))
+        qat = QAT(cfg)
+        model = qat.quantize(model)
+        model(x)  # observe
+        model = qat.convert(model, execute_dtype="int8")
+        assert isinstance(model[0], Int8InferenceLinear)
+        assert model[0].qweight.numpy().dtype == np.int8
+        int8_out = model(x).numpy()
+        rel = np.abs(int8_out - float_out).mean() / (np.abs(float_out).mean() + 1e-9)
+        assert rel < 0.05, rel
+
+        # the executed program must contain an int8 dot
+        lin = model[0]
+
+        def raw(av):
+            from paddle_tpu.nn.quant import int8_dynamic_matmul
+
+            return int8_dynamic_matmul(av, lin.qweight._data, lin.scale._data)
+
+        assert _jaxpr_has_int8_dot(raw, x.numpy())
+
+    def test_convert_default_still_folds(self):
+        from paddle_tpu.nn import Linear
+        from paddle_tpu.quantization import QAT, QuantConfig, quanter
+
+        paddle.seed(1)
+        model = nn.Sequential(nn.Linear(8, 8))
+        qat = QAT(QuantConfig(activation=None, weight=quanter(moving_rate=0.9)))
+        model = qat.quantize(model)
+        x = paddle.to_tensor(np.random.RandomState(1).randn(2, 8).astype(np.float32))
+        model(x)
+        model = qat.convert(model)
+        assert isinstance(model[0], Linear)
+
+
+class TestFP8Gemm:
+    def test_fp8_dot_executes_and_tolerates(self):
+        import ml_dtypes
+
+        from paddle_tpu.tensor.linalg import fp8_fp8_half_gemm_fused
+
+        rng = np.random.RandomState(4)
+        a = rng.randn(8, 32).astype(np.float32) * 0.5
+        b = rng.randn(32, 16).astype(np.float32) * 0.5
+        out = fp8_fp8_half_gemm_fused(
+            paddle.to_tensor(a), paddle.to_tensor(b), output_dtype="bfloat16"
+        )
+        want = a @ b
+        got = out.numpy().astype(np.float32)
+        rel = np.abs(got - want).mean() / np.abs(want).mean()
+        assert rel < 0.06, rel  # e4m3 has ~2 decimal digits
+
+        def raw(av, bv):
+            aa = av.astype(ml_dtypes.float8_e4m3fn)
+            bb = bv.astype(ml_dtypes.float8_e4m3fn)
+            return jax.lax.dot_general(
+                aa, bb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        jaxpr = str(jax.make_jaxpr(raw)(a, b))
+        assert "f8_e4m3" in jaxpr
+
+    def test_fp8_act_fusion(self):
+        from paddle_tpu.tensor.linalg import fp8_fp8_half_gemm_fused
+
+        rng = np.random.RandomState(5)
+        a = rng.randn(4, 16).astype(np.float32)
+        b = rng.randn(16, 8).astype(np.float32)
+        out = fp8_fp8_half_gemm_fused(
+            paddle.to_tensor(a), paddle.to_tensor(b), act="relu"
+        ).numpy().astype(np.float32)
+        assert (out >= 0).all()
+        with pytest.raises(ValueError, match="unsupported act"):
+            fp8_fp8_half_gemm_fused(
+                paddle.to_tensor(a), paddle.to_tensor(b), act="tanh"
+            )
