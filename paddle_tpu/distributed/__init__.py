@@ -48,6 +48,8 @@ from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from . import auto_tuner  # noqa: F401
+from . import rpc  # noqa: F401
+from . import store  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import ps  # noqa: F401
 from . import io  # noqa: F401

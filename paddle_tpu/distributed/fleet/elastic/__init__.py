@@ -5,16 +5,20 @@ ref: python/paddle/distributed/fleet/elastic/manager.py:124
 (ElasticManager: etcd leases + watches, rank reassignment, relaunch via
 ELASTIC_EXIT_CODE) and elastic/collective.py.
 
-TPU-native redesign: the rendezvous store is a **shared directory**
-(NFS/GCS-fuse — present on TPU pods; etcd is not) holding one
-heartbeat file per node. Each node renews its file's mtime; the
-manager derives the alive set, detects scale-up/down against the
-expected world, and reassigns dense ranks deterministically
-(lexicographic by node id — every node computes the same assignment
-with no coordinator). On a membership change the watchdog reports
-ELASTIC_EXIT_CODE so the launcher (distributed.launch, which already
-restarts on nonzero exits) relaunches with the new world — same
-division of labor as the reference.
+TPU-native redesign: membership lives in a pluggable KV store
+(distributed/store.py). Two backends: a **shared directory**
+(NFS/GCS-fuse — present on TPU pods; etcd is not) and a **TCP store**
+(``tcp://host:port`` — multi-node clusters WITHOUT a shared
+filesystem; the launcher/master runs TCPStoreServer, replacing the
+reference's etcd. ref: manager.py:124 etcd leases+watches). Each node
+renews a timestamped heartbeat entry; the manager derives the alive
+set, detects scale-up/down against the expected world, and reassigns
+dense ranks deterministically (lexicographic by node id — every node
+computes the same assignment with no coordinator). On a membership
+change the watchdog reports ELASTIC_EXIT_CODE so the launcher
+(distributed.launch, which already restarts on nonzero exits)
+relaunches with the new world — same division of labor as the
+reference.
 """
 from __future__ import annotations
 
@@ -23,6 +27,8 @@ import os
 import threading
 import time
 from typing import Dict, List, Optional
+
+from ...store import KVStore, make_store
 
 __all__ = ["ElasticManager", "ELASTIC_EXIT_CODE"]
 
@@ -38,11 +44,15 @@ class ElasticManager:
     declared.
     """
 
-    def __init__(self, store_dir: str, node_id: Optional[str] = None,
+    def __init__(self, store_dir: str | KVStore, node_id: Optional[str] = None,
                  np=1, heartbeat_interval: float = 2.0,
                  elastic_timeout: float = 30.0):
-        self.store_dir = store_dir
-        os.makedirs(os.path.join(store_dir, "nodes"), exist_ok=True)
+        """``store_dir``: a shared-directory path, a ``tcp://host:port``
+        store location, or a KVStore instance."""
+        self.store_dir = store_dir if isinstance(store_dir, str) else None
+        self.store = (
+            store_dir if isinstance(store_dir, KVStore) else make_store(store_dir)
+        )
         self.node_id = node_id or f"{os.uname().nodename}-{os.getpid()}"
         if isinstance(np, str) and ":" in np:
             lo, hi = np.split(":")
@@ -51,7 +61,7 @@ class ElasticManager:
             self.min_np = self.max_np = int(np)
         self.heartbeat_interval = heartbeat_interval
         self.elastic_timeout = elastic_timeout
-        self._hb_path = os.path.join(store_dir, "nodes", self.node_id)
+        self._hb_key = f"nodes/{self.node_id}"
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._registered_world: Optional[List[str]] = None
@@ -59,28 +69,24 @@ class ElasticManager:
 
     # -- membership ----------------------------------------------------
     def _beat(self):
-        tmp = self._hb_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"node": self.node_id, "ts": time.time()}, f)
-        os.replace(tmp, self._hb_path)
+        self.store.set(
+            self._hb_key, json.dumps({"node": self.node_id, "ts": time.time()})
+        )
 
     def alive_nodes(self) -> List[str]:
         """Alive members, capped at max_np (surplus joiners are held
         out deterministically — lexicographically first max_np win,
-        ref: manager.py world-size ceiling)."""
-        now = time.time()
-        out = []
-        ndir = os.path.join(self.store_dir, "nodes")
-        for name in sorted(os.listdir(ndir)):
-            if name.endswith(".tmp"):
-                continue  # in-flight _beat() write, not a member
-            path = os.path.join(ndir, name)
-            try:
-                if now - os.path.getmtime(path) <= self.elastic_timeout:
-                    out.append(name)
-            except OSError:
-                continue
-        return out[: self.max_np]
+        ref: manager.py world-size ceiling).
+
+        Liveness uses the STORE's entry ages (file mtime / TCP-server
+        receive time) via one dump() round trip — immune to cross-node
+        clock skew and O(1) connections per scan."""
+        out = [
+            key[len("nodes/"):]
+            for key, _val, age in self.store.dump("nodes/")
+            if age <= self.elastic_timeout
+        ]
+        return sorted(out)[: self.max_np]
 
     def rank_mapping(self) -> Dict[str, int]:
         """Deterministic dense ranks over the REGISTERED world snapshot
@@ -125,9 +131,15 @@ class ElasticManager:
 
         def loop():
             while not self._stop.wait(self.heartbeat_interval):
-                self._beat()
-                if self.world_changed():
-                    self.need_sync = True
+                # a transient store error (TCP reset, brief master
+                # overload) must not kill the heartbeat — a dead beat
+                # thread gets a healthy node evicted
+                try:
+                    self._beat()
+                    if self.world_changed():
+                        self.need_sync = True
+                except OSError:
+                    continue
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
@@ -157,6 +169,6 @@ class ElasticManager:
         if self._thread is not None:
             self._thread.join(self.heartbeat_interval * 2)
         try:
-            os.remove(self._hb_path)
+            self.store.delete(self._hb_key)
         except OSError:
             pass

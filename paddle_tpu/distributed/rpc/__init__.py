@@ -1,0 +1,265 @@
+"""paddle.distributed.rpc — user-facing RPC between workers.
+
+ref: python/paddle/distributed/rpc/rpc.py (init_rpc:73 bootstraps a
+TCPStore at the master, exchanges WorkerInfos, starts a brpc agent;
+rpc_sync:143 / rpc_async:183 serialize the callable and run it on the
+remote worker; shutdown:276 barriers then stops the agent).
+
+TPU-native redesign: the master runs the line-JSON TCPStoreServer
+(distributed/store.py) for discovery and barriers; each worker runs a
+small threaded socket server executing pickled (fn, args, kwargs)
+requests — the role brpc plays in the reference. Python pickle is the
+wire format, exactly like the reference's serialized-python payloads:
+a TRUSTED-CLUSTER protocol; never expose the ports beyond the job.
+
+The compute path stays single-controller JAX; rpc exists for the
+host-side control plane (metrics aggregation, orchestration, parameter
+server clients) the reference uses it for.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import threading
+import time
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, NamedTuple, Optional
+
+from ..store import TCPKVStore, TCPStoreServer
+
+__all__ = [
+    "init_rpc", "shutdown", "rpc_async", "rpc_sync",
+    "get_worker_info", "get_all_worker_infos", "get_current_worker_info",
+    "WorkerInfo",
+]
+
+_DEFAULT_RPC_TIMEOUT = 30.0
+
+
+class WorkerInfo(NamedTuple):
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _State:
+    def __init__(self):
+        self.server_sock: Optional[socket.socket] = None
+        self.server_thread: Optional[threading.Thread] = None
+        self.store: Optional[TCPKVStore] = None
+        self.store_server: Optional[TCPStoreServer] = None
+        self.self_info: Optional[WorkerInfo] = None
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.world_size = 0
+        self.stop = threading.Event()
+        self.pool = ThreadPoolExecutor(max_workers=8)
+
+
+_state: Optional[_State] = None
+
+
+def _recv_msg(f):
+    head = f.read(8)
+    if len(head) < 8:
+        raise EOFError
+    n = int.from_bytes(head, "big")
+    return pickle.loads(f.read(n))
+
+
+def _send_msg(f, obj):
+    payload = pickle.dumps(obj)
+    f.write(len(payload).to_bytes(8, "big") + payload)
+    f.flush()
+
+
+def _serve_loop(st: _State):
+    st.server_sock.settimeout(0.2)
+    while not st.stop.is_set():
+        try:
+            conn, _ = st.server_sock.accept()
+        except socket.timeout:
+            continue
+        except OSError:
+            break
+
+        def handle(c=conn):
+            try:
+                with c, c.makefile("rwb") as f:
+                    fn, args, kwargs = _recv_msg(f)
+                    try:
+                        result = fn(*args, **(kwargs or {}))
+                        _send_msg(f, ("ok", result))
+                    except Exception as e:  # noqa: BLE001 — marshalled to caller
+                        _send_msg(f, ("err", f"{e!r}\n{traceback.format_exc()}"))
+            except (OSError, EOFError):
+                pass
+
+        threading.Thread(target=handle, daemon=True).start()
+    st.server_sock.close()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC agent and discover all peers (ref:
+    rpc.py:73 — same env-var fallbacks)."""
+    global _state
+    if _state is not None:
+        raise RuntimeError("init_rpc already called; call shutdown() first")
+    rank = int(os.environ["PADDLE_TRAINER_ID"]) if rank is None else rank
+    world_size = (
+        int(os.environ["PADDLE_TRAINERS_NUM"]) if world_size is None else world_size
+    )
+    master_endpoint = master_endpoint or os.environ["PADDLE_MASTER_ENDPOINT"]
+    master_addr, master_port = master_endpoint.rsplit(":", 1)
+
+    st = _State()
+    st.world_size = world_size
+    try:
+        if rank == 0:
+            st.store_server = TCPStoreServer(host="0.0.0.0", port=int(master_port))
+        st.store = TCPKVStore(master_addr, int(master_port))
+        st.store.wait_alive()
+
+        # exec server on an ephemeral port
+        st.server_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        st.server_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        st.server_sock.bind(("0.0.0.0", 0))
+        st.server_sock.listen(64)
+        port = st.server_sock.getsockname()[1]
+        ip = os.getenv("PADDLE_WORKER_IP", "127.0.0.1")
+        st.self_info = WorkerInfo(name, rank, ip, port)
+        st.server_thread = threading.Thread(
+            target=_serve_loop, args=(st,), daemon=True
+        )
+        st.server_thread.start()
+
+        # exchange WorkerInfos through the store
+        # (ref: _exchange_all_service_infos; duplicate ranks rejected)
+        key = f"rpc/worker/{rank}"
+        existing = st.store.get(key)
+        if existing is not None:
+            other: WorkerInfo = pickle.loads(bytes.fromhex(existing))
+            raise RuntimeError(
+                f"rpc rank {rank} already registered by worker "
+                f"{other.name!r} at {other.ip}:{other.port}"
+            )
+        st.store.set(key, pickle.dumps(st.self_info).hex())
+        deadline = time.time() + _DEFAULT_RPC_TIMEOUT
+        while True:
+            keys = st.store.keys("rpc/worker/")
+            if len(keys) >= world_size:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"only {len(keys)}/{world_size} rpc workers joined"
+                )
+            time.sleep(0.1)
+        for k in st.store.keys("rpc/worker/"):
+            info: WorkerInfo = pickle.loads(bytes.fromhex(st.store.get(k)))
+            if info.name in st.workers:
+                raise RuntimeError(
+                    f"duplicate rpc worker name {info.name!r} (ranks "
+                    f"{st.workers[info.name].rank} and {info.rank})"
+                )
+            st.workers[info.name] = info
+    except BaseException:
+        # failed bootstrap must not leak the exec socket, serve thread,
+        # or (rank 0) the bound master store — a retry would EADDRINUSE
+        st.stop.set()
+        if st.server_sock is not None:
+            try:
+                st.server_sock.close()
+            except OSError:
+                pass
+        if st.server_thread is not None:
+            st.server_thread.join(1.0)
+        if st.store_server is not None:
+            st.store_server.stop()
+        raise
+    _state = st
+
+
+def _require_state() -> _State:
+    if _state is None:
+        raise RuntimeError("rpc not initialized; call init_rpc first")
+    return _state
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Run ``fn(*args, **kwargs)`` on worker ``to``; block for the
+    result (ref: rpc.py:143). ``fn`` must be picklable (importable)."""
+    st = _require_state()
+    if to not in st.workers:
+        raise ValueError(f"unknown rpc worker {to!r}; have {sorted(st.workers)}")
+    info = st.workers[to]
+    with socket.create_connection((info.ip, info.port), timeout=timeout) as conn:
+        conn.settimeout(timeout)
+        with conn.makefile("rwb") as f:
+            _send_msg(f, (fn, tuple(args or ()), dict(kwargs or {})))
+            status, payload = _recv_msg(f)
+    if status != "ok":
+        raise RuntimeError(f"rpc to {to!r} failed: {payload}")
+    return payload
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT) -> Future:
+    """Like rpc_sync but returns a Future (ref: rpc.py:183 returns a
+    FutureWrapper; concurrent.futures.Future has the same .wait()/
+    .result() surface via result())."""
+    st = _require_state()
+    fut = st.pool.submit(rpc_sync, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # reference API compat: fut.wait()
+    return fut
+
+
+def _barrier(st: _State, key: str):
+    st.store.add(key, 1)
+    deadline = time.time() + _DEFAULT_RPC_TIMEOUT
+    while int(st.store.get(key) or 0) < st.world_size:
+        if time.time() > deadline:
+            raise TimeoutError(f"rpc barrier {key} timed out")
+        time.sleep(0.05)
+
+
+def shutdown():
+    """Barrier all workers, then stop agent + master store (ref:
+    rpc.py:276). Two-phase: after the shutdown barrier, every
+    non-master worker posts an explicit exit ack and does no further
+    store access; the master stops the store only once all acks are in
+    — no fixed-sleep race against slow workers."""
+    global _state
+    st = _state
+    if st is None:
+        return
+    _barrier(st, "rpc/shutdown")
+    st.stop.set()
+    if st.server_thread is not None:
+        st.server_thread.join(2.0)
+    st.pool.shutdown(wait=False)
+    if st.store_server is not None:
+        deadline = time.time() + _DEFAULT_RPC_TIMEOUT
+        while int(st.store.get("rpc/exited") or 0) < st.world_size - 1:
+            if time.time() > deadline:
+                break  # stop anyway; stragglers already passed the barrier
+            time.sleep(0.05)
+        st.store_server.stop()
+    else:
+        st.store.add("rpc/exited", 1)  # final store access
+    _state = None
+
+
+def get_worker_info(name) -> WorkerInfo:
+    """ref: rpc.py:307."""
+    return _require_state().workers[name]
+
+
+def get_all_worker_infos():
+    """ref: rpc.py:337."""
+    return sorted(_require_state().workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    """ref: rpc.py:364."""
+    return _require_state().self_info

@@ -1,0 +1,255 @@
+"""Rendezvous / membership KV stores.
+
+ref: the reference rendezvous layer — etcd leases+watches for elastic
+(fleet/elastic/manager.py:124), TCPStore for collective bootstrap
+(paddle/fluid/distributed/store/tcp_store.h). TPU-native equivalents:
+
+- ``FileKVStore``: a shared directory (NFS / GCS-fuse — present on TPU
+  pods). Atomic per-key files; zero extra infrastructure.
+- ``TCPKVStore`` + ``TCPStoreServer``: a small line-JSON socket store
+  for multi-node clusters WITHOUT a shared filesystem — the master
+  node (rank 0 / launcher) runs the server, everyone connects by
+  ``tcp://host:port``. One request per connection; values are strings.
+
+``make_store`` turns a location string into a store: a filesystem path
+-> FileKVStore, ``tcp://host:port`` -> TCPKVStore. Both back
+fleet.elastic membership and distributed.rpc worker discovery.
+
+Trusted-cluster protocol (like the reference's brpc/etcd usage): no
+auth, do not expose the port beyond the cluster network.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.parse
+from typing import Dict, List, Optional
+
+__all__ = ["KVStore", "FileKVStore", "TCPKVStore", "TCPStoreServer", "make_store"]
+
+
+class KVStore:
+    """Interface: string keys/values, prefix listing, numeric add."""
+
+    def set(self, key: str, value: str) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def keys(self, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def add(self, key: str, amount: int = 1) -> int:
+        """Atomic-enough counter (single-writer-per-key or server-side)."""
+        raise NotImplementedError
+
+    def dump(self, prefix: str = "") -> List[tuple]:
+        """[(key, value, age_seconds)] for every key under prefix, in ONE
+        backend round trip, with ages measured on the BACKEND's clock
+        (file mtime / server receive time) — so liveness comparisons are
+        immune to cross-node wall-clock skew."""
+        raise NotImplementedError
+
+
+class FileKVStore(KVStore):
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, urllib.parse.quote(key, safe=""))
+
+    def set(self, key: str, value: str) -> None:
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(value)
+        os.replace(tmp, self._path(key))
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            with open(self._path(key)) as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def keys(self, prefix: str = "") -> List[str]:
+        out = []
+        for name in os.listdir(self.root):
+            if name.endswith((".tmp", ".lock")):
+                continue
+            key = urllib.parse.unquote(name)
+            if key.startswith(prefix):
+                out.append(key)
+        return sorted(out)
+
+    def dump(self, prefix: str = "") -> List[tuple]:
+        now = time.time()
+        out = []
+        for key in self.keys(prefix):
+            try:
+                age = now - os.path.getmtime(self._path(key))
+                with open(self._path(key)) as f:
+                    out.append((key, f.read(), age))
+            except OSError:
+                continue
+        return out
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+
+    def add(self, key: str, amount: int = 1) -> int:
+        # advisory file lock for cross-process atomicity
+        import fcntl
+
+        lock_path = self._path(key) + ".lock"
+        with open(lock_path, "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            cur = int(self.get(key) or 0) + amount
+            self.set(key, str(cur))
+            fcntl.flock(lk, fcntl.LOCK_UN)
+        return cur
+
+
+class TCPStoreServer:
+    """Line-JSON KV server. Start on the master, stop() when done."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._data: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+        self._sock.close()
+
+    def _handle(self, conn):
+        try:
+            with conn, conn.makefile("rw") as f:
+                line = f.readline()
+                if not line:
+                    return
+                req = json.loads(line)
+                op = req.get("op")
+                now = time.time()
+                with self._lock:
+                    if op == "set":
+                        # stamped with the SERVER clock so dump() ages are
+                        # mutually comparable across skewed client clocks
+                        self._data[req["k"]] = (req["v"], now)
+                        resp = {"ok": True}
+                    elif op == "get":
+                        ent = self._data.get(req["k"])
+                        resp = {"ok": True, "v": None if ent is None else ent[0]}
+                    elif op == "keys":
+                        p = req.get("prefix", "")
+                        resp = {"ok": True,
+                                "v": sorted(k for k in self._data if k.startswith(p))}
+                    elif op == "dump":
+                        p = req.get("prefix", "")
+                        resp = {"ok": True, "v": [
+                            (k, v, now - ts)
+                            for k, (v, ts) in sorted(self._data.items())
+                            if k.startswith(p)
+                        ]}
+                    elif op == "delete":
+                        self._data.pop(req["k"], None)
+                        resp = {"ok": True}
+                    elif op == "add":
+                        ent = self._data.get(req["k"])
+                        cur = int(ent[0] if ent else "0") + int(req["amount"])
+                        self._data[req["k"]] = (str(cur), now)
+                        resp = {"ok": True, "v": cur}
+                    else:
+                        resp = {"ok": False, "err": f"bad op {op!r}"}
+                f.write(json.dumps(resp) + "\n")
+                f.flush()
+        except (OSError, ValueError):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(2.0)
+
+
+class TCPKVStore(KVStore):
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.host, self.port, self.timeout = host, port, timeout
+
+    def _req(self, **payload):
+        with socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        ) as conn, conn.makefile("rw") as f:
+            f.write(json.dumps(payload) + "\n")
+            f.flush()
+            resp = json.loads(f.readline())
+        if not resp.get("ok"):
+            raise RuntimeError(f"TCP store error: {resp.get('err')}")
+        return resp.get("v")
+
+    def set(self, key: str, value: str) -> None:
+        self._req(op="set", k=key, v=value)
+
+    def get(self, key: str) -> Optional[str]:
+        return self._req(op="get", k=key)
+
+    def keys(self, prefix: str = "") -> List[str]:
+        return self._req(op="keys", prefix=prefix)
+
+    def dump(self, prefix: str = "") -> List[tuple]:
+        return [tuple(e) for e in self._req(op="dump", prefix=prefix)]
+
+    def delete(self, key: str) -> None:
+        self._req(op="delete", k=key)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return self._req(op="add", k=key, amount=amount)
+
+    def wait_alive(self, deadline: float = 30.0) -> None:
+        end = time.time() + deadline
+        while True:
+            try:
+                self._req(op="get", k="__ping__")
+                return
+            except OSError:
+                if time.time() > end:
+                    raise TimeoutError(
+                        f"TCP store {self.host}:{self.port} not reachable"
+                    ) from None
+                time.sleep(0.2)
+
+
+def make_store(location: str) -> KVStore:
+    """Path -> FileKVStore; tcp://host:port -> TCPKVStore."""
+    if location.startswith("tcp://"):
+        hostport = location[len("tcp://"):]
+        host, port = hostport.rsplit(":", 1)
+        return TCPKVStore(host, int(port))
+    return FileKVStore(location)

@@ -1,0 +1,179 @@
+"""KV-store backends (shared-dir + TCP), elastic membership over TCP
+without a shared filesystem, and distributed.rpc (ref:
+fleet/elastic/manager.py etcd store, distributed/rpc/rpc.py)."""
+import operator
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import (
+    FileKVStore,
+    TCPKVStore,
+    TCPStoreServer,
+    make_store,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestStores:
+    @pytest.mark.parametrize("kind", ["file", "tcp"])
+    def test_roundtrip(self, tmp_path, kind):
+        server = None
+        if kind == "file":
+            store = FileKVStore(str(tmp_path))
+        else:
+            server = TCPStoreServer(host="127.0.0.1")
+            store = TCPKVStore("127.0.0.1", server.port)
+        try:
+            assert store.get("missing") is None
+            store.set("a/b", "v1")
+            store.set("a/c", "v2")
+            store.set("z", "v3")
+            assert store.get("a/b") == "v1"
+            assert store.keys("a/") == ["a/b", "a/c"]
+            store.delete("a/b")
+            assert store.get("a/b") is None
+            assert store.add("count", 2) == 2
+            assert store.add("count", 3) == 5
+        finally:
+            if server:
+                server.stop()
+
+    def test_make_store(self, tmp_path):
+        assert isinstance(make_store(str(tmp_path)), FileKVStore)
+        s = make_store("tcp://1.2.3.4:555")
+        assert isinstance(s, TCPKVStore) and s.port == 555
+
+
+_CHILD_ELASTIC = """
+import sys, time
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+m = ElasticManager(sys.argv[1], node_id=sys.argv[2], np="1:2",
+                   heartbeat_interval=0.2, elastic_timeout=1.0)
+m.register()
+print("registered", flush=True)
+time.sleep(60)
+"""
+
+
+class TestElasticOverTCP:
+    def test_kill_and_relaunch_member(self):
+        """Two processes over the TCP store (no shared FS): the child is
+        SIGKILLed -> membership change detected (watch returns
+        ELASTIC_EXIT_CODE); relaunched -> world reassembles."""
+        from paddle_tpu.distributed.fleet.elastic import (
+            ELASTIC_EXIT_CODE,
+            ElasticManager,
+        )
+
+        server = TCPStoreServer(host="127.0.0.1")
+        loc = f"tcp://127.0.0.1:{server.port}"
+
+        def spawn_child():
+            p = subprocess.Popen(
+                [sys.executable, "-c", _CHILD_ELASTIC, loc, "node-b"],
+                env=_env(), stdout=subprocess.PIPE, text=True,
+            )
+            assert "registered" in p.stdout.readline()
+            return p
+
+        try:
+            child = spawn_child()
+            a = ElasticManager(loc, node_id="node-a", np="1:2",
+                               heartbeat_interval=0.2, elastic_timeout=1.0)
+            world = a.register()
+            assert world == ["node-a", "node-b"]
+            assert a.rank() == 0
+
+            os.kill(child.pid, signal.SIGKILL)
+            child.wait()
+            assert a.watch() == ELASTIC_EXIT_CODE  # blocks until change
+            assert a.alive_nodes() == ["node-a"]
+            a.exit()
+
+            # relaunch: both members re-register (what the launcher does
+            # after the elastic exit code)
+            child = spawn_child()
+            a2 = ElasticManager(loc, node_id="node-a", np="1:2",
+                                heartbeat_interval=0.2, elastic_timeout=1.0)
+            world = a2.register()
+            assert world == ["node-a", "node-b"]
+            a2.exit()
+            os.kill(child.pid, signal.SIGKILL)
+            child.wait()
+        finally:
+            server.stop()
+
+
+_CHILD_RPC = """
+import sys
+import paddle_tpu.distributed.rpc as rpc
+rpc.init_rpc("worker1", rank=1, world_size=2, master_endpoint=sys.argv[1])
+print("up", flush=True)
+rpc.shutdown()  # blocks at the barrier until the master shuts down too
+print("down", flush=True)
+"""
+
+
+class TestRPC:
+    def test_two_process_rpc(self):
+        import paddle_tpu.distributed.rpc as rpc
+
+        port = _free_port()
+        endpoint = f"127.0.0.1:{port}"
+        child = subprocess.Popen(
+            [sys.executable, "-c", _CHILD_RPC, endpoint],
+            env=_env(), stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            rpc.init_rpc("worker0", rank=0, world_size=2,
+                         master_endpoint=endpoint)
+            assert "up" in child.stdout.readline()
+
+            infos = rpc.get_all_worker_infos()
+            assert [w.name for w in infos] == ["worker0", "worker1"]
+            assert rpc.get_worker_info("worker1").rank == 1
+            assert rpc.get_current_worker_info().name == "worker0"
+
+            assert rpc.rpc_sync("worker1", operator.add, (2, 3)) == 5
+            fut = rpc.rpc_async("worker1", operator.mul, (6, 7))
+            assert fut.wait() == 42
+            # self-rpc works too
+            assert rpc.rpc_sync("worker0", operator.sub, (9, 4)) == 5
+
+            with pytest.raises(RuntimeError, match="failed"):
+                rpc.rpc_sync("worker1", operator.truediv, (1, 0))
+
+            rpc.shutdown()
+            assert "down" in child.stdout.readline()
+            assert child.wait(10) == 0
+        finally:
+            if child.poll() is None:
+                child.kill()
+
+    def test_uninitialized_raises(self):
+        import paddle_tpu.distributed.rpc as rpc
+
+        with pytest.raises(RuntimeError, match="not initialized"):
+            rpc.rpc_sync("x", operator.add, (1, 2))
