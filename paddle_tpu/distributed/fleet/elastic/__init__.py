@@ -138,7 +138,9 @@ class ElasticManager:
                     self._beat()
                     if self.world_changed():
                         self.need_sync = True
-                except OSError:
+                except (OSError, ValueError, RuntimeError):
+                    # OSError: connect/reset; ValueError: truncated
+                    # response mid-close; RuntimeError: server-side error
                     continue
 
         self._thread = threading.Thread(target=loop, daemon=True)

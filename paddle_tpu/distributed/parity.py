@@ -49,18 +49,27 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
 
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
-    """ref: communication/scatter.py scatter_object_list. Group-of-one:
-    identity; the single controller owns every rank's objects."""
+    """ref: communication/scatter.py scatter_object_list — rank r
+    receives in_object_list[r]. The single controller holds every
+    rank's objects, so the contract is evaluated at the group's own
+    rank (same relaxation ``gather`` documents): out gets this rank's
+    object. src is accepted for parity (the controller IS every src)."""
     g = group or _get_global_group()
-    if g.nranks == 1:
-        out_object_list.clear()
-        out_object_list.extend(in_object_list[:1] if in_object_list else [])
+    out_object_list.clear()
+    if not in_object_list:
         return
-    raise RuntimeError(
-        "scatter_object_list: eager multi-rank object scatter is not "
-        "representable in the single-controller model; pass host objects "
-        "directly (every process sees the full program)."
-    )
+    if g.nranks > 1:
+        if len(in_object_list) != g.nranks:
+            raise ValueError(
+                f"scatter_object_list: need {g.nranks} objects (one per "
+                f"rank), got {len(in_object_list)}"
+            )
+        if g.rank < 0:
+            raise RuntimeError(
+                "scatter_object_list: this controller is not a member of "
+                f"group {g.name}; no rank to receive for"
+            )
+    out_object_list.append(in_object_list[g.rank if g.nranks > 1 else 0])
 
 
 def broadcast_object_list(object_list, src=0, group=None):

@@ -65,13 +65,30 @@ def _shard_spec(shape, mesh: Mesh, axis: str) -> PartitionSpec:
     """Shard the first dim divisible by the axis size; replicate 0-d or
     indivisible tensors (the reference pads flat buffers instead —
     ref group_sharded_utils.py; with per-tensor layout, skipping the
-    indivisible ones costs only those tensors' replication)."""
+    indivisible ones costs only those tensors' replication). Tensors
+    big enough that replication forfeits a real memory win get a
+    warning instead of silently replicating."""
+    import warnings
+
     size = dict(mesh.shape)[axis]
     spec = [None] * len(shape)
     for i, d in enumerate(shape):
         if d % size == 0 and d >= size:
             spec[i] = axis
             break
+    else:
+        numel = 1
+        for d in shape:
+            numel *= d
+        if numel >= 1 << 16:  # small biases/scalars replicate silently
+            warnings.warn(
+                f"group sharding: tensor of shape {tuple(shape)} has no "
+                f"axis divisible by the sharding degree {size}; it will "
+                "be REPLICATED on every shard (no memory saving). Pad "
+                "the dimension (e.g. vocab) to a multiple of the degree "
+                "to shard it.",
+                stacklevel=3,
+            )
     return PartitionSpec(*spec)
 
 

@@ -159,40 +159,45 @@ class TCPStoreServer:
                 req = json.loads(line)
                 op = req.get("op")
                 now = time.time()
-                with self._lock:
-                    if op == "set":
-                        # stamped with the SERVER clock so dump() ages are
-                        # mutually comparable across skewed client clocks
-                        self._data[req["k"]] = (req["v"], now)
-                        resp = {"ok": True}
-                    elif op == "get":
-                        ent = self._data.get(req["k"])
-                        resp = {"ok": True, "v": None if ent is None else ent[0]}
-                    elif op == "keys":
-                        p = req.get("prefix", "")
-                        resp = {"ok": True,
-                                "v": sorted(k for k in self._data if k.startswith(p))}
-                    elif op == "dump":
-                        p = req.get("prefix", "")
-                        resp = {"ok": True, "v": [
-                            (k, v, now - ts)
-                            for k, (v, ts) in sorted(self._data.items())
-                            if k.startswith(p)
-                        ]}
-                    elif op == "delete":
-                        self._data.pop(req["k"], None)
-                        resp = {"ok": True}
-                    elif op == "add":
-                        ent = self._data.get(req["k"])
-                        cur = int(ent[0] if ent else "0") + int(req["amount"])
-                        self._data[req["k"]] = (str(cur), now)
-                        resp = {"ok": True, "v": cur}
-                    else:
-                        resp = {"ok": False, "err": f"bad op {op!r}"}
+                try:
+                    resp = self._dispatch(op, req, now)
+                except Exception as e:  # noqa: BLE001 — marshalled to client
+                    resp = {"ok": False, "err": f"{type(e).__name__}: {e}"}
                 f.write(json.dumps(resp) + "\n")
                 f.flush()
         except (OSError, ValueError):
             pass
+
+    def _dispatch(self, op, req, now):
+        with self._lock:
+            if op == "set":
+                # stamped with the SERVER clock so dump() ages are
+                # mutually comparable across skewed client clocks
+                self._data[req["k"]] = (req["v"], now)
+                return {"ok": True}
+            if op == "get":
+                ent = self._data.get(req["k"])
+                return {"ok": True, "v": None if ent is None else ent[0]}
+            if op == "keys":
+                p = req.get("prefix", "")
+                return {"ok": True,
+                        "v": sorted(k for k in self._data if k.startswith(p))}
+            if op == "dump":
+                p = req.get("prefix", "")
+                return {"ok": True, "v": [
+                    (k, v, now - ts)
+                    for k, (v, ts) in sorted(self._data.items())
+                    if k.startswith(p)
+                ]}
+            if op == "delete":
+                self._data.pop(req["k"], None)
+                return {"ok": True}
+            if op == "add":
+                ent = self._data.get(req["k"])
+                cur = int(ent[0] if ent else "0") + int(req["amount"])
+                self._data[req["k"]] = (str(cur), now)
+                return {"ok": True, "v": cur}
+            return {"ok": False, "err": f"bad op {op!r}"}
 
     def stop(self):
         self._stop.set()

@@ -47,6 +47,15 @@ def _norm_padding(padding, n):
     raise ValueError(f"bad padding {padding!r}")
 
 
+def _conv_precision(a, w):
+    """Match tensor/linalg.py matmul: f32 inputs get HIGHEST precision
+    (the TPU default truncates conv operands to bf16); low-precision
+    inputs stay MXU-native."""
+    if np.dtype(a.dtype) == np.float32 and np.dtype(w.dtype) == np.float32:
+        return jax.lax.Precision.HIGHEST
+    return None
+
+
 def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, n, name):
     strides = _tuple(stride, n)
     dilations = _tuple(dilation, n)
@@ -66,6 +75,7 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, n,
             rhs_dilation=dilations,
             dimension_numbers=dn,
             feature_group_count=groups,
+            precision=_conv_precision(a, w),
         )
         if maybe_b:
             b = maybe_b[0]
@@ -115,6 +125,10 @@ def _conv_transpose_nd(
                 )
                 for i in range(n)
             ]
+        # transpose-conv kernel: spatial flip; the I/O channel swap is
+        # already expressed by the "IO" rhs spec in dn (newer jax removed
+        # conv_general_dilated's transpose_kernel kwarg)
+        w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
         if groups > 1:
             # grouped transpose: split I axis; lax transpose has no
             # feature_group_count for IO layout, do per-group and concat
@@ -124,7 +138,7 @@ def _conv_transpose_nd(
                 jax.lax.conv_general_dilated(
                     ag, wg, window_strides=(1,) * n, padding=padding_cfg,
                     lhs_dilation=strides, rhs_dilation=dilations,
-                    dimension_numbers=dn, transpose_kernel=True,
+                    dimension_numbers=dn, precision=_conv_precision(ag, wg),
                 )
                 for ag, wg in zip(a_groups, w_groups)
             ]
@@ -133,7 +147,7 @@ def _conv_transpose_nd(
             out = jax.lax.conv_general_dilated(
                 a, w, window_strides=(1,) * n, padding=padding_cfg,
                 lhs_dilation=strides, rhs_dilation=dilations,
-                dimension_numbers=dn, transpose_kernel=True,
+                dimension_numbers=dn, precision=_conv_precision(a, w),
             )
         if maybe_b:
             b = maybe_b[0]

@@ -20,6 +20,52 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Heavyweight files (multi-second jit compiles, model zoo, distributed
+# meshes, full parity scans). Everything else is marked `quick`:
+#   pytest -m quick   -> the <5-minute subset
+#   pytest -m slow    -> the rest (CI shard 2)
+_SLOW_FILES = {
+    "test_advice_fixes.py",       # torch-parity ctc/grid_sample sweeps
+    "test_auto_parallel.py",
+    "test_auto_tuner.py",         # measured-step tune loop
+    "test_distributed.py",
+    "test_distribution.py",       # 25 scipy-validated distributions
+    "test_fft_sparse.py",
+    "test_flash_attention.py",
+    "test_generation.py",
+    "test_grad_sweep.py",
+    "test_optimizer_training.py",
+    "test_hapi_metric.py",
+    "test_hybrid_parallel.py",
+    "test_io.py",
+    "test_models_gpt_bert.py",
+    "test_moe.py",
+    "test_namespace_parity.py",
+    "test_namespace_parity2.py",
+    "test_nn_layers.py",
+    "test_paged_attention.py",
+    "test_parity_modules.py",
+    "test_ring_attention.py",
+    "test_rnn.py",
+    "test_sharding_and_io.py",
+    "test_store_rpc.py",          # spawns subprocesses
+    "test_unet.py",
+    "test_vision.py",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "quick: fast subset (< 5 min total)")
+    config.addinivalue_line("markers", "slow: heavyweight tests (CI shard 2)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        name = os.path.basename(str(item.fspath))
+        item.add_marker(
+            pytest.mark.slow if name in _SLOW_FILES else pytest.mark.quick
+        )
+
 
 @pytest.fixture(autouse=True)
 def _fixed_seed():
