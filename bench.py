@@ -49,19 +49,44 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
 
+    import os as _os
+
+    variant = _os.environ.get("BENCH_CONFIG", "flagship")
+    multi_precision = on_tpu
     if on_tpu:
-        # 542M-param Llama at seq 2048: large enough to be MXU-bound
-        # (v5e measures ~0.74 MFU), small enough to fit params + fp32
-        # master/moments in one chip's HBM
-        config = LlamaConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-            num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=16,
-            max_position_embeddings=2048,
-        )
-        import os as _os
-        batch = int(_os.environ.get("BENCH_BATCH", 4))
-        seq = int(_os.environ.get("BENCH_SEQ", 2048))
-        steps, warmup = int(_os.environ.get("BENCH_STEPS", 132)), 2
+        if variant == "long":
+            # long-context row: attention-heavy regime, Pallas flash
+            # kernel path (BASELINE.md S>=8192 row)
+            config = LlamaConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=8192,
+            )
+            batch, seq = 1, 8192
+            steps, warmup = int(_os.environ.get("BENCH_STEPS", 48)), 2
+        elif variant == "big":
+            # largest-fits row: ~1.5B params; bf16 AdamW moments (fp32
+            # masters would need 16 bytes/param and not fit 15.75G)
+            config = LlamaConfig(
+                vocab_size=32000, hidden_size=2560, intermediate_size=6912,
+                num_hidden_layers=18, num_attention_heads=20,
+                num_key_value_heads=20, max_position_embeddings=2048,
+            )
+            batch, seq = int(_os.environ.get("BENCH_BATCH", 1)), 2048
+            steps, warmup = int(_os.environ.get("BENCH_STEPS", 24)), 2
+            multi_precision = False
+        else:
+            # flagship: 542M-param Llama at seq 2048 — large enough to be
+            # MXU-bound (v5e measures ~0.75 MFU), small enough to fit
+            # params + fp32 master/moments in one chip's HBM
+            config = LlamaConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=2048,
+            )
+            batch = int(_os.environ.get("BENCH_BATCH", 4))
+            seq = int(_os.environ.get("BENCH_SEQ", 2048))
+            steps, warmup = int(_os.environ.get("BENCH_STEPS", 132)), 2
     else:  # CPU fallback so the bench is runnable anywhere
         config = LlamaConfig.tiny()
         batch, seq, steps, warmup = 2, 64, 3, 1
@@ -70,8 +95,15 @@ def main():
     model = LlamaForCausalLM(config)
     if on_tpu:
         model.bfloat16()  # bf16 params+activations; AdamW keeps fp32 masters
+    # bf16-moment AdamW (the largest-fits config) needs a smaller step to
+    # stay stable — bf16 carries ~3 significant digits
+    lr = 1e-4 if multi_precision or not on_tpu else 1e-5
     opt = popt.AdamW(
-        learning_rate=1e-4, parameters=model.parameters(), multi_precision=on_tpu
+        learning_rate=lr, parameters=model.parameters(),
+        multi_precision=multi_precision,
+        # bf16 moment STORAGE (f32 update math, f32 masters): the AdamW
+        # pass is HBM-bound; halving its moment traffic buys ~5 ms/step
+        moment_dtype="bfloat16" if on_tpu else None,
     )
 
     def step(ids, labels):

@@ -55,9 +55,14 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None)
     rank (same relaxation ``gather`` documents): out gets this rank's
     object. src is accepted for parity (the controller IS every src)."""
     g = group or _get_global_group()
-    out_object_list.clear()
-    if not in_object_list:
-        return
+    if in_object_list is None:
+        # reference convention: only src supplies the list — but the
+        # single controller IS src; a None here would silently deliver
+        # nothing, so fail loudly instead
+        raise ValueError(
+            "scatter_object_list: in_object_list is required on the "
+            "single controller (it is every rank, including src)"
+        )
     if g.nranks > 1:
         if len(in_object_list) != g.nranks:
             raise ValueError(
@@ -69,6 +74,9 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None)
                 "scatter_object_list: this controller is not a member of "
                 f"group {g.name}; no rank to receive for"
             )
+    out_object_list.clear()
+    if not in_object_list:
+        return
     out_object_list.append(in_object_list[g.rank if g.nranks > 1 else 0])
 
 

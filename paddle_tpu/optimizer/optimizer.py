@@ -126,12 +126,14 @@ class Optimizer:
     # ------------------------------------------------------------------
     # accumulators
     # ------------------------------------------------------------------
-    def _get_accum(self, name: str, param, init=None):
+    def _get_accum(self, name: str, param, init=None, dtype=None):
         store = self._accumulators.setdefault(name, {})
         key = param.name
         if key not in store:
             if init is None:
-                dt = jnp.float32 if self._use_master(param) else param._data.dtype
+                dt = dtype or (
+                    jnp.float32 if self._use_master(param) else param._data.dtype
+                )
                 store[key] = jnp.zeros(param._data.shape, dt)
             else:
                 store[key] = init
@@ -291,32 +293,53 @@ class Momentum(Optimizer):
 
 class _AdamBase(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
-                 weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+                 weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None,
+                 moment_dtype=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        # TPU-native extension: storage dtype for m/v ("bfloat16" halves
+        # the optimizer's HBM traffic — the AdamW pass runs at bandwidth
+        # roofline; update ARITHMETIC stays f32 (_moments), and master
+        # weights keep full precision, so this is the standard safe
+        # low-precision-moments trade)
+        self._moment_dtype = (
+            None if moment_dtype is None else jnp.dtype(
+                {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+                 "float32": jnp.float32}.get(str(moment_dtype), moment_dtype)
+            )
+        )
 
     def _moments(self, p, g):
         pv = self._param_value(p)
-        g = g.astype(pv.dtype)
-        m = self._get_accum("moment1", p)
-        v = self._get_accum("moment2", p)
-        b1p = self._get_accum("beta1_pow", p, init=jnp.ones((), pv.dtype))
-        b2p = self._get_accum("beta2_pow", p, init=jnp.ones((), pv.dtype))
-        b1p = b1p * self._beta1
-        b2p = b2p * self._beta2
+        # update ARITHMETIC always runs in f32 — bf16 accumulator math
+        # (beta powers with 8 mantissa bits, g*g underflow, eps-dominated
+        # denominators) diverges after a single step at billion-param
+        # scale; only the accumulator STORAGE stays in the param dtype
+        # when multi_precision is off (the memory trade the user asked
+        # for). beta powers are scalars: always f32.
+        compute = jnp.float32 if pv.dtype != jnp.float64 else jnp.float64
+        store = self._moment_dtype or pv.dtype
+        g = g.astype(compute)
+        m = self._get_accum("moment1", p, dtype=self._moment_dtype).astype(compute)
+        v = self._get_accum("moment2", p, dtype=self._moment_dtype).astype(compute)
+        b1p = self._get_accum("beta1_pow", p, init=jnp.ones((), compute))
+        b2p = self._get_accum("beta2_pow", p, init=jnp.ones((), compute))
+        b1p = b1p.astype(compute) * self._beta1
+        b2p = b2p.astype(compute) * self._beta2
         m = self._beta1 * m + (1 - self._beta1) * g
         v = self._beta2 * v + (1 - self._beta2) * g * g
-        self._set_accum("moment1", p, m)
-        self._set_accum("moment2", p, v)
+        self._set_accum("moment1", p, m.astype(store))
+        self._set_accum("moment2", p, v.astype(store))
         self._set_accum("beta1_pow", p, b1p)
         self._set_accum("beta2_pow", p, b2p)
         return pv, g, m, v, b1p, b2p
 
     def _adam_delta(self, lr, m, v, b1p, b2p):
         # paddle adam kernel: lr_t = lr * sqrt(1-b2^t)/(1-b1^t);
-        # denom = sqrt(v) + eps * sqrt(1-b2^t)
+        # denom = sqrt(v) + eps * sqrt(1-b2^t); computed in f32 (see
+        # _moments), cast to the param dtype by the caller's subtract
         lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
         return lr_t * m / (jnp.sqrt(v) + self._epsilon * jnp.sqrt(1 - b2p))
 
@@ -335,8 +358,9 @@ class AdamW(_AdamBase):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
                  weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None):
-        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, lazy_mode, multi_precision, name)
+                 lazy_mode=False, multi_precision=False, name=None, moment_dtype=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, lazy_mode, multi_precision, name,
+                         moment_dtype=moment_dtype)
         self._coeff = float(weight_decay) if not callable(weight_decay) else weight_decay
         self._lr_ratio = lr_ratio
         self._apply_decay_param_fun = apply_decay_param_fun
