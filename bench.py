@@ -95,8 +95,10 @@ def main():
     model = LlamaForCausalLM(config)
     if on_tpu:
         model.bfloat16()  # bf16 params+activations; AdamW keeps fp32 masters
-    # bf16-moment AdamW (the largest-fits config) needs a smaller step to
-    # stay stable — bf16 carries ~3 significant digits
+    # the masterless config (multi_precision=False: bf16 WEIGHTS carry
+    # the update, ~3 significant digits) needs a smaller step to stay
+    # stable; bf16 moment STORAGE itself is safe at lr 1e-4 (update
+    # math is f32 and fp32 masters accumulate — the flagship setting)
     lr = 1e-4 if multi_precision or not on_tpu else 1e-5
     opt = popt.AdamW(
         learning_rate=lr, parameters=model.parameters(),

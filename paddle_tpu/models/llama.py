@@ -118,8 +118,30 @@ class LlamaAttention(nn.Layer):
         from ..ops.paged_attention import PagedLayerCache
 
         if isinstance(cache, PagedLayerCache):
-            # paged (block) cache: scatter into pools, attend over the
-            # gathered view — token-for-token identical to dense
+            if s == 1:
+                # decode: Pallas paged-attention kernel reads the pools
+                # through the block tables — no padded-view gather
+                def pstep_decode(qq, kk, vv, kp, vp, tbl, cl):
+                    from ..ops.paged_attention import (
+                        paged_decode_attention,
+                        paged_write_kv,
+                    )
+
+                    qq, kk = _rope(qq, kk, theta, cl.astype(jnp.float32))
+                    kp, vp = paged_write_kv(kk, vv, kp, vp, tbl, cl, 1)
+                    return paged_decode_attention(qq, kp, vp, tbl, cl), kp, vp
+
+                out, k_pool, v_pool = apply(
+                    pstep_decode, q, k, v, cache.k_pool, cache.v_pool,
+                    cache.block_tables, cur_len, op_name="paged_decode",
+                )
+                out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+                return self.o_proj(out), PagedLayerCache(
+                    k_pool, v_pool, cache.block_tables
+                )
+
+            # prefill: scatter into pools, attend over the gathered
+            # view — token-for-token identical to dense
             def pstep(qq, kk, vv, kp, vp, tbl, cl):
                 from ..ops.paged_attention import paged_update_kv_cache
 

@@ -8,17 +8,22 @@ per-sequence block tables; kernels in
 paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel).
 
 Design:
-- ``k_pool``/``v_pool`` are [num_blocks, block_size, kv_heads, head_dim]
-  pools per layer; ``block_tables`` is a [batch, max_blocks_per_seq]
-  int32 map from a sequence's logical block to a physical pool slot
-  (shared by all layers — each layer has its own pools but the layout
-  is identical). All shapes are static, so the decode step stays one
-  cached XLA program.
+- ``k_pool``/``v_pool`` are [kv_heads, num_blocks, block_size, head_dim]
+  pools per layer (the TPU paged-attention kernel's native layout);
+  ``block_tables`` is a [batch, max_blocks_per_seq] int32 map from a
+  sequence's logical block to a physical pool slot (shared by all
+  layers — each layer has its own pools but the layout is identical).
+  All shapes are static, so the decode step stays one cached XLA
+  program.
 - Writes scatter the new tokens to (table[pos//bs], pos%bs) with
   ``Array.at[...].set`` — a static-shape scatter XLA fuses into the
-  step. Reads gather the table back into a [batch, max_len] view and
-  run the same masked attention as the dense path, making paged decode
-  token-for-token identical to the dense cache by construction.
+  step. Prefill reads gather the table back into a [batch, max_len]
+  view and run the same masked attention as the dense path, making
+  paged attention token-for-token identical to the dense cache by
+  construction. Single-token DECODE instead runs the Pallas paged-
+  attention kernel (jax.experimental.pallas.ops.tpu.paged_attention —
+  scalar-prefetched block tables steer the block DMAs, no padded-view
+  materialization), with the gather path as the non-TPU fallback.
 - ``BlockManager`` is the host-side allocator (free list, per-sequence
   allocation/free) for serving loops where sequences join and leave the
   batch; ``contiguous_tables`` is the trivial layout ``generate`` uses.
@@ -44,7 +49,7 @@ __all__ = [
 class PagedLayerCache(NamedTuple):
     """One layer's paged cache: pools + the (shared) block table."""
 
-    k_pool: object  # Tensor [num_blocks, block_size, kv_heads, head_dim]
+    k_pool: object  # Tensor [kv_heads, num_blocks, block_size, head_dim]
     v_pool: object
     block_tables: object  # Tensor [batch, max_blocks_per_seq] int32
 
@@ -116,24 +121,21 @@ def alloc_paged_kv_caches(
     caches = []
     for _ in range(num_layers):
         k = Tensor(
-            jnp.zeros((num_blocks, block_size, num_kv_heads, head_dim), dtype),
+            jnp.zeros((num_kv_heads, num_blocks, block_size, head_dim), dtype),
             _internal=True,
         )
         v = Tensor(
-            jnp.zeros((num_blocks, block_size, num_kv_heads, head_dim), dtype),
+            jnp.zeros((num_kv_heads, num_blocks, block_size, head_dim), dtype),
             _internal=True,
         )
         caches.append(PagedLayerCache(k, v, tables_t))
     return caches
 
 
-def paged_update_kv_cache(kk, vv, k_pool, v_pool, tables, cl, s: int):
-    """Scatter s new tokens (starting at position ``cl``) into the pools
-    and return (k_pool, v_pool, kc_view, vc_view, mask) where the views
-    are the gathered [B, max_len, kv_heads, head_dim] caches and the
-    mask is identical to the dense ``update_kv_cache`` mask — raw jnp
-    arrays, same protocol as generation.update_kv_cache."""
-    bs = k_pool.shape[1]
+def paged_write_kv(kk, vv, k_pool, v_pool, tables, cl, s: int):
+    """Scatter s new tokens (starting at position ``cl``) into the
+    [kvh, blocks, bs, D] pools; returns the updated pools."""
+    bs = k_pool.shape[2]
     b = kk.shape[0]
     positions = cl + jnp.arange(s)  # [s]
     logical = positions // bs  # [s]
@@ -142,8 +144,24 @@ def paged_update_kv_cache(kk, vv, k_pool, v_pool, tables, cl, s: int):
         tables, jnp.broadcast_to(logical[None, :], (b, s)), axis=1
     )  # [B, s]
     off = jnp.broadcast_to(offset[None, :], (b, s))
-    k_pool = k_pool.at[phys, off].set(kk.astype(k_pool.dtype))
-    v_pool = v_pool.at[phys, off].set(vv.astype(v_pool.dtype))
+    # consecutive advanced indices (dims 1,2) keep their position, so
+    # the value layout is [kvh, B, s, D]
+    k_pool = k_pool.at[:, phys, off].set(
+        jnp.moveaxis(kk.astype(k_pool.dtype), 2, 0)
+    )
+    v_pool = v_pool.at[:, phys, off].set(
+        jnp.moveaxis(vv.astype(v_pool.dtype), 2, 0)
+    )
+    return k_pool, v_pool
+
+
+def paged_update_kv_cache(kk, vv, k_pool, v_pool, tables, cl, s: int):
+    """Scatter + gather protocol for PREFILL (or the non-TPU fallback):
+    returns (k_pool, v_pool, kc_view, vc_view, mask) where the views
+    are the gathered [B, max_len, kv_heads, head_dim] caches and the
+    mask is identical to the dense ``update_kv_cache`` mask — raw jnp
+    arrays, same protocol as generation.update_kv_cache."""
+    k_pool, v_pool = paged_write_kv(kk, vv, k_pool, v_pool, tables, cl, s)
     kc, vc = paged_gather_kv(k_pool, v_pool, tables)
     max_len = kc.shape[1]
     k_idx = jnp.arange(max_len)[None, :]
@@ -154,7 +172,56 @@ def paged_update_kv_cache(kk, vv, k_pool, v_pool, tables, cl, s: int):
 def paged_gather_kv(k_pool, v_pool, tables):
     """[B, max_blocks] tables -> padded [B, max_blocks*bs, kvh, D] views."""
     b, nb = tables.shape
-    bs, kvh, d = k_pool.shape[1:]
-    kc = k_pool[tables].reshape(b, nb * bs, kvh, d)
-    vc = v_pool[tables].reshape(b, nb * bs, kvh, d)
+    kvh, _, bs, d = k_pool.shape
+    kc = jnp.moveaxis(k_pool[:, tables], 0, 3).reshape(b, nb * bs, kvh, d)
+    vc = jnp.moveaxis(v_pool[:, tables], 0, 3).reshape(b, nb * bs, kvh, d)
     return kc, vc
+
+
+def _largest_divisor(n: int, cap: int) -> int:
+    for c in range(min(cap, n), 0, -1):
+        if n % c == 0:
+            return c
+    return 1
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, cache_len):
+    """Single-token decode attention over the paged cache.
+
+    q: [B, 1, num_heads, D]; pools [kvh, blocks, bs, D]; cache_len:
+    scalar position of the token being written (so each sequence
+    attends over cache_len+1 tokens). On TPU this runs the Pallas
+    paged-attention kernel (block tables scalar-prefetched to steer the
+    DMAs — the block_multihead_attention decode kernel role); elsewhere
+    the gathered-view fallback computes the identical result."""
+    b, s, h, d = q.shape
+    assert s == 1, "paged_decode_attention is the s==1 decode path"
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # pragma: no cover
+        platform = "cpu"
+    bs = k_pool.shape[2]
+    # TPU tiling: kernel blocks are (page_size, head_dim) tiles
+    if platform == "tpu" and d % 128 == 0 and bs % 8 == 0:
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention as _paged_attention_kernel,
+        )
+
+        lengths = jnp.full((b,), cache_len + 1, jnp.int32)
+        pages_per_seq = tables.shape[1]
+        scale = jnp.asarray(1.0 / np.sqrt(d), q.dtype)
+        out = _paged_attention_kernel(
+            q[:, 0] * scale,  # kernel applies no 1/sqrt(d) itself
+            k_pool, v_pool,
+            lengths, tables,
+            pages_per_compute_block=_largest_divisor(pages_per_seq, 8),
+        )
+        return out[:, None]  # [B, 1, H, D]
+    # fallback: gathered padded view through the SAME attention math as
+    # the dense/prefill path (keeps paged-vs-dense parity by construction)
+    from ..nn.functional.attention import _naive_attention
+
+    kc, vc = paged_gather_kv(k_pool, v_pool, tables)
+    max_len = kc.shape[1]
+    mask = (jnp.arange(max_len)[None, :] <= cache_len)[None, None]  # [1,1,1,S]
+    return _naive_attention(q, kc, vc, mask, 0.0, False, None, None)

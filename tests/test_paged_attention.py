@@ -56,6 +56,44 @@ class TestPagedGenerate:
         np.testing.assert_array_equal(jit.numpy(), eager.numpy())
 
 
+class TestPagedDecodeKernelParity:
+    @pytest.mark.skipif(
+        __import__("jax").devices()[0].platform != "tpu",
+        reason="Pallas paged-attention kernel is TPU-only; CPU runs the "
+        "gather fallback (covered by the generate-parity tests above)",
+    )
+    def test_kernel_matches_gather_fallback(self):
+        """d=128, bs=8: the kernel branch must match the gather fallback
+        on the same pools (guards the kernel invocation — scale, lengths
+        off-by-one, layout)."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import paged_attention as PA
+
+        rng = np.random.RandomState(0)
+        b, h, kvh, d, bs, nb = 2, 8, 4, 128, 8, 6
+        tables = jnp.asarray(
+            np.arange(b * nb, dtype=np.int32).reshape(b, nb)
+        )
+        k_pool = jnp.asarray(rng.randn(kvh, b * nb, bs, d), jnp.float32)
+        v_pool = jnp.asarray(rng.randn(kvh, b * nb, bs, d), jnp.float32)
+        q = jnp.asarray(rng.randn(b, 1, h, d), jnp.float32)
+        cl = jnp.asarray(37, jnp.int32)  # mid-block position
+
+        got = PA.paged_decode_attention(q, k_pool, v_pool, tables, cl)
+        # force the fallback for reference
+        kc, vc = PA.paged_gather_kv(k_pool, v_pool, tables)
+        from paddle_tpu.nn.functional.attention import _naive_attention
+
+        mask = (jnp.arange(kc.shape[1])[None, :] <= cl)[None, None]
+        want = _naive_attention(q, kc, vc, mask, 0.0, False, None, None)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+
 class TestBlockManager:
     def test_allocate_grow_free(self):
         bm = BlockManager(num_blocks=8, block_size=4)
@@ -79,8 +117,8 @@ class TestBlockManager:
             dtype=np.float32, block_size=16,
             tables=contiguous_tables(4, 32, 16),  # only 32 tokens used
         )
-        k = caches[0].k_pool
-        assert k.shape[0] == 8  # 4 seqs * 2 blocks, not 4 * 4
+        k = caches[0].k_pool  # [kvh, blocks, bs, d]
+        assert k.shape[1] == 8  # 4 seqs * 2 blocks, not 4 * 4
 
 
 class TestBlockMultiheadAttention:
