@@ -19,11 +19,17 @@ from .search import (  # noqa: F401
     cost_score,
     default_candidates,
 )
-from .tuner import AutoTuner, measured_step_runner, tune  # noqa: F401
+from .tuner import (  # noqa: F401
+    AutoTuner,
+    hybrid_runner,
+    measured_step_runner,
+    pipelined_step_runner,
+    tune,
+)
 
 __all__ = [
     "AutoTuner", "ModelGeometry", "HistoryRecorder", "GridSearch",
     "CostModelSearch", "estimate_memory_bytes", "default_candidates",
-    "cost_score", "tune", "measured_step_runner", "register_prune",
-    "register_prune_history",
+    "cost_score", "tune", "measured_step_runner", "pipelined_step_runner",
+    "hybrid_runner", "register_prune", "register_prune_history",
 ]

@@ -157,6 +157,85 @@ class TestSearchAndRecorder:
         assert best2["metric"] == 10.0
 
 
+class TestPipelinedTune:
+    def test_hybrid_runner_measures_pp_configs(self, tmp_path):
+        """pp>=2 candidates measured through the real PipelineParallel
+        schedule; pp==1 through the flat runner — one tune() sweep."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc
+
+        H, C = 16, 8
+
+        class Block(nn.Layer):
+            def __init__(self, h):
+                super().__init__()
+                self.fc = nn.Linear(h, h)
+
+            def forward(self, x):
+                return F.relu(self.fc(x))
+
+        geom = at.ModelGeometry(
+            hidden_size=H, intermediate_size=H, num_hidden_layers=8,
+            num_attention_heads=4, vocab_size=C, seq_length=1,
+        )
+
+        def layer_factory():
+            layers = [LayerDesc(Block, H) for _ in range(8)] + [nn.Linear(H, C)]
+
+            def make_batch(gbs):
+                rng = np.random.RandomState(0)
+                return (rng.randn(gbs, H).astype(np.float32),
+                        rng.randint(0, C, (gbs,)).astype(np.int64))
+
+            return layers, (lambda lo, y: F.cross_entropy(lo, y)), make_batch
+
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        cfg_model = LlamaConfig.tiny(num_attention_heads=4, num_key_value_heads=4)
+
+        def model_factory():
+            import paddle_tpu as paddle
+
+            paddle.seed(0)
+            model = LlamaForCausalLM(cfg_model)
+
+            def make_batch(gbs):
+                rng = np.random.RandomState(0)
+                ids = rng.randint(0, cfg_model.vocab_size, (gbs, 16)).astype(np.int32)
+                return ids, ids
+
+            return model, make_batch
+
+        tuner_cfg = {
+            "geometry": geom, "num_devices": 8, "global_batch_size": 16,
+            "hbm_budget_gb": 15.75,
+            "micro_batch_size_candidates": [2],
+            "recompute_candidates": [False],
+            "vpp_candidates": [1],
+            "sharding_stage_candidates": [1],
+            "search_algo": "grid",
+        }
+        from paddle_tpu.distributed import fleet
+
+        assert fleet.get_hybrid_communicate_group() is None
+        run_fn = at.hybrid_runner(model_factory, layer_factory, tuner_cfg)
+        best, rec = at.tune(
+            tuner_cfg, run_fn, max_measured=4,
+            history_path=str(tmp_path / "pp_hist.csv"),
+        )
+        measured = [c for c in rec.history if c.get("metric")]
+        assert best is not None, [c.get("error") for c in rec.history][:5]
+        # both protocols measured: at least one pipelined and one flat
+        assert any(c["pp_degree"] >= 2 for c in measured), measured
+        assert any(c["pp_degree"] == 1 for c in measured), measured
+        for c in measured:
+            assert np.isfinite(c["loss"])
+        # the sweep must not leave fleet globals behind
+        assert fleet.get_hybrid_communicate_group() is None
+        assert not fleet._fleet_initialized
+
+
 class TestMeasuredTune:
     def test_tune_542m_shape_on_8_devices(self, tmp_path):
         """End-to-end: search+prune+measure+record picks a feasible config
