@@ -240,10 +240,16 @@ def pipelined_step_runner(layer_factory: Callable, tuner_cfg: dict) -> Callable:
         }
         strategy.pipeline_configs = {"accumulate_steps": num_micro}
         # the tuner borrows the fleet globals per candidate; snapshot the
-        # caller's state so a tune sweep doesn't clobber a live job
+        # caller's state (incl. the collective group registry, which
+        # destroy_process_group clears) so a tune sweep doesn't clobber
+        # a live job
+        from paddle_tpu.distributed import collective as _coll
+
         prev_hcg = fleet.get_hybrid_communicate_group()
         prev_strategy = fleet.get_strategy()
         prev_init = fleet._fleet_initialized
+        prev_default_group = _coll._default_group
+        prev_groups = dict(_coll._groups)
         try:
             hcg = fleet.init(strategy=strategy)
             layers, loss_fn, make_batch = layer_factory()
@@ -273,6 +279,9 @@ def pipelined_step_runner(layer_factory: Callable, tuner_cfg: dict) -> Callable:
             fleet.set_hybrid_communicate_group(prev_hcg)
             fleet._strategy = prev_strategy
             fleet._fleet_initialized = prev_init
+            _coll._default_group = prev_default_group
+            _coll._groups.clear()
+            _coll._groups.update(prev_groups)
 
     return run_fn
 

@@ -312,7 +312,7 @@ class PipelineLayer(nn.Layer):
         return tape.apply(seq, h, *self._stacked, op_name="pipeline_sequential")
 
     def _forward_body_pipelined(self, h: Tensor, mesh, num_micro: int,
-                                dp_axis=None) -> Tensor:
+                                dp_axis=None, sep_axis=None) -> Tensor:
         """SPMD pipeline over the pp axis; ``h`` is [M*mb, ...].
 
         Interleaved tick schedule (reduces to classic fill-drain at V=1):
@@ -327,6 +327,14 @@ class PipelineLayer(nn.Layer):
             # this batch's microbatch size doesn't divide dp; run the
             # pipeline without the dp sharding rather than erroring
             dp_axis = None
+        if sep_axis is not None and (
+            h.ndim < 3 or h.shape[1] % dict(mesh.shape)[sep_axis] != 0
+        ):
+            # no sequence dim (or indivisible): a sep-using stage body
+            # would then open a nested shard_map inside the partial-
+            # manual region (rejected by jax) — run this batch through
+            # the correct sequential body instead
+            return self._forward_body_sequential(h)
         h_stream = tape.apply(
             lambda x: x.reshape((M, mb) + tuple(x.shape[1:])), h, op_name="microbatch_split"
         )
@@ -391,14 +399,23 @@ class PipelineLayer(nn.Layer):
 
             # dp x pp hybrid: batch-within-microbatch dim sharded over
             # dp; stacked params replicated over dp (their grads psum
-            # over dp via the shard_map transpose). Only pp (+dp) are
-            # bound manually — every other mesh axis (mp, sep, ...)
-            # stays in GSPMD auto mode, so sharding constraints inside
-            # the stage body (TP layers) keep working and XLA inserts
-            # the mp collectives within each pipeline tick.
-            x_spec = P(None, dp_axis) if dp_axis else P()
+            # over dp via the shard_map transpose). pp (+dp, +sep) are
+            # bound manually — sep shards the sequence dim (dim 2 of the
+            # [M, mb, S, ...] stream) so ring attention inside the stage
+            # body runs directly on the bound axis. Every other mesh axis
+            # (mp, ...) stays in GSPMD auto mode, so sharding constraints
+            # inside the stage body (TP layers) keep working and XLA
+            # inserts the mp collectives within each pipeline tick.
+            if sep_axis:
+                x_spec = P(None, dp_axis, sep_axis)
+            else:
+                x_spec = P(None, dp_axis) if dp_axis else P()
             in_specs = (x_spec,) + tuple(P("pp") for _ in stacked)
-            manual = frozenset({"pp"} | ({dp_axis} if dp_axis else set()))
+            manual = frozenset(
+                {"pp"}
+                | ({dp_axis} if dp_axis else set())
+                | ({sep_axis} if sep_axis else set())
+            )
             # partial-manual (auto axes present) requires VMA tracking:
             # jax's check_vma=False path builds an internal all-axes spec
             # that partial mode rejects
@@ -421,12 +438,13 @@ class PipelineLayer(nn.Layer):
         )
 
     def forward(self, x, num_micro: Optional[int] = None, mesh=None,
-                dp_axis=None):
+                dp_axis=None, sep_axis=None):
         h = x
         for l in self._pre:
             h = l(h)
         if self._num_stages > 1 and num_micro is not None and mesh is not None:
-            h = self._forward_body_pipelined(h, mesh, num_micro, dp_axis)
+            h = self._forward_body_pipelined(h, mesh, num_micro, dp_axis,
+                                             sep_axis)
         else:
             h = self._forward_body_sequential(h)
         for l in self._post:
@@ -448,6 +466,7 @@ class PipelineParallel:
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self._mesh = hcg.mesh
         self._dp_axis = None
+        self._sep_axis = None
         for name, size in dict(self._mesh.shape).items():
             if name in ("pp", "mp") or size <= 1:
                 # mp stays OUT of the shard_map's manual axis_names, in
@@ -461,12 +480,18 @@ class PipelineParallel:
                 # sharded over dp, stages over pp, grads psum over dp
                 # via the shard_map transpose
                 self._dp_axis = name
+            elif name == "sep":
+                # sep binds MANUALLY alongside pp/dp: activations carry
+                # their sequence dim sharded over sep, and
+                # sep_parallel_attention detects the already-bound axis
+                # and runs the ring body directly (no nested shard_map)
+                self._sep_axis = name
             else:
-                # sep/sharding inside the pipelined region would nest a
-                # manual shard_map (ring attention) in the partial-manual
-                # context, which jax rejects; fall back to sequential
+                # sharding-stage params inside the pipelined region are
+                # not composed; fall back to sequential
                 self._mesh = None
                 self._dp_axis = None
+                self._sep_axis = None
                 break
         self._compiled = {}
         self._place_stacked()
@@ -518,7 +543,7 @@ class PipelineParallel:
             def step(xx, yy):
                 logits = layers.forward(
                     xx, num_micro=self.accumulate_steps, mesh=self._mesh,
-                    dp_axis=self._dp_axis,
+                    dp_axis=self._dp_axis, sep_axis=self._sep_axis,
                 )
                 loss = layers._loss_fn(logits, yy)
                 if scaler is not None:
@@ -547,7 +572,8 @@ class PipelineParallel:
         with tape.no_grad():
             if self._mesh is not None and x.shape[0] % M == 0:
                 logits = self._layers.forward(
-                    x, num_micro=M, mesh=self._mesh, dp_axis=self._dp_axis
+                    x, num_micro=M, mesh=self._mesh, dp_axis=self._dp_axis,
+                    sep_axis=self._sep_axis,
                 )
             else:
                 logits = self._layers.forward(x)

@@ -36,11 +36,32 @@ __all__ = ["ring_attention", "sep_parallel_attention"]
 _NEG = -1e30
 
 
+def _manual_axes() -> tuple:
+    """Axis names bound manually in the current trace context (empty
+    outside any shard_map). Single point of contact with the abstract-
+    mesh introspection API."""
+    am = jax.sharding.get_abstract_mesh()
+    if am is None or am.empty:
+        return ()
+    from jax.sharding import AxisType
+
+    return tuple(
+        n for n, t in zip(am.axis_names, am.axis_types) if t == AxisType.Manual
+    )
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   vary_axes: Optional[tuple] = None):
     """Sequence-sharded attention; call inside shard_map/pjit over a
     mesh with ``axis_name``. q/k/v: [B, S_local, H, D] (paddle layout).
-    Returns [B, S_local, H, D]."""
+    Returns [B, S_local, H, D].
+
+    ``vary_axes``: manual axes the scan carries must be marked varying
+    over. Defaults to (axis_name,) — correct when this ring owns the
+    only manual region; a caller composing inside an outer manual
+    shard_map (the pipelined dp x sep x pp path) passes the outer
+    manual set so the carry variance matches the k/v entries."""
     p_size = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -52,13 +73,21 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     # out_t = sum_k exp(s - m_t)·v and l_t = sum_k exp(s - m_t). Merge:
     #   m' = max(m, m_t); acc' = acc·e^{m-m'} + out_t·e^{m_t-m'}
     #   l'  = l·e^{m-m'} + l_t·e^{m_t-m'}
+    # framework policy (tensor/linalg.py matmul, nn/functional/conv.py):
+    # f32 inputs get HIGHEST precision — the TPU default truncates
+    # einsum operands to bf16
+    _prec = (
+        jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else None
+    )
+
     def block(q, k_t, v_t, src_rank):
         kv_off = src_rank * s_local
         qh = jnp.swapaxes(q, 1, 2)
         kh = jnp.swapaxes(k_t, 1, 2)
         vh = jnp.swapaxes(v_t, 1, 2)
         s = jnp.einsum(
-            "bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32
+            "bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32,
+            precision=_prec,
         ) * sc
         if causal:
             q_abs = q_off + jax.lax.broadcasted_iota(jnp.int32, (s_local, s_local), 0)
@@ -70,7 +99,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
             p = jnp.where(s <= _NEG / 2, 0.0, p)
         l_t = jnp.sum(p, axis=-1)
         out_t = jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vh.astype(jnp.float32)
+            "bhqk,bhkd->bhqd", p, vh.astype(jnp.float32), precision=_prec
         )
         return out_t, m_t, l_t
 
@@ -102,9 +131,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     def _varying(x):
         # shard_map scans need device-varying carries; pcast is the
         # non-deprecated spelling, pvary the fallback on older jax
+        axes = vary_axes or (axis_name,)
         if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(x, (axis_name,), to="varying")
-        return jax.lax.pvary(x, (axis_name,))
+            return jax.lax.pcast(x, axes, to="varying")
+        return jax.lax.pvary(x, axes)
 
     m0 = _varying(jnp.full((b, h, s_local), _NEG, jnp.float32))
     l0 = _varying(jnp.zeros((b, h, s_local), jnp.float32))
@@ -123,18 +153,45 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, S_local, H, D]
 
 
-def sep_parallel_attention(q, k, v, mesh, axis_name: str = "sep",
+def _axis_already_manual(axis_name: str) -> bool:
+    """True when the current trace is inside a shard_map that bound
+    ``axis_name`` manually — the caller's arrays are already local
+    shards and a nested shard_map over the axis would be rejected."""
+    return axis_name in _manual_axes()
+
+
+def sep_parallel_attention(q, k, v, mesh=None, axis_name: str = "sep",
                            causal: bool = False,
                            scale: Optional[float] = None):
-    """User entry: q/k/v are GLOBAL [B, S, H, D] Tensors/arrays; shards
-    the sequence over ``axis_name`` of ``mesh``, runs ring attention,
-    returns the global result (ref: the sep_parallel attention path in
-    fleet meta_parallel)."""
+    """User entry (ref: the sep_parallel attention path in fleet
+    meta_parallel). Two calling contexts:
+
+    - OUTSIDE any manual region (the usual case): q/k/v are GLOBAL
+      [B, S, H, D] Tensors/arrays; opens a shard_map over ``mesh``'s
+      ``axis_name``, runs ring attention on the sequence shards,
+      returns the global result.
+    - INSIDE a shard_map that already bound ``axis_name`` (e.g. the
+      pipelined region binding sep manually): q/k/v are the LOCAL
+      sequence shards; runs the ring body directly on the bound axis —
+      this is what lets sep compose inside dp x sep x pp pipelines.
+    """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..base.tape import apply
 
+    if _axis_already_manual(axis_name):
+        return apply(
+            partial(ring_attention, axis_name=axis_name, causal=causal,
+                    scale=scale, vary_axes=_manual_axes()),
+            q, k, v, op_name="sep_parallel_attention_local",
+        )
+
+    if mesh is None:
+        raise ValueError(
+            "sep_parallel_attention needs `mesh` when called outside a "
+            f"manual region binding axis {axis_name!r}"
+        )
     spec = P(None, axis_name, None, None)
 
     def f(qq, kk, vv):

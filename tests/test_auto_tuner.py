@@ -219,6 +219,7 @@ class TestPipelinedTune:
         from paddle_tpu.distributed import fleet
 
         assert fleet.get_hybrid_communicate_group() is None
+        pre_init_flag = fleet._fleet_initialized
         run_fn = at.hybrid_runner(model_factory, layer_factory, tuner_cfg)
         best, rec = at.tune(
             tuner_cfg, run_fn, max_measured=4,
@@ -231,9 +232,9 @@ class TestPipelinedTune:
         assert any(c["pp_degree"] == 1 for c in measured), measured
         for c in measured:
             assert np.isfinite(c["loss"])
-        # the sweep must not leave fleet globals behind
+        # the sweep must restore the caller's fleet globals exactly
         assert fleet.get_hybrid_communicate_group() is None
-        assert not fleet._fleet_initialized
+        assert fleet._fleet_initialized == pre_init_flag
 
 
 class TestMeasuredTune:

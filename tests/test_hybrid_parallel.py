@@ -521,6 +521,106 @@ class TestPipelineParallel:
             dist.destroy_process_group()
             fleet.set_hybrid_communicate_group(None)
 
+    def test_dp_sep_pp_hybrid_matches_serial(self):
+        """dp=2 x sep=2 x pp=2: RING ATTENTION runs inside the pipelined
+        shard_map — sep is bound manually alongside pp/dp and
+        sep_parallel_attention detects the bound axis (no nested
+        shard_map). Losses must match a serial full-attention twin."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc,
+            PipelineLayer,
+            PipelineParallel,
+        )
+        from paddle_tpu.ops.ring_attention import sep_parallel_attention
+        from paddle_tpu.tensor import manipulation as M
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "sep_degree": 2, "pp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        hcg = fleet.init(strategy=strategy)
+
+        H, HEADS, S, C, MB, Mn = 16, 2, 8, 6, 4, 2
+
+        class SepBlock(nn.Layer):
+            def __init__(self, h, heads, use_sep=True):
+                super().__init__()
+                self.h, self.heads = h, heads
+                self.qkv = nn.Linear(h, 3 * h)
+                self.o = nn.Linear(h, h)
+                self.use_sep = use_sep
+
+            def forward(self, x):  # [B, S, h]
+                b, s, hh = x.shape
+                d = hh // self.heads
+                qkv = M.reshape(self.qkv(x), [b, s, 3, self.heads, d])
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                if self.use_sep:
+                    out = sep_parallel_attention(
+                        q, k, v, mesh=hcg.mesh, axis_name="sep", causal=True
+                    )
+                else:
+                    out = F.scaled_dot_product_attention(
+                        q, k, v, is_causal=True, training=False
+                    )
+                return x + self.o(M.reshape(out, [b, s, hh]))
+
+        def loss_fn(logits, y):
+            b, s, c = logits.shape
+            return F.cross_entropy(
+                M.reshape(logits, [b * s, c]), M.reshape(y, [b * s])
+            )
+
+        try:
+            paddle.seed(61)
+            pipe = PipelineLayer(
+                layers=[LayerDesc(SepBlock, H, HEADS) for _ in range(4)]
+                + [nn.Linear(H, C)],
+                num_stages=2, loss_fn=loss_fn,
+            )
+            pp_model = PipelineParallel(pipe, hcg, strategy)
+            assert pp_model._mesh is not None and pp_model._sep_axis == "sep"
+
+            serial_blocks = [SepBlock(H, HEADS, use_sep=False) for _ in range(4)]
+            for s_idx in range(2):
+                for i in range(2):
+                    blk = serial_blocks[s_idx * 2 + i]
+                    base = i * 4
+                    blk.qkv.weight.set_value(paddle.to_tensor(np.asarray(pipe._stacked[base + 0]._data[s_idx])))
+                    blk.qkv.bias.set_value(paddle.to_tensor(np.asarray(pipe._stacked[base + 1]._data[s_idx])))
+                    blk.o.weight.set_value(paddle.to_tensor(np.asarray(pipe._stacked[base + 2]._data[s_idx])))
+                    blk.o.bias.set_value(paddle.to_tensor(np.asarray(pipe._stacked[base + 3]._data[s_idx])))
+            serial_head = nn.Linear(H, C)
+            serial_head.weight.set_value(pipe._post[0].weight)
+            serial_head.bias.set_value(pipe._post[0].bias)
+
+            pp_opt = opt.SGD(learning_rate=0.05, parameters=pipe.parameters())
+            serial_params = [p for b in serial_blocks for p in b.parameters()] + list(
+                serial_head.parameters()
+            )
+            serial_opt = opt.SGD(learning_rate=0.05, parameters=serial_params)
+
+            rng = np.random.RandomState(5)
+            for step in range(3):
+                x_np = rng.randn(Mn * MB, S, H).astype(np.float32)
+                y_np = rng.randint(0, C, (Mn * MB, S)).astype(np.int64)
+                loss_pp = pp_model.train_batch(
+                    (paddle.to_tensor(x_np), paddle.to_tensor(y_np)), pp_opt
+                )
+                h = paddle.to_tensor(x_np)
+                for blk in serial_blocks:
+                    h = blk(h)
+                loss_serial = loss_fn(serial_head(h), paddle.to_tensor(y_np))
+                loss_serial.backward()
+                serial_opt.step()
+                serial_opt.clear_grad()
+                np.testing.assert_allclose(
+                    float(loss_pp), float(loss_serial), rtol=3e-5, atol=1e-6
+                )
+        finally:
+            dist.destroy_process_group()
+            fleet.set_hybrid_communicate_group(None)
+
     def test_dp_pp_hybrid_odd_microbatch_falls_back(self):
         """mb not divisible by dp must run (unsharded) instead of raising."""
         import paddle_tpu.distributed as dist

@@ -84,3 +84,25 @@ def test_under_jit_with_long_sequence():
     np.testing.assert_allclose(
         np.asarray(out), _naive(q, k, v, True), atol=2e-5
     )
+
+
+def test_standalone_on_multi_axis_mesh():
+    """Regression: sep_parallel_attention on a hybrid mesh (dp x sep)
+    OUTSIDE any manual region — the self-opened shard_map binds all
+    mesh axes; the scan carries must vary over the ring axis only."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.ops.ring_attention import sep_parallel_attention
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "sep"))
+    rng = np.random.RandomState(0)
+    q = paddle.to_tensor(rng.randn(2, 8, 2, 4).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(2, 8, 2, 4).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(2, 8, 2, 4).astype(np.float32))
+    out = sep_parallel_attention(q, k, v, mesh=mesh, axis_name="sep", causal=True)
+    want = F.scaled_dot_product_attention(q, k, v, is_causal=True, training=False)
+    np.testing.assert_allclose(out.numpy(), want.numpy(), rtol=1e-5, atol=1e-5)
