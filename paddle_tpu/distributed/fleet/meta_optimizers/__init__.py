@@ -7,7 +7,42 @@ from __future__ import annotations
 from typing import Optional
 
 
-class HybridParallelOptimizer:
+class _OptimizerWrapper:
+    """Attribute-transparent optimizer wrapper base.
+
+    Contract: a subclass __init__ assigns its OWN attributes FIRST and
+    ``self._inner_opt`` LAST. Until ``_inner_opt`` exists, every write
+    stays on the wrapper; afterwards, writes to names the wrapper does
+    not already own forward to the inner optimizer. jit.to_static
+    threads optimizer state by ASSIGNING ``_accumulators`` /
+    ``_lr_override`` / ``_global_step`` — a write landing on the
+    wrapper would leave the inner optimizer holding stale trace-time
+    tracers.
+    """
+
+    def __setattr__(self, name, value):
+        if "_inner_opt" not in self.__dict__ or name in self.__dict__:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self.__dict__["_inner_opt"], name, value)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+
+class HybridParallelOptimizer(_OptimizerWrapper):
     """Wraps the user optimizer for hybrid-parallel training.
 
     The reference localizes grad clip per comm group and fuses
@@ -19,18 +54,13 @@ class HybridParallelOptimizer:
     """
 
     def __init__(self, optimizer, hcg=None, strategy=None):
-        self._inner_opt = optimizer
+        # wrapper-local attrs BEFORE _inner_opt (see _OptimizerWrapper)
         self._hcg = hcg
         self._strategy = strategy
-
-    def step(self):
-        self._inner_opt.step()
+        self._inner_opt = optimizer
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
         return self._inner_opt.minimize(loss)
-
-    def clear_grad(self, set_to_zero: bool = False):
-        self._inner_opt.clear_grad(set_to_zero)
 
     def set_lr(self, lr):
         self._inner_opt.set_lr(lr)
@@ -38,17 +68,8 @@ class HybridParallelOptimizer:
     def get_lr(self):
         return self._inner_opt.get_lr()
 
-    def state_dict(self):
-        return self._inner_opt.state_dict()
 
-    def set_state_dict(self, sd):
-        return self._inner_opt.set_state_dict(sd)
-
-    def __getattr__(self, name):
-        return getattr(self.__dict__["_inner_opt"], name)
-
-
-class DygraphShardingOptimizer:
+class DygraphShardingOptimizer(_OptimizerWrapper):
     """Stage-1 sharding optimizer (ref: fleet/meta_optimizers/
     dygraph_optimizer/dygraph_sharding_optimizer.py:44).
 
@@ -62,8 +83,9 @@ class DygraphShardingOptimizer:
     def __init__(self, optimizer, hcg=None):
         from ...sharding import _place, _sharding_mesh_axis
 
-        self._inner_opt = optimizer
+        # wrapper-local attrs BEFORE _inner_opt (see _OptimizerWrapper)
         self._hcg = hcg
+        self._inner_opt = optimizer
         group = hcg.get_sharding_parallel_group() if hcg is not None else None
         mesh, axis = _sharding_mesh_axis(group)
         optimizer._accum_placement_fn = (
@@ -73,18 +95,3 @@ class DygraphShardingOptimizer:
         for store in optimizer._accumulators.values():
             for key in store:
                 store[key] = _place(store[key], mesh, axis)
-
-    def step(self):
-        self._inner_opt.step()
-
-    def clear_grad(self, set_to_zero: bool = False):
-        self._inner_opt.clear_grad(set_to_zero)
-
-    def state_dict(self):
-        return self._inner_opt.state_dict()
-
-    def set_state_dict(self, sd):
-        return self._inner_opt.set_state_dict(sd)
-
-    def __getattr__(self, name):
-        return getattr(self.__dict__["_inner_opt"], name)

@@ -468,12 +468,16 @@ class PipelineParallel:
         self._dp_axis = None
         self._sep_axis = None
         for name, size in dict(self._mesh.shape).items():
-            if name in ("pp", "mp") or size <= 1:
-                # mp stays OUT of the shard_map's manual axis_names, in
-                # GSPMD auto mode: the TP layers' with_sharding_constraint
-                # over "mp" keeps partitioning each stage body's matmuls
-                # and inserting the TP collectives inside the pipelined
-                # region — dp x mp x pp composes in one program.
+            if name in ("pp", "mp", "sharding") or size <= 1:
+                # mp and sharding stay OUT of the shard_map's manual
+                # axis_names, in GSPMD auto mode: the TP layers'
+                # with_sharding_constraint over "mp" keeps partitioning
+                # each stage body's matmuls inside the pipelined region,
+                # and sharding-stage state lives on the OPTIMIZER
+                # accumulators (DygraphShardingOptimizer places them over
+                # "sharding" via GSPMD) — the forward only sees params
+                # replicated over that axis. dp x mp x pp x sharding
+                # composes in one program.
                 continue
             if name == "dp":
                 # dp x pp hybrid: the shard_map binds both axes — batch
@@ -487,8 +491,9 @@ class PipelineParallel:
                 # and runs the ring body directly (no nested shard_map)
                 self._sep_axis = name
             else:
-                # sharding-stage params inside the pipelined region are
-                # not composed; fall back to sequential
+                # unknown custom axis: a stage body doing manual
+                # collectives over it would nest a shard_map inside the
+                # partial-manual region; fall back to sequential
                 self._mesh = None
                 self._dp_axis = None
                 self._sep_axis = None

@@ -109,10 +109,19 @@ class StaticFunction:
             candidates += [c.cell_contents for c in fn_closure if c.cell_contents is not None]
         if hasattr(fn, "__self__"):
             candidates.append(fn.__self__)
+        def is_optimizer(o):
+            # plain optimizers AND attribute-forwarding wrappers
+            # (HybridParallelOptimizer / DygraphShardingOptimizer expose
+            # _inner_opt) — a closure-captured wrapper must be threaded
+            # or its Adam state silently resets every cached call
+            return isinstance(o, Optimizer) or isinstance(
+                getattr(o, "_inner_opt", None), Optimizer
+            )
+
         for obj in candidates:
             if isinstance(obj, Layer) and obj not in self._layers:
                 self._layers.append(obj)
-            elif isinstance(obj, Optimizer) and obj not in self._optimizers:
+            elif is_optimizer(obj) and obj not in self._optimizers:
                 self._optimizers.append(obj)
             elif isinstance(obj, AmpScaler) and obj not in self._scalers:
                 self._scalers.append(obj)

@@ -621,6 +621,87 @@ class TestPipelineParallel:
             dist.destroy_process_group()
             fleet.set_hybrid_communicate_group(None)
 
+    def test_dp_sharding_pp_hybrid_matches_serial(self):
+        """dp=2 x sharding=2 x pp=2 with DygraphShardingOptimizer:
+        the sharding axis stays in GSPMD auto mode (optimizer-state
+        placement), the pipeline still runs, losses match a serial AdamW
+        twin, and the accumulators really shard over 'sharding'."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            DygraphShardingOptimizer,
+        )
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc,
+            PipelineLayer,
+            PipelineParallel,
+        )
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 2, "sharding_degree": 2, "pp_degree": 2,
+        }
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        hcg = fleet.init(strategy=strategy)
+        try:
+            H, C, MB, Mn = 16, 4, 4, 2
+
+            def loss_fn(logits, y):
+                return F.cross_entropy(logits, y)
+
+            paddle.seed(71)
+            pipe = PipelineLayer(
+                layers=[LayerDesc(Block, H) for _ in range(4)] + [nn.Linear(H, C)],
+                num_stages=2, loss_fn=loss_fn,
+            )
+            pp_model = PipelineParallel(pipe, hcg, strategy)
+            assert pp_model._mesh is not None  # sharding axis must not null it
+
+            serial_blocks = [Block(H) for _ in range(4)]
+            for s in range(2):
+                for i in range(2):
+                    blk = serial_blocks[s * 2 + i]
+                    blk.fc.weight.set_value(paddle.to_tensor(np.asarray(pipe._stacked[2 * i]._data[s])))
+                    blk.fc.bias.set_value(paddle.to_tensor(np.asarray(pipe._stacked[2 * i + 1]._data[s])))
+            serial_head = nn.Linear(H, C)
+            serial_head.weight.set_value(pipe._post[0].weight)
+            serial_head.bias.set_value(pipe._post[0].bias)
+
+            inner = opt.AdamW(learning_rate=0.01, parameters=pipe.parameters())
+            pp_opt = DygraphShardingOptimizer(inner, hcg)
+            serial_params = [p for b in serial_blocks for p in b.parameters()] + list(
+                serial_head.parameters()
+            )
+            serial_opt = opt.AdamW(learning_rate=0.01, parameters=serial_params)
+
+            rng = np.random.RandomState(9)
+            for step in range(3):
+                x_np = rng.randn(Mn * MB, H).astype(np.float32)
+                y_np = rng.randint(0, C, (Mn * MB,)).astype(np.int64)
+                loss_pp = pp_model.train_batch(
+                    (paddle.to_tensor(x_np), paddle.to_tensor(y_np)), pp_opt
+                )
+                h = paddle.to_tensor(x_np)
+                for b in serial_blocks:
+                    h = b(h)
+                loss_serial = loss_fn(serial_head(h), paddle.to_tensor(y_np))
+                loss_serial.backward()
+                serial_opt.step()
+                serial_opt.clear_grad()
+                np.testing.assert_allclose(
+                    float(loss_pp), float(loss_serial), rtol=3e-5, atol=1e-6
+                )
+
+            m1 = inner._accumulators["moment1"]
+            sharded = [
+                k for k, v in m1.items()
+                if getattr(v.sharding, "spec", None)
+                and "sharding" in str(v.sharding.spec)
+            ]
+            assert sharded, {k: str(v.sharding) for k, v in m1.items()}
+        finally:
+            dist.destroy_process_group()
+            fleet.set_hybrid_communicate_group(None)
+
     def test_dp_pp_hybrid_odd_microbatch_falls_back(self):
         """mb not divisible by dp must run (unsharded) instead of raising."""
         import paddle_tpu.distributed as dist

@@ -129,3 +129,44 @@ class TestSaveLoad:
         paddle.jit.save(net, path)
         state = paddle.jit.load(path)
         assert "0.weight" in state
+
+
+class TestWrappedOptimizerThreading:
+    def test_closure_captured_wrapper_threads_state(self):
+        """Regression: a fleet optimizer WRAPPER captured in the step
+        closure must be discovered and its Adam state threaded — losses
+        must match a plain AdamW run exactly and _global_step advance."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            HybridParallelOptimizer,
+        )
+
+        def train(wrap):
+            paddle.seed(3)
+            model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+            inner = opt.AdamW(learning_rate=1e-2, parameters=model.parameters())
+            optimizer = HybridParallelOptimizer(inner) if wrap else inner
+
+            def step(x, y):
+                loss = F.cross_entropy(model(x), y)
+                loss.backward()
+                optimizer.step()
+                optimizer.clear_grad()
+                return loss
+
+            fn = paddle.jit.to_static(step)  # closure discovery only
+            rng = np.random.RandomState(0)
+            x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+            y = paddle.to_tensor(rng.randint(0, 4, (8,)).astype(np.int64))
+            losses = [float(fn(x, y)) for _ in range(4)]
+            return losses, inner._global_step
+
+        plain_losses, plain_steps = train(wrap=False)
+        wrapped_losses, wrapped_steps = train(wrap=True)
+        np.testing.assert_allclose(wrapped_losses, plain_losses, rtol=1e-6)
+        assert wrapped_steps == plain_steps > 1
