@@ -80,8 +80,11 @@ class StaticFunction:
         self._layers = list(layers)
         self._optimizers = list(optimizers)
         self._scalers = list(scalers)
-        if not self._layers and not self._optimizers:
-            self._auto_discover(fn)
+        # auto-discovery is DEFERRED to the first call: a module-level
+        # @to_static decorator usually runs before the model/optimizer
+        # globals it references even exist
+        self._orig_fn = fn
+        self._needs_discovery = not self._layers and not self._optimizers
         # dy2static: rewrite tensor-dependent if/while into runtime
         # dispatch (lax select/while under trace, plain Python eagerly)
         from . import dy2static as _d2s
@@ -97,9 +100,16 @@ class StaticFunction:
 
     # -- discovery ------------------------------------------------------
     def _auto_discover(self, fn):
-        """Find Layers/Optimizers in the function's closure + globals
-        (the SOT front-end does this at bytecode level; here a direct
-        object scan suffices for the supported idiom)."""
+        """Find Layers/Optimizers in the function's closure + the module
+        globals its bytecode actually LOADS (the SOT front-end does this
+        at bytecode level; here dis + a direct object scan suffice).
+        Runs at first call, not decoration, so globals defined after the
+        decorator are seen. Optimizer wrappers (any ``_inner_opt``
+        chain) are recognized and deduplicated against their innermost
+        optimizer — threading the same state twice would double-donate
+        its buffers."""
+        import dis
+
         from ..amp.grad_scaler import AmpScaler
         from ..nn.layer.layers import Layer
         from ..optimizer.optimizer import Optimizer
@@ -109,22 +119,46 @@ class StaticFunction:
             candidates += [c.cell_contents for c in fn_closure if c.cell_contents is not None]
         if hasattr(fn, "__self__"):
             candidates.append(fn.__self__)
-        def is_optimizer(o):
-            # plain optimizers AND attribute-forwarding wrappers
-            # (HybridParallelOptimizer / DygraphShardingOptimizer expose
-            # _inner_opt) — a closure-captured wrapper must be threaded
-            # or its Adam state silently resets every cached call
-            return isinstance(o, Optimizer) or isinstance(
-                getattr(o, "_inner_opt", None), Optimizer
-            )
+        # module-level step functions reference their model/optimizer as
+        # GLOBALS, not closure cells; scan exactly the names loaded via
+        # LOAD_GLOBAL (co_names alone also contains attribute names)
+        code = getattr(fn, "__code__", None)
+        fn_globals = getattr(fn, "__globals__", None)
+        if code is not None and fn_globals is not None:
+            loaded = {
+                ins.argval
+                for ins in dis.get_instructions(code)
+                if ins.opname == "LOAD_GLOBAL"
+            }
+            for gname in loaded:
+                obj = fn_globals.get(gname)
+                if obj is not None:
+                    candidates.append(obj)
 
+        def innermost(o):
+            # unwrap _inner_opt chains (HybridParallelOptimizer around
+            # DygraphShardingOptimizer around AdamW, etc.)
+            seen = set()
+            while not isinstance(o, Optimizer):
+                if id(o) in seen:
+                    return None
+                seen.add(id(o))
+                o = getattr(o, "_inner_opt", None)
+                if o is None:
+                    return None
+            return o
+
+        known_inner = {id(innermost(o)) for o in self._optimizers}
         for obj in candidates:
             if isinstance(obj, Layer) and obj not in self._layers:
                 self._layers.append(obj)
-            elif is_optimizer(obj) and obj not in self._optimizers:
-                self._optimizers.append(obj)
             elif isinstance(obj, AmpScaler) and obj not in self._scalers:
                 self._scalers.append(obj)
+            else:
+                inner = innermost(obj)
+                if inner is not None and id(inner) not in known_inner:
+                    known_inner.add(id(inner))
+                    self._optimizers.append(obj)
 
     def _collect_cells(self):
         cells, seen = [], set()
@@ -170,6 +204,14 @@ class StaticFunction:
     # -- the pure function ----------------------------------------------
     def _make_pure(self, arg_treedef, n_out_hint=None):
         def pure(state, lrs, flat_args):
+            # host-side trace marker: pure() only executes while jax is
+            # TRACING (cached executions replay the compiled program).
+            # __call__ uses this to know whether the optimizer's host
+            # step counter already advanced — inferring from "first call
+            # with this treedef" misses jax-level retraces (e.g. the
+            # second call, once lazily-created accumulators change the
+            # state pytree), which double-counted _global_step.
+            self._pure_runs = getattr(self, "_pure_runs", 0) + 1
             self._write_state(state)
             for o, lr in zip(self._optimizers, lrs):
                 o._lr_override = lr
@@ -206,6 +248,9 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _jit_enabled[0]:
             return self._fn(*args, **kwargs)
+        if self._needs_discovery:
+            self._auto_discover(self._orig_fn)
+            self._needs_discovery = False
         if not self._cells:
             self._collect_cells()
 
@@ -216,23 +261,26 @@ class StaticFunction:
         lrs = [jnp.asarray(o.get_lr(), jnp.float32) for o in self._optimizers]
 
         jitted = self._jit_cache.get(arg_treedef)
-        traced_now = jitted is None
-        if traced_now:
+        if jitted is None:
             pure = self._make_pure(arg_treedef)
             jit_kwargs = {}
             if self._donate_state:
                 jit_kwargs["donate_argnums"] = (0,)
             jitted = jax.jit(pure, **jit_kwargs)
             self._jit_cache[arg_treedef] = jitted
+        runs_before = getattr(self, "_pure_runs", 0)
         out_arrays, new_state = jitted(state, lrs, flat_arrays)
+        trace_runs = getattr(self, "_pure_runs", 0) - runs_before
         self._last_lowered = jitted
         self._write_state(new_state)
         self._sanitize_grads()
-        # host-side step counters: the traced optimizer.step() advanced
-        # _global_step only at trace time; advance it on cached calls
-        if not traced_now:
+        # host-side step counters: this call represents exactly ONE
+        # optimizer step; tracing already advanced _global_step once per
+        # pure() execution (0 on cached calls, 1 per [re]trace)
+        correction = 1 - trace_runs
+        if correction:
             for o in self._optimizers:
-                o._global_step += 1
+                o._global_step += correction
         return tree_util.tree_map(
             lambda a: Tensor(a, _internal=True) if isinstance(a, jax.Array) else a, out_arrays
         )
@@ -305,8 +353,7 @@ class StaticFunction:
         abstract = tuple((tuple(a.shape), str(a.dtype)) for a in flat_arrays)
         key = ("__multi_step__", arg_treedef, abstract, n)
         jitted = self._jit_cache.get(key)
-        traced_now = jitted is None
-        if traced_now:
+        if jitted is None:
             pure = self._make_pure(arg_treedef)
 
             def scanned(state, lrs_stacked, flat_stacked):
@@ -324,13 +371,18 @@ class StaticFunction:
                 scanned, donate_argnums=(0,) if self._donate_state else ()
             )
             self._jit_cache[key] = jitted
+        runs_before = getattr(self, "_pure_runs", 0)
         outs, new_state = jitted(state, lrs_stacked, flat_arrays)
+        trace_runs = getattr(self, "_pure_runs", 0) - runs_before
         self._write_state(new_state)
         self._sanitize_grads()
-        # host-side step counter: tracing already advanced it by 1
-        # (optimizer.step() ran once at trace time), same as __call__
-        for o in self._optimizers:
-            o._global_step += n - 1 if traced_now else n
+        # host-side step counter: this call represents n optimizer
+        # steps; tracing already advanced _global_step once per pure()
+        # execution (scan traces its body at least once)
+        correction = n - trace_runs
+        if correction:
+            for o in self._optimizers:
+                o._global_step += correction
         return tree_util.tree_map(
             lambda a: Tensor(a, _internal=True) if isinstance(a, jax.Array) else a, outs
         )

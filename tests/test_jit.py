@@ -169,4 +169,80 @@ class TestWrappedOptimizerThreading:
         plain_losses, plain_steps = train(wrap=False)
         wrapped_losses, wrapped_steps = train(wrap=True)
         np.testing.assert_allclose(wrapped_losses, plain_losses, rtol=1e-6)
-        assert wrapped_steps == plain_steps > 1
+        # exactly one _global_step per call (jax-level retraces must not
+        # double-count)
+        assert wrapped_steps == plain_steps == 4
+
+
+class TestDeferredGlobalsDiscovery:
+    def test_decorator_before_globals_and_nested_wrapper(self):
+        """Discovery runs at FIRST CALL (globals may not exist at
+        decoration) and unwraps nested optimizer wrappers."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            DygraphShardingOptimizer,
+            HybridParallelOptimizer,
+        )
+
+        global _g_model, _g_optimizer
+
+        @paddle.jit.to_static
+        def step(x, y):
+            loss = F.cross_entropy(_g_model(x), y)  # LOAD_GLOBAL
+            loss.backward()
+            _g_optimizer.step()
+            _g_optimizer.clear_grad()
+            return loss
+
+        # the module globals are created AFTER the decorator ran
+        paddle.seed(0)
+        _g_model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+        inner = opt.AdamW(learning_rate=1e-2, parameters=_g_model.parameters())
+        _g_optimizer = HybridParallelOptimizer(DygraphShardingOptimizer(inner))
+
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (8,)).astype(np.int64))
+        losses = [float(step(x, y)) for _ in range(5)]
+        assert losses[-1] < losses[0], losses
+        assert inner._global_step == 5, inner._global_step
+
+    def test_wrapper_and_inner_thread_once(self):
+        """A step fn referencing BOTH the wrapper and the inner optimizer
+        must thread the state exactly once (double-threading would
+        double-donate buffers and double-count steps)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            DygraphShardingOptimizer,
+        )
+
+        paddle.seed(1)
+        model = nn.Sequential(nn.Linear(8, 4))
+        inner = opt.AdamW(learning_rate=1e-2, parameters=model.parameters())
+        wrapper = DygraphShardingOptimizer(inner)
+
+        def step(x, y):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            wrapper.step()
+            wrapper.clear_grad()
+            _ = inner.get_lr()  # inner ALSO referenced
+            return loss
+
+        fn = paddle.jit.to_static(step)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (8,)).astype(np.int64))
+        losses = [float(fn(x, y)) for _ in range(4)]
+        assert len(fn._optimizers) == 1
+        assert losses[-1] < losses[0] and inner._global_step == 4
