@@ -1,0 +1,65 @@
+"""Decode-throughput bench: dense KV cache vs paged (Pallas kernel)
+vs paged (gather fallback, monkeypatched) — the BASELINE.md decode
+rows. Run on the real chip:
+
+    PYTHONPATH="/root/repo:$PYTHONPATH" python benchmarks/decode_bench.py
+
+Tunnel RTT varies +-2x between sessions; only same-session rows
+compare. Set P below for the long-prompt regime."""
+import time
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import generate
+import paddle_tpu.ops.paged_attention as PA
+
+config = LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                     num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=16,
+                     max_position_embeddings=2048)
+paddle.seed(0)
+model = LlamaForCausalLM(config)
+model.bfloat16()
+B, P = 8, 1792
+rng = np.random.RandomState(0)
+ids = paddle.to_tensor(rng.randint(0, 32000, (B, P)).astype(np.int64))
+
+orig = PA.paged_decode_attention
+
+def measure(label, kw):
+    model._generation_programs = {}
+    for n in (32, 96):
+        generate(model, ids, max_new_tokens=n, temperature=0.0, **kw)
+    best = 1e9
+    for _ in range(2):
+        t0 = time.perf_counter()
+        np.asarray(generate(model, ids, max_new_tokens=96, temperature=0.0, **kw)._data)
+        t96 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        np.asarray(generate(model, ids, max_new_tokens=32, temperature=0.0, **kw)._data)
+        t32 = time.perf_counter() - t0
+        best = min(best, t96 - t32)
+    print(f"{label}: {B*64/best:.0f} tok/s ({best/64*1e3:.2f} ms/token)")
+
+measure("dense", {})
+measure("paged+kernel", {"block_size": 64})
+
+# gather fallback: force the non-kernel path
+def no_kernel(q, k_pool, v_pool, tables, cache_len):
+    import jax, jax.numpy as jnp
+    kc, vc = PA.paged_gather_kv(k_pool, v_pool, tables)
+    max_len = kc.shape[1]
+    valid = (jnp.arange(max_len)[None, :] <= cache_len)
+    h = q.shape[2]
+    rep = h // kc.shape[2]
+    ks = jnp.repeat(kc, rep, axis=2); vs = jnp.repeat(vc, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ks) / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32)).astype(q.dtype)
+    scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+    p = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, vs)
+
+# the llama paged-decode branch does `from ..ops.paged_attention import
+# paged_decode_attention` inside the traced step, so rebinding the
+# module attribute here DOES take effect for the fresh trace below
+PA.paged_decode_attention = no_kernel
+measure("paged+gather", {"block_size": 64})
+PA.paged_decode_attention = orig

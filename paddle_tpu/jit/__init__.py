@@ -97,6 +97,10 @@ class StaticFunction:
         self._cells: List[Tensor] = []
         self._jit_cache: Dict[Any, Any] = {}  # arg_treedef -> jitted pure fn
         self._last_lowered = None
+        self._pure_runs = 0  # pure() executions == jax trace count
+        # optimizers whose step() actually ran in the traced step (set
+        # during tracing); only these get host-side step corrections
+        self._stepped_optimizers: List[Any] = []
 
     # -- discovery ------------------------------------------------------
     def _auto_discover(self, fn):
@@ -121,16 +125,23 @@ class StaticFunction:
             candidates.append(fn.__self__)
         # module-level step functions reference their model/optimizer as
         # GLOBALS, not closure cells; scan exactly the names loaded via
-        # LOAD_GLOBAL (co_names alone also contains attribute names)
+        # LOAD_GLOBAL (co_names alone also contains attribute names),
+        # recursing into nested defs/lambdas/comprehensions
         code = getattr(fn, "__code__", None)
         fn_globals = getattr(fn, "__globals__", None)
         if code is not None and fn_globals is not None:
-            loaded = {
-                ins.argval
-                for ins in dis.get_instructions(code)
-                if ins.opname == "LOAD_GLOBAL"
-            }
-            for gname in loaded:
+            import types
+
+            def load_global_names(co, out):
+                for ins in dis.get_instructions(co):
+                    if ins.opname == "LOAD_GLOBAL":
+                        out.add(ins.argval)
+                for const in co.co_consts:
+                    if isinstance(const, types.CodeType):
+                        load_global_names(const, out)
+                return out
+
+            for gname in load_global_names(code, set()):
                 obj = fn_globals.get(gname)
                 if obj is not None:
                     candidates.append(obj)
@@ -211,7 +222,8 @@ class StaticFunction:
             # with this treedef" misses jax-level retraces (e.g. the
             # second call, once lazily-created accumulators change the
             # state pytree), which double-counted _global_step.
-            self._pure_runs = getattr(self, "_pure_runs", 0) + 1
+            self._pure_runs += 1
+            steps_before = [o._global_step for o in self._optimizers]
             self._write_state(state)
             for o, lr in zip(self._optimizers, lrs):
                 o._lr_override = lr
@@ -236,6 +248,13 @@ class StaticFunction:
             finally:
                 for o in self._optimizers:
                     o._lr_override = None
+            # which optimizers actually stepped during the traced run:
+            # only those get host-side step-count corrections (a merely
+            # READ optimizer, e.g. get_lr() logging, must not advance)
+            self._stepped_optimizers = [
+                o for o, s0 in zip(self._optimizers, steps_before)
+                if o._global_step > s0
+            ]
             new_state = self._read_state()
             out_arrays = tree_util.tree_map(
                 lambda t: t._data if isinstance(t, Tensor) else t, out, is_leaf=_is_tensor
@@ -268,18 +287,19 @@ class StaticFunction:
                 jit_kwargs["donate_argnums"] = (0,)
             jitted = jax.jit(pure, **jit_kwargs)
             self._jit_cache[arg_treedef] = jitted
-        runs_before = getattr(self, "_pure_runs", 0)
+        runs_before = self._pure_runs
         out_arrays, new_state = jitted(state, lrs, flat_arrays)
-        trace_runs = getattr(self, "_pure_runs", 0) - runs_before
+        trace_runs = self._pure_runs - runs_before
         self._last_lowered = jitted
         self._write_state(new_state)
         self._sanitize_grads()
-        # host-side step counters: this call represents exactly ONE
-        # optimizer step; tracing already advanced _global_step once per
-        # pure() execution (0 on cached calls, 1 per [re]trace)
+        # host-side step counters: this call represents exactly ONE step
+        # for each optimizer that actually steps in the traced program;
+        # tracing already advanced _global_step once per pure() execution
+        # (0 on cached calls, 1 per [re]trace)
         correction = 1 - trace_runs
         if correction:
-            for o in self._optimizers:
+            for o in self._stepped_optimizers:
                 o._global_step += correction
         return tree_util.tree_map(
             lambda a: Tensor(a, _internal=True) if isinstance(a, jax.Array) else a, out_arrays
@@ -371,17 +391,18 @@ class StaticFunction:
                 scanned, donate_argnums=(0,) if self._donate_state else ()
             )
             self._jit_cache[key] = jitted
-        runs_before = getattr(self, "_pure_runs", 0)
+        runs_before = self._pure_runs
         outs, new_state = jitted(state, lrs_stacked, flat_arrays)
-        trace_runs = getattr(self, "_pure_runs", 0) - runs_before
+        trace_runs = self._pure_runs - runs_before
         self._write_state(new_state)
         self._sanitize_grads()
-        # host-side step counter: this call represents n optimizer
-        # steps; tracing already advanced _global_step once per pure()
-        # execution (scan traces its body at least once)
+        # host-side step counter: this call represents n steps for each
+        # optimizer that steps in the traced program; tracing already
+        # advanced _global_step once per pure() execution (scan traces
+        # its body at least once)
         correction = n - trace_runs
         if correction:
-            for o in self._optimizers:
+            for o in self._stepped_optimizers:
                 o._global_step += correction
         return tree_util.tree_map(
             lambda a: Tensor(a, _internal=True) if isinstance(a, jax.Array) else a, outs
