@@ -751,3 +751,55 @@ class TestPipelineParallel:
         for p in pipe._stacked:
             assert p.grad is not None, "stacked param got no grad via fallback"
             assert float(np.abs(np.asarray(p.grad._data)).sum()) > 0
+
+
+class TestSepFallback:
+    def test_indivisible_sequence_runs_sequential(self):
+        """sep mesh with a sequence length not divisible by sep_degree
+        must fall back to the (correct) sequential body, not crash with
+        a nested-shard_map error."""
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc,
+            PipelineLayer,
+            PipelineParallel,
+        )
+        from paddle_tpu.tensor import manipulation as M
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"sep_degree": 2, "pp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        hcg = fleet.init(strategy=strategy)
+        try:
+            H, C = 8, 3
+
+            class B3(nn.Layer):
+                def __init__(self, h):
+                    super().__init__()
+                    self.fc = nn.Linear(h, h)
+
+                def forward(self, x):
+                    return F.relu(self.fc(x))
+
+            def loss_fn(logits, y):
+                b, s, c = logits.shape
+                return F.cross_entropy(
+                    M.reshape(logits, [b * s, c]), M.reshape(y, [b * s])
+                )
+
+            paddle.seed(81)
+            pipe = PipelineLayer(
+                layers=[LayerDesc(B3, H) for _ in range(4)] + [nn.Linear(H, C)],
+                num_stages=2, loss_fn=loss_fn,
+            )
+            pp_model = PipelineParallel(pipe, hcg, strategy)
+            assert pp_model._sep_axis == "sep"
+            pp_opt = opt.SGD(learning_rate=0.05, parameters=pipe.parameters())
+            rng = np.random.RandomState(2)
+            # S = 5: not divisible by sep_degree 2 -> sequential fallback
+            x = paddle.to_tensor(rng.randn(4, 5, H).astype(np.float32))
+            y = paddle.to_tensor(rng.randint(0, C, (4, 5)).astype(np.int64))
+            loss = pp_model.train_batch((x, y), pp_opt)
+            assert np.isfinite(float(loss))
+        finally:
+            dist.destroy_process_group()
+            fleet.set_hybrid_communicate_group(None)

@@ -222,3 +222,28 @@ class TestMaskedMultiheadAttention:
         np.testing.assert_allclose(
             np.asarray(new_cache.numpy())[0, :, :, prior], k, rtol=1e-6
         )
+
+
+class TestPagedEos:
+    def test_eos_freezing_matches_dense(self):
+        """eos_token_id handling composes with the paged cache — the eos
+        token is taken from the model's own greedy output so the
+        freezing branch REALLY fires."""
+        model = _model()
+        rng = np.random.RandomState(4)
+        ids = paddle.to_tensor(
+            rng.randint(0, model.config.vocab_size, (2, 7)).astype(np.int64)
+        )
+        probe = generate(model, ids, max_new_tokens=10, temperature=0.0)
+        eos = int(probe.numpy()[0, 7 + 2])  # emitted at decode step 3
+        dense = generate(model, ids, max_new_tokens=10, temperature=0.0,
+                         eos_token_id=eos)
+        paged = generate(model, ids, max_new_tokens=10, temperature=0.0,
+                         eos_token_id=eos, block_size=4)
+        np.testing.assert_array_equal(dense.numpy(), paged.numpy())
+        # the freezing branch actually activated: row 0 emits eos at
+        # step 3 and every later position stays eos
+        row = paged.numpy()[0, 7:]
+        first = int(np.argmax(row == eos))
+        assert row[first] == eos and first < len(row) - 1
+        assert (row[first + 1:] == eos).all(), row
