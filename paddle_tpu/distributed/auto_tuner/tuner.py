@@ -53,14 +53,19 @@ class AutoTuner:
         return self.recorder.history
 
     def search_once(self) -> Optional[dict]:
+        # task_limit bounds ATTEMPTED runs (cur_task_id advances in
+        # add_cfg for every config except runner REFUSALS) — candidates
+        # the runner refuses instantly (pp>1 under the default runner,
+        # recompute, sharding stage 1-2, marked refused=True) must not
+        # exhaust the budget, but OOM/compile failures cost a real
+        # compile+step attempt and still count
         if self.cur_task_id >= self.task_limit:
             return None
-        cfg = self.algo.search_once(self.history_cfgs)
-        if cfg is not None:
-            self.cur_task_id += 1
-        return cfg
+        return self.algo.search_once(self.history_cfgs)
 
     def add_cfg(self, cfg: dict):
+        if not cfg.get("refused"):
+            self.cur_task_id += 1
         self.recorder.add_cfg(**cfg)
 
     def get_best(self):
@@ -110,14 +115,15 @@ def measured_step_runner(model_factory: Callable, tuner_cfg: dict) -> Callable:
         ):
             if bad:
                 return {
-                    "metric": None,
+                    "metric": None, "refused": True,
                     "error": f"default runner cannot realize {knob}="
                              f"{cfg.get(knob)}; supply a custom run_fn",
                 }
         n = cfg["dp_degree"] * cfg["sharding_degree"] * cfg["mp_degree"]
         devices = jax.devices()[:n]
         if len(devices) < n:
-            return {"metric": None, "error": f"need {n} devices"}
+            return {"metric": None, "refused": True,
+                    "error": f"need {n} devices"}
         mesh = Mesh(
             np.array(devices).reshape(
                 cfg["dp_degree"], cfg["sharding_degree"], cfg["mp_degree"]
@@ -219,7 +225,7 @@ def pipelined_step_runner(layer_factory: Callable, tuner_cfg: dict) -> Callable:
         ):
             if bad:
                 return {
-                    "metric": None,
+                    "metric": None, "refused": True,
                     "error": f"pipelined runner cannot realize {knob}="
                              f"{cfg.get(knob)}",
                 }

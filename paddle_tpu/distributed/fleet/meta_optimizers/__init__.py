@@ -27,6 +27,10 @@ class _OptimizerWrapper:
             setattr(self.__dict__["_inner_opt"], name, value)
 
     def __getattr__(self, name):
+        # Before __init__ assigns _inner_opt (pickle/copy/hasattr probes),
+        # delegation must fail as a normal missing attribute, not KeyError.
+        if "_inner_opt" not in self.__dict__:
+            raise AttributeError(name)
         return getattr(self.__dict__["_inner_opt"], name)
 
     def step(self):

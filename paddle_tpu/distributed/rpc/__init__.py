@@ -138,26 +138,31 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         # exchange WorkerInfos through the store
         # (ref: _exchange_all_service_infos; duplicate ranks rejected)
         key = f"rpc/worker/{rank}"
-        existing = st.store.get(key)
-        if existing is not None:
-            other: WorkerInfo = pickle.loads(bytes.fromhex(existing))
+        # atomic claim — two workers racing on one rank must not both win
+        if not st.store.set_if_absent(key, pickle.dumps(st.self_info).hex()):
+            other: WorkerInfo = pickle.loads(bytes.fromhex(st.store.get(key)))
             raise RuntimeError(
                 f"rpc rank {rank} already registered by worker "
                 f"{other.name!r} at {other.ip}:{other.port}"
             )
-        st.store.set(key, pickle.dumps(st.self_info).hex())
         deadline = time.time() + _DEFAULT_RPC_TIMEOUT
         while True:
-            keys = st.store.keys("rpc/worker/")
-            if len(keys) >= world_size:
+            # dump() = keys+values in ONE backend round trip (a keys()+
+            # N get() poll would open O(world_size^2) TCP conns/sec)
+            entries = {
+                k: v for k, v, _ in st.store.dump("rpc/worker/")
+            }
+            # all(...) guards the claim-visible-before-value-lands window
+            # on backends without hard links (store.set_if_absent)
+            if len(entries) >= world_size and all(entries.values()):
                 break
             if time.time() > deadline:
                 raise TimeoutError(
-                    f"only {len(keys)}/{world_size} rpc workers joined"
+                    f"only {len(entries)}/{world_size} rpc workers joined"
                 )
             time.sleep(0.1)
-        for k in st.store.keys("rpc/worker/"):
-            info: WorkerInfo = pickle.loads(bytes.fromhex(st.store.get(k)))
+        for k in sorted(entries):
+            info: WorkerInfo = pickle.loads(bytes.fromhex(entries[k]))
             if info.name in st.workers:
                 raise RuntimeError(
                     f"duplicate rpc worker name {info.name!r} (ranks "

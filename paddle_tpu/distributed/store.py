@@ -50,6 +50,12 @@ class KVStore:
         """Atomic-enough counter (single-writer-per-key or server-side)."""
         raise NotImplementedError
 
+    def set_if_absent(self, key: str, value: str) -> bool:
+        """Atomically claim ``key``: set it iff unset. Returns True when
+        THIS caller won the claim. Backs duplicate-rank detection in
+        distributed.rpc — check-then-set races must lose loudly."""
+        raise NotImplementedError
+
     def dump(self, prefix: str = "") -> List[tuple]:
         """[(key, value, age_seconds)] for every key under prefix, in ONE
         backend round trip, with ages measured on the BACKEND's clock
@@ -83,7 +89,7 @@ class FileKVStore(KVStore):
     def keys(self, prefix: str = "") -> List[str]:
         out = []
         for name in os.listdir(self.root):
-            if name.endswith((".tmp", ".lock")):
+            if name.endswith((".tmp", ".lock", ".probe")):
                 continue
             key = urllib.parse.unquote(name)
             if key.startswith(prefix):
@@ -108,17 +114,166 @@ class FileKVStore(KVStore):
         except OSError:
             pass
 
-    def add(self, key: str, amount: int = 1) -> int:
-        # advisory file lock for cross-process atomicity
-        import fcntl
+    def _backend_age(self, path: str, token: str) -> float:
+        """Age of ``path`` measured on the BACKEND's clock: touch a
+        per-caller probe file and diff the two mtimes — immune to
+        client-vs-fileserver wall-clock skew (the same reason dump()
+        reports backend ages)."""
+        probe = path + "." + token + ".probe"
+        try:
+            with open(probe, "w"):
+                pass
+            return os.path.getmtime(probe) - os.path.getmtime(path)
+        finally:
+            try:
+                os.remove(probe)
+            except OSError:
+                pass
 
+    def _acquire(self, lock_path: str, deadline: float = 30.0) -> str:
+        """O_CREAT|O_EXCL lock file with retry — exclusive create is
+        atomic even on NFS/GCS-fuse where flock is advisory or absent.
+        The lock records the holder's token; release only removes a
+        lock the caller still owns, so a waiter that broke a stale lock
+        cannot have its fresh lock deleted by the old holder, and a
+        stale break re-checks the recorded token first so one breaker
+        cannot delete another breaker's fresh lock. Staleness is
+        probed at most once per second (the probe costs ~4 backend
+        round trips; the cheap O_EXCL retry stays at 10ms). A holder
+        that stalls past ``deadline`` without crashing can still race
+        the breaker in the final read-vs-remove window — add() remains
+        "atomic-enough", not a consensus protocol."""
+        import uuid
+
+        token = uuid.uuid4().hex
+        end = time.monotonic() + deadline
+        last_probe = float("-inf")
+        while True:
+            try:
+                fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                with os.fdopen(fd, "w") as f:
+                    f.write(token)
+                return token
+            except FileExistsError:
+                pass
+            if time.monotonic() - last_probe >= 1.0:
+                last_probe = time.monotonic()
+                try:
+                    with open(lock_path) as f:
+                        holder = f.read()
+                    if self._backend_age(lock_path, token) > deadline:
+                        if self._break_stale(lock_path, holder, token):
+                            continue
+                except OSError:
+                    pass  # lock released / fs hiccup — retry below
+            if time.monotonic() > end:
+                raise TimeoutError(f"lock {lock_path} held too long")
+            time.sleep(0.01)
+
+    def _break_stale(self, lock_path: str, stale_token: str,
+                     my_token: str) -> bool:
+        """Break a stale lock ATOMICALLY: claim it by os.replace into a
+        per-breaker path (only one breaker's replace finds the source),
+        then confirm the captured content really is the stale holder.
+        If a FRESH lock was displaced instead (holder changed between
+        the age check and the replace), restore it with os.link —
+        atomic, fails-if-exists, never overwrites a newer lock. The
+        irreducible residual: three parties racing inside one backend
+        round trip can still strand a fresh holder; see _acquire's
+        "atomic-enough" disclaimer."""
+        bpath = lock_path + "." + my_token + ".breaking"
+        try:
+            os.replace(lock_path, bpath)
+        except OSError:
+            return False  # another breaker got there first
+        try:
+            with open(bpath) as f:
+                captured = f.read()
+            if captured == stale_token:
+                return True  # broke the stale lock
+            try:
+                os.link(bpath, lock_path)  # put the fresh lock back
+            except OSError:
+                pass  # someone re-created meanwhile; their lock stands
+            return False
+        finally:
+            try:
+                os.remove(bpath)
+            except OSError:
+                pass
+
+    def _release(self, lock_path: str, token: str) -> None:
+        try:
+            with open(lock_path) as f:
+                if f.read() != token:
+                    return  # someone broke our (stale) lock; not ours now
+            os.remove(lock_path)
+        except OSError:
+            pass
+
+    def add(self, key: str, amount: int = 1) -> int:
         lock_path = self._path(key) + ".lock"
-        with open(lock_path, "w") as lk:
-            fcntl.flock(lk, fcntl.LOCK_EX)
+        token = self._acquire(lock_path)
+        try:
             cur = int(self.get(key) or 0) + amount
             self.set(key, str(cur))
-            fcntl.flock(lk, fcntl.LOCK_UN)
+        finally:
+            self._release(lock_path, token)
         return cur
+
+    def set_if_absent(self, key: str, value: str) -> bool:
+        # write the value to a tmp first, then CLAIM by hard-linking it
+        # to the final path — link(2) is atomic and fails if the target
+        # exists, so the key is never visible empty (readers racing a
+        # plain O_EXCL-create-then-write could observe "")
+        import uuid
+
+        tmp = self._path(key) + "." + uuid.uuid4().hex + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(value)
+        try:
+            os.link(tmp, self._path(key))
+            return True
+        except FileExistsError:
+            return False
+        except OSError:
+            # hard links unsupported (gcsfuse) — fall back to exclusive
+            # create + write: the CLAIM stays atomic, but a racing
+            # reader can briefly observe the key empty on this backend,
+            # and a claimant killed between create and write leaves an
+            # empty file. Recover from the latter: a lost claim whose
+            # key is still empty after 30s (backend clock) is a dead
+            # claimant — remove it and retry once.
+            for retry in (True, False):
+                try:
+                    fd = os.open(
+                        self._path(key), os.O_CREAT | os.O_EXCL | os.O_WRONLY
+                    )
+                except FileExistsError:
+                    if retry and self.get(key) == "":
+                        try:
+                            if self._backend_age(
+                                self._path(key), uuid.uuid4().hex
+                            ) > 30.0:
+                                os.remove(self._path(key))
+                                continue
+                        except OSError:
+                            pass
+                    return False
+                try:
+                    with os.fdopen(fd, "w") as f:
+                        f.write(value)
+                except BaseException:
+                    # write failed (ENOSPC/…): don't poison the key
+                    try:
+                        os.remove(self._path(key))
+                    except OSError:
+                        pass
+                    raise
+                return True
+            return False
+        finally:
+            os.remove(tmp)
 
 
 class TCPStoreServer:
@@ -192,6 +347,11 @@ class TCPStoreServer:
             if op == "delete":
                 self._data.pop(req["k"], None)
                 return {"ok": True}
+            if op == "set_if_absent":
+                if req["k"] in self._data:
+                    return {"ok": True, "v": False}
+                self._data[req["k"]] = (req["v"], now)
+                return {"ok": True, "v": True}
             if op == "add":
                 ent = self._data.get(req["k"])
                 cur = int(ent[0] if ent else "0") + int(req["amount"])
@@ -236,6 +396,9 @@ class TCPKVStore(KVStore):
 
     def add(self, key: str, amount: int = 1) -> int:
         return self._req(op="add", k=key, amount=amount)
+
+    def set_if_absent(self, key: str, value: str) -> bool:
+        return bool(self._req(op="set_if_absent", k=key, v=value))
 
     def wait_alive(self, deadline: float = 30.0) -> None:
         end = time.time() + deadline

@@ -59,14 +59,17 @@ class LlamaConfig:
 
 def _rope(q, k, theta, position_offset=0):
     """Rotary position embedding on [B, S, H, D] (half-split layout).
-    ``position_offset`` may be a traced scalar (KV-cache decode)."""
+    ``position_offset`` may be a traced scalar (KV-cache decode) or a
+    per-sequence [B] array (ragged serving batches)."""
     d = q.shape[-1]
     s = q.shape[1]
-    pos = jnp.arange(s, dtype=jnp.float32) + position_offset
+    off = jnp.asarray(position_offset, jnp.float32)
+    # [B, S] positions ([1, S] when the offset is shared)
+    pos = jnp.arange(s, dtype=jnp.float32)[None, :] + off.reshape(-1, 1)
     inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = pos[:, None] * inv_freq[None, :]  # [S, D/2]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = pos[..., None] * inv_freq  # [B|1, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
 
     def rot(x):
         x1, x2 = jnp.split(x, 2, axis=-1)

@@ -132,18 +132,36 @@ def alloc_paged_kv_caches(
     return caches
 
 
+def _validate_cache_len(cl, b: int):
+    """Single source of truth for the scalar-or-[B] cache_len contract."""
+    cl = jnp.asarray(cl)
+    if cl.ndim not in (0, 1) or (cl.ndim == 1 and cl.shape != (b,)):
+        raise ValueError(
+            f"cache_len must be a scalar or [batch]={b} array, got "
+            f"shape {cl.shape}"
+        )
+    return cl
+
+
+def _per_seq_positions(cl, b: int, s: int):
+    """[B, s] write positions from a scalar or per-sequence [B] start.
+    Ragged serving batches (BlockManager's whole point) pass [B]."""
+    cl = _validate_cache_len(cl, b)
+    if cl.ndim == 0:
+        return jnp.broadcast_to(cl + jnp.arange(s), (b, s))
+    return cl[:, None] + jnp.arange(s)[None, :]
+
+
 def paged_write_kv(kk, vv, k_pool, v_pool, tables, cl, s: int):
-    """Scatter s new tokens (starting at position ``cl``) into the
-    [kvh, blocks, bs, D] pools; returns the updated pools."""
+    """Scatter s new tokens (starting at position ``cl``, scalar or
+    per-sequence [B]) into the [kvh, blocks, bs, D] pools; returns the
+    updated pools."""
     bs = k_pool.shape[2]
     b = kk.shape[0]
-    positions = cl + jnp.arange(s)  # [s]
-    logical = positions // bs  # [s]
-    offset = positions % bs  # [s]
-    phys = jnp.take_along_axis(
-        tables, jnp.broadcast_to(logical[None, :], (b, s)), axis=1
-    )  # [B, s]
-    off = jnp.broadcast_to(offset[None, :], (b, s))
+    positions = _per_seq_positions(cl, b, s)  # [B, s]
+    logical = positions // bs  # [B, s]
+    off = positions % bs  # [B, s]
+    phys = jnp.take_along_axis(tables, logical, axis=1)  # [B, s]
     # consecutive advanced indices (dims 1,2) keep their position, so
     # the value layout is [kvh, B, s, D]
     k_pool = k_pool.at[:, phys, off].set(
@@ -164,9 +182,11 @@ def paged_update_kv_cache(kk, vv, k_pool, v_pool, tables, cl, s: int):
     k_pool, v_pool = paged_write_kv(kk, vv, k_pool, v_pool, tables, cl, s)
     kc, vc = paged_gather_kv(k_pool, v_pool, tables)
     max_len = kc.shape[1]
-    k_idx = jnp.arange(max_len)[None, :]
-    q_idx = cl + jnp.arange(s)[:, None]
-    return k_pool, v_pool, kc, vc, (k_idx <= q_idx)[None, None]
+    b = kk.shape[0]
+    q_pos = _per_seq_positions(cl, b, s)  # [B, s]
+    # [B, 1, s, max_len] causal mask (broadcasts over heads)
+    mask = jnp.arange(max_len)[None, None, None, :] <= q_pos[:, None, :, None]
+    return k_pool, v_pool, kc, vc, mask
 
 
 def paged_gather_kv(k_pool, v_pool, tables):
@@ -189,13 +209,15 @@ def paged_decode_attention(q, k_pool, v_pool, tables, cache_len):
     """Single-token decode attention over the paged cache.
 
     q: [B, 1, num_heads, D]; pools [kvh, blocks, bs, D]; cache_len:
-    scalar position of the token being written (so each sequence
-    attends over cache_len+1 tokens). On TPU this runs the Pallas
+    position of the token being written — a scalar OR a per-sequence
+    [B] array for ragged serving batches (each sequence attends over
+    its own cache_len+1 tokens). On TPU this runs the Pallas
     paged-attention kernel (block tables scalar-prefetched to steer the
     DMAs — the block_multihead_attention decode kernel role); elsewhere
     the gathered-view fallback computes the identical result."""
     b, s, h, d = q.shape
     assert s == 1, "paged_decode_attention is the s==1 decode path"
+    cache_len = _validate_cache_len(cache_len, b)
     try:
         platform = jax.devices()[0].platform
     except Exception:  # pragma: no cover
@@ -207,7 +229,7 @@ def paged_decode_attention(q, k_pool, v_pool, tables, cache_len):
             paged_attention as _paged_attention_kernel,
         )
 
-        lengths = jnp.full((b,), cache_len + 1, jnp.int32)
+        lengths = jnp.broadcast_to(cache_len + 1, (b,)).astype(jnp.int32)
         pages_per_seq = tables.shape[1]
         scale = jnp.asarray(1.0 / np.sqrt(d), q.dtype)
         out = _paged_attention_kernel(
@@ -223,5 +245,8 @@ def paged_decode_attention(q, k_pool, v_pool, tables, cache_len):
 
     kc, vc = paged_gather_kv(k_pool, v_pool, tables)
     max_len = kc.shape[1]
-    mask = (jnp.arange(max_len)[None, :] <= cache_len)[None, None]  # [1,1,1,S]
+    # [B or 1, 1, 1, S] — per-sequence lengths mask their own tails
+    mask = (
+        jnp.arange(max_len)[None, :] <= cache_len.reshape(-1, 1)
+    )[:, None, None, :]
     return _naive_attention(q, kc, vc, mask, 0.0, False, None, None)

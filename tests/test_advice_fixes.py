@@ -127,3 +127,164 @@ class TestNMSNegativeCoords:
             categories=[0, 1],
         )
         assert sorted(keep.numpy().tolist()) == [0, 1, 2]
+
+
+# ---- round-3 advisor findings ----
+
+class TestOptimizerWrapperGetattr:
+    def test_hasattr_before_init_raises_attribute_error(self):
+        """__getattr__ before _inner_opt exists (pickle/copy probes) must
+        raise AttributeError, not KeyError."""
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            _OptimizerWrapper,
+        )
+
+        w = _OptimizerWrapper.__new__(_OptimizerWrapper)
+        assert not hasattr(w, "_accumulators")  # KeyError would propagate
+        try:
+            w.anything
+        except AttributeError:
+            pass
+        else:
+            raise AssertionError("expected AttributeError")
+
+
+class TestStoreSetIfAbsent:
+    def test_file_store_claim(self, tmp_path):
+        from paddle_tpu.distributed.store import FileKVStore
+
+        st = FileKVStore(str(tmp_path))
+        assert st.set_if_absent("rank/0", "alice") is True
+        assert st.set_if_absent("rank/0", "bob") is False
+        assert st.get("rank/0") == "alice"
+
+    def test_tcp_store_claim(self):
+        from paddle_tpu.distributed.store import TCPKVStore, TCPStoreServer
+
+        srv = TCPStoreServer(host="127.0.0.1")
+        try:
+            st = TCPKVStore("127.0.0.1", srv.port)
+            assert st.set_if_absent("rank/1", "alice") is True
+            assert st.set_if_absent("rank/1", "bob") is False
+            assert st.get("rank/1") == "alice"
+        finally:
+            srv.stop()
+
+    def test_file_store_add_concurrent(self, tmp_path):
+        """O_EXCL-lock counter survives concurrent increments."""
+        import threading
+
+        from paddle_tpu.distributed.store import FileKVStore
+
+        st = FileKVStore(str(tmp_path))
+
+        def bump():
+            for _ in range(20):
+                st.add("ctr", 1)
+
+        ts = [threading.Thread(target=bump) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert st.get("ctr") == "80"
+
+
+class TestAutoTunerBudget:
+    def test_refused_configs_do_not_consume_task_limit(self):
+        """Configs without a metric (runner-refused) must not count
+        against task_limit."""
+        from paddle_tpu.distributed.auto_tuner.memory_model import (
+            ModelGeometry,
+        )
+        from paddle_tpu.distributed.auto_tuner.tuner import AutoTuner
+
+        geom = ModelGeometry(
+            hidden_size=64, intermediate_size=256, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=4, vocab_size=128,
+            seq_length=64,
+        )
+        tuner = AutoTuner({
+            "geometry": geom, "num_devices": 8, "global_batch_size": 8,
+            "task_limit": 3,
+        })
+        # feed back 10 runner-refused configs; budget must not be consumed
+        for _ in range(10):
+            cfg = tuner.search_once()
+            if cfg is None:
+                break
+            cfg["metric"] = None
+            cfg["refused"] = True
+            tuner.add_cfg(cfg)
+        assert tuner.cur_task_id == 0
+        # attempted runs (measured OR OOM-failed) DO consume it — a
+        # failed compile+step costs real time, unlike an instant refusal
+        results = [1.0, None, 1.0]  # second one "OOMed"
+        for r in results:
+            cfg = tuner.search_once()
+            if cfg is None:
+                break
+            cfg["metric"] = r
+            if r is None:
+                cfg["oom"] = True
+            tuner.add_cfg(cfg)
+        assert tuner.cur_task_id == 3
+        assert tuner.search_once() is None
+
+
+class TestPagedPerSeqLengths:
+    def test_ragged_decode_matches_per_seq_scalar_runs(self):
+        """paged_decode_attention with a [B] cache_len must equal running
+        each sequence alone with its scalar length."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import paged_attention as PA
+
+        rng = np.random.RandomState(0)
+        b, h, kvh, d, bs, nb = 3, 4, 4, 16, 8, 12
+        q = jnp.asarray(rng.randn(b, 1, h, d).astype(np.float32))
+        k_pool = jnp.asarray(rng.randn(kvh, nb, bs, d).astype(np.float32))
+        v_pool = jnp.asarray(rng.randn(kvh, nb, bs, d).astype(np.float32))
+        tables = jnp.asarray(
+            np.arange(b * 4, dtype=np.int32).reshape(b, 4))
+        lens = np.array([5, 17, 30], np.int32)
+        ragged = PA.paged_decode_attention(
+            q, k_pool, v_pool, tables, jnp.asarray(lens))
+        for i in range(b):
+            solo = PA.paged_decode_attention(
+                q[i:i + 1], k_pool, v_pool, tables[i:i + 1],
+                jnp.asarray(lens[i]))
+            np.testing.assert_allclose(
+                np.asarray(ragged[i]), np.asarray(solo[0]),
+                rtol=2e-5, atol=2e-5)
+
+    def test_ragged_write_lands_per_sequence(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import paged_attention as PA
+
+        b, kvh, d, bs, nb = 2, 1, 4, 4, 8
+        kk = jnp.ones((b, 1, kvh, d))
+        vv = jnp.ones((b, 1, kvh, d)) * 2
+        k_pool = jnp.zeros((kvh, nb, bs, d))
+        v_pool = jnp.zeros((kvh, nb, bs, d))
+        tables = jnp.asarray(np.arange(b * 4, dtype=np.int32).reshape(b, 4))
+        cl = jnp.asarray(np.array([1, 6], np.int32))  # blocks 0 and 5
+        k_pool, v_pool = PA.paged_write_kv(
+            kk, vv, k_pool, v_pool, tables, cl, 1)
+        kp = np.asarray(k_pool)
+        assert kp[0, 0, 1].sum() == d  # seq 0 -> block 0, offset 1
+        assert kp[0, 5, 2].sum() == d  # seq 1 -> block 4+1=5, offset 6%4=2
+        assert kp.sum() == 2 * d
+
+    def test_bad_shape_fails_loudly(self):
+        import jax.numpy as jnp
+        import pytest
+
+        from paddle_tpu.ops import paged_attention as PA
+
+        q = jnp.zeros((2, 1, 2, 8))
+        k_pool = jnp.zeros((2, 4, 8, 8))
+        v_pool = jnp.zeros((2, 4, 8, 8))
+        tables = jnp.zeros((2, 2), jnp.int32)
+        with pytest.raises(ValueError, match="scalar or \\[batch\\]"):
+            PA.paged_decode_attention(
+                q, k_pool, v_pool, tables, jnp.zeros((3,), jnp.int32))
