@@ -34,6 +34,7 @@ _SLOW_FILES = {
     "test_flash_attention.py",
     "test_generation.py",
     "test_grad_sweep.py",
+    "test_graft_entry.py",        # 8-device GSPMD + pipeline dryrun
     "test_optimizer_training.py",
     "test_hapi_metric.py",
     "test_hybrid_parallel.py",
