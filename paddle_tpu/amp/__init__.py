@@ -28,14 +28,31 @@ __all__ = [
     "AutoMixedPrecisionLists",
     "is_float16_supported",
     "is_bfloat16_supported",
+    "debugging",
+    "DebugMode",
+    "TensorCheckerConfig",
+    "collect_operator_stats",
+    "compare_accuracy",
+    "disable_operator_stats_collection",
+    "disable_tensor_checker",
+    "enable_operator_stats_collection",
+    "enable_tensor_checker",
 ]
 
-# debugging helpers (ref: python/paddle/amp/debugging.py)
-from ..base.flags import flag as _flag  # noqa: E402
+# debugging tools (ref: python/paddle/amp/debugging.py) — real per-op
+# stats collection / tensor checking / cross-dtype comparison, hooked
+# into the tape's single dispatch point. See debugging.py.
+from . import debugging  # noqa: E402,F401
+from .debugging import (  # noqa: E402,F401
+    DebugMode,
+    TensorCheckerConfig,
+    collect_operator_stats,
+    compare_accuracy,
+    disable_operator_stats_collection,
+    disable_tensor_checker,
+    enable_operator_stats_collection,
+    enable_tensor_checker,
+)
 
-
-def debugging_enable_operator_stats_collection():  # pragma: no cover - thin shim
-    raise NotImplementedError(
-        "operator stats collection relies on the eager kernel registry; "
-        "use jax.profiler traces on TPU instead"
-    )
+# legacy alias kept from the round-2 shim era
+debugging_enable_operator_stats_collection = enable_operator_stats_collection

@@ -1,0 +1,611 @@
+"""AMP debugging tools: per-op tensor checking, operator stats, and
+cross-dtype accuracy comparison.
+
+TPU-native redesign of the reference's amp debugging stack (ref:
+python/paddle/amp/debugging.py:156 TensorCheckerConfig, :455
+enable_operator_stats_collection, :534 collect_operator_stats, :569
+compare_accuracy, :628 enable_tensor_checker). The reference instruments
+its generated ad_func layer and GPU kernel logs; here every op already
+flows through ONE dispatch point (base.tape.apply/_wrap_outputs), so the
+collector and checker are tape observers:
+
+- observers see each op's RAW output arrays right after execution and
+  compute nan/inf counts, absmax/absmin/mean on host (a device sync per
+  op — this is a debugging tool, not a fast path);
+- collection is EAGER-mode: under a jit trace outputs are abstract
+  tracers and are skipped (run the step un-jitted to inspect it — the
+  same code runs in both regimes by tape design);
+- training-step tracking for ``debug_step`` ranges ticks on each
+  ``run_backward`` entry (the reference ticks its iter_id in the
+  optimizer hook).
+
+``compare_accuracy`` keeps the reference's dump-file signature
+(dump_path, another_dump_path, output_filename) over JSONL stats dumps
+written by ``collect_operator_stats(output_dir=...)``, writing a CSV
+(not xlsx — no openpyxl dependency) — and additionally accepts a
+callable first argument to run a function under two dtypes back-to-back
+and diff the per-op stats directly.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import traceback
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "DebugMode",
+    "TensorCheckerConfig",
+    "check_numerics",
+    "enable_operator_stats_collection",
+    "disable_operator_stats_collection",
+    "collect_operator_stats",
+    "enable_tensor_checker",
+    "disable_tensor_checker",
+    "compare_accuracy",
+    "check_layer_numerics",
+]
+
+_FP16_MAX = 65504.0
+_FP16_TINY = 6.103515625e-05  # smallest normal float16
+
+
+class DebugMode(Enum):
+    """Checker behavior (ref: debugging.py:41).
+
+    - CHECK_NAN_INF_AND_ABORT: raise on NaN/Inf outputs.
+    - CHECK_NAN_INF: report NaN/Inf outputs, keep running.
+    - CHECK_ALL_FOR_OVERFLOW: report fp32 outputs outside the float16
+      representable range (overflow/underflow candidates for O1).
+    - CHECK_ALL: report key stats for every checked op.
+    """
+
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+def _leaf_stats(arr) -> Optional[dict]:
+    """Host-side stats for one raw output array; None for non-float or
+    abstract (traced) values."""
+    import jax.core as jcore
+
+    if isinstance(arr, jcore.Tracer):
+        return None
+    try:
+        a = np.asarray(arr)
+    except Exception:  # pragma: no cover — non-array leaf
+        return None
+    dtype_str = str(a.dtype)
+    if a.dtype.kind not in "fcV" or a.size == 0:
+        return None
+    if a.dtype.kind == "V":
+        # ml_dtypes (bfloat16, float8_*) register as numpy void kinds;
+        # they're exactly the dtypes AMP debugging exists for — widen to
+        # float32 for the stats math (NaN/Inf preserved)
+        try:
+            a = a.astype(np.float32)
+        except Exception:
+            return None  # a genuine void/struct dtype
+    af = np.abs(a).astype(np.float64)  # complex -> magnitude
+    finite = np.isfinite(a)
+    num_nan = int(np.isnan(a).sum())
+    num_inf = int(np.isinf(a).sum())
+    if finite.any():
+        fin = af[finite]
+        absmax = float(fin.max())
+        nonzero = fin[fin > 0]
+        absmin = float(nonzero.min()) if nonzero.size else 0.0
+        mean = float(fin.mean())
+    else:
+        absmax = absmin = mean = float("nan")
+    return {
+        "dtype": dtype_str,
+        "numel": int(a.size),
+        "num_nan": num_nan,
+        "num_inf": num_inf,
+        "absmax": absmax,
+        "absmin": absmin,
+        "mean": mean,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Operator stats collection (ref: debugging.py:455-568)
+# ---------------------------------------------------------------------------
+
+
+class _StatsCollector:
+    """Aggregates per-(op, dtype) stats from the tape observer."""
+
+    def __init__(self):
+        # (op, dtype) -> {calls, num_nan, num_inf, absmax, absmin, mean_sum}
+        self.stats: Dict[Tuple[str, str], dict] = {}
+
+    def __call__(self, op_name: str, leaves: Sequence):
+        op = op_name or "op"  # backward ops arrive as "grad_<op>"
+        seen_dtypes = set()  # "calls" = op INVOCATIONS per dtype
+        for leaf in leaves:
+            st = _leaf_stats(leaf)
+            if st is None:
+                continue
+            key = (op, st["dtype"])
+            ent = self.stats.setdefault(
+                key,
+                {"calls": 0, "leaves": 0, "num_nan": 0, "num_inf": 0,
+                 "absmax": 0.0, "absmin": float("inf"),
+                 "_mean_sum": 0.0, "_mean_count": 0},
+            )
+            if st["dtype"] not in seen_dtypes:
+                seen_dtypes.add(st["dtype"])
+                ent["calls"] += 1
+            ent["leaves"] += 1
+            ent["num_nan"] += st["num_nan"]
+            ent["num_inf"] += st["num_inf"]
+            if not np.isnan(st["absmax"]):
+                ent["absmax"] = max(ent["absmax"], st["absmax"])
+                if st["absmin"] > 0:
+                    ent["absmin"] = min(ent["absmin"], st["absmin"])
+                ent["_mean_sum"] += st["mean"]
+                ent["_mean_count"] += 1
+
+    def rows(self) -> List[dict]:
+        out = []
+        for (op, dt), ent in sorted(self.stats.items()):
+            out.append({
+                "op": op, "dtype": dt, "calls": ent["calls"],
+                "num_nan": ent["num_nan"], "num_inf": ent["num_inf"],
+                "absmax": ent["absmax"],
+                "absmin": 0.0 if ent["absmin"] == float("inf") else ent["absmin"],
+                "mean": ent["_mean_sum"] / max(ent["_mean_count"], 1),
+            })
+        return out
+
+    def summary_table(self) -> str:
+        """Printable table in the spirit of the reference's
+        _print_operator_stats (ref: debugging.py:411): op, dtype call
+        counts, nan/inf totals, absmax."""
+        rows = self.rows()
+        if not rows:
+            return "<op stats: no float operator outputs observed>"
+        header = (
+            f"{'op':<28}{'dtype':<12}{'calls':>7}{'num_nan':>9}"
+            f"{'num_inf':>9}{'absmax':>13}{'absmin':>13}{'mean':>13}"
+        )
+        lines = [header, "-" * len(header)]
+        for r in rows:
+            lines.append(
+                f"{r['op']:<28}{r['dtype']:<12}{r['calls']:>7}"
+                f"{r['num_nan']:>9}{r['num_inf']:>9}{r['absmax']:>13.4e}"
+                f"{r['absmin']:>13.4e}{r['mean']:>13.4e}"
+            )
+        return "\n".join(lines)
+
+    def dump(self, path: str) -> str:
+        with open(path, "w") as f:
+            for r in self.rows():
+                f.write(json.dumps(r) + "\n")
+        return path
+
+
+_active_collector: Optional[_StatsCollector] = None
+
+
+def enable_operator_stats_collection():
+    """Start collecting per-op output stats at the tape dispatch point
+    (ref: debugging.py:455). Eager-mode only; traced ops are skipped."""
+    global _active_collector
+    from ..base import tape
+
+    if _active_collector is not None:
+        return
+    _active_collector = _StatsCollector()
+    tape._op_observers.append(_active_collector)
+
+
+def disable_operator_stats_collection():
+    """Stop collecting and print the summary table (ref: debugging.py:493).
+    Returns the list of per-(op, dtype) stat rows."""
+    global _active_collector
+    from ..base import tape
+
+    if _active_collector is None:
+        return []
+    col = _active_collector
+    _active_collector = None
+    try:
+        tape._op_observers.remove(col)
+    except ValueError:
+        pass
+    print(col.summary_table())
+    return col.rows()
+
+
+@contextlib.contextmanager
+def collect_operator_stats(output_dir: Optional[str] = None,
+                           print_summary: bool = True):
+    """Context manager: collect per-op stats inside the block (ref:
+    debugging.py:534). Yields the collector; on exit prints the summary
+    and, with ``output_dir``, writes ``op_stats.jsonl`` there (the dump
+    ``compare_accuracy`` consumes)."""
+    from ..base import tape
+
+    col = _StatsCollector()
+    tape._op_observers.append(col)
+    try:
+        yield col
+    finally:
+        try:
+            tape._op_observers.remove(col)
+        except ValueError:
+            pass
+        if print_summary:
+            print(col.summary_table())
+        if output_dir:
+            os.makedirs(output_dir, exist_ok=True)
+            col.dump(os.path.join(output_dir, "op_stats.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# Tensor checker (ref: debugging.py:156, 628, 669)
+# ---------------------------------------------------------------------------
+
+
+class TensorCheckerConfig:
+    """Per-op numeric checking config (ref: debugging.py:156).
+
+    Args mirror the reference: ``enable``, ``debug_mode``, ``output_dir``
+    (report lines are appended to ``<output_dir>/tensor_check.log``
+    instead of printed), ``checked_op_list`` / ``skipped_op_list`` (exact
+    op names), ``debug_step`` ((start, end) training-step window, ticked
+    per backward pass), ``stack_height_limit`` (Python stack frames
+    reported on a hit)."""
+
+    def __init__(
+        self,
+        enable: bool,
+        debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT,
+        output_dir: Optional[str] = None,
+        checked_op_list: Optional[Sequence[str]] = None,
+        skipped_op_list: Optional[Sequence[str]] = None,
+        debug_step: Optional[Tuple[int, int]] = None,
+        stack_height_limit: int = 1,
+    ):
+        self.enable = bool(enable)
+        if not isinstance(debug_mode, DebugMode):
+            raise TypeError(
+                f"debug_mode must be a DebugMode, got {type(debug_mode)}"
+            )
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        if debug_step is not None:
+            start, end = debug_step
+            if start < 0 or end <= start:
+                raise ValueError(
+                    f"debug_step must be (start, end) with 0 <= start < "
+                    f"end, got {debug_step}"
+                )
+            self.start_step, self.end_step = int(start), int(end)
+        else:
+            self.start_step = self.end_step = None
+        self.stack_height_limit = int(stack_height_limit)
+        self._step = 0
+
+    # -- step window ----------------------------------------------------
+    def update_and_check_step_id(self) -> bool:
+        """Tick the training step (called per backward pass); returns
+        whether checking is active for the current step."""
+        self._step += 1
+        return self._step_active()
+
+    def _step_active(self) -> bool:
+        if self.start_step is None:
+            return True
+        return self.start_step <= self._step < self.end_step
+
+    def _op_selected(self, op: str) -> bool:
+        if op in self.skipped_op_list:
+            return False
+        if self.checked_op_list:
+            return op in self.checked_op_list
+        return True
+
+    # -- reporting ------------------------------------------------------
+    def _report(self, msg: str):
+        if self.output_dir:
+            os.makedirs(self.output_dir, exist_ok=True)
+            with open(os.path.join(self.output_dir, "tensor_check.log"), "a") as f:
+                f.write(msg + "\n")
+        else:
+            print(msg)
+
+    def _stack_suffix(self) -> str:
+        if self.stack_height_limit <= 0:
+            return ""
+        frames = traceback.extract_stack()
+        # prefer user frames (outside the framework); if the hit came
+        # entirely from framework-internal code (hapi fit loop etc.),
+        # report the innermost non-observer framework frames instead
+        user = [f for f in frames if "paddle_tpu" not in f.filename]
+        if not user:
+            user = [f for f in frames
+                    if not f.filename.endswith(("tape.py", "debugging.py"))]
+        user = user[-self.stack_height_limit:]
+        return "".join(
+            f"\n  at {f.filename}:{f.lineno} in {f.name}" for f in user
+        )
+
+    # -- the observer ---------------------------------------------------
+    def __call__(self, op_name: str, leaves: Sequence):
+        if not self.enable or not self._step_active():
+            return
+        op = op_name or "op"
+        if not self._op_selected(op):
+            return
+        for leaf in leaves:
+            st = _leaf_stats(leaf)
+            if st is None:
+                continue
+            bad = st["num_nan"] + st["num_inf"]
+            mode = self.debug_mode
+            if mode in (DebugMode.CHECK_NAN_INF_AND_ABORT,
+                        DebugMode.CHECK_NAN_INF):
+                if bad:
+                    msg = (
+                        f"[tensor checker] op '{op}' output has "
+                        f"{st['num_nan']} NaN / {st['num_inf']} Inf of "
+                        f"{st['numel']} ({st['dtype']}), finite absmax="
+                        f"{st['absmax']:.4e}{self._stack_suffix()}"
+                    )
+                    if mode is DebugMode.CHECK_NAN_INF_AND_ABORT:
+                        raise FloatingPointError(msg)
+                    self._report(msg)
+            elif mode is DebugMode.CHECK_ALL_FOR_OVERFLOW:
+                if st["dtype"] == "float32" and (
+                    bad
+                    or st["absmax"] > _FP16_MAX
+                    or (0 < st["absmin"] < _FP16_TINY)
+                ):
+                    self._report(
+                        f"[tensor checker] op '{op}' float32 output "
+                        f"outside float16 range: absmax={st['absmax']:.4e} "
+                        f"absmin={st['absmin']:.4e} nan={st['num_nan']} "
+                        f"inf={st['num_inf']}{self._stack_suffix()}"
+                    )
+            elif mode is DebugMode.CHECK_ALL:
+                self._report(
+                    f"[tensor checker] op '{op}' {st['dtype']} "
+                    f"numel={st['numel']} absmax={st['absmax']:.4e} "
+                    f"absmin={st['absmin']:.4e} mean={st['mean']:.4e} "
+                    f"nan={st['num_nan']} inf={st['num_inf']}"
+                )
+
+
+_active_checker: Optional[TensorCheckerConfig] = None
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """Install the checker at the tape dispatch point (ref:
+    debugging.py:628)."""
+    global _active_checker
+    from ..base import tape
+
+    disable_tensor_checker()
+    _active_checker = checker_config
+    tape._op_observers.append(checker_config)
+    tape._backward_tick_callbacks.append(
+        checker_config.update_and_check_step_id
+    )
+
+
+def disable_tensor_checker():
+    """Remove the active checker (ref: debugging.py:669)."""
+    global _active_checker
+    from ..base import tape
+
+    if _active_checker is None:
+        return
+    for lst in (tape._op_observers, tape._backward_tick_callbacks):
+        for item in list(lst):
+            if item is _active_checker or (
+                getattr(item, "__self__", None) is _active_checker
+            ):
+                lst.remove(item)
+    _active_checker = None
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT,
+                   stack_height_limit: int = 1,
+                   output_dir: Optional[str] = None):
+    """Check one tensor immediately (ref: debugging.py:338). Returns
+    (num_nan, num_inf, numel) as ints."""
+    data = getattr(tensor, "_data", tensor)
+    st = _leaf_stats(data)
+    if st is None:
+        return 0, 0, int(np.size(np.asarray(data)))
+    cfg = TensorCheckerConfig(
+        True, debug_mode=debug_mode, output_dir=output_dir,
+        stack_height_limit=stack_height_limit,
+    )
+    cfg(f"{op_type or 'check_numerics'}:{var_name}", [data])
+    return st["num_nan"], st["num_inf"], st["numel"]
+
+
+def check_layer_numerics(func: Callable) -> Callable:
+    """Decorator: check a layer forward's tensor inputs and outputs for
+    NaN/Inf (ref: debugging.py:63). Raises FloatingPointError on a hit."""
+    import functools
+
+    def check_tree(tree, what, layer_name):
+        # every Tensor leaf in any nesting (tuples, dicts, kwargs)
+        from jax import tree_util
+
+        from ..base.tensor import Tensor
+
+        leaves = tree_util.tree_leaves(tree, is_leaf=lambda x: isinstance(x, Tensor))
+        for i, leaf in enumerate(leaves):
+            data = getattr(leaf, "_data", None)
+            if data is None:
+                continue
+            st = _leaf_stats(data)
+            if st and (st["num_nan"] or st["num_inf"]):
+                raise FloatingPointError(
+                    f"{what} {i} of {layer_name}.forward has "
+                    f"{st['num_nan']} NaN / {st['num_inf']} Inf"
+                )
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        check_tree((args, kwargs), "input", type(self).__name__)
+        out = func(self, *args, **kwargs)
+        check_tree(out, "output", type(self).__name__)
+        return out
+
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# Cross-dtype accuracy comparison (ref: debugging.py:569)
+# ---------------------------------------------------------------------------
+
+
+def _run_fn_with_stats(fn, args, kwargs, dtype: str):
+    """Run fn with float Tensor args cast to ``dtype``, collecting stats."""
+    from ..base import dtype as dtypes
+    from ..base.tensor import Tensor
+
+    def cast(x):
+        if isinstance(x, Tensor) and dtypes.is_floating_point(x.dtype):
+            return x.astype(dtype)
+        return x
+
+    cargs = [cast(a) for a in args]
+    ckw = {k: cast(v) for k, v in (kwargs or {}).items()}
+    with collect_operator_stats(print_summary=False) as col:
+        fn(*cargs, **ckw)
+    return col.rows()
+
+
+def _rows_by_op(rows: List[dict]) -> Dict[str, dict]:
+    """Merge rows over dtypes per op (an op may emit several dtypes)."""
+    out: Dict[str, dict] = {}
+    for r in rows:
+        ent = out.setdefault(
+            r["op"],
+            {"calls": 0, "num_nan": 0, "num_inf": 0, "absmax": 0.0,
+             "dtypes": set()},
+        )
+        ent["calls"] += r["calls"]
+        ent["num_nan"] += r["num_nan"]
+        ent["num_inf"] += r["num_inf"]
+        ent["absmax"] = max(ent["absmax"], r["absmax"])
+        ent["dtypes"].add(r["dtype"])
+    return out
+
+
+def _compare_tables(rows_a, rows_b, label_a, label_b,
+                    output_filename=None) -> List[dict]:
+    a, b = _rows_by_op(rows_a), _rows_by_op(rows_b)
+    report = []
+    for op in sorted(set(a) | set(b)):
+        ea = a.get(op)
+        eb = b.get(op)
+        flag = ""
+        if ea and eb:
+            if (eb["num_nan"] + eb["num_inf"]) > (ea["num_nan"] + ea["num_inf"]):
+                flag = "OVERFLOW_IN_" + label_b.upper()
+            elif (ea["num_nan"] + ea["num_inf"]) > (eb["num_nan"] + eb["num_inf"]):
+                flag = "OVERFLOW_IN_" + label_a.upper()
+            elif ea["absmax"] > 0 and (
+                abs(ea["absmax"] - eb["absmax"]) / ea["absmax"] > 0.05
+            ):
+                flag = "ABSMAX_DIVERGED"
+        report.append({
+            "op": op,
+            f"{label_a}_dtypes": ",".join(sorted(ea["dtypes"])) if ea else "",
+            f"{label_a}_nan_inf": (ea["num_nan"] + ea["num_inf"]) if ea else "",
+            f"{label_a}_absmax": ea["absmax"] if ea else "",
+            f"{label_b}_dtypes": ",".join(sorted(eb["dtypes"])) if eb else "",
+            f"{label_b}_nan_inf": (eb["num_nan"] + eb["num_inf"]) if eb else "",
+            f"{label_b}_absmax": eb["absmax"] if eb else "",
+            "flag": flag,
+        })
+    if output_filename:
+        import csv
+
+        with open(output_filename, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(report[0].keys()) if report
+                               else ["op"])
+            w.writeheader()
+            w.writerows(report)
+    flagged = [r for r in report if r["flag"]]
+    print(
+        f"compare_accuracy: {len(report)} ops compared "
+        f"({label_a} vs {label_b}), {len(flagged)} flagged"
+    )
+    for r in flagged:
+        print(f"  {r['op']:<28} {r['flag']}")
+    return report
+
+
+def compare_accuracy(
+    dump_path,
+    another_dump_path=None,
+    output_filename: Optional[str] = None,
+    loss_scale: float = 1,
+    dump_all_tensors: bool = False,
+    *,
+    args: Sequence = (),
+    kwargs: Optional[dict] = None,
+    dtypes: Tuple[str, str] = ("float32", "bfloat16"),
+):
+    """Cross-dtype accuracy comparison (ref: debugging.py:569).
+
+    Two call forms:
+
+    - ``compare_accuracy(dump_a, dump_b, out_csv)``: compare two
+      ``op_stats.jsonl`` dumps written by
+      ``collect_operator_stats(output_dir=...)`` (a path to the file or
+      its directory); writes a CSV report.
+    - ``compare_accuracy(fn, args=..., dtypes=("float32","bfloat16"))``:
+      run ``fn`` twice with its float tensor args cast to each dtype,
+      collecting per-op stats for both runs and diffing them — flags
+      ops that produce NaN/Inf only in the lower precision or whose
+      absmax diverges >5%.
+
+    Returns the list of per-op comparison rows."""
+    if callable(dump_path):
+        fn = dump_path
+        lo, hi = dtypes[0], dtypes[1]
+        rows_a = _run_fn_with_stats(fn, args, kwargs, lo)
+        rows_b = _run_fn_with_stats(fn, args, kwargs, hi)
+        return _compare_tables(rows_a, rows_b, lo, hi, output_filename)
+
+    if another_dump_path is None:
+        raise ValueError(
+            "compare_accuracy dump mode needs two dump paths "
+            "(dump_path, another_dump_path); to compare a function "
+            "under two dtypes pass a callable first argument instead"
+        )
+
+    def load(path):
+        if os.path.isdir(path):
+            path = os.path.join(path, "op_stats.jsonl")
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    if dump_all_tensors:
+        print("compare_accuracy: dump_all_tensors is not supported "
+              "(per-op stats only)")
+    return _compare_tables(
+        load(dump_path), load(another_dump_path), "run_a", "run_b",
+        output_filename,
+    )

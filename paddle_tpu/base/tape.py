@@ -196,6 +196,16 @@ def apply(fn: Callable, *args, op_name: str = "", **kwargs):
     return _wrap_outputs(out, node=node, op_name=op_name)
 
 
+# Observers at the single dispatch point: callables (op_name, out_leaves)
+# invoked on every op's raw outputs, and callables () invoked at each
+# run_backward entry (training-step ticks). Empty lists cost one truthiness
+# check per op. amp.debugging's operator-stats collector and tensor
+# checker register here (the reference instruments its generated ad_func
+# layer; ref python/paddle/amp/debugging.py:534 collect_operator_stats).
+_op_observers: List[Callable] = []
+_backward_tick_callbacks: List[Callable] = []
+
+
 def _wrap_outputs(out, node, op_name=""):
     from .tensor import Tensor
 
@@ -203,6 +213,9 @@ def _wrap_outputs(out, node, op_name=""):
         _check_nan_inf(out, op_name)
 
     leaves, treedef = tree_util.tree_flatten(out)
+    if _op_observers:
+        for obs in list(_op_observers):
+            obs(op_name, leaves)
     wrapped = []
     for i, leaf in enumerate(leaves):
         t = Tensor(leaf, stop_gradient=node is None, _internal=True)
@@ -285,6 +298,9 @@ def run_backward(
     import jax.numpy as jnp
 
     from .tensor import Tensor
+
+    for cb in list(_backward_tick_callbacks):
+        cb()
 
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)

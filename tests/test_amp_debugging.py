@@ -1,0 +1,211 @@
+"""amp.debugging: operator stats collection, tensor checker, and
+cross-dtype compare_accuracy (ref: python/paddle/amp/debugging.py:156,
+534, 569). The collector/checker observe the tape's single dispatch
+point, so any framework op is covered without per-op instrumentation."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.amp import debugging as dbg
+
+
+def _cleanup():
+    from paddle_tpu.base import tape
+
+    dbg.disable_tensor_checker()
+    dbg._active_collector = None
+    tape._op_observers.clear()
+    tape._backward_tick_callbacks.clear()
+
+
+@pytest.fixture(autouse=True)
+def _reset_observers():
+    yield
+    _cleanup()
+
+
+class TestOperatorStats:
+    def test_collect_and_summary(self, capsys):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        w = paddle.to_tensor(np.random.RandomState(1).randn(8, 8).astype(np.float32))
+        with dbg.collect_operator_stats() as col:
+            y = paddle.matmul(x, w)
+            F.relu(y)
+        rows = col.rows()
+        ops = {r["op"] for r in rows}
+        assert "matmul" in ops and "relu" in ops
+        mm = next(r for r in rows if r["op"] == "matmul")
+        assert mm["dtype"] == "float32" and mm["calls"] == 1
+        assert mm["num_nan"] == 0 and mm["num_inf"] == 0
+        assert mm["absmax"] > 0
+        out = capsys.readouterr().out
+        assert "matmul" in out and "absmax" in out
+
+    def test_enable_disable_pair(self, capsys):
+        dbg.enable_operator_stats_collection()
+        paddle.to_tensor(np.ones((2, 2), np.float32)) * 2.0
+        rows = dbg.disable_operator_stats_collection()
+        assert any(r["num_nan"] == 0 for r in rows)
+        assert "calls" in capsys.readouterr().out
+
+    def test_backward_ops_tracked(self):
+        x = paddle.to_tensor(np.ones((3, 3), np.float32))
+        x.stop_gradient = False
+        with dbg.collect_operator_stats(print_summary=False) as col:
+            (x * x).sum().backward()
+        ops = {r["op"] for r in col.rows()}
+        assert any(op.startswith("grad_") for op in ops), ops
+
+    def test_collection_skips_traced_ops(self):
+        import paddle_tpu.jit as pjit
+
+        def f(x):
+            return x * 2.0
+
+        sf = pjit.to_static(f)
+        with dbg.collect_operator_stats(print_summary=False) as col:
+            sf(paddle.to_tensor(np.ones((2,), np.float32)))
+        # traced leaves are abstract: nothing observable collected there;
+        # must not crash (the old shim raised NotImplementedError)
+        assert isinstance(col.rows(), list)
+
+    def test_dump_roundtrip(self, tmp_path):
+        with dbg.collect_operator_stats(
+            output_dir=str(tmp_path), print_summary=False
+        ):
+            paddle.to_tensor(np.ones((2,), np.float32)) + 1.0
+        rows = [r for r in open(tmp_path / "op_stats.jsonl")]
+        assert rows and "absmax" in rows[0]
+
+
+class TestTensorChecker:
+    def test_abort_on_inf(self):
+        cfg = dbg.TensorCheckerConfig(
+            True, debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT
+        )
+        dbg.enable_tensor_checker(cfg)
+        x = paddle.to_tensor(np.array([1e38], np.float32))
+        with pytest.raises(FloatingPointError, match="multiply"):
+            x * 100.0  # overflows float32 -> inf
+        dbg.disable_tensor_checker()
+        x * 100.0  # no longer raises
+
+    def test_warn_mode_logs_to_dir(self, tmp_path):
+        cfg = dbg.TensorCheckerConfig(
+            True, debug_mode=dbg.DebugMode.CHECK_NAN_INF,
+            output_dir=str(tmp_path),
+        )
+        dbg.enable_tensor_checker(cfg)
+        paddle.to_tensor(np.array([np.nan], np.float32)) + 1.0
+        dbg.disable_tensor_checker()
+        log = (tmp_path / "tensor_check.log").read_text()
+        assert "NaN" in log and "add" in log
+
+    def test_checked_op_list_filters(self):
+        cfg = dbg.TensorCheckerConfig(
+            True, checked_op_list=["matmul"],
+        )
+        dbg.enable_tensor_checker(cfg)
+        bad = paddle.to_tensor(np.array([np.inf], np.float32))
+        bad + 1.0  # add not in checked list: passes
+        with pytest.raises(FloatingPointError):
+            paddle.matmul(
+                paddle.to_tensor(np.full((2, 2), np.inf, np.float32)),
+                paddle.to_tensor(np.ones((2, 2), np.float32)),
+            )
+
+    def test_skipped_op_list(self):
+        cfg = dbg.TensorCheckerConfig(True, skipped_op_list=["divide"])
+        dbg.enable_tensor_checker(cfg)
+        a = paddle.to_tensor(np.array([1.0], np.float32))
+        a / 0.0  # skipped
+        with pytest.raises(FloatingPointError):
+            a * np.inf
+
+    def test_debug_step_window(self):
+        cfg = dbg.TensorCheckerConfig(True, debug_step=(1, 2))
+        dbg.enable_tensor_checker(cfg)
+        bad = paddle.to_tensor(np.array([np.inf], np.float32))
+        # step 0: outside window
+        bad + 0.0
+        # a backward pass ticks the step counter to 1 -> window active
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        x.stop_gradient = False
+        x.sum().backward()
+        with pytest.raises(FloatingPointError):
+            bad + 0.0
+        # second backward -> step 2, window closed again
+        y = paddle.to_tensor(np.ones((2,), np.float32))
+        y.stop_gradient = False
+        y.sum().backward()
+        bad + 0.0
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError):
+            dbg.TensorCheckerConfig(True, debug_step=(3, 2))
+        with pytest.raises(TypeError):
+            dbg.TensorCheckerConfig(True, debug_mode="abort")
+
+    def test_check_numerics_counts(self):
+        t = paddle.to_tensor(np.array([1.0, np.nan, np.inf], np.float32))
+        nan, inf, numel = dbg.check_numerics(
+            t, "probe", "t", debug_mode=dbg.DebugMode.CHECK_NAN_INF,
+            output_dir=None,
+        )
+        assert (nan, inf, numel) == (1, 1, 3)
+
+    def test_check_layer_numerics_decorator(self):
+        import paddle_tpu.nn as nn
+
+        class Bad(nn.Layer):
+            @dbg.check_layer_numerics
+            def forward(self, x):
+                return x / 0.0
+
+        with pytest.raises(FloatingPointError, match="output"):
+            Bad()(paddle.to_tensor(np.ones((2,), np.float32)))
+
+
+class TestCompareAccuracy:
+    def test_planted_low_precision_overflow_flagged(self):
+        """3.3e4 squared = 1.09e9: fine in float32, Inf in float16 —
+        the fn-mode diff must flag the square op."""
+
+        def f(x):
+            return (x * x).sum()
+
+        x = paddle.to_tensor(np.full((4,), 3.3e4, np.float32))
+        report = dbg.compare_accuracy(
+            f, args=(x,), dtypes=("float32", "float16")
+        )
+        flagged = {r["op"]: r["flag"] for r in report if r["flag"]}
+        assert any("OVERFLOW_IN_FLOAT16" in v for v in flagged.values()), report
+
+    def test_planted_bf16_overflow_flagged(self):
+        """x + x at 1.7e38: 3.4e38 is finite in f32 (max 3.4028e38) but
+        2^128 after bf16 rounding — Inf in the bf16 run only."""
+
+        def f(x):
+            return x + x
+
+        x = paddle.to_tensor(np.full((2,), 1.7e38, np.float32))
+        report = dbg.compare_accuracy(
+            f, args=(x,), dtypes=("float32", "bfloat16")
+        )
+        flagged = {r["op"]: r["flag"] for r in report if r["flag"]}
+        assert any("OVERFLOW_IN_BFLOAT16" in v for v in flagged.values()), report
+
+    def test_dump_mode(self, tmp_path, capsys):
+        a_dir, b_dir = tmp_path / "a", tmp_path / "b"
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        with dbg.collect_operator_stats(str(a_dir), print_summary=False):
+            x * 2.0
+        with dbg.collect_operator_stats(str(b_dir), print_summary=False):
+            x * np.float32(np.inf)
+        out_csv = tmp_path / "cmp.csv"
+        report = dbg.compare_accuracy(str(a_dir), str(b_dir), str(out_csv))
+        assert out_csv.exists()
+        mult = next(r for r in report if r["op"] == "multiply")
+        assert mult["flag"] == "OVERFLOW_IN_RUN_B"
+        assert "1 flagged" in capsys.readouterr().out
