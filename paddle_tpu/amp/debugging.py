@@ -430,7 +430,10 @@ def check_numerics(tensor, op_type: str = "", var_name: str = "",
     data = getattr(tensor, "_data", tensor)
     st = _leaf_stats(data)
     if st is None:
-        return 0, 0, int(np.size(np.asarray(data)))
+        # traced or non-float value: skipped (module contract) — size
+        # from the aval shape, never materializing a tracer
+        shape = getattr(data, "shape", None)
+        return 0, 0, int(np.prod(shape)) if shape is not None else 0
     cfg = TensorCheckerConfig(
         True, debug_mode=debug_mode, output_dir=output_dir,
         stack_height_limit=stack_height_limit,

@@ -117,5 +117,12 @@ define_flag("enable_comm_dynamic_check", False, "Cross-rank shape/dtype check be
 define_flag("use_stream_safe_allocator", True, "no-op on TPU; kept for parity")
 define_flag("eager_delete_tensor_gb", 0.0, "no-op on TPU; kept for parity")
 define_flag("log_level", 0, "VLOG-style verbosity for paddle_tpu.utils.log")
+define_flag(
+    "dy2static_while_grad_bound", 0,
+    "When > 0, a converted tensor-`while` whose carries need gradients "
+    "runs as a bounded differentiable lax.scan of this many iterations "
+    "with an early-exit mask (the bound MUST cover the true trip count; "
+    "extra iterations are masked no-ops). 0 keeps the non-differentiable "
+    "lax.while_loop (ref: while backward, static/nn/control_flow.py:682)")
 define_flag("allocator_strategy", "xla", "TPU: XLA owns allocation; kept for parity")
 define_flag("cudnn_deterministic", False, "maps to XLA deterministic ops flag semantics")

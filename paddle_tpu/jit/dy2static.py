@@ -14,9 +14,21 @@ Here the rewrite targets XLA's structured control flow:
   keeps the transform differentiable through the tape (lax.cond's vjp
   would be routed the same way).
 - ``while`` on a traced tensor: ``lax.while_loop`` over the carried
-  variables (the names assigned in the loop body). Gradients do not
-  flow through a traced while (XLA's while has no transpose without
-  checkpointing the trip count); outputs are stop-gradient tensors.
+  variables (the names assigned in the loop body). By default gradients
+  do not flow (XLA's while has no transpose); setting
+  ``FLAGS_dy2static_while_grad_bound = N`` makes carries that need
+  gradients run as a DIFFERENTIABLE bounded ``lax.scan`` of N
+  iterations with an early-exit mask (ref: the reference's while
+  backward, static/nn/control_flow.py:682 + append_backward) — N must
+  upper-bound the true trip count.
+- ``for <name> in range(...)``: converted to one ``lax.scan`` over the
+  index sequence when the carried variables are tensors (differentiable,
+  one traced body instead of n unrolled copies); bodies that mutate
+  outer state (x.append, buf[i] = v), change carry shapes, or loop over
+  non-range iterables stay plain Python loops. A traced bound becomes a
+  converted ``while``. Tensors the body reads from the enclosing scope
+  are routed as explicit vjp inputs (closure-cell rebinding), so their
+  gradients survive the scan.
 - Predicates that are NOT traced tensors dispatch to plain Python at
   runtime — the transform never changes eager semantics.
 
@@ -30,6 +42,7 @@ traced predicate raises an actionable graph-break error (see
 from __future__ import annotations
 
 import ast
+import contextlib
 import inspect
 import textwrap
 from typing import Any, Callable, Dict, List, Sequence, Tuple
@@ -91,12 +104,12 @@ def _select_leaf(pred, a, b):
         return a
     a_undef, b_undef = isinstance(a, _Undef), isinstance(b, _Undef)
     if a_undef or b_undef:
-        name = (a if a_undef else b).name
-        raise ValueError(
-            f"variable '{name}' is assigned in only one branch of a "
-            "tensor-dependent `if`; both branches must produce it so the "
-            "results can be selected"
-        )
+        # a variable bound in only one branch with NO incoming binding:
+        # defer the error to USE (the reference's UndefinedVar
+        # semantics, dy2static/utils.py) — the region's undef-cleanup
+        # deletes it, so touching it later raises UnboundLocalError,
+        # and code that never touches it is unaffected
+        return a if a_undef else b
     tensorish = lambda v: isinstance(v, (Tensor, jax.Array)) or hasattr(v, "dtype")  # noqa: E731
     if tensorish(a) or tensorish(b):
         return tape.apply(
@@ -121,11 +134,118 @@ def convert_ifelse(pred, true_fn, false_fn, init_args: Tuple):
     return tuple(_select_leaf(pred, a, b) for a, b in zip(t_out, f_out))
 
 
+def _carry_arrays(init_args, var_names, what):
+    """Validate + unwrap loop carries to raw arrays."""
+    from ..base.tensor import Tensor
+
+    arrays = []
+    for i, v in enumerate(init_args):
+        name = var_names[i] if i < len(var_names) else f"#{i}"
+        if isinstance(v, _Undef):
+            raise ValueError(
+                f"loop variable '{v.name}' must be initialized before a "
+                f"tensor-dependent `{what}`"
+            )
+        if isinstance(v, Tensor):
+            arrays.append(v._data)
+        elif isinstance(v, (jax.Array, int, float, bool)) or hasattr(v, "dtype"):
+            arrays.append(jnp.asarray(v))
+        else:
+            raise ValueError(
+                f"loop variable '{name}' has type {type(v).__name__}, which "
+                f"cannot be carried through a traced `{what}` (tensors and "
+                "numbers only)"
+            )
+    return arrays
+
+
+def _closure_tensor_cells(*fns):
+    """Cells in ``fns``' closures holding differentiable Tensors.
+
+    A converted loop body runs INSIDE one tape.apply closure; tensors it
+    reads from the enclosing scope (e.g. ``x`` in ``h = h*0.5 + x*0.1``)
+    are closure captures, invisible to jax.vjp's explicit primals — their
+    gradient contribution would silently vanish. Passing each such cell's
+    Tensor as an extra explicit arg (and rebinding the cell to the traced
+    value inside the closure) routes the cotangents. Module-global
+    tensors are NOT routed (rare; assign them to a local first)."""
+    import types
+
+    from ..base import dtype as dtypes
+    from ..base.tensor import Tensor
+
+    cells, seen = [], set()
+
+    def scan(f, depth):
+        for cell in getattr(f, "__closure__", None) or ():
+            if id(cell) in seen:
+                continue
+            seen.add(id(cell))
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if (
+                isinstance(v, Tensor)
+                and not v.stop_gradient
+                and (dtypes.is_floating_point(v.dtype) or dtypes.is_complex(v.dtype))
+            ):
+                cells.append(cell)
+            elif isinstance(v, types.FunctionType) and depth > 0:
+                # a wrapper closing over the real body (the traced-bound
+                # for path) — its inner closure tensors need routing too
+                scan(v, depth - 1)
+
+    for f in fns:
+        scan(f, 2)
+    return cells
+
+
+@contextlib.contextmanager
+def _rebind_cells(cells, values):
+    """Temporarily point closure cells at traced stand-ins."""
+    from ..base.tensor import Tensor
+
+    saved = [c.cell_contents for c in cells]
+    try:
+        for c, v in zip(cells, values):
+            c.cell_contents = Tensor(v, _internal=True)
+        yield
+    finally:
+        for c, v in zip(cells, saved):
+            c.cell_contents = v
+
+
+def _needs_grad(init_args) -> bool:
+    from ..base import dtype as dtypes
+    from ..base import tape
+    from ..base.tensor import Tensor
+
+    return tape.is_grad_enabled() and any(
+        isinstance(v, Tensor)
+        and not v.stop_gradient
+        and (dtypes.is_floating_point(v.dtype) or dtypes.is_complex(v.dtype))
+        for v in init_args
+    )
+
+
 def convert_while_loop(cond_fn, body_fn, init_args: Tuple, var_names: Sequence[str] = ()):
     """Runtime dispatch for a converted ``while``: Python loop for
-    concrete predicates (unrolls under trace, keeping gradients),
-    ``lax.while_loop`` for traced ones (no grad)."""
+    concrete predicates (unrolls under trace, keeping gradients); for
+    traced predicates either ``lax.while_loop`` (no grad) or — when the
+    carries need gradients and FLAGS_dy2static_while_grad_bound > 0 — a
+    DIFFERENTIABLE bounded ``lax.scan`` with an early-exit mask (ref:
+    while backward, static/nn/control_flow.py:682 + append_backward).
+
+    Bounded-scan semantics: exactly ``bound`` scan iterations run;
+    iterations past the loop's true exit are masked no-ops (the body
+    still executes on the converged values — it must not produce side
+    effects, and NaNs it produces in masked lanes can leak through
+    jnp.where gradients). The bound must be >= the true trip count:
+    iterations beyond the bound are silently dropped, so pick a real
+    upper bound."""
     from ..base import tape
+    from ..base.flags import flag
     from ..base.tensor import Tensor
 
     first = cond_fn(*init_args)
@@ -139,43 +259,163 @@ def convert_while_loop(cond_fn, body_fn, init_args: Tuple, var_names: Sequence[s
             cur = cond_fn(*vars_t)
         return vars_t
 
-    arrays = []
-    for i, v in enumerate(init_args):
-        name = var_names[i] if i < len(var_names) else f"#{i}"
-        if isinstance(v, _Undef):
-            raise ValueError(
-                f"loop variable '{v.name}' must be initialized before a "
-                "tensor-dependent `while`"
-            )
-        if isinstance(v, Tensor):
-            arrays.append(v._data)
-        elif isinstance(v, (jax.Array, int, float, bool)) or hasattr(v, "dtype"):
-            arrays.append(jnp.asarray(v))
-        else:
-            raise ValueError(
-                f"loop variable '{name}' has type {type(v).__name__}, which "
-                "cannot be carried through a traced `while` (tensors and "
-                "numbers only)"
-            )
+    arrays = _carry_arrays(init_args, var_names, "while")
 
     def _wrap(carry):
         return tuple(Tensor(a, _internal=True) for a in carry)
 
-    def _cond(carry):
+    def _cond_raw(carry):
         with tape.no_grad():
             r = cond_fn(*_wrap(carry))
         r = r._data if isinstance(r, Tensor) else jnp.asarray(r)
         return r.astype(bool).reshape(())
 
-    def _body(carry):
+    def _body_raw(carry):
         with tape.no_grad():
             out = body_fn(*_wrap(carry))
         return tuple(
             (o._data if isinstance(o, Tensor) else jnp.asarray(o)) for o in out
         )
 
-    res = jax.lax.while_loop(_cond, _body, tuple(arrays))
+    bound = int(flag("dy2static_while_grad_bound") or 0)
+    if bound > 0 and _needs_grad(init_args):
+        cells = _closure_tensor_cells(cond_fn, body_fn)
+        n_carry = len(init_args)
+
+        def bounded(*arrs):
+            carries, extras = arrs[:n_carry], arrs[n_carry:]
+            with _rebind_cells(cells, extras):
+                def step(carry, _):
+                    vals, done = carry
+                    active = jnp.logical_and(~done, _cond_raw(vals))
+                    new_vals = _body_raw(vals)
+                    vals = tuple(
+                        jnp.where(active, n, v) for n, v in zip(new_vals, vals)
+                    )
+                    return (vals, ~active), None
+
+                (vals, _), _ = jax.lax.scan(
+                    step, (tuple(carries), jnp.asarray(False)), None,
+                    length=bound,
+                )
+            return vals
+
+        return tape.apply(
+            bounded,
+            *(v if isinstance(v, Tensor) else Tensor(jnp.asarray(v), _internal=True)
+              for v in init_args),
+            *(c.cell_contents for c in cells),
+            op_name="dy2static_while_grad",
+        )
+
+    res = jax.lax.while_loop(_cond_raw, _body_raw, tuple(arrays))
     return tuple(Tensor(a, _internal=True) for a in res)
+
+
+def convert_for_range(range_args: Tuple, body_fn, init_args: Tuple,
+                      var_names: Sequence[str] = ()):
+    """Runtime dispatch for a converted ``for <i> in range(...)``.
+
+    - Concrete bounds, nothing traced in the carries: plain Python loop
+      (eager semantics preserved exactly, including non-tensor carries).
+    - Concrete bounds with traced/tensor carries: ``lax.scan`` over the
+      index sequence — ONE traced body instead of n unrolled copies,
+      differentiable through the tape. Bodies whose carries change
+      shape/dtype across iterations (or that index Python containers
+      with the loop index) fall back to the unrolled Python loop.
+    - Traced bound: rewritten as a converted ``while`` (same grad rules
+      as convert_while_loop, including the bounded-scan path).
+    """
+    from ..base import tape
+    from ..base.tensor import Tensor
+
+    def _sanitize_target(args):
+        # the loop target (carry 0) is usually unbound before the loop;
+        # seed it with a 0 placeholder — the body's prologue overwrites
+        # it with the real index before any user statement runs
+        args = list(args)
+        if args and isinstance(args[0], _Undef):
+            args[0] = Tensor(jnp.asarray(0, jnp.int32), _internal=True)
+        return tuple(args)
+
+    traced_bound = any(_tracer_of(a) is not None for a in range_args)
+    if traced_bound:
+        # i < n while-loop over (i, *vars); i is carried as a tensor
+        if len(range_args) == 1:
+            start, stop, step_ = 0, range_args[0], 1
+        elif len(range_args) == 2:
+            start, stop, step_ = range_args[0], range_args[1], 1
+        else:
+            start, stop, step_ = range_args
+        if not isinstance(step_, int) or step_ == 0:
+            raise ValueError(
+                "a traced range() bound requires a concrete nonzero int "
+                "step"
+            )
+        start_arr = start._data if isinstance(start, Tensor) else jnp.asarray(start)
+        i0 = Tensor(start_arr.astype(jnp.int32), _internal=True)
+
+        def cond(i, *vars_):
+            return (i < stop) if step_ > 0 else (i > stop)
+
+        def body(i, *vars_):
+            out = body_fn(i, *vars_)
+            return (i + step_,) + tuple(out)
+
+        res = convert_while_loop(
+            cond, body, (i0,) + _sanitize_target(init_args),
+            ("<range index>",) + tuple(var_names),
+        )
+        return res[1:]
+
+    rng = range(*[int(a) for a in range_args])
+    any_traced_carry = any(_tracer_of(v) is not None for v in init_args)
+    if len(rng) == 0 or not any_traced_carry:
+        vars_t = tuple(init_args)
+        for i in rng:
+            vars_t = body_fn(i, *vars_t)
+        return vars_t
+
+    # concrete bounds, traced carries: try ONE scanned body; fall back
+    # to the unrolled loop when the body isn't scannable (carry shape /
+    # dtype changes, Python-container indexing by the traced index, ...)
+    try:
+        init_args = _sanitize_target(init_args)
+        _carry_arrays(init_args, var_names, "for")  # validate early
+        cells = _closure_tensor_cells(body_fn)
+        n_carry = len(init_args)
+
+        def scanned(*arrs):
+            carries, extras = arrs[:n_carry], arrs[n_carry:]
+            with _rebind_cells(cells, extras):
+                def step(vals, i):
+                    with tape.no_grad():
+                        out = body_fn(
+                            Tensor(i, _internal=True),
+                            *(Tensor(a, _internal=True) for a in vals),
+                        )
+                    return tuple(
+                        (o._data if isinstance(o, Tensor) else jnp.asarray(o))
+                        for o in out
+                    ), None
+
+                vals, _ = jax.lax.scan(
+                    step, tuple(carries), jnp.asarray(list(rng), jnp.int32)
+                )
+            return vals
+
+        return tape.apply(
+            scanned,
+            *(v if isinstance(v, Tensor) else Tensor(jnp.asarray(v), _internal=True)
+              for v in init_args),
+            *(c.cell_contents for c in cells),
+            op_name="dy2static_for_scan",
+        )
+    except Exception:
+        vars_t = tuple(init_args)
+        for i in rng:
+            vars_t = body_fn(i, *vars_t)
+        return vars_t
 
 
 # ---------------------------------------------------------------------------
@@ -331,6 +571,48 @@ def _undef_cleanup_stmts(names):
     return out
 
 
+_MUTATOR_METHODS = {
+    "append", "extend", "insert", "add", "update", "pop", "remove",
+    "clear", "setdefault", "popleft", "appendleft", "write", "discard",
+}
+
+
+def _mutates_outer_state(stmts: Sequence[ast.stmt]) -> bool:
+    """Conservative guard for `for` conversion: a body that mutates a
+    container/tensor through a bare name (x.append(...), buf[i] = v)
+    must stay an unrolled Python loop — under lax.scan the body traces
+    ONCE, so the mutation would fire once instead of once per iteration
+    and leak tracers. False positives only cost the scan optimization."""
+    found = False
+
+    class V(ast.NodeVisitor):
+        def visit_Call(self, node):
+            nonlocal found
+            f = node.func
+            # any receiver: x.append(...), self.outs.append(...), ...
+            if isinstance(f, ast.Attribute) and f.attr in _MUTATOR_METHODS:
+                found = True
+            self.generic_visit(node)
+
+        def visit_Subscript(self, node):
+            nonlocal found
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                found = True
+            self.generic_visit(node)
+
+        def visit_Attribute(self, node):
+            nonlocal found
+            # attribute stores (self.h = h) mutate an outer object
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                found = True
+            self.generic_visit(node)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return found
+
+
 class _Transformer(ast.NodeTransformer):
     def __init__(self):
         self.changed = False
@@ -420,6 +702,58 @@ class _Transformer(ast.NodeTransformer):
         )
         self.changed = True
         return [cond_def, body_def, *inits, call, *_undef_cleanup_stmts(assigned)]
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if self._blocked or node.orelse:
+            return node
+        # only `for <name> in range(...)` with 1-3 plain args
+        if not (
+            isinstance(node.target, ast.Name)
+            and isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == "range"
+            and not node.iter.keywords
+            and 1 <= len(node.iter.args) <= 3
+            and not any(isinstance(a, ast.Starred) for a in node.iter.args)
+        ):
+            return node
+        if _has_abrupt_exit(node.body) or _mutates_outer_state(node.body):
+            return node
+        body_assigned, has_del = _assigned_names(node.body)
+        if has_del:
+            return node
+        target = node.target.id
+        # the target is carried too: a prologue `target = <idx>` feeds it
+        # each iteration, and the final carry keeps Python's after-loop
+        # binding (last index, or the body's reassignment)
+        assigned = [target] + [n for n in body_assigned if n != target]
+        uid = self._next()
+        bname, iname = f"_pt_forbody_{uid}", f"_pt_i_{uid}"
+        body_def = ast.FunctionDef(
+            name=bname, args=_fn_args([iname] + assigned),
+            body=[ast.Assign(targets=[_name(target, ast.Store())],
+                             value=_name(iname))]
+            + list(node.body) + [_epilogue_return(assigned)],
+            decorator_list=[], returns=None, type_comment=None, type_params=[],
+        )
+        inits, init_names = _init_stmts(assigned, uid)
+        call = ast.Assign(
+            targets=[_tuple_of(assigned, ast.Store())],
+            value=ast.Call(
+                func=ast.Attribute(value=_name(_RUNTIME_NAME),
+                                   attr="convert_for_range", ctx=ast.Load()),
+                args=[
+                    ast.Tuple(elts=list(node.iter.args), ctx=ast.Load()),
+                    _name(bname),
+                    ast.Tuple(elts=[_name(n) for n in init_names], ctx=ast.Load()),
+                    ast.Tuple(elts=[ast.Constant(value=n) for n in assigned], ctx=ast.Load()),
+                ],
+                keywords=[],
+            ),
+        )
+        self.changed = True
+        return [body_def, *inits, call, *_undef_cleanup_stmts(assigned)]
 
 
 def convert(fn: Callable) -> Callable:

@@ -268,10 +268,14 @@ class TestConvertDirect:
         assert dy2static.convert(plain) is plain
         assert dy2static.convert(plain) is plain
 
-    def test_single_branch_assignment_raises_clearly(self):
+    def test_single_branch_assignment_defers_error_to_use(self):
+        """A var bound in only one branch (no incoming binding) is fine
+        as long as it is never used after the `if` (the reference's
+        UndefinedVar semantics); USING it raises UnboundLocalError."""
+
         def f(x):
             if x.sum() > 0:
-                z = x * 2.0
+                z = x * 2.0  # noqa: F841 -- deliberately one-branch
             else:
                 w = x * 3.0  # noqa: F841 -- different name on purpose
             return x
@@ -279,7 +283,290 @@ class TestConvertDirect:
         conv = dy2static.convert(f)
         import jax
 
-        with pytest.raises(ValueError, match="only one branch"):
-            jax.jit(lambda v: conv(paddle.to_tensor(v))._data + 0)(
+        out = jax.jit(lambda v: conv(paddle.to_tensor(v))._data + 0)(
+            np.array([1.0], np.float32)
+        )
+        assert float(out[0]) == 1.0
+
+        def g(x):
+            if x.sum() > 0:
+                z = x * 2.0
+            return z
+
+        convg = dy2static.convert(g)
+        with pytest.raises((UnboundLocalError, NameError)):
+            jax.jit(lambda v: convg(paddle.to_tensor(v))._data + 0)(
                 np.array([1.0], np.float32)
             )
+
+    def test_single_branch_with_incoming_binding_selects(self):
+        def f(x):
+            y = x
+            if x.sum() > 0:
+                y = x * 2.0
+            return y
+
+        conv = dy2static.convert(f)
+        import jax
+
+        run = jax.jit(lambda v: conv(paddle.to_tensor(v))._data + 0)
+        np.testing.assert_allclose(run(np.array([2.0], np.float32)), [4.0])
+        np.testing.assert_allclose(run(np.array([-2.0], np.float32)), [-2.0])
+
+
+class TestForRangeConversion:
+    def test_scan_matches_unrolled_values_and_grads(self):
+        """Converted `for i in range(n)` (lax.scan) must match the eager
+        unrolled loop in value AND gradient."""
+        import paddle_tpu.jit as pjit
+
+        def step(x):
+            x.stop_gradient = False
+            h = x
+            for i in range(5):
+                h = h * 0.5 + x * 0.1  # tensor-carried body
+            loss = h.sum()
+            loss.backward()
+            return loss, x.grad
+
+        x_np = np.array([1.0, -2.0, 3.0], np.float32)
+
+        # eager reference
+        le, ge = step(paddle.to_tensor(x_np))
+
+        sf = pjit.to_static(step)
+        ls, gs = sf(paddle.to_tensor(x_np))
+        np.testing.assert_allclose(float(ls), float(le), rtol=1e-6)
+        np.testing.assert_allclose(gs.numpy(), ge.numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_scan_is_actually_used_not_unrolled(self):
+        """A long range must produce ONE scanned body, not n unrolled
+        copies — assert via the jaxpr text containing a scan."""
+        import jax
+
+        from paddle_tpu.jit import dy2static
+
+        def f(x):
+            h = x
+            for i in range(64):
+                h = h * 0.99 + 0.01
+            return h
+
+        conv = dy2static.convert(f)
+        jaxpr = jax.make_jaxpr(
+            lambda v: conv(paddle.to_tensor(v))._data + 0
+        )(np.ones((2,), np.float32))
+        text = str(jaxpr)
+        assert "scan" in text, text[:400]
+        # unrolled would repeat mul 64 times
+        assert text.count("mul") < 10
+
+    def test_target_binding_after_loop(self):
+        """Python leaves the loop target bound to the last index."""
+        from paddle_tpu.jit import dy2static
+
+        def f(x):
+            acc = x
+            for i in range(4):
+                acc = acc + i
+            return acc, i
+
+        conv = dy2static.convert(f)
+        acc, i = conv(paddle.to_tensor(np.zeros((1,), np.float32)))
+        assert float(acc[0]) == 6.0
+        assert int(i) == 3
+
+    def test_zero_trip_loop(self):
+        from paddle_tpu.jit import dy2static
+
+        def f(x):
+            acc = x
+            for i in range(0):
+                acc = acc + 100.0
+            return acc
+
+        conv = dy2static.convert(f)
+        assert float(conv(paddle.to_tensor(np.ones((1,), np.float32)))[0]) == 1.0
+
+    def test_mutating_body_left_unrolled(self):
+        """Bodies appending to an outer list must stay Python loops —
+        the accumulation still sees every iteration."""
+        from paddle_tpu.jit import dy2static
+
+        def f(x):
+            outs = []
+            h = x
+            for i in range(3):
+                h = h + 1.0
+                outs.append(h)
+            return outs
+
+        conv = dy2static.convert(f)
+        outs = conv(paddle.to_tensor(np.zeros((1,), np.float32)))
+        assert len(outs) == 3
+        assert [float(o[0]) for o in outs] == [1.0, 2.0, 3.0]
+
+    def test_traced_bound_runs_as_while(self):
+        """range(n) with a TRACED n becomes a converted while loop."""
+        import paddle_tpu.jit as pjit
+
+        def f(x, n):
+            acc = x * 0.0
+            for i in range(n.astype("int32")):
+                acc = acc + x
+            return acc.sum()
+
+        sf = pjit.to_static(f)
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        n = paddle.to_tensor(np.asarray(3))
+        assert float(sf(x, n)) == 6.0
+        n2 = paddle.to_tensor(np.asarray(5))
+        assert float(sf(x, n2)) == 10.0
+
+
+class TestWhileGrad:
+    def test_bounded_scan_grad_matches_eager(self):
+        """With FLAGS_dy2static_while_grad_bound set, gradients flow
+        through a converted tensor-`while` and match the eager loop."""
+        import paddle_tpu.jit as pjit
+
+        def step(x):
+            x.stop_gradient = False
+            h = x
+            while h.sum() < 20.0:
+                h = h * 2.0
+            loss = h.sum()
+            loss.backward()
+            return loss, x.grad
+
+        x_np = np.array([1.0, 2.0], np.float32)
+        le, ge = step(paddle.to_tensor(x_np))  # eager: 3 doublings -> 24
+        assert float(le) == 24.0
+
+        paddle.set_flags({"dy2static_while_grad_bound": 8})
+        try:
+            sf = pjit.to_static(step)
+            ls, gs = sf(paddle.to_tensor(x_np))
+            np.testing.assert_allclose(float(ls), 24.0, rtol=1e-6)
+            np.testing.assert_allclose(gs.numpy(), ge.numpy(), rtol=1e-5)
+        finally:
+            paddle.set_flags({"dy2static_while_grad_bound": 0})
+
+    def test_without_flag_stays_stop_gradient(self):
+        import paddle_tpu.jit as pjit
+
+        def step(x):
+            x.stop_gradient = False
+            h = x
+            while h.sum() < 20.0:
+                h = h * 2.0
+            loss = h.sum()
+            g = paddle.grad(
+                outputs=[loss], inputs=[x], allow_unused=True
+            )[0]
+            return loss, g
+
+        sf = pjit.to_static(step)
+        loss, g = sf(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+        assert float(loss) == 24.0
+        assert g is None  # while_loop path: no grad flows
+
+    def test_grad_finite_difference(self):
+        """Converted-while gradient vs central finite differences.
+
+        The loss is continuous in x only while the trip count is
+        locally constant — the probe point and eps are chosen so every
+        perturbed run takes the same number of iterations."""
+        import paddle_tpu.jit as pjit
+
+        def step(x):
+            x.stop_gradient = False
+            h = x
+            while (h * h).sum() < 50.0:
+                h = h * 1.5 + 0.1
+            loss = (h * h).sum()
+            loss.backward()
+            return loss, x.grad
+
+        paddle.set_flags({"dy2static_while_grad_bound": 16})
+        try:
+            sf = pjit.to_static(step)
+
+            def val(v):
+                loss, g = sf(paddle.to_tensor(v.astype(np.float32)))
+                return float(loss), g
+
+            x0 = np.array([1.0, 0.5], np.float64)
+            _, g_t = val(x0)
+            g = g_t.numpy()
+            eps = 1e-3
+            for k in range(2):
+                xp, xm = x0.copy(), x0.copy()
+                xp[k] += eps
+                xm[k] -= eps
+                fd = (val(xp)[0] - val(xm)[0]) / (2 * eps)
+                np.testing.assert_allclose(g[k], fd, rtol=2e-2, atol=1e-3)
+        finally:
+            paddle.set_flags({"dy2static_while_grad_bound": 0})
+
+
+class TestReviewEdgeCases:
+    def test_attribute_mutation_left_unrolled(self):
+        """self.outs.append(...) in a for body must keep the Python loop."""
+        import paddle_tpu.nn as nn
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.outs = []
+
+            def forward(self, x):
+                h = x
+                for i in range(3):
+                    h = h + 1.0
+                    self.outs.append(h)
+                return h
+
+        m = M()
+        conv = dy2static.convert(M.forward)
+        out = conv(m, paddle.to_tensor(np.zeros((1,), np.float32)))
+        assert float(out[0]) == 3.0
+        assert len(m.outs) == 3
+        assert [float(o[0]) for o in m.outs] == [1.0, 2.0, 3.0]
+
+    def test_traced_bound_closure_grads_flow(self):
+        """Traced-bound for + grad bound: closure tensor x must get its
+        gradient through the wrapper chain (cells scanned 2 deep)."""
+
+        def step(x, n):
+            x.stop_gradient = False
+            h = x * 0.0
+            for i in range(n.astype("int32")):
+                h = h * 0.5 + x * 0.1
+            loss = h.sum()
+            loss.backward()
+            return loss, x.grad
+
+        # eager reference with n=3: h = ((0*.5+.1x)*.5+.1x)*.5+.1x
+        # dh/dx = .1*(.25+.5+1) = .175
+        paddle.set_flags({"dy2static_while_grad_bound": 8})
+        try:
+            sf = pjit.to_static(step)
+            loss, g = sf(
+                paddle.to_tensor(np.array([2.0], np.float32)),
+                paddle.to_tensor(np.asarray(3)),
+            )
+            np.testing.assert_allclose(g.numpy(), [0.175], rtol=1e-5)
+        finally:
+            paddle.set_flags({"dy2static_while_grad_bound": 0})
+
+    def test_check_numerics_on_tracer_skips(self):
+        from paddle_tpu.amp import debugging as dbg
+
+        def f(x):
+            nan, inf, numel = dbg.check_numerics(x)
+            return x * 1.0
+
+        sf = pjit.to_static(f)
+        out = sf(paddle.to_tensor(np.ones((2,), np.float32)))
+        assert float(out.sum()) == 2.0
