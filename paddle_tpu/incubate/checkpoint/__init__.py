@@ -1,0 +1,3 @@
+from .auto_checkpoint import AutoCheckpoint, ELASTIC_AUTO_CHECKPOINT_DIR  # noqa: F401
+
+__all__ = ["AutoCheckpoint", "ELASTIC_AUTO_CHECKPOINT_DIR"]

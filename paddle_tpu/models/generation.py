@@ -66,6 +66,19 @@ class _KVCacheState:
             pass
 
         self.holder = Holder()
+        # decode-loop state for the CHUNKED path: the current token and
+        # the eos-finished mask live on device with the caches, so a
+        # lax.scan over decode steps carries them — one dispatch per
+        # chunk instead of per token (the tunnel/host RTT otherwise
+        # bounds decode throughput; see BASELINE.md decode rows)
+        self.holder.register_buffer(
+            "tok", Tensor(jnp.zeros((batch,), jnp.int32), _internal=True),
+            persistable=False,
+        )
+        self.holder.register_buffer(
+            "finished", Tensor(jnp.zeros((batch,), bool), _internal=True),
+            persistable=False,
+        )
         self.paged = block_size is not None
         kwargs = {"block_size": block_size} if self.paged else {}
         caches = model.init_cache(batch, max_len, **kwargs)
@@ -75,6 +88,7 @@ class _KVCacheState:
             from ..ops.paged_attention import PagedLayerCache  # noqa: F401
 
             self._tables = caches[0].block_tables
+            self._contiguous = bool(getattr(caches[0], "contiguous", False))
             for i, c in enumerate(caches):
                 self.holder.register_buffer(f"k{i}", c.k_pool, persistable=False)
                 self.holder.register_buffer(f"v{i}", c.v_pool, persistable=False)
@@ -96,6 +110,7 @@ class _KVCacheState:
                     self.holder._buffers[f"k{i}"],
                     self.holder._buffers[f"v{i}"],
                     self._tables,
+                    self._contiguous,
                 )
                 for i in range(self.n)
             ]
@@ -114,6 +129,10 @@ class _KVCacheState:
         for i, (shape, dt) in enumerate(self.shapes_dtypes):
             self.holder._buffers[f"k{i}"]._data = jnp.zeros(shape, dt)
             self.holder._buffers[f"v{i}"]._data = jnp.zeros(shape, dt)
+        tok = self.holder._buffers["tok"]
+        tok._data = jnp.zeros(tok._data.shape, jnp.int32)
+        fin = self.holder._buffers["finished"]
+        fin._data = jnp.zeros(fin._data.shape, bool)
 
 
 def _sample(logits, temperature: float, top_k: int):
@@ -133,12 +152,19 @@ def _sample(logits, temperature: float, top_k: int):
 
 
 def _get_compiled(model, b, s, max_len, temperature, top_k, use_jit,
-                  block_size=None):
+                  block_size=None, chunked=False, eos_token_id=None):
     """Build (or fetch) the prefill/decode programs + cache state for
-    this (batch, prompt-len, max-len, sampling) signature."""
+    this (batch, prompt-len, max-len, sampling) signature.
+
+    ``chunked=True`` builds a decode step that reads/writes the token
+    and eos-finished mask as HOLDER BUFFERS (device state) instead of
+    passing the token host-side — so ``decode.multi_step`` can scan K
+    steps in one dispatch. The eos logic is baked into the step, hence
+    eos_token_id joins the cache key."""
     from .. import jit
 
-    key = (b, s, max_len, temperature, top_k, use_jit, block_size)
+    key = (b, s, max_len, temperature, top_k, use_jit, block_size,
+           chunked, eos_token_id if chunked else None)
     store = getattr(model, "_generation_programs", None)
     if store is None:
         store = model._generation_programs = {}
@@ -158,14 +184,37 @@ def _get_compiled(model, b, s, max_len, temperature, top_k, use_jit,
     def prefill(ids, cur_len):
         logits, new = model.forward_with_cache(ids, state.caches(), cur_len)
         state.set(new)
-        return _sample(logits[:, -1], temperature, top_k)
+        tok = _sample(logits[:, -1], temperature, top_k)
+        state.holder._buffers["tok"]._data = tok._data
+        return tok
 
-    def decode(tok, cur_len):
-        logits, new = model.forward_with_cache(
-            tok.reshape([b, 1]), state.caches(), cur_len
-        )
-        state.set(new)
-        return _sample(logits[:, -1], temperature, top_k)
+    if chunked:
+        def decode(cur_len):
+            prev = state.holder._buffers["tok"]
+            fin = state.holder._buffers["finished"]
+            logits, new = model.forward_with_cache(
+                prev.reshape([b, 1]), state.caches(), cur_len
+            )
+            state.set(new)
+            tok = _sample(logits[:, -1], temperature, top_k)
+            if eos_token_id is not None:
+                fin2, tok = apply(
+                    lambda f, p, t: (
+                        f | (p == eos_token_id),
+                        jnp.where(f | (p == eos_token_id), eos_token_id, t),
+                    ),
+                    fin, prev, tok, op_name="eos_freeze",
+                )
+                state.holder._buffers["finished"]._data = fin2._data
+            state.holder._buffers["tok"]._data = tok._data
+            return tok
+    else:
+        def decode(tok, cur_len):
+            logits, new = model.forward_with_cache(
+                tok.reshape([b, 1]), state.caches(), cur_len
+            )
+            state.set(new)
+            return _sample(logits[:, -1], temperature, top_k)
 
     if use_jit:
         prefill = jit.to_static(prefill, layers=[model, state.holder])
@@ -174,10 +223,47 @@ def _get_compiled(model, b, s, max_len, temperature, top_k, use_jit,
     return state, prefill, decode
 
 
+def _decode_chunked(state, decode, first_tok, s, max_new_tokens,
+                    chunk: int, eos_token_id):
+    """Drive the chunked decode: one regular call (required before
+    multi_step, and it compiles the step), then multi_step scans of up
+    to ``chunk`` steps per dispatch. Returns the per-position token
+    Tensors ([B] each), eos rows frozen in-program."""
+    from .. import to_tensor
+
+    out = [first_tok]
+    done = 1  # tokens emitted so far (prefill's sample)
+    # regular call: position s + done - 1 writes cache slot for token
+    out.append(decode(to_tensor(np.asarray(s + done - 1, np.int32))))
+    done += 1
+    while done < max_new_tokens:
+        k = min(chunk, max_new_tokens - done)
+        curs = np.arange(s + done - 1, s + done - 1 + k, dtype=np.int32)
+        if k == 1:
+            out.append(decode(to_tensor(curs[0])))
+        else:
+            toks = decode.multi_step(to_tensor(curs))  # [k, B]
+            from ..tensor.manipulation import unstack
+
+            out.extend(unstack(toks, axis=0))
+        done += k
+        if eos_token_id is not None and bool(
+            np.asarray(state.holder._buffers["finished"]._data).all()
+        ):
+            # every row finished: emit frozen eos for the remainder
+            # without further dispatches
+            while done < max_new_tokens:
+                out.append(out[-1])
+                done += 1
+            break
+    return out
+
+
 def generate(model, input_ids, max_new_tokens: int = 32,
              temperature: float = 0.0, top_k: int = 0,
              eos_token_id: Optional[int] = None, use_jit: bool = True,
-             block_size: Optional[int] = None):
+             block_size: Optional[int] = None,
+             decode_chunk: Optional[int] = None):
     """Generate ``max_new_tokens`` continuations of ``input_ids``
     ([B, S] int Tensor) with KV caching. Returns [B, S + new] ids.
 
@@ -187,7 +273,14 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     switches to the paged (block-table) KV cache — same tokens, pool
     memory layout (ref: block_multihead_attention); the model's
     ``init_cache`` must accept ``block_size`` and its attention must
-    handle PagedLayerCache (LlamaForCausalLM does; GPT is dense-only)."""
+    handle PagedLayerCache (LlamaForCausalLM does; GPT is dense-only).
+
+    ``decode_chunk=K`` scans K decode steps inside ONE device dispatch
+    (lax.scan over the compiled step; token + eos state carried on
+    device) — the serving idiom when host↔device latency dominates
+    per-token dispatch. Token-identical to the per-token loop; eos rows
+    freeze in-program, and generation stops at the first chunk whose
+    rows are all finished."""
     from .. import to_tensor
     from ..base.tape import no_grad
 
@@ -204,14 +297,27 @@ def generate(model, input_ids, max_new_tokens: int = 32,
 
     was_training = model.training
     model.eval()
+    chunked = bool(decode_chunk) and use_jit and max_new_tokens > 2
     try:
         with no_grad():
             state, prefill, decode = _get_compiled(
                 model, b, s, max_len, temperature, top_k, use_jit,
-                block_size=block_size,
+                block_size=block_size, chunked=chunked,
+                eos_token_id=eos_token_id,
             )
             zero = to_tensor(np.asarray(0, np.int32))
             tok = prefill(input_ids, zero)
+            if chunked:
+                out = _decode_chunked(
+                    state, decode, tok, s, max_new_tokens,
+                    int(decode_chunk), eos_token_id,
+                )
+                from ..tensor.manipulation import concat, stack
+
+                new_tokens = stack(out, axis=1)  # [B, new]
+                return concat(
+                    [input_ids, new_tokens.astype(input_ids.dtype)], axis=1
+                )
             out = [tok]
             finished = apply(
                 lambda t: jnp.zeros(t.shape, bool), tok, op_name="zeros_like"

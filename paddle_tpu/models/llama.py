@@ -121,9 +121,11 @@ class LlamaAttention(nn.Layer):
         from ..ops.paged_attention import PagedLayerCache
 
         if isinstance(cache, PagedLayerCache):
+            contiguous = bool(getattr(cache, "contiguous", False))
             if s == 1:
-                # decode: Pallas paged-attention kernel reads the pools
-                # through the block tables — no padded-view gather
+                # decode: contiguous tables take the reshape-view XLA
+                # path; ragged tables run the Pallas paged-attention
+                # kernel (no padded-view gather either way)
                 def pstep_decode(qq, kk, vv, kp, vp, tbl, cl):
                     from ..ops.paged_attention import (
                         paged_decode_attention,
@@ -132,7 +134,10 @@ class LlamaAttention(nn.Layer):
 
                     qq, kk = _rope(qq, kk, theta, cl.astype(jnp.float32))
                     kp, vp = paged_write_kv(kk, vv, kp, vp, tbl, cl, 1)
-                    return paged_decode_attention(qq, kp, vp, tbl, cl), kp, vp
+                    out = paged_decode_attention(
+                        qq, kp, vp, tbl, cl, contiguous=contiguous
+                    )
+                    return out, kp, vp
 
                 out, k_pool, v_pool = apply(
                     pstep_decode, q, k, v, cache.k_pool, cache.v_pool,
@@ -140,7 +145,7 @@ class LlamaAttention(nn.Layer):
                 )
                 out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
                 return self.o_proj(out), PagedLayerCache(
-                    k_pool, v_pool, cache.block_tables
+                    k_pool, v_pool, cache.block_tables, contiguous
                 )
 
             # prefill: scatter into pools, attend over the gathered
@@ -150,7 +155,7 @@ class LlamaAttention(nn.Layer):
 
                 qq, kk = _rope(qq, kk, theta, cl.astype(jnp.float32))
                 kp, vp, kc, vc, mask = paged_update_kv_cache(
-                    kk, vv, kp, vp, tbl, cl, s
+                    kk, vv, kp, vp, tbl, cl, s, contiguous=contiguous
                 )
                 return qq, kp, vp, kc, vc, mask
 
@@ -164,7 +169,7 @@ class LlamaAttention(nn.Layer):
             )
             out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
             return self.o_proj(out), PagedLayerCache(
-                k_pool, v_pool, cache.block_tables
+                k_pool, v_pool, cache.block_tables, contiguous
             )
 
         k_cache, v_cache = cache

@@ -87,26 +87,63 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(np.iinfo(dt).min),
                 data_format, ceil_mode, "max_pool2d")
     if return_mask:
-        idx = _max_pool_indices(x, kernel_size, stride, padding, data_format)
+        idx = _max_pool_indices(x, kernel_size, stride, padding,
+                                data_format, ceil_mode)
         return out, idx
     return out
 
 
-def _max_pool_indices(x, kernel_size, stride, padding, data_format):
-    """Flat spatial argmax index per window (for max_unpool)."""
+def _pool_pads(shape_sp, ks, st, pad, ceil_mode):
+    """Per-spatial-dim (lo, hi) pads incl. the ceil-mode high extension
+    — EXACTLY _pool's geometry, so (out, indices) shapes always agree."""
+    pads = [tuple(p) for p in pad]
+    if ceil_mode:
+        for i in range(len(pads)):
+            size = shape_sp[i] + pads[i][0] + pads[i][1]
+            rem = (size - ks[i]) % st[i]
+            if rem:
+                pads[i] = (pads[i][0], pads[i][1] + st[i] - rem)
+    return pads
+
+
+def _neg_fill(dt):
+    # finite lowest value, NOT -inf: the patch extraction is a one-hot
+    # CONVOLUTION, and -inf * 0 = NaN would poison every window that
+    # touches padding. Halved so low-precision rounding (bf16) cannot
+    # tip it over to -inf.
+    if jnp.issubdtype(dt, jnp.floating):
+        try:
+            lo = np.finfo(np.dtype(dt)).min
+        except ValueError:  # ml_dtypes (bfloat16, ...)
+            import ml_dtypes
+
+            lo = ml_dtypes.finfo(dt).min
+        return float(lo) * 0.5
+    return int(np.iinfo(dt).min)
+
+
+def _max_pool_indices(x, kernel_size, stride, padding, data_format,
+                      ceil_mode=False):
+    """Flat spatial argmax index per window (for max_unpool).
+
+    The input is padded EXPLICITLY with -inf (the same fill the pooled
+    reduce_window uses) before patch extraction — argmax can then never
+    select a pad slot, so indices are always valid positions in the
+    UNPADDED input (the zero-padded-patches variant returned negative /
+    out-of-range indices on negative inputs)."""
     ks = _tuple(kernel_size, 2)
     st = _tuple(stride if stride is not None else kernel_size, 2)
     pad = _norm_pad(padding, 2)
+    if isinstance(pad, str):
+        raise ValueError("return_mask does not support string padding")
 
     def _f(a):
         N, C, H, W = a.shape
-        lin = jnp.arange(H * W, dtype=jnp.float64 if False else jnp.float32).reshape(1, 1, H, W)
-        lin = jnp.broadcast_to(lin, a.shape)
-        # select-and-gather: encode (value, index) lexicographically via
-        # reduce_window on a large-composite trick is overkill; use
-        # conv_general_dilated_patches for small kernels instead
+        pads = _pool_pads((H, W), ks, st, pad, ceil_mode)
+        ap = jnp.pad(a, ((0, 0), (0, 0)) + tuple(pads),
+                     constant_values=_neg_fill(a.dtype))
         patches = jax.lax.conv_general_dilated_patches(
-            a, ks, st, padding=pad if not isinstance(pad, str) else pad,
+            ap, ks, st, padding=[(0, 0), (0, 0)],
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
         )  # [N, C*kh*kw, oh, ow]
         oh, ow = patches.shape[2], patches.shape[3]
@@ -115,10 +152,8 @@ def _max_pool_indices(x, kernel_size, stride, padding, data_format):
         ky, kx = arg // ks[1], arg % ks[1]
         oy = jnp.arange(oh).reshape(1, 1, -1, 1)
         ox = jnp.arange(ow).reshape(1, 1, 1, -1)
-        p0 = pad[0][0] if not isinstance(pad, str) else 0
-        p1 = pad[1][0] if not isinstance(pad, str) else 0
-        iy = oy * st[0] + ky - p0
-        ix = ox * st[1] + kx - p1
+        iy = oy * st[0] + ky - pads[0][0]
+        ix = ox * st[1] + kx - pads[1][0]
         return (iy * W + ix).astype(jnp.int32)
 
     return apply(_f, x, op_name="max_pool2d_indices")
@@ -133,6 +168,19 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
     out = _pool(x, kernel_size, stride, padding, 1, jax.lax.max,
                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(np.iinfo(dt).min),
                 "NCH", ceil_mode, "max_pool1d")
+    if return_mask:
+        # reuse the 2D argmax machinery over a singleton H dim; the flat
+        # index of a [1, L] window IS the L index
+        from ...tensor.manipulation import reshape as _rs
+
+        x4 = _rs(x, [x.shape[0], x.shape[1], 1, x.shape[2]])
+        idx = _max_pool_indices(
+            x4, (1, _tuple(kernel_size, 1)[0]),
+            (1, _tuple(stride if stride is not None else kernel_size, 1)[0]),
+            (0, _tuple(padding, 1)[0]), "NCHW", ceil_mode,
+        )
+        idx = _rs(idx, [idx.shape[0], idx.shape[1], idx.shape[3]])
+        return out, idx
     return out
 
 
@@ -142,9 +190,48 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
-                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(np.iinfo(dt).min),
-                 data_format, ceil_mode, "max_pool3d")
+    out = _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
+                lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(np.iinfo(dt).min),
+                data_format, ceil_mode, "max_pool3d")
+    if return_mask:
+        return out, _max_pool3d_indices(x, kernel_size, stride, padding,
+                                        ceil_mode)
+    return out
+
+
+def _max_pool3d_indices(x, kernel_size, stride, padding, ceil_mode=False):
+    """Flat spatial argmax index (d*H*W + h*W + w) per window — the 3D
+    analogue of _max_pool_indices (same -inf padding + ceil geometry)."""
+    ks = _tuple(kernel_size, 3)
+    st = _tuple(stride if stride is not None else kernel_size, 3)
+    pad = _norm_pad(padding, 3)
+    if isinstance(pad, str):
+        raise ValueError("return_mask does not support string padding")
+
+    def _f(a):
+        N, C, D, H, W = a.shape
+        pads = _pool_pads((D, H, W), ks, st, pad, ceil_mode)
+        ap = jnp.pad(a, ((0, 0), (0, 0)) + tuple(pads),
+                     constant_values=_neg_fill(a.dtype))
+        patches = jax.lax.conv_general_dilated_patches(
+            ap, ks, st, padding=[(0, 0)] * 3,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        )  # [N, C*kd*kh*kw, od, oh, ow]
+        od, oh, ow = patches.shape[2:]
+        patches = patches.reshape(N, C, ks[0] * ks[1] * ks[2], od, oh, ow)
+        arg = jnp.argmax(patches, axis=2)  # index inside the window
+        kd = arg // (ks[1] * ks[2])
+        kh = (arg // ks[2]) % ks[1]
+        kw = arg % ks[2]
+        odx = jnp.arange(od).reshape(1, 1, -1, 1, 1)
+        ohx = jnp.arange(oh).reshape(1, 1, 1, -1, 1)
+        owx = jnp.arange(ow).reshape(1, 1, 1, 1, -1)
+        iz = odx * st[0] + kd - pads[0][0]
+        iy = ohx * st[1] + kh - pads[1][0]
+        ix = owx * st[2] + kw - pads[2][0]
+        return ((iz * H + iy) * W + ix).astype(jnp.int32)
+
+    return apply(_f, x, op_name="max_pool3d_indices")
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
@@ -247,11 +334,48 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="N
 
 
 def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL", output_size=None, name=None):
-    raise NotImplementedError("max_unpool1d: use max_unpool2d with a singleton H dim")
+    """Inverse of max_pool1d(return_mask=True) — scatter values back to
+    their argmax positions (ref: nn/functional/pooling.py max_unpool1d)."""
+    from ...tensor.manipulation import reshape as _rs
+
+    k = _tuple(kernel_size, 1)[0]
+    s = _tuple(stride if stride is not None else kernel_size, 1)[0]
+    p = _tuple(padding, 1)[0]
+    if output_size is not None:
+        L = output_size[-1]
+    else:
+        L = (x.shape[-1] - 1) * s + k - 2 * p
+    x4 = _rs(x, [x.shape[0], x.shape[1], 1, x.shape[2]])
+    i4 = _rs(indices, [indices.shape[0], indices.shape[1], 1, indices.shape[2]])
+    # output_size carries the padding-corrected length; unpool2d must
+    # not subtract the scalar padding from the singleton H dim
+    out = max_unpool2d(x4, i4, (1, k), stride=(1, s), padding=0,
+                       output_size=[1, L])
+    return _rs(out, [out.shape[0], out.shape[1], out.shape[3]])
 
 
 def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW", output_size=None, name=None):
-    raise NotImplementedError("max_unpool3d not yet provided")
+    """Inverse of max_pool3d(return_mask=True): values scatter to their
+    flat (d*H*W + h*W + w) argmax positions."""
+    ks = _tuple(kernel_size, 3)
+    st = _tuple(stride if stride is not None else kernel_size, 3)
+    p = _tuple(padding, 3)
+
+    def _f(a, idx):
+        N, C, od, oh, ow = a.shape
+        if output_size is not None:
+            D, H, W = output_size[-3], output_size[-2], output_size[-1]
+        else:
+            D = (od - 1) * st[0] + ks[0] - 2 * p[0]
+            H = (oh - 1) * st[1] + ks[1] - 2 * p[1]
+            W = (ow - 1) * st[2] + ks[2] - 2 * p[2]
+        out = jnp.zeros((N, C, D * H * W), a.dtype)
+        flat_idx = idx.reshape(N, C, -1)
+        flat_val = a.reshape(N, C, -1)
+        out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(out, flat_idx, flat_val)
+        return out.reshape(N, C, D, H, W)
+
+    return apply(_f, x, indices, op_name="max_unpool3d")
 
 
 def _fractional_bounds(in_size, out_size, u):

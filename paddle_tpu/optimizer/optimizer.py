@@ -247,10 +247,33 @@ class Optimizer:
         self._global_step = int(state_dict.get("global_step", 0))
         if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        # Saved accumulator keys carry the SAVING process's tensor names
+        # (volatile: auto-generated, counter-dependent). A restoring
+        # process's params usually have different auto-names, so identify
+        # parameters POSITIONALLY: the per-accumulator pname order in
+        # state_dict follows the saving optimizer's parameter order
+        # (accumulators are created in _parameter_list order), which is
+        # this optimizer's order too. Without the remap, _get_accum later
+        # misses the restored entries and silently reinitializes zero
+        # moments — resumed training drifts from the original run.
+        saved_pnames: list = []
+        for key in state_dict:
+            if key in ("global_step", "LR_Scheduler"):
+                continue
+            pname = key.rpartition(".")[0]
+            if pname and pname not in saved_pnames:
+                saved_pnames.append(pname)
+        live_pnames = [p.name for p in self._parameter_list]
+        remap = (
+            dict(zip(saved_pnames, live_pnames))
+            if len(saved_pnames) == len(live_pnames)
+            else {}  # partial/foreign state: fall back to name identity
+        )
         for key, val in state_dict.items():
             if key in ("global_step", "LR_Scheduler"):
                 continue
             pname, _, accum = key.rpartition(".")
+            pname = remap.get(pname, pname)
             if isinstance(val, Tensor):
                 val = val._data
             self._accumulators.setdefault(accum, {})[pname] = jnp.asarray(np.asarray(val))

@@ -457,12 +457,19 @@ def masked_fill_(x, mask, value, name=None):
 
 def masked_scatter(x, mask, value, name=None):
     _require_eager("masked_scatter", x, mask)
-    a = np.asarray(x._data if isinstance(x, Tensor) else x)
-    m = np.broadcast_to(np.asarray(mask._data if isinstance(mask, Tensor) else mask), a.shape)
-    v = np.asarray(value._data if isinstance(value, Tensor) else value).reshape(-1)
-    out = a.copy()
-    out[m] = v[: int(m.sum())]
-    return Tensor(jnp.asarray(out), _internal=True)
+    shape = tuple((x._data if isinstance(x, Tensor) else x).shape)
+    m = np.broadcast_to(
+        np.asarray(mask._data if isinstance(mask, Tensor) else mask), shape
+    ).astype(bool)
+    idx = np.nonzero(m)  # concrete mask -> static scatter positions
+    n = len(idx[0])
+
+    def f(a, v):
+        return a.at[idx].set(v.reshape(-1)[:n])
+
+    # differentiable: x's grad is zeroed at scattered slots, value's
+    # grad collects from them
+    return apply(f, x, value if isinstance(value, Tensor) else Tensor(jnp.asarray(value), _internal=True), op_name="masked_scatter")
 
 
 def _require_eager(opname, *tensors):

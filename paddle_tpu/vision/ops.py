@@ -101,43 +101,49 @@ def _roi_pool_common(x, boxes, boxes_num, output_size, spatial_scale, mode):
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     oh, ow = output_size
-    xa = _unwrap(x)  # [N, C, H, W]
+    # boxes/boxes_num are host-concrete (eager boxes, like the eager
+    # detection pipelines the reference serves); the image sampling runs
+    # through ONE tape.apply so gradients flow back into ``x``
     ba = np.asarray(jax.device_get(_unwrap(boxes)), np.float32)
     bn = np.asarray(jax.device_get(_unwrap(boxes_num)), np.int64)
-    c, h, w = xa.shape[1], xa.shape[2], xa.shape[3]
-    outs = []
     img_idx = np.repeat(np.arange(len(bn)), bn)
-    for k, box in enumerate(ba):
-        x1, y1, x2, y2 = box * spatial_scale
-        img = xa[img_idx[k]]
-        # sample a (2*oh, 2*ow) grid then reduce 2x2 bins
-        gy = jnp.linspace(y1, y2, 2 * oh)
-        gx = jnp.linspace(x1, x2, 2 * ow)
-        gy = jnp.clip(gy, 0, h - 1)
-        gx = jnp.clip(gx, 0, w - 1)
-        if mode == "align":
-            y0f = jnp.floor(gy).astype(jnp.int32)
-            x0f = jnp.floor(gx).astype(jnp.int32)
-            y1f = jnp.minimum(y0f + 1, h - 1)
-            x1f = jnp.minimum(x0f + 1, w - 1)
-            wy = (gy - y0f)[None, :, None]
-            wx = (gx - x0f)[None, None, :]
-            v00 = img[:, y0f][:, :, x0f]
-            v01 = img[:, y0f][:, :, x1f]
-            v10 = img[:, y1f][:, :, x0f]
-            v11 = img[:, y1f][:, :, x1f]
-            grid = (
-                v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
-                + v10 * wy * (1 - wx) + v11 * wy * wx
-            )
-            pooled = grid.reshape(c, oh, 2, ow, 2).mean(axis=(2, 4))
-        else:
-            yi = jnp.round(gy).astype(jnp.int32)
-            xi = jnp.round(gx).astype(jnp.int32)
-            grid = img[:, yi][:, :, xi]
-            pooled = grid.reshape(c, oh, 2, ow, 2).max(axis=(2, 4))
-        outs.append(pooled)
-    return Tensor(jnp.stack(outs), _internal=True)
+
+    def sample(xa):
+        c, h, w = xa.shape[1], xa.shape[2], xa.shape[3]
+        outs = []
+        for k, box in enumerate(ba):
+            x1, y1, x2, y2 = box * spatial_scale
+            img = xa[int(img_idx[k])]
+            # sample a (2*oh, 2*ow) grid then reduce 2x2 bins
+            gy = jnp.clip(jnp.linspace(y1, y2, 2 * oh), 0, h - 1)
+            gx = jnp.clip(jnp.linspace(x1, x2, 2 * ow), 0, w - 1)
+            if mode == "align":
+                y0f = jnp.floor(gy).astype(jnp.int32)
+                x0f = jnp.floor(gx).astype(jnp.int32)
+                y1f = jnp.minimum(y0f + 1, h - 1)
+                x1f = jnp.minimum(x0f + 1, w - 1)
+                wy = (gy - y0f)[None, :, None]
+                wx = (gx - x0f)[None, None, :]
+                v00 = img[:, y0f][:, :, x0f]
+                v01 = img[:, y0f][:, :, x1f]
+                v10 = img[:, y1f][:, :, x0f]
+                v11 = img[:, y1f][:, :, x1f]
+                grid = (
+                    v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                    + v10 * wy * (1 - wx) + v11 * wy * wx
+                )
+                pooled = grid.reshape(c, oh, 2, ow, 2).mean(axis=(2, 4))
+            else:
+                yi = jnp.round(gy).astype(jnp.int32)
+                xi = jnp.round(gx).astype(jnp.int32)
+                grid = img[:, yi][:, :, xi]
+                pooled = grid.reshape(c, oh, 2, ow, 2).max(axis=(2, 4))
+            outs.append(pooled)
+        return jnp.stack(outs)
+
+    from ..base.tape import apply
+
+    return apply(sample, x, op_name=f"roi_{mode}")
 
 
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,

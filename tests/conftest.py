@@ -26,6 +26,8 @@ import pytest  # noqa: E402
 #   pytest -m slow    -> the rest (CI shard 2)
 _SLOW_FILES = {
     "test_advice_fixes.py",       # torch-parity ctc/grid_sample sweeps
+    "test_auto_checkpoint.py",    # kill-and-relaunch subprocess
+    "test_convergence.py",        # real training-to-target runs
     "test_auto_parallel.py",
     "test_auto_tuner.py",         # measured-step tune loop
     "test_distributed.py",

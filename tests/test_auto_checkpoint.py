@@ -1,0 +1,303 @@
+"""Fault-tolerant auto-checkpointing (ref: base/incubate/checkpoint/
+auto_checkpoint.py:70,615): periodic async saves, keep-last-k pruning,
+resume from the newest VALID checkpoint, and a kill-and-relaunch test
+that resumes within one save interval."""
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make(tmp_path, **kw):
+    paddle.seed(0)
+    model = nn.Linear(4, 3)
+    optimizer = opt.AdamW(learning_rate=0.01, parameters=model.parameters())
+    ac = AutoCheckpoint(str(tmp_path), layers=[model],
+                        optimizers=[optimizer], **kw)
+    return model, optimizer, ac
+
+
+def _train_steps(model, optimizer, ac, start, n):
+    rng = np.random.RandomState(7)
+    losses = []
+    for step in range(start, start + n):
+        x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 3, (8,)).astype(np.int64))
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        losses.append(float(loss))
+        ac.step(step)
+    return losses
+
+
+class TestAutoCheckpoint:
+    def test_interval_save_and_resume(self, tmp_path):
+        model, optimizer, ac = _make(tmp_path, save_interval_steps=5,
+                                     async_save=False)
+        assert ac.resume() == 0  # fresh start
+        _train_steps(model, optimizer, ac, 0, 12)
+        # steps 5 and 10 saved
+        steps = [s for s, _ in ac._list_ckpts()]
+        assert steps == [5, 10]
+
+        model2, optimizer2, ac2 = _make(tmp_path, save_interval_steps=5,
+                                        async_save=False)
+        start = ac2.resume()
+        assert start == 11  # newest valid ckpt step + 1
+        w_saved = np.asarray(model.weight._data)
+        # weights at resume differ from the step-11 weights of the
+        # original run (we rewound to step 10's state)... so compare
+        # against a fresh run replayed to step 10
+        model3, optimizer3, ac3 = _make(tmp_path / "b", save_interval_steps=999,
+                                        async_save=False)
+        _train_steps(model3, optimizer3, ac3, 0, 11)  # steps 0..10
+        np.testing.assert_allclose(
+            np.asarray(model2.weight._data),
+            np.asarray(model3.weight._data), rtol=1e-6)
+
+    def test_keep_last_k_prunes(self, tmp_path):
+        model, optimizer, ac = _make(tmp_path, save_interval_steps=2,
+                                     keep_last_k=2, async_save=False)
+        _train_steps(model, optimizer, ac, 0, 11)
+        steps = [s for s, _ in ac._list_ckpts()]
+        assert steps == [8, 10]
+
+    def test_async_save_drains(self, tmp_path):
+        model, optimizer, ac = _make(tmp_path, save_interval_steps=3,
+                                     async_save=True)
+        _train_steps(model, optimizer, ac, 0, 7)
+        ac.wait()
+        steps = [s for s, _ in ac._list_ckpts()]
+        assert 3 in steps and 6 in steps
+
+    def test_torn_checkpoint_skipped(self, tmp_path):
+        model, optimizer, ac = _make(tmp_path, save_interval_steps=4,
+                                     async_save=False)
+        _train_steps(model, optimizer, ac, 0, 9)
+        # corrupt the newest checkpoint: remove its done marker
+        newest = ac._list_ckpts()[-1][1]
+        os.remove(os.path.join(newest, "meta.json"))
+        model2, optimizer2, ac2 = _make(tmp_path, save_interval_steps=4,
+                                        async_save=False)
+        assert ac2.resume() == 5  # fell back to ckpt-4
+
+    def test_extra_state_roundtrip(self, tmp_path):
+        holder = {"lr_step": 42}
+        model, optimizer, ac = _make(
+            tmp_path, save_interval_steps=1, async_save=False,
+            extra_state=lambda: dict(holder),
+            set_extra_state=lambda s: holder.update(s),
+        )
+        _train_steps(model, optimizer, ac, 0, 2)
+        holder["lr_step"] = -1
+        model2 = nn.Linear(4, 3)
+        opt2 = opt.AdamW(learning_rate=0.01, parameters=model2.parameters())
+        ac2 = AutoCheckpoint(str(tmp_path), layers=[model2],
+                             optimizers=[opt2],
+                             set_extra_state=lambda s: holder.update(s))
+        ac2.resume()
+        assert holder["lr_step"] == 42
+
+
+_KILL_SCRIPT = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    # the env pins JAX_PLATFORMS to the shared TPU tunnel and env vars
+    # do NOT override it — force CPU in-process so both runs are
+    # hermetic and bit-exact
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.incubate.checkpoint import AutoCheckpoint
+
+    ckdir, logpath = sys.argv[1], sys.argv[2]
+    paddle.seed(0)
+    model = nn.Linear(4, 3)
+    optimizer = opt.AdamW(learning_rate=0.01, parameters=model.parameters())
+    ac = AutoCheckpoint(ckdir, layers=[model], optimizers=[optimizer],
+                        save_interval_steps=5, async_save=False)
+    start = ac.resume()
+    rng = np.random.RandomState(7)
+    # deterministic data stream indexed by step so the relaunched run
+    # sees the same batches the killed one would have
+    for step in range(start, 40):
+        st = np.random.RandomState(1000 + step)
+        x = paddle.to_tensor(st.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(st.randint(0, 3, (8,)).astype(np.int64))
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        with open(logpath, "a") as f:
+            f.write(f"{{step}} {{float(loss):.6f}}\\n")
+        ac.step(step)
+    print("DONE", start)
+""")
+
+
+class TestElasticKillRelaunch:
+    def test_killed_run_resumes_within_one_interval(self, tmp_path):
+        """Kill a training process mid-run; the relaunch must resume
+        from the newest checkpoint (within one 5-step interval of the
+        kill) and the loss curve must continue the original trajectory
+        exactly (same steps -> same losses)."""
+        script = tmp_path / "train.py"
+        script.write_text(_KILL_SCRIPT.format(repo=_REPO))
+        ckdir, log1 = str(tmp_path / "ck"), str(tmp_path / "run1.log")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+        p = subprocess.Popen([sys.executable, str(script), ckdir, log1],
+                             env=env)
+        # wait until it has passed step 12 (so ckpt-5 and ckpt-10 exist)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                lines = open(log1).read().strip().splitlines()
+                if lines and int(lines[-1].split()[0]) >= 12:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        else:
+            p.kill()
+            pytest.fail("first run never reached step 12")
+        p.send_signal(signal.SIGKILL)
+        p.wait()
+        killed_at = int(open(log1).read().strip().splitlines()[-1].split()[0])
+
+        log2 = str(tmp_path / "run2.log")
+        out = subprocess.run(
+            [sys.executable, str(script), ckdir, log2],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        lines2 = open(log2).read().strip().splitlines()
+        resumed_at = int(lines2[0].split()[0])
+        # resumed from a checkpoint at most one interval before the kill
+        assert killed_at - resumed_at <= 5 + 1, (killed_at, resumed_at)
+        assert "DONE" in out.stdout
+        # overlapping steps must produce IDENTICAL losses (true resume,
+        # not a restart): compare the original run's curve on the
+        # overlap window
+        run1 = {int(l.split()[0]): l.split()[1] for l in
+                open(log1).read().strip().splitlines()}
+        overlap = [l for l in lines2 if int(l.split()[0]) in run1]
+        assert overlap, "no overlapping steps to compare"
+        for l in overlap:
+            step, loss = l.split()
+            assert run1[int(step)] == loss, (step, run1[int(step)], loss)
+
+
+class TestReviewFindings:
+    def test_async_capture_is_a_snapshot(self, tmp_path):
+        """The async save must serialize step-N values even if the train
+        thread rebinds parameters before the write happens."""
+        import threading
+
+        import jax.numpy as jnp
+
+        model, optimizer, ac = _make(tmp_path, save_interval_steps=1,
+                                     async_save=True)
+        w_before = np.asarray(model.weight._data).copy()
+        # block the writer until we've mutated the weights
+        gate = threading.Event()
+        from paddle_tpu.framework import io as fio
+
+        orig_save = fio.save
+
+        def slow_save(obj, path, *a, **k):
+            gate.wait(5.0)
+            return orig_save(obj, path, *a, **k)
+
+        fio.save = slow_save
+        try:
+            ac.save_now(1)
+            model.weight._data = jnp.zeros_like(model.weight._data)
+            gate.set()
+            ac.wait()
+        finally:
+            fio.save = orig_save
+        model2, optimizer2, ac2 = _make(tmp_path / "r", save_interval_steps=1)
+        ac2.dir = str(tmp_path)
+        assert ac2.resume() == 2
+        np.testing.assert_allclose(
+            np.asarray(model2.weight._data), w_before)
+
+    def test_wait_raises_failed_save(self, tmp_path):
+        model, optimizer, ac = _make(tmp_path, save_interval_steps=1,
+                                     async_save=True)
+        from paddle_tpu.framework import io as fio
+
+        orig_save = fio.save
+        fio.save = lambda *a, **k: (_ for _ in ()).throw(OSError("disk full"))
+        try:
+            ac.save_now(1)
+            import pytest as _pytest
+
+            with _pytest.raises(RuntimeError, match="disk full"):
+                ac.wait()
+        finally:
+            fio.save = orig_save
+
+
+class TestPoolingEdgeFixes:
+    def test_unpool1d_with_padding_roundtrip(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(
+            (np.random.RandomState(0).permutation(16).astype(np.float32)
+             * 0.5).reshape(1, 2, 8))
+        out, idx = F.max_pool1d(x, 2, stride=2, padding=1, return_mask=True)
+        up = F.max_unpool1d(out, idx, 2, stride=2, padding=1)
+        assert up.shape == [1, 2, 8]
+
+    def test_pool3d_ceil_mode_mask_shapes_agree(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 1, 5, 5, 5).astype(np.float32))
+        out, idx = F.max_pool3d(x, 2, stride=2, ceil_mode=True,
+                                return_mask=True)
+        assert tuple(out.shape) == tuple(idx.shape)
+
+    def test_pool3d_negative_input_padding_indices_in_range(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(
+            -np.abs(np.random.RandomState(0).randn(1, 1, 4, 4, 4))
+            .astype(np.float32) - 1.0)
+        out, idx = F.max_pool3d(x, 2, stride=2, padding=1, return_mask=True)
+        ia = np.asarray(idx._data)
+        assert ia.min() >= 0 and ia.max() < 4 * 4 * 4
+        up = F.max_unpool3d(out, idx, 2, stride=2, padding=1)
+        # every kept value scatters to a real input position
+        assert np.isfinite(np.asarray(up._data)).all()
+
+    def test_pool2d_negative_input_padding_indices_in_range(self):
+        import paddle_tpu.nn.functional as F
+
+        x = paddle.to_tensor(
+            -np.abs(np.random.RandomState(1).randn(1, 1, 4, 4))
+            .astype(np.float32) - 1.0)
+        out, idx = F.max_pool2d(x, 2, stride=2, padding=1, return_mask=True)
+        ia = np.asarray(idx._data)
+        assert ia.min() >= 0 and ia.max() < 16

@@ -322,6 +322,224 @@ def test_numeric_grad(name, op, data):
     check_grad(op, data, rtol=rtol, atol=atol)
 
 
+# ---- round-4 extension: registry-tail ops (losses, pools, convs, ----
+# ---- linalg, fft, complex, scatter/index, vision, attention)     ----
+# check_grad drives a SINGLE differentiable input; other operands are
+# constants closed over. FD away from kinks where needed.
+
+_rng4 = np.random.RandomState(41)
+_G5 = _rng4.randn(4, 5)                           # generic [4, 5]
+_G24 = _rng4.randn(2, 4)
+_POS5 = np.abs(_rng4.randn(4, 5)) + 0.5
+_IMG = _rng4.randn(1, 2, 4, 4)                    # NCHW
+_VOL = _rng4.randn(1, 1, 2, 4, 4)                 # NCDHW
+# max-pool FD needs well-separated window values (no argmax flips
+# within +-eps): a scaled permutation has pairwise gaps >= 0.25
+_VOLSEP = (_rng4.permutation(32).astype(np.float64) * 0.25 - 4.0).reshape(1, 1, 2, 4, 4)
+_SEQ = _rng4.randn(1, 2, 6)                       # NCL
+_SQ = _rng4.randn(3, 3)
+
+_t = lambda a: paddle.to_tensor(np.asarray(a, np.float32))  # noqa: E731
+_ti = lambda a: paddle.to_tensor(np.asarray(a, np.int64))   # noqa: E731
+
+_LBL01 = _t((_rng4.rand(4, 5) > 0.5).astype(np.float32))
+_MASK45 = paddle.to_tensor(_rng4.rand(4, 5) > 0.5)
+_CE_Y = _ti(np.where(_rng4.rand(4) > 0.5, 1, -1))
+_TD_B = _t(_rng4.randn(4, 5))
+_MD_C = _t(_rng4.randn(3, 2))
+_HH_TAU = _t(np.abs(_rng4.randn(3)) * 0.2)
+_HH_X = _rng4.randn(4, 3)
+_IS_IDX = _ti(_rng4.randint(0, 5, (4, 3)))
+_GRID = _t(_rng4.rand(1, 3, 3, 2) * 1.2 - 0.6)
+_G44 = _rng4.randn(4, 4)
+_TS_IN = _rng4.randn(4, 5)
+_LBLPM = _t(np.where(_rng4.rand(4, 5) > 0.5, 1.0, -1.0).astype(np.float32))
+_IDX4 = _ti(_rng4.randint(0, 5, (4,)))
+_W35 = _t(_rng4.randn(5, 3) * 0.5)
+_CK1 = _t(_rng4.randn(2, 2, 3) * 0.4)             # conv1d kernel [out,in,k]
+_CK1T = _t(_rng4.randn(2, 2, 3) * 0.4)            # conv1d_transpose [in,out,k]
+_CK2T = _t(_rng4.randn(2, 2, 2, 2) * 0.4)         # conv2d_transpose [in,out,kh,kw]
+_CK3 = _t(_rng4.randn(1, 1, 2, 2, 2) * 0.4)       # conv3d [out,in,kd,kh,kw]
+_CK3T = _t(_rng4.randn(1, 1, 2, 2, 2) * 0.4)
+
+_SWEEP_EXTRA = [
+    # --- losses -------------------------------------------------------
+    ("binary_cross_entropy", lambda x: F.binary_cross_entropy(F.sigmoid(x), _LBL01), _G5),
+    ("binary_cross_entropy_with_logits", lambda x: F.binary_cross_entropy_with_logits(x, _LBL01), _G5),
+    ("nll_loss", lambda x: F.nll_loss(F.log_softmax(x, -1), _IDX4), _G5),
+    ("softmax_with_cross_entropy", lambda x: F.softmax_with_cross_entropy(x, _IDX4.reshape([4, 1])).sum(), _G5),
+    ("smooth_l1_loss", lambda x: F.smooth_l1_loss(x, _t(_G5 * 0.5 + 1.0)), _G5),
+    ("soft_margin_loss", lambda x: F.soft_margin_loss(x, _LBLPM), _G5),
+    ("multi_label_soft_margin_loss", lambda x: F.multi_label_soft_margin_loss(x, _LBL01), _G5),
+    ("multi_margin_loss", lambda x: F.multi_margin_loss(x, _IDX4), _G5),
+    ("hinge_embedding_loss", lambda x: F.hinge_embedding_loss(x, _LBLPM), _G5),
+    ("margin_ranking_loss", lambda x: F.margin_ranking_loss(x, _t(_G5[::-1].copy()), _LBLPM), _G5),
+    ("cosine_embedding_loss", lambda x: F.cosine_embedding_loss(x, _t(_G5 + 0.3), _CE_Y), _G5),
+    ("triplet_margin_loss", lambda x: F.triplet_margin_loss(x, _t(_G5 + 0.2), _t(_G5 - 0.4)), _G5),
+    ("triplet_margin_with_distance_loss", lambda x: F.triplet_margin_with_distance_loss(x, _t(_G5 + 0.2), _t(_G5 - 0.4)), _G5),
+    ("sigmoid_focal_loss", lambda x: F.sigmoid_focal_loss(x, _LBL01), _G5),
+    ("poisson_nll_loss", lambda x: F.poisson_nll_loss(x, _t(np.abs(_G5))), _G5),
+    ("gaussian_nll_loss", lambda x: F.gaussian_nll_loss(x, _t(_G5 * 0.5), _t(np.abs(_G5) + 0.5)), _G5),
+    ("dice_loss", lambda x: F.dice_loss(F.softmax(x, -1), _IDX4.reshape([4, 1])), _G5),
+    ("npair_loss", lambda x: F.npair_loss(x, _t(_G5 * 0.8), _IDX4), _G5),
+    ("label_smooth", lambda x: F.label_smooth(x, epsilon=0.1).sum() * 0 + F.label_smooth(F.softmax(x, -1), epsilon=0.1).sum(), _G5),
+    ("hsigmoid_loss", lambda x: F.hsigmoid_loss(x, _ti([1, 2, 0, 3]), 5, _W35.T), _G5),
+    ("margin_cross_entropy", lambda x: F.margin_cross_entropy(F.normalize(x, axis=-1), _IDX4, margin1=1.0, margin2=0.0, margin3=0.0).sum(), _G5),
+    ("cosine_similarity", lambda x: F.cosine_similarity(x, _t(_G5 + 0.3), axis=-1), _G5),
+    ("pairwise_distance", lambda x: F.pairwise_distance(x, _t(_G5 + 0.3)), _G5),
+    ("cdist", lambda x: paddle.cdist(x, _t(_G5[:3] + 0.4)), _G5),
+    # --- pools / padding / patches -----------------------------------
+    ("avg_pool1d", lambda x: F.avg_pool1d(x, 2, stride=2), _SEQ),
+    ("max_pool1d", lambda x: F.max_pool1d(x, 2, stride=2), _SEQ),
+    ("lp_pool1d", lambda x: F.lp_pool1d(x, 2, 2, stride=2), np.abs(_SEQ) + 0.3),
+    ("adaptive_avg_pool1d", lambda x: F.adaptive_avg_pool1d(x, 2), _SEQ),
+    ("adaptive_max_pool1d", lambda x: F.adaptive_max_pool1d(x, 2), _SEQ),
+    ("avg_pool3d", lambda x: F.avg_pool3d(x, 2, stride=2), _VOL),
+    ("max_pool3d", lambda x: F.max_pool3d(x, 2, stride=2), _VOLSEP),
+    ("adaptive_avg_pool3d", lambda x: F.adaptive_avg_pool3d(x, 2), _VOL),
+    ("adaptive_max_pool3d", lambda x: F.adaptive_max_pool3d(x, 2), _VOLSEP),
+    ("max_unpool1d", lambda x: F.max_unpool1d(*F.max_pool1d(x, 2, stride=2, return_mask=True), 2, stride=2), _SEQ),
+    ("max_unpool2d", lambda x: F.max_unpool2d(*F.max_pool2d(x, 2, stride=2, return_mask=True), 2, stride=2), _IMG),
+    ("max_unpool3d", lambda x: F.max_unpool3d(*F.max_pool3d(x, 2, stride=2, return_mask=True), 2, stride=2), _VOLSEP),
+    ("fold", lambda x: F.fold(x.reshape([1, 4, 4]), [4, 4], [2, 2], strides=2)[0], _G44),
+    ("unfold", lambda x: F.unfold(x, 2, strides=2), _IMG),
+    ("zeropad2d", lambda x: F.zeropad2d(x, [1, 1, 1, 1]), _IMG),
+    ("pad", lambda x: F.pad(x, [1, 1], mode="reflect", data_format="NCL"), _SEQ),
+    # --- convs / linear ----------------------------------------------
+    ("conv1d", lambda x: F.conv1d(x, _CK1._data), _SEQ),
+    ("conv1d_transpose", lambda x: F.conv1d_transpose(x, _CK1T._data), _SEQ),
+    ("conv2d_transpose", lambda x: F.conv2d_transpose(x, _CK2T._data), _IMG),
+    ("conv3d", lambda x: F.conv3d(x, _CK3._data), _VOL),
+    ("conv3d_transpose", lambda x: F.conv3d_transpose(x, _CK3T._data), _VOL),
+    ("linear", lambda x: F.linear(x, _W35), _G5),
+    # --- norms --------------------------------------------------------
+    ("batch_norm", lambda x: F.batch_norm(x, _t(np.zeros(2)), _t(np.ones(2)), _t(np.ones(2)), _t(np.zeros(2)), training=False), _IMG),
+    ("rms_norm", lambda x: F.rms_norm(x, _t(np.ones(5))), _G5),
+    # --- linalg -------------------------------------------------------
+    ("inverse", lambda x: paddle.linalg.inv(_spd(x)), _GENERIC),
+    ("cholesky_solve", lambda x: paddle.linalg.cholesky_solve(x[:3, :2], paddle.linalg.cholesky(_spd(x))), _GENERIC),
+    ("cholesky_inverse", lambda x: paddle.linalg.cholesky_inverse(paddle.linalg.cholesky(_spd(x))), _GENERIC),
+    ("eigvalsh", lambda x: paddle.linalg.eigvalsh(_spd(x)), _GENERIC),
+    ("eigh_vals", lambda x: paddle.linalg.eigh(_spd(x))[0], _GENERIC),
+    ("svdvals_sum", lambda x: paddle.linalg.svd(x, full_matrices=False)[1], _G24),
+    ("qr_r_diag", lambda x: paddle.abs(paddle.diagonal(paddle.linalg.qr(_spd(x))[1])), _GENERIC),
+    ("lstsq_sol", lambda x: paddle.linalg.lstsq(_spd(x), x[:3, :2])[0], _GENERIC),
+    ("multi_dot", lambda x: paddle.linalg.multi_dot([x, _W35, _MD_C]), _G5),
+    ("mm", lambda x: paddle.mm(x, _W35), _G5),
+    ("tensordot", lambda x: paddle.tensordot(x, _TD_B, axes=[[0], [0]]), _G5),
+    ("matrix_norm_fro", lambda x: paddle.linalg.matrix_norm(x, p="fro"), _G5),
+    ("cov", lambda x: paddle.linalg.cov(x), _G5),
+    ("corrcoef", lambda x: paddle.linalg.corrcoef(x), _G5),
+    ("t", lambda x: paddle.t(x) * paddle.t(x), _G5),
+    ("householder_product", lambda x: paddle.linalg.householder_product(x * 0.3, _HH_TAU), _HH_X),
+    # --- fft (loss via abs) ------------------------------------------
+    ("fft", lambda x: paddle.fft.fft(x).abs(), _G5),
+    ("ifft", lambda x: paddle.fft.ifft(x).abs(), _G5),
+    ("fft2", lambda x: paddle.fft.fft2(x).abs(), _G5),
+    ("ifft2", lambda x: paddle.fft.ifft2(x).abs(), _G5),
+    ("fftn", lambda x: paddle.fft.fftn(x).abs(), _G5),
+    ("ifftn", lambda x: paddle.fft.ifftn(x).abs(), _G5),
+    ("rfft", lambda x: paddle.fft.rfft(x).abs(), _G5),
+    ("irfft", lambda x: paddle.fft.irfft(paddle.fft.rfft(x)), _G5),
+    ("rfft2", lambda x: paddle.fft.rfft2(x).abs(), _G5),
+    ("irfft2", lambda x: paddle.fft.irfft2(paddle.fft.rfft2(x)), _G5),
+    ("rfftn", lambda x: paddle.fft.rfftn(x).abs(), _G5),
+    ("irfftn", lambda x: paddle.fft.irfftn(paddle.fft.rfftn(x)), _G5),
+    ("hfft", lambda x: paddle.fft.hfft(paddle.fft.rfft(x)), _G5),
+    ("ihfft", lambda x: paddle.fft.ihfft(x).abs(), _G5),
+    ("fftshift", lambda x: paddle.fft.fftshift(x) * x, _G5),
+    ("ifftshift", lambda x: paddle.fft.ifftshift(x) * x, _G5),
+    # --- complex ------------------------------------------------------
+    ("as_complex", lambda x: paddle.as_complex(x.reshape([8, 2])).abs(), _G44),
+    ("as_real", lambda x: paddle.as_real(paddle.complex(x, x * 0.5)), _G5),
+    ("complex_abs", lambda x: paddle.complex(x, _t(_G5 * 0.7)).abs(), _G5),
+    ("real", lambda x: paddle.real(paddle.complex(x, _t(_G5))), _G5),
+    ("imag", lambda x: paddle.imag(paddle.complex(_t(_G5), x)), _G5),
+    ("conj", lambda x: paddle.conj(paddle.complex(x, _t(_G5))).real(), _G5),
+    ("angle", lambda x: paddle.angle(paddle.complex(x, _t(np.abs(_G5) + 0.5))), _POS5),
+    ("polar", lambda x: paddle.polar(x, _t(_G5 * 0.3)).abs(), _POS5),
+    # --- scatter / index / manipulation ------------------------------
+    ("diag", lambda x: paddle.diag(x[0]), _G5),
+    ("diag_embed", lambda x: paddle.diag_embed(x), _G5),
+    ("diagonal_scatter", lambda x: paddle.diagonal_scatter(paddle.zeros([5, 5]) + 1.0, x[0], 0), _G5),
+    ("gather_nd", lambda x: paddle.gather_nd(x, _ti([[0, 1], [3, 2]])), _G5),
+    ("index_fill", lambda x: paddle.index_fill(x, _ti([1, 3]), 0, 0.0) * x, _G5),
+    ("index_put", lambda x: paddle.index_put(x, (_ti([0, 2]),), _t(np.zeros((2, 5)))) * x, _G5),
+    ("index_sample", lambda x: paddle.index_sample(x, _IS_IDX), _G5),
+    ("masked_fill", lambda x: paddle.masked_fill(x, _MASK45, 0.0) * x, _G5),
+    ("masked_scatter", lambda x: paddle.masked_scatter(x, _MASK45, _t(np.zeros((4, 5)))) * x, _G5),
+    ("masked_select", lambda x: paddle.masked_select(x, paddle.to_tensor(np.asarray([[True, False, True, False, True]] * 4))), _G5),
+    ("scatter", lambda x: paddle.scatter(x, _ti([0, 2]), _t(np.zeros((2, 5)))) * x, _G5),
+    ("scatter_nd", lambda x: paddle.scatter_nd(_ti([[1], [3]]), x[:2], [6, 5]), _G5),
+    ("scatter_nd_add", lambda x: paddle.scatter_nd_add(x, _ti([[0], [2]]), _t(np.ones((2, 5)))), _G5),
+    ("slice_scatter", lambda x: paddle.slice_scatter(x, _t(np.zeros((2, 5))), axes=[0], starts=[1], ends=[3], strides=[1]) * x, _G5),
+    ("select_scatter", lambda x: paddle.select_scatter(x, _t(np.zeros(5)), axis=0, index=1) * x, _G5),
+    ("take", lambda x: paddle.take(x, _ti([1, 7, 12])), _G5),
+    ("tensor_split", lambda x: paddle.tensor_split(x, 2, axis=1)[0], _G5),
+    ("hsplit", lambda x: paddle.hsplit(x, 2)[0], _rng4.randn(4, 4)),
+    ("vsplit", lambda x: paddle.vsplit(x, 2)[0], _rng4.randn(4, 4)),
+    ("dsplit", lambda x: paddle.dsplit(x.reshape([2, 2, 2]), 2)[0], _rng4.randn(2, 4)),
+    ("column_stack", lambda x: paddle.column_stack([x, x * 2.0]), _G5),
+    ("block_diag", lambda x: paddle.block_diag([x, x[:2, :2] * 2.0]), _G5),
+    ("meshgrid", lambda x: paddle.meshgrid(x[0], x[1])[0] * paddle.meshgrid(x[0], x[1])[1], _G5),
+    ("squeeze", lambda x: paddle.squeeze(x.reshape([1, 4, 5]), 0) * x, _G5),
+    ("unsqueeze", lambda x: paddle.unsqueeze(x, 1) * x.reshape([4, 1, 5]), _G5),
+    ("expand", lambda x: paddle.expand(x.reshape([1, 4, 5]), [3, 4, 5]), _G5),
+    ("reverse", lambda x: paddle.reverse(x, [0]) * x, _G5),
+    ("as_strided", lambda x: paddle.as_strided(x, [2, 3], [5, 1]), _G5),
+    ("strided_slice", lambda x: paddle.strided_slice(x, [0, 1], [0, 0], [4, 5], [2, 2]), _G5),
+    ("multiplex", lambda x: paddle.multiplex([x, x * 2.0], _ti([0, 1, 0, 1])), _G5),
+    ("broadcast_tensors", lambda x: paddle.broadcast_tensors([x.reshape([1, 4, 5]), x.reshape([4, 1, 5]) * 0 + 1.0])[0], _G5),
+    ("atleast_1d", lambda x: paddle.atleast_1d(x) * x, _G5),
+    ("atleast_3d", lambda x: paddle.atleast_3d(x) * x.reshape([1, 4, 5]).transpose([1, 2, 0]), _G5),
+    ("cartesian_prod", lambda x: paddle.cartesian_prod([x[0], x[1, :3]]), _G5),
+    ("view", lambda x: x.view([5, 4]) * x.view([5, 4]), _G5),
+    ("view_as", lambda x: x.view_as(_t(np.zeros((5, 4)))) * 2.0, _G5),
+    ("clone", lambda x: paddle.clone(x) * x, _G5),
+    ("assign", lambda x: paddle.assign(x) * x, _G5),
+    ("cast_f64", lambda x: paddle.cast(x, "float64") * 2.0, _G5),
+    ("sort_vals", lambda x: paddle.sort(x, axis=1), _G5),
+    ("neg", lambda x: paddle.neg(x) * 3.0, _G5),
+    ("trace_like", lambda x: paddle.diagonal(x), _G5),
+    # --- vision -------------------------------------------------------
+    ("grid_sample", lambda x: F.grid_sample(x, _GRID, align_corners=True), _IMG),
+    ("roi_align", lambda x: paddle.vision.ops.roi_align(x, _t([[0.5, 0.5, 3.0, 3.0]]), _ti([1]), output_size=2, spatial_scale=1.0), _IMG),
+    ("roi_pool", lambda x: paddle.vision.ops.roi_pool(x, _t([[0.4, 0.4, 3.1, 3.1]]), _ti([1]), output_size=2, spatial_scale=1.0), _IMG),
+    ("temporal_shift", lambda x: F.temporal_shift(x.reshape([4, 1, 1, 5]), seg_num=2, shift_ratio=0.25), _TS_IN),
+    ("interpolate", lambda x: F.interpolate(x, size=[8, 8], mode="bilinear", align_corners=True), _IMG),
+    ("upsample", lambda x: F.upsample(x, scale_factor=2, mode="nearest"), _IMG),
+    # --- attention ----------------------------------------------------
+    ("scaled_dot_product_attention", lambda x: F.scaled_dot_product_attention(x.reshape([1, 4, 1, 5]), _t(_G5).reshape([1, 4, 1, 5]), _t(_G5 * 0.5).reshape([1, 4, 1, 5])), _G5),
+    # --- elementwise tail --------------------------------------------
+    ("stanh", lambda x: paddle.stanh(x), _G5),
+    ("frac", lambda x: paddle.frac(x), _OFF_ZERO),
+    ("heaviside_y", lambda x: paddle.heaviside(_t(_OFF_ZERO), x), _OFF_ZERO + 1.0),
+    ("i0e", lambda x: paddle.i0e(x), _G5),
+    ("i1e", lambda x: paddle.i1e(x), _G5),
+    ("mod", lambda x: paddle.mod(x, _t(np.full((4, 5), 2.7))), _POS5),
+    ("remainder", lambda x: paddle.remainder(x, _t(np.full((4, 5), 1.9))), _POS5),
+    ("scale_op", lambda x: paddle.scale(x, scale=2.5, bias=0.3), _G5),
+    ("rrelu_eval", lambda x: F.rrelu(x, training=False), _OFF_ZERO),
+    ("hardtanh", lambda x: F.hardtanh(x * 0.4), _OFF_ZERO),
+    ("floor_zero_grad", lambda x: paddle.floor(x), _OFF_ZERO),
+    ("ceil_zero_grad", lambda x: paddle.ceil(x), _OFF_ZERO),
+    ("round_zero_grad", lambda x: paddle.round(x), _OFF_ZERO),
+    ("trunc_zero_grad", lambda x: paddle.trunc(x), _OFF_ZERO),
+    ("sign_zero_grad", lambda x: paddle.sign(x), _OFF_ZERO),
+]
+
+
+_LOOSE_EXTRA = {"multi_margin_loss": (2e-2, 5e-3),
+                "cosine_embedding_loss": (3e-2, 5e-3)}
+
+
+@pytest.mark.parametrize("name,op,data", _SWEEP_EXTRA,
+                         ids=[s[0] for s in _SWEEP_EXTRA])
+def test_numeric_grad_extra(name, op, data):
+    rtol, atol = _LOOSE_EXTRA.get(name, (2e-2, 2e-3))
+    check_grad(op, np.asarray(data, np.float64), rtol=rtol, atol=atol)
+
+
 class TestDtypePaths:
     def test_bf16_matmul_grad_flows(self):
         x = paddle.to_tensor(_GENERIC.astype(np.float32)).astype("bfloat16")
