@@ -62,13 +62,15 @@ def _pool(x, kernel, stride, padding, n, reducer, init, data_format, ceil_mode, 
             pads = ([(0, 0)] + list(pad) + [(0, 0)]) if not isinstance(pad, str) else pad
         if ceil_mode and not isinstance(pads, str):
             # extend high padding so the last partial window is included
-            spatial_axes = range(2, 2 + n) if channels_first else range(1, 1 + n)
+            # (single source of truth with the return_mask index helpers)
+            spatial_axes = list(range(2, 2 + n) if channels_first else range(1, 1 + n))
+            sp_pads = _pool_pads(
+                [a.shape[ax] for ax in spatial_axes],
+                ks, st, [pads[ax] for ax in spatial_axes], True,
+            )
             pads = list(pads)
-            for i, ax in enumerate(spatial_axes):
-                size = a.shape[ax] + pads[ax][0] + pads[ax][1]
-                rem = (size - ks[i]) % st[i]
-                if rem:
-                    pads[ax] = (pads[ax][0], pads[ax][1] + st[i] - rem)
+            for ax, p2 in zip(spatial_axes, sp_pads):
+                pads[ax] = p2
         if average:
             summed = jax.lax.reduce_window(a, 0.0 if jnp.issubdtype(a.dtype, jnp.floating) else 0, jax.lax.add, window, strides, pads)
             if count_include_pad and not isinstance(pads, str):
@@ -194,6 +196,10 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating) else int(np.iinfo(dt).min),
                 data_format, ceil_mode, "max_pool3d")
     if return_mask:
+        if data_format != "NCDHW":
+            raise ValueError(
+                "max_pool3d(return_mask=True) supports NCDHW only"
+            )
         return out, _max_pool3d_indices(x, kernel_size, stride, padding,
                                         ceil_mode)
     return out
@@ -357,6 +363,8 @@ def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="N
 def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW", output_size=None, name=None):
     """Inverse of max_pool3d(return_mask=True): values scatter to their
     flat (d*H*W + h*W + w) argmax positions."""
+    if data_format != "NCDHW":
+        raise ValueError("max_unpool3d supports NCDHW only")
     ks = _tuple(kernel_size, 3)
     st = _tuple(stride if stride is not None else kernel_size, 3)
     p = _tuple(padding, 3)

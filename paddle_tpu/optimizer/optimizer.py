@@ -256,19 +256,31 @@ class Optimizer:
         # this optimizer's order too. Without the remap, _get_accum later
         # misses the restored entries and silently reinitializes zero
         # moments — resumed training drifts from the original run.
-        saved_pnames: list = []
+        per_accum: dict = {}
         for key in state_dict:
             if key in ("global_step", "LR_Scheduler"):
                 continue
-            pname = key.rpartition(".")[0]
-            if pname and pname not in saved_pnames:
-                saved_pnames.append(pname)
+            pname, _, accum = key.rpartition(".")
+            if pname:
+                per_accum.setdefault(accum, [])
+                if pname not in per_accum[accum]:
+                    per_accum[accum].append(pname)
         live_pnames = [p.name for p in self._parameter_list]
-        remap = (
-            dict(zip(saved_pnames, live_pnames))
-            if len(saved_pnames) == len(live_pnames)
-            else {}  # partial/foreign state: fall back to name identity
-        )
+        saved_all = {pn for pnames in per_accum.values() for pn in pnames}
+        if saved_all and saved_all <= set(live_pnames):
+            remap = {}  # names already match (same-process restore)
+        else:
+            # positional order must come from ONE full-coverage store
+            # (each store is in _parameter_list order, but e.g. a
+            # multi_precision master_weight store covers only low-
+            # precision params and may have been created first — the
+            # whole-dict key order would cross-wire parameters)
+            ordered = max(per_accum.values(), key=len) if per_accum else []
+            remap = (
+                dict(zip(ordered, live_pnames))
+                if len(ordered) == len(live_pnames)
+                else {}  # partial/foreign state: name identity
+            )
         for key, val in state_dict.items():
             if key in ("global_step", "LR_Scheduler"):
                 continue

@@ -43,10 +43,16 @@ def _unwrap(x):
 
 
 class SparseCooTensor:
-    """COO sparse tensor over jax.experimental.sparse.BCOO."""
+    """COO sparse tensor over jax.experimental.sparse.BCOO.
 
-    def __init__(self, bcoo: jsparse.BCOO):
+    ``values_tensor`` (optional) is the LIVE tape Tensor the values came
+    from: sparse.nn ops pass it so ``values()`` / ``to_dense()`` stay on
+    the autograd tape (a fresh wrapper around the raw buffer would cut
+    the gradient path at every sparse layer boundary)."""
+
+    def __init__(self, bcoo: jsparse.BCOO, values_tensor: "Tensor" = None):
         self._bcoo = bcoo
+        self._values_tensor = values_tensor
 
     # -- paddle Tensor-like surface ------------------------------------
     @property
@@ -65,9 +71,23 @@ class SparseCooTensor:
         return Tensor(self._bcoo.indices.T, _internal=True)
 
     def values(self) -> Tensor:
+        if self._values_tensor is not None:
+            return self._values_tensor
         return Tensor(self._bcoo.data, _internal=True)
 
     def to_dense(self) -> Tensor:
+        if self._values_tensor is not None:
+            # differentiable scatter so grads flow back to the values
+            from ..base.tape import apply as _apply
+
+            idx = tuple(np.asarray(jax.device_get(self._bcoo.indices)).T)
+            shape = self._bcoo.shape
+
+            def scatter(v):
+                return jnp.zeros(shape, v.dtype).at[idx].add(v)
+
+            return _apply(scatter, self._values_tensor,
+                          op_name="sparse_to_dense")
         return Tensor(self._bcoo.todense(), _internal=True)
 
     def to_sparse_csr(self) -> "SparseCsrTensor":
@@ -429,3 +449,8 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     u_hat, s, vt = jnp.linalg.svd(bmat, full_matrices=False)
     u = qmat @ u_hat
     return Tensor(u, _internal=True), Tensor(s, _internal=True), Tensor(vt.T, _internal=True)
+
+
+# sparse.nn (layer stack) — imported last: it consumes the COO/CSR
+# types defined above (ref: python/paddle/sparse/nn/)
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,156 @@
+"""paddle.sparse.nn parity: sparse conv / norm / activation / pooling
+layers over SparseCooTensor (ref: python/paddle/sparse/nn/layer/
+conv.py:304 Conv3D, :574 SubmConv3D; norm.py BatchNorm; activation.py
+ReLU/ReLU6/LeakyReLU/Softmax; pooling.py MaxPool3D). See functional.py
+for the gather-GEMM-scatter design notes."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import functional  # noqa: F401
+from ...nn.layer.layers import Layer
+
+__all__ = [
+    "Conv3D", "SubmConv3D", "BatchNorm", "SyncBatchNorm",
+    "ReLU", "ReLU6", "LeakyReLU", "Softmax", "MaxPool3D", "functional",
+]
+
+
+def _tup3(v):
+    return functional._tup3(v)
+
+
+class _Conv3DBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 key=None):
+        super().__init__()
+        if groups != 1:
+            raise ValueError("sparse conv supports groups=1")
+        if padding_mode != "zeros":
+            raise ValueError("sparse conv supports padding_mode='zeros'")
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _tup3(kernel_size)
+        self._stride = _tup3(stride)
+        self._padding = _tup3(padding)
+        self._dilation = _tup3(dilation)
+        kd, kh, kw = self._kernel_size
+        fan_in = in_channels * kd * kh * kw
+        bound = 1.0 / np.sqrt(fan_in)
+        from ...nn import initializer as I
+
+        self.weight = self.create_parameter(
+            shape=[kd, kh, kw, in_channels, out_channels],
+            attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound),
+        )
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[out_channels], is_bias=True, attr=bias_attr,
+                default_initializer=I.Uniform(-bound, bound),
+            )
+        else:
+            self.bias = None
+
+
+class Conv3D(_Conv3DBase):
+    """Sparse 3-D conv (ref: sparse/nn/layer/conv.py:304)."""
+
+    def forward(self, x):
+        return functional.conv3d(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._dilation,
+        )
+
+
+class SubmConv3D(_Conv3DBase):
+    """Submanifold sparse 3-D conv (ref: conv.py:574)."""
+
+    def forward(self, x):
+        return functional.subm_conv3d(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._dilation,
+        )
+
+
+class BatchNorm(Layer):
+    """Sparse BatchNorm (ref: sparse/nn/layer/norm.py:24 — a BatchNorm1D
+    over the nnz values, channelwise): normalizes values [nnz, C] with
+    running statistics."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        import paddle_tpu.nn as nn
+
+        self._bn = nn.BatchNorm1D(
+            num_features, momentum=momentum, epsilon=epsilon,
+            weight_attr=weight_attr, bias_attr=bias_attr,
+        )
+
+    def forward(self, x):
+        import jax.experimental.sparse as jsparse
+
+        from .. import SparseCooTensor
+        from ...base.tensor import Tensor
+
+        bcoo = x._bcoo
+        out = self._bn(x.values())
+        return SparseCooTensor(jsparse.BCOO(
+            (out._data, bcoo.indices), shape=bcoo.shape,
+            indices_sorted=bcoo.indices_sorted,
+            unique_indices=bcoo.unique_indices,
+        ), values_tensor=out)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Sparse SyncBatchNorm (ref: norm.py SyncBatchNorm) — under GSPMD
+    the batch statistics are computed on the global (replicated or
+    sharded) values, so the dense BatchNorm semantics already match
+    the synchronized behavior."""
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self._axis)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        self._kernel = kernel_size
+        self._stride = stride
+        self._padding = padding
+
+    def forward(self, x):
+        return functional.max_pool3d(
+            x, self._kernel, self._stride, self._padding
+        )

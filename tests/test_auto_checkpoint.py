@@ -301,3 +301,39 @@ class TestPoolingEdgeFixes:
         out, idx = F.max_pool2d(x, 2, stride=2, padding=1, return_mask=True)
         ia = np.asarray(idx._data)
         assert ia.min() >= 0 and ia.max() < 16
+
+
+class TestMultiPrecisionRestoreOrder:
+    def test_remap_uses_full_coverage_store_order(self):
+        """A state dict whose FIRST store covers only a subset (the
+        multi_precision master_weight pattern) must not cross-wire
+        parameters in the positional remap."""
+        import paddle_tpu.optimizer as popt
+
+        paddle.seed(0)
+        m = nn.Linear(4, 3)
+        o = popt.AdamW(learning_rate=0.01, parameters=m.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+        m(x).sum().backward()
+        o.step()
+        o.clear_grad()
+        sd = {k: v for k, v in o.state_dict().items()}
+        live = [p.name for p in m.parameters()]
+        # simulate a foreign-process dict: rename params AND put a
+        # subset-coverage store first (dict order)
+        renamed = {}
+        renamed[f"{live[1]}_only.master_weight"] = sd[f"{live[1]}.moment1"]
+        for k, v in sd.items():
+            if k in ("global_step",):
+                renamed[k] = v
+                continue
+            pn, _, acc = k.rpartition(".")
+            renamed[f"{pn}_foreign.{acc}"] = v
+        o2 = popt.AdamW(learning_rate=0.01, parameters=m.parameters())
+        o2.set_state_dict(renamed)
+        # the full-coverage stores must map foreign names onto live
+        # params in parameter order
+        np.testing.assert_allclose(
+            np.asarray(o2._accumulators["moment1"][live[0]]),
+            np.asarray(getattr(sd[f"{live[0]}.moment1"], "_data",
+                               sd[f"{live[0]}.moment1"])))

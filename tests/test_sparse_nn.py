@@ -1,0 +1,239 @@
+"""sparse.nn stack (ref: python/paddle/sparse/nn/layer/conv.py:304,574;
+norm/activation/pooling; phi sparse conv kernels): parity against DENSE
+conv3d on fully-active inputs, submanifold semantics, gradients, a
+trainable point-cloud classifier, and block-sparse attention parity."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import sparse as sp
+
+rng = np.random.RandomState(0)
+
+
+def _full_coo(n, d, h, w, c, seed=0):
+    """Fully-active sparse tensor (every voxel stored) + its dense twin
+    [N, C, D, H, W] for paddle dense conv3d."""
+    r = np.random.RandomState(seed)
+    dense_ndhwc = r.randn(n, d, h, w, c).astype(np.float32)
+    coords = np.stack(np.meshgrid(
+        np.arange(n), np.arange(d), np.arange(h), np.arange(w),
+        indexing="ij"), axis=-1).reshape(-1, 4)
+    vals = dense_ndhwc[coords[:, 0], coords[:, 1], coords[:, 2], coords[:, 3]]
+    x = sp.sparse_coo_tensor(coords.T, vals, shape=[n, d, h, w, c])
+    return x, np.moveaxis(dense_ndhwc, -1, 1)  # NCDHW
+
+
+def _sparse_out_to_dense(y):
+    """[N, D, H, W, C] sparse -> NCDHW numpy."""
+    return np.moveaxis(np.asarray(y.to_dense().numpy()), -1, 1)
+
+
+class TestConvParity:
+    @pytest.mark.parametrize("stride,padding", [(1, 1), (2, 0), (1, 0)])
+    def test_conv3d_matches_dense_on_full_input(self, stride, padding):
+        n, d, h, w, ci, co, k = 1, 4, 4, 4, 3, 5, 3
+        x, dense = _full_coo(n, d, h, w, ci, seed=1)
+        wgt = rng.randn(k, k, k, ci, co).astype(np.float32) * 0.3
+        bias = rng.randn(co).astype(np.float32)
+
+        y = sp.nn.functional.conv3d(
+            x, paddle.to_tensor(wgt), paddle.to_tensor(bias),
+            stride=stride, padding=padding)
+        # dense reference: NCDHW conv with OIDHW kernel
+        ref = F.conv3d(
+            paddle.to_tensor(dense),
+            paddle.to_tensor(np.transpose(wgt, (4, 3, 0, 1, 2))),
+            paddle.to_tensor(bias), stride=stride, padding=padding)
+        got = _sparse_out_to_dense(y)
+        np.testing.assert_allclose(got, ref.numpy(), rtol=2e-5, atol=2e-5)
+
+    def test_subm_conv3d_matches_dense_at_active_sites(self):
+        """Submanifold conv == dense conv EVALUATED AT the active sites
+        when the input is fully active (output coords == input coords)."""
+        n, d, h, w, ci, co, k = 1, 3, 4, 4, 2, 4, 3
+        x, dense = _full_coo(n, d, h, w, ci, seed=2)
+        wgt = rng.randn(k, k, k, ci, co).astype(np.float32) * 0.3
+        y = sp.nn.functional.subm_conv3d(
+            x, paddle.to_tensor(wgt), stride=1, padding=1)
+        assert y.nnz == x.nnz  # submanifold: coords preserved
+        ref = F.conv3d(
+            paddle.to_tensor(dense),
+            paddle.to_tensor(np.transpose(wgt, (4, 3, 0, 1, 2))),
+            stride=1, padding=1)
+        np.testing.assert_allclose(
+            _sparse_out_to_dense(y), ref.numpy(), rtol=2e-5, atol=2e-5)
+
+    def test_subm_keeps_sparsity_partial_input(self):
+        """On a PARTIAL active set, subm conv must not dilate it while a
+        regular sparse conv does."""
+        coords = np.array([[0, 1, 1, 1], [0, 2, 2, 2]]).T
+        vals = rng.randn(2, 3).astype(np.float32)
+        x = sp.sparse_coo_tensor(coords, vals, shape=[1, 5, 5, 5, 3])
+        wgt = paddle.to_tensor(rng.randn(3, 3, 3, 3, 4).astype(np.float32))
+        ys = sp.nn.functional.subm_conv3d(x, wgt, padding=1)
+        yc = sp.nn.functional.conv3d(x, wgt, padding=1)
+        assert ys.nnz == 2
+        assert yc.nnz > 2  # regular conv reaches neighboring voxels
+
+    def test_max_pool3d_matches_dense_on_full_input(self):
+        n, d, h, w, c = 1, 4, 4, 4, 3
+        x, dense = _full_coo(n, d, h, w, c, seed=3)
+        y = sp.nn.functional.max_pool3d(x, 2, stride=2)
+        ref = F.max_pool3d(paddle.to_tensor(dense), 2, stride=2)
+        np.testing.assert_allclose(
+            _sparse_out_to_dense(y), ref.numpy(), rtol=1e-6)
+
+
+class TestGradsAndTraining:
+    def test_conv_grads_match_finite_difference(self):
+        coords = np.array([[0, 0, 0, 0], [0, 1, 1, 1], [0, 1, 2, 2]]).T
+        vals_np = rng.randn(3, 2).astype(np.float64)
+        wgt_np = rng.randn(2, 2, 2, 2, 3).astype(np.float64) * 0.5
+
+        def loss_of(w_np):
+            x = sp.sparse_coo_tensor(
+                coords, vals_np.astype(np.float32), shape=[1, 3, 3, 3, 2])
+            w = paddle.to_tensor(w_np.astype(np.float32))
+            w.stop_gradient = False
+            y = sp.nn.functional.subm_conv3d(x, w, padding=1)
+            loss = (y.values() * y.values()).sum()
+            return loss, w
+
+        loss, w = loss_of(wgt_np)
+        loss.backward()
+        g = np.asarray(w.grad.numpy(), np.float64)
+        eps = 1e-3
+        for idx in [(0, 0, 0, 0, 0), (1, 1, 1, 1, 2), (0, 1, 0, 1, 1)]:
+            wp, wm = wgt_np.copy(), wgt_np.copy()
+            wp[idx] += eps
+            wm[idx] -= eps
+            fd = (float(loss_of(wp)[0]) - float(loss_of(wm)[0])) / (2 * eps)
+            np.testing.assert_allclose(g[idx], fd, rtol=2e-2, atol=1e-3)
+
+    def test_point_cloud_classifier_trains(self):
+        """A SubmConv3D->BN->ReLU->MaxPool->Conv3D->linear head stack
+        must train on a tiny synthetic point-cloud task (loss drops by
+        >2x over 30 steps)."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as popt
+
+        paddle.seed(0)
+
+        class PCNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.c1 = sp.nn.SubmConv3D(1, 8, 3, padding=1)
+                self.bn1 = sp.nn.BatchNorm(8)
+                self.act = sp.nn.ReLU()
+                self.pool = sp.nn.MaxPool3D(2, stride=2)
+                self.c2 = sp.nn.Conv3D(8, 16, 2, stride=2)
+                self.head = nn.Linear(16, 2)
+
+            def forward(self, x):
+                y = self.pool(self.act(self.bn1(self.c1(x))))
+                y = self.c2(y)
+                # global max over the active set -> dense features
+                feats = y.values().max(axis=0, keepdim=True)
+                return self.head(feats)
+
+        net = PCNet()
+        opt = popt.AdamW(learning_rate=5e-3, parameters=net.parameters())
+
+        r = np.random.RandomState(5)
+        clouds = []
+        for label in (0, 1):
+            for _ in range(4):
+                npts = 12
+                if label == 0:  # diagonal line
+                    base = np.arange(npts) % 8
+                    coords = np.stack([np.zeros(npts, int), base, base, base], 1)
+                else:  # random scatter
+                    coords = np.concatenate(
+                        [np.zeros((npts, 1), int), r.randint(0, 8, (npts, 3))], 1)
+                coords = np.unique(coords, axis=0)
+                vals = np.ones((len(coords), 1), np.float32)
+                clouds.append((coords, vals, label))
+
+        def step():
+            total = 0.0
+            for coords, vals, label in clouds:
+                x = sp.sparse_coo_tensor(
+                    coords.T, vals, shape=[1, 8, 8, 8, 1])
+                logits = net(x)
+                loss = F.cross_entropy(
+                    logits, paddle.to_tensor(np.array([label], np.int64)))
+                loss.backward()
+                total += float(loss)
+            opt.step()
+            opt.clear_grad()
+            return total / len(clouds)
+
+        first = step()
+        for _ in range(29):
+            last = step()
+        assert last < first / 2, (first, last)
+
+
+class TestSparseAttention:
+    def test_matches_dense_attention_under_mask(self):
+        b, hh, s, d = 2, 2, 8, 16
+        q = rng.randn(b, hh, s, d).astype(np.float32)
+        k = rng.randn(b, hh, s, d).astype(np.float32)
+        v = rng.randn(b, hh, s, d).astype(np.float32)
+        # banded sparsity pattern as a CSR mask
+        mask = (np.abs(np.arange(s)[:, None] - np.arange(s)[None, :]) <= 2)
+        mask_t = sp.sparse_csr_tensor(
+            *_dense_to_csr_args(mask.astype(np.float32)), shape=[s, s])
+        out = sp.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            mask_t)
+        # dense reference with -inf masking
+        scores = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(d)
+        scores = np.where(mask[None, None], scores, -np.inf)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = np.einsum("bhst,bhtd->bhsd", p, v)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-5)
+
+    def test_grads_flow(self):
+        s, d = 6, 8
+        q = paddle.to_tensor(rng.randn(1, 1, s, d).astype(np.float32))
+        q.stop_gradient = False
+        k = paddle.to_tensor(rng.randn(1, 1, s, d).astype(np.float32))
+        v = paddle.to_tensor(rng.randn(1, 1, s, d).astype(np.float32))
+        mask = np.tril(np.ones((s, s), np.float32))
+        out = sp.nn.functional.attention(
+            q, k, v, paddle.to_tensor(mask))
+        out.sum().backward()
+        assert np.isfinite(np.asarray(q.grad.numpy())).all()
+
+
+def _dense_to_csr_args(dense):
+    crows = [0]
+    cols = []
+    vals = []
+    for row in dense:
+        nz = np.nonzero(row)[0]
+        cols.extend(nz.tolist())
+        vals.extend(row[nz].tolist())
+        crows.append(len(cols))
+    return np.asarray(crows, np.int64), np.asarray(cols, np.int64), np.asarray(vals, np.float32)
+
+
+class TestSparseSoftmax:
+    def test_scalar_values_per_row_softmax(self):
+        """Scalar-valued 2-D COO: softmax normalizes each ROW's stored
+        entries (ref sparse softmax semantics), not the global nnz."""
+        coords = np.array([[0, 0], [0, 2], [1, 1], [2, 0], [2, 3]]).T
+        vals = np.array([1.0, 2.0, 5.0, 0.5, 0.7], np.float32)
+        x = sp.sparse_coo_tensor(coords, vals, shape=[3, 4])
+        y = sp.nn.functional.softmax(x)
+        out = np.asarray(y.values().numpy())
+        # row 0: entries 0,1; row 1: entry 2; row 2: entries 3,4
+        np.testing.assert_allclose(out[0] + out[1], 1.0, rtol=1e-6)
+        np.testing.assert_allclose(out[2], 1.0, rtol=1e-6)
+        np.testing.assert_allclose(out[3] + out[4], 1.0, rtol=1e-6)
+        e = np.exp([1.0, 2.0])
+        np.testing.assert_allclose(out[:2], e / e.sum(), rtol=1e-6)
