@@ -86,8 +86,13 @@ class FakeQuanterWithAbsMaxObserver(Layer):
         self.moving_rate = moving_rate
         self.bit_length = bit_length
         self.register_buffer("scale", Tensor(jnp.ones(()), _internal=True))
-        self.register_buffer("accum", Tensor(jnp.ones(()), _internal=True))
-        self.register_buffer("state", Tensor(jnp.ones(()), _internal=True))
+        # accum/state start at ZERO so the FIRST observation yields
+        # scale == absmax exactly (state becomes 1): a 1.0 init skews
+        # the startup scale toward (r + absmax)/(r + 1) — for small
+        # weights that's ~10x too coarse a grid and one-shot PTQ-style
+        # calibration quantizes into a handful of levels
+        self.register_buffer("accum", Tensor(jnp.zeros(()), _internal=True))
+        self.register_buffer("state", Tensor(jnp.zeros(()), _internal=True))
 
     def forward(self, x):
         if self.training:

@@ -30,6 +30,8 @@ class _Conv3DBase(Layer):
             raise ValueError("sparse conv supports groups=1")
         if padding_mode != "zeros":
             raise ValueError("sparse conv supports padding_mode='zeros'")
+        if data_format != "NDHWC":
+            raise ValueError("sparse conv uses the NDHWC sparse layout")
         self._in_channels = in_channels
         self._out_channels = out_channels
         self._kernel_size = _tup3(kernel_size)
@@ -84,6 +86,10 @@ class BatchNorm(Layer):
                  weight_attr=None, bias_attr=None, data_format="NDHWC",
                  use_global_stats=None, name=None):
         super().__init__()
+        if use_global_stats:
+            raise NotImplementedError(
+                "sparse BatchNorm(use_global_stats=True) is not supported"
+            )
         import paddle_tpu.nn as nn
 
         self._bn = nn.BatchNorm1D(
@@ -146,6 +152,13 @@ class MaxPool3D(Layer):
                  ceil_mode=False, return_mask=False, data_format="NDHWC",
                  name=None):
         super().__init__()
+        if ceil_mode or return_mask:
+            raise NotImplementedError(
+                "sparse MaxPool3D supports ceil_mode=False, "
+                "return_mask=False"
+            )
+        if data_format != "NDHWC":
+            raise ValueError("sparse MaxPool3D uses the NDHWC layout")
         self._kernel = kernel_size
         self._stride = stride
         self._padding = padding

@@ -53,6 +53,26 @@ def _coords_values(x: SparseCooTensor):
     return coords, values
 
 
+_rulebook_cache: dict = {}
+
+
+def _cached_rulebook(coords, spatial, kernel, stride, padding, dilation,
+                     subm: bool):
+    """Rulebooks depend only on the coordinate pattern + geometry, so a
+    SubmConv stack (same coords every layer) and a training loop (same
+    clouds every step) reuse them instead of re-running the O(K^3*nnz)
+    host loop per forward. Bounded LRU-ish cache."""
+    key = (coords.tobytes(), coords.shape, tuple(spatial), tuple(kernel),
+           tuple(stride), tuple(padding), tuple(dilation), subm)
+    hit = _rulebook_cache.get(key)
+    if hit is None:
+        if len(_rulebook_cache) >= 256:
+            _rulebook_cache.pop(next(iter(_rulebook_cache)))
+        hit = _rulebook_cache[key] = _build_rulebook(
+            coords, spatial, kernel, stride, padding, dilation, subm)
+    return hit
+
+
 def _build_rulebook(coords, spatial, kernel, stride, padding, dilation,
                     subm: bool):
     """(out_coords, per-offset (in_rows, out_rows)) — the sparse-conv
@@ -129,7 +149,7 @@ def _sparse_conv(x: SparseCooTensor, weight, bias, stride, padding,
     wshape = tuple((weight._data if isinstance(weight, Tensor) else weight).shape)
     kernel = wshape[:3]
     coords, values = _coords_values(x)
-    out_coords, pairs, out_spatial = _build_rulebook(
+    out_coords, pairs, out_spatial = _cached_rulebook(
         coords, shape[1:4], kernel, _tup3(stride), _tup3(padding),
         _tup3(dilation), subm,
     )
@@ -252,13 +272,17 @@ def max_pool3d(x, kernel_size, stride=None, padding=0,
     pad = _tup3(padding)
     shape = x.shape
     coords, values = _coords_values(x)
-    out_coords, pairs, out_spatial = _build_rulebook(
-        coords, shape[1:4], kernel, stride_t, pad, (1, 1, 1), subm=False,
+    out_coords, pairs, out_spatial = _cached_rulebook(
+        coords, shape[1:4], kernel, stride_t, pad, (1, 1, 1), False,
     )
     n_out = len(out_coords)
     c = shape[-1]
-    all_ins = np.concatenate([p[0] for p in pairs.values()])
-    all_outs = np.concatenate([p[1] for p in pairs.values()])
+    if not pairs:  # empty active set / no reachable window
+        all_ins = np.zeros((0,), np.int32)
+        all_outs = np.zeros((0,), np.int32)
+    else:
+        all_ins = np.concatenate([p[0] for p in pairs.values()])
+        all_outs = np.concatenate([p[1] for p in pairs.values()])
 
     def run(vals):
         out = jnp.full((n_out, c), -jnp.inf, vals.dtype)
@@ -308,9 +332,10 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
         m = jnp.broadcast_to(m, scores.shape)
         i = 0
         if key_padding_mask is not None:
-            kp = extra[i]
+            # ADDITIVE float mask [B, S] (0 keeps, -inf masks) — the
+            # same convention as attn_mask below
+            scores = scores + extra[i][:, None, None, :]
             i += 1
-            m = m & (kp[:, None, None, :] != 0)
         if attn_mask is not None:
             scores = scores + extra[i][None, None]
         scores = jnp.where(m, scores, -jnp.inf)

@@ -181,3 +181,54 @@ class TestFP8Gemm:
             fp8_fp8_half_gemm_fused(
                 paddle.to_tensor(a), paddle.to_tensor(b), act="tanh"
             )
+
+
+class TestInt8Serving:
+    """convert(execute_dtype='int8') wired into the generation decode
+    path (ref: llm_int8_matmul_kernel_impl.h): int8 generate must run,
+    stay close to the bf16/f32 logits, and keep argmax in the float
+    top-5 (greedy match on a RANDOM-init model is a worst-case metric —
+    near-tie logits flip under tiny perturbations; BASELINE.md records
+    the measured 542M row)."""
+
+    def test_int8_generate_matches_float_logits(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.models.generation import generate
+        from paddle_tpu.quantization import QAT, QuantConfig
+
+        paddle.seed(3)
+        cfg_m = LlamaConfig.tiny(num_hidden_layers=2)
+        model = LlamaForCausalLM(cfg_m)
+        model.eval()
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rng.randint(0, cfg_m.vocab_size, (4, 12)).astype(np.int64))
+
+        ref_logits = np.asarray(model(ids)._data[:, -1].astype("float32"))
+        ref_out = generate(model, ids, max_new_tokens=6, temperature=0.0)
+
+        qat = QAT(QuantConfig(activation=None, weight=None))
+        model = qat.quantize(model)
+        model = qat.convert(model, execute_dtype="int8")
+        int8_logits = np.asarray(model(ids)._data[:, -1].astype("float32"))
+        rel = np.abs(int8_logits - ref_logits).mean() / (
+            np.abs(ref_logits).mean() + 1e-9)
+        assert rel < 0.08, rel
+        top5 = np.argsort(ref_logits, -1)[:, -5:]
+        hits = sum(int8_logits[i].argmax() in top5[i] for i in range(4))
+        assert hits >= 3, hits
+
+        out = generate(model, ids, max_new_tokens=6, temperature=0.0,
+                       decode_chunk=4)
+        assert out.shape == ref_out.shape  # int8 decode runs end-to-end
+
+    def test_observer_first_scale_is_absmax(self):
+        """Regression: accum/state zero-init — one observation must set
+        scale == absmax (the old 1.0 init skewed it ~(r+a)/(r+1))."""
+        from paddle_tpu.quantization import FakeQuanterWithAbsMaxObserver
+
+        q = FakeQuanterWithAbsMaxObserver(moving_rate=0.9)
+        q.train()
+        x = paddle.to_tensor(np.array([0.5, -2.0, 1.0], np.float32))
+        q(x)
+        np.testing.assert_allclose(float(q.scale), 2.0, rtol=1e-6)
