@@ -29,6 +29,13 @@ Here the rewrite targets XLA's structured control flow:
   converted ``while``. Tensors the body reads from the enclosing scope
   are routed as explicit vjp inputs (closure-cell rebinding), so their
   gradients survive the scan.
+- EARLY-RETURN ``if`` (``if p: return a ... return b``): the function
+  tail becomes the false continuation and both continuations are
+  evaluated + tree-selected (the reference SOT's most common
+  graph-break site, ref jit/sot opcode_executor.py:305,1594 — its
+  bytecode tracer resumes after the branch; here the split happens at
+  statement level). Chains of guards convert recursively; both paths
+  must end in ``return <expr>`` with matching result structure.
 - Predicates that are NOT traced tensors dispatch to plain Python at
   runtime — the transform never changes eager semantics.
 
@@ -122,6 +129,34 @@ def _select_leaf(pred, a, b):
         f"tensor-dependent `if` ({a!r} vs {b!r}); only tensor results can "
         "be selected under trace"
     )
+
+
+def convert_ret_ifelse(pred, true_fn, false_fn):
+    """Runtime dispatch for a converted EARLY-RETURN ``if`` (the
+    guard pattern ``if p: return a ... return b``, the reference SOT's
+    most common graph-break site, ref opcode_executor.py:305 — here the
+    tail of the function becomes the false continuation): concrete
+    predicates pick a branch; traced predicates evaluate BOTH
+    continuations and tree-select the results."""
+    from jax import tree_util
+
+    from ..base.tensor import Tensor
+
+    if _tracer_of(pred) is None:
+        return true_fn() if _as_bool(pred) else false_fn()
+    t_out = true_fn()
+    f_out = false_fn()
+    is_leaf = lambda v: isinstance(v, Tensor)  # noqa: E731
+    t_leaves, t_def = tree_util.tree_flatten(t_out, is_leaf=is_leaf)
+    f_leaves, f_def = tree_util.tree_flatten(f_out, is_leaf=is_leaf)
+    if t_def != f_def:
+        raise ValueError(
+            "a tensor-dependent early-return `if` must return the same "
+            f"STRUCTURE on both paths (got {t_def} vs {f_def}); restructure "
+            "the returns or mark the function @paddle.jit.not_to_static"
+        )
+    out = [_select_leaf(pred, a, b) for a, b in zip(t_leaves, f_leaves)]
+    return tree_util.tree_unflatten(t_def, out)
 
 
 def convert_ifelse(pred, true_fn, false_fn, init_args: Tuple):
@@ -613,6 +648,93 @@ def _mutates_outer_state(stmts: Sequence[ast.stmt]) -> bool:
     return found
 
 
+def _contains(node_or_stmts, types) -> bool:
+    stmts = node_or_stmts if isinstance(node_or_stmts, list) else [node_or_stmts]
+    for s in stmts:
+        for sub in ast.walk(s):
+            if isinstance(sub, types):
+                return True
+    return False
+
+
+def _rewrite_return_ifs(stmts):
+    """Early-return ``if`` -> continuation closures (the SOT guard
+    pattern, ref jit/sot opcode_executor.py:305,1594 — the bytecode
+    tracer splits at the branch and resumes after it; here the split is
+    at statement level: the if-body becomes the true continuation and
+    everything AFTER the if — else-branch plus the function tail —
+    becomes the false continuation, selected by convert_ret_ifelse).
+
+    Applied only where control flow is total: the if-body's last
+    statement is ``return <expr>``, and the remainder also ends in
+    ``return <expr>``. Recurses into both continuations, so chains of
+    guards convert. Statements after a converted if are consumed by its
+    false continuation."""
+    out = []
+    for i, node in enumerate(stmts):
+        if (
+            isinstance(node, ast.If)
+            and node.body
+            and isinstance(node.body[-1], ast.Return)
+            and node.body[-1].value is not None
+            and not _contains(node.body + node.orelse + stmts[i + 1:],
+                              (ast.Yield, ast.YieldFrom, ast.Await,
+                               ast.AsyncFor, ast.AsyncWith))
+        ):
+            rest = node.orelse + stmts[i + 1:]
+            if not (rest and isinstance(rest[-1], ast.Return)
+                    and rest[-1].value is not None):
+                out.append(node)
+                continue
+            t_body, _ = _rewrite_return_ifs(list(node.body))
+            f_body, _ = _rewrite_return_ifs(list(rest))
+            uid = next(_ret_uid)
+            tname, fname = f"_pt_rt_true_{uid}", f"_pt_rt_false_{uid}"
+
+            def mk(nm, body, uid_tag):
+                # names a continuation ASSIGNS become parameters seeded
+                # by default args (evaluated at def time, after the init
+                # try/excepts below): a continuation that reads-then-
+                # shadows a pre-if binding (y = y + 1) would otherwise
+                # hit UnboundLocalError — the same hazard visit_If
+                # solves with explicit init args
+                assigned, has_del = _assigned_names(body)
+                if has_del:
+                    return None, []
+                inits, init_names = _init_stmts(assigned, uid_tag)
+                args = ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=n) for n in assigned],
+                    vararg=None, kwonlyargs=[], kw_defaults=[],
+                    kwarg=None,
+                    defaults=[_name(n) for n in init_names],
+                )
+                fn = ast.FunctionDef(
+                    name=nm, args=args, body=body,
+                    decorator_list=[], returns=None, type_comment=None,
+                    type_params=[],
+                )
+                return fn, inits
+
+            t_fn, t_inits = mk(tname, t_body, f"{uid}t")
+            f_fn, f_inits = mk(fname, f_body, f"{uid}f")
+            if t_fn is None or f_fn is None:  # del inside: leave as-is
+                out.append(node)
+                continue
+            call = ast.Return(value=ast.Call(
+                func=ast.Attribute(value=_name(_RUNTIME_NAME),
+                                   attr="convert_ret_ifelse", ctx=ast.Load()),
+                args=[node.test, _name(tname), _name(fname)], keywords=[],
+            ))
+            out.extend([*t_inits, *f_inits, t_fn, f_fn, call])
+            return out, True
+        out.append(node)
+    return out, False
+
+
+_ret_uid = iter(range(1, 1 << 30))
+
+
 class _Transformer(ast.NodeTransformer):
     def __init__(self):
         self.changed = False
@@ -816,9 +938,13 @@ def _compile_transform(fn):
         if not isinstance(fndef, (ast.FunctionDef, ast.AsyncFunctionDef)):
             return None
         fndef.decorator_list = []
+        # pass 1: early-return ifs -> continuation closures (must run
+        # before the main transformer so loops/ifs inside the generated
+        # continuations get converted too)
+        fndef.body, ret_changed = _rewrite_return_ifs(list(fndef.body))
         tr = _Transformer()
         tree = tr.visit(tree)
-        if not tr.changed or tr._blocked:
+        if (not tr.changed and not ret_changed) or tr._blocked:
             return None
         ast.fix_missing_locations(tree)
         filename = f"<dy2static:{inspect.getsourcefile(fn) or '?'}>"
@@ -862,9 +988,10 @@ def graph_break_error(exc: BaseException) -> RuntimeError:
     return RuntimeError(
         "to_static: tensor-dependent Python control flow (or another "
         f"bool()/int()/numpy() concretization) reached under trace{where}. "
-        "`if`/`while` in the entry function are "
-        "converted automatically; this one could not be (helper function, "
-        "or a branch containing return/break/continue). Options: apply "
+        "`if`/`while`/`for range()` and early-return `if` chains in the "
+        "entry function are converted automatically; this one could not "
+        "be (helper function, break/continue escaping a converted "
+        "region, or mixed return/fallthrough paths). Options: apply "
         "paddle_tpu.jit.dy2static.convert to the helper; rewrite with "
         "paddle.where / a converted-friendly loop; or mark the function "
         "@paddle.jit.not_to_static to run it eagerly."

@@ -142,8 +142,12 @@ class TestGraphBreakError:
 
     def test_error_names_options(self):
         def f(x):
-            if x.sum() > 0:   # return inside branch -> not converted
-                return x * 2.0
+            # break inside a tensor-while -> not convertible; the traced
+            # predicate must raise the actionable graph-break error
+            while x.sum() > 0:
+                x = x - 1.0
+                if x.max() > 100:
+                    break
             return x
 
         sf = pjit.to_static(f)
@@ -570,3 +574,105 @@ class TestReviewEdgeCases:
         sf = pjit.to_static(f)
         out = sf(paddle.to_tensor(np.ones((2,), np.float32)))
         assert float(out.sum()) == 2.0
+
+
+class TestEarlyReturnIf:
+    """SOT-gap closure (ref: jit/sot opcode_executor.py:305,1594 —
+    resume-after-branch): the guard pattern `if p: return a ... return b`
+    converts by making the function tail the false continuation."""
+
+    def test_guard_pattern_converts(self):
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0
+            return x * 3.0
+
+        sf = pjit.to_static(f)
+        np.testing.assert_allclose(
+            sf(paddle.to_tensor(np.array([1.0], np.float32))).numpy(), [2.0])
+        np.testing.assert_allclose(
+            sf(paddle.to_tensor(np.array([-1.0], np.float32))).numpy(), [-3.0])
+
+    def test_chained_guards(self):
+        def f(x):
+            if x.sum() > 10:
+                return x * 100.0
+            if x.sum() > 0:
+                y = x + 1.0
+                return y * 2.0
+            return -x
+
+        sf = pjit.to_static(f)
+        for v, want in ((20.0, 2000.0), (1.0, 4.0), (-5.0, 5.0)):
+            got = float(sf(paddle.to_tensor(np.array([v], np.float32)))[0])
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_tuple_returns_and_else(self):
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0, x + 1.0
+            else:
+                return x * 3.0, x - 1.0
+
+        a, b = pjit.to_static(f)(paddle.to_tensor(np.array([2.0], np.float32)))
+        np.testing.assert_allclose(a.numpy(), [4.0])
+        np.testing.assert_allclose(b.numpy(), [3.0])
+
+    def test_structure_mismatch_raises(self):
+        from paddle_tpu.jit import dy2static
+
+        def f(x):
+            if x.sum() > 0:
+                return x, x
+            return x
+
+        conv = dy2static.convert(f)
+        import jax
+
+        with pytest.raises(Exception, match="STRUCTURE|structure"):
+            jax.jit(
+                lambda v: conv(paddle.to_tensor(v))
+            )(np.array([1.0], np.float32))
+
+    def test_concrete_predicate_unchanged(self):
+        from paddle_tpu.jit import dy2static
+
+        def f(x, flag):
+            if flag:
+                return x * 2.0
+            return x * 5.0
+
+        conv = dy2static.convert(f)
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(conv(x, True).numpy(), [2.0])
+        np.testing.assert_allclose(conv(x, False).numpy(), [5.0])
+
+    def test_shadowing_continuation_reads_pre_if_binding(self):
+        """A continuation that reads-then-assigns a pre-if variable
+        (y = y + 1) must see the incoming binding, not UnboundLocal."""
+
+        def f(x):
+            y = x * 2.0
+            if x.sum() > 0:
+                y = y + 1.0
+                return y
+            y = y - 1.0
+            return y
+
+        sf = pjit.to_static(f)
+        np.testing.assert_allclose(
+            sf(paddle.to_tensor(np.array([1.0], np.float32))).numpy(), [3.0])
+        np.testing.assert_allclose(
+            sf(paddle.to_tensor(np.array([-1.0], np.float32))).numpy(), [-3.0])
+
+    def test_generator_functions_left_alone(self):
+        from paddle_tpu.jit import dy2static
+        import inspect
+
+        def g(x):
+            if x > 0:
+                return x
+            yield x
+
+        conv = dy2static.convert(g)
+        assert inspect.isgeneratorfunction(conv)
