@@ -1,3 +1,11 @@
-from .auto_checkpoint import AutoCheckpoint, ELASTIC_AUTO_CHECKPOINT_DIR  # noqa: F401
+from .auto_checkpoint import (  # noqa: F401
+    ELASTIC_AUTO_CHECKPOINT_DIR,
+    AutoCheckpoint,
+    TrainEpochRange,
+    train_epoch_range,
+)
 
-__all__ = ["AutoCheckpoint", "ELASTIC_AUTO_CHECKPOINT_DIR"]
+__all__ = [
+    "AutoCheckpoint", "ELASTIC_AUTO_CHECKPOINT_DIR",
+    "TrainEpochRange", "train_epoch_range",
+]

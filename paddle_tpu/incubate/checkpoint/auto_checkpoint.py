@@ -233,3 +233,61 @@ class AutoCheckpoint:
                 self._set_extra_state(state["extra"])
             return step + 1
         return 0
+
+
+class TrainEpochRange:
+    """Epoch-range auto-checkpointing (ref: base/incubate/checkpoint/
+    auto_checkpoint.py:615 TrainEpochRange / the ``acp.train_epoch_range``
+    loop idiom): iterate it instead of ``range(max_epoch)`` and every
+    completed epoch checkpoints; after an elastic relaunch iteration
+    resumes at the first UNFINISHED epoch.
+
+    The reference hooks executor state implicitly; this runtime has no
+    global executor, so the tracked layers/optimizers are passed
+    explicitly::
+
+        for epoch in train_epoch_range(10, "ckpts", layers=[model],
+                                       optimizers=[opt]):
+            ...train one epoch...
+    """
+
+    def __init__(self, max_epoch_num: int, directory: Optional[str] = None,
+                 layers: Sequence = (), optimizers: Sequence = (),
+                 keep_last_k: int = 3, async_save: bool = True,
+                 extra_state=None, set_extra_state=None):
+        self._max = int(max_epoch_num)
+        self._ac = AutoCheckpoint(
+            directory, layers=layers, optimizers=optimizers,
+            save_interval_steps=1, keep_last_k=keep_last_k,
+            async_save=async_save, extra_state=extra_state,
+            set_extra_state=set_extra_state,
+        )
+        self._start = self._ac.resume()
+
+    @property
+    def start_epoch(self) -> int:
+        """The first epoch the NEXT iteration will run (advances as
+        epochs complete, so re-iterating resumes instead of repeating)."""
+        return self._start
+
+    def __iter__(self):
+        try:
+            while self._start < self._max:
+                epoch = self._start
+                yield epoch
+                # only a COMPLETED epoch checkpoints (a break/exception
+                # inside the epoch must not mark it finished)
+                self._ac.save_now(epoch)
+                self._start = epoch + 1
+        finally:
+            # drain (and surface errors from) the in-flight async save
+            # even when the caller breaks out early
+            self._ac.wait()
+
+
+def train_epoch_range(max_epoch_num: int, directory: Optional[str] = None,
+                      layers: Sequence = (), optimizers: Sequence = (),
+                      **kw) -> TrainEpochRange:
+    """ref: acp.train_epoch_range — see TrainEpochRange."""
+    return TrainEpochRange(max_epoch_num, directory, layers=layers,
+                           optimizers=optimizers, **kw)

@@ -337,3 +337,44 @@ class TestMultiPrecisionRestoreOrder:
             np.asarray(o2._accumulators["moment1"][live[0]]),
             np.asarray(getattr(sd[f"{live[0]}.moment1"], "_data",
                                sd[f"{live[0]}.moment1"])))
+
+
+class TestTrainEpochRange:
+    """ref: auto_checkpoint.py:615 — epoch-range iteration resumes at
+    the first unfinished epoch after a restart."""
+
+    def test_resume_at_unfinished_epoch(self, tmp_path):
+        from paddle_tpu.incubate.checkpoint import train_epoch_range
+
+        paddle.seed(0)
+        model = nn.Linear(4, 3)
+        optimizer = opt.AdamW(learning_rate=0.01,
+                              parameters=model.parameters())
+        seen = []
+        w_after1 = None
+        r = train_epoch_range(5, str(tmp_path), layers=[model],
+                              optimizers=[optimizer], async_save=False)
+        for epoch in r:
+            if epoch == 2:
+                # crash before epoch 2 trains: 0 and 1 are checkpointed
+                w_after1 = np.asarray(model.weight._data).copy()
+                break
+            seen.append(epoch)
+            _train_steps(model, optimizer,
+                         type("N", (), {"step": staticmethod(lambda s: None)}),
+                         epoch * 3, 3)
+        assert seen == [0, 1]
+
+        model2 = nn.Linear(4, 3)
+        opt2 = opt.AdamW(learning_rate=0.01, parameters=model2.parameters())
+        r2 = train_epoch_range(5, str(tmp_path), layers=[model2],
+                               optimizers=[opt2], async_save=False)
+        # epochs 0 and 1 completed (checkpointed); resume at 2, and the
+        # restored weights equal the first run's state after epoch 1
+        assert r2.start_epoch == 2
+        np.testing.assert_allclose(
+            np.asarray(model2.weight._data), w_after1, rtol=1e-6)
+        assert list(r2) == [2, 3, 4]
+        # iterating again resumes past the completed epochs (no repeat)
+        assert list(r2) == []
+        assert r2.start_epoch == 5
