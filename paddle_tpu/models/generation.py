@@ -273,7 +273,7 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     switches to the paged (block-table) KV cache — same tokens, pool
     memory layout (ref: block_multihead_attention); the model's
     ``init_cache`` must accept ``block_size`` and its attention must
-    handle PagedLayerCache (LlamaForCausalLM does; GPT is dense-only).
+    handle PagedLayerCache (LlamaForCausalLM and GPTForCausalLM do).
 
     ``decode_chunk=K`` scans K decode steps inside ONE device dispatch
     (lax.scan over the compiled step; token + eos state carried on

@@ -86,6 +86,30 @@ class GPTAttention(nn.Layer):
             return self.out_proj(M.reshape(out, [b, s, h]))
 
         from ..base.tape import apply
+        from ..ops.paged_attention import PagedLayerCache
+
+        if isinstance(cache, PagedLayerCache):
+            from ..ops.paged_attention import paged_attention_step
+
+            if self.training and self.dropout > 0 and s == 1:
+                raise ValueError(
+                    "the paged KV decode path has no attention-probability "
+                    "dropout (the dense cache path does) — call "
+                    "model.eval() before paged-cache generation"
+                )
+            if s == 1:
+                out, new_cache = paged_attention_step(
+                    q, k, v, cache, cur_len, 1)
+                return self.out_proj(M.reshape(out, [b, s, h])), new_cache
+
+            q, kc, vc, mask, new_cache = paged_attention_step(
+                q, k, v, cache, cur_len, s)
+            out = F.scaled_dot_product_attention(
+                q, kc, vc, attn_mask=mask, is_causal=False,
+                dropout_p=self.dropout, training=self.training,
+            )
+            return self.out_proj(M.reshape(out, [b, s, h])), new_cache
+
         from .generation import update_kv_cache
 
         k_cache, v_cache = cache
@@ -174,14 +198,27 @@ class GPTForCausalLM(nn.Layer):
     def forward(self, input_ids):
         return self.lm_head(self.transformer(input_ids))
 
-    def init_cache(self, batch: int, max_len: int, dtype=None):
+    def init_cache(self, batch: int, max_len: int, dtype=None,
+                   block_size=None, num_blocks=None, tables=None):
+        """Dense caches by default; ``block_size`` switches to the paged
+        (block-table) layout (ops/paged_attention.py) — same protocol as
+        LlamaForCausalLM.init_cache."""
+        c = self.config
+        dt = dtype or self.transformer.wte.weight.dtype
+        head_dim = c.hidden_size // c.num_attention_heads
+        if block_size is not None:
+            from ..ops.paged_attention import alloc_paged_kv_caches
+
+            return alloc_paged_kv_caches(
+                c.num_hidden_layers, batch, max_len, c.num_attention_heads,
+                head_dim, dt, block_size=block_size, num_blocks=num_blocks,
+                tables=tables,
+            )
         from .generation import alloc_kv_caches
 
-        c = self.config
         return alloc_kv_caches(
             c.num_hidden_layers, batch, max_len, c.num_attention_heads,
-            c.hidden_size // c.num_attention_heads,
-            dtype or self.transformer.wte.weight.dtype,
+            head_dim, dt,
         )
 
     def forward_with_cache(self, input_ids, caches, cur_len):

@@ -121,56 +121,29 @@ class LlamaAttention(nn.Layer):
         from ..ops.paged_attention import PagedLayerCache
 
         if isinstance(cache, PagedLayerCache):
-            contiguous = bool(getattr(cache, "contiguous", False))
+            from ..ops.paged_attention import paged_attention_step
+
+            rope_fn = lambda qq, kk, cl: _rope(  # noqa: E731
+                qq, kk, theta, cl.astype(jnp.float32))
             if s == 1:
                 # decode: contiguous tables take the reshape-view XLA
                 # path; ragged tables run the Pallas paged-attention
                 # kernel (no padded-view gather either way)
-                def pstep_decode(qq, kk, vv, kp, vp, tbl, cl):
-                    from ..ops.paged_attention import (
-                        paged_decode_attention,
-                        paged_write_kv,
-                    )
-
-                    qq, kk = _rope(qq, kk, theta, cl.astype(jnp.float32))
-                    kp, vp = paged_write_kv(kk, vv, kp, vp, tbl, cl, 1)
-                    out = paged_decode_attention(
-                        qq, kp, vp, tbl, cl, contiguous=contiguous
-                    )
-                    return out, kp, vp
-
-                out, k_pool, v_pool = apply(
-                    pstep_decode, q, k, v, cache.k_pool, cache.v_pool,
-                    cache.block_tables, cur_len, op_name="paged_decode",
-                )
+                out, new_cache = paged_attention_step(
+                    q, k, v, cache, cur_len, 1, rope_fn=rope_fn)
                 out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
-                return self.o_proj(out), PagedLayerCache(
-                    k_pool, v_pool, cache.block_tables, contiguous
-                )
+                return self.o_proj(out), new_cache
 
             # prefill: scatter into pools, attend over the gathered
             # view — token-for-token identical to dense
-            def pstep(qq, kk, vv, kp, vp, tbl, cl):
-                from ..ops.paged_attention import paged_update_kv_cache
-
-                qq, kk = _rope(qq, kk, theta, cl.astype(jnp.float32))
-                kp, vp, kc, vc, mask = paged_update_kv_cache(
-                    kk, vv, kp, vp, tbl, cl, s, contiguous=contiguous
-                )
-                return qq, kp, vp, kc, vc, mask
-
-            q, k_pool, v_pool, kc, vc, mask = apply(
-                pstep, q, k, v, cache.k_pool, cache.v_pool,
-                cache.block_tables, cur_len, op_name="paged_kv_cache_update",
-            )
+            q, kc, vc, mask, new_cache = paged_attention_step(
+                q, k, v, cache, cur_len, s, rope_fn=rope_fn)
             out = F.scaled_dot_product_attention(
                 q, kc, vc, attn_mask=mask, is_causal=False,
                 training=self.training,
             )
             out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
-            return self.o_proj(out), PagedLayerCache(
-                k_pool, v_pool, cache.block_tables, contiguous
-            )
+            return self.o_proj(out), new_cache
 
         k_cache, v_cache = cache
 

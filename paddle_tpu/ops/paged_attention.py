@@ -222,6 +222,62 @@ def paged_gather_kv(k_pool, v_pool, tables, contiguous: bool = False):
     return kc, vc
 
 
+def paged_attention_step(q, k, v, cache: "PagedLayerCache", cur_len, s: int,
+                         rope_fn=None):
+    """Shared model-side paged-cache step (used by LlamaAttention and
+    GPTAttention — ONE copy of the tape plumbing, so protocol changes
+    land in one place).
+
+    q/k/v: [B, s, H|kvh, D] Tensors. ``rope_fn(qq, kk, cl) -> (qq, kk)``
+    applies positional rotation inside the traced step (None for
+    absolute-position models).
+
+    Returns:
+    - decode (s == 1): ``(out, new_cache)`` where ``out`` is the
+      attention output [B, 1, H, D] (path policy per
+      paged_decode_attention — note no attention-probability dropout
+      exists on this path; callers must enforce eval semantics);
+    - prefill (s > 1): ``(q_t, kc, vc, mask, new_cache)`` — the caller
+      runs its own SDPA (dropout and all) over the gathered view.
+    """
+    from ..base.tape import apply
+
+    contiguous = bool(getattr(cache, "contiguous", False))
+    if s == 1:
+        def pstep_decode(qq, kk, vv, kp, vp, tbl, cl):
+            if rope_fn is not None:
+                qq, kk = rope_fn(qq, kk, cl)
+            kp, vp = paged_write_kv(kk, vv, kp, vp, tbl, cl, 1)
+            out = paged_decode_attention(
+                qq, kp, vp, tbl, cl, contiguous=contiguous
+            )
+            return out, kp, vp
+
+        out, k_pool, v_pool = apply(
+            pstep_decode, q, k, v, cache.k_pool, cache.v_pool,
+            cache.block_tables, cur_len, op_name="paged_decode",
+        )
+        return out, PagedLayerCache(
+            k_pool, v_pool, cache.block_tables, contiguous
+        )
+
+    def pstep(qq, kk, vv, kp, vp, tbl, cl):
+        if rope_fn is not None:
+            qq, kk = rope_fn(qq, kk, cl)
+        kp, vp, kc, vc, mask = paged_update_kv_cache(
+            kk, vv, kp, vp, tbl, cl, s, contiguous=contiguous
+        )
+        return qq, kp, vp, kc, vc, mask
+
+    q_t, k_pool, v_pool, kc, vc, mask = apply(
+        pstep, q, k, v, cache.k_pool, cache.v_pool,
+        cache.block_tables, cur_len, op_name="paged_kv_cache_update",
+    )
+    return q_t, kc, vc, mask, PagedLayerCache(
+        k_pool, v_pool, cache.block_tables, contiguous
+    )
+
+
 def _largest_divisor(n: int, cap: int) -> int:
     for c in range(min(cap, n), 0, -1):
         if n % c == 0:

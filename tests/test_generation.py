@@ -138,3 +138,24 @@ class TestChunkedDecode:
         got = generate(m, ids, max_new_tokens=10, temperature=0.0,
                        decode_chunk=64)  # chunk > remaining tokens
         np.testing.assert_array_equal(ref.numpy(), got.numpy())
+
+
+class TestGPTPagedCache:
+    def test_gpt_paged_matches_dense(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        paddle.seed(9)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32,
+                        intermediate_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, max_position_embeddings=64)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        rng = np.random.RandomState(4)
+        ids = paddle.to_tensor(rng.randint(0, 128, (2, 7)).astype(np.int64))
+        ref = generate(m, ids, max_new_tokens=9, temperature=0.0)
+        got = generate(m, ids, max_new_tokens=9, temperature=0.0,
+                       block_size=8)
+        np.testing.assert_array_equal(ref.numpy(), got.numpy())
+        chunked = generate(m, ids, max_new_tokens=9, temperature=0.0,
+                           block_size=8, decode_chunk=4)
+        np.testing.assert_array_equal(ref.numpy(), chunked.numpy())
