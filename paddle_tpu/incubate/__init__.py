@@ -30,3 +30,4 @@ from .ops import (  # noqa: F401
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import autograd  # noqa: F401

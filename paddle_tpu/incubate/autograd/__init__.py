@@ -1,0 +1,313 @@
+"""paddle_tpu.incubate.autograd — functional autodiff (beta surface).
+
+ref: python/paddle/incubate/autograd/__init__.py (vjp/jvp/Jacobian/
+Hessian in functional.py:22,80,170,257; forward_grad/grad in
+primapi.py:25,116; prim toggles in utils.py:39,73,99).
+
+The reference implements these twice: an eager path over double-backward
+and a "primitive operator" static path (primx.py program transforms).
+Here both collapse into jax's functional transforms — the user function
+already executes as jax primitives through the tape, so ``vjp``/``jvp``/
+``Jacobian``/``Hessian`` wrap it into a pure array function and apply
+``jax.vjp``/``jax.jvp``/``jax.jacrev`` directly. ``forward_grad`` over
+already-recorded eager outputs uses the double-vjp identity (forward
+mode from two reverse passes) on the tape.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...base.tensor import Tensor
+
+__all__ = [
+    "vjp",
+    "jvp",
+    "Jacobian",
+    "Hessian",
+    "enable_prim",
+    "disable_prim",
+    "prim_enabled",
+    "forward_grad",
+    "grad",
+]
+
+
+def _as_list(xs):
+    return [xs] if isinstance(xs, Tensor) else list(xs)
+
+
+def _wrap(arrs):
+    return [Tensor(a, stop_gradient=False, _internal=True) for a in arrs]
+
+
+def _pure(func, n_in):
+    """func over Tensors -> pure fn over arrays (single out stays single)."""
+
+    def pure(*arrs):
+        outs = func(*_wrap(arrs[:n_in]))
+        if isinstance(outs, Tensor):
+            return outs._data
+        return tuple(o._data for o in outs)
+
+    return pure
+
+
+def _match_v(v, ys_arrays, what):
+    """Default cotangent/tangent of all-ones; validate shapes."""
+    single = not isinstance(ys_arrays, tuple)
+    leaves = (ys_arrays,) if single else ys_arrays
+    if v is None:
+        vs = tuple(jnp.ones_like(a) for a in leaves)
+    else:
+        vs = tuple(
+            t._data if isinstance(t, Tensor) else jnp.asarray(t)
+            for t in ([v] if isinstance(v, Tensor) else list(v))
+        )
+        if len(vs) != len(leaves):
+            raise ValueError(
+                f"{what}: v has {len(vs)} tensors but func returned "
+                f"{len(leaves)}"
+            )
+        for got, want in zip(vs, leaves):
+            if tuple(got.shape) != tuple(want.shape):
+                raise ValueError(
+                    f"{what}: v shape {tuple(got.shape)} does not match "
+                    f"output shape {tuple(want.shape)}"
+                )
+    return vs[0] if single else vs
+
+
+def vjp(func, xs, v=None):
+    """Vector-Jacobian product (ref: functional.py:22).
+
+    Returns ``(func_out, vjp_result)``; ``v`` defaults to all ones of
+    the output shape. Single-tensor inputs/outputs stay single.
+    """
+    xs_list = _as_list(xs)
+    pure = _pure(func, len(xs_list))
+    ys, pullback = jax.vjp(pure, *[x._data for x in xs_list])
+    cot = _match_v(v, ys, "vjp")
+    gxs = pullback(cot)
+    outs = (
+        Tensor(ys, stop_gradient=False, _internal=True)
+        if not isinstance(ys, tuple)
+        else tuple(Tensor(y, stop_gradient=False, _internal=True) for y in ys)
+    )
+    grads = tuple(Tensor(g, stop_gradient=False, _internal=True) for g in gxs)
+    return outs, grads[0] if isinstance(xs, Tensor) else grads
+
+
+def jvp(func, xs, v=None):
+    """Jacobian-vector product, forward mode (ref: functional.py:80)."""
+    xs_list = _as_list(xs)
+    pure = _pure(func, len(xs_list))
+    primals = tuple(x._data for x in xs_list)
+    if v is None:
+        tangents = tuple(jnp.ones_like(p) for p in primals)
+    else:
+        vs = [v] if isinstance(v, Tensor) else list(v)
+        if len(vs) != len(primals):
+            raise ValueError(
+                f"jvp: v has {len(vs)} tensors but func takes {len(primals)}"
+            )
+        tangents = tuple(
+            (t._data if isinstance(t, Tensor) else jnp.asarray(t)).astype(p.dtype)
+            for t, p in zip(vs, primals)
+        )
+    ys, dys = jax.jvp(pure, primals, tangents)
+    wrap = lambda a: Tensor(a, stop_gradient=False, _internal=True)  # noqa: E731
+    outs = wrap(ys) if not isinstance(ys, tuple) else tuple(map(wrap, ys))
+    douts = wrap(dys) if not isinstance(dys, tuple) else tuple(map(wrap, dys))
+    return outs, douts
+
+
+class Jacobian:
+    """Dense Jacobian of ``func`` at ``xs`` with flatten-and-concat
+    semantics (ref: functional.py:170): multiple inputs/outputs are
+    flattened (batch axis retained when ``is_batched``) and concatenated,
+    giving a ``[M, N]`` (or ``[B, M, N]``) matrix indexable like a
+    tensor. Evaluated on first access and cached (the reference
+    evaluates lazily by row; one XLA call for the whole matrix is the
+    TPU-friendly shape of the same contract)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = _as_list(xs)
+        self._batched = bool(is_batched)
+        self._mat = None
+        self._shape = None
+
+    # -- flatten plumbing ------------------------------------------------
+    def _split_sizes(self):
+        drop = 1 if self._batched else 0
+        return [
+            int(np.prod(tuple(x.shape)[drop:]) or 1) for x in self._xs
+        ]
+
+    def _flat_fn(self):
+        sizes = self._split_sizes()
+        shapes = [tuple(x.shape) for x in self._xs]
+        func, batched = self._func, self._batched
+
+        def fn(z):  # z: [N] (one sample's flattened, concatenated inputs)
+            pieces, off = [], 0
+            for size, shp in zip(sizes, shapes):
+                tail = shp[1:] if batched else shp
+                pieces.append(z[off : off + size].reshape(tail)[None] if batched
+                              else z[off : off + size].reshape(tail))
+                off += size
+            outs = func(*_wrap(pieces))
+            leaves = [outs] if isinstance(outs, Tensor) else list(outs)
+            flat = [
+                (o._data[0] if batched else o._data).reshape(-1) for o in leaves
+            ]
+            return jnp.concatenate(flat) if len(flat) > 1 else flat[0]
+
+        return fn
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        fn = self._flat_fn()
+        if self._batched:
+            rows = jnp.concatenate(
+                [x._data.reshape(x._data.shape[0], -1) for x in self._xs], axis=1
+            )
+            mat = jax.vmap(jax.jacrev(fn))(rows)  # [B, M, N]
+        else:
+            z = jnp.concatenate([x._data.reshape(-1) for x in self._xs])
+            mat = jax.jacrev(fn)(z)  # [M, N]
+        self._mat = mat
+        return mat
+
+    @property
+    def shape(self):
+        if self._shape is None:
+            fn = self._flat_fn()
+            n = sum(self._split_sizes())
+            if self._batched:
+                b = int(self._xs[0].shape[0])
+                out = jax.eval_shape(fn, jax.ShapeDtypeStruct((n,), jnp.float32))
+                self._shape = (b, int(out.shape[0]), n)
+            else:
+                out = jax.eval_shape(fn, jax.ShapeDtypeStruct((n,), jnp.float32))
+                self._shape = (int(out.shape[0]), n)
+        return self._shape
+
+    def __getitem__(self, indexes):
+        return Tensor(self._compute()[indexes], stop_gradient=False,
+                      _internal=True)
+
+
+class Hessian:
+    """Dense Hessian of a scalar-valued ``func`` (ref: functional.py:257):
+    ``[N, N]``, or ``[B, N, N]`` when ``is_batched`` (output ``[B, 1]``)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        def grad_fn(*inner_xs):
+            _, g = vjp(func, inner_xs if len(inner_xs) > 1 else inner_xs[0])
+            return g
+
+        self.symbolic = Jacobian(grad_fn, xs, is_batched=is_batched)
+
+    @property
+    def shape(self):
+        return self.symbolic.shape
+
+    def __getitem__(self, indexes):
+        return self.symbolic[indexes]
+
+
+# -- tape-level forward/reverse over recorded outputs -----------------------
+
+_prim_state = [True]
+
+
+def prim_enabled():
+    """ref: utils.py:39. In this framework every op is already lowered
+    to jax/XLA primitives, so primitive mode is the only mode; the
+    toggle is retained for API compatibility."""
+    return _prim_state[0]
+
+
+def enable_prim():
+    _prim_state[0] = True
+
+
+def disable_prim():
+    _prim_state[0] = False
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Reverse-mode grad over recorded eager outputs (ref: primapi.py:116;
+    the static prim rewrite collapses into the tape's vjp here)."""
+    from ...autograd import grad as _eager_grad
+
+    outs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    if grad_outputs is None:
+        # ref contract: None is equivalent to all ones (including for
+        # non-scalar outputs, where the eager API would refuse)
+        grad_outputs = [
+            Tensor(jnp.ones_like(o._data), _internal=True) for o in outs
+        ]
+    else:
+        if isinstance(grad_outputs, Tensor):
+            grad_outputs = [grad_outputs]
+        if len(grad_outputs) != len(outs):
+            raise ValueError(
+                f"grad: grad_outputs has {len(grad_outputs)} tensors but "
+                f"outputs has {len(outs)}"
+            )
+    res = _eager_grad(outs, inputs, grad_outputs=grad_outputs,
+                      retain_graph=True, allow_unused=True)
+    return res[0] if isinstance(inputs, Tensor) else res
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode grad over recorded eager outputs (ref: primapi.py:25).
+
+    Uses the double-vjp identity: with ``h(u) = <vjp_xs(u), v>`` (linear
+    in the cotangent ``u``), ``d h / d u = J v`` — two reverse passes
+    over the tape give the forward-mode result, so this works on the
+    eager tape where the reference needs the static prim program pass.
+    """
+    from ...autograd import grad as _eager_grad
+
+    single = isinstance(outputs, Tensor)
+    outs = [outputs] if single else list(outputs)
+    ins = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_inputs is None:
+        vs = [Tensor(jnp.ones_like(i._data), _internal=True) for i in ins]
+    else:
+        vs = [grad_inputs] if isinstance(grad_inputs, Tensor) else list(grad_inputs)
+        if len(vs) != len(ins):
+            raise ValueError(
+                f"forward_grad: grad_inputs has {len(vs)} tensors but "
+                f"inputs has {len(ins)}"
+            )
+    # u must participate in the graph: seed the first vjp with a
+    # differentiable all-ones cotangent per output
+    us = [
+        Tensor(jnp.ones_like(o._data), stop_gradient=False, _internal=True)
+        for o in outs
+    ]
+    gxs = _eager_grad(outs, ins, grad_outputs=us, retain_graph=True,
+                      create_graph=True, allow_unused=True)
+    h = None
+    for g, v in zip(gxs, vs):
+        if g is None:
+            continue
+        term = (g * v).sum()
+        h = term if h is None else h + term
+    if h is None:
+        raise RuntimeError("forward_grad: outputs do not depend on inputs")
+    jvps = _eager_grad([h], us, retain_graph=True, allow_unused=True)
+    res = [
+        Tensor(jnp.zeros_like(o._data), _internal=True) if g is None else g
+        for g, o in zip(jvps, outs)
+    ]
+    return res[0] if single else res

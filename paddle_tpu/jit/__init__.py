@@ -71,6 +71,7 @@ class StaticFunction:
         state_shardings=None,
         in_shardings=None,
         static_argnums: Tuple[int, ...] = (),
+        full_graph: bool = True,
     ):
         functools.update_wrapper(self, fn, updated=[])
         from ..nn.layer.layers import Layer
@@ -101,6 +102,10 @@ class StaticFunction:
         # optimizers whose step() actually ran in the traced step (set
         # during tracing); only these get host-side step corrections
         self._stepped_optimizers: List[Any] = []
+        # full_graph=False: a graph break demotes this function to
+        # piecewise eager execution instead of raising (SOT semantics)
+        self._full_graph = bool(full_graph)
+        self._fallback_eager = False
 
     # -- discovery ------------------------------------------------------
     def _auto_discover(self, fn):
@@ -238,7 +243,7 @@ class StaticFunction:
                 try:
                     out = self._fn(*args, **kwargs)
                 except (
-                    jax.errors.TracerBoolConversionError,
+                    jax.errors.ConcretizationTypeError,  # incl. bool conv
                     jax.errors.TracerArrayConversionError,
                     jax.errors.TracerIntegerConversionError,
                 ) as e:
@@ -265,8 +270,8 @@ class StaticFunction:
 
     # -- call -----------------------------------------------------------
     def __call__(self, *args, **kwargs):
-        if not _jit_enabled[0]:
-            return self._fn(*args, **kwargs)
+        if not _jit_enabled[0] or self._fallback_eager:
+            return self._orig_fn(*args, **kwargs)
         if self._needs_discovery:
             self._auto_discover(self._orig_fn)
             self._needs_discovery = False
@@ -288,7 +293,33 @@ class StaticFunction:
             jitted = jax.jit(pure, **jit_kwargs)
             self._jit_cache[arg_treedef] = jitted
         runs_before = self._pure_runs
-        out_arrays, new_state = jitted(state, lrs, flat_arrays)
+        steps_before = [o._global_step for o in self._optimizers]
+        try:
+            out_arrays, new_state = jitted(state, lrs, flat_arrays)
+        except dy2static.GraphBreakError as e:
+            if self._full_graph:
+                raise
+            # SOT semantics (ref jit/sot opcode_executor.py:305,1594):
+            # a graph break demotes the function to piecewise eager
+            # execution — every op still runs XLA-compiled through the
+            # tape's per-op dispatch, but forward/backward/optimizer are
+            # no longer fused into one program. The failed trace wrote
+            # tracers into the threaded state; roll it back first.
+            self._write_state(state)
+            self._sanitize_grads()
+            for o, s0 in zip(self._optimizers, steps_before):
+                o._global_step = s0
+            import warnings
+
+            warnings.warn(
+                "to_static(full_graph=False): graph break — falling back "
+                f"to piecewise eager execution for "
+                f"{getattr(self._orig_fn, '__qualname__', self._orig_fn)}. "
+                f"Reason: {e}",
+                stacklevel=2,
+            )
+            self._fallback_eager = True
+            return self._orig_fn(*args, **kwargs)
         trace_runs = self._pure_runs - runs_before
         self._last_lowered = jitted
         self._write_state(new_state)
@@ -333,6 +364,12 @@ class StaticFunction:
 
         Returns the K-stacked outputs.
         """
+        if self._fallback_eager:
+            raise RuntimeError(
+                "multi_step requires full-graph capture, but this "
+                "function fell back to eager after a graph break "
+                "(full_graph=False); fix the break or use full_graph=True"
+            )
         if not self._cells:
             raise RuntimeError(
                 "multi_step requires one regular call first (to create "
@@ -431,16 +468,27 @@ def to_static(
       step; layer params, optimizer state and RNG are threaded and
       donated automatically. If not given, Layers/Optimizers are
       auto-discovered from the function closure.
+    - ``full_graph`` (ref: jit/api.py:271 — True selects the AST
+      whole-graph translator, False the SOT bytecode tracer with
+      graph-break fallback): True (default) raises an actionable error
+      on an unconvertible construct; False demotes the function to
+      piecewise eager execution at the first graph break — each op
+      still runs XLA-compiled via the tape's per-op dispatch (the
+      limit case of SOT's subgraph stitching), with fusion/donation/
+      ``multi_step`` forfeited. The fallback is per-function and
+      emits a one-time warning naming the breaking construct.
     """
     from ..nn.layer.layers import Layer
 
     def decorate(obj):
         if isinstance(obj, Layer):
-            sf = StaticFunction(obj.forward, layers=[obj], **kwargs)
+            sf = StaticFunction(obj.forward, layers=[obj],
+                                full_graph=full_graph, **kwargs)
             obj.forward = sf
             return obj
         return StaticFunction(
-            obj, layers=layers, optimizers=optimizers, scalers=scalers, **kwargs
+            obj, layers=layers, optimizers=optimizers, scalers=scalers,
+            full_graph=full_graph, **kwargs
         )
 
     if function is not None:

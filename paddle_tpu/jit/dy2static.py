@@ -61,6 +61,16 @@ _RUNTIME_NAME = "_paddle_tpu_jst"
 _cache: Dict[Any, Callable] = {}
 
 
+class GraphBreakError(RuntimeError):
+    """A construct that cannot live inside one traced graph was reached.
+
+    Under ``to_static(full_graph=True)`` (default) this propagates to the
+    user with rewrite options; under ``full_graph=False`` StaticFunction
+    catches it and falls back to piecewise eager execution (the SOT
+    graph-break behavior, ref jit/sot/opcode_translator/executor/
+    opcode_executor.py:305,1594)."""
+
+
 class _Undef:
     """Sentinel for a variable unbound before a converted region; any
     use raises with the variable's name (mirrors UnboundLocalError)."""
@@ -124,7 +134,7 @@ def _select_leaf(pred, a, b):
         )
     if a == b:
         return a
-    raise ValueError(
+    raise GraphBreakError(
         f"non-tensor value differs between the branches of a "
         f"tensor-dependent `if` ({a!r} vs {b!r}); only tensor results can "
         "be selected under trace"
@@ -150,7 +160,7 @@ def convert_ret_ifelse(pred, true_fn, false_fn):
     t_leaves, t_def = tree_util.tree_flatten(t_out, is_leaf=is_leaf)
     f_leaves, f_def = tree_util.tree_flatten(f_out, is_leaf=is_leaf)
     if t_def != f_def:
-        raise ValueError(
+        raise GraphBreakError(
             "a tensor-dependent early-return `if` must return the same "
             f"STRUCTURE on both paths (got {t_def} vs {f_def}); restructure "
             "the returns or mark the function @paddle.jit.not_to_static"
@@ -177,7 +187,7 @@ def _carry_arrays(init_args, var_names, what):
     for i, v in enumerate(init_args):
         name = var_names[i] if i < len(var_names) else f"#{i}"
         if isinstance(v, _Undef):
-            raise ValueError(
+            raise GraphBreakError(
                 f"loop variable '{v.name}' must be initialized before a "
                 f"tensor-dependent `{what}`"
             )
@@ -186,7 +196,7 @@ def _carry_arrays(init_args, var_names, what):
         elif isinstance(v, (jax.Array, int, float, bool)) or hasattr(v, "dtype"):
             arrays.append(jnp.asarray(v))
         else:
-            raise ValueError(
+            raise GraphBreakError(
                 f"loop variable '{name}' has type {type(v).__name__}, which "
                 f"cannot be carried through a traced `{what}` (tensors and "
                 "numbers only)"
@@ -383,7 +393,7 @@ def convert_for_range(range_args: Tuple, body_fn, init_args: Tuple,
         else:
             start, stop, step_ = range_args
         if not isinstance(step_, int) or step_ == 0:
-            raise ValueError(
+            raise GraphBreakError(
                 "a traced range() bound requires a concrete nonzero int "
                 "step"
             )
@@ -971,7 +981,7 @@ def _compile_transform(fn):
         return None
 
 
-def graph_break_error(exc: BaseException) -> RuntimeError:
+def graph_break_error(exc: BaseException) -> "GraphBreakError":
     """Actionable error for a tensor-bool reached under trace, naming the
     user source line (the reference's SOT emits a graph-break instead;
     here the failing construct is reported with the rewrite options)."""
@@ -985,7 +995,7 @@ def graph_break_error(exc: BaseException) -> RuntimeError:
         loc = f"{f}:{frame.lineno} ({frame.line})"
         break
     where = f" at {loc}" if loc else ""
-    return RuntimeError(
+    return GraphBreakError(
         "to_static: tensor-dependent Python control flow (or another "
         f"bool()/int()/numpy() concretization) reached under trace{where}. "
         "`if`/`while`/`for range()` and early-return `if` chains in the "

@@ -1,10 +1,10 @@
-"""paddle_tpu.utils — logging, lazy import, misc helpers.
+"""paddle_tpu.utils — logging, lazy import, native extensions, misc.
 
-ref: python/paddle/utils/ — the reference bundles cpp_extension,
-download, gast…; the TPU build needs the observability pieces: VLOG
-logging (utils/log.py here, backing FLAGS_log_level), deprecated-API
-decorator, and unique_name (re-exported from base).
+ref: python/paddle/utils/ — VLOG logging (utils/log.py here, backing
+FLAGS_log_level), deprecated-API decorator, unique_name (re-exported
+from base), and cpp_extension (native custom-op build + load).
 """
+from . import cpp_extension  # noqa: F401
 from . import log  # noqa: F401
 from .log import get_logger  # noqa: F401
 

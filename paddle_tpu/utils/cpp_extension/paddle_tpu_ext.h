@@ -1,0 +1,53 @@
+/* paddle_tpu custom-op C ABI.
+ *
+ * TPU-native counterpart of the reference's custom-operator headers
+ * (ref: paddle/phi/api/ext/op_meta_info.h PD_BUILD_OP; python/paddle/
+ * utils/cpp_extension/). The reference registers C++ kernels into its
+ * dispatch runtime; here a custom op is a plain C-ABI function over
+ * tensor descriptors, loaded with utils.cpp_extension.load() and routed
+ * through jax.pure_callback (host execution — on TPU the array is
+ * fetched to the host, computed, and shipped back, like the reference
+ * running a CPU custom kernel inside a GPU model).
+ *
+ * Contract: an op is
+ *     PT_EXPORT int my_op(const PTTensor* inputs, int n_in,
+ *                         PTTensor* outputs, int n_out);
+ * Inputs are read-only; output buffers are pre-allocated by the caller
+ * (shapes from the Python-side infer_shape, the InferMeta role).
+ * Return 0 on success, nonzero on failure.
+ */
+#ifndef PADDLE_TPU_EXT_H_
+#define PADDLE_TPU_EXT_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+#else
+#define PT_EXPORT __attribute__((visibility("default")))
+#endif
+
+/* dtype codes — keep in sync with _DTYPE_CODES in __init__.py */
+enum PTDtype {
+  PT_FLOAT32 = 0,
+  PT_FLOAT64 = 1,
+  PT_INT32 = 2,
+  PT_INT64 = 3,
+  PT_UINT8 = 4,
+  PT_BOOL = 5,
+};
+
+typedef struct {
+  void* data;           /* contiguous, C-order */
+  const int64_t* shape; /* ndim entries */
+  int32_t ndim;
+  int32_t dtype; /* PTDtype */
+} PTTensor;
+
+static inline int64_t pt_numel(const PTTensor* t) {
+  int64_t n = 1;
+  for (int32_t i = 0; i < t->ndim; ++i) n *= t->shape[i];
+  return n;
+}
+
+#endif /* PADDLE_TPU_EXT_H_ */

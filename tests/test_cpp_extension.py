@@ -1,0 +1,294 @@
+"""utils.cpp_extension — native custom ops (ref: python/paddle/utils/
+cpp_extension/): g++ JIT build, C-ABI op wrapping, custom backward,
+composition with eager autograd and to_static."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension as cpp
+
+_SRC = textwrap.dedent("""
+    #include "paddle_tpu_ext.h"
+
+    // out = x * x
+    PT_EXPORT int square_fwd(const PTTensor* ins, int n_in,
+                             PTTensor* outs, int n_out) {
+      if (n_in != 1 || n_out != 1 || ins[0].dtype != PT_FLOAT32) return 1;
+      const float* x = (const float*)ins[0].data;
+      float* y = (float*)outs[0].data;
+      int64_t n = pt_numel(&ins[0]);
+      for (int64_t i = 0; i < n; ++i) y[i] = x[i] * x[i];
+      return 0;
+    }
+
+    // gx = 2 * x * gy   (inputs: x, gy; outputs: gx)
+    PT_EXPORT int square_bwd(const PTTensor* ins, int n_in,
+                             PTTensor* outs, int n_out) {
+      if (n_in != 2 || n_out != 1) return 1;
+      const float* x = (const float*)ins[0].data;
+      const float* gy = (const float*)ins[1].data;
+      float* gx = (float*)outs[0].data;
+      int64_t n = pt_numel(&ins[0]);
+      for (int64_t i = 0; i < n; ++i) gx[i] = 2.0f * x[i] * gy[i];
+      return 0;
+    }
+
+    // row-wise sum: [m, n] -> [m]
+    PT_EXPORT int rowsum_fwd(const PTTensor* ins, int n_in,
+                             PTTensor* outs, int n_out) {
+      if (n_in != 1 || n_out != 1 || ins[0].ndim != 2) return 1;
+      const float* x = (const float*)ins[0].data;
+      float* y = (float*)outs[0].data;
+      int64_t m = ins[0].shape[0], n = ins[0].shape[1];
+      for (int64_t i = 0; i < m; ++i) {
+        float acc = 0.0f;
+        for (int64_t j = 0; j < n; ++j) acc += x[i * n + j];
+        y[i] = acc;
+      }
+      return 0;
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cppext")
+    src = d / "myops.cc"
+    src.write_text(_SRC)
+    return cpp.load("myops", [str(src)], build_directory=str(d / "build"))
+
+
+class TestLoadAndOps:
+    def test_forward_matches_numpy(self, ext):
+        sq = ext.def_op("my_square", forward="square_fwd",
+                        backward="square_bwd")
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        np.testing.assert_allclose(sq(x).numpy(), (np.arange(6) ** 2)
+                                   .reshape(2, 3).astype(np.float32))
+
+    def test_custom_backward_on_tape(self, ext):
+        sq = ext.def_op("my_square2", forward="square_fwd",
+                        backward="square_bwd")
+        x = paddle.to_tensor(np.array([1.0, -2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        y = (sq(x) * paddle.to_tensor(np.array([1.0, 10.0, 100.0],
+                                               np.float32))).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, -40.0, 600.0])
+
+    def test_under_to_static(self, ext):
+        sq = ext.def_op("my_square3", forward="square_fwd",
+                        backward="square_bwd")
+
+        def f(x):
+            return sq(x).sum()
+
+        sf = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+        assert float(sf(x)) == pytest.approx(13.0)
+        assert sf._last_lowered is not None  # really compiled
+
+    def test_infer_shape_op(self, ext):
+        rowsum = ext.def_op(
+            "rowsum", forward="rowsum_fwd",
+            infer_shape=lambda s: [(s[0],)],
+        )
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        np.testing.assert_allclose(
+            rowsum(x).numpy(), x.numpy().sum(axis=1))
+
+    def test_error_code_surfaces(self, ext):
+        bad = ext.def_op("bad_rank", forward="rowsum_fwd",
+                         infer_shape=lambda s: [(s[0],)])
+        with pytest.raises(Exception, match="error code"):
+            bad(paddle.to_tensor(np.zeros((2, 2, 2), np.float32))).numpy()
+
+    def test_unsupported_dtype_message(self, ext):
+        sq = ext.def_op("my_square4", forward="square_fwd")
+        with pytest.raises(Exception, match="unsupported dtype"):
+            sq(paddle.to_tensor(np.zeros(3, np.float16))).numpy()
+
+
+class TestBuildPlumbing:
+    def test_rebuild_is_cached(self, ext, tmp_path):
+        src = tmp_path / "again.cc"
+        src.write_text(_SRC)
+        a = cpp._build("again", [str(src)], build_directory=str(tmp_path))
+        b = cpp._build("again", [str(src)], build_directory=str(tmp_path))
+        assert a == b and os.path.exists(a)
+        # content change -> new artifact
+        src.write_text(_SRC + "\n// v2\n")
+        c = cpp._build("again", [str(src)], build_directory=str(tmp_path))
+        assert c != a
+
+    def test_compile_error_reported(self, tmp_path):
+        src = tmp_path / "broken.cc"
+        src.write_text("this is not C++")
+        with pytest.raises(RuntimeError, match="build failed"):
+            cpp.load("broken", [str(src)], build_directory=str(tmp_path))
+
+    def test_cuda_extension_rejected_with_guidance(self):
+        with pytest.raises(RuntimeError, match="Pallas"):
+            cpp.CUDAExtension(["kernel.cu"])
+
+    def test_cuda_extension_cpp_sources_ok(self, tmp_path):
+        src = tmp_path / "host.cc"
+        src.write_text(_SRC)
+        ext = cpp.CUDAExtension([str(src)], name="hostonly")
+        mod = cpp.load("hostonly", extension=ext,
+                       build_directory=str(tmp_path))
+        assert os.path.exists(mod.so_path)
+
+    def test_setup_writes_loader(self, tmp_path):
+        src = tmp_path / "s.cc"
+        src.write_text(_SRC)
+        loaders = cpp.setup(
+            name="segext",
+            ext_modules=[cpp.CppExtension([str(src)], name="segext")],
+            build_directory=str(tmp_path),
+        )
+        assert len(loaders) == 1
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("segext", loaders[0])
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        op = mod.def_op("sq", forward="square_fwd")
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        assert float(op(x)) == pytest.approx(9.0)
+
+    def test_get_build_directory_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_EXTENSION_DIR", str(tmp_path / "bd"))
+        assert cpp.get_build_directory() == str(tmp_path / "bd")
+
+
+class TestIncubateAutograd:
+    """paddle.incubate.autograd (ref: incubate/autograd/functional.py:
+    22,80,170,257; primapi.py:25,116) — reference docstring examples."""
+
+    def test_vjp_reference_example(self):
+        def func(x):
+            return paddle.matmul(x, x)
+
+        x = paddle.ones([2, 2], dtype="float32")
+        _, r = paddle.incubate.autograd.vjp(func, x)
+        np.testing.assert_allclose(r.numpy(), [[4.0, 4.0], [4.0, 4.0]])
+        v = paddle.to_tensor([[1.0, 0.0], [0.0, 0.0]])
+        _, r2 = paddle.incubate.autograd.vjp(func, x, v)
+        np.testing.assert_allclose(r2.numpy(), [[2.0, 1.0], [1.0, 0.0]])
+
+    def test_jvp_matches_finite_difference(self):
+        def func(x):
+            return paddle.sin(x) * x
+
+        x = paddle.to_tensor(np.array([0.3, 1.2], np.float32))
+        v = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+        _, d = paddle.incubate.autograd.jvp(func, x, v)
+        eps = 1e-3
+        fd = (np.sin(x.numpy() + eps * v.numpy()) * (x.numpy() + eps * v.numpy())
+              - np.sin(x.numpy() - eps * v.numpy()) * (x.numpy() - eps * v.numpy())) / (2 * eps)
+        np.testing.assert_allclose(d.numpy(), fd, rtol=1e-3)
+
+    def test_jacobian_reference_example(self):
+        def func(x, y):
+            return paddle.matmul(x, y)
+
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        J = paddle.incubate.autograd.Jacobian(func, [x, x])
+        want = np.array(
+            [[1.0, 3.0, 0.0, 0.0, 1.0, 0.0, 2.0, 0.0],
+             [2.0, 4.0, 0.0, 0.0, 0.0, 1.0, 0.0, 2.0],
+             [0.0, 0.0, 1.0, 3.0, 3.0, 0.0, 4.0, 0.0],
+             [0.0, 0.0, 2.0, 4.0, 0.0, 3.0, 0.0, 4.0]], np.float32)
+        np.testing.assert_allclose(J[:, :].numpy(), want)
+        np.testing.assert_allclose(J[0, :].numpy(), want[0])
+        np.testing.assert_allclose(J[:, 0].numpy(), want[:, 0])
+        assert J.shape == (4, 8)
+
+    def test_batched_jacobian_and_hessian(self):
+        def func(x):
+            return (x * x).sum(-1, keepdim=True)
+
+        xb = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        Jb = paddle.incubate.autograd.Jacobian(func, xb, is_batched=True)
+        np.testing.assert_allclose(
+            Jb[:, :, :].numpy(),
+            (2 * np.arange(6).reshape(3, 1, 2)).astype(np.float32))
+
+        def scalar(x):
+            return (x * x * x).sum()
+
+        xh = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        H = paddle.incubate.autograd.Hessian(scalar, xh)
+        np.testing.assert_allclose(
+            H[:, :].numpy(), np.diag([6.0, 12.0]).astype(np.float32))
+
+    def test_forward_grad_and_grad_on_tape(self):
+        ag = paddle.incubate.autograd
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = x * x
+        np.testing.assert_allclose(ag.forward_grad(y, x).numpy(), [2.0, 4.0])
+        v = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        np.testing.assert_allclose(ag.forward_grad(y, x, v).numpy(), [2.0, 0.0])
+        np.testing.assert_allclose(ag.grad(y, x).numpy(), [2.0, 4.0])
+
+    def test_prim_toggles(self):
+        ag = paddle.incubate.autograd
+        assert ag.prim_enabled()
+        ag.disable_prim()
+        assert not ag.prim_enabled()
+        ag.enable_prim()
+        assert ag.prim_enabled()
+
+
+class TestReviewFindings:
+    def test_forward_only_op_runs_with_grad_input(self, ext):
+        """A forward-only op must still FORWARD when an input requires
+        grad; only pulling its gradient errors (with guidance)."""
+        rowsum = ext.def_op("rowsum_g", forward="rowsum_fwd",
+                            infer_shape=lambda s: [(s[0],)])
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        x.stop_gradient = False
+        out = rowsum(x)  # must not raise
+        np.testing.assert_allclose(out.numpy(), [3.0, 12.0])
+        with pytest.raises(RuntimeError, match="no backward registered"):
+            out.sum().backward()
+
+    def test_header_edit_forces_rebuild(self, tmp_path):
+        inc = tmp_path / "inc"
+        inc.mkdir()
+        (inc / "k.h").write_text("#define SCALE 2.0f\n")
+        src = tmp_path / "h.cc"
+        src.write_text(textwrap.dedent("""
+            #include "paddle_tpu_ext.h"
+            #include "k.h"
+            PT_EXPORT int scale_fwd(const PTTensor* ins, int n_in,
+                                    PTTensor* outs, int n_out) {
+              const float* x = (const float*)ins[0].data;
+              float* y = (float*)outs[0].data;
+              for (int64_t i = 0; i < pt_numel(&ins[0]); ++i)
+                y[i] = SCALE * x[i];
+              return 0;
+            }
+        """))
+        a = cpp._build("hdr", [str(src)], include_dirs=[str(inc)],
+                       build_directory=str(tmp_path / "b"))
+        (inc / "k.h").write_text("#define SCALE 3.0f\n")
+        b = cpp._build("hdr", [str(src)], include_dirs=[str(inc)],
+                       build_directory=str(tmp_path / "b"))
+        assert a != b
+
+    def test_forward_grad_wrong_tangent_count_raises(self):
+        ag = paddle.incubate.autograd
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.stop_gradient = False
+        y = x * x
+        v = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(ValueError, match="grad_inputs"):
+            ag.forward_grad(y, x, [v, v])
+        with pytest.raises(ValueError, match="grad_outputs"):
+            ag.grad(y, x, [v, v])

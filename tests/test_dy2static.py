@@ -676,3 +676,113 @@ class TestEarlyReturnIf:
 
         conv = dy2static.convert(g)
         assert inspect.isgeneratorfunction(conv)
+
+
+class TestFullGraphFallback:
+    """full_graph=False (ref: jit/api.py:271 SOT mode) — a graph break
+    demotes the function to piecewise eager execution instead of
+    raising; results and training state must match pure eager."""
+
+    @staticmethod
+    def _breaking_fn():
+        def helper(x):
+            # helpers are not converted; a tensor-if with returns inside
+            # is the canonical SOT graph-break site
+            if x.sum() > 0:
+                return x * 2.0
+            return x * 3.0
+
+        def f(x):
+            return helper(x) + 1.0
+
+        return f
+
+    def test_fallback_matches_eager(self):
+        f = self._breaking_fn()
+        sf = pjit.to_static(f, full_graph=False)
+        xp = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        xn = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+        with pytest.warns(UserWarning, match="graph break"):
+            got = sf(xp)
+        np.testing.assert_allclose(got.numpy(), f(xp).numpy(), rtol=1e-6)
+        # both predicate paths run correctly after the fallback
+        np.testing.assert_allclose(sf(xn).numpy(), f(xn).numpy(), rtol=1e-6)
+        assert sf._fallback_eager
+
+    def test_default_full_graph_still_raises(self):
+        sf = pjit.to_static(self._breaking_fn())
+        with pytest.raises(RuntimeError, match="tensor-dependent"):
+            sf(paddle.to_tensor(np.array([1.0], np.float32)))
+
+    def test_training_state_rolls_back_and_continues(self):
+        """The failed trace writes tracers into params/optimizer state;
+        the fallback must roll back and train eagerly to the same curve
+        as a never-compiled run."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as popt
+
+        def build():
+            paddle.seed(0)
+            model = nn.Linear(4, 3)
+            o = popt.AdamW(learning_rate=0.01, parameters=model.parameters())
+            return model, o
+
+        def make_step(model, o):
+            def step(x, y):
+                logits = model(x)
+                if float(logits.sum()) > -1e30:  # host concretization -> break
+                    loss = F.cross_entropy(logits, y)
+                loss.backward()
+                o.step()
+                o.clear_grad()
+                return loss
+
+            return step
+
+        rng = np.random.RandomState(0)
+        xs = [rng.randn(8, 4).astype(np.float32) for _ in range(4)]
+        ys = [rng.randint(0, 3, (8,)).astype(np.int64) for _ in range(4)]
+
+        m1, o1 = build()
+        eager = make_step(m1, o1)
+        want = [float(eager(paddle.to_tensor(x), paddle.to_tensor(y)))
+                for x, y in zip(xs, ys)]
+
+        m2, o2 = build()
+        sf = pjit.to_static(make_step(m2, o2), layers=[m2], optimizers=[o2],
+                            full_graph=False)
+        with pytest.warns(UserWarning, match="graph break"):
+            got = [float(sf(paddle.to_tensor(x), paddle.to_tensor(y)))
+                   for x, y in zip(xs, ys)]
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        assert o2._global_step == o1._global_step
+        # params stayed concrete (no leaked tracers)
+        import jax
+
+        for p in m2.parameters():
+            assert not isinstance(p._data, jax.core.Tracer)
+
+    def test_multi_step_refused_after_fallback(self):
+        f = self._breaking_fn()
+        sf = pjit.to_static(f, full_graph=False)
+        with pytest.warns(UserWarning, match="graph break"):
+            sf(paddle.to_tensor(np.array([1.0], np.float32)))
+        with pytest.raises(RuntimeError, match="full-graph"):
+            sf.multi_step(paddle.to_tensor(np.array([[1.0]], np.float32)))
+
+    def test_convertible_fn_stays_compiled(self):
+        """full_graph=False must NOT degrade functions that capture
+        fine — only a real break triggers the fallback."""
+
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        sf = pjit.to_static(f, full_graph=False)
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(sf(x).numpy(), f(x).numpy(), rtol=1e-6)
+        assert not sf._fallback_eager
+        assert sf._last_lowered is not None
