@@ -95,14 +95,22 @@ def main():
     model = LlamaForCausalLM(config)
     if on_tpu:
         model.bfloat16()  # bf16 params+activations; AdamW keeps fp32 masters
-    # the masterless config (multi_precision=False: bf16 WEIGHTS carry
-    # the update, ~3 significant digits) needs a smaller step to stay
-    # stable; bf16 moment STORAGE itself is safe at lr 1e-4 (update
-    # math is f32 and fp32 masters accumulate — the flagship setting)
-    lr = 1e-4 if multi_precision or not on_tpu else 1e-5
+    # BENCH_SR=1: masterless bf16 with stochastic-rounded writes — drops
+    # the fp32 masters' 8 bytes/param of HBM traffic while keeping the
+    # fp32-master loss trajectory (unbiased rounding carries sub-ulp
+    # updates in expectation), so the full fp32-master lr applies
+    use_sr = _os.environ.get("BENCH_SR") == "1" and on_tpu
+    if use_sr:
+        multi_precision = False
+    # the PLAIN masterless config (multi_precision=False, no SR: bf16
+    # WEIGHTS carry the update, ~3 significant digits) needs a smaller
+    # step to stay stable; bf16 moment STORAGE itself is safe at lr 1e-4
+    # (update math is f32 and fp32 masters accumulate)
+    lr = 1e-4 if multi_precision or use_sr or not on_tpu else 1e-5
     opt = popt.AdamW(
         learning_rate=lr, parameters=model.parameters(),
         multi_precision=multi_precision,
+        use_stochastic_rounding=use_sr,
         # bf16 moment STORAGE (f32 update math, f32 masters): the AdamW
         # pass is HBM-bound; halving its moment traffic buys ~5 ms/step
         moment_dtype="bfloat16" if on_tpu else None,

@@ -78,7 +78,8 @@ def batches(stream: np.ndarray, batch: int, seq: int, rng: np.random.RandomState
 
 def run(hidden=256, layers=4, heads=4, batch=32, seq=128,
         steps=600, eval_every=100, lr=3e-3, train_tokens=400_000,
-        eval_tokens=50_000, target_ratio=1.05, order=2, log=print):
+        eval_tokens=50_000, target_ratio=1.05, order=2, log=print,
+        bf16_sr=False):
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
     import paddle_tpu.optimizer as popt
@@ -100,8 +101,13 @@ def run(hidden=256, layers=4, heads=4, batch=32, seq=128,
         num_key_value_heads=heads, max_position_embeddings=max(seq, 256),
     )
     model = LlamaForCausalLM(cfg)
+    if bf16_sr:
+        # masterless bf16 with stochastic-rounded writes: the full-lr
+        # trajectory without fp32 masters (validated against the f32
+        # run's eval target)
+        model.bfloat16()
     opt = popt.AdamW(learning_rate=lr, parameters=model.parameters(),
-                     weight_decay=0.01)
+                     weight_decay=0.01, use_stochastic_rounding=bf16_sr)
 
     def step_fn(x, y):
         logits = model(x)
@@ -162,9 +168,14 @@ def run(hidden=256, layers=4, heads=4, batch=32, seq=128,
 
 
 if __name__ == "__main__":
+    import os
+
     # the BASELINE.md row's config (reached 1.027x floor on v5e,
-    # 2026-07-31; lr 1e-2 DIVERGES at this width — sits at unigram)
+    # 2026-07-31; lr 1e-2 DIVERGES at this width — sits at unigram).
+    # CONV_BF16_SR=1 reruns it in masterless-bf16 stochastic-rounding
+    # mode (same lr/steps — the point is trajectory parity).
     run(hidden=256, layers=4, heads=4, batch=64, seq=128,
         steps=3000, eval_every=500, lr=3e-3,
         train_tokens=2_000_000, eval_tokens=100_000,
-        target_ratio=1.05, order=2)
+        target_ratio=1.05, order=2,
+        bf16_sr=os.environ.get("CONV_BF16_SR") == "1")

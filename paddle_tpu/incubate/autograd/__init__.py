@@ -78,6 +78,9 @@ def _match_v(v, ys_arrays, what):
                     f"{what}: v shape {tuple(got.shape)} does not match "
                     f"output shape {tuple(want.shape)}"
                 )
+        # jax pullbacks require exact cotangent dtypes (bf16 outputs are
+        # the norm here); cast like jvp casts tangents
+        vs = tuple(g.astype(w.dtype) for g, w in zip(vs, leaves))
     return vs[0] if single else vs
 
 

@@ -30,6 +30,40 @@ __all__ = [
 ]
 
 
+def _stochastic_round_bf16(x):
+    """Unbiased f32 → bf16 rounding: add 16 random bits below the bf16
+    mantissa cut, truncate. Sign-magnitude format makes the trick
+    unbiased for both signs (|x| rounds up with probability equal to
+    the discarded fraction, so E[result] == x). This is the standard
+    masterless-bf16 training recipe: the expected update survives even
+    when each step's delta is smaller than one bf16 ulp, replacing the
+    8 bytes/param of fp32-master HBM traffic with 16 random bits.
+    inf/NaN pass through unperturbed.
+
+    Bit source: a lowbias32-style integer hash over (lane index, two
+    per-call threefry salts) — measured ~10x cheaper inside the fused
+    optimizer pass than a full per-element threefry draw (which cost
+    more than the master traffic it replaced); rounding noise needs
+    per-element uniformity, not cryptographic streams."""
+    import jax
+
+    from ..base import random as _random
+
+    xf = x.astype(jnp.float32)
+    u = jax.lax.bitcast_convert_type(xf, jnp.uint32)
+    salt = jax.random.bits(_random.next_key(), (2,), jnp.uint32)
+    i = jax.lax.iota(jnp.uint32, x.size).reshape(x.shape)
+    b = i * jnp.uint32(0x9E3779B9) + salt[0]
+    b = (b ^ (b >> 16)) * jnp.uint32(0x7FEB352D)
+    b = (b ^ (b >> 15)) * jnp.uint32(0x846CA68B)
+    b = (b ^ (b >> 16)) + salt[1]
+    r = jax.lax.bitcast_convert_type(
+        (u + (b & jnp.uint32(0xFFFF))) & jnp.uint32(0xFFFF0000),
+        jnp.float32,
+    )
+    return jnp.where(jnp.isfinite(xf), r, xf).astype(jnp.bfloat16)
+
+
 class L2Decay:
     """ref: python/paddle/regularizer.py L2Decay — grad += coeff * param."""
 
@@ -84,6 +118,9 @@ class Optimizer:
         # layout (stage-2 reduce-scatter)
         self._accum_placement_fn = None
         self._grad_placement_fn = None
+        # write low-precision params back with unbiased stochastic
+        # rounding (subclasses expose use_stochastic_rounding=True)
+        self._stochastic_rounding = False
         self._global_step = 0
 
     # ------------------------------------------------------------------
@@ -212,6 +249,12 @@ class Optimizer:
         if self._use_master(p):
             self._accumulators["master_weight"][p.name] = new_value
             p._data = new_value.astype(p._data.dtype)
+        elif (
+            self._stochastic_rounding
+            and p._data.dtype == jnp.bfloat16
+            and new_value.dtype != jnp.bfloat16
+        ):
+            p._data = _stochastic_round_bf16(new_value)
         else:
             p._data = new_value.astype(p._data.dtype)
 
@@ -329,11 +372,15 @@ class Momentum(Optimizer):
 class _AdamBase(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
                  weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None,
-                 moment_dtype=None):
+                 moment_dtype=None, use_stochastic_rounding=False):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        # masterless-bf16 mode: unbiased stochastic-rounded writes let
+        # bf16 weights carry the update without fp32 masters (see
+        # _stochastic_round_bf16); ignored when multi_precision is on
+        self._stochastic_rounding = bool(use_stochastic_rounding)
         # TPU-native extension: storage dtype for m/v ("bfloat16" halves
         # the optimizer's HBM traffic — the AdamW pass runs at bandwidth
         # roofline; update ARITHMETIC stays f32 (_moments), and master
@@ -393,9 +440,11 @@ class AdamW(_AdamBase):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
                  weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None, moment_dtype=None):
+                 lazy_mode=False, multi_precision=False, name=None, moment_dtype=None,
+                 use_stochastic_rounding=False):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, lazy_mode, multi_precision, name,
-                         moment_dtype=moment_dtype)
+                         moment_dtype=moment_dtype,
+                         use_stochastic_rounding=use_stochastic_rounding)
         self._coeff = float(weight_decay) if not callable(weight_decay) else weight_decay
         self._lr_ratio = lr_ratio
         self._apply_decay_param_fun = apply_decay_param_fun

@@ -31,6 +31,20 @@ class TestMarkovLMConvergence:
         assert result["reached"], (final, floor)
 
 
+    def test_small_llama_bf16_sr_matches_f32_target(self):
+        """Masterless bf16 + stochastic rounding must reach the same
+        held-out entropy-floor target as the f32 run (trajectory
+        parity is the point of SR — no fp32 masters anywhere)."""
+        from convergence_lm import run
+
+        result = run(hidden=128, layers=2, heads=4, batch=16, seq=64,
+                     steps=200, eval_every=200, lr=1e-2,
+                     train_tokens=120_000, eval_tokens=20_000,
+                     target_ratio=1.15, order=1, log=lambda *a: None,
+                     bf16_sr=True)
+        assert result["reached"], (result["final_eval_ce"],
+                                   result["floor_nats"])
+
 class TestResNetConvergence:
     def test_small_cnn_learns_textures_heldout(self):
         import paddle_tpu.nn as nn
