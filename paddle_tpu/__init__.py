@@ -92,6 +92,7 @@ from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
 from . import signal  # noqa: F401
 from . import static  # noqa: F401
+from . import cost_model  # noqa: F401
 from . import quantization  # noqa: F401
 from . import incubate  # noqa: F401
 from . import text  # noqa: F401

@@ -1,0 +1,76 @@
+"""paddle.cost_model (ref: python/paddle/cost_model/cost_model.py:25 —
+CostModel.profile_measure runs a Program under the profiler and
+collects per-op costs).
+
+TPU-native: the compiled program's costs come from XLA itself —
+``jax.jit(fn).lower(...).compile().cost_analysis()`` exposes the
+compiler's FLOP/byte estimates, and wall-time measurement runs the
+compiled binary. Both are surfaced here."""
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    """Static cost estimates + measured step time for a jittable fn."""
+
+    def profile_measure(self, fn, example_args=(), run_iters: int = 10,
+                        device: str = None, fetch_cost_list=None) -> Dict:
+        """Compile ``fn`` on the example args and return XLA's cost
+        analysis plus a measured mean step time (the reference returns
+        per-op profiler times; XLA fuses ops, so the granularity here
+        is the fused program)."""
+        import jax
+        import numpy as np
+
+        from ..base.tensor import Tensor
+
+        raw = [a._data if isinstance(a, Tensor) else a for a in example_args]
+
+        def pure(*xs):
+            out = fn(*[Tensor(x, _internal=True) for x in xs])
+            return out._data if isinstance(out, Tensor) else out
+
+        compiled = jax.jit(pure).lower(*raw).compile()
+        cost = dict(compiled.cost_analysis() or {})
+        out = compiled(*raw)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(run_iters):
+            out = compiled(*raw)
+        jax.block_until_ready(out)
+        per_step = (time.perf_counter() - t0) / max(run_iters, 1)
+        return {
+            "time_ms": per_step * 1e3,
+            "flops": float(cost.get("flops", 0.0)),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+            "cost_analysis": cost,
+        }
+
+    # the reference's toy entry (cost_model.py:29) builds a demo fc
+    # program; kept for API parity
+    def build_program(self):
+        import numpy as np
+
+        from .. import nn
+        from ..base.tensor import Tensor
+
+        model = nn.Linear(1, 10)
+
+        def fn(x):
+            return model(x)
+
+        x = Tensor(np.zeros((4, 1), np.float32), _internal=True)
+        return fn, (x,)
+
+    def static_cost_data(self):
+        """ref: cost_model.py static_cost_data — the reference loads a
+        json table of measured op costs; here the authoritative static
+        cost source is XLA's cost_analysis (see profile_measure)."""
+        raise NotImplementedError(
+            "per-op static cost tables do not exist under XLA fusion; "
+            "use profile_measure(fn, args)['cost_analysis']"
+        )

@@ -242,6 +242,32 @@ class cuda:
     def device_count():
         return device_count()
 
+    @staticmethod
+    def get_device_name(device=None):
+        """ref: device/cuda get_device_name — the accelerator's name
+        (here the TPU device kind, e.g. 'TPU v5 lite')."""
+        props = get_device_properties(device)
+        return getattr(props, "name", str(props))
+
+    @staticmethod
+    def get_device_capability(device=None):
+        """ref: device/cuda get_device_capability — (major, minor). CUDA
+        compute capability has no TPU analogue; the TPU generation is
+        reported as (generation, 0), parsed from the device kind."""
+        import re
+
+        name = cuda.get_device_name(device)
+        m = re.search(r"v(\d+)", str(name))
+        return (int(m.group(1)), 0) if m else (0, 0)
+
+
+class xpu:
+    """paddle.device.xpu parity (ref: device/xpu/__init__.py — one
+    public name; XPU has no TPU analogue, synchronize maps to the
+    device barrier)."""
+
+    synchronize = staticmethod(synchronize)
+
 
 # -- parity sweep (ref: python/paddle/device/__init__.py remaining) ---------
 from ..base.device import CPUPlace as _CPUPlace

@@ -69,3 +69,5 @@ from .auto_parallel import (  # noqa: F401
     shard_optimizer,
     shard_tensor,
 )
+
+from . import passes  # noqa: E402,F401

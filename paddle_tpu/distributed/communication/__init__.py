@@ -196,6 +196,32 @@ def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group: Optional[Grou
     tensor._inplace_from(Tensor(out, _internal=True))
 
 
+def gather(tensor: Tensor, gather_list=None, dst: int = 0, group: Optional[Group] = None, sync_op: bool = True):
+    """Gather every rank's tensor to ``dst`` (ref: communication/
+    gather.py). SPMD note: like ``reduce``, the gather is computed on
+    every shard (all_gather is the HLO) and ``gather_list`` is filled
+    on all of them — the dst distinction is host-level bookkeeping the
+    single-controller model does not need."""
+    g = _resolve(group)
+    x = _data(tensor)
+    if not _is_traced(x):
+        if _eager_guard(g, "gather"):
+            if gather_list is not None:
+                gather_list.clear()
+                gather_list.append(Tensor(x, _internal=True))
+                return
+            # same contract as the traced path: stacked [nranks, ...]
+            return Tensor(x[None], _internal=True)
+    stacked = lax.all_gather(x, g.axis_name)
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(
+            Tensor(stacked[i], _internal=True) for i in range(g.nranks)
+        )
+        return
+    return Tensor(stacked, _internal=True)
+
+
 def alltoall(out_tensor_list: List, in_tensor_list: List, group: Optional[Group] = None, sync_op: bool = True):
     """Each rank sends in_tensor_list[r] to rank r (communication/all_to_all.py)."""
     g = _resolve(group)

@@ -1,8 +1,50 @@
-"""fleet.utils — recompute + hybrid-parallel helpers.
+"""fleet.utils — recompute, filesystems, PS-infer helper.
 
-ref: python/paddle/distributed/fleet/utils/__init__.py (recompute
-re-export), fleet/utils/sequence_parallel_utils.py.
+ref: python/paddle/distributed/fleet/utils/__init__.py (__all__ =
+LocalFS/recompute/DistributedInfer/HDFSClient),
+fleet/utils/sequence_parallel_utils.py, fs.py, ps_util.py.
 """
+from .fs import HDFSClient, LocalFS  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 
-__all__ = ["recompute", "recompute_sequential"]
+
+class DistributedInfer:
+    """ref: fleet/utils/ps_util.py:24 — prepares a PS-trained model for
+    inference: pulls the distributed embedding shards into local dense
+    tables, then serves the plain forward. The reference rewrites a
+    static Program's distributed-lookup ops; here sparse tables live in
+    distributed/ps and pull directly."""
+
+    def __init__(self, main_program=None, startup_program=None,
+                 tables=None):
+        # distributed/ps SparseTable instances to localize (the
+        # reference discovers them from the Program's lookup ops;
+        # here they are passed or discovered from a model)
+        self._tables = list(tables or [])
+
+    def init_distributed_infer_env(self, exe=None, loss=None, role_maker=None,
+                                   dirname=None, model=None):
+        """Make every sparse table locally servable. Single-controller
+        note: distributed/ps rows are mesh-sharded jax arrays that are
+        already globally addressable from the controller, so no
+        pull-RPC pass is needed (the reference rewrites
+        distributed_lookup ops into local lookups here); optionally
+        loads saved tables from ``dirname``."""
+        if model is not None:
+            from ...ps import DistributedEmbedding, SparseTable
+
+            for _, sub in model.named_sublayers(include_self=True):
+                if isinstance(sub, (DistributedEmbedding, SparseTable)):
+                    self._tables.append(sub)
+        if dirname:
+            for t in self._tables:
+                if hasattr(t, "load"):
+                    t.load(dirname)
+
+    def get_dist_infer_program(self):
+        """The runtime has one program form — the model's forward; after
+        init_distributed_infer_env the lookups hit local tables."""
+        return None
+
+
+__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient"]

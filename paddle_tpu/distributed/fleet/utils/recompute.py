@@ -166,3 +166,18 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
         res = recompute(seg, *out, **kwargs)
         out = res if isinstance(res, tuple) else (res,)
     return out[0] if len(out) == 1 else out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Recompute inside hybrid parallelism (ref: incubate/distributed/
+    fleet/recompute_hybrid.py — adds mp-group RNG coordination and
+    optional activation offload to plain recompute).
+
+    Here the model-parallel RNG tracker already derives per-axis
+    branches from the threaded key (base/random.py), so the mp_group
+    plumbing is implicit; ``ctx`` accepts {"mp_group": ..., "offload":
+    bool} and offload maps to a jax.checkpoint save-nothing policy
+    (the XLA analogue of pushing activations off-chip: recompute
+    everything from the segment boundary)."""
+    del ctx  # coordination handled by the RNG tracker (see docstring)
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,163 @@
+"""paddle.distributed.passes — program-transform passes.
+
+ref: python/paddle/distributed/passes/__init__.py (new_pass /
+PassManager / PassContext; pass_base.py:20,131,350). The reference's
+passes rewrite a static Program's op graph (fuse_gemm_epilogue,
+auto_parallel_recompute, fuse_optimizer, …). Here the "program" is a
+traced jax function: XLA already performs the fusion passes during
+compilation, so the pass framework transforms CALLABLES — a pass takes
+the step function and returns a wrapped one. Registered passes:
+
+- ``auto_parallel_recompute``: wraps the function in ``jax.checkpoint``
+  (the reference pass inserts recompute subgraphs).
+- ``auto_parallel_amp`` / ``auto_parallel_fp16``: runs the function
+  under ``amp.auto_cast`` O1/O2.
+- ``fuse_gemm_epilogue`` / ``fused_attention`` / ``fuse_optimizer`` /
+  ``fuse_all_reduce``: identity passes — the XLA compiler performs
+  these rewrites unconditionally; registering them keeps pass lists
+  portable from the reference.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+
+class PassContext:
+    """ref: pass_base.py:20."""
+
+    def __init__(self):
+        self._applied_passes = []
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+
+class PassBase:
+    """A pass transforms a step callable (ref: pass_base.py PassBase —
+    _apply_single_impl over Programs becomes apply() over callables)."""
+
+    _REGISTERED_PASSES: Dict[str, type] = {}
+
+    name = "base"
+
+    def __init__(self):
+        self._attrs: Dict[str, object] = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    @classmethod
+    def register(cls, pass_cls):
+        cls._REGISTERED_PASSES[pass_cls.name] = pass_cls
+        return pass_cls
+
+    def apply(self, fn: Callable, context: Optional[PassContext] = None):
+        out = self._apply_impl(fn)
+        if context is not None:
+            context._applied_passes.append(self)
+        return out
+
+    def _apply_impl(self, fn):
+        raise NotImplementedError
+
+
+@PassBase.register
+class _RecomputePass(PassBase):
+    name = "auto_parallel_recompute"
+
+    def _apply_impl(self, fn):
+        import jax
+
+        policy = self.get_attr("checkpoint_policy")
+        kwargs = {"policy": policy} if policy is not None else {}
+        return jax.checkpoint(fn, **kwargs)
+
+
+class _AmpPass(PassBase):
+    name = "auto_parallel_amp"
+    level = "O1"
+
+    def _apply_impl(self, fn):
+        import functools
+
+        from ...amp import auto_cast
+
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            with auto_cast(enable=True, level=self.level,
+                           dtype=self.get_attr("dtype", "bfloat16")):
+                return fn(*a, **k)
+
+        return wrapped
+
+
+PassBase.register(_AmpPass)
+
+
+@PassBase.register
+class _Fp16Pass(_AmpPass):
+    name = "auto_parallel_fp16"
+    level = "O2"
+
+
+class _IdentityPass(PassBase):
+    """The XLA compiler performs this rewrite unconditionally."""
+
+    def _apply_impl(self, fn):
+        return fn
+
+
+for _name in ("fuse_gemm_epilogue", "fused_attention", "fused_feedforward",
+              "fuse_optimizer", "fuse_all_reduce", "fuse_elewise_add_act",
+              "auto_parallel_sharding", "auto_parallel_gradient_merge"):
+    PassBase.register(type(f"_{_name}_pass", (_IdentityPass,),
+                          {"name": _name}))
+
+
+def new_pass(name, pass_attrs=None):
+    """ref: pass_base.py:131 new_pass."""
+    pass_class = PassBase._REGISTERED_PASSES.get(name)
+    if pass_class is None:
+        raise ValueError(
+            f"Pass {name!r} is not registered; available: "
+            f"{sorted(PassBase._REGISTERED_PASSES)}"
+        )
+    pass_obj = pass_class()
+    for k, v in (pass_attrs or {}).items():
+        pass_obj.set_attr(k, v)
+    return pass_obj
+
+
+class PassManager:
+    """ref: pass_base.py:350 — apply a pass list in order."""
+
+    def __init__(self, passes, context=None, auto_solve_conflict=True):
+        self._context = context or PassContext()
+        self._passes = list(passes)
+
+    def apply(self, fn):
+        """Apply all passes to a step callable (the reference applies to
+        [main_program]; a single callable is this runtime's program)."""
+        if isinstance(fn, (list, tuple)):
+            return type(fn)(self.apply(f) for f in fn)
+        for p in self._passes:
+            fn = p.apply(fn, self._context)
+        return fn
+
+    @property
+    def context(self):
+        return self._context
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]

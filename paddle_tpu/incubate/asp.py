@@ -78,16 +78,36 @@ def reset_excluded_layers(main_program=None):
     _excluded.clear()
 
 
+# user-registered prunable layer types (ref: asp/utils.py
+# add_supported_layer) — (type or type-name) -> optional custom
+# pruning func fn(weight_np, n, m, mask_algo) -> mask
+_extra_supported: dict = {}
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """ref: incubate/asp/utils.py add_supported_layer — register a
+    layer TYPE (class or class name) whose ``weight`` participates in
+    n:m pruning; ``pruning_func(weight_np, n, m, mask_algo) -> mask``
+    overrides the default mask algorithm for it."""
+    key = layer if isinstance(layer, str) else getattr(layer, "__name__", None)
+    if not key:
+        raise ValueError("add_supported_layer expects a Layer class or name")
+    _extra_supported[key] = pruning_func
+
+
 def _prunable(layer) -> List:
     from ..nn import Conv2D, Linear
 
     params = []
     for name, sub in layer.named_sublayers(include_self=True):
-        if isinstance(sub, (Linear, Conv2D)):
+        supported = (isinstance(sub, (Linear, Conv2D))
+                     or type(sub).__name__ in _extra_supported)
+        if supported and getattr(sub, "weight", None) is not None:
             w = sub.weight
             flat_cols = int(np.prod(w.shape[1:])) if len(w.shape) > 2 else w.shape[-1]
             if w.name not in _excluded and flat_cols % 4 == 0:
-                params.append(w)
+                params.append(
+                    (w, _extra_supported.get(type(sub).__name__)))
     return params
 
 
@@ -98,8 +118,11 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
     import jax.numpy as jnp
 
     out = {}
-    for w in _prunable(model):
-        mask = create_mask(w, mask_algo, n, m)
+    for w, custom in _prunable(model):
+        if custom is not None:
+            mask = np.asarray(custom(np.asarray(w.numpy()), n, m, mask_algo))
+        else:
+            mask = create_mask(w, mask_algo, n, m)
         w.set_value(np.asarray(w.numpy()) * mask)
         if with_mask:
             _masks[id(w)] = mask

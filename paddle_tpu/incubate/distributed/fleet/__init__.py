@@ -1,0 +1,9 @@
+"""paddle.incubate.distributed.fleet (ref: python/paddle/incubate/
+distributed/fleet/__init__.py — recompute_sequential/recompute_hybrid
+re-exports over the fleet recompute machinery)."""
+from ....distributed.fleet.utils.recompute import (  # noqa: F401
+    recompute_hybrid,
+    recompute_sequential,
+)
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]

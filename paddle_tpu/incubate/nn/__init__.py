@@ -1,2 +1,23 @@
 """incubate.nn (ref: python/paddle/incubate/nn)."""
 from . import functional  # noqa: F401
+from .layers import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedDropoutAdd,
+    FusedEcMoe,
+    FusedFeedForward,
+    FusedLinear,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
+
+__all__ = [
+    "FusedMultiHeadAttention",
+    "FusedFeedForward",
+    "FusedTransformerEncoderLayer",
+    "FusedMultiTransformer",
+    "FusedLinear",
+    "FusedBiasDropoutResidualLayerNorm",
+    "FusedEcMoe",
+    "FusedDropoutAdd",
+]

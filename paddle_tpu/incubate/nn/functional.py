@@ -13,12 +13,17 @@ from typing import Optional
 import jax.numpy as jnp
 
 from ...base.tape import apply
+from ...base.tensor import Tensor
 from ...nn import functional as F
 
 __all__ = [
     "fused_linear", "fused_feedforward", "fused_multi_head_attention",
     "fused_rms_norm", "fused_rotary_position_embedding",
     "masked_multihead_attention", "block_multihead_attention",
+    "fused_matmul_bias", "fused_linear_activation", "fused_dropout_add",
+    "swiglu", "fused_layer_norm", "fused_bias_dropout_residual_layer_norm",
+    "fused_ec_moe", "variable_length_memory_efficient_attention",
+    "blha_get_max_len", "fused_multi_transformer",
 ]
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
@@ -368,3 +373,371 @@ def block_multihead_attention(
     out = jnp.concatenate(outs, axis=0) if outs else jnp.zeros((0, qh * d), qkv_a.dtype)
     mk = lambda a: Tensor(a, _internal=True)  # noqa: E731
     return mk(out), mk(qkv_a), mk(kc), mk(vc)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """ref: functional/fused_matmul_bias.py:24 (cuBLASLt epilogue) — one
+    XLA dot with the bias add fused by the compiler. ``fused_linear``
+    above is the transpose_x=False special case (transpose_weight ==
+    transpose_y)."""
+    from ...tensor.linalg import matmul
+
+    if not transpose_x:
+        return fused_linear(x, y, bias, transpose_weight=transpose_y)
+    out = matmul(x, y, transpose_x=True, transpose_y=transpose_y)
+    return out + bias if bias is not None else out
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation=None):
+    """ref: functional/fused_matmul_bias.py:118 — GEMM + bias + gelu/relu
+    epilogue (XLA fuses the activation into the dot's consumer)."""
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    if activation in (None, "none"):
+        return out
+    try:
+        act = {"gelu": F.gelu, "relu": F.relu}[activation]
+    except KeyError:
+        raise ValueError(
+            f"fused_linear_activation supports 'gelu'/'relu', got "
+            f"{activation!r}"
+        ) from None
+    return act(out)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """ref: functional/fused_dropout_add.py:22 — dropout(x) + y in one
+    fused elementwise chain."""
+    return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def swiglu(x, y=None, name=None):
+    """ref: functional/swiglu.py:20 — silu(x) * y, or chunk x in two
+    when y is None (the Llama MLP gate; XLA fuses the pair)."""
+    if y is None:
+        from ...tensor.manipulation import chunk
+
+        x, y = chunk(x, 2, axis=-1)
+    return F.silu(x) * y
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon, residual_alpha=1.0,
+                     begin_norm_axis=1, bias=None, residual=None,
+                     quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                     quant_min_bound=0):
+    """ref: functional/fused_layer_norm.py:21 — LayerNorm(bias +
+    residual_alpha*residual + x); norm_weight=None skips the norm and
+    returns the fused add chain. The int8 quant epilogue
+    (quant_scale > 0) applies scale/clip like the reference kernel."""
+    z = x
+    if bias is not None:
+        z = z + bias
+    if residual is not None:
+        z = z + residual_alpha * residual
+    if norm_weight is None and norm_bias is None:
+        out = z
+    else:
+        shape = tuple(int(s) for s in z.shape[begin_norm_axis:])
+        out = F.layer_norm(z, shape, weight=norm_weight, bias=norm_bias,
+                           epsilon=epsilon)
+    if quant_scale > 0:
+        # ref epilogue (phi/kernels/funcs/quant_dequant.h:56):
+        # clip(round(max_bound * scale * x), min_bound, max_bound);
+        # round_type 0 = rint (half-to-even), 1 = round half away
+        def q(a):
+            v = a.astype(jnp.float32) * (quant_max_bound * quant_scale)
+            if quant_round_type == 0:
+                v = jnp.rint(v)
+            else:
+                v = jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+            return jnp.clip(v, quant_min_bound, quant_max_bound).astype(
+                jnp.int8)
+
+        out = apply(q, out, op_name="fused_layer_norm_quant")
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True,
+                                           mode="upscale_in_train", name=None):
+    """ref: functional/fused_transformer.py:323 —
+    layer_norm(residual + dropout(bias + x))."""
+    z = x + bias if bias is not None else x
+    z = residual + F.dropout(z, dropout_rate, training=training, mode=mode)
+    h = int(z.shape[-1])
+    return F.layer_norm(z, (h,), weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type):
+    """ref: functional/fused_ec_moe.py:18 (sm75+ CUDA kernel) — dense
+    expert-choice MoE: softmax gate over e experts, every expert runs
+    on every token (batched einsum over the expert axis — the MXU-dense
+    formulation; the reference's kernel is the same dense bmm pair),
+    outputs combined by gate weight."""
+    if act_type not in ("gelu", "relu"):
+        raise ValueError(f"fused_ec_moe supports 'gelu'/'relu', got {act_type!r}")
+
+    def f(xx, gg, w0, b0, w1, b1):
+        import jax
+
+        probs = jax.nn.softmax(gg, axis=-1)          # [b, s, e]
+        h = jnp.einsum("bsd,edf->bsef", xx, w0) + b0[:, 0][None, None]
+        h = jax.nn.gelu(h) if act_type == "gelu" else jnp.maximum(h, 0)
+        y = jnp.einsum("bsef,efd->bsed", h, w1) + b1[:, 0][None, None]
+        return jnp.einsum("bsed,bse->bsd", y, probs)
+
+    return apply(f, x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 op_name="fused_ec_moe")
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    """ref: functional/variable_length_memory_efficient_attention.py:28
+    (cutlass varlen kernel) — per-sequence-length masked SDPA. Layouts
+    follow the reference: q/k/v are [b, heads, seq, head_dim], lengths
+    [b, 1]; positions past a sequence's length are masked out."""
+
+    def f(q, k, v, sl, kvl, *maybe_mask):
+        import jax
+
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / float(d) ** 0.5
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
+        qlen, klen = q.shape[2], k.shape[2]
+        kv_valid = jnp.arange(klen)[None, :] < kvl.reshape(-1, 1)  # [b, k]
+        neg = jnp.finfo(jnp.float32).min
+        logits = jnp.where(kv_valid[:, None, None, :], logits, neg)
+        if causal:
+            cm = jnp.arange(klen)[None, :] <= (
+                jnp.arange(qlen)[:, None] + (klen - qlen)
+            )
+            logits = jnp.where(cm[None, None], logits, neg)
+        if maybe_mask:
+            logits = logits + maybe_mask[0]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+        # zero rows past each sequence's own query length
+        q_valid = jnp.arange(qlen)[None, :] < sl.reshape(-1, 1)
+        return out * q_valid[:, None, :, None].astype(out.dtype)
+
+    args = (query, key, value, seq_lens, kv_seq_lens)
+    if mask is not None:
+        args = args + (mask,)
+    return apply(f, *args, op_name="variable_length_memory_efficient_attention")
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
+    """ref: functional/blha_get_max_len.py:19 — max encoder/decoder
+    lengths for block-layout attention setup (two reductions)."""
+    enc = apply(lambda a: jnp.max(a).reshape(1), seq_lens_encoder,
+                op_name="blha_get_max_len")
+    dec = apply(lambda a: jnp.max(a).reshape(1), seq_lens_decoder,
+                op_name="blha_get_max_len")
+    return enc, dec
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, pre_caches=None,
+                            rotary_embs=None, rotary_emb_dims=0,
+                            time_step=None, attn_mask=None,
+                            dropout_rate=0.0, activation="gelu",
+                            training=False, mode="upscale_in_train",
+                            trans_qkvw=True, ring_id=-1, name=None):
+    """ref: functional/fused_transformer.py:964 — the N-layer fused
+    transformer serving op. Each layer: pre-LN -> packed-QKV attention
+    (optional per-layer dense KV cache, layout [2, b, heads, max, hd]
+    like the reference) -> out-proj + residual -> FFN with its own LN.
+
+    Decode (``time_step`` given — Python int OR traced scalar) writes
+    the new token at ``time_step`` with a dynamic-index update and
+    attends over the FULL cache under a position mask, so the compiled
+    program's shapes never depend on the step (one compilation serves
+    the whole generation; the same fixed-shape design as
+    ops/paged_attention.py paged_attention_step). RoPE positions follow
+    ``time_step`` during decode and 0..s-1 (offset by the pre-cache
+    length) during prefill; ``rotary_embs`` accepts the reference's
+    [2, 1, 1, max_seq, head_dim] cos/sin table. ``pre_caches``
+    ([2, b, heads, pre_len, hd] per layer) prepends prompt-prefix KV in
+    the uncached/prefill path. Returns (out, cache_kvs) when caches are
+    given, else out."""
+    num_layers = len(qkv_weights)
+    out = x
+    new_caches = [] if cache_kvs is not None else None
+    use_rope = (rotary_embs is not None and rotary_emb_dims != 0) or (
+        rotary_emb_dims or 0) > 0
+
+    user_sin = user_cos = None
+    if rotary_embs is not None:
+        re_arr = rotary_embs._data if isinstance(rotary_embs, Tensor) \
+            else jnp.asarray(rotary_embs)
+        if re_arr.ndim == 5 and int(re_arr.shape[1]) == 1:
+            # reference layout [2, bsz=1, 1, max_seq, head_dim]
+            user_cos = Tensor(re_arr[0, 0, 0], _internal=True)
+            user_sin = Tensor(re_arr[1, 0, 0], _internal=True)
+        else:
+            raise ValueError(
+                "rotary_embs expects the [2, 1, 1, max_seq, head_dim] "
+                "table (per-batch tables are not supported)"
+            )
+
+    def _rope(q, k, positions, max_pos, hd):
+        # positions: [B, S] int array (traced ok)
+        sin_t, cos_t = user_sin, user_cos
+        if sin_t is None:
+            pos = jnp.arange(int(max_pos), dtype=jnp.float32)
+            inv = 1.0 / (10000.0 ** (
+                jnp.arange(0, hd, 2, jnp.float32) / hd))
+            freqs = pos[:, None] * inv[None, :]
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+            sin_t = Tensor(jnp.sin(emb), _internal=True)
+            cos_t = Tensor(jnp.cos(emb), _internal=True)
+        return fused_rotary_position_embedding(
+            q, k, None, sin=sin_t, cos=cos_t,
+            position_ids=positions)
+
+    for i in range(num_layers):
+        residual = out
+        h = int(out.shape[-1])
+        if pre_layer_norm:
+            attn_in = F.layer_norm(out, (h,), weight=ln_scales[i],
+                                   bias=ln_biases[i] if ln_biases else None,
+                                   epsilon=epsilon)
+        else:
+            attn_in = out
+        qkv_w = qkv_weights[i]
+        # reference layout (trans_qkvw=True): [3, heads, head_dim, h];
+        # trans_qkvw=False: [h, 3, heads, head_dim]
+        if trans_qkvw:
+            nheads, hd = int(qkv_w.shape[1]), int(qkv_w.shape[2])
+        else:
+            nheads, hd = int(qkv_w.shape[2]), int(qkv_w.shape[3])
+        qkv_b = qkv_biases[i] if qkv_biases else None
+
+        def qkv_proj(a, w, *maybe_b):
+            wt = w if trans_qkvw else jnp.transpose(w, (1, 2, 3, 0))
+            y = jnp.einsum("bsh,tndh->tbsnd", a, wt)
+            if maybe_b:
+                y = y + maybe_b[0].reshape(3, 1, 1, nheads, hd)
+            return y
+
+        qkv = apply(qkv_proj, attn_in, qkv_w,
+                    *([qkv_b] if qkv_b is not None else []),
+                    op_name="fused_mt_qkv")
+        q, k, v = qkv[0], qkv[1], qkv[2]  # each [b, s, heads, hd]
+        b, s = int(q.shape[0]), int(q.shape[1])
+        pre = pre_caches[i] if pre_caches is not None else None
+        pre_len = int(pre.shape[3]) if pre is not None else 0
+        cache = cache_kvs[i] if cache_kvs is not None else None
+        if cache is not None and time_step is not None:
+            if pre is not None:
+                raise NotImplementedError(
+                    "pre_caches with time_step decode: fold the prefix "
+                    "into the cache during prefill instead"
+                )
+            if s != 1:
+                raise ValueError(
+                    f"time_step decode expects one token per sequence "
+                    f"(got seq_len={s}, same contract as the reference "
+                    "kernel); run multi-token chunks through the prefill "
+                    "path"
+                )
+            max_len = int(cache.shape[3])
+            ts = time_step._data if isinstance(time_step, Tensor) \
+                else jnp.asarray(time_step, jnp.int32)
+            if use_rope:
+                q, k = _rope(q, k, Tensor(
+                    jnp.broadcast_to(ts.reshape(1, 1), (b, 1)),
+                    _internal=True), max_len, hd)
+            # dynamic-index write at time_step (fixed shapes; ts traced ok)
+            cache = apply(
+                lambda c, kk, vv, t: c
+                .at[0, :, :, t].set(jnp.swapaxes(kk, 1, 2)[:, :, 0])
+                .at[1, :, :, t].set(jnp.swapaxes(vv, 1, 2)[:, :, 0]),
+                cache, k, v, Tensor(ts, _internal=True),
+                op_name="fused_mt_cache")
+            k_full = apply(lambda c: jnp.swapaxes(c[0], 1, 2), cache,
+                           op_name="fused_mt_k")  # [b, max, heads, hd]
+            v_full = apply(lambda c: jnp.swapaxes(c[1], 1, 2), cache,
+                           op_name="fused_mt_v")
+            # position mask over the full cache: only <= time_step live
+            live = jnp.arange(max_len)[None, None, None, :] <= ts
+            m = jnp.where(live, 0.0, jnp.finfo(jnp.float32).min)
+            if attn_mask is not None:
+                am = attn_mask._data if isinstance(attn_mask, Tensor) \
+                    else jnp.asarray(attn_mask)
+                m = m + am.astype(jnp.float32)[..., :max_len]
+            attn = F.scaled_dot_product_attention(
+                q, k_full, v_full,
+                attn_mask=Tensor(m, _internal=True), training=False)
+            new_caches.append(cache)
+        else:
+            if use_rope:
+                positions = Tensor(
+                    jnp.broadcast_to(
+                        jnp.arange(pre_len, pre_len + s)[None], (b, s)),
+                    _internal=True)
+                max_pos = pre_len + max(
+                    s, int(cache.shape[3]) if cache is not None else 0)
+                q, k = _rope(q, k, positions, max_pos, hd)
+            if cache is not None:
+                cache = apply(
+                    lambda c, kk, vv: c.at[0, :, :, :s].set(
+                        jnp.swapaxes(kk, 1, 2)
+                    ).at[1, :, :, :s].set(jnp.swapaxes(vv, 1, 2)),
+                    cache, k, v, op_name="fused_mt_prefill")
+                new_caches.append(cache)
+            k_att, v_att = k, v
+            if pre is not None:
+                # prepend prompt-prefix KV ([2, b, heads, pre_len, hd])
+                k_att = apply(
+                    lambda kk, p: jnp.concatenate(
+                        [jnp.swapaxes(p[0], 1, 2), kk], axis=1),
+                    k, pre, op_name="fused_mt_prek")
+                v_att = apply(
+                    lambda vv, p: jnp.concatenate(
+                        [jnp.swapaxes(p[1], 1, 2), vv], axis=1),
+                    v, pre, op_name="fused_mt_prev")
+            attn = F.scaled_dot_product_attention(
+                q, k_att, v_att, attn_mask=attn_mask,
+                is_causal=attn_mask is None, training=training)
+        attn = attn.reshape([b, s, nheads * hd])
+        proj = F.linear(attn, linear_weights[i],
+                        linear_biases[i] if linear_biases else None)
+        out = residual + F.dropout(proj, dropout_rate, training=training,
+                                   mode=mode)
+        if not pre_layer_norm:
+            out = F.layer_norm(out, (h,), weight=ln_scales[i],
+                               bias=ln_biases[i] if ln_biases else None,
+                               epsilon=epsilon)
+        residual = out
+        if pre_layer_norm:
+            y = F.layer_norm(out, (h,), weight=ffn_ln_scales[i],
+                             bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+                             epsilon=epsilon)
+        else:
+            y = out
+        act = {"gelu": F.gelu, "relu": F.relu}[activation]
+        y = act(F.linear(y, ffn1_weights[i],
+                         ffn1_biases[i] if ffn1_biases else None))
+        y = F.linear(y, ffn2_weights[i],
+                     ffn2_biases[i] if ffn2_biases else None)
+        out = residual + F.dropout(y, dropout_rate, training=training,
+                                   mode=mode)
+        if not pre_layer_norm:
+            out = F.layer_norm(out, (h,), weight=ffn_ln_scales[i],
+                               bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+                               epsilon=epsilon)
+    if new_caches is not None:
+        return out, new_caches
+    return out

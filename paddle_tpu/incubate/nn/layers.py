@@ -1,0 +1,374 @@
+"""incubate.nn fused Layers (ref: python/paddle/incubate/nn/layer/
+fused_transformer.py:116,271,545,759,970, fused_ec_moe.py,
+fused_dropout_add.py, fused_linear.py).
+
+The reference backs these with hand-fused CUDA kernels; here each Layer
+owns the same parameters (packed QKV, paired expert bmm weights, …) and
+forwards through incubate.nn.functional, whose op chains XLA fuses —
+the Layer surface is the parity contract, the fusion is the compiler's.
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn.initializer import Constant
+from ...nn.layer.layers import Layer
+from . import functional as IF
+
+__all__ = [
+    "FusedMultiHeadAttention",
+    "FusedFeedForward",
+    "FusedTransformerEncoderLayer",
+    "FusedMultiTransformer",
+    "FusedLinear",
+    "FusedBiasDropoutResidualLayerNorm",
+    "FusedEcMoe",
+    "FusedDropoutAdd",
+]
+
+
+class FusedLinear(Layer):
+    """ref: layer/fused_linear.py — Linear over fused_matmul_bias."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        if transpose_weight:
+            weight_shape = [out_features, in_features]
+        else:
+            weight_shape = [in_features, out_features]
+        self.weight = self.create_parameter(shape=weight_shape,
+                                            attr=weight_attr)
+        self.bias = self.create_parameter(shape=[out_features],
+                                          attr=bias_attr, is_bias=True)
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        return IF.fused_matmul_bias(x, self.weight, self.bias,
+                                    transpose_y=self.transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    """ref: layer/fused_dropout_add.py — dropout(x) + y."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return IF.fused_dropout_add(x, y, p=self.p, training=self.training,
+                                    mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """ref: layer/fused_transformer.py:116 —
+    layer_norm(residual + dropout(bias + x))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        assert embed_dim > 0, (
+            f"Expected embed_dim to be greater than 0, but received {embed_dim}"
+        )
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = self.create_parameter(shape=[embed_dim],
+                                                 attr=bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=weight_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(shape=[embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        return IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training,
+        )
+
+
+class FusedMultiHeadAttention(Layer):
+    """ref: layer/fused_transformer.py:271 — packed-QKV attention with
+    pre/post LN, forwarded through fused_multi_head_attention."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, transpose_qkv_wb=False, name=None):
+        super().__init__()
+        assert embed_dim > 0 and num_heads > 0
+        assert embed_dim % num_heads == 0
+        if need_weights:
+            raise NotImplementedError(
+                "need_weights=True is unsupported (the fused path never "
+                "materializes attention probabilities; use "
+                "nn.MultiHeadAttention for weights)"
+            )
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+        # packed layout [3, heads, head_dim, embed] (ref trans_qkvw=True)
+        self.qkv_weight = self.create_parameter(
+            shape=[3, num_heads, self.head_dim, embed_dim],
+            attr=qkv_weight_attr)
+        self.qkv_bias = self.create_parameter(
+            shape=[3, num_heads, self.head_dim], attr=qkv_bias_attr,
+            is_bias=True)
+        self.linear_weight = self.create_parameter(
+            shape=[embed_dim, embed_dim], attr=linear_weight_attr)
+        self.linear_bias = self.create_parameter(
+            shape=[embed_dim], attr=linear_bias_attr, is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(shape=[embed_dim],
+                                                 is_bias=True)
+        self.ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=ln_scale_attr, default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(shape=[embed_dim], is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        # the functional op takes the bias flattened to [3*embed_dim]
+        # (the packed [3, heads, head_dim] layout is the parameter's)
+        qkv_bias = self.qkv_bias.reshape([3 * self.embed_dim])
+        return IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self._epsilon, qkv_bias=qkv_bias,
+            linear_bias=self.linear_bias, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training,
+        )
+
+
+class FusedFeedForward(Layer):
+    """ref: layer/fused_transformer.py:545 — pre/post-LN FFN block."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert d_model > 0 and dim_feedforward > 0
+        self._d_model = d_model
+        self._dim_feedforward = dim_feedforward
+        self._dropout_rate = dropout_rate
+        self._act_dropout_rate = (
+            dropout_rate if act_dropout_rate is None else act_dropout_rate)
+        self._activation = activation
+        self._normalize_before = normalize_before
+        self._epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            shape=[d_model, dim_feedforward], attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter(
+            shape=[dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            shape=[dim_feedforward, d_model], attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter(
+            shape=[d_model], attr=linear2_bias_attr, is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            shape=[d_model], attr=ln1_scale_attr, default_initializer=Constant(1.0))
+        self.ln1_bias = self.create_parameter(shape=[d_model], is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            shape=[d_model], attr=ln2_scale_attr, default_initializer=Constant(1.0))
+        self.ln2_bias = self.create_parameter(shape=[d_model], is_bias=True)
+
+    def forward(self, src, cache=None):
+        return IF.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self._act_dropout_rate,
+            dropout2_rate=self._dropout_rate,
+            activation=self._activation, ln1_epsilon=self._epsilon,
+            ln2_epsilon=self._epsilon,
+            pre_layer_norm=self._normalize_before, training=self.training,
+        )
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """ref: layer/fused_transformer.py:759 — FusedMultiHeadAttention +
+    FusedFeedForward with shared dropout defaults."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        assert d_model > 0 and nhead > 0 and dim_feedforward > 0
+        attn_dropout_rate = (
+            dropout_rate if attn_dropout_rate is None else attn_dropout_rate)
+        act_dropout_rate = (
+            dropout_rate if act_dropout_rate is None else act_dropout_rate)
+        self.normalize_before = normalize_before
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before,
+        )
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before,
+        )
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """ref: layer/fused_transformer.py:970 — N fused decoder layers for
+    serving, forwarded through functional.fused_multi_transformer
+    (dense per-layer KV caches, decode-at-time_step)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, ln_bias_attrs=None,
+                 qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None,
+                 epsilon=1e-5, num_layers=-1, nranks=1, trans_qkvw=True,
+                 ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim > 0 and num_heads > 0 and dim_feedforward > 0
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer is a pre-LN serving stack "
+                "(ref kernel asserts pre_layer_norm too)"
+            )
+        if num_layers < 0:
+            num_layers = (
+                len(qkv_weight_attrs)
+                if isinstance(qkv_weight_attrs, (list, tuple)) else 1
+            )
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        head_dim = embed_dim // num_heads
+        self._epsilon = epsilon
+        self._trans_qkvw = trans_qkvw
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+
+        def attr_at(attrs, i):
+            return attrs[i] if isinstance(attrs, (list, tuple)) else attrs
+
+        for i in range(num_layers):
+            self.ln_scales.append(self.create_parameter(
+                shape=[embed_dim], attr=attr_at(ln_scale_attrs, i),
+                default_initializer=Constant(1.0)))
+            self.ln_biases.append(self.create_parameter(
+                shape=[embed_dim], attr=attr_at(ln_bias_attrs, i),
+                is_bias=True))
+            qkv_shape = ([3, num_heads, head_dim, embed_dim] if trans_qkvw
+                         else [embed_dim, 3, num_heads, head_dim])
+            self.qkv_weights.append(self.create_parameter(
+                shape=qkv_shape, attr=attr_at(qkv_weight_attrs, i)))
+            self.qkv_biases.append(self.create_parameter(
+                shape=[3, num_heads, head_dim],
+                attr=attr_at(qkv_bias_attrs, i), is_bias=True))
+            self.linear_weights.append(self.create_parameter(
+                shape=[embed_dim, embed_dim],
+                attr=attr_at(linear_weight_attrs, i)))
+            self.linear_biases.append(self.create_parameter(
+                shape=[embed_dim], attr=attr_at(linear_bias_attrs, i),
+                is_bias=True))
+            self.ffn_ln_scales.append(self.create_parameter(
+                shape=[embed_dim], attr=attr_at(ffn_ln_scale_attrs, i),
+                default_initializer=Constant(1.0)))
+            self.ffn_ln_biases.append(self.create_parameter(
+                shape=[embed_dim], attr=attr_at(ffn_ln_bias_attrs, i),
+                is_bias=True))
+            self.ffn1_weights.append(self.create_parameter(
+                shape=[embed_dim, dim_feedforward],
+                attr=attr_at(ffn1_weight_attrs, i)))
+            self.ffn1_biases.append(self.create_parameter(
+                shape=[dim_feedforward], attr=attr_at(ffn1_bias_attrs, i),
+                is_bias=True))
+            self.ffn2_weights.append(self.create_parameter(
+                shape=[dim_feedforward, embed_dim],
+                attr=attr_at(ffn2_weight_attrs, i)))
+            self.ffn2_biases.append(self.create_parameter(
+                shape=[embed_dim], attr=attr_at(ffn2_bias_attrs, i),
+                is_bias=True))
+            for nm, plist in (
+                ("ln_scale", self.ln_scales), ("ln_bias", self.ln_biases),
+                ("qkv_weight", self.qkv_weights), ("qkv_bias", self.qkv_biases),
+                ("linear_weight", self.linear_weights),
+                ("linear_bias", self.linear_biases),
+                ("ffn_ln_scale", self.ffn_ln_scales),
+                ("ffn_ln_bias", self.ffn_ln_biases),
+                ("ffn1_weight", self.ffn1_weights),
+                ("ffn1_bias", self.ffn1_biases),
+                ("ffn2_weight", self.ffn2_weights),
+                ("ffn2_bias", self.ffn2_biases),
+            ):
+                setattr(self, f"{nm}_{i}", plist[i])
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, time_step=None,
+                seq_lens=None):
+        ts = time_step  # int OR traced scalar (fixed-shape decode)
+        return IF.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=True, epsilon=self._epsilon, cache_kvs=caches,
+            pre_caches=pre_caches, rotary_embs=rotary_embs,
+            rotary_emb_dims=rotary_emb_dims, time_step=ts,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            activation=self.activation, training=self.training,
+            trans_qkvw=self._trans_qkvw,
+        )
+
+
+class FusedEcMoe(Layer):
+    """ref: layer/fused_ec_moe.py — dense expert-choice MoE block."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.bmm0_weight = self.create_parameter(
+            shape=[num_experts, hidden_size, inter_size], attr=weight_attr)
+        self.bmm0_bias = self.create_parameter(
+            shape=[num_experts, 1, inter_size], attr=bias_attr, is_bias=True)
+        self.bmm1_weight = self.create_parameter(
+            shape=[num_experts, inter_size, hidden_size], attr=weight_attr)
+        self.bmm1_bias = self.create_parameter(
+            shape=[num_experts, 1, hidden_size], attr=bias_attr, is_bias=True)
+        self.act_type = act_type
+        if self.act_type not in ("gelu", "relu"):
+            raise NotImplementedError("Currently only support `gelu`, `relu`.")
+
+    def forward(self, x, gate):
+        return IF.fused_ec_moe(
+            x, gate, self.bmm0_weight, self.bmm0_bias, self.bmm1_weight,
+            self.bmm1_bias, self.act_type,
+        )

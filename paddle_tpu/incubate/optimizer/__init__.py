@@ -1,0 +1,7 @@
+"""paddle.incubate.optimizer (ref: python/paddle/incubate/optimizer/
+__init__.py — __all__ = ['LBFGS']; LookAhead/ModelAverage are exported
+from paddle.incubate directly, see incubate/__init__.py)."""
+from ...optimizer.lbfgs import LBFGS  # noqa: F401
+from . import functional  # noqa: F401
+
+__all__ = ["LBFGS"]

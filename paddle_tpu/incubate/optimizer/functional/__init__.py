@@ -1,0 +1,202 @@
+"""paddle.incubate.optimizer.functional — functional quasi-Newton
+minimizers (ref: incubate/optimizer/functional/bfgs.py:27 minimize_bfgs,
+lbfgs.py minimize_lbfgs; Nocedal & Wright Alg. 6.1 / 7.5).
+
+TPU-native design: the whole minimization loop is a host-side Python
+loop over jitted value-and-gradient evaluations of the user's
+objective (the tape runs under jax.vjp). Strong-Wolfe line search with
+cubic-ish bisection zoom, matching the reference's only supported
+line_search_fn."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....base.tensor import Tensor
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _value_and_grad(objective_func):
+    def pure(x):
+        out = objective_func(Tensor(x, stop_gradient=False, _internal=True))
+        return out._data if isinstance(out, Tensor) else jnp.asarray(out)
+
+    vag = jax.value_and_grad(pure)
+    calls = [0]
+
+    def f(x):
+        calls[0] += 1
+        v, g = vag(x)
+        return float(v), np.asarray(g, np.float64)
+
+    return f, calls
+
+
+def _strong_wolfe(f, x, p, f0, g0, max_iters, alpha0, c1=1e-4, c2=0.9):
+    """Strong-Wolfe line search (Nocedal & Wright Alg. 3.5/3.6)."""
+    d0 = float(g0 @ p)
+    if d0 >= 0:
+        return 0.0, f0, g0  # not a descent direction; give up
+
+    def phi(a):
+        v, g = f(x + a * p)
+        return v, g, float(g @ p)
+
+    a_prev, f_prev = 0.0, f0
+    a = alpha0
+    f_hi = g_hi = None
+    for i in range(max_iters):
+        fa, ga, da = phi(a)
+        if fa > f0 + c1 * a * d0 or (i > 0 and fa >= f_prev):
+            return _zoom(phi, a_prev, f_prev, a, f0, d0, max_iters, c1, c2)
+        if abs(da) <= -c2 * d0:
+            return a, fa, ga
+        if da >= 0:
+            return _zoom(phi, a, fa, a_prev, f0, d0, max_iters, c1, c2)
+        a_prev, f_prev = a, fa
+        a = min(2 * a, 1e10)
+    return a, fa, ga
+
+
+def _zoom(phi, lo, f_lo, hi, f0, d0, max_iters, c1, c2):
+    g_best = None
+    for _ in range(max_iters):
+        a = 0.5 * (lo + hi)
+        fa, ga, da = phi(a)
+        if fa > f0 + c1 * a * d0 or fa >= f_lo:
+            hi = a
+        else:
+            if abs(da) <= -c2 * d0:
+                return a, fa, ga
+            if da * (hi - lo) >= 0:
+                hi = lo
+            lo, f_lo, g_best = a, fa, ga
+        if abs(hi - lo) < 1e-12:
+            break
+    fa, ga, _ = phi(lo)
+    return lo, fa, ga
+
+
+def _pack_result(converged, calls, x, fx, gx, h, dtype):
+    mk = lambda a: Tensor(jnp.asarray(a, dtype), _internal=True)  # noqa: E731
+    return (
+        Tensor(jnp.asarray(bool(converged)), _internal=True),
+        Tensor(jnp.asarray(calls, jnp.int32), _internal=True),
+        mk(x), mk(fx), mk(gx), mk(h),
+    )
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    """ref: functional/bfgs.py:27 — full inverse-Hessian BFGS. Returns
+    (is_converge, num_func_calls, position, objective_value,
+    objective_gradient, inverse_hessian_estimate)."""
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError("only line_search_fn='strong_wolfe'")
+    f, calls = _value_and_grad(objective_func)
+    x = np.asarray(
+        initial_position._data if isinstance(initial_position, Tensor)
+        else initial_position, np.float64).reshape(-1)
+    n = x.size
+    if initial_inverse_hessian_estimate is not None:
+        H = np.asarray(
+            initial_inverse_hessian_estimate._data
+            if isinstance(initial_inverse_hessian_estimate, Tensor)
+            else initial_inverse_hessian_estimate, np.float64)
+    else:
+        H = np.eye(n)
+    fx, gx = f(x)
+    converged = False
+    for _ in range(max_iters):
+        if np.abs(gx).max() <= tolerance_grad:
+            converged = True
+            break
+        p = -H @ gx
+        a, f_new, g_new = _strong_wolfe(
+            f, x, p, fx, gx, max_line_search_iters, initial_step_length)
+        if a == 0.0:
+            break
+        s = a * p
+        y = g_new - gx
+        x_new = x + s
+        if (abs(f_new - fx) <= tolerance_change
+                and np.abs(s).max() <= tolerance_change):
+            x, fx, gx = x_new, f_new, g_new
+            converged = True
+            break
+        sy = float(s @ y)
+        if sy > 1e-10:
+            rho = 1.0 / sy
+            I_ = np.eye(n)
+            V = I_ - rho * np.outer(s, y)
+            H = V @ H @ V.T + rho * np.outer(s, s)
+        x, fx, gx = x_new, f_new, g_new
+    else:
+        converged = bool(np.abs(gx).max() <= tolerance_grad)
+    return _pack_result(converged, calls[0], x, fx, gx, H, dtype)
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7, tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    """ref: functional/lbfgs.py — limited-memory BFGS with the two-loop
+    recursion (history of (s, y) pairs instead of a dense H)."""
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError("only line_search_fn='strong_wolfe'")
+    f, calls = _value_and_grad(objective_func)
+    x = np.asarray(
+        initial_position._data if isinstance(initial_position, Tensor)
+        else initial_position, np.float64).reshape(-1)
+    fx, gx = f(x)
+    S, Y = [], []
+    converged = False
+    for _ in range(max_iters):
+        if np.abs(gx).max() <= tolerance_grad:
+            converged = True
+            break
+        # two-loop recursion
+        q = gx.copy()
+        alphas = []
+        for s, y in reversed(list(zip(S, Y))):
+            rho = 1.0 / float(s @ y)
+            a_i = rho * float(s @ q)
+            alphas.append((a_i, rho, s, y))
+            q -= a_i * y
+        gamma = (float(S[-1] @ Y[-1]) / float(Y[-1] @ Y[-1])) if S else 1.0
+        r = gamma * q
+        for a_i, rho, s, y in reversed(alphas):
+            b = rho * float(y @ r)
+            r += (a_i - b) * s
+        p = -r
+        a, f_new, g_new = _strong_wolfe(
+            f, x, p, fx, gx, max_line_search_iters, initial_step_length)
+        if a == 0.0:
+            break
+        s, y = a * p, g_new - gx
+        x_new = x + s
+        if (abs(f_new - fx) <= tolerance_change
+                and np.abs(s).max() <= tolerance_change):
+            x, fx, gx = x_new, f_new, g_new
+            converged = True
+            break
+        if float(s @ y) > 1e-10:
+            S.append(s)
+            Y.append(y)
+            if len(S) > history_size:
+                S.pop(0)
+                Y.pop(0)
+        x, fx, gx = x_new, f_new, g_new
+    else:
+        converged = bool(np.abs(gx).max() <= tolerance_grad)
+    # the reference returns the (dense) inverse-Hessian estimate slot as
+    # the implicit gamma*I used by the two-loop recursion
+    gamma = (float(S[-1] @ Y[-1]) / float(Y[-1] @ Y[-1])) if S else 1.0
+    H = gamma * np.eye(x.size)
+    return _pack_result(converged, calls[0], x, fx, gx, H, dtype)

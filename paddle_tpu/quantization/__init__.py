@@ -338,3 +338,7 @@ class PTQ:
     def convert(self, model: Layer, inplace: bool = False,
                 execute_dtype: str | None = None) -> Layer:
         return self._qat.convert(model, inplace, execute_dtype=execute_dtype)
+
+# submodule namespaces (ref: quantization/{observers,quanters}/)
+from . import observers  # noqa: E402,F401
+from . import quanters  # noqa: E402,F401

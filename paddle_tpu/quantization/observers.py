@@ -1,0 +1,58 @@
+"""paddle.quantization.observers (ref: python/paddle/quantization/
+observers/__init__.py — AbsmaxObserver in abs_max.py,
+GroupWiseWeightObserver in groupwise.py:23)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base.tape import apply
+from . import AbsmaxObserver, BaseObserver  # noqa: F401
+
+__all__ = ["AbsmaxObserver", "GroupWiseWeightObserver"]
+
+
+class GroupWiseWeightObserver(BaseObserver):
+    """Per-group abs-max over a 2-D weight (ref: groupwise.py:46 — the
+    weight-only-quant calibration used for group-quantized int4/int8
+    LLM serving): columns are scanned in ``group_size`` chunks of input
+    channels, one scale per (out_channel, group)."""
+
+    def __init__(self, quant_bits: int = 8, group_size: int = 128):
+        super().__init__()
+        if group_size not in (64, 128):
+            raise ValueError("group_size only supports 64 or 128")
+        self.quant_bits = quant_bits
+        self.group_size = group_size
+        self._max = None
+
+    def forward(self, x):
+        def f(w):
+            if w.ndim != 2:
+                raise ValueError("GroupWiseWeightObserver expects 2-D weights")
+            cin, cout = w.shape
+            if cin % self.group_size:
+                raise ValueError(
+                    f"group_size {self.group_size} must divide input "
+                    f"channels {cin}"
+                )
+            g = w.T.reshape(cout, cin // self.group_size, self.group_size)
+            m = jnp.abs(g).max(axis=2).astype(jnp.float32)
+            return jnp.maximum(m, 1e-8)
+
+        self._max = apply(f, x, op_name="groupwise_absmax")
+        return x
+
+    def scales(self):
+        if self._max is None:
+            raise RuntimeError("observer has not seen a weight yet")
+        bound = 2 ** (self.quant_bits - 1) - 1
+        return self._max / bound
+
+    def zero_points(self):
+        return None
+
+    def bit_length(self):
+        return self.quant_bits
+
+    def quant_axis(self):
+        return 0

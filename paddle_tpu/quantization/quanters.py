@@ -1,0 +1,5 @@
+"""paddle.quantization.quanters (ref: python/paddle/quantization/
+quanters/__init__.py — FakeQuanterWithAbsMaxObserver in abs_max.py)."""
+from . import FakeQuanterWithAbsMaxObserver  # noqa: F401
+
+__all__ = ["FakeQuanterWithAbsMaxObserver"]

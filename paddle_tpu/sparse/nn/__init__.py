@@ -11,8 +11,9 @@ from . import functional  # noqa: F401
 from ...nn.layer.layers import Layer
 
 __all__ = [
-    "Conv3D", "SubmConv3D", "BatchNorm", "SyncBatchNorm",
-    "ReLU", "ReLU6", "LeakyReLU", "Softmax", "MaxPool3D", "functional",
+    "Conv2D", "SubmConv2D", "Conv3D", "SubmConv3D", "BatchNorm",
+    "SyncBatchNorm", "ReLU", "ReLU6", "LeakyReLU", "Softmax", "MaxPool3D",
+    "functional",
 ]
 
 
@@ -72,6 +73,63 @@ class SubmConv3D(_Conv3DBase):
 
     def forward(self, x):
         return functional.subm_conv3d(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._dilation,
+        )
+
+
+class _Conv2DBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC",
+                 key=None):
+        super().__init__()
+        if groups != 1:
+            raise ValueError("sparse conv supports groups=1")
+        if padding_mode != "zeros":
+            raise ValueError("sparse conv supports padding_mode='zeros'")
+        if data_format != "NHWC":
+            raise ValueError("sparse conv2d uses the NHWC sparse layout")
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = functional._tup(kernel_size, 2)
+        self._stride = functional._tup(stride, 2)
+        self._padding = functional._tup(padding, 2)
+        self._dilation = functional._tup(dilation, 2)
+        kh, kw = self._kernel_size
+        fan_in = in_channels * kh * kw
+        bound = 1.0 / np.sqrt(fan_in)
+        from ...nn import initializer as I
+
+        self.weight = self.create_parameter(
+            shape=[kh, kw, in_channels, out_channels],
+            attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound),
+        )
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[out_channels], is_bias=True, attr=bias_attr,
+                default_initializer=I.Uniform(-bound, bound),
+            )
+        else:
+            self.bias = None
+
+
+class Conv2D(_Conv2DBase):
+    """Sparse 2-D conv (ref: sparse/nn/layer/conv.py Conv2D)."""
+
+    def forward(self, x):
+        return functional.conv2d(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._dilation,
+        )
+
+
+class SubmConv2D(_Conv2DBase):
+    """Submanifold sparse 2-D conv (ref: conv.py SubmConv2D)."""
+
+    def forward(self, x):
+        return functional.subm_conv2d(
             x, self.weight, self.bias, self._stride, self._padding,
             self._dilation,
         )

@@ -36,13 +36,7 @@ from .. import SparseCooTensor
 
 
 def _tup3(v) -> Tuple[int, int, int]:
-    if isinstance(v, (list, tuple)):
-        if len(v) == 3:
-            return tuple(int(x) for x in v)
-        if len(v) == 1:
-            return (int(v[0]),) * 3
-        raise ValueError(f"need 1 or 3 entries, got {v!r}")
-    return (int(v),) * 3
+    return _tup(v, 3)
 
 
 def _coords_values(x: SparseCooTensor):
@@ -76,12 +70,17 @@ def _cached_rulebook(coords, spatial, kernel, stride, padding, dilation,
 def _build_rulebook(coords, spatial, kernel, stride, padding, dilation,
                     subm: bool):
     """(out_coords, per-offset (in_rows, out_rows)) — the sparse-conv
-    rulebook (ref: conv_kernel.cu's hash-table product), on host."""
-    kd, kh, kw = kernel
-    sd, sh, sw = stride
-    pd, ph, pw = padding
-    dd, dh, dw = dilation
-    D, H, W = spatial
+    rulebook (ref: conv_kernel.cu's hash-table product), on host.
+    Dimension-generic: spatial/kernel/stride/... are length-nd tuples
+    (nd=2 for conv2d, nd=3 for conv3d); coords rows are [n, *pos]."""
+    import itertools
+
+    nd = len(spatial)
+    out_sizes = tuple(
+        (spatial[d] + 2 * padding[d] - dilation[d] * (kernel[d] - 1) - 1)
+        // stride[d] + 1
+        for d in range(nd)
+    )
 
     in_map = {tuple(c): i for i, c in enumerate(coords)}
     if subm:
@@ -91,67 +90,77 @@ def _build_rulebook(coords, spatial, kernel, stride, padding, dilation,
         out_map = {}
         out_list = []
 
-    oD = (D + 2 * pd - dd * (kd - 1) - 1) // sd + 1
-    oH = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
-    oW = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
-
     pairs = {}
-    for oz in range(kd):
-        for oy in range(kh):
-            for ox in range(kw):
-                k = (oz * kh + oy) * kw + ox
-                ins, outs = [], []
-                for i, (n, z, y, xx) in enumerate(coords):
-                    # output position this input feeds through offset k
-                    tz = z + pd - oz * dd
-                    ty = y + ph - oy * dh
-                    tx = xx + pw - ox * dw
-                    if tz % sd or ty % sh or tx % sw:
-                        continue
-                    tz, ty, tx = tz // sd, ty // sh, tx // sw
-                    if not (0 <= tz < oD and 0 <= ty < oH and 0 <= tx < oW):
-                        continue
-                    key = (n, tz, ty, tx)
-                    if subm:
-                        j = out_map.get(key)
-                        if j is None:
-                            continue
-                    else:
-                        j = out_map.get(key)
-                        if j is None:
-                            j = len(out_list)
-                            out_map[key] = j
-                            out_list.append(key)
-                    ins.append(i)
-                    outs.append(j)
-                if ins:
-                    pairs[k] = (np.asarray(ins, np.int32),
-                                np.asarray(outs, np.int32))
+    for offs in itertools.product(*[range(k) for k in kernel]):
+        k = 0
+        for d in range(nd):
+            k = k * kernel[d] + offs[d]
+        ins, outs = [], []
+        for i, row in enumerate(coords):
+            n, pos = row[0], row[1:]
+            # output position this input feeds through offset k
+            t = []
+            ok = True
+            for d in range(nd):
+                td = pos[d] + padding[d] - offs[d] * dilation[d]
+                if td % stride[d]:
+                    ok = False
+                    break
+                td //= stride[d]
+                if not 0 <= td < out_sizes[d]:
+                    ok = False
+                    break
+                t.append(td)
+            if not ok:
+                continue
+            key = (n, *t)
+            j = out_map.get(key)
+            if j is None:
+                if subm:
+                    continue
+                j = len(out_list)
+                out_map[key] = j
+                out_list.append(key)
+            ins.append(i)
+            outs.append(j)
+        if ins:
+            pairs[k] = (np.asarray(ins, np.int32),
+                        np.asarray(outs, np.int32))
     if not subm:
-        out_coords = np.asarray(out_list, np.int64).reshape(-1, 4)
-    return out_coords, pairs, (oD, oH, oW)
+        out_coords = np.asarray(out_list, np.int64).reshape(-1, nd + 1)
+    return out_coords, pairs, out_sizes
+
+
+def _tup(v, nd: int):
+    if isinstance(v, (list, tuple)):
+        if len(v) == nd:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return (int(v[0]),) * nd
+        raise ValueError(f"need 1 or {nd} entries, got {v!r}")
+    return (int(v),) * nd
 
 
 def _sparse_conv(x: SparseCooTensor, weight, bias, stride, padding,
-                 dilation, subm: bool, op_name: str) -> SparseCooTensor:
-    """Shared conv3d / subm_conv3d body.
-
-    x dense shape [N, D, H, W, C_in] (the reference's NDHWC sparse
-    layout); weight [kd, kh, kw, C_in, C_out]."""
+                 dilation, subm: bool, op_name: str,
+                 nd: int = 3) -> SparseCooTensor:
+    """Shared gather-GEMM-scatter body for conv2d/3d and their subm
+    variants. x dense shape [N, *spatial, C_in] (the reference's
+    NDHWC/NHWC sparse layouts); weight [*kernel, C_in, C_out]."""
     import jax.experimental.sparse as jsparse
 
     shape = x.shape
-    if len(shape) != 5:
+    if len(shape) != nd + 2:
         raise ValueError(
-            f"sparse conv3d expects a 5-D [N, D, H, W, C] input, got "
-            f"{shape}"
+            f"sparse conv{nd}d expects a {nd + 2}-D [N, *spatial, C] "
+            f"input, got {shape}"
         )
     wshape = tuple((weight._data if isinstance(weight, Tensor) else weight).shape)
-    kernel = wshape[:3]
+    kernel = wshape[:nd]
     coords, values = _coords_values(x)
     out_coords, pairs, out_spatial = _cached_rulebook(
-        coords, shape[1:4], kernel, _tup3(stride), _tup3(padding),
-        _tup3(dilation), subm,
+        coords, shape[1 : nd + 1], kernel, _tup(stride, nd),
+        _tup(padding, nd), _tup(dilation, nd), subm,
     )
     n_out = len(out_coords)
     c_out = wshape[-1]
@@ -160,7 +169,7 @@ def _sparse_conv(x: SparseCooTensor, weight, bias, stride, padding,
     args = [vt, weight] + ([bias] if bias is not None else [])
 
     def run(vals, w, *maybe_bias):
-        w2 = w.reshape(-1, w.shape[3], w.shape[4])  # [K^3, C_in, C_out]
+        w2 = w.reshape(-1, w.shape[-2], w.shape[-1])  # [prod(K), C_in, C_out]
         out = jnp.zeros((n_out, c_out), vals.dtype)
         for k, (ins, outs) in pairs.items():
             contrib = vals[ins] @ w2[k].astype(vals.dtype)  # MXU GEMM
@@ -345,3 +354,44 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
 
     extra = [t for t in (key_padding_mask, attn_mask) if t is not None]
     return apply(run, query, key, value, *extra, op_name="sparse_attention")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NHWC", name=None):
+    """Sparse 2-D convolution (ref: sparse/nn/functional/conv.py conv2d;
+    same gather-GEMM-scatter rulebook as conv3d with nd=2)."""
+    if groups != 1:
+        raise ValueError("sparse conv2d supports groups=1")
+    if data_format != "NHWC":
+        raise ValueError("sparse conv2d uses the NHWC sparse layout")
+    return _sparse_conv(x, weight, bias, stride, padding, dilation,
+                        subm=False, op_name="sparse_conv2d", nd=2)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    """Submanifold sparse 2-D conv (ref: conv.py subm_conv2d): output
+    coordinates == input coordinates."""
+    if groups != 1:
+        raise ValueError("sparse subm_conv2d supports groups=1")
+    if data_format != "NHWC":
+        raise ValueError("sparse subm_conv2d uses the NHWC sparse layout")
+    return _sparse_conv(x, weight, bias, stride, padding, dilation,
+                        subm=True, op_name="sparse_subm_conv2d", nd=2)
+
+
+def subm_conv2d_igemm(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                      groups=1, data_format="NHWC", key=None, name=None):
+    """ref: conv.py subm_conv2d_igemm — the reference's implicit-GEMM
+    kernel variant; here every rulebook offset already lowers to one
+    dense GEMM on the MXU, so the igemm entry point IS the regular
+    path."""
+    return subm_conv2d(x, weight, bias, stride, padding, dilation, groups,
+                       data_format, key, name)
+
+
+def subm_conv3d_igemm(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                      groups=1, data_format="NDHWC", key=None, name=None):
+    """ref: conv.py subm_conv3d_igemm — see subm_conv2d_igemm."""
+    return subm_conv3d(x, weight, bias, stride, padding, dilation, groups,
+                       data_format, key, name)

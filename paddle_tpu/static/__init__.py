@@ -22,6 +22,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from . import nn  # noqa: F401
+
 __all__ = ["InputSpec", "save_inference_model", "load_inference_model",
            "Program", "Executor", "default_main_program"]
 

@@ -1,0 +1,701 @@
+"""paddle.static.nn — the static-graph layer helpers.
+
+ref: python/paddle/static/nn/__init__.py (38 names; common.py fc/
+group_norm/…, control_flow.py cond/case/switch_case/while_loop,
+sequence_lod.py sequence_*).
+
+TPU-native design notes:
+
+- The reference's helpers add ops + persistent variables to a Program;
+  here execution is eager/jit, so parameter-creating helpers (``fc``,
+  ``conv2d``, ``layer_norm``, …) instantiate the matching ``nn`` Layer
+  and cache it by ``name`` — a named call reuses its parameters across
+  invocations exactly like a named variable in a Program; an unnamed
+  call creates fresh parameters each time (each program-build does
+  too). The cache lives in ``paddle.static.global_scope()``-like module
+  state and is cleared by ``paddle_tpu.static.nn.reset_parameters()``.
+- Control flow (``cond``/``case``/``switch_case``/``while_loop``)
+  delegates to the dy2static runtime (lax select/while under trace,
+  plain Python eagerly — jit/dy2static.py).
+- ``sequence_*`` ops: the reference operates on LoD tensors; the
+  TPU-native representation of ragged batches is dense padded
+  ``[B, T, ...]`` plus an explicit ``length`` tensor, so every
+  sequence op here takes/returns padded data (the reference's
+  ``sequence_pad``/``sequence_unpad`` convert between the two —
+  here padded IS the base layout, and lengths ride alongside).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import apply
+from ..base.tensor import Tensor
+from .. import nn as _nn
+from ..nn import functional as F
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad",
+    "sequence_unpad", "sequence_reshape", "sequence_scatter",
+    "sequence_enumerate",
+]
+
+# name -> (constructed Layer, build signature) — the Program's
+# persistent-variable role
+_layer_scope: dict = {}
+_anon_counter = [0]
+
+
+def reset_parameters():
+    """Drop all name-cached helper parameters (a fresh Program)."""
+    _layer_scope.clear()
+
+
+def _scoped(name: Optional[str], kind: str, build: Callable, sig=None):
+    """``sig`` carries the shape-determining arguments: a named reuse
+    with a different signature is a programming error (the reference's
+    Program raises on a shape-mismatched variable reuse too)."""
+    if name is None:
+        _anon_counter[0] += 1
+        return build()  # fresh params, like a new program op
+    key = (kind, name)
+    hit = _layer_scope.get(key)
+    if hit is not None:
+        layer, old_sig = hit
+        if sig != old_sig:
+            raise ValueError(
+                f"static.nn.{kind}(name={name!r}) reused with a different "
+                f"configuration: {sig!r} vs cached {old_sig!r}"
+            )
+        return layer
+    layer = build()
+    _layer_scope[key] = (layer, sig)
+    return layer
+
+
+# -- parameter-backed helpers ------------------------------------------------
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """ref: static/nn/common.py fc — flatten trailing dims, linear,
+    optional activation."""
+    shape = list(x.shape)
+    if num_flatten_dims < 0:
+        num_flatten_dims = len(shape) + num_flatten_dims
+    in_features = int(np.prod(shape[num_flatten_dims:]))
+    layer = _scoped(name, "fc", lambda: _nn.Linear(
+        in_features, size, weight_attr=weight_attr, bias_attr=bias_attr), sig=(in_features, size))
+    from ..tensor.manipulation import reshape
+
+    flat = reshape(x, shape[:num_flatten_dims] + [in_features])
+    out = layer(flat)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,  # noqa: A002
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False, is_test=False):
+    """ref: static/nn/common.py batch_norm."""
+    c = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
+    layer = _scoped(name, "batch_norm", lambda: _nn.BatchNorm(
+        c, momentum=momentum, epsilon=epsilon, param_attr=param_attr,
+        bias_attr=bias_attr, data_layout=data_layout,
+        use_global_stats=use_global_stats), sig=(c, data_layout))
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    return getattr(F, act)(out) if act else out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,  # noqa: A002
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    """ref: static/nn/common.py embedding."""
+    layer = _scoped(name, "embedding", lambda: _nn.Embedding(
+        size[0], size[1], padding_idx=padding_idx, weight_attr=param_attr), sig=tuple(size))
+    return layer(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,  # noqa: A002
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None, name=None):
+    """ref: static/nn/common.py sparse_embedding — the PS-backed lookup;
+    single-process lookups resolve to a dense table (the distributed
+    path lives in distributed/ps)."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype, name=name)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """ref: static/nn/common.py bilinear_tensor_product."""
+    layer = _scoped(name, "bilinear", lambda: _nn.Bilinear(
+        int(x.shape[-1]), int(y.shape[-1]), size, weight_attr=param_attr,
+        bias_attr=bias_attr), sig=(int(x.shape[-1]), int(y.shape[-1]), size))
+    out = layer(x, y)
+    return getattr(F, act)(out) if act else out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    """ref: static/nn/common.py conv2d."""
+    c = int(input.shape[1] if data_format == "NCHW" else input.shape[-1])
+    layer = _scoped(name, "conv2d", lambda: _nn.Conv2D(
+        c, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format), sig=(c, num_filters, str(filter_size), str(stride), str(padding), str(dilation), groups, data_format))
+    out = layer(input)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    """ref: static/nn/common.py conv3d."""
+    c = int(input.shape[1] if data_format == "NCDHW" else input.shape[-1])
+    layer = _scoped(name, "conv3d", lambda: _nn.Conv3D(
+        c, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format), sig=(c, num_filters, str(filter_size), str(stride), str(padding), str(dilation), groups, data_format))
+    out = layer(input)
+    return getattr(F, act)(out) if act else out
+
+
+def conv2d_transpose(input, num_filters, output_size=None,  # noqa: A002
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCHW"):
+    """ref: static/nn/common.py conv2d_transpose."""
+    c = int(input.shape[1] if data_format == "NCHW" else input.shape[-1])
+    layer = _scoped(name, "conv2d_transpose", lambda: _nn.Conv2DTranspose(
+        c, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format), sig=(c, num_filters, str(filter_size), str(stride), str(padding), str(dilation), groups, data_format))
+    out = layer(input, output_size=output_size)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None,  # noqa: A002
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCDHW"):
+    """ref: static/nn/common.py conv3d_transpose."""
+    c = int(input.shape[1] if data_format == "NCDHW" else input.shape[-1])
+    layer = _scoped(name, "conv3d_transpose", lambda: _nn.Conv3DTranspose(
+        c, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_format), sig=(c, num_filters, str(filter_size), str(stride), str(padding), str(dilation), groups, data_format))
+    out = layer(input, output_size=output_size)
+    return getattr(F, act)(out) if act else out
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size,  # noqa: A002
+                  stride=1, padding=0, dilation=1, groups=1,
+                  deformable_groups=1, im2col_step=1, param_attr=None,
+                  bias_attr=None, name=None):
+    """ref: static/nn/common.py deform_conv2d → vision deform_conv2d."""
+    from ..vision.ops import DeformConv2D
+
+    c = int(input.shape[1])
+    layer = _scoped(name, "deform_conv2d", lambda: DeformConv2D(
+        c, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups,
+        deformable_groups=deformable_groups, weight_attr=param_attr,
+        bias_attr=bias_attr), sig=(c, num_filters, str(filter_size), groups, deformable_groups))
+    return layer(input, offset, mask)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,  # noqa: A002
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    """ref: static/nn/common.py group_norm."""
+    c = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
+    layer = _scoped(name, "group_norm", lambda: _nn.GroupNorm(
+        groups, c, epsilon=epsilon, weight_attr=param_attr,
+        bias_attr=bias_attr, data_format=data_layout), sig=(groups, c))
+    out = layer(input)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None,  # noqa: A002
+                  bias_attr=None, name=None):
+    """ref: static/nn/common.py instance_norm."""
+    c = int(input.shape[1])
+    layer = _scoped(name, "instance_norm", lambda: _nn.InstanceNorm2D(
+        c, epsilon=epsilon, weight_attr=param_attr, bias_attr=bias_attr), sig=(c,))
+    return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,  # noqa: A002
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """ref: static/nn/common.py layer_norm."""
+    shape = tuple(int(s) for s in input.shape[begin_norm_axis:])
+    layer = _scoped(name, "layer_norm", lambda: _nn.LayerNorm(
+        list(shape), epsilon=epsilon,
+        weight_attr=param_attr if scale else False,
+        bias_attr=bias_attr if shift else False), sig=(shape, bool(scale), bool(shift)))
+    out = layer(input)
+    return getattr(F, act)(out) if act else out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,  # noqa: A002
+              enable_scale_and_shift=False, name=None, data_layout="NCHW",
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_rate=0.9999999, sync_stats=False):
+    """ref: static/nn/common.py data_norm — normalization by RUNNING
+    batch summaries (size/sum/square-sum accumulators) instead of
+    per-batch statistics."""
+    c = int(input.shape[-1] if data_layout != "NCHW" or len(input.shape) == 2
+            else input.shape[1])
+
+    class _DataNorm(_nn.Layer):
+        def __init__(self):
+            super().__init__()
+            from ..nn.initializer import Constant
+
+            self.batch_size = self.create_parameter(
+                [c], default_initializer=Constant(1e4))
+            self.batch_sum = self.create_parameter(
+                [c], default_initializer=Constant(0.0))
+            self.batch_square_sum = self.create_parameter(
+                [c], default_initializer=Constant(1e4))
+            if enable_scale_and_shift:
+                self.scale_w = self.create_parameter(
+                    [c], default_initializer=Constant(1.0))
+                self.bias = self.create_parameter(
+                    [c], default_initializer=Constant(0.0))
+
+        def forward(self, x):
+            def f(xx, n, s, ss, *sw):
+                mean = s / n
+                scale = jnp.sqrt(n / ss)
+                y = (xx - mean) * scale
+                if sw:
+                    y = y * sw[0] + sw[1]
+                return y
+
+            args = [x, self.batch_size, self.batch_sum,
+                    self.batch_square_sum]
+            if enable_scale_and_shift:
+                args += [self.scale_w, self.bias]
+            return apply(f, *args, op_name="data_norm")
+
+    layer = _scoped(name, "data_norm", _DataNorm, sig=(c, enable_scale_and_shift))
+    out = layer(input)
+    return getattr(F, act)(out) if act else out
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """ref: static/nn/common.py prelu — modes all/channel/element."""
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = int(x.shape[1] if data_format == "NCHW" else x.shape[-1])
+    elif mode == "element":
+        num = int(np.prod(x.shape[1:]))
+    else:
+        raise ValueError("prelu mode must be all/channel/element")
+    layer = _scoped(name, f"prelu_{mode}", lambda: _nn.PReLU(
+        num_parameters=num, weight_attr=param_attr,
+        data_format=data_format), sig=(num, mode))
+    if mode == "element":
+        from ..tensor.manipulation import reshape
+
+        flat = reshape(x, [int(x.shape[0]), num])
+        return reshape(layer(flat), list(x.shape))
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """ref: static/nn/common.py spectral_norm — weight / sigma_max via
+    power iteration (stateless: iterations run from a fixed start each
+    call, the functional form of nn.utils.spectral_norm)."""
+
+    def f(w):
+        w2 = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((w2.shape[0],), w.dtype) / np.sqrt(w2.shape[0])
+        for _ in range(max(power_iters, 1)):
+            v = w2.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = w2 @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ w2 @ v
+        return w / (sigma + eps)
+
+    return apply(f, weight, op_name="spectral_norm")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,  # noqa: A002
+             name=None):
+    """ref: static/nn/common.py row_conv — lookahead row convolution
+    over [B, T, D]: out[t] = sum_{i<=future_context} x[t+i] * w[i].
+    ``name`` (or ``param_attr.name``) keys parameter reuse like the
+    other helpers; unnamed calls create fresh weights each time."""
+    d = int(input.shape[-1])
+    k = future_context_size + 1
+    if name is None:
+        name = getattr(param_attr, "name", None)
+
+    class _RowConv(_nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter([k, d], attr=param_attr)
+
+    layer = _scoped(name, "row_conv", _RowConv, sig=(k, d))
+
+    def f(x, w):
+        pads = [(0, 0)] * x.ndim
+        pads[1] = (0, k - 1)
+        xp = jnp.pad(x, pads)
+        out = jnp.zeros_like(x)
+        for i in range(k):
+            out = out + xp[:, i : i + x.shape[1]] * w[i]
+        return out
+
+    out = apply(f, input, layer.weight, op_name="row_conv")
+    return getattr(F, act)(out) if act else out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """ref: static/nn/common.py nce — noise-contrastive estimation loss
+    with uniform negative sampling (the reference's default sampler);
+    returns the per-example NCE loss."""
+    from ..base import random as _random
+
+    d = int(input.shape[-1])
+    k = num_neg_samples or 10
+    layer = _scoped(name, "nce", lambda: _nn.Linear(
+        d, num_total_classes, weight_attr=param_attr, bias_attr=bias_attr), sig=(d, num_total_classes))
+    w, b = layer.weight, layer.bias
+
+    def f(x, y, wt, bt):
+        n = x.shape[0]
+        key = _random.next_key()
+        neg = jax.random.randint(key, (n, k), 0, num_total_classes)
+        yv = y.reshape(-1)
+        pos_logit = jnp.einsum("nd,nd->n", x, wt[:, yv].T) + bt[yv]
+        neg_logit = jnp.einsum("nd,nkd->nk", x, wt[:, neg.reshape(-1)].T
+                               .reshape(n, k, d)) + bt[neg]
+        # NCE: log sigmoid(pos) + sum log sigmoid(-neg)
+        loss = -(jax.nn.log_sigmoid(pos_logit)
+                 + jax.nn.log_sigmoid(-neg_logit).sum(-1))
+        return loss.reshape(n, 1)
+
+    return apply(f, input, label, w, b, op_name="nce")
+
+
+# -- control flow ------------------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """ref: static/nn/control_flow.py cond → dy2static convert_ifelse."""
+    from ..jit import dy2static as d2s
+
+    return d2s.convert_ret_ifelse(pred, true_fn or (lambda: None),
+                                  false_fn or (lambda: None))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """ref: control_flow.py case — first true predicate wins."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+
+    def chain(pairs):
+        (pred, fn), rest = pairs[0], pairs[1:]
+        if not rest:
+            fallback = default if default is not None else fn
+            return cond(pred, fn, fallback)
+        return cond(pred, fn, lambda: chain(rest))
+
+    return chain(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """ref: control_flow.py switch_case."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns)) if callable(branch_fns[0]) \
+            else sorted(branch_fns)
+    pairs = [(branch_index == idx, fn) for idx, fn in items]
+    if default is None:
+        default = items[-1][1]
+    return case(pairs, default=default)
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    """ref: control_flow.py while_loop → dy2static convert_while_loop."""
+    from ..jit import dy2static as d2s
+
+    def body_tupled(*vs):
+        r = body(*vs)
+        return tuple(r) if isinstance(r, (list, tuple)) else (r,)
+
+    out = d2s.convert_while_loop(cond_fn, body_tupled, tuple(loop_vars))
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """ref: control_flow.py static_pylayer — custom forward/backward
+    pair; rides PyLayer (autograd/py_layer.py)."""
+    from ..autograd import PyLayer
+
+    class _Op(PyLayer):
+        @staticmethod
+        def forward(ctx, *xs):
+            ctx.save_for_backward(*xs)
+            out = forward_fn(*xs)
+            return out
+
+        @staticmethod
+        def backward(ctx, *gouts):
+            if backward_fn is None:
+                raise RuntimeError("static_pylayer has no backward_fn")
+            return backward_fn(*gouts)
+
+    return _Op.apply(*inputs)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """ref: control_flow.py py_func — host-python op. ``out`` provides
+    the result template (shape/dtype) the callback must fill."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    structs = tuple(
+        jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(o.dtype)) for o in outs
+    )
+
+    def run(*arrs):
+        if any(isinstance(a, jax.core.Tracer) for a in arrs):
+            res = jax.pure_callback(
+                lambda *np_arrs: _host(*np_arrs), structs, *arrs)
+        else:
+            res = _host(*[np.asarray(a) for a in arrs])
+        return res[0] if len(structs) == 1 else res
+
+    def _host(*np_arrs):
+        res = func(*[Tensor(jnp.asarray(a), _internal=True) for a in np_arrs])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(
+            np.asarray(r.numpy() if isinstance(r, Tensor) else r, s.dtype)
+            for r, s in zip(res, structs)
+        )
+
+    return apply(run, *xs, op_name="py_func")
+
+
+# -- sequence ops over padded [B, T, ...] + lengths --------------------------
+
+def _lengths_mask(length, t):
+    larr = length._data if isinstance(length, Tensor) else jnp.asarray(length)
+    return jnp.arange(t)[None, :] < larr.reshape(-1, 1)
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """ref: sequence_lod.py sequence_pad. Padded-native: pads the time
+    axis to ``maxlen`` with ``pad_value`` and returns (padded, length)
+    — the identity-plus-extension in this layout."""
+    t = int(x.shape[1])
+    maxlen = maxlen or t
+    if length is None:
+        length = Tensor(jnp.full((int(x.shape[0]),), t, jnp.int32),
+                        _internal=True)
+
+    pv = float(np.asarray(
+        pad_value.numpy() if isinstance(pad_value, Tensor) else pad_value))
+
+    def f(xx):
+        if maxlen <= t:
+            return xx[:, :maxlen]
+        pads = [(0, 0)] * xx.ndim
+        pads[1] = (0, maxlen - t)
+        return jnp.pad(xx, pads, mode="constant", constant_values=pv)
+
+    return apply(f, x, op_name="sequence_pad"), length
+
+
+def sequence_unpad(x, length, name=None):
+    """ref: sequence_lod.py sequence_unpad — mask tail positions to 0
+    and trim to the longest real length."""
+    def f(xx, ll):
+        m = _lengths_mask(Tensor(ll, _internal=True), xx.shape[1])
+        shape = m.shape + (1,) * (xx.ndim - 2)
+        return xx * m.reshape(shape).astype(xx.dtype)
+
+    return apply(f, x, length, op_name="sequence_unpad")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, length=None):  # noqa: A002
+    """ref: sequence_lod.py sequence_softmax — softmax over each
+    sequence's VALID positions."""
+    def f(x, *maybe_len):
+        logits = x
+        if maybe_len:
+            m = _lengths_mask(Tensor(maybe_len[0], _internal=True),
+                              x.shape[1])
+            logits = jnp.where(m, x, jnp.finfo(jnp.float32).min)
+        return jax.nn.softmax(logits, axis=1)
+
+    args = [input] + ([length] if length is not None else [])
+    return apply(f, *args, op_name="sequence_softmax")
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,  # noqa: A002
+                  length=None, name=None):
+    """ref: sequence_lod.py sequence_pool — sum/average/sqrt/max/first/
+    last over each sequence's valid positions."""
+    pool_type = pool_type.lower()
+
+    def f(x, *maybe_len):
+        t = x.shape[1]
+        if maybe_len:
+            larr = maybe_len[0].reshape(-1)
+            m = (jnp.arange(t)[None, :] < larr[:, None])
+            m = m.reshape(m.shape + (1,) * (x.ndim - 2)).astype(x.dtype)
+        else:
+            larr = jnp.full((x.shape[0],), t)
+            m = jnp.ones((x.shape[0], t) + (1,) * (x.ndim - 2), x.dtype)
+        n = larr.reshape((-1,) + (1,) * (x.ndim - 2)).astype(jnp.float32)
+        if pool_type == "sum":
+            return (x * m).sum(1)
+        if pool_type == "average":
+            return (x * m).sum(1) / jnp.maximum(n, 1)
+        if pool_type == "sqrt":
+            return (x * m).sum(1) / jnp.sqrt(jnp.maximum(n, 1))
+        if pool_type == "max":
+            neg = jnp.finfo(jnp.float32).min
+            return jnp.where(m > 0, x, neg).max(1)
+        if pool_type == "first":
+            return x[:, 0]
+        if pool_type == "last":
+            idx = jnp.maximum(larr - 1, 0)
+            return x[jnp.arange(x.shape[0]), idx]
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    args = [input] + ([length] if length is not None else [])
+    return apply(f, *args, op_name="sequence_pool")
+
+
+def sequence_first_step(input, length=None):  # noqa: A002
+    """ref: sequence_lod.py sequence_first_step."""
+    return sequence_pool(input, "first", length=length)
+
+
+def sequence_last_step(input, length=None):  # noqa: A002
+    """ref: sequence_lod.py sequence_last_step."""
+    return sequence_pool(input, "last", length=length)
+
+
+def sequence_slice(input, offset, length, name=None):  # noqa: A002
+    """ref: sequence_lod.py sequence_slice — per-sequence [offset,
+    offset+length) window, gathered into a padded result. The output
+    keeps the FULL time width (rows masked past each slice's length)
+    so eager and traced shapes agree."""
+    def f(x, off, ln):
+        t = x.shape[1]
+        pos = off.reshape(-1, 1) + jnp.arange(t)[None, :]
+        pos = jnp.clip(pos, 0, t - 1)
+        g = jnp.take_along_axis(
+            x, pos.reshape(pos.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+            axis=1)
+        m = jnp.arange(t)[None, :] < ln.reshape(-1, 1)
+        return g * m.reshape(m.shape + (1,) * (x.ndim - 2)).astype(x.dtype)
+
+    return apply(f, input, offset, length, op_name="sequence_slice")
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,  # noqa: A002
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """ref: sequence_lod.py sequence_conv — a context-window linear over
+    the time axis ([B, T, D] padded layout)."""
+    d = int(input.shape[-1])
+    if filter_stride != 1:
+        raise ValueError("sequence_conv supports filter_stride=1")
+    layer = _scoped(name, "sequence_conv", lambda: _nn.Linear(
+        filter_size * d, num_filters, weight_attr=param_attr,
+        bias_attr=bias_attr), sig=(filter_size * d, num_filters))
+    start = padding_start if padding_start is not None \
+        else -((filter_size - 1) // 2)
+
+    def f(x):
+        t = x.shape[1]
+        cols = []
+        for i in range(filter_size):
+            shift = start + i  # time offset this filter row reads from
+            xi = jnp.roll(x, -shift, axis=1)
+            idx = jnp.arange(t) + shift
+            valid = (idx >= 0) & (idx < t)
+            cols.append(jnp.where(valid[None, :, None], xi, 0))
+        return jnp.concatenate(cols, axis=-1)
+
+    ctx = apply(f, input, op_name="sequence_conv_im2col")
+    out = layer(ctx)
+    return getattr(F, act)(out) if act else out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """ref: sequence_lod.py sequence_expand — repeat each of x's rows
+    ``times`` times (padded-native: uniform repeat count derived from
+    y's leading-dim ratio)."""
+    times = int(y.shape[0]) // int(x.shape[0])
+
+    def f(xx):
+        return jnp.repeat(xx, times, axis=0)
+
+    return apply(f, x, op_name="sequence_expand")
+
+
+def sequence_expand_as(x, y, name=None):
+    """ref: sequence_lod.py sequence_expand_as."""
+    return sequence_expand(x, y)
+
+
+def sequence_reshape(input, new_dim):  # noqa: A002
+    """ref: sequence_lod.py sequence_reshape — refold the feature dim."""
+    from ..tensor.manipulation import reshape
+
+    b = int(input.shape[0])
+    total = int(np.prod(input.shape[1:])) * 1
+    return reshape(input, [b, (total // new_dim), new_dim])
+
+
+def sequence_scatter(input, index, updates, name=None):  # noqa: A002
+    """ref: sequence_lod.py sequence_scatter — per-row scatter-add of
+    updates at time indices."""
+    def f(x, idx, upd):
+        rows = jnp.arange(x.shape[0])[:, None] + 0 * idx
+        return x.at[rows, idx].add(upd)
+
+    return apply(f, input, index, updates, op_name="sequence_scatter")
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):  # noqa: A002
+    """ref: sequence_lod.py sequence_enumerate — sliding windows of ids
+    ([B, T] -> [B, T, win_size], tail padded)."""
+    def f(x):
+        t = x.shape[1]
+        idx = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]
+        valid = idx < t
+        idx = jnp.clip(idx, 0, t - 1)
+        g = x[:, idx]
+        return jnp.where(valid[None], g, pad_value)
+
+    return apply(f, input, op_name="sequence_enumerate")

@@ -1,0 +1,317 @@
+"""incubate.nn fused ops + Layers (ref: python/paddle/incubate/nn/ —
+functional/fused_matmul_bias.py:24,118, fused_dropout_add.py:22,
+fused_layer_norm.py:21, fused_transformer.py:323,964, fused_ec_moe.py:18,
+swiglu.py:20, variable_length_memory_efficient_attention.py:28,
+blha_get_max_len.py:19; layer/fused_transformer.py:116,271,545,759,970).
+
+Each fused op is checked against its unfused composition; the
+multi-transformer's cached decode is checked against the uncached full
+forward (the serving-correctness contract)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate.nn import functional as IF
+from paddle_tpu.incubate import nn as inn
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+rng = np.random.RandomState(0)
+
+
+class TestFusedFunctional:
+    def test_fused_matmul_bias(self):
+        x, y, b = rng.randn(3, 4), rng.randn(4, 5), rng.randn(5)
+        out = IF.fused_matmul_bias(t(x), t(y), t(b))
+        np.testing.assert_allclose(out.numpy(), x @ y + b, rtol=1e-5)
+        out_t = IF.fused_matmul_bias(t(x), t(y.T), t(b), transpose_y=True)
+        np.testing.assert_allclose(out_t.numpy(), x @ y + b, rtol=1e-5)
+
+    def test_fused_linear_activation(self):
+        x, y, b = rng.randn(3, 4), rng.randn(4, 5), rng.randn(5)
+        out = IF.fused_linear_activation(t(x), t(y), t(b), activation="relu")
+        np.testing.assert_allclose(out.numpy(), np.maximum(x @ y + b, 0),
+                                   rtol=1e-5)
+        with pytest.raises(ValueError, match="gelu"):
+            IF.fused_linear_activation(t(x), t(y), t(b), activation="tanh")
+
+    def test_fused_dropout_add(self):
+        x, y = rng.randn(4, 8), rng.randn(4, 8)
+        out = IF.fused_dropout_add(t(x), t(y), p=0.0)
+        np.testing.assert_allclose(out.numpy(), x + y, rtol=1e-6)
+        # inference mode: dropout is identity
+        out_ev = IF.fused_dropout_add(t(x), t(y), p=0.9, training=False)
+        np.testing.assert_allclose(out_ev.numpy(), x + y, rtol=1e-6)
+
+    def test_swiglu_both_forms(self):
+        x, y = rng.randn(3, 8), rng.randn(3, 8)
+        want = (x / (1 + np.exp(-x))) * y
+        np.testing.assert_allclose(IF.swiglu(t(x), t(y)).numpy(), want,
+                                   rtol=1e-5)
+        packed = np.concatenate([x, y], axis=-1)
+        np.testing.assert_allclose(IF.swiglu(t(packed)).numpy(), want,
+                                   rtol=1e-5)
+
+    def test_fused_layer_norm_residual_chain(self):
+        x = rng.randn(2, 6).astype(np.float32)
+        res = rng.randn(2, 6).astype(np.float32)
+        bias = rng.randn(6).astype(np.float32)
+        w = rng.rand(6).astype(np.float32) + 0.5
+        b = rng.randn(6).astype(np.float32)
+        out = IF.fused_layer_norm(t(x), t(w), t(b), 1e-5, residual_alpha=0.7,
+                                  bias=t(bias), residual=t(res))
+        want = F.layer_norm(t(x + bias + 0.7 * res), (6,), weight=t(w),
+                            bias=t(b), epsilon=1e-5)
+        np.testing.assert_allclose(out.numpy(), want.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        # norm_weight=None -> returns the fused add only
+        out2 = IF.fused_layer_norm(t(x), None, None, 1e-5, bias=t(bias),
+                                   residual=t(res))
+        np.testing.assert_allclose(out2.numpy(), x + bias + res, rtol=1e-6)
+
+    def test_fused_bias_dropout_residual_layer_norm(self):
+        x = rng.randn(2, 3, 6).astype(np.float32)
+        res = rng.randn(2, 3, 6).astype(np.float32)
+        bias = rng.randn(6).astype(np.float32)
+        out = IF.fused_bias_dropout_residual_layer_norm(
+            t(x), t(res), bias=t(bias), dropout_rate=0.0)
+        want = F.layer_norm(t(res + x + bias), (6,), epsilon=1e-5)
+        np.testing.assert_allclose(out.numpy(), want.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_fused_ec_moe_matches_expert_loop(self):
+        b, s, d, f_, e = 2, 3, 4, 8, 3
+        x = rng.randn(b, s, d).astype(np.float32)
+        gate = rng.randn(b, s, e).astype(np.float32)
+        w0 = rng.randn(e, d, f_).astype(np.float32)
+        b0 = rng.randn(e, 1, f_).astype(np.float32)
+        w1 = rng.randn(e, f_, d).astype(np.float32)
+        b1 = rng.randn(e, 1, d).astype(np.float32)
+        out = IF.fused_ec_moe(t(x), t(gate), t(w0), t(b0), t(w1), t(b1),
+                              "relu")
+        probs = np.exp(gate) / np.exp(gate).sum(-1, keepdims=True)
+        want = np.zeros((b, s, d), np.float32)
+        for i in range(e):
+            h = np.maximum(x @ w0[i] + b0[i, 0], 0)
+            want += (h @ w1[i] + b1[i, 0]) * probs[..., i : i + 1]
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)
+
+    def test_varlen_attention_masks_tails(self):
+        b, h, s, d = 2, 2, 5, 4
+        q = rng.randn(b, h, s, d).astype(np.float32)
+        k = rng.randn(b, h, s, d).astype(np.float32)
+        v = rng.randn(b, h, s, d).astype(np.float32)
+        seq_lens = np.array([[3], [5]], np.int32)
+        out = IF.variable_length_memory_efficient_attention(
+            t(q), t(k), t(v), paddle.to_tensor(seq_lens),
+            paddle.to_tensor(seq_lens))
+        o = out.numpy()
+        # query rows past a sequence's length are zeroed
+        assert np.abs(o[0, :, 3:]).max() == 0
+        # valid rows must equal dense attention over the valid kv prefix
+        scale = 1.0 / np.sqrt(d)
+        logits = (q[0, :, :3] @ k[0, :, :3].transpose(0, 2, 1)) * scale
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(o[0, :, :3], p @ v[0, :, :3], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_blha_get_max_len(self):
+        enc, dec = IF.blha_get_max_len(
+            paddle.to_tensor(np.array([3, 9, 5], np.int32)),
+            paddle.to_tensor(np.array([7, 2, 4], np.int32)), 3)
+        assert int(enc.numpy()[0]) == 9 and int(dec.numpy()[0]) == 7
+
+
+class TestFusedLayers:
+    def test_fused_linear_trains(self):
+        paddle.seed(0)
+        lin = inn.FusedLinear(6, 3)
+        x = t(rng.randn(4, 6))
+        out = lin(x)
+        assert list(out.shape) == [4, 3]
+        out.sum().backward()
+        assert lin.weight.grad is not None
+
+    def test_fused_dropout_add_layer(self):
+        layer = inn.FusedDropoutAdd(p=0.0)
+        x, y = t(rng.randn(3, 4)), t(rng.randn(3, 4))
+        np.testing.assert_allclose(layer(x, y).numpy(),
+                                   x.numpy() + y.numpy(), rtol=1e-6)
+
+    def test_fused_bias_dropout_residual_ln_layer(self):
+        paddle.seed(0)
+        layer = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+        x, res = t(rng.randn(2, 8)), t(rng.randn(2, 8))
+        out = layer(x, res)
+        assert list(out.shape) == [2, 8]
+        np.testing.assert_allclose(out.numpy().mean(-1), 0, atol=1e-5)
+
+    def test_fused_encoder_layer_shapes_and_grads(self):
+        paddle.seed(0)
+        enc = inn.FusedTransformerEncoderLayer(
+            d_model=16, nhead=4, dim_feedforward=32, dropout_rate=0.0,
+            normalize_before=True)
+        x = t(rng.randn(2, 5, 16))
+        out = enc(x)
+        assert list(out.shape) == [2, 5, 16]
+        out.sum().backward()
+        assert enc.fused_attn.qkv_weight.grad is not None
+        assert enc.ffn.linear1_weight.grad is not None
+
+    def test_fused_ec_moe_layer(self):
+        paddle.seed(0)
+        moe = inn.FusedEcMoe(8, 16, 4, "gelu")
+        x, gate = t(rng.randn(2, 3, 8)), t(rng.randn(2, 3, 4))
+        out = moe(x, gate)
+        assert list(out.shape) == [2, 3, 8]
+        with pytest.raises(NotImplementedError):
+            inn.FusedEcMoe(8, 16, 4, "tanh")
+
+
+class TestFusedMultiTransformer:
+    def _build(self, layers=2, heads=2, dim=8, ff=16):
+        paddle.seed(7)
+        return inn.FusedMultiTransformer(
+            embed_dim=dim, num_heads=heads, dim_feedforward=ff,
+            dropout_rate=0.0, num_layers=layers)
+
+    def test_uncached_forward(self):
+        mt = self._build()
+        x = t(rng.randn(2, 4, 8))
+        out = mt(x)
+        assert list(out.shape) == [2, 4, 8]
+        out.sum().backward()
+        assert mt.qkv_weights[0].grad is not None
+
+    def test_cached_decode_matches_full_forward(self):
+        """Prefill s0 tokens into dense caches, then decode one token at
+        time_step; the decoded output must equal the uncached causal
+        forward's last position."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.base.tensor import Tensor
+
+        mt = self._build(layers=2, heads=2, dim=8)
+        mt.eval()
+        b, s0, dim, heads, hd, max_len = 1, 3, 8, 2, 4, 8
+        full = rng.randn(b, s0 + 1, dim).astype(np.float32)
+
+        out_full = mt(t(full))
+
+        caches = [
+            Tensor(jnp.zeros((2, b, heads, max_len, hd), jnp.float32),
+                   _internal=True)
+            for _ in range(2)
+        ]
+        out_pre, caches = mt(t(full[:, :s0]), caches=caches)
+        np.testing.assert_allclose(out_pre.numpy(), out_full.numpy()[:, :s0],
+                                   rtol=1e-4, atol=1e-5)
+        out_dec, caches = mt(t(full[:, s0:]), caches=caches, time_step=s0)
+        np.testing.assert_allclose(
+            out_dec.numpy()[:, 0], out_full.numpy()[:, s0], rtol=1e-4,
+            atol=1e-5)
+
+
+class TestReviewFindings:
+    def test_quant_epilogue_matches_reference_formula(self):
+        # ref quant_dequant.h:56: clip(round(max_bound*scale*x), lo, hi)
+        x = np.array([[0.5, -0.5, 2.0, -2.0]], np.float32)
+        w = np.ones(4, np.float32)
+        b = np.zeros(4, np.float32)
+        out = IF.fused_layer_norm(t(x), t(w), t(b), 1e-5, quant_scale=0.05,
+                                  quant_round_type=0, quant_max_bound=127,
+                                  quant_min_bound=-127)
+        normed = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+            x.var(-1) + 1e-5)
+        want = np.clip(np.rint(127 * 0.05 * normed), -127, 127)
+        np.testing.assert_array_equal(out.numpy().astype(np.int32),
+                                      want.astype(np.int32))
+        assert out.numpy().dtype == np.int8
+        # scale 0.05 on O(1) activations must NOT collapse to all-zero
+        assert np.abs(out.numpy()).max() > 0
+
+    def test_rope_decode_uses_time_step_position(self):
+        """With RoPE enabled, cached decode at time_step must equal the
+        uncached full causal forward's last position (would fail if the
+        decoded token were rotated as position 0)."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.base.tensor import Tensor
+
+        paddle.seed(3)
+        mt = inn.FusedMultiTransformer(
+            embed_dim=8, num_heads=2, dim_feedforward=16,
+            dropout_rate=0.0, num_layers=1)
+        mt.eval()
+        b, s0, heads, hd, max_len = 1, 3, 2, 4, 8
+        full = rng.randn(b, s0 + 1, 8).astype(np.float32)
+        out_full = mt(t(full), rotary_emb_dims=1)
+        caches = [Tensor(jnp.zeros((2, b, heads, max_len, hd), jnp.float32),
+                         _internal=True)]
+        _, caches = mt(t(full[:, :s0]), caches=caches, rotary_emb_dims=1)
+        out_dec, _ = mt(t(full[:, s0:]), caches=caches, time_step=s0,
+                        rotary_emb_dims=1)
+        np.testing.assert_allclose(out_dec.numpy()[:, 0],
+                                   out_full.numpy()[:, s0],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_traced_time_step_single_compilation(self):
+        """time_step may be a TRACED scalar: the whole decode loop runs
+        under one jit with the step threaded as data (fixed shapes)."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.base.tensor import Tensor
+
+        paddle.seed(4)
+        mt = self_build = inn.FusedMultiTransformer(
+            embed_dim=8, num_heads=2, dim_feedforward=16,
+            dropout_rate=0.0, num_layers=1)
+        mt.eval()
+        b, heads, hd, max_len = 1, 2, 4, 8
+        tok = rng.randn(b, 1, 8).astype(np.float32)
+        cache0 = jnp.zeros((2, b, heads, max_len, hd), jnp.float32)
+
+        def step(x, cache, ts):
+            out, caches = mt(Tensor(x, _internal=True),
+                             caches=[Tensor(cache, _internal=True)],
+                             time_step=Tensor(ts, _internal=True))
+            return out._data, caches[0]._data
+
+        jitted = jax.jit(step)
+        out1, c1 = jitted(jnp.asarray(tok), cache0, jnp.asarray(0))
+        out2, c2 = jitted(jnp.asarray(tok), c1, jnp.asarray(1))
+        assert np.isfinite(np.asarray(out2)).all()
+        # both steps hit the same compiled program
+        assert jitted._cache_size() == 1
+
+    def test_pre_caches_prepend_prefix(self):
+        """pre_caches must participate in attention (not be silently
+        dropped): output differs from the no-prefix run and matches
+        explicit concatenation."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.base.tensor import Tensor
+
+        paddle.seed(5)
+        mt = inn.FusedMultiTransformer(
+            embed_dim=8, num_heads=2, dim_feedforward=16,
+            dropout_rate=0.0, num_layers=1)
+        mt.eval()
+        x = t(rng.randn(1, 3, 8))
+        pre = Tensor(jnp.asarray(rng.randn(2, 1, 2, 2, 4), jnp.float32),
+                     _internal=True)
+        # explicit mask: queries may attend the 2 prefix slots + causal self
+        qlen, klen = 3, 5
+        cm = np.tril(np.ones((qlen, qlen)), 0)
+        m = np.concatenate([np.ones((qlen, 2)), cm], axis=1)
+        mask = t(np.where(m > 0, 0.0, np.finfo(np.float32).min)
+                 .reshape(1, 1, qlen, klen))
+        out_pre = mt(x, pre_caches=[pre], attn_mask=mask)
+        out_plain = mt(x)
+        assert not np.allclose(out_pre.numpy(), out_plain.numpy())

@@ -1,0 +1,291 @@
+"""Round-4 namespace-gap closures (ref: the per-subpackage __all__
+lists): communication.stream, quantization.{quanters,observers},
+incubate.optimizer.functional BFGS/L-BFGS, distributed.passes,
+cost_model, fleet.utils filesystems, asp.add_supported_layer,
+device.cuda/xpu additions, incubate.distributed.fleet."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+rng = np.random.RandomState(0)
+
+
+class TestStreamCollectives:
+    def test_all_names_delegate(self):
+        import paddle_tpu.distributed.communication.stream as st
+
+        for n in st.__all__:
+            assert callable(getattr(st, n)), n
+
+    def test_stream_all_reduce_spmd(self):
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.distributed.communication.stream as st
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("world",))
+        dist.init_parallel_env(mesh)
+        try:
+            x = paddle.to_tensor(
+                np.arange(8, dtype=np.float32).reshape(8, 1))
+
+            def body(t):
+                st.all_reduce(t, use_calc_stream=True)
+                return t
+
+            out = dist.shard_map(body, mesh, in_specs=P("world", None),
+                                 out_specs=P("world", None))(x)
+            np.testing.assert_allclose(out.numpy(),
+                                       np.full((8, 1), 28.0))
+        finally:
+            dist.destroy_process_group()
+
+    def test_gather_spmd(self):
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.communication import gather
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("world",))
+        dist.init_parallel_env(mesh)
+        try:
+            x = paddle.to_tensor(
+                np.arange(8, dtype=np.float32).reshape(8, 1))
+
+            def body(t):
+                return gather(t)  # stacked [nranks, ...] on every rank
+
+            out = dist.shard_map(body, mesh, in_specs=P("world", None),
+                                 out_specs=P("world", None, None))(x)
+            np.testing.assert_allclose(
+                out.numpy().reshape(8, 8), np.tile(np.arange(8), (8, 1)))
+        finally:
+            dist.destroy_process_group()
+
+
+class TestQuantSubmodules:
+    def test_quanters_reexport(self):
+        from paddle_tpu.quantization.quanters import (
+            FakeQuanterWithAbsMaxObserver,
+        )
+
+        q = FakeQuanterWithAbsMaxObserver()
+        x = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+        out = q(x)
+        assert list(out.shape) == [4, 4]
+
+    def test_groupwise_observer_scales(self):
+        from paddle_tpu.quantization.observers import GroupWiseWeightObserver
+
+        ob = GroupWiseWeightObserver(quant_bits=8, group_size=64)
+        w = rng.randn(128, 6).astype(np.float32)
+        ob(paddle.to_tensor(w))
+        scales = np.asarray(ob.scales().numpy())
+        assert scales.shape == (6, 2)  # [out_channels, cin/group]
+        want = np.abs(w.T.reshape(6, 2, 64)).max(-1) / 127
+        np.testing.assert_allclose(scales, want, rtol=1e-6)
+        with pytest.raises(ValueError, match="64 or 128"):
+            GroupWiseWeightObserver(group_size=32)
+
+
+class TestQuasiNewton:
+    def test_bfgs_quadratic(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_bfgs
+
+        A = np.array([[3.0, 0.5], [0.5, 1.0]], np.float32)
+        b = np.array([1.0, -2.0], np.float32)
+
+        def f(x):
+            return 0.5 * (x * paddle.to_tensor(A).matmul(x)).sum() - (
+                paddle.to_tensor(b) * x).sum()
+
+        conv, calls, pos, val, grad, H = minimize_bfgs(
+            f, paddle.to_tensor(np.zeros(2, np.float32)), max_iters=50,
+            tolerance_grad=1e-5)
+        want = np.linalg.solve(A, b)
+        np.testing.assert_allclose(pos.numpy(), want, atol=1e-4)
+        assert bool(np.asarray(conv._data))
+
+    def test_lbfgs_rosenbrock(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_lbfgs
+
+        def rosen(x):
+            return (1 - x[0]) ** 2 + 100 * (x[1] - x[0] ** 2) ** 2
+
+        conv, calls, pos, val, grad, H = minimize_lbfgs(
+            rosen, paddle.to_tensor(np.array([-1.2, 1.0], np.float32)),
+            max_iters=200)
+        np.testing.assert_allclose(pos.numpy(), [1.0, 1.0], atol=1e-3)
+
+    def test_incubate_optimizer_lbfgs_export(self):
+        assert paddle.incubate.optimizer.LBFGS is not None
+
+
+class TestPasses:
+    def test_new_pass_and_manager(self):
+        from paddle_tpu.distributed.passes import (
+            PassContext, PassManager, new_pass,
+        )
+
+        calls = []
+
+        def step(x):
+            calls.append(1)
+            return (x * x).sum()
+
+        pm = PassManager([new_pass("auto_parallel_recompute"),
+                          new_pass("fuse_gemm_epilogue")])
+        fn = pm.apply(step)
+        import jax.numpy as jnp
+
+        out = fn(jnp.ones((3,)))
+        assert float(out) == 3.0
+        assert pm.names == ["auto_parallel_recompute", "fuse_gemm_epilogue"]
+        ctx = PassContext()
+        ctx.set_attr("k", 7)
+        assert ctx.get_attr("k") == 7
+
+    def test_unknown_pass_rejected(self):
+        from paddle_tpu.distributed.passes import new_pass
+
+        with pytest.raises(ValueError, match="not registered"):
+            new_pass("no_such_pass")
+
+    def test_amp_pass_casts(self):
+        from paddle_tpu.distributed.passes import new_pass
+
+        import jax.numpy as jnp
+
+        def step(x):
+            return paddle.matmul(x, x)
+
+        fn = new_pass("auto_parallel_amp").apply(step)
+        x = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+        out = fn(x)
+        assert out._data.dtype == jnp.bfloat16
+
+
+class TestCostModel:
+    def test_profile_measure_reports_flops(self):
+        cm = paddle.cost_model.CostModel()
+
+        def fn(x):
+            return paddle.matmul(x, x).sum()
+
+        x = paddle.to_tensor(rng.randn(32, 32).astype(np.float32))
+        res = cm.profile_measure(fn, (x,), run_iters=2)
+        assert res["time_ms"] > 0
+        assert res["flops"] > 0  # 2*32^3 ~ 65k
+        fn2, args = cm.build_program()
+        res2 = cm.profile_measure(fn2, args, run_iters=1)
+        assert res2["time_ms"] > 0
+
+
+class TestFleetUtilsFS:
+    def test_localfs_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+
+        fs = LocalFS()
+        d = str(tmp_path / "a" / "b")
+        fs.mkdirs(d)
+        assert fs.is_dir(d)
+        f = os.path.join(d, "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f) and not fs.need_upload_download()
+        open(f, "w").write("hello")
+        assert fs.cat(f) == "hello"
+        dirs, files = fs.ls_dir(d)
+        assert files == ["x.txt"]
+        fs.mv(f, f + ".2", overwrite=True)
+        assert fs.is_exist(f + ".2")
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_needs_client(self):
+        from paddle_tpu.distributed.fleet.utils import HDFSClient
+
+        if __import__("shutil").which("hadoop"):
+            pytest.skip("hadoop present")
+        with pytest.raises(RuntimeError, match="hadoop"):
+            HDFSClient()
+
+    def test_distributed_infer_constructs(self):
+        from paddle_tpu.distributed.fleet.utils import DistributedInfer
+
+        di = DistributedInfer()
+        di.init_distributed_infer_env()
+        assert di.get_dist_infer_program() is None
+
+
+class TestAspSupportedLayer:
+    def test_custom_layer_registered_and_pruned(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate import asp
+
+        class MyProj(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.weight = self.create_parameter([8, 8])
+
+            def forward(self, x):
+                return paddle.matmul(x, self.weight)
+
+        paddle.seed(0)
+        m = MyProj()
+        # not pruned before registration
+        assert asp.prune_model(m) == {}
+        asp.add_supported_layer(MyProj)
+        masks = asp.prune_model(m, n=2, m=4)
+        assert len(masks) == 1
+        w = next(iter(masks))
+        mask = masks[w]
+        groups = mask.reshape(-1, 4)
+        assert (groups.sum(-1) <= 2).all()  # 2:4 sparsity
+
+    def test_custom_pruning_func(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate import asp
+
+        class MyOther(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.weight = self.create_parameter([4, 4])
+
+            def forward(self, x):
+                return x
+
+        asp.add_supported_layer(
+            "MyOther", lambda w, n, m, algo: np.zeros_like(w))
+        m = MyOther()
+        masks = asp.prune_model(m)
+        assert (next(iter(masks.values())) == 0).all()
+        assert float(np.abs(m.weight.numpy()).sum()) == 0.0
+
+
+class TestDeviceAdditions:
+    def test_cuda_name_and_capability(self):
+        name = paddle.device.cuda.get_device_name()
+        assert isinstance(name, str) and name
+        cap = paddle.device.cuda.get_device_capability()
+        assert isinstance(cap, tuple) and len(cap) == 2
+
+    def test_xpu_synchronize(self):
+        paddle.device.xpu.synchronize()
+
+    def test_incubate_fleet_recompute_exports(self):
+        import paddle_tpu.incubate.distributed.fleet as f
+
+        x = paddle.to_tensor(rng.randn(2, 4).astype(np.float32))
+        x.stop_gradient = False
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        layer = nn.Linear(4, 4)
+        out = f.recompute_hybrid({"offload": False}, layer, x)
+        out.sum().backward()
+        assert layer.weight.grad is not None

@@ -237,3 +237,75 @@ class TestSparseSoftmax:
         np.testing.assert_allclose(out[3] + out[4], 1.0, rtol=1e-6)
         e = np.exp([1.0, 2.0])
         np.testing.assert_allclose(out[:2], e / e.sum(), rtol=1e-6)
+
+
+def _full_coo_2d(n, h, w, c, seed=0):
+    r = np.random.RandomState(seed)
+    dense_nhwc = r.randn(n, h, w, c).astype(np.float32)
+    coords = np.stack(np.meshgrid(
+        np.arange(n), np.arange(h), np.arange(w),
+        indexing="ij"), axis=-1).reshape(-1, 3)
+    vals = dense_nhwc[coords[:, 0], coords[:, 1], coords[:, 2]]
+    x = sp.sparse_coo_tensor(coords.T, vals, shape=[n, h, w, c])
+    return x, np.moveaxis(dense_nhwc, -1, 1)  # NCHW
+
+
+class TestConv2DParity:
+    """2-D variants (ref: sparse/nn/layer/conv.py Conv2D/SubmConv2D,
+    functional conv2d/subm_conv2d + igemm aliases) over the same
+    dimension-generic rulebook."""
+
+    @pytest.mark.parametrize("stride,padding", [(1, 1), (2, 0)])
+    def test_conv2d_matches_dense_on_full_input(self, stride, padding):
+        n, h, w, ci, co, k = 1, 5, 5, 3, 4, 3
+        x, dense = _full_coo_2d(n, h, w, ci, seed=3)
+        wgt = rng.randn(k, k, ci, co).astype(np.float32) * 0.3
+        bias = rng.randn(co).astype(np.float32)
+        y = sp.nn.functional.conv2d(
+            x, paddle.to_tensor(wgt), paddle.to_tensor(bias),
+            stride=stride, padding=padding)
+        ref = F.conv2d(
+            paddle.to_tensor(dense),
+            paddle.to_tensor(np.transpose(wgt, (3, 2, 0, 1))),
+            paddle.to_tensor(bias), stride=stride, padding=padding)
+        got = np.moveaxis(np.asarray(y.to_dense().numpy()), -1, 1)
+        np.testing.assert_allclose(got, ref.numpy(), rtol=2e-5, atol=2e-5)
+
+    def test_subm_conv2d_keeps_coords_and_matches_dense(self):
+        n, h, w, ci, co, k = 1, 4, 6, 2, 3, 3
+        x, dense = _full_coo_2d(n, h, w, ci, seed=4)
+        wgt = rng.randn(k, k, ci, co).astype(np.float32) * 0.3
+        y = sp.nn.functional.subm_conv2d(
+            x, paddle.to_tensor(wgt), stride=1, padding=1)
+        assert y.nnz == x.nnz
+        ref = F.conv2d(
+            paddle.to_tensor(dense),
+            paddle.to_tensor(np.transpose(wgt, (3, 2, 0, 1))),
+            stride=1, padding=1)
+        got = np.moveaxis(np.asarray(y.to_dense().numpy()), -1, 1)
+        np.testing.assert_allclose(got, ref.numpy(), rtol=2e-5, atol=2e-5)
+        # igemm alias is the same path
+        y2 = sp.nn.functional.subm_conv2d_igemm(
+            x, paddle.to_tensor(wgt), stride=1, padding=1)
+        np.testing.assert_allclose(
+            np.asarray(y2.values().numpy()), np.asarray(y.values().numpy()),
+            rtol=1e-6)
+
+    def test_conv2d_layer_trains(self):
+        paddle.seed(0)
+        layer = sp.nn.SubmConv2D(2, 4, 3, padding=1)
+        x, _ = _full_coo_2d(1, 4, 4, 2, seed=5)
+        y = layer(x)
+        loss = (y.values() ** 2).sum()
+        loss.backward()
+        assert layer.weight.grad is not None
+
+    def test_partial_2d_subm_no_dilation(self):
+        coords = np.array([[0, 0, 0], [0, 2, 3], [0, 3, 1]]).T
+        vals = rng.randn(3, 2).astype(np.float32)
+        x = sp.sparse_coo_tensor(coords, vals, shape=[1, 4, 4, 2])
+        wgt = paddle.to_tensor(rng.randn(3, 3, 2, 2).astype(np.float32))
+        y = sp.nn.functional.subm_conv2d(x, wgt, padding=1)
+        assert y.nnz == 3
+        y2 = sp.nn.functional.conv2d(x, wgt, padding=1)
+        assert y2.nnz > 3  # regular sparse conv dilates

@@ -1,0 +1,237 @@
+"""paddle.static.nn helpers (ref: python/paddle/static/nn/__init__.py —
+common.py fc/layer_norm/…, control_flow.py cond/case/switch_case/
+while_loop, sequence_lod.py sequence_*): name-keyed parameter reuse,
+control flow under trace, and padded+lengths sequence semantics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+rng = np.random.RandomState(0)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_scope():
+    snn.reset_parameters()
+    yield
+    snn.reset_parameters()
+
+
+class TestParamHelpers:
+    def test_fc_named_reuse_and_activation(self):
+        x = t(rng.randn(4, 6))
+        a = snn.fc(x, 3, name="s")
+        b = snn.fc(x, 3, name="s")
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        r = snn.fc(x, 3, name="s", activation="relu")
+        assert (r.numpy() >= 0).all()
+        # unnamed -> fresh params
+        paddle.seed(1)
+        c = snn.fc(x, 3)
+        assert not np.allclose(a.numpy(), c.numpy())
+
+    def test_layer_norm_matches_functional(self):
+        import paddle_tpu.nn.functional as F
+
+        x = t(rng.randn(3, 8))
+        out = snn.layer_norm(x, begin_norm_axis=1)
+        want = F.layer_norm(x, (8,), epsilon=1e-5)
+        np.testing.assert_allclose(out.numpy(), want.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_embedding_and_sparse_embedding(self):
+        ids = paddle.to_tensor(np.array([[0, 2], [1, 3]], np.int64))
+        e1 = snn.embedding(ids, (5, 4), name="emb")
+        e2 = snn.sparse_embedding(ids, (5, 4), name="emb")
+        np.testing.assert_allclose(e1.numpy(), e2.numpy())
+        assert list(e1.shape) == [2, 2, 4]
+
+    def test_conv2d_and_group_norm_shapes(self):
+        x = t(rng.randn(2, 3, 8, 8))
+        y = snn.conv2d(x, 6, 3, padding=1, name="c")
+        assert list(y.shape) == [2, 6, 8, 8]
+        g = snn.group_norm(y, 2, name="g")
+        assert list(g.shape) == [2, 6, 8, 8]
+
+    def test_spectral_norm_unit_sigma(self):
+        w = t(rng.randn(6, 4))
+        wn = snn.spectral_norm(w, power_iters=30)
+        s = np.linalg.svd(wn.numpy(), compute_uv=False)
+        assert abs(s[0] - 1.0) < 1e-2
+
+    def test_prelu_modes(self):
+        x = t(rng.randn(2, 3, 4, 4))
+        for mode in ("all", "channel", "element"):
+            out = snn.prelu(x, mode, name=f"p_{mode}")
+            assert list(out.shape) == list(x.shape)
+
+    def test_row_conv_future_context(self):
+        x = t(rng.randn(2, 5, 3))
+        out = snn.row_conv(x, future_context_size=2)
+        assert list(out.shape) == [2, 5, 3]
+
+    def test_data_norm_normalizes(self):
+        x = t(rng.randn(8, 4) * 3 + 1)
+        out = snn.data_norm(x, name="dn")
+        assert list(out.shape) == [8, 4]
+
+    def test_nce_loss_positive(self):
+        x = t(rng.randn(6, 8))
+        y = paddle.to_tensor(rng.randint(0, 20, (6, 1)).astype(np.int64))
+        loss = snn.nce(x, y, num_total_classes=20, num_neg_samples=4,
+                       name="nce")
+        assert list(loss.shape) == [6, 1]
+        assert (loss.numpy() > 0).all()
+
+    def test_bilinear_tensor_product(self):
+        x, y = t(rng.randn(3, 4)), t(rng.randn(3, 5))
+        out = snn.bilinear_tensor_product(x, y, 6, name="bi")
+        assert list(out.shape) == [3, 6]
+
+
+class TestControlFlow:
+    def test_cond_concrete_and_traced(self):
+        x = t([2.0])
+        out = snn.cond(x.sum() > 1, lambda: x * 2, lambda: x - 1)
+        np.testing.assert_allclose(out.numpy(), [4.0])
+
+        def f(v):
+            return snn.cond(v.sum() > 0, lambda: v * 2, lambda: v - 1)
+
+        sf = paddle.jit.to_static(f)
+        np.testing.assert_allclose(sf(t([3.0])).numpy(), [6.0])
+        np.testing.assert_allclose(sf(t([-3.0])).numpy(), [-4.0])
+
+    def test_case_first_true_wins(self):
+        x = t([1.0])
+        out = snn.case(
+            [(x.sum() > 10, lambda: x * 100),
+             (x.sum() > 0, lambda: x * 10)],
+            default=lambda: x,
+        )
+        np.testing.assert_allclose(out.numpy(), [10.0])
+
+    def test_switch_case(self):
+        idx = paddle.to_tensor(np.array(1, np.int64))
+        x = t([2.0])
+        out = snn.switch_case(idx, {0: lambda: x, 1: lambda: x * 5,
+                                    2: lambda: x * 7})
+        np.testing.assert_allclose(out.numpy(), [10.0])
+
+    def test_while_loop(self):
+        i = paddle.to_tensor(np.array(0.0, np.float32))
+        out = snn.while_loop(lambda i: i < 5, lambda i: i + 1, [i])
+        assert float(out[0]) == 5.0
+
+    def test_static_pylayer_custom_backward(self):
+        x = t([1.0, 2.0])
+        x.stop_gradient = False
+        out = snn.static_pylayer(
+            lambda v: v * 3, [x], backward_fn=lambda g: g * 7)
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0, 7.0])
+
+    def test_py_func_host_roundtrip(self):
+        x = t(rng.randn(3, 2))
+        out = snn.py_func(lambda v: v * 2 + 1, x, out=x)
+        np.testing.assert_allclose(out.numpy(), x.numpy() * 2 + 1,
+                                   rtol=1e-6)
+
+
+class TestSequenceOps:
+    def test_sequence_softmax_masks_tail(self):
+        x = t(rng.randn(2, 4))
+        length = paddle.to_tensor(np.array([2, 4], np.int64))
+        out = snn.sequence_softmax(x, length=length).numpy()
+        np.testing.assert_allclose(out[0, :2].sum(), 1.0, rtol=1e-5)
+        assert out[0, 2:].max() < 1e-12
+        np.testing.assert_allclose(out[1].sum(), 1.0, rtol=1e-5)
+
+    @pytest.mark.parametrize("pool,expect", [
+        ("sum", lambda x, n: x[:n].sum(0)),
+        ("average", lambda x, n: x[:n].mean(0)),
+        ("sqrt", lambda x, n: x[:n].sum(0) / np.sqrt(n)),
+        ("max", lambda x, n: x[:n].max(0)),
+        ("first", lambda x, n: x[0]),
+        ("last", lambda x, n: x[n - 1]),
+    ])
+    def test_sequence_pool_types(self, pool, expect):
+        x = rng.randn(2, 5, 3).astype(np.float32)
+        lens = np.array([3, 5], np.int64)
+        out = snn.sequence_pool(t(x), pool,
+                                length=paddle.to_tensor(lens)).numpy()
+        for b in range(2):
+            np.testing.assert_allclose(out[b], expect(x[b], lens[b]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_first_last_step(self):
+        x = rng.randn(2, 4, 3).astype(np.float32)
+        lens = paddle.to_tensor(np.array([2, 4], np.int64))
+        np.testing.assert_allclose(
+            snn.sequence_first_step(t(x), length=lens).numpy(), x[:, 0])
+        last = snn.sequence_last_step(t(x), length=lens).numpy()
+        np.testing.assert_allclose(last[0], x[0, 1])
+        np.testing.assert_allclose(last[1], x[1, 3])
+
+    def test_sequence_pad_unpad(self):
+        x = rng.randn(2, 3, 2).astype(np.float32)
+        padded, length = snn.sequence_pad(t(x), 0.0, maxlen=5)
+        assert list(padded.shape) == [2, 5, 2]
+        assert np.abs(padded.numpy()[:, 3:]).max() == 0
+        lens = paddle.to_tensor(np.array([2, 3], np.int64))
+        un = snn.sequence_unpad(t(x), lens).numpy()
+        assert np.abs(un[0, 2:]).max() == 0
+        np.testing.assert_allclose(un[1], x[1])
+
+    def test_sequence_conv_shape_and_center(self):
+        x = rng.randn(1, 6, 4).astype(np.float32)
+        out = snn.sequence_conv(t(x), 5, filter_size=3, name="sc")
+        assert list(out.shape) == [1, 6, 5]
+
+    def test_sequence_expand_and_reshape(self):
+        x = rng.randn(2, 3).astype(np.float32)
+        y = rng.randn(4, 3).astype(np.float32)
+        out = snn.sequence_expand(t(x), t(y)).numpy()
+        assert out.shape == (4, 3)
+        np.testing.assert_allclose(out[0], x[0])
+        np.testing.assert_allclose(out[1], x[0])
+        r = snn.sequence_reshape(t(rng.randn(2, 6, 2)), 4)
+        assert list(r.shape) == [2, 3, 4]
+
+    def test_sequence_scatter_and_enumerate(self):
+        x = np.zeros((2, 5), np.float32)
+        idx = paddle.to_tensor(np.array([[0, 2], [1, 3]], np.int64))
+        upd = t(np.ones((2, 2), np.float32))
+        out = snn.sequence_scatter(t(x), idx, upd).numpy()
+        assert out[0, 0] == 1 and out[0, 2] == 1 and out[1, 1] == 1
+        ids = paddle.to_tensor(np.array([[1, 2, 3]], np.int64))
+        win = snn.sequence_enumerate(ids, 2, pad_value=0).numpy()
+        np.testing.assert_array_equal(win[0], [[1, 2], [2, 3], [3, 0]])
+
+    def test_sequence_slice(self):
+        x = rng.randn(2, 6, 2).astype(np.float32)
+        off = paddle.to_tensor(np.array([1, 2], np.int64))
+        ln = paddle.to_tensor(np.array([2, 3], np.int64))
+        out = snn.sequence_slice(t(x), off, ln).numpy()
+        np.testing.assert_allclose(out[0, :2], x[0, 1:3])
+        np.testing.assert_allclose(out[1, :3], x[1, 2:5])
+        assert np.abs(out[0, 2:]).max() == 0
+
+
+class TestScopedSignatureGuard:
+    def test_named_reuse_with_different_config_raises(self):
+        x = t(rng.randn(4, 6))
+        snn.fc(x, 3, name="guard")
+        with pytest.raises(ValueError, match="different configuration"):
+            snn.fc(x, 16, name="guard")
+
+    def test_row_conv_named_reuse(self):
+        x = t(rng.randn(2, 5, 3))
+        a = snn.row_conv(x, 2, name="rc")
+        b = snn.row_conv(x, 2, name="rc")
+        np.testing.assert_allclose(a.numpy(), b.numpy())
