@@ -114,3 +114,28 @@ print(f"quality: greedy token match {match:.3f} over {ref_tokens.shape[1]} "
       f"tokens x {B} seqs; prefill last-logit rel err {rel:.4f}; "
       f"int8 argmax in bf16 top-5: {in_top5:.2f}")
 print(f"speedup int8 vs bf16: {bf16_ms/int8_ms:.2f}x")
+
+
+# ---- int4 weight-only conversion -----------------------------------------
+# packed two-per-byte weights (0.5 B/param streamed) + group-64 scales;
+# compute dequantizes into the bf16 MXU feed (nn/quant WeightOnlyLinear)
+from paddle_tpu.nn.quant import convert_to_weight_only
+
+paddle.seed(0)
+model4 = LlamaForCausalLM(config)
+model4.bfloat16()
+n_int4 = convert_to_weight_only(model4, weight_dtype="int4", group_size=64)
+print(f"converted {n_int4} Linear layers to packed-int4 weight-only")
+
+int4_ms = scan_row(model4, "int4")
+int4_tokens = greedy_tokens(model4)
+int4_logits = last_logits(model4)
+match4 = float((ref_tokens == int4_tokens).mean())
+rel4 = float(np.abs(int4_logits - ref_logits).mean()
+             / (np.abs(ref_logits).mean() + 1e-9))
+in_top5_4 = float(np.mean([
+    int4_logits[i].argmax() in top5[i] for i in range(B)]))
+print(f"int4 quality: greedy match {match4:.3f}; prefill last-logit rel "
+      f"err {rel4:.4f}; int4 argmax in bf16 top-5: {in_top5_4:.2f}")
+print(f"SUMMARY ms/step: bf16 {bf16_ms*1e3:.3f} | int8 {int8_ms*1e3:.3f} "
+      f"| int4 {int4_ms*1e3:.3f}  (same session)")

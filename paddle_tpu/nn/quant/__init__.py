@@ -17,6 +17,7 @@ from ...base.tensor import Tensor
 from ..layer.layers import Layer
 
 __all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+    "WeightOnlyLinear", "convert_to_weight_only",
            "weight_quantize", "weight_dequantize", "int8_dynamic_matmul"]
 
 
@@ -33,19 +34,71 @@ class Stub(Layer):
 
 
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
-    """Quantize a [in, out] weight to int8/int4 with per-out-channel
-    absmax scales (ref: nn/quant/quantized_linear.py weight_quantize)."""
+    """Quantize a [in, out] weight (ref: nn/quant/quantized_linear.py:39
+    weight_quantize).
+
+    - int8: per-out-channel absmax scales, stored unpacked.
+    - int4: values in [-8, 7] PACKED two-per-byte along the in axis
+      ([in/2, out] int8 — the serving win is the halved HBM weight
+      stream), with per-out-channel scales (group_size=-1) or
+      group-wise scales over the in axis (group_size 64/128, scale
+      shape [in/group, out] — the GroupWiseWeightObserver layout).
+    """
     if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
         raise ValueError(f"unsupported algo {algo!r}")
-    bits = 4 if algo == "weight_only_int4" else 8
-    qmax = (1 << (bits - 1)) - 1
+    if algo != "weight_only_int4":
+        def _f8(w):
+            scale = jnp.max(jnp.abs(w), axis=0) / 127.0
+            q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-9)), -128, 127)
+            return q.astype(jnp.int8), scale.astype(jnp.float32)
 
-    def _f(w):
-        scale = jnp.max(jnp.abs(w), axis=0) / qmax
-        q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-9)), -qmax - 1, qmax)
-        return q.astype(jnp.int8), scale.astype(jnp.float32)
+        return apply(_f8, x, op_name="weight_quantize")
 
-    return apply(_f, x, op_name="weight_quantize")
+    cin = int(x.shape[0])
+    if group_size not in (-1, 64, 128):
+        raise ValueError("group_size supports -1, 64 or 128")
+    if cin % 2:
+        raise ValueError("int4 packing needs an even input dim")
+    if group_size > 0 and cin % group_size:
+        raise ValueError(f"group_size {group_size} must divide in={cin}")
+
+    def _f4(w):
+        if group_size > 0:
+            g = w.reshape(cin // group_size, group_size, -1)
+            scale = jnp.max(jnp.abs(g), axis=1) / 7.0  # [in/gs, out]
+            sc = jnp.repeat(jnp.maximum(scale, 1e-9), group_size, axis=0)
+        else:
+            scale = jnp.max(jnp.abs(w), axis=0) / 7.0  # [out]
+            sc = jnp.maximum(scale, 1e-9)
+        q = jnp.clip(jnp.round(w / sc), -8, 7).astype(jnp.int32)
+        # pack: byte = (q[2i] & 0xF) | (q[2i+1] << 4)
+        lo = q[0::2] & 0xF
+        hi = (q[1::2] & 0xF) << 4
+        packed = (lo | hi).astype(jnp.uint8).view(jnp.int8)
+        return packed, scale.astype(jnp.float32)
+
+    return apply(_f4, x, op_name="weight_quantize_int4")
+
+
+def _unpack_int4(packed):
+    """[in/2, out] packed int8 -> [in, out] int8 values in [-8, 7]."""
+    u = packed.view(jnp.uint8).astype(jnp.int32)
+    lo = (u & 0xF)
+    hi = (u >> 4) & 0xF
+    # sign-extend 4-bit two's complement
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    n2, out = packed.shape
+    return jnp.stack([lo, hi], axis=1).reshape(2 * n2, out).astype(jnp.int8)
+
+
+def _dequant_weight(q, s, weight_dtype, group_size, dtype):
+    if weight_dtype == "int4":
+        q = _unpack_int4(q)
+    if s.ndim == 2:  # group-wise [in/gs, out]
+        gs = q.shape[0] // s.shape[0]
+        s = jnp.repeat(s, gs, axis=0)
+    return q.astype(dtype) * s.astype(dtype)
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
@@ -53,19 +106,23 @@ def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
     from ...base.dtype import canonical_dtype
 
     dt = canonical_dtype(out_dtype)
+    wd = "int4" if algo == "weight_only_int4" else "int8"
     return apply(
-        lambda q, s: (q.astype(jnp.float32) * s).astype(dt),
+        lambda q, s: _dequant_weight(q, s, wd, -1, jnp.float32).astype(dt),
         x, scale, op_name="weight_dequantize",
     )
 
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1):
-    """y = x @ dequant(weight) + bias (ref: quantized_linear.py
-    weight_only_linear). The dequant fuses into the matmul under XLA."""
+    """y = x @ dequant(weight) + bias (ref: quantized_linear.py:156
+    weight_only_linear). int4 weights arrive PACKED ([in/2, out], see
+    weight_quantize) with per-channel or group-wise scales; the unpack+
+    dequant fuses into the matmul's operand load under XLA, so the HBM
+    stream is the packed array — the bandwidth-bound decode win."""
 
     def _f(a, q, s, *maybe_b):
-        w = q.astype(a.dtype) * s.astype(a.dtype)
+        w = _dequant_weight(q, s, weight_dtype, group_size, a.dtype)
         out = a @ w
         if maybe_b:
             out = out + maybe_b[0]
@@ -154,3 +211,74 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
     )
     args = (x, weight, weight_scale) + ((bias,) if bias is not None else ())
     return apply(_ste if needs_grad else _int8, *args, op_name="llm_int8_linear")
+
+
+class WeightOnlyLinear(Layer):
+    """Inference Linear over frozen weight-only-quantized weights
+    (ref: the deploy layer paddlenlp builds on quantized_linear.py:156;
+    the functional contract is weight_only_linear above).
+
+    - ``weight_dtype="int8"``: per-out-channel scales, unpacked int8.
+    - ``weight_dtype="int4"``: weights PACKED two-per-byte ([in/2, out])
+      with per-channel or group-wise scales — the weight HBM stream
+      halves again vs int8, which is the whole game for small-batch
+      decode. Dequant fuses into the matmul's operand load (XLA), so
+      compute stays bf16 on the MXU.
+    """
+
+    def __init__(self, linear, weight_dtype: str = "int4",
+                 group_size: int = -1):
+        super().__init__()
+        from ...base.tape import no_grad
+
+        algo = ("weight_only_int4" if weight_dtype == "int4"
+                else "weight_only_int8")
+        with no_grad():
+            q, s = weight_quantize(linear.weight, algo=algo,
+                                   group_size=group_size)
+        # deployment buffers: detached, non-differentiable (the float
+        # weight must not stay alive through tape nodes)
+        for t in (q, s):
+            t._grad_node = None
+            t.stop_gradient = True
+        self.register_buffer("weight", q)  # packed for int4
+        self.register_buffer("weight_scale", s)
+        self.bias = linear.bias
+        self.weight_dtype = weight_dtype
+        self.group_size = group_size
+        self._in_features = int(linear.weight.shape[0])
+        self._out_features = int(linear.weight.shape[1])
+
+    def forward(self, x):
+        return weight_only_linear(
+            x, self.weight, bias=self.bias, weight_scale=self.weight_scale,
+            weight_dtype=self.weight_dtype, group_size=self.group_size,
+        )
+
+    def extra_repr(self):
+        return (f"in={self._in_features}, out={self._out_features}, "
+                f"weight_dtype={self.weight_dtype}, gs={self.group_size}")
+
+
+def convert_to_weight_only(model, weight_dtype: str = "int4",
+                           group_size: int = -1, exclude=lambda name: False):
+    """Swap every nn.Linear in ``model`` for a WeightOnlyLinear holding
+    quantized frozen weights (the weight-only deploy pass; int8's
+    counterpart conversion lives in quantization.QAT.convert). Returns
+    the number of layers converted."""
+    from ..layer.common import Linear
+
+    n = 0
+    for name, sub in list(model.named_sublayers(include_self=False)):
+        if not isinstance(sub, Linear) or exclude(name):
+            continue
+        if weight_dtype == "int4" and int(sub.weight.shape[0]) % 2:
+            continue  # odd in-dim cannot pack
+        parent = model
+        parts = name.split(".")
+        for p in parts[:-1]:
+            parent = getattr(parent, p)
+        setattr(parent, parts[-1],
+                WeightOnlyLinear(sub, weight_dtype, group_size))
+        n += 1
+    return n
