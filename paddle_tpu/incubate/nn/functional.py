@@ -488,7 +488,10 @@ def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
 
         probs = jax.nn.softmax(gg, axis=-1)          # [b, s, e]
         h = jnp.einsum("bsd,edf->bsef", xx, w0) + b0[:, 0][None, None]
-        h = jax.nn.gelu(h) if act_type == "gelu" else jnp.maximum(h, 0)
+        # exact erf gelu — matches F.gelu and the reference kernel (the
+        # jax.nn.gelu default is the tanh approximation)
+        h = (jax.nn.gelu(h, approximate=False) if act_type == "gelu"
+             else jnp.maximum(h, 0))
         y = jnp.einsum("bsef,efd->bsed", h, w1) + b1[:, 0][None, None]
         return jnp.einsum("bsed,bse->bsd", y, probs)
 
@@ -691,11 +694,29 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                     s, int(cache.shape[3]) if cache is not None else 0)
                 q, k = _rope(q, k, positions, max_pos, hd)
             if cache is not None:
-                cache = apply(
-                    lambda c, kk, vv: c.at[0, :, :, :s].set(
-                        jnp.swapaxes(kk, 1, 2)
-                    ).at[1, :, :, :s].set(jnp.swapaxes(vv, 1, 2)),
-                    cache, k, v, op_name="fused_mt_prefill")
+                if pre is not None:
+                    # fold the prefix into the cache so a later decode
+                    # (which attends slots [:time_step] with RoPE
+                    # positions continuing from pre_len + s) sees the
+                    # prefix at [:pre_len] and this chunk at
+                    # [pre_len : pre_len+s] — without this, decode would
+                    # attend a cache missing the prefix with offset
+                    # positions (advisor r4 medium)
+                    cache = apply(
+                        lambda c, kk, vv, p: c
+                        .at[0, :, :, :pre_len].set(p[0])
+                        .at[1, :, :, :pre_len].set(p[1])
+                        .at[0, :, :, pre_len:pre_len + s].set(
+                            jnp.swapaxes(kk, 1, 2))
+                        .at[1, :, :, pre_len:pre_len + s].set(
+                            jnp.swapaxes(vv, 1, 2)),
+                        cache, k, v, pre, op_name="fused_mt_prefill")
+                else:
+                    cache = apply(
+                        lambda c, kk, vv: c.at[0, :, :, :s].set(
+                            jnp.swapaxes(kk, 1, 2)
+                        ).at[1, :, :, :s].set(jnp.swapaxes(vv, 1, 2)),
+                        cache, k, v, op_name="fused_mt_prefill")
                 new_caches.append(cache)
             k_att, v_att = k, v
             if pre is not None:

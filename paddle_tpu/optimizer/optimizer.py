@@ -459,7 +459,13 @@ class AdamW(_AdamBase):
             decay = 0.0
         if getattr(p, "no_weight_decay", False):
             decay = 0.0
-        pv = pv * (1.0 - lr * decay)
+        # decay in the f32 compute dtype: a bf16 pv * (1 - lr*decay)
+        # round-trips bit-exactly (relative change ~1e-6 is far below
+        # bf16's half-ulp), so in the masterless modes the decay would
+        # silently never reach the parameter; promoting first lets the
+        # f32 `pv - delta` and _apply's SR write carry it unbiasedly
+        compute = jnp.float64 if pv.dtype == jnp.float64 else jnp.float32
+        pv = pv.astype(compute) * (1.0 - lr * decay)
         self._apply(p, pv - self._adam_delta(lr, m, v, b1p, b2p))
 
 

@@ -15,7 +15,8 @@ class GroupWiseWeightObserver(BaseObserver):
     """Per-group abs-max over a 2-D weight (ref: groupwise.py:46 — the
     weight-only-quant calibration used for group-quantized int4/int8
     LLM serving): columns are scanned in ``group_size`` chunks of input
-    channels, one scale per (out_channel, group)."""
+    channels; ``scales()`` returns [cin/group_size, out_channels] (the
+    reference's transposed layout, matching weight_quantize)."""
 
     def __init__(self, quant_bits: int = 8, group_size: int = 128):
         super().__init__()
@@ -37,7 +38,10 @@ class GroupWiseWeightObserver(BaseObserver):
                 )
             g = w.T.reshape(cout, cin // self.group_size, self.group_size)
             m = jnp.abs(g).max(axis=2).astype(jnp.float32)
-            return jnp.maximum(m, 1e-8)
+            # [cin/group, cout] — the reference's final transpose
+            # (quantization/observers/groupwise.py _cal_abs_max) and the
+            # group-scale layout weight_quantize/weight_only_linear use
+            return jnp.maximum(m, 1e-8).T
 
         self._max = apply(f, x, op_name="groupwise_absmax")
         return x
@@ -55,4 +59,6 @@ class GroupWiseWeightObserver(BaseObserver):
         return self.quant_bits
 
     def quant_axis(self):
-        return 0
+        # -1: with the [cin/group, cout] scale layout the out-channel
+        # axis is the last one (ref: groupwise.py:94)
+        return -1

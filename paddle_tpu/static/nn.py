@@ -498,7 +498,69 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
             for r, s in zip(res, structs)
         )
 
-    return apply(run, *xs, op_name="py_func")
+    if backward_func is None:
+        return apply(run, *xs, op_name="py_func")
+
+    # backward_func rides PyLayer (same mechanism as static_pylayer):
+    # the reference calls it with (inputs, outputs, output-grads) minus
+    # ``skip_vars_in_backward_input``, expecting one grad per input
+    # (ref: python/paddle/static/nn/control_flow.py py_func backward
+    # registration). Previously backward_func was silently ignored.
+    from ..autograd import PyLayer
+
+    skip_ids = {id(v) for v in (skip_vars_in_backward_input or ())}
+    n_in = len(xs)
+
+    class _PyFuncOp(PyLayer):
+        @staticmethod
+        def forward(ctx, *ts):
+            res = apply(run, *ts, op_name="py_func")
+            res_t = res if isinstance(res, (list, tuple)) else (res,)
+            ctx.save_for_backward(*ts, *res_t)
+            return res
+
+        @staticmethod
+        def backward(ctx, *gouts):
+            saved = ctx.saved_tensor
+            ins, outs_f = saved[:n_in], saved[n_in:]
+            bwd_in = [t for i, t in enumerate(ins)
+                      if id(xs[i]) not in skip_ids]
+            bwd_in += [t for i, t in enumerate(outs_f)
+                       if id(outs[i]) not in skip_ids]
+            nb = len(bwd_in)
+            in_structs = tuple(
+                jax.ShapeDtypeStruct(tuple(t.shape), np.dtype(t.dtype))
+                for t in ins)
+
+            # same host-callback contract as the forward: backward_func
+            # may use .numpy()/plain numpy and return numpy arrays, and
+            # must still work when the tape backward itself is traced
+            # (jit.to_static jits the whole step including .backward())
+            def _bhost(*np_arrs):
+                ts_ = [Tensor(jnp.asarray(a), _internal=True)
+                       for a in np_arrs]
+                g = backward_func(*ts_[:nb], *ts_[nb:])
+                g = g if isinstance(g, (list, tuple)) else [g]
+                if len(g) != n_in:
+                    raise ValueError(
+                        f"py_func backward_func returned {len(g)} grads "
+                        f"for {n_in} inputs")
+                return tuple(
+                    np.asarray(r.numpy() if isinstance(r, Tensor) else r,
+                               s.dtype)
+                    for r, s in zip(g, in_structs))
+
+            def run_bwd(*arrs):
+                if any(isinstance(a, jax.core.Tracer) for a in arrs):
+                    res = jax.pure_callback(_bhost, in_structs, *arrs)
+                else:
+                    res = _bhost(*[np.asarray(a) for a in arrs])
+                return res[0] if n_in == 1 else res
+
+            g = apply(run_bwd, *bwd_in, *gouts, op_name="py_func_grad")
+            return g if n_in == 1 else tuple(g)
+
+    return _PyFuncOp.apply(*xs)
 
 
 # -- sequence ops over padded [B, T, ...] + lengths --------------------------

@@ -315,3 +315,65 @@ class TestReviewFindings:
         out_pre = mt(x, pre_caches=[pre], attn_mask=mask)
         out_plain = mt(x)
         assert not np.allclose(out_pre.numpy(), out_plain.numpy())
+
+    def test_pre_caches_fold_into_cache_for_decode(self):
+        """advisor r4 (medium): prefill with cache + pre_caches must
+        write the prefix into the cache so a later decode attends it at
+        consistent RoPE positions — matches the full uncached run."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.base.tensor import Tensor
+
+        paddle.seed(6)
+        mt = inn.FusedMultiTransformer(
+            embed_dim=8, num_heads=2, dim_feedforward=16,
+            dropout_rate=0.0, num_layers=1)
+        mt.eval()
+        b, s0, heads, hd, pre_len, max_len = 1, 3, 2, 4, 2, 8
+        full = rng.randn(b, s0 + 1, 8).astype(np.float32)
+        pre = Tensor(jnp.asarray(
+            rng.randn(2, b, heads, pre_len, hd), jnp.float32), _internal=True)
+
+        def _mask(qlen):
+            m = np.concatenate(
+                [np.ones((qlen, pre_len)), np.tril(np.ones((qlen, qlen)))], 1)
+            return t(np.where(m > 0, 0.0, np.finfo(np.float32).min)
+                     .reshape(1, 1, qlen, pre_len + qlen))
+
+        out_full = mt(t(full), pre_caches=[pre], attn_mask=_mask(s0 + 1),
+                      rotary_emb_dims=1)
+
+        caches = [Tensor(jnp.zeros((2, b, heads, max_len, hd), jnp.float32),
+                         _internal=True)]
+        out_pre, caches = mt(t(full[:, :s0]), caches=caches,
+                             pre_caches=[pre], attn_mask=_mask(s0),
+                             rotary_emb_dims=1)
+        np.testing.assert_allclose(out_pre.numpy(), out_full.numpy()[:, :s0],
+                                   rtol=1e-4, atol=1e-5)
+        out_dec, _ = mt(t(full[:, s0:]), caches=caches,
+                        time_step=pre_len + s0, rotary_emb_dims=1)
+        np.testing.assert_allclose(out_dec.numpy()[:, 0],
+                                   out_full.numpy()[:, s0],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_ec_moe_gelu_is_exact_erf(self):
+        """advisor r4 (low): the gelu path must match F.gelu's exact erf
+        form (jax.nn.gelu defaults to the tanh approximation)."""
+        from scipy.special import erf as _erf
+
+        b, s, d, f_, e = 1, 2, 4, 8, 2
+        x = rng.randn(b, s, d).astype(np.float32)
+        gate = rng.randn(b, s, e).astype(np.float32)
+        w0 = rng.randn(e, d, f_).astype(np.float32)
+        b0 = rng.randn(e, 1, f_).astype(np.float32)
+        w1 = rng.randn(e, f_, d).astype(np.float32)
+        b1 = rng.randn(e, 1, d).astype(np.float32)
+        out = IF.fused_ec_moe(t(x), t(gate), t(w0), t(b0), t(w1), t(b1),
+                              "gelu")
+        probs = np.exp(gate) / np.exp(gate).sum(-1, keepdims=True)
+        want = np.zeros((b, s, d), np.float32)
+        for i in range(e):
+            h = x @ w0[i] + b0[i, 0]
+            h = h * 0.5 * (1.0 + _erf(h / np.sqrt(2.0)))
+            want += (h @ w1[i] + b1[i, 0]) * probs[..., i : i + 1]
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)

@@ -86,8 +86,10 @@ class TestQuantSubmodules:
         w = rng.randn(128, 6).astype(np.float32)
         ob(paddle.to_tensor(w))
         scales = np.asarray(ob.scales().numpy())
-        assert scales.shape == (6, 2)  # [out_channels, cin/group]
-        want = np.abs(w.T.reshape(6, 2, 64)).max(-1) / 127
+        # [cin/group, out_channels] — the reference's layout (groupwise
+        # observer ends with transpose([1, 0])), matching weight_quantize
+        assert scales.shape == (2, 6)
+        want = np.abs(w.T.reshape(6, 2, 64)).max(-1).T / 127
         np.testing.assert_allclose(scales, want, rtol=1e-6)
         with pytest.raises(ValueError, match="64 or 128"):
             GroupWiseWeightObserver(group_size=32)

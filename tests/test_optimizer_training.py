@@ -300,6 +300,35 @@ class TestStochasticRounding:
         assert abs(l_sr - l_master) < 0.25 * l_master, (l_sr, l_master)
         assert l_plain > l_sr, (l_plain, l_sr)
 
+    def test_sr_weight_decay_reaches_params(self):
+        # advisor r4 (high): lr*decay ~1e-3 relative is below bf16's
+        # half-ulp, so a bf16 decay multiply rounds back bit-exactly and
+        # weight decay silently never reached masterless params; the fix
+        # promotes to f32 before decaying so the SR write carries it.
+        # Pure decay (zero grads -> adam delta == 0): after N steps the
+        # weights should shrink by ~(1 - lr*decay)^N in expectation.
+        import jax.numpy as jnp
+
+        paddle.seed(0)
+        m = nn.Linear(64, 64)
+        for p in m.parameters():
+            p._data = p._data.astype(jnp.bfloat16)
+        lr, decay, steps = 1e-2, 0.1, 300
+        o = opt.AdamW(learning_rate=lr, weight_decay=decay,
+                      parameters=m.parameters(),
+                      use_stochastic_rounding=True)
+        w0 = float(jnp.linalg.norm(m.weight._data.astype(jnp.float32)))
+        zeros = {id(p): paddle.to_tensor(
+            np.zeros(p.shape, np.float32)).astype("bfloat16")
+            for p in m.parameters()}
+        for _ in range(steps):
+            for p in m.parameters():
+                p.grad = zeros[id(p)]
+            o.step()
+        w1 = float(jnp.linalg.norm(m.weight._data.astype(jnp.float32)))
+        expected = (1.0 - lr * decay) ** steps  # ~0.741
+        assert 0.9 * expected < w1 / w0 < 1.1 * expected, (w1 / w0, expected)
+
     def test_sr_under_to_static(self):
         import jax.numpy as jnp
 
