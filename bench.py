@@ -8,13 +8,160 @@ Metric: tokens/sec/chip for a Llama-style decoder LM train step
 activations path. vs_baseline = achieved MFU / 0.55 (the conventional
 A100-class MFU anchor for Llama-2 pretrain stacks, BASELINE.md north
 star: MFU parity ⇒ vs_baseline ≥ 1.0).
+
+Hardening (round-4 verdict Next #1 — BENCH_r04 was lost to one
+transient "Unable to initialize backend" with no second chance): the
+top-level invocation is a SUPERVISOR that runs the actual bench in a
+child process with a per-attempt timeout, retries transient backend
+failures (init errors, connection loss, hangs) with exponential
+backoff, fails fast on real errors (compile/shape/import bugs retry
+zero times), and on final failure prints a structured diagnostics JSON
+line instead of a bare traceback. Knobs (env): BENCH_ATTEMPTS=5,
+BENCH_ATTEMPT_TIMEOUT=1800 s, BENCH_RETRY_DELAY=5 s (doubles each
+retry). BENCH_FORCE_FAIL=transient_until:N|fatal|hang_until:N is the
+test hook (tests/test_bench_guard.py).
 """
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+# lowercase substrings that mark a failure as transient-infrastructure
+# (worth retrying) rather than a real bug in the bench or framework
+TRANSIENT_PATTERNS = (
+    "unable to initialize backend",
+    "failed to connect",
+    "connection refused",
+    "connection reset",
+    "broken pipe",
+    "socket closed",
+    "unavailable:",  # gRPC status prefix ("UNAVAILABLE: ..."), not the
+    # bare word — a traceback merely containing "unavailable" is a bug
+    "deadline exceeded",
+    "grant unclaimed",
+)
+
+# checked BEFORE the transient list: these ride inside "Unable to
+# initialize backend ..." messages but mean the backend plugin was never
+# registered in this process — no retry can fix that
+FATAL_OVERRIDES = ("not in the list of known backends",)
+
+
+def _classify(stderr_text: str, rc: int) -> str:
+    """timeout/kill and known backend-bring-up errors are transient;
+    anything else (tracebacks from compile/shape/import bugs) is fatal
+    and retrying would just burn the capture window."""
+    if rc < 0 or rc == 124:  # killed (timeout) / shell timeout rc
+        return "transient"
+    t = stderr_text.lower()
+    if any(p in t for p in FATAL_OVERRIDES):
+        return "fatal"
+    if any(p in t for p in TRANSIENT_PATTERNS):
+        return "transient"
+    return "fatal"
+
+
+def _last_metric_line(stdout_text: str):
+    """The child's contract is one JSON metric line; tolerate log noise
+    around it by scanning from the end."""
+    for line in reversed(stdout_text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return line
+    return None
+
+
+def _supervise() -> int:
+    import subprocess
+
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "5"))
+    timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "1800"))
+    delay = float(os.environ.get("BENCH_RETRY_DELAY", "5"))
+    history = []
+    for attempt in range(1, attempts + 1):
+        env = dict(os.environ, BENCH_CHILD="1", BENCH_ATTEMPT=str(attempt))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=timeout_s,
+            )
+            rc, out_s, err_s = proc.returncode, proc.stdout, proc.stderr
+        except subprocess.TimeoutExpired as e:
+            def _txt(b):
+                return b.decode("utf-8", "replace") if isinstance(b, bytes) \
+                    else (b or "")
+            rc, out_s = -9, _txt(e.stdout)
+            err_s = _txt(e.stderr) + (
+                f"\n[bench supervisor] attempt killed after {timeout_s:.0f}s"
+                " (backend hang)")
+        if rc == 0:
+            line = _last_metric_line(out_s)
+            if line is not None:
+                print(line)
+                sys.stderr.write(err_s[-2000:])
+                return 0
+            err_s += ("\n[bench supervisor] child exited 0 without a JSON"
+                      " metric line")
+        classification = _classify(err_s, rc)
+        history.append({
+            "attempt": attempt,
+            "rc": rc,
+            "classification": classification,
+            "stderr_tail": err_s[-600:],
+        })
+        sys.stderr.write(
+            f"[bench supervisor] attempt {attempt}/{attempts} failed "
+            f"(rc={rc}, {classification})\n")
+        if classification == "fatal":
+            break
+        if attempt < attempts:
+            time.sleep(delay)
+            delay *= 2
+    # final failure: one structured diagnostics line, not a traceback
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": None,
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "error": {
+            "final_classification": history[-1]["classification"]
+            if history else "unknown",
+            "attempts": len(history),
+            "history": history,
+        },
+    }))
+    return 1
+
+
+def _maybe_force_fail():
+    """Test hook: deterministic failures before any JAX import so the
+    retry path is provable without a real backend outage."""
+    spec = os.environ.get("BENCH_FORCE_FAIL")
+    if not spec:
+        return
+    attempt = int(os.environ.get("BENCH_ATTEMPT", "1"))
+    kind, _, n = spec.partition(":")
+    if kind == "transient_until" and attempt < int(n):
+        raise RuntimeError(
+            "Unable to initialize backend 'axon' (forced test failure)")
+    if kind == "fatal":
+        raise ValueError("forced fatal failure: simulated compile error")
+    if kind == "unregistered":
+        raise RuntimeError(
+            "Unable to initialize backend 'axon': Backend 'axon' is not "
+            "in the list of known backends (forced test failure)")
+    if kind == "hang_until" and attempt < int(n):
+        time.sleep(10_000)
 
 # bf16 peak FLOP/s per chip by TPU generation (device_kind substring)
 _PEAK = {
@@ -37,6 +184,7 @@ def _peak_flops(device) -> float:
 
 
 def main():
+    _maybe_force_fail()
     import jax
     import jax.numpy as jnp
 
@@ -195,4 +343,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD") == "1":
+        main()
+    else:
+        sys.exit(_supervise())

@@ -510,27 +510,26 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
 
     skip_ids = {id(v) for v in (skip_vars_in_backward_input or ())}
     n_in = len(xs)
+    # resolve the skip filter once; save only the tensors backward will
+    # actually receive (a skip-listed activation must not be retained)
+    keep_in = [i for i in range(n_in) if id(xs[i]) not in skip_ids]
+    keep_out = [i for i in range(len(outs)) if id(outs[i]) not in skip_ids]
+    in_structs = tuple(
+        jax.ShapeDtypeStruct(tuple(t.shape), np.dtype(t.dtype)) for t in xs)
 
     class _PyFuncOp(PyLayer):
         @staticmethod
         def forward(ctx, *ts):
             res = apply(run, *ts, op_name="py_func")
             res_t = res if isinstance(res, (list, tuple)) else (res,)
-            ctx.save_for_backward(*ts, *res_t)
+            ctx.save_for_backward(*[ts[i] for i in keep_in],
+                                  *[res_t[i] for i in keep_out])
             return res
 
         @staticmethod
         def backward(ctx, *gouts):
-            saved = ctx.saved_tensor
-            ins, outs_f = saved[:n_in], saved[n_in:]
-            bwd_in = [t for i, t in enumerate(ins)
-                      if id(xs[i]) not in skip_ids]
-            bwd_in += [t for i, t in enumerate(outs_f)
-                       if id(outs[i]) not in skip_ids]
+            bwd_in = list(ctx.saved_tensor)
             nb = len(bwd_in)
-            in_structs = tuple(
-                jax.ShapeDtypeStruct(tuple(t.shape), np.dtype(t.dtype))
-                for t in ins)
 
             # same host-callback contract as the forward: backward_func
             # may use .numpy()/plain numpy and return numpy arrays, and
