@@ -28,11 +28,25 @@ class Generator:
 
     def __init__(self, seed: int = 0):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        # key creation is LAZY: building a jax PRNG key initializes the
+        # XLA backend, and this module is imported by `import paddle_tpu`
+        # — which must stay backend-free so multi-controller workers can
+        # call jax.distributed.initialize after import
+        # (multi_controller.initialize_from_env)
+        self._key = None
+
+    @property
+    def _k(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        # stay lazy: paddle.seed() at the top of a multi-controller
+        # worker must not initialize the backend before
+        # jax.distributed.initialize (same invariant as __init__)
+        self._key = None
         return self
 
     def initial_seed(self) -> int:
@@ -40,7 +54,7 @@ class Generator:
 
     # -- state (for checkpoint / tracker swap) ----------------------------
     def get_state(self):
-        return self._key
+        return self._k
 
     def set_state(self, state):
         self._key = state
@@ -48,11 +62,11 @@ class Generator:
     # -- drawing ----------------------------------------------------------
     def split(self):
         """Return a fresh subkey, advancing the generator state."""
-        self._key, sub = jax.random.split(self._key)
+        self._key, sub = jax.random.split(self._k)
         return sub
 
     def split_n(self, n: int):
-        keys = jax.random.split(self._key, n + 1)
+        keys = jax.random.split(self._k, n + 1)
         self._key = keys[0]
         return keys[1:]
 

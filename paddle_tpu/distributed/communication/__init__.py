@@ -10,10 +10,18 @@ Every function here is dual-mode:
 - **eager, group of 1**: identity (matches the reference's single-rank
   fast path, e.g. communication/all_reduce.py returns immediately when
   world_size == 1).
-- **eager, group > 1**: raises — the single-controller model has no
-  per-rank eager view; use paddle_tpu.distributed.shard_map (or a
-  jit'ed sharded step) exactly like the reference requires a launched
-  process group (ref: process_group.h:48 requires initialized PG).
+- **eager, group > 1, single controller**: raises — one process owns
+  the whole mesh, so there is no per-rank eager view; use
+  paddle_tpu.distributed.shard_map (or a jit'ed sharded step) exactly
+  like the reference requires a launched process group
+  (ref: process_group.h:48 requires initialized PG).
+- **eager, multi-controller** (``jax.process_count() > 1``, i.e. the
+  worker was started by ``distributed.launch`` and
+  ``jax.distributed.initialize`` ran): TRAINER-level collectives — each
+  process contributes its local value, the op executes over a
+  one-device-per-process ``world`` mesh (``multi_controller.py``), and
+  ``src``/``dst`` arguments are process ranks. This is the reference's
+  eager gloo/NCCL path between real trainer processes.
 
 In-place convention follows the reference (all_reduce mutates its input
 tensor and returns None in sync mode).
@@ -24,6 +32,7 @@ from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ...base.tensor import Tensor
@@ -62,6 +71,26 @@ def _eager_guard(g: Group, op: str) -> bool:
     )
 
 
+_OP_KIND = {ReduceOp.SUM: "sum", ReduceOp.MAX: "max", ReduceOp.MIN: "min",
+            ReduceOp.PROD: "prod", ReduceOp.AVG: "avg"}
+
+
+def _mc_if_active(g: Group, op: str):
+    """The multi_controller module when trainer-level eager collectives
+    apply (multi-process runtime + default group), else None. Eager
+    sub-group collectives stay unsupported in multi-controller mode."""
+    from .. import multi_controller as mc
+
+    if not mc.active():
+        return None
+    if g.id != 0:
+        raise RuntimeError(
+            f"{op}: eager collectives over sub-groups are not supported "
+            "in multi-controller mode; use the default (trainer) group "
+            "or run inside shard_map/jit")
+    return mc
+
+
 def _reduce_traced(x, g: Group, op: int):
     axis = g.axis_name
     if op == ReduceOp.SUM:
@@ -83,6 +112,11 @@ def all_reduce(tensor: Tensor, op: int = ReduceOp.SUM, group: Optional[Group] = 
     g = _resolve(group)
     x = _data(tensor)
     if not _is_traced(x):
+        mc = _mc_if_active(g, "all_reduce")
+        if mc is not None:
+            out = mc.eager_all_reduce(np.asarray(x), _OP_KIND[op])
+            tensor._inplace_from(Tensor(jnp.asarray(out), _internal=True))
+            return
         if _eager_guard(g, "all_reduce"):
             return
     out = _reduce_traced(x, g, op)
@@ -94,6 +128,13 @@ def all_gather(tensor_list: List, tensor: Tensor, group: Optional[Group] = None,
     g = _resolve(group)
     x = _data(tensor)
     if not _is_traced(x):
+        mc = _mc_if_active(g, "all_gather")
+        if mc is not None:
+            rows = mc.eager_all_gather(np.asarray(x))
+            tensor_list.extend(
+                Tensor(jnp.asarray(rows[r]), _internal=True)
+                for r in range(rows.shape[0]))
+            return
         if _eager_guard(g, "all_gather"):
             tensor_list.append(tensor.clone() if isinstance(tensor, Tensor) else Tensor(x))
             return
@@ -107,6 +148,10 @@ def all_gather_object(obj_list: List, obj, group: Optional[Group] = None):
     if g.nranks == 1:
         obj_list.append(obj)
         return
+    mc = _mc_if_active(g, "all_gather_object")
+    if mc is not None:
+        obj_list.extend(mc.eager_all_gather_object(obj))
+        return
     raise RuntimeError("all_gather_object requires multi-host coordination; single-controller holds the global view already")
 
 
@@ -115,6 +160,12 @@ def all_gather_into_tensor(out: Tensor, tensor: Tensor, group: Optional[Group] =
     g = _resolve(group)
     x = _data(tensor)
     if not _is_traced(x):
+        mc = _mc_if_active(g, "all_gather_into_tensor")
+        if mc is not None:
+            rows = mc.eager_all_gather(np.asarray(x))
+            res = np.concatenate(list(rows), axis=axis)
+            out._inplace_from(Tensor(jnp.asarray(res), _internal=True))
+            return
         if _eager_guard(g, "all_gather_into_tensor"):
             out._inplace_from(Tensor(x, _internal=True))
             return
@@ -129,6 +180,12 @@ def reduce(tensor: Tensor, dst: int = 0, op: int = ReduceOp.SUM, group: Optional
     g = _resolve(group)
     x = _data(tensor)
     if not _is_traced(x):
+        mc = _mc_if_active(g, "reduce")
+        if mc is not None:
+            red = mc.eager_all_reduce(np.asarray(x), _OP_KIND[op])
+            if jax.process_index() == dst:
+                tensor._inplace_from(Tensor(jnp.asarray(red), _internal=True))
+            return
         if _eager_guard(g, "reduce"):
             return
     red = _reduce_traced(x, g, op)
@@ -142,6 +199,11 @@ def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None, sync_
     g = _resolve(group)
     x = _data(tensor)
     if not _is_traced(x):
+        mc = _mc_if_active(g, "broadcast")
+        if mc is not None:
+            out = mc.eager_broadcast(np.asarray(x), src)
+            tensor._inplace_from(Tensor(jnp.asarray(out), _internal=True))
+            return
         if _eager_guard(g, "broadcast"):
             return
     src_in_group = _group_rank_of(g, src, "broadcast")
@@ -162,6 +224,16 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op: int = ReduceOp.SUM
     else:
         x = _data(tensor_or_tensor_list)
     if not _is_traced(x) and not _is_traced(_data(tensor)):
+        mc = _mc_if_active(g, "reduce_scatter")
+        if mc is not None:
+            red = mc.eager_all_reduce(np.asarray(x), _OP_KIND[op])
+            nproc = jax.process_count()
+            shard = red.shape[0] // nproc
+            me = jax.process_index()
+            tensor._inplace_from(Tensor(
+                jnp.asarray(red[me * shard:(me + 1) * shard]),
+                _internal=True))
+            return
         if _eager_guard(g, "reduce_scatter"):
             tensor._inplace_from(Tensor(x, _internal=True))
             return
@@ -185,6 +257,16 @@ def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group: Optional[Grou
     else:
         x = _data(tensor)
     if not _is_traced(x) and not _is_traced(_data(tensor)):
+        mc = _mc_if_active(g, "scatter")
+        if mc is not None:
+            nproc = jax.process_count()
+            base = np.asarray(_data(tensor))
+            stacked = (np.asarray(x) if tensor_list is not None
+                       else np.zeros((nproc, *base.shape), base.dtype))
+            rows = mc.eager_broadcast(stacked, src)
+            tensor._inplace_from(Tensor(
+                jnp.asarray(rows[jax.process_index()]), _internal=True))
+            return
         if _eager_guard(g, "scatter"):
             tensor._inplace_from(Tensor(x[0] if tensor_list is not None else x, _internal=True))
             return
@@ -205,6 +287,16 @@ def gather(tensor: Tensor, gather_list=None, dst: int = 0, group: Optional[Group
     g = _resolve(group)
     x = _data(tensor)
     if not _is_traced(x):
+        mc = _mc_if_active(g, "gather")
+        if mc is not None:
+            rows = mc.eager_all_gather(np.asarray(x))
+            if gather_list is not None:
+                gather_list.clear()
+                gather_list.extend(
+                    Tensor(jnp.asarray(rows[r]), _internal=True)
+                    for r in range(rows.shape[0]))
+                return
+            return Tensor(jnp.asarray(rows), _internal=True)
         if _eager_guard(g, "gather"):
             if gather_list is not None:
                 gather_list.clear()
@@ -227,6 +319,14 @@ def alltoall(out_tensor_list: List, in_tensor_list: List, group: Optional[Group]
     g = _resolve(group)
     parts = [_data(t) for t in in_tensor_list]
     if not any(_is_traced(p) for p in parts):
+        mc = _mc_if_active(g, "alltoall")
+        if mc is not None:
+            rows = mc.eager_all_gather(np.stack([np.asarray(p) for p in parts]))
+            me = jax.process_index()
+            out_tensor_list.extend(
+                Tensor(jnp.asarray(rows[r][me]), _internal=True)
+                for r in range(rows.shape[0]))
+            return
         if _eager_guard(g, "alltoall"):
             out_tensor_list.extend(Tensor(p, _internal=True) for p in parts)
             return
@@ -241,6 +341,19 @@ def alltoall_single(out: Tensor, tensor: Tensor, in_split_sizes=None, out_split_
     g = _resolve(group)
     x = _data(tensor)
     if not _is_traced(x):
+        mc = _mc_if_active(g, "alltoall_single")
+        if mc is not None:
+            if in_split_sizes or out_split_sizes:
+                raise NotImplementedError(
+                    "uneven alltoall splits: pad to equal splits")
+            rows = mc.eager_all_gather(np.asarray(x))
+            nproc, me = jax.process_count(), jax.process_index()
+            shard = rows.shape[1] // nproc
+            res = np.concatenate(
+                [rows[r][me * shard:(me + 1) * shard] for r in range(nproc)],
+                axis=0)
+            out._inplace_from(Tensor(jnp.asarray(res), _internal=True))
+            return
         if _eager_guard(g, "alltoall_single"):
             out._inplace_from(Tensor(x, _internal=True))
             return
@@ -255,6 +368,12 @@ def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None, sync_op: b
     g = _resolve(group)
     x = _data(tensor)
     if not _is_traced(x):
+        mc = _mc_if_active(g, "send")
+        if mc is not None:
+            # true p2p over the coordination-service KV store: only the
+            # two endpoints participate (a bystander rank proceeds)
+            mc.eager_send(np.asarray(x), dst=dst)
+            return
         _eager_guard(g, "send")
         return
     raise RuntimeError(
@@ -269,6 +388,11 @@ def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None, sync_op: b
     g = _resolve(group)
     x = _data(tensor)
     if not _is_traced(x):
+        mc = _mc_if_active(g, "recv")
+        if mc is not None:
+            arr = mc.eager_recv(src=src)
+            tensor._inplace_from(Tensor(jnp.asarray(arr), _internal=True))
+            return
         _eager_guard(g, "recv")
         return
     raise RuntimeError(
@@ -284,6 +408,11 @@ def p2p_sendrecv(tensor: Tensor, src: int, dst: int, group: Optional[Group] = No
     g = _resolve(group)
     x = _data(tensor)
     if not _is_traced(x):
+        mc = _mc_if_active(g, "p2p_sendrecv")
+        if mc is not None:
+            rows = mc.eager_p2p(np.asarray(x), src=src, dst=dst)
+            return Tensor(jnp.asarray(rows[jax.process_index()]),
+                          _internal=True)
         if _eager_guard(g, "p2p_sendrecv"):
             return Tensor(x, _internal=True)
     out = lax.ppermute(x, g.axis_name, perm=[(src, dst)])
@@ -295,6 +424,11 @@ def ppermute(tensor: Tensor, perm: Sequence, group: Optional[Group] = None) -> T
     g = _resolve(group)
     x = _data(tensor)
     if not _is_traced(x):
+        mc = _mc_if_active(g, "ppermute")
+        if mc is not None:
+            rows = mc.eager_ppermute(np.asarray(x), perm)
+            return Tensor(jnp.asarray(rows[jax.process_index()]),
+                          _internal=True)
         if _eager_guard(g, "ppermute"):
             return Tensor(x, _internal=True)
     return Tensor(lax.ppermute(x, g.axis_name, perm=list(perm)), _internal=True)

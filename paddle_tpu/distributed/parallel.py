@@ -47,18 +47,34 @@ class ParallelEnv:
 def init_parallel_env(mesh: Optional[jax.sharding.Mesh] = None) -> Group:
     """Initialize the default process group over the device mesh.
 
-    Multi-host: callers run ``jax.distributed.initialize`` first (the
-    coordination service is the TCPStore equivalent, SURVEY §5.8); then
-    every host sees the global mesh and this returns the world group.
+    Multi-host: when the worker was started by
+    ``paddle_tpu.distributed.launch`` (or the reference's env surface is
+    present), this first brings up JAX's coordination service — the
+    TCPStore/rendezvous equivalent (SURVEY §5.8) — via
+    ``multi_controller.initialize_from_env``; then every host sees the
+    global mesh and this returns the world group
+    (ref: python/paddle/distributed/parallel.py:957 init_parallel_env).
     """
+    from . import multi_controller as _mc
+
+    _mc.initialize_from_env()
     if not is_initialized():
         init_default_group(mesh)
     return _collective._get_global_group()
 
 
 def get_world_size(group: Optional[Group] = None) -> int:
+    """World size in the unit the active mode's collectives use:
+    multi-controller → TRAINER (process) count, matching the eager
+    collectives and the reference (world_size == number of trainer
+    processes); single-controller → device count (each device is an
+    SPMD rank)."""
     if group is not None:
         return group.nranks
+    from . import multi_controller as _mc
+
+    if _mc.active():
+        return jax.process_count()
     if is_initialized():
         return _collective._get_global_group().nranks
     return jax.device_count()

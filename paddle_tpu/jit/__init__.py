@@ -96,6 +96,7 @@ class StaticFunction:
         self._in_shardings = in_shardings
         self._static_argnums = tuple(static_argnums)
         self._cells: List[Tensor] = []
+        self._accum_layouts: List[Any] = []  # set by every _read_state
         self._jit_cache: Dict[Any, Any] = {}  # arg_treedef -> jitted pure fn
         self._last_lowered = None
         self._pure_runs = 0  # pure() executions == jax trace count
@@ -195,10 +196,39 @@ class StaticFunction:
         self._cells = cells
 
     # -- state threading ------------------------------------------------
+    def _accum_layout(self, o):
+        """Deterministic POSITIONAL order for the optimizer's accumulator
+        pytree: parameter-list position first, then extras by key.
+
+        Threading the raw name-keyed dicts would let jax's dict-key sort
+        define the traced program's structure — and auto tensor names
+        ("tensor_<n>", a process-global counter) make that ordering
+        depend on how many tensors the process happened to create
+        ("tensor_9" sorts AFTER "tensor_10"). Two multi-controller ranks
+        whose user code created different tensor counts (e.g. one rank
+        calls send, the other recv) would then trace DIFFERENTLY-ORDERED
+        programs and their XLA collectives would pair up mismatched
+        (observed as gloo "Received data size doesn't match expected
+        size"). Positional order is rank-invariant."""
+        pos = {p.name: i for i, p in enumerate(o._parameter_list)}
+        layout = []
+        for aname in sorted(o._accumulators):
+            store = o._accumulators[aname]
+            keys = sorted(
+                store, key=lambda k: (0, pos[k]) if k in pos else (1, k))
+            layout.append((aname, keys))
+        return layout
+
     def _read_state(self):
+        self._accum_layouts = [
+            self._accum_layout(o) for o in self._optimizers]
         return {
             "cells": [c._data for c in self._cells],
-            "accums": [o._accumulators for o in self._optimizers],
+            "accums": [
+                [[o._accumulators[an][k] for k in keys]
+                 for an, keys in lay]
+                for o, lay in zip(self._optimizers, self._accum_layouts)
+            ],
             "scalers": [
                 (s._scale, s._good_steps, s._bad_steps, s._found_inf)
                 for s in self._scalers
@@ -210,8 +240,12 @@ class StaticFunction:
     def _write_state(self, state):
         for c, arr in zip(self._cells, state["cells"]):
             c._data = arr
-        for o, acc in zip(self._optimizers, state["accums"]):
-            o._accumulators = acc
+        for o, lay, acc in zip(
+                self._optimizers, self._accum_layouts, state["accums"]):
+            o._accumulators = {
+                an: dict(zip(keys, vals))
+                for (an, keys), vals in zip(lay, acc)
+            }
         for sc, vals in zip(self._scalers, state.get("scalers", [])):
             sc._scale, sc._good_steps, sc._bad_steps, sc._found_inf = vals
         _random.default_generator().set_state(state["rng"])
