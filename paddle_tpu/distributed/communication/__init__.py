@@ -83,6 +83,8 @@ def _mc_if_active(g: Group, op: str):
 
     if not mc.active():
         return None
+    if g.nranks == 1:
+        return None  # identity no-op — the _eager_guard fast path handles it
     if g.id != 0:
         raise RuntimeError(
             f"{op}: eager collectives over sub-groups are not supported "
@@ -228,6 +230,10 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op: int = ReduceOp.SUM
         if mc is not None:
             red = mc.eager_all_reduce(np.asarray(x), _OP_KIND[op])
             nproc = jax.process_count()
+            if red.shape[0] % nproc:
+                raise ValueError(
+                    f"reduce_scatter: leading dim {red.shape[0]} not "
+                    f"divisible by {nproc} processes")
             shard = red.shape[0] // nproc
             me = jax.process_index()
             tensor._inplace_from(Tensor(
@@ -348,6 +354,10 @@ def alltoall_single(out: Tensor, tensor: Tensor, in_split_sizes=None, out_split_
                     "uneven alltoall splits: pad to equal splits")
             rows = mc.eager_all_gather(np.asarray(x))
             nproc, me = jax.process_count(), jax.process_index()
+            if rows.shape[1] % nproc:
+                raise ValueError(
+                    f"alltoall_single: leading dim {rows.shape[1]} not "
+                    f"divisible by {nproc} processes")
             shard = rows.shape[1] // nproc
             res = np.concatenate(
                 [rows[r][me * shard:(me + 1) * shard] for r in range(nproc)],

@@ -169,24 +169,51 @@ class MoELayer(Layer):
     """ref: incubate moe_layer.py MoELayer — drop-in FFN replacement.
 
     forward: [B, S, H] → [B, S, H]; sets ``self.l_aux`` each call.
+
+    ``dispatch_mode``:
+
+    - ``"einsum"`` (default): GShard dense dispatch — two einsums
+      against a [N, E, C] mask. Simple and GSPMD-friendly, but the mask
+      materializes N*E*C elements: at many experts it becomes the
+      layer's bandwidth bottleneck.
+    - ``"sort"``: scatter dispatch — top-k routing, stable sort of the
+      N*k (token, expert) slots by expert id, static-shape scatter into
+      the [E, C, H] expert buffer, gather + weighted scatter-add back.
+      Replaces the O(N*E*C*H) einsums with O(N*k*H) gathers + an
+      O(N*k log) sort, the standard TPU sparse-dispatch recipe. Same
+      routing as einsum mode when nothing overflows, and the same
+      post-drop weight renormalization (a survivor takes full weight);
+      on overflow only the DROP ORDER differs (einsum drops all second
+      choices after first choices, sort interleaves by token index
+      within each expert).
     """
 
     def __init__(self, d_model: int, d_hidden: int, num_experts: int,
                  top_k: int = 2, capacity_factor: float = 1.25,
                  gate: Optional[TopKGate] = None,
                  experts: Optional[Layer] = None,
-                 activation: str = "gelu"):
+                 activation: str = "gelu",
+                 dispatch_mode: str = "einsum"):
         super().__init__()
+        if dispatch_mode not in ("einsum", "sort"):
+            raise ValueError(
+                f"dispatch_mode must be 'einsum' or 'sort', got "
+                f"{dispatch_mode!r}")
         self.num_experts = num_experts
         self.gate = gate or TopKGate(d_model, num_experts, top_k, capacity_factor)
         self.experts = experts or ExpertMLP(num_experts, d_model, d_hidden, activation)
         self.l_aux = None
+        self.dispatch_mode = dispatch_mode
 
     def forward(self, x):
         b, s, h = x.shape
         from ....tensor.manipulation import reshape
 
         tokens = reshape(x, [b * s, h])
+        if self.dispatch_mode == "sort":
+            out, l_aux = self._forward_sort(tokens)
+            self.l_aux = l_aux
+            return reshape(out, [b, s, h])
         dispatch, combine, l_aux = self.gate(tokens)
         self.l_aux = l_aux
 
@@ -202,6 +229,58 @@ class MoELayer(Layer):
 
         out = apply(route_out, expert_out, combine, op_name="moe_combine")
         return reshape(out, [b, s, h])
+
+    # -- sort/scatter dispatch --------------------------------------------
+    def _forward_sort(self, tokens):
+        e = self.num_experts
+        top_k = self.gate.top_k
+        cap = self.gate.capacity(int(tokens.shape[0]))
+
+        def route(t, wg, w1, w2):
+            n, h = t.shape
+            act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[
+                self.experts.activation]
+            gates = jax.nn.softmax((t @ wg).astype(jnp.float32), axis=-1)
+            gate_vals, expert_ids = jax.lax.top_k(gates, top_k)  # [N, k]
+            # gshard aux loss on the top-1 assignment
+            mask1 = jax.nn.one_hot(expert_ids[:, 0], e, dtype=gates.dtype)
+            l_aux = jnp.sum(
+                jnp.mean(gates, axis=0) * jnp.mean(mask1, axis=0)) * e
+
+            flat_expert = expert_ids.reshape(-1)  # [N*k]
+            src_token = jnp.arange(n * top_k) // top_k
+            order = jnp.argsort(flat_expert, stable=True)
+            sorted_expert = flat_expert[order]
+            counts = jnp.bincount(sorted_expert, length=e)
+            starts = jnp.cumsum(counts) - counts
+            pos = jnp.arange(n * top_k) - starts[sorted_expert]
+            keep_sorted = pos < cap
+            # renormalize over the SURVIVING slots (einsum-gate parity:
+            # its g1+g2 denominator is computed after the capacity
+            # mask, so a token whose other choice dropped puts full
+            # weight on the survivor)
+            keep = jnp.zeros((n * top_k,), bool).at[order].set(keep_sorted)
+            kept = gate_vals * keep.reshape(n, top_k)
+            denom = kept.sum(-1, keepdims=True)
+            flat_gate = (kept / jnp.where(denom > 0, denom, 1.0)
+                         ).reshape(-1).astype(t.dtype)
+            # slot into the [E*C] buffer; overflow -> trash row E*C
+            slot = jnp.where(keep_sorted, sorted_expert * cap + pos, e * cap)
+            buf = jnp.zeros((e * cap + 1, h), t.dtype)
+            buf = buf.at[slot].set(t[src_token[order]])
+            xin = buf[: e * cap].reshape(e, cap, h)
+
+            hmid = act(jnp.einsum("ech,ehf->ecf", xin, w1))
+            xout = jnp.einsum("ecf,efh->ech", hmid, w2)
+
+            out_buf = jnp.concatenate(
+                [xout.reshape(e * cap, h), jnp.zeros((1, h), t.dtype)])
+            gathered = out_buf[slot] * flat_gate[order][:, None]
+            out = jnp.zeros((n, h), t.dtype).at[src_token[order]].add(gathered)
+            return out, l_aux
+
+        return apply(route, tokens, self.gate.weight, self.experts.w1,
+                     self.experts.w2, op_name="moe_sort_dispatch")
 
 
 def place_experts_on_mesh(layer: Layer, mesh, ep_axis: str = "ep"):

@@ -139,3 +139,118 @@ class TestExpertParallel:
             return moe(x).numpy()
 
         np.testing.assert_allclose(run(True), run(False), rtol=1e-4, atol=1e-5)
+
+
+class TestSortDispatch:
+    """dispatch_mode='sort': scatter dispatch must match the dense
+    einsum path when capacity is ample, train, and bound per-expert
+    load on overflow."""
+
+    def _pair(self, top_k, cf=4.0, e=4):
+        paddle.seed(3)
+        a = MoELayer(d_model=16, d_hidden=32, num_experts=e, top_k=top_k,
+                     capacity_factor=cf, dispatch_mode="einsum")
+        b = MoELayer(d_model=16, d_hidden=32, num_experts=e, top_k=top_k,
+                     capacity_factor=cf, dispatch_mode="sort")
+        for pb, pa in zip(b.parameters(), a.parameters()):
+            pb.set_value(pa)
+        return a, b
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_matches_einsum_under_capacity(self, top_k):
+        a, b = self._pair(top_k)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 8, 16).astype(np.float32))
+        out_a, out_b = a(x), b(x)
+        np.testing.assert_allclose(out_b.numpy(), out_a.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(b.l_aux), float(a.l_aux), rtol=1e-5)
+
+    def test_grads_match_einsum_under_capacity(self):
+        a, b = self._pair(2)
+        x = np.random.RandomState(2).randn(2, 8, 16).astype(np.float32)
+        grads = {}
+        for name, m in (("einsum", a), ("sort", b)):
+            loss = (m(paddle.to_tensor(x)) ** 2).sum() + 0.1 * m.l_aux
+            loss.backward()
+            grads[name] = [np.asarray(p.grad.numpy()) for p in m.parameters()]
+            for p in m.parameters():
+                p.clear_grad()
+        for ga, gb in zip(grads["einsum"], grads["sort"]):
+            np.testing.assert_allclose(gb, ga, rtol=2e-3, atol=1e-5)
+
+    def test_overflow_bounded_and_trains(self):
+        paddle.seed(5)
+        moe = MoELayer(d_model=8, d_hidden=16, num_experts=2, top_k=1,
+                       capacity_factor=0.5, dispatch_mode="sort")
+        head = nn.Linear(8, 3)
+        o = opt.SGD(learning_rate=0.1,
+                    parameters=[*moe.parameters(), *head.parameters()])
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 3, (4, 8)).astype(np.int64))
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.tensor import manipulation as M
+
+        losses = []
+        for _ in range(30):
+            logits = head(moe(x))
+            b, s, c = logits.shape
+            loss = F.cross_entropy(M.reshape(logits, [b * s, c]),
+                                   M.reshape(y, [b * s])) + 0.01 * moe.l_aux
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_sort_under_to_static(self):
+        paddle.seed(7)
+        moe = MoELayer(d_model=8, d_hidden=16, num_experts=4, top_k=2,
+                       dispatch_mode="sort")
+        o = opt.SGD(learning_rate=0.05, parameters=moe.parameters())
+
+        def step(x):
+            loss = (moe(x) ** 2).mean() + 0.01 * moe.l_aux
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return loss
+
+        sf = paddle.jit.to_static(step, layers=[moe], optimizers=[o])
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(2, 4, 8).astype(np.float32))
+        l0 = float(sf(x))
+        for _ in range(10):
+            l1 = float(sf(x))
+        assert np.isfinite(l1) and l1 < l0
+
+    def test_overflow_renormalizes_to_survivors(self):
+        # identity experts (relu(x@[I,-I]) @ [I;-I] == x) make the layer
+        # output w_tok * x where w_tok is the token's total combine
+        # weight: post-drop renormalization requires w_tok in {0, 1}
+        # even when one of a token's two choices overflowed
+        import jax.numpy as jnp
+
+        paddle.seed(9)
+        h, e, n = 8, 4, 32
+        moe = MoELayer(d_model=h, d_hidden=2 * h, num_experts=e, top_k=2,
+                       capacity_factor=0.7, activation="relu",
+                       dispatch_mode="sort")
+        eye = np.eye(h, dtype=np.float32)
+        w1 = np.concatenate([eye, -eye], axis=1)  # [h, 2h]
+        w2 = np.concatenate([eye, -eye], axis=0)  # [2h, h]
+        moe.experts.w1.set_value(paddle.to_tensor(
+            np.broadcast_to(w1, (e, h, 2 * h)).copy()))
+        moe.experts.w2.set_value(paddle.to_tensor(
+            np.broadcast_to(w2, (e, 2 * h, h)).copy()))
+        x_np = np.random.RandomState(4).randn(1, n, h).astype(np.float32)
+        out = moe(paddle.to_tensor(x_np)).numpy()[0]
+        # per-token weight = out . x / (x . x)
+        w_tok = (out * x_np[0]).sum(-1) / (x_np[0] ** 2).sum(-1)
+        ok = np.isclose(w_tok, 1.0, atol=1e-4) | np.isclose(
+            w_tok, 0.0, atol=1e-4)
+        assert ok.all(), w_tok
+        # the overflow config must actually drop something
+        assert np.isclose(w_tok, 0.0, atol=1e-4).any() or (
+            np.abs(out - x_np[0]).max() < 1e-4)
