@@ -331,6 +331,18 @@ def run_backward(
             cots[key] = g if key not in cots else cots[key] + g
             if t._retain_grads and inputs is None:
                 t._grad = g if t._grad is None else t._grad + g
+        elif getattr(t, "_piecewise_carry", False):
+            # a cotangent reached a tensor carried across a piecewise
+            # graph-break split: eager execution would have continued
+            # into the prefix's graph, but the carry is a materialized
+            # array with no history — silently stopping here would train
+            # wrong. Raising demotes the split to whole-function eager
+            # (StaticFunction catches any piecewise-path exception).
+            raise RuntimeError(
+                "backward reached a value carried across a piecewise "
+                "graph-break split; the autograd graph cannot span the "
+                "compiled prefix"
+            )
         elif inputs is None and not t.stop_gradient:
             # leaf accumulation (GradNodeAccumulation parity)
             t._grad = g if t._grad is None else t._grad + g

@@ -41,6 +41,7 @@ class Tensor:
         "name",
         "persistable",
         "_dist_attr",
+        "_piecewise_carry",
         "__weakref__",
     )
 

@@ -202,6 +202,16 @@ class MoELayer(Layer):
         self.num_experts = num_experts
         self.gate = gate or TopKGate(d_model, num_experts, top_k, capacity_factor)
         self.experts = experts or ExpertMLP(num_experts, d_model, d_hidden, activation)
+        if dispatch_mode == "sort" and (
+                type(self.gate) is not TopKGate
+                or type(self.experts) is not ExpertMLP):
+            # the fused sort kernel reads TopKGate/ExpertMLP internals
+            # (gate.weight routing, experts.w1/w2/activation); a custom
+            # gate's forward() would be silently bypassed
+            raise ValueError(
+                "dispatch_mode='sort' supports only the built-in "
+                "TopKGate/ExpertMLP; use dispatch_mode='einsum' with "
+                "custom gate/experts layers")
         self.l_aux = None
         self.dispatch_mode = dispatch_mode
 

@@ -45,6 +45,11 @@ from . import dy2static  # noqa: E402  (control-flow conversion submodule)
 _jit_enabled = [True]
 
 
+class _PiecewiseUnsafe(RuntimeError):
+    """A piecewise split was built but is unsafe at runtime (carried
+    non-jaxable value, or autograd would span the compiled prefix)."""
+
+
 def enable_to_static(flag: bool = True):
     """ref: paddle.jit.enable_to_static — globally fall back to eager."""
     _jit_enabled[0] = bool(flag)
@@ -72,6 +77,7 @@ class StaticFunction:
         in_shardings=None,
         static_argnums: Tuple[int, ...] = (),
         full_graph: bool = True,
+        carry_args: bool = False,
     ):
         functools.update_wrapper(self, fn, updated=[])
         from ..nn.layer.layers import Layer
@@ -96,6 +102,8 @@ class StaticFunction:
         self._in_shardings = in_shardings
         self._static_argnums = tuple(static_argnums)
         self._cells: List[Tensor] = []
+        self._piecewise = None  # set after a successful graph-break split
+        self._split_depth = 0  # recursion guard for nested splits
         self._accum_layouts: List[Any] = []  # set by every _read_state
         self._jit_cache: Dict[Any, Any] = {}  # arg_treedef -> jitted pure fn
         self._last_lowered = None
@@ -107,6 +115,10 @@ class StaticFunction:
         # piecewise eager execution instead of raising (SOT semantics)
         self._full_graph = bool(full_graph)
         self._fallback_eager = False
+        # piecewise-suffix functions: their args are values carried
+        # across a graph-break split — mark the traced wrappers so the
+        # tape can detect autograd reaching across the split
+        self._carry_args = bool(carry_args)
 
     # -- discovery ------------------------------------------------------
     def _auto_discover(self, fn):
@@ -273,6 +285,10 @@ class StaticFunction:
                     else a
                     for a in flat_args
                 ]
+                if self._carry_args:
+                    for w in wrapped:
+                        if isinstance(w, Tensor):
+                            w._piecewise_carry = True
                 args, kwargs = tree_util.tree_unflatten(arg_treedef, wrapped)
                 try:
                     out = self._fn(*args, **kwargs)
@@ -306,6 +322,26 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _jit_enabled[0] or self._fallback_eager:
             return self._orig_fn(*args, **kwargs)
+        if self._piecewise is not None:
+            # a later call can still prove unsafe (the break may bind a
+            # different type on another branch): restore and demote
+            # instead of leaking the internal error mid-training-loop
+            snap = self._snapshot_host_state()  # O(#params) host refs —
+            # negligible next to a train step, and the price of making
+            # any late failure restorable
+            try:
+                return self._piecewise(*args, **kwargs)
+            except Exception as why:
+                import warnings
+
+                self._restore_host_state(snap)
+                warnings.warn(
+                    "to_static(full_graph=False): piecewise capture "
+                    f"became unsafe ({why}); demoting to whole-function "
+                    "eager execution.", stacklevel=2)
+                self._piecewise = None
+                self._fallback_eager = True
+                return self._orig_fn(*args, **kwargs)
         if self._needs_discovery:
             self._auto_discover(self._orig_fn)
             self._needs_discovery = False
@@ -334,17 +370,51 @@ class StaticFunction:
             if self._full_graph:
                 raise
             # SOT semantics (ref jit/sot opcode_executor.py:305,1594):
-            # a graph break demotes the function to piecewise eager
-            # execution — every op still runs XLA-compiled through the
-            # tape's per-op dispatch, but forward/backward/optimizer are
-            # no longer fused into one program. The failed trace wrote
-            # tracers into the threaded state; roll it back first.
+            # split the function at the breaking statement — prefix and
+            # suffix stay COMPILED (their own StaticFunctions), the
+            # breaking statement runs eagerly each call. Only when no
+            # safe split exists does the whole function demote to
+            # per-op eager. The failed trace wrote tracers into the
+            # threaded state; roll it back first.
             self._write_state(state)
             self._sanitize_grads()
             for o, s0 in zip(self._optimizers, steps_before):
                 o._global_step = s0
             import warnings
 
+            if self._split_depth < 3:
+                piecewise = self._build_piecewise(e)
+                if piecewise is not None:
+                    snap = self._snapshot_host_state()
+                    try:
+                        out = piecewise(*args, **kwargs)
+                    except Exception as why:
+                        # ANY failure in the split path (unsafe carry,
+                        # tape truncation, a Tensor where the break
+                        # expected a python int, ...) demotes: restore
+                        # the snapshot so a prefix that already stepped
+                        # the optimizer isn't applied twice, then rerun
+                        # eagerly — genuine user errors re-raise from
+                        # the eager path with clean state
+                        self._restore_host_state(snap)
+                        warnings.warn(
+                            "to_static(full_graph=False): piecewise "
+                            f"capture unsafe ({why}); falling back to "
+                            "whole-function eager execution.",
+                            stacklevel=2)
+                    else:
+                        info = piecewise._info
+                        warnings.warn(
+                            "to_static(full_graph=False): graph break at "
+                            f"line {info['line']} ({info['stmt']!r}) — "
+                            "piecewise capture: prefix and suffix run "
+                            "compiled; only the breaking statement runs "
+                            "eagerly each call (host side effects "
+                            "re-execute; carried locals: "
+                            f"{info['carry1']}).",
+                            stacklevel=2)
+                        self._piecewise = piecewise
+                        return out
             warnings.warn(
                 "to_static(full_graph=False): graph break — falling back "
                 f"to piecewise eager execution for "
@@ -369,6 +439,127 @@ class StaticFunction:
         return tree_util.tree_map(
             lambda a: Tensor(a, _internal=True) if isinstance(a, jax.Array) else a, out_arrays
         )
+
+    # -- host-state snapshot (piecewise trial safety) --------------------
+    def _snapshot_host_state(self):
+        """Shallow snapshot of every host-visible training state the
+        compiled prefix could commit — jax arrays are immutable, so
+        reference copies suffice. Used to make a piecewise attempt
+        atomic: if it proves unsafe mid-call, restore and re-run eagerly
+        (otherwise a prefix that already stepped the optimizer would
+        step AGAIN in the eager rerun)."""
+        return {
+            "cells": [c._data for c in self._cells],
+            "accums": [
+                {an: dict(store) for an, store in o._accumulators.items()}
+                for o in self._optimizers
+            ],
+            "steps": [o._global_step for o in self._optimizers],
+            "scalers": [
+                (s._scale, s._good_steps, s._bad_steps, s._found_inf)
+                for s in self._scalers
+            ],
+            "rng": _random.default_generator().get_state(),
+            "tracker": _random.get_rng_state_tracker().get_states_dict(),
+        }
+
+    def _restore_host_state(self, snap):
+        for c, arr in zip(self._cells, snap["cells"]):
+            c._data = arr
+        for o, acc, st in zip(self._optimizers, snap["accums"],
+                              snap["steps"]):
+            o._accumulators = acc
+            o._global_step = st
+        for s, vals in zip(self._scalers, snap["scalers"]):
+            s._scale, s._good_steps, s._bad_steps, s._found_inf = vals
+        _random.default_generator().set_state(snap["rng"])
+        _random.get_rng_state_tracker().set_states_dict(snap["tracker"])
+        self._sanitize_grads()
+
+    def _build_piecewise(self, err):
+        """Build the split execution path after a graph break.
+
+        Splits ``_orig_fn`` at the breaking top-level statement
+        (dy2static.split_at_break): prefix and suffix compile as their
+        own StaticFunctions sharing this one's layers/optimizers (state
+        threads through each), the breaking statement runs eagerly per
+        call — host control flow and side effects re-execute naturally,
+        so no guards are needed. Returns None when no safe split exists.
+        Runtime safety: carried values must be jax-able, and when the
+        break/suffix differentiates, no carried tensor may still require
+        grad (the tape cannot span a compiled prefix); violations raise
+        _PiecewiseUnsafe and the caller demotes to whole-eager.
+        """
+        import warnings
+
+        code = self._orig_fn.__code__
+        src_file = getattr(code, "co_filename", None)
+        src_base = getattr(
+            inspect.unwrap(self._orig_fn), "__code__", code).co_firstlineno
+        # try every same-file frame, deepest first: a break inside a
+        # same-file helper maps outside this function's body, but the
+        # shallower CALL-SITE frame still splits cleanly. Frames from
+        # dy2static-converted code carry lines RELATIVE to the function
+        # start — translate via co_firstlineno.
+        parts = None
+        for f, ln in getattr(err, "frames", ()):
+            if f == src_file:
+                line = ln
+            elif f == f"<dy2static:{src_file}>":
+                # converted THIS function: relative lineno
+                line = src_base + ln - 1
+            else:
+                continue
+            parts = dy2static.split_at_break(self._orig_fn, line)
+            if parts is not None:
+                break
+        if parts is None:
+            return None
+        pre_fn, brk_fn, suf_fn, info = parts
+        # donate_state=False: the demote-to-eager path restores a
+        # snapshot of the pre-call state arrays; donation would delete
+        # them inside the prefix's jit and poison both the restore and
+        # the eager rerun
+        kwargs = dict(layers=self._layers, optimizers=self._optimizers,
+                      scalers=self._scalers, donate_state=False,
+                      full_graph=False)
+        pre_sf = StaticFunction(pre_fn, **kwargs)
+        suf_sf = StaticFunction(suf_fn, carry_args=True, **kwargs)
+        pre_sf._split_depth = suf_sf._split_depth = self._split_depth + 1
+        grad_hazard = info["grad_hazard"]
+
+        def _check_carry(carry, stage):
+            for k, v in carry.items():
+                if isinstance(v, Tensor):
+                    if grad_hazard:
+                        raise _PiecewiseUnsafe(
+                            f"{stage} carries tensor {k!r} across the "
+                            "split while the code after the break uses "
+                            "autograd — a materialized carry has no grad "
+                            "history, so backward/step would silently "
+                            "miss it")
+                    # runtime backstop for INDIRECT autograd the static
+                    # token scan can't see (a helper that differentiates):
+                    # the tape raises if a cotangent ever reaches a
+                    # carry-marked tensor, and the piecewise caller
+                    # demotes (base/tape.py run_backward)
+                    v._piecewise_carry = True
+                elif not isinstance(v, (int, float, bool, complex,
+                                        np.ndarray, jax.Array, type(None))):
+                    raise _PiecewiseUnsafe(
+                        f"{stage} carries non-tensor value {k!r} of type "
+                        f"{type(v).__name__}")
+
+        def piecewise(*args, **kw):
+            carry = pre_sf(*args, **kw)
+            _check_carry(carry, "prefix")
+            carry2 = brk_fn(carry)
+            _check_carry(carry2, "break")
+            return suf_sf(carry2)
+
+        piecewise._info = info
+        piecewise._prefix_sf, piecewise._suffix_sf = pre_sf, suf_sf
+        return piecewise
 
     def _sanitize_grads(self):
         for c in self._cells:
@@ -398,11 +589,11 @@ class StaticFunction:
 
         Returns the K-stacked outputs.
         """
-        if self._fallback_eager:
+        if self._fallback_eager or self._piecewise is not None:
             raise RuntimeError(
                 "multi_step requires full-graph capture, but this "
-                "function fell back to eager after a graph break "
-                "(full_graph=False); fix the break or use full_graph=True"
+                "function hit a graph break (full_graph=False) and runs "
+                "piecewise; fix the break or use full_graph=True"
             )
         if not self._cells:
             raise RuntimeError(

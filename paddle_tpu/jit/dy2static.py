@@ -51,6 +51,7 @@ from __future__ import annotations
 import ast
 import contextlib
 import inspect
+import sys
 import textwrap
 from typing import Any, Callable, Dict, List, Sequence, Tuple
 
@@ -984,18 +985,28 @@ def _compile_transform(fn):
 def graph_break_error(exc: BaseException) -> "GraphBreakError":
     """Actionable error for a tensor-bool reached under trace, naming the
     user source line (the reference's SOT emits a graph-break instead;
-    here the failing construct is reported with the rewrite options)."""
+    here the failing construct is reported with the rewrite options).
+    The returned error carries ``frames`` — the user-code (file, line)
+    candidates, deepest first — for piecewise splitting."""
     import traceback
 
     loc = None
+    frames = []
     for frame in reversed(traceback.extract_tb(exc.__traceback__)):
         f = frame.filename
-        if "/jax/" in f or "/paddle_tpu/" in f or f.startswith("<dy2static"):
+        if "/jax/" in f or "/paddle_tpu/" in f:
             continue
-        loc = f"{f}:{frame.lineno} ({frame.line})"
-        break
+        if f.startswith("<dy2static"):
+            # converted code: linenos are RELATIVE to the function start
+            # (the AST was parsed from dedented source); piecewise
+            # splitting translates them via co_firstlineno
+            frames.append((f, frame.lineno))
+            continue
+        frames.append((f, frame.lineno))
+        if loc is None:
+            loc = f"{f}:{frame.lineno} ({frame.line})"
     where = f" at {loc}" if loc else ""
-    return GraphBreakError(
+    err = GraphBreakError(
         "to_static: tensor-dependent Python control flow (or another "
         f"bool()/int()/numpy() concretization) reached under trace{where}. "
         "`if`/`while`/`for range()` and early-return `if` chains in the "
@@ -1006,3 +1017,244 @@ def graph_break_error(exc: BaseException) -> "GraphBreakError":
         "paddle.where / a converted-friendly loop; or mark the function "
         "@paddle.jit.not_to_static to run it eagerly."
     )
+    err.frames = frames
+    return err
+
+
+# -- piecewise capture: split a function at a graph-break statement ----------
+
+def _carry_get(carry: dict, name: str):
+    """Runtime unpacker for split-function carries: missing names become
+    _Undef sentinels (use raises, mirroring UnboundLocalError)."""
+    return carry[name] if name in carry else _Undef(name)
+
+
+def _stmt_names(stmts, ctx_type):
+    """Name identifiers with the given ctx in ``stmts``.
+
+    Load: descends everywhere (over-collection only widens the carry —
+    safe). Store: stops at nested function/class/lambda/comprehension
+    scopes, whose bindings are not locals of the split function (a
+    leaked nested-scope Store would generate an _Undef unpack shadowing
+    a real global in the suffix); a nested def/class still BINDS its
+    own name in the enclosing scope, as do import aliases and
+    ``except ... as`` names."""
+    out = set()
+    if ctx_type is ast.Load:
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, (ast.Load, ast.Del)):
+                    out.add(node.id)
+                elif (isinstance(node, ast.AugAssign)
+                        and isinstance(node.target, ast.Name)):
+                    # read-modify-write: the target must be carried even
+                    # though its ctx is Store
+                    out.add(node.target.id)
+        return out
+
+    class _Stores(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Store):
+                out.add(node.id)
+
+        def visit_FunctionDef(self, node):
+            out.add(node.name)  # don't descend: its body is another scope
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            out.add(node.name)
+
+        def visit_Lambda(self, node):
+            pass
+
+        def _comp(self, node):
+            pass  # comprehension targets live in their own scope
+
+        visit_ListComp = visit_SetComp = visit_DictComp = _comp
+        visit_GeneratorExp = _comp
+
+        def visit_Import(self, node):
+            for a in node.names:
+                out.add(a.asname or a.name.split(".")[0])
+
+        visit_ImportFrom = visit_Import
+
+        def visit_ExceptHandler(self, node):
+            if node.name:
+                out.add(node.name)
+            self.generic_visit(node)
+
+    v = _Stores()
+    for stmt in stmts:
+        v.visit(stmt)
+    return out
+
+
+def split_at_break(fn: Callable, break_line: int):
+    """Split ``fn`` into (prefix_fn, break_fn, suffix_fn, info) at the
+    TOP-LEVEL statement containing absolute source line ``break_line``.
+
+    The piecewise-capture core (reference: SOT's graph-break + resume
+    functions, jit/sot/opcode_translator/executor/opcode_executor.py:305,
+    1594 — there at bytecode level, here at statement level):
+
+    - ``prefix_fn``: original signature, runs statements before the
+      break, returns ``{name: value}`` for every local the rest needs;
+    - ``break_fn(carry) -> carry2``: the breaking statement, to run
+      EAGERLY each call (host control flow and side effects preserved);
+    - ``suffix_fn(carry2)``: the remaining statements (original returns
+      included).
+
+    Returns None when the function cannot be split safely: source
+    unavailable, the break line is not inside a top-level statement, a
+    ``return`` occurs at or before the breaking statement, or
+    global/nonlocal declarations are present. Free variables are bound
+    by VALUE at split time (late rebinding of closure cells is not
+    reflected — same trade as jit constant capture).
+    """
+    try:
+        code = fn.__code__
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fndef = tree.body[0]
+        if not isinstance(fndef, ast.FunctionDef):
+            return None
+        rel = break_line - code.co_firstlineno + 1
+        idx = None
+        for i, stmt in enumerate(fndef.body):
+            if stmt.lineno <= rel <= (stmt.end_lineno or stmt.lineno):
+                idx = i
+                break
+        if idx is None:
+            return None
+        body = fndef.body
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    return None
+        # a return at/before the break would have to skip the suffix
+        # (returns inside nested function scopes don't count)
+        class _ReturnFinder(ast.NodeVisitor):
+            found = False
+
+            def visit_Return(self, node):
+                self.found = True
+
+            def visit_FunctionDef(self, node):
+                pass
+
+            def visit_AsyncFunctionDef(self, node):
+                pass
+
+            def visit_Lambda(self, node):
+                pass
+
+        rf = _ReturnFinder()
+        for stmt in body[: idx + 1]:
+            rf.visit(stmt)
+        if rf.found:
+            return None
+
+        params = {a.arg for a in (
+            *fndef.args.posonlyargs, *fndef.args.args,
+            *fndef.args.kwonlyargs)}
+        if fndef.args.vararg:
+            params.add(fndef.args.vararg.arg)
+        if fndef.args.kwarg:
+            params.add(fndef.args.kwarg.arg)
+
+        avail1 = params | _stmt_names(body[:idx], ast.Store)
+        used_after = _stmt_names(body[idx:], ast.Load)
+        carry1 = sorted(avail1 & used_after)
+        avail2 = avail1 | _stmt_names([body[idx]], ast.Store)
+        used_suffix = _stmt_names(body[idx + 1:], ast.Load)
+        carry2 = sorted(avail2 & used_suffix)
+
+        sig = ast.unparse(fndef.args)
+        rt = _RUNTIME_NAME
+
+        def _block(stmts, extra_indent="    "):
+            if not stmts:
+                return ""
+            return textwrap.indent(
+                "\n".join(ast.unparse(s) for s in stmts), extra_indent) + "\n"
+
+        def _ret_carry(names):
+            keys = ", ".join(repr(n) for n in names)
+            return (f"    __pt_l = dict(locals())\n"
+                    f"    return {{k: __pt_l[k] for k in ({keys},)"
+                    f" if k in __pt_l}}\n")
+
+        def _unpack(names):
+            return "".join(
+                f"    {n} = {rt}._carry_get(__pt_carry, {n!r})\n"
+                for n in names)
+
+        name = fndef.name
+        parts = [
+            f"def __pt_prefix({sig}):\n"
+            + _block(body[:idx]) + _ret_carry(carry1),
+            f"def __pt_break(__pt_carry):\n"
+            + _unpack(carry1) + _block([body[idx]]) + _ret_carry(carry2),
+            f"def __pt_suffix(__pt_carry):\n"
+            + _unpack(carry2) + (_block(body[idx + 1:]) or "    pass\n"),
+        ]
+        module_src = "\n".join(parts)
+        # LIVE globals (the function's own module dict) + the original
+        # closure CELLS rebound onto the generated code — module-global
+        # or closure rebinding between calls stays visible, same as
+        # eager execution (the earlier by-value snapshot silently froze
+        # them). Same factory pattern as _compile_transform.
+        import types
+
+        gl = fn.__globals__
+        gl.setdefault(rt, sys.modules[__name__])
+        ns: Dict[str, Any] = {}
+        filename = f"<piecewise:{inspect.getsourcefile(fn) or '?'}:{name}>"
+        if code.co_freevars and fn.__closure__:
+            factory_src = (
+                "def __pt_factory(" + ", ".join(code.co_freevars) + "):\n"
+                + textwrap.indent(module_src, "    ")
+                + "\n    return __pt_prefix, __pt_break, __pt_suffix\n")
+            exec(compile(factory_src, filename, "exec"), gl, ns)
+            templates = ns["__pt_factory"](*[None] * len(code.co_freevars))
+            cellmap = dict(zip(code.co_freevars, fn.__closure__))
+
+            def _rebind(tmpl):
+                cells = tuple(
+                    cellmap[n] for n in tmpl.__code__.co_freevars)
+                f2 = types.FunctionType(
+                    tmpl.__code__, gl, tmpl.__name__, tmpl.__defaults__,
+                    cells)
+                f2.__kwdefaults__ = tmpl.__kwdefaults__
+                return f2
+
+            ns["__pt_prefix"], ns["__pt_break"], ns["__pt_suffix"] = (
+                _rebind(t) for t in templates)
+        else:
+            exec(compile(module_src, filename, "exec"), gl, ns)
+        info = {
+            "stmt": ast.unparse(body[idx]).splitlines()[0][:80],
+            "line": break_line,
+            "carry1": carry1,
+            "carry2": carry2,
+            # static hazard scan: autograd activity in break/suffix over
+            # tensors carried from the compiled prefix cannot work — a
+            # materialized carry has no grad history, so backward would
+            # silently produce no/partial grads. The caller demotes when
+            # this is set and any carried value is a Tensor.
+            "grad_hazard": any(
+                tok in ast.unparse(body[idx:])
+                for tok in (".backward(", "paddle.grad(", ".grad",
+                            ".step(", "clear_grad")),
+        }
+        pre, brk, suf = ns["__pt_prefix"], ns["__pt_break"], ns["__pt_suffix"]
+        pre.__name__ = f"{name}__prefix"
+        suf.__name__ = f"{name}__suffix"
+        for f_ in (pre, brk, suf):
+            f_.__globals__[rt] = sys.modules[__name__]
+        return pre, brk, suf, info
+    except Exception:
+        return None

@@ -786,3 +786,353 @@ class TestFullGraphFallback:
         np.testing.assert_allclose(sf(x).numpy(), f(x).numpy(), rtol=1e-6)
         assert not sf._fallback_eager
         assert sf._last_lowered is not None
+
+
+class TestPiecewiseCapture:
+    """full_graph=False piecewise capture (round-4 verdict Next #3, ref
+    SOT opcode_executor.py:305,1594): a graph break SPLITS the function —
+    prefix and suffix each run as one compiled program, only the
+    breaking statement runs eagerly, its host side effects re-executing
+    every call."""
+
+    @staticmethod
+    def _build():
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as popt
+
+        paddle.seed(0)
+        model = nn.Linear(4, 3)
+        o = popt.AdamW(learning_rate=0.01, parameters=model.parameters())
+        log = []
+
+        def step(x, y):
+            logits = model(x)
+            loss = F.cross_entropy(logits, y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            if float(loss) > -1e30:  # host concretization -> graph break
+                log.append(1)
+            metric = loss * 2.0 + 1.0
+            return metric
+
+        return model, o, step, log
+
+    def test_prefix_and_suffix_run_compiled(self):
+        m, o, step, log = self._build()
+        sf = pjit.to_static(step, layers=[m], optimizers=[o],
+                            full_graph=False)
+        rng = np.random.RandomState(0)
+        xs = [rng.randn(8, 4).astype(np.float32) for _ in range(4)]
+        ys = [rng.randint(0, 3, (8,)).astype(np.int64) for _ in range(4)]
+
+        with pytest.warns(UserWarning, match="piecewise capture"):
+            first = float(sf(paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0])))
+        assert sf._piecewise is not None and not sf._fallback_eager
+        pre, suf = sf._piecewise._prefix_sf, sf._piecewise._suffix_sf
+        got = [first]
+        got.append(float(sf(paddle.to_tensor(xs[1]), paddle.to_tensor(ys[1]))))
+        # steady state reached (the one extra trace is the documented
+        # lazy-accumulator retrace); later calls replay compiled programs
+        runs2 = (pre._pure_runs, suf._pure_runs)
+        got += [
+            float(sf(paddle.to_tensor(x), paddle.to_tensor(y)))
+            for x, y in zip(xs[2:], ys[2:])
+        ]
+        assert pre._last_lowered is not None and suf._last_lowered is not None
+        assert (pre._pure_runs, suf._pure_runs) == runs2  # no retraces
+        # the breaking statement ran eagerly on EVERY call (side effect)
+        assert log == [1, 1, 1, 1]
+
+        # loss trajectory matches a never-compiled eager run
+        m2, o2, step2, _ = self._build()
+        want = [float(step2(paddle.to_tensor(x), paddle.to_tensor(y)))
+                for x, y in zip(xs, ys)]
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+        assert o._global_step == o2._global_step
+
+    def test_branch_flip_reexecutes_host_control_flow(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(1)
+        m = nn.Linear(2, 2)
+        taken = []
+
+        def f(x, thresh):
+            y = m(x) * 2.0
+            if float(y.sum()) > thresh:  # break
+                taken.append(True)
+            else:
+                taken.append(False)
+            return y + 1.0
+
+        sf = pjit.to_static(f, layers=[m], full_graph=False)
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with pytest.warns(UserWarning, match="piecewise"):
+            out1 = sf(x, -1e9)   # predicate True
+        out2 = sf(x, 1e9)        # predicate False -> other branch, no
+        # recapture needed: the if is the eager statement
+        np.testing.assert_allclose(out1.numpy(), out2.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(out1.numpy(), (m(x) * 2.0 + 1.0).numpy(),
+                                   rtol=1e-5)
+        assert taken == [True, False]
+
+    def test_autograd_across_split_demotes_to_eager(self):
+        """backward over a tensor carried from the compiled prefix is
+        impossible (no grad history) — must demote, not silently train
+        wrong."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as popt
+
+        paddle.seed(2)
+        m = nn.Linear(4, 3)
+        o = popt.SGD(learning_rate=0.05, parameters=m.parameters())
+
+        def step(x, y):
+            logits = m(x)
+            loss = F.cross_entropy(logits, y)
+            if float(loss) > -1e30:  # break BEFORE backward
+                pass
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return loss
+
+        sf = pjit.to_static(step, layers=[m], optimizers=[o],
+                            full_graph=False)
+        rng = np.random.RandomState(1)
+        xs = [rng.randn(8, 4).astype(np.float32) for _ in range(3)]
+        ys = [rng.randint(0, 3, (8,)).astype(np.int64) for _ in range(3)]
+        with pytest.warns(UserWarning):
+            got = [float(sf(paddle.to_tensor(x), paddle.to_tensor(y)))
+                   for x, y in zip(xs, ys)]
+        assert sf._fallback_eager  # unsafe split -> whole-function eager
+
+        paddle.seed(2)
+        m2 = nn.Linear(4, 3)
+        o2 = popt.SGD(learning_rate=0.05, parameters=m2.parameters())
+
+        def step2(x, y):
+            loss = F.cross_entropy(m2(x), y)
+            loss.backward()
+            o2.step()
+            o2.clear_grad()
+            return loss
+
+        want = [float(step2(paddle.to_tensor(x), paddle.to_tensor(y)))
+                for x, y in zip(xs, ys)]
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_unsafe_trial_does_not_double_step(self):
+        """The trial piecewise run may commit a compiled prefix (incl.
+        an optimizer step) before proving unsafe; the eager rerun must
+        not apply the step twice (host state restored)."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as popt
+
+        def build():
+            paddle.seed(4)
+            m = nn.Linear(4, 3)
+            o = popt.SGD(learning_rate=0.05, parameters=m.parameters())
+            return m, o
+
+        def make(m, o):
+            def step(x, y):
+                loss = F.cross_entropy(m(x), y)
+                loss.backward()
+                o.step()
+                o.clear_grad()
+                stats = {"loss": float(loss)}  # dict local -> unsafe carry
+                if float(loss) > -1e30:  # break
+                    pass
+                return stats["loss"]
+
+            return step
+
+        rng = np.random.RandomState(2)
+        xs = [rng.randn(8, 4).astype(np.float32) for _ in range(3)]
+        ys = [rng.randint(0, 3, (8,)).astype(np.int64) for _ in range(3)]
+
+        m1, o1 = build()
+        eager = make(m1, o1)
+        want = [eager(paddle.to_tensor(x), paddle.to_tensor(y))
+                for x, y in zip(xs, ys)]
+
+        m2, o2 = build()
+        sf = pjit.to_static(make(m2, o2), layers=[m2], optimizers=[o2],
+                            full_graph=False)
+        with pytest.warns(UserWarning):
+            got = [sf(paddle.to_tensor(x), paddle.to_tensor(y))
+                   for x, y in zip(xs, ys)]
+        assert sf._fallback_eager
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        assert o2._global_step == o1._global_step  # no double step
+        for pa, pb in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(np.asarray(pb._data),
+                                       np.asarray(pa._data), rtol=1e-6)
+
+    def test_later_call_unsafe_demotes_instead_of_raising(self):
+        """A branch that binds a non-jaxable local only on SOME calls:
+        the first call installs piecewise, a later call must demote to
+        eager (with the documented warning), not leak an internal
+        exception mid-training-loop."""
+        import paddle_tpu.nn as nn
+
+        paddle.seed(5)
+        m = nn.Linear(2, 2)
+
+        def f(x, flag):
+            y = m(x) * 2.0
+            if float(y.sum()) > flag:  # break
+                extra = None
+            else:
+                extra = {"bad": 1}
+            z = y + 1.0
+            return z if extra is None else z + 0.0
+
+        sf = pjit.to_static(f, layers=[m], full_graph=False)
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with pytest.warns(UserWarning, match="piecewise"):
+            out1 = sf(x, -1e9)  # extra=None -> installs piecewise
+        assert sf._piecewise is not None
+        with pytest.warns(UserWarning, match="became unsafe"):
+            out2 = sf(x, 1e9)  # extra=dict -> demote, run eagerly
+        assert sf._fallback_eager and sf._piecewise is None
+        np.testing.assert_allclose(out1.numpy(), f(x, -1e9).numpy(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out2.numpy(), f(x, 1e9).numpy(),
+                                   rtol=1e-5)
+
+    def test_break_inside_same_file_helper_splits_at_call_site(self):
+        """When the concretization happens inside a helper in the same
+        file, the deepest frame maps outside the function body — the
+        call-site frame must still produce the split."""
+        import paddle_tpu.nn as nn
+
+        paddle.seed(6)
+        m = nn.Linear(2, 2)
+
+        def helper(t):
+            return float(t.sum()) > 0  # concretization in the helper
+
+        def f(x):
+            y = m(x) + 1.0
+            flag = helper(y)  # break at THIS call site
+            z = y * 3.0
+            return z, flag
+
+        sf = pjit.to_static(f, layers=[m], full_graph=False)
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with pytest.warns(UserWarning, match="piecewise capture"):
+            z, flag = sf(x)
+        assert sf._piecewise is not None and not sf._fallback_eager
+        ze, fe = f(x)
+        np.testing.assert_allclose(z.numpy(), ze.numpy(), rtol=1e-5)
+        assert flag == fe
+        assert sf._piecewise._prefix_sf._last_lowered is not None
+        assert sf._piecewise._suffix_sf._last_lowered is not None
+
+    def test_indirect_autograd_in_helper_demotes(self):
+        """The static token scan can't see a helper that differentiates;
+        the tape-level carry backstop must catch it at runtime and the
+        call must demote — never silently train wrong."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as popt
+
+        def build():
+            paddle.seed(8)
+            m = nn.Linear(4, 3)
+            o = popt.SGD(learning_rate=0.05, parameters=m.parameters())
+            return m, o
+
+        def make(m, o):
+            def apply_update(loss):  # autograd hidden in a helper
+                loss.backward()
+                o.step()
+                o.clear_grad()
+
+            def step(x, y):
+                loss = F.cross_entropy(m(x), y)
+                if float(loss) > -1e30:  # break BEFORE the update helper
+                    pass
+                apply_update(loss)
+                return loss
+
+            return step
+
+        rng = np.random.RandomState(3)
+        xs = [rng.randn(8, 4).astype(np.float32) for _ in range(3)]
+        ys = [rng.randint(0, 3, (8,)).astype(np.int64) for _ in range(3)]
+
+        m1, o1 = build()
+        eager = make(m1, o1)
+        want = [float(eager(paddle.to_tensor(x), paddle.to_tensor(y)))
+                for x, y in zip(xs, ys)]
+
+        m2, o2 = build()
+        sf = pjit.to_static(make(m2, o2), layers=[m2], optimizers=[o2],
+                            full_graph=False)
+        with pytest.warns(UserWarning):
+            got = [float(sf(paddle.to_tensor(x), paddle.to_tensor(y)))
+                   for x, y in zip(xs, ys)]
+        assert sf._fallback_eager  # demoted, not silently wrong
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        assert o2._global_step == o1._global_step
+        for pa, pb in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(np.asarray(pb._data),
+                                       np.asarray(pa._data), rtol=1e-6)
+
+    def test_augassign_after_break_is_carried(self):
+        """'patience -= 1' after the break: the target's ctx is Store,
+        but it must still be carried (read-modify-write)."""
+        import paddle_tpu.nn as nn
+
+        paddle.seed(9)
+        m = nn.Linear(2, 2)
+
+        def f(x):
+            y = m(x) * 2.0
+            patience = 3
+            if float(y.sum()) > -1e30:  # break
+                patience -= 1
+            z = y + float(patience)
+            return z, patience
+
+        sf = pjit.to_static(f, layers=[m], full_graph=False)
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with pytest.warns(UserWarning):
+            z, patience = sf(x)
+        ze, pe = f(x)
+        np.testing.assert_allclose(np.asarray(z.numpy(), np.float32),
+                                   ze.numpy(), rtol=1e-5)
+        assert int(patience) == pe == 2
+
+    def test_break_statement_sees_live_globals(self):
+        """Compiled segments freeze globals at trace time (ordinary jit
+        semantics) — but the BREAK statement re-executes eagerly every
+        call and must see module-global rebinding, same as eager."""
+        import paddle_tpu.nn as nn
+        import sys
+
+        mod = sys.modules[__name__]
+        mod._pw_knob = 1.0
+        try:
+            paddle.seed(10)
+            m = nn.Linear(2, 2)
+
+            def f(x):
+                y = m(x) * 2.0
+                if float(y.sum()) > -1e30:  # break reads the knob
+                    flag = float(_pw_knob)
+                return y + 1.0, flag
+
+            sf = pjit.to_static(f, layers=[m], full_graph=False)
+            x = paddle.to_tensor(np.ones((2, 2), np.float32))
+            with pytest.warns(UserWarning, match="piecewise"):
+                _, flag1 = sf(x)
+            assert sf._piecewise is not None
+            mod._pw_knob = 100.0  # rebind the global
+            _, flag2 = sf(x)
+            assert float(flag1) == 1.0 and float(flag2) == 100.0
+        finally:
+            del mod._pw_knob
