@@ -1,0 +1,280 @@
+"""Continuous batching over the paged KV cache.
+
+The serving loop paged attention exists for (ref:
+python/paddle/incubate/nn/functional/block_multihead_attention.py —
+the reference's serving kernel keeps per-sequence block tables exactly
+so sequences can join and leave a running batch): a fixed pool of HBM
+blocks, a fixed number of batch slots, requests admitted as slots and
+blocks free up, finished sequences evicted and their blocks recycled.
+
+TPU-native design (single compiled program per phase, static shapes):
+
+- ONE decode program serves every engine iteration: tokens [B],
+  per-layer pools, block tables [B, max_blocks], per-sequence
+  ``cache_len`` [B] (the scalar-or-[B] contract of
+  ops/paged_attention.py). Slot membership changes only change the
+  TABLE CONTENTS and lengths — never shapes — so XLA compiles once.
+- ONE prefill program (prompts padded to ``prompt_pad``) admits a
+  request into a slot: rows other than the admitted one have their
+  table pointed entirely at a reserved TRASH block, so their scattered
+  writes land in a sacrificial page and live sequences are untouched
+  (the positions a padded prompt writes past its real length are
+  overwritten by later decode steps before they are ever attended).
+- ``BlockManager`` (ops/paged_attention.py) is the allocator; eviction
+  returns a sequence's blocks to the free list, and the next admission
+  may reuse them immediately — correctness is guaranteed by the tables
+  alone, which is what the eviction test pins down.
+
+Greedy decoding (temperature 0) — matching models.generation.generate's
+default — so engine outputs are token-identical to isolated generate()
+runs, which is the correctness contract the tests assert.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base.tape import no_grad
+from ..base.tensor import Tensor
+from ..ops.paged_attention import BlockManager, PagedLayerCache
+
+__all__ = ["GenRequest", "ContinuousBatchingEngine"]
+
+
+@dataclass
+class GenRequest:
+    """One generation request (ref: the reference's serving request —
+    prompt ids + budget)."""
+
+    req_id: object
+    prompt: np.ndarray  # [s] int
+    max_new_tokens: int = 32
+    out: List[int] = field(default_factory=list)
+
+
+class _Slot:
+    __slots__ = ("req", "cache_len", "remaining")
+
+    def __init__(self):
+        self.req: Optional[GenRequest] = None
+        self.cache_len = 0
+        self.remaining = 0
+
+    @property
+    def active(self):
+        return self.req is not None
+
+
+class ContinuousBatchingEngine:
+    def __init__(self, model, *, max_batch: int, max_len: int,
+                 block_size: int = 64, num_blocks: int,
+                 prompt_pad: Optional[int] = None,
+                 eos_token_id: Optional[int] = None):
+        """``num_blocks`` fixes the HBM budget (the pool allocates one
+        extra trash block); ``max_len`` bounds any sequence's positions
+        (tables carry ceil(max_len/block_size) slots per row);
+        ``prompt_pad`` is the static prefill width (default: one block).
+        """
+        self.model = model
+        self.B = int(max_batch)
+        self.block_size = int(block_size)
+        self.max_len = int(max_len)
+        self.prompt_pad = int(prompt_pad or block_size)
+        if self.prompt_pad > self.max_len:
+            raise ValueError("prompt_pad exceeds max_len")
+        self.eos_token_id = eos_token_id
+        self.manager = BlockManager(num_blocks, block_size)
+        self._trash = num_blocks  # reserved sacrificial pool row
+        self.max_blocks_per_seq = -(-self.max_len // block_size)
+
+        was_training = model.training
+        model.eval()
+        self._restore_training = was_training
+        caches = model.init_cache(
+            self.B, self.max_len, block_size=block_size,
+            num_blocks=num_blocks + 1,
+            tables=np.full((self.B, self.max_blocks_per_seq), self._trash,
+                           np.int32),
+        )
+        self._pools = [(c.k_pool._data, c.v_pool._data) for c in caches]
+        self._tables = np.full(
+            (self.B, self.max_blocks_per_seq), self._trash, np.int32)
+        self._slots = [_Slot() for _ in range(self.B)]
+        self._queue: List[GenRequest] = []
+        self._completed: Dict[object, GenRequest] = {}
+        self._params = list(model.parameters())
+        self._prefill_jit = None
+        self._decode_jit = None
+        self.steps = 0
+        self.decode_tokens = 0
+
+    # -- compiled phases -------------------------------------------------
+    def _caches_from(self, pools, tables_arr):
+        t = Tensor(tables_arr, _internal=True)
+        return [
+            PagedLayerCache(Tensor(k, _internal=True),
+                            Tensor(v, _internal=True), t, False)
+            for k, v in pools
+        ]
+
+    def _build_jits(self):
+        model, params = self.model, self._params
+
+        def prefill(param_arrays, pools, ids, tables, cache_len):
+            for p, a in zip(params, param_arrays):
+                p._data = a
+            with no_grad():
+                caches = self._caches_from(pools, tables)
+                logits, new_caches = model.forward_with_cache(
+                    Tensor(ids, _internal=True), caches,
+                    Tensor(cache_len, _internal=True))
+            toks = jnp.argmax(logits._data, axis=-1)  # [B, s_pad]
+            return toks, [(c.k_pool._data, c.v_pool._data)
+                          for c in new_caches]
+
+        def decode(param_arrays, pools, tok, tables, cache_len):
+            for p, a in zip(params, param_arrays):
+                p._data = a
+            with no_grad():
+                caches = self._caches_from(pools, tables)
+                logits, new_caches = model.forward_with_cache(
+                    Tensor(tok[:, None], _internal=True), caches,
+                    Tensor(cache_len, _internal=True))
+            nxt = jnp.argmax(logits._data[:, -1], axis=-1).astype(jnp.int32)
+            return nxt, [(c.k_pool._data, c.v_pool._data)
+                         for c in new_caches]
+
+        self._prefill_jit = jax.jit(prefill, donate_argnums=(1,))
+        self._decode_jit = jax.jit(decode, donate_argnums=(1,))
+
+    def _run_jit(self, jit_fn, *args):
+        """Invoke a compiled phase with the params' CURRENT host arrays
+        (weight updates after engine construction are served) and
+        restore them afterwards: the traced body writes tracers into
+        p._data; leaving them there would leak tracers into the next
+        eager/jit use."""
+        current = [p._data for p in self._params]
+        try:
+            return jit_fn(current, *args)
+        finally:
+            for p, a in zip(self._params, current):
+                p._data = a
+
+    # -- public API ------------------------------------------------------
+    def add_request(self, req_id, prompt, max_new_tokens: int = 32):
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0 or prompt.size > self.prompt_pad:
+            raise ValueError(
+                f"prompt length {prompt.size} not in [1, prompt_pad="
+                f"{self.prompt_pad}]")
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_len")
+        req = GenRequest(req_id, prompt, max_new_tokens)
+        if self._blocks_needed(req) > self.manager.num_blocks:
+            raise ValueError(
+                f"request needs {self._blocks_needed(req)} blocks but the "
+                f"pool only has {self.manager.num_blocks} — it could never "
+                "be admitted")
+        self._queue.append(req)
+
+    @property
+    def num_active(self):
+        return sum(s.active for s in self._slots)
+
+    def _blocks_needed(self, req):
+        total = max(int(req.prompt.size) + req.max_new_tokens,
+                    self.prompt_pad)
+        return -(-total // self.block_size)
+
+    def _admit(self):
+        """Fill free slots from the queue while blocks last; one padded
+        prefill per admission (per-slot isolation via the trash table).
+        """
+        for slot_idx, slot in enumerate(self._slots):
+            if not self._queue or slot.active:
+                continue
+            req = self._queue[0]
+            if self._blocks_needed(req) > self.manager.free_blocks:
+                break  # head-of-line; keep FIFO fairness
+            self._queue.pop(0)
+            blocks = self.manager.allocate(
+                req.req_id,
+                max(req.prompt.size + req.max_new_tokens, self.prompt_pad))
+            row = np.full((self.max_blocks_per_seq,), self._trash, np.int32)
+            row[: len(blocks)] = blocks
+            self._tables[slot_idx] = row
+            slot.req = req
+            slot.cache_len = int(req.prompt.size)
+            slot.remaining = req.max_new_tokens
+
+            # isolated prefill: only this row's table points at real
+            # blocks; every other row scatters into the trash block
+            iso = np.full_like(self._tables, self._trash)
+            iso[slot_idx] = row
+            ids = np.zeros((self.B, self.prompt_pad), np.int32)
+            ids[slot_idx, : req.prompt.size] = req.prompt
+            if self._prefill_jit is None:
+                self._build_jits()
+            toks, self._pools = self._run_jit(
+                self._prefill_jit, self._pools, jnp.asarray(ids),
+                jnp.asarray(iso), jnp.zeros((self.B,), jnp.int32))
+            first = int(np.asarray(toks)[slot_idx, req.prompt.size - 1])
+            req.out.append(first)
+            slot.remaining -= 1
+            if self._finish_if_done(slot_idx, first):
+                continue
+
+    def _finish_if_done(self, slot_idx, last_tok) -> bool:
+        slot = self._slots[slot_idx]
+        req = slot.req
+        done = slot.remaining <= 0 or (
+            self.eos_token_id is not None and last_tok == self.eos_token_id)
+        if done:
+            self.manager.free_sequence(req.req_id)
+            self._tables[slot_idx] = self._trash
+            self._completed[req.req_id] = req
+            slot.req = None
+        return done
+
+    def step(self):
+        """One engine iteration: admit, then one decode step for every
+        active slot. Returns the requests completed this iteration."""
+        before = set(self._completed)
+        self._admit()
+        active = [i for i, s in enumerate(self._slots) if s.active]
+        if active:
+            if self._decode_jit is None:
+                self._build_jits()
+            tok = np.zeros((self.B,), np.int32)
+            cl = np.zeros((self.B,), np.int32)
+            for i in active:
+                slot = self._slots[i]
+                tok[i] = slot.req.out[-1]
+                cl[i] = slot.cache_len
+            nxt, self._pools = self._run_jit(
+                self._decode_jit, self._pools, jnp.asarray(tok),
+                jnp.asarray(self._tables), jnp.asarray(cl))
+            nxt = np.asarray(nxt)
+            for i in active:
+                slot = self._slots[i]
+                t = int(nxt[i])
+                slot.req.out.append(t)
+                slot.cache_len += 1
+                slot.remaining -= 1
+                self.decode_tokens += 1
+                self._finish_if_done(i, t)
+        self.steps += 1
+        return [self._completed[r] for r in set(self._completed) - before]
+
+    def run(self, max_steps: int = 100_000) -> Dict[object, GenRequest]:
+        """Drain the queue + active slots; returns {req_id: GenRequest}."""
+        while (self._queue or self.num_active) and max_steps > 0:
+            self.step()
+            max_steps -= 1
+        if self._restore_training:
+            self.model.train()
+        return dict(self._completed)

@@ -528,7 +528,7 @@ class StaticFunction:
         pre_sf._split_depth = suf_sf._split_depth = self._split_depth + 1
         grad_hazard = info["grad_hazard"]
 
-        def _check_carry(carry, stage):
+        def _check_carry(carry, stage, marked):
             for k, v in carry.items():
                 if isinstance(v, Tensor):
                     if grad_hazard:
@@ -544,6 +544,7 @@ class StaticFunction:
                     # carry-marked tensor, and the piecewise caller
                     # demotes (base/tape.py run_backward)
                     v._piecewise_carry = True
+                    marked.append(v)
                 elif not isinstance(v, (int, float, bool, complex,
                                         np.ndarray, jax.Array, type(None))):
                     raise _PiecewiseUnsafe(
@@ -551,11 +552,20 @@ class StaticFunction:
                         f"{type(v).__name__}")
 
         def piecewise(*args, **kw):
-            carry = pre_sf(*args, **kw)
-            _check_carry(carry, "prefix")
-            carry2 = brk_fn(carry)
-            _check_carry(carry2, "break")
-            return suf_sf(carry2)
+            marked = []
+            try:
+                carry = pre_sf(*args, **kw)
+                _check_carry(carry, "prefix", marked)
+                carry2 = brk_fn(carry)
+                _check_carry(carry2, "break", marked)
+                return suf_sf(carry2)
+            finally:
+                # the break may bind LONG-LIVED objects (a parameter,
+                # a buffer) to a carried local — the mark must not
+                # outlive the call or later ordinary backward()s
+                # through that tensor would raise forever
+                for t in marked:
+                    t._piecewise_carry = False
 
         piecewise._info = info
         piecewise._prefix_sf, piecewise._suffix_sf = pre_sf, suf_sf

@@ -56,6 +56,12 @@ def _default_state_scheduler(step: int) -> ProfilerState:
     return ProfilerState.RECORD
 
 
+# the innermost active Profiler; RecordEvent spans report here so
+# summary() can print the user-annotation table (ref:
+# profiler_statistic.py UserDefined view)
+_active_profiler = None
+
+
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
     """ref: profiler.py export_chrome_tracing — returns an
     on_trace_ready callback; the jax trace directory is TensorBoard's
@@ -90,6 +96,9 @@ class RecordEvent:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
             self.end_ns = time.perf_counter_ns()
+            if _active_profiler is not None:
+                _active_profiler._events.append(
+                    (self.name, self.end_ns - self.begin_ns))
 
     def __enter__(self):
         self.begin()
@@ -131,14 +140,22 @@ class Profiler:
         self._exported_dir = None
         self._step_times = []
         self._last_step_t = None
+        self._events = []  # completed RecordEvent spans (name, dur_ns)
 
     # -- lifecycle -----------------------------------------------------
     def start(self):
+        global _active_profiler
+        self._prev_active = _active_profiler  # stack discipline: an
+        # inner profiler must not deregister the outer one on stop
+        _active_profiler = self
         self._state = self._scheduler(self.step_num)
         self._transition()
         self._last_step_t = time.perf_counter()
 
     def stop(self):
+        global _active_profiler
+        if _active_profiler is self:
+            _active_profiler = getattr(self, "_prev_active", None)
         if self._tracing:
             self._stop_trace()
         if self._on_trace_ready is not None:
@@ -194,23 +211,135 @@ class Profiler:
         return False
 
     # -- reporting -----------------------------------------------------
+    def _collect_trace_ops(self):
+        """Aggregate the captured XLA trace's complete events into
+        per-op statistics, grouped by execution lane.
+
+        The jax tracer writes the TensorBoard profile format; the
+        chrome-trace file inside it carries one complete ('ph':'X')
+        event per executed op/kernel with its duration, and 'M'
+        metadata events naming each pid's lane ('/device:TPU:0 ...',
+        host threads, ...). This is the device-event source the
+        reference aggregates in profiler_statistic.py.
+
+        Returns {lane_label: {op_name: [count, total_us, max_us]}}.
+        """
+        import glob
+        import gzip
+        import json as _json
+
+        trace_dir = self._exported_dir or self._dir
+        paths = sorted(
+            glob.glob(os.path.join(
+                trace_dir, "plugins", "profile", "*", "*.trace.json.gz")),
+            key=os.path.getmtime)
+        if not paths:
+            return {}
+        with gzip.open(paths[-1], "rt") as f:
+            events = _json.load(f).get("traceEvents", [])
+        pid_label = {}
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pid_label[e.get("pid")] = e.get("args", {}).get("name", "?")
+        lanes = {}
+        for e in events:
+            if e.get("ph") != "X" or "dur" not in e:
+                continue
+            name = e.get("name", "?")
+            if name.startswith(("$", "<")):
+                # raw python source frames ("$file.py:123 fn") — the
+                # table shows logical ops/kernels, like the reference's
+                continue
+            label = pid_label.get(e.get("pid"), "?")
+            ops = lanes.setdefault(label, {})
+            st = ops.setdefault(name, [0, 0.0, 0.0])
+            st[0] += 1
+            st[1] += float(e["dur"])
+            st[2] = max(st[2], float(e["dur"]))
+        return lanes
+
+    @staticmethod
+    def _print_table(title, rows, total_us, top_k):
+        """rows: [(name, count, total_us, max_us)] — the reference's
+        op-summary table shape (profiler_statistic.py _build_table)."""
+        print(f"\n{'-' * 78}\n{title}\n{'-' * 78}")
+        print(f"{'Name':<40} {'Calls':>6} {'Total(ms)':>10} "
+              f"{'Avg(ms)':>9} {'Max(ms)':>9} {'Ratio':>6}")
+        for name, count, tot, mx in rows[:top_k]:
+            ratio = tot / total_us if total_us else 0.0
+            shown = name if len(name) <= 40 else name[:37] + "..."
+            print(f"{shown:<40} {count:>6} {tot / 1000:>10.3f} "
+                  f"{tot / 1000 / max(count, 1):>9.3f} {mx / 1000:>9.3f} "
+                  f"{ratio:>6.1%}")
+
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
-        """Host-side step-time summary; the op-level breakdown lives in
-        the exported XLA trace (TensorBoard), which supersedes the
-        reference's table printer."""
-        if not self._step_times:
-            print("Profiler: no steps recorded")
-            return
+                time_unit="ms", top_k: int = 20):
+        """Step-time overview + per-op device/host tables aggregated
+        from the captured trace + user RecordEvent spans + a device
+        memory view (ref: profiler/profiler_statistic.py — overview,
+        op summary, UserDefined and memory views)."""
         import numpy as np
 
-        ts = np.asarray(self._step_times) * 1000.0
-        print(
-            f"Profiler summary over {len(ts)} steps: "
-            f"mean {ts.mean():.3f} ms, p50 {np.percentile(ts, 50):.3f} ms, "
-            f"p99 {np.percentile(ts, 99):.3f} ms"
-            + (f"; trace exported to {self._exported_dir}" if self._exported_dir else "")
-        )
+        if self._step_times:
+            ts = np.asarray(self._step_times) * 1000.0
+            print(
+                f"Profiler summary over {len(ts)} steps: "
+                f"mean {ts.mean():.3f} ms, p50 {np.percentile(ts, 50):.3f} ms, "
+                f"p99 {np.percentile(ts, 99):.3f} ms"
+                + (f"; trace exported to {self._exported_dir}"
+                   if self._exported_dir else "")
+            )
+        else:
+            print("Profiler: no steps recorded")
+
+        if op_detail:
+            lanes = self._collect_trace_ops()
+            order = sorted_by or SortedKeys.GPUTotal
+            key = {
+                SortedKeys.GPUMax: lambda r: r[3],
+                SortedKeys.CPUMax: lambda r: r[3],
+                SortedKeys.GPUAvg: lambda r: r[2] / max(r[1], 1),
+                SortedKeys.CPUAvg: lambda r: r[2] / max(r[1], 1),
+            }.get(order, lambda r: r[2])
+            # device lanes first (the tables that matter), then host
+            def lane_rank(label):
+                return (0 if "device" in label.lower()
+                        or "tpu" in label.lower() else 1, label)
+
+            for label in sorted(lanes, key=lane_rank):
+                rows = sorted(
+                    ((n, c, t, m) for n, (c, t, m) in lanes[label].items()),
+                    key=key, reverse=True)
+                total = sum(r[2] for r in rows)
+                self._print_table(f"Op summary — {label}", rows, total,
+                                  top_k)
+
+        if self._events:
+            agg = {}
+            for name, dur_ns in self._events:
+                st = agg.setdefault(name, [0, 0.0, 0.0])
+                st[0] += 1
+                st[1] += dur_ns / 1000.0
+                st[2] = max(st[2], dur_ns / 1000.0)
+            rows = sorted(((n, c, t, m) for n, (c, t, m) in agg.items()),
+                          key=lambda r: r[2], reverse=True)
+            self._print_table("UserDefined summary (RecordEvent)", rows,
+                              sum(r[2] for r in rows), top_k)
+
+        # memory view: live device telemetry (ref MemorySummary)
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+        except Exception:
+            stats = {}
+        if stats:
+            used = stats.get("bytes_in_use", 0)
+            peak = stats.get("peak_bytes_in_use", 0)
+            limit = stats.get("bytes_limit", 0)
+            print(f"\nDevice memory: in use {used / 2**20:.1f} MiB, "
+                  f"peak {peak / 2**20:.1f} MiB"
+                  + (f", limit {limit / 2**20:.1f} MiB" if limit else ""))
 
     def export(self, path: Optional[str] = None, format: str = "json"):
         return self._exported_dir

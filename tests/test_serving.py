@@ -1,0 +1,151 @@
+"""Continuous batching engine (round-4 verdict Next #8).
+
+Correctness contract: greedy engine outputs are token-identical to
+isolated generate() runs — ESPECIALLY after evictions recycle blocks
+into newly admitted sequences (the failure mode block tables exist to
+prevent; ref: incubate/nn/functional/block_multihead_attention.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import ContinuousBatchingEngine
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import generate
+
+pytestmark = pytest.mark.slow
+
+
+def _model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _reference_tokens(model, prompt, max_new):
+    ids = paddle.to_tensor(np.asarray(prompt, np.int64)[None])
+    out = generate(model, ids, max_new_tokens=max_new, use_jit=False)
+    return list(np.asarray(out.numpy())[0][len(prompt):])
+
+
+class TestContinuousBatching:
+    def test_mixed_prompts_match_isolated_generate(self):
+        model = _model()
+        rng = np.random.RandomState(0)
+        prompts = {
+            "a": rng.randint(0, 250, (5,)),
+            "b": rng.randint(0, 250, (11,)),
+            "c": rng.randint(0, 250, (3,)),
+        }
+        budgets = {"a": 6, "b": 4, "c": 8}
+
+        eng = ContinuousBatchingEngine(
+            model, max_batch=3, max_len=64, block_size=8, num_blocks=24,
+            prompt_pad=16)
+        for rid, p in prompts.items():
+            eng.add_request(rid, p, max_new_tokens=budgets[rid])
+        done = eng.run()
+        assert set(done) == set(prompts)
+        for rid, p in prompts.items():
+            want = _reference_tokens(model, p, budgets[rid])
+            assert done[rid].out == want, (rid, done[rid].out, want)
+
+    def test_eviction_recycles_blocks_without_corruption(self):
+        """max_batch=2, pool sized so the 3rd request MUST reuse the 1st
+        request's freed blocks while the 2nd is still decoding — the
+        survivor's and the newcomer's tokens must both stay exact."""
+        model = _model()
+        rng = np.random.RandomState(1)
+        p_short = rng.randint(0, 250, (4,))   # finishes first
+        p_long = rng.randint(0, 250, (6,))    # survives the eviction
+        p_new = rng.randint(0, 250, (7,))     # admitted into freed blocks
+
+        # per request: ceil(max(prompt+new, pad)/bs) blocks = 2 each;
+        # 4 total blocks => the third request CANNOT be admitted until
+        # the first frees its 2
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=32, block_size=8, num_blocks=4,
+            prompt_pad=8)
+        eng.add_request("short", p_short, max_new_tokens=3)
+        eng.add_request("long", p_long, max_new_tokens=10)
+        eng.add_request("new", p_new, max_new_tokens=5)
+
+        first_batch = eng.step()
+        assert eng.num_active == 2  # "new" had to wait for blocks
+        done = eng.run()
+        assert set(done) == {"short", "long", "new"}
+        for rid, p, n in (("short", p_short, 3), ("long", p_long, 10),
+                          ("new", p_new, 5)):
+            want = _reference_tokens(model, p, n)
+            assert done[rid].out == want, (rid, done[rid].out, want)
+        # blocks really recycled: everything freed at the end
+        assert eng.manager.free_blocks == 4
+
+    def test_eos_finishes_early_and_frees_blocks(self):
+        model = _model()
+        p = np.random.RandomState(2).randint(0, 250, (4,))
+        ref = _reference_tokens(model, p, 8)
+        eos = ref[2]  # force an early stop at the 3rd generated token
+
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=4,
+            prompt_pad=8, eos_token_id=eos)
+        eng.add_request("x", p, max_new_tokens=8)
+        done = eng.run()
+        assert done["x"].out == ref[:3]  # stopped AT the eos token
+        assert eng.manager.free_blocks == 4
+
+    def test_admission_rejects_oversized(self):
+        model = _model()
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=4,
+            prompt_pad=8)
+        with pytest.raises(ValueError, match="prompt length"):
+            eng.add_request("big", np.zeros(9, np.int32))
+        with pytest.raises(ValueError, match="max_len"):
+            eng.add_request("long", np.zeros(8, np.int32),
+                            max_new_tokens=100)
+
+    def test_sustained_throughput_counters(self):
+        """The stats the benchmark row reports: decode tokens + steps
+        accumulate across arrivals/finishes."""
+        model = _model()
+        rng = np.random.RandomState(3)
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=32, block_size=8, num_blocks=8,
+            prompt_pad=8)
+        for i in range(4):
+            eng.add_request(i, rng.randint(0, 250, (4,)), max_new_tokens=4)
+        done = eng.run()
+        assert len(done) == 4
+        # 4 requests x 4 tokens, one from each prefill => 12 decode
+        assert eng.decode_tokens == 12
+        assert eng.steps >= 6  # two waves of 2 + drain
+
+    def test_weight_updates_after_construction_are_served(self):
+        """The engine must serve the params' CURRENT values (and leave
+        them intact), not an init-time snapshot."""
+        import jax.numpy as jnp
+
+        model = _model()
+        p = np.random.RandomState(4).randint(0, 250, (4,))
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=4,
+            prompt_pad=8)
+        eng.add_request("r1", p, max_new_tokens=4)
+        out1 = eng.run()["r1"].out
+
+        # perturb the lm head; outputs must change and params survive
+        head = model.lm_head.weight if hasattr(model, "lm_head") else None
+        target = head if head is not None else model.parameters()[-1]
+        before = np.asarray(target._data).copy()
+        target._data = target._data + jnp.asarray(
+            np.random.RandomState(5).randn(*before.shape).astype(
+                before.dtype) * 0.5)
+        after = np.asarray(target._data).copy()
+
+        eng.add_request("r2", p, max_new_tokens=4)
+        out2 = eng.run()["r2"].out
+        want = _reference_tokens(model, p, 4)
+        assert out2 == want  # serves the NEW weights
+        assert out2 != out1 or np.allclose(before, after)
+        np.testing.assert_array_equal(np.asarray(target._data), after)
