@@ -38,6 +38,11 @@ _OP_NAMESPACES = [
     "paddle_tpu.nn.functional.attention",
     "paddle_tpu.fft",
     "paddle_tpu.vision.ops",
+    "paddle_tpu.sparse",
+    "paddle_tpu.sparse.nn.functional",
+    "paddle_tpu.incubate.nn.functional",
+    "paddle_tpu.geometric",
+    "paddle_tpu.signal",
 ]
 
 
@@ -90,6 +95,12 @@ def registry(refresh: bool = False) -> Dict[str, OpRecord]:
             except (TypeError, ValueError):
                 sig = "(...)"
             key = name if name not in out else f"{mod_name.rsplit('.', 1)[-1]}.{name}"
+            if key in out:
+                # two namespaces with the same terminal segment (e.g.
+                # *.nn.functional) exporting the same op name would
+                # silently clobber an inventory entry — fail loudly
+                key = f"{mod_name}.{name}"
+                assert key not in out, f"op registry collision: {key}"
             out[key] = OpRecord(name, mod_name, sig, _doc_ref(fn) or mod_ref)
     _cache = out
     return out

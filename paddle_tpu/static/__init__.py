@@ -84,6 +84,9 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
     )
 
 
+save_inference_model._guidance_refusal = True
+
+
 def load_inference_model(path_prefix: str, executor=None, **kwargs):
     from .. import jit
 
@@ -91,6 +94,11 @@ def load_inference_model(path_prefix: str, executor=None, **kwargs):
 
 
 class _StaticStub:
+    # marks a GUIDANCE REFUSAL: the name resolves (API parity) but use
+    # raises with the working alternative. Parity accounting counts
+    # these separately from real implementations
+    # (tests/test_namespace_parity.py).
+    _guidance_refusal = True
     _msg = (
         "the Program/Executor machinery has no TPU counterpart: code under "
         "jit.to_static is traced to a jaxpr and compiled by XLA. Port "
@@ -115,6 +123,10 @@ def default_main_program():
 
 def default_startup_program():
     raise NotImplementedError(_StaticStub._msg)
+
+
+default_main_program._guidance_refusal = True
+default_startup_program._guidance_refusal = True
 
 
 # ---------------------------------------------------------------------------
@@ -522,6 +534,10 @@ def set_ipu_shard(call_func, index=-1, stage=-1):
     raise NotImplementedError("IPU sharding has no TPU counterpart")
 
 
+ipu_shard_guard._guidance_refusal = True
+set_ipu_shard._guidance_refusal = True
+
+
 def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
     """ref: static/nn/metric.py ctr_metric_bundle — use metric.Auc +
     the accuracy/auc functions above in the dygraph runtime."""
@@ -529,3 +545,6 @@ def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
         "ctr_metric_bundle is ProgramDesc-bound; compose paddle_tpu.metric."
         "Auc with static.accuracy/static.auc instead."
     )
+
+
+ctr_metric_bundle._guidance_refusal = True

@@ -734,3 +734,147 @@ class TestRegistryTailGrads:
             return out[1]  # scalar loss
 
         check_grad(scalar, rng.randn(4, 8).astype(np.float32), rtol=2e-2, atol=5e-3)
+
+
+# ---- round-5 extension: hand-written vjps + the new registry ----
+# ---- namespaces (geometric / incubate fused ops / attention)  ----
+# Priorities per the round-4 verdict: flash/paged attention backward,
+# CTC, deformable conv — the gradients most likely to be wrong because
+# a human wrote them (ref op_test.py:418 check_grad). The raw-jax
+# flash kernel is routed through tape.apply so its custom vjp is what
+# the tape differentiates. (The sparse COO math module wraps jax
+# BCOO without tape dispatch — eager grads are out of scope there;
+# sparse trainability is covered by test_sparse_nn's training runs.)
+
+_rng5 = np.random.RandomState(51)
+_QKV = _rng5.randn(1, 8, 2, 4)                    # [B, S, H, D]
+_KC_ARR = np.asarray(_rng5.randn(1, 8, 2, 4), np.float32)
+_VC_ARR = np.asarray(_rng5.randn(1, 8, 2, 4), np.float32)
+_KC = paddle.to_tensor(_KC_ARR)
+_VC = paddle.to_tensor(_VC_ARR)
+_SRC = paddle.to_tensor(np.asarray([0, 1, 2, 2, 3], np.int64))
+_DST = paddle.to_tensor(np.asarray([1, 2, 0, 3, 0], np.int64))
+_SEG = paddle.to_tensor(np.asarray([0, 0, 1, 1], np.int64))
+_EW = paddle.to_tensor(np.asarray(_rng5.rand(5, 4) + 0.2, np.float32))
+_DCW = paddle.to_tensor(np.asarray(_rng5.randn(2, 1, 2, 2) * 0.4, np.float32))
+_DCOFF = paddle.to_tensor(
+    np.asarray(_rng5.rand(1, 2 * 2 * 2, 3, 3) * 0.4 - 0.2, np.float32))
+_DCX = paddle.to_tensor(np.asarray(_rng5.randn(1, 1, 4, 4), np.float32))
+_CTC_LBL = paddle.to_tensor(np.asarray([[1, 2]], np.int64))
+_CTC_IL = paddle.to_tensor(np.asarray([6], np.int64))
+_CTC_LL = paddle.to_tensor(np.asarray([2], np.int64))
+_FF_W1 = paddle.to_tensor(np.asarray(_rng5.randn(4, 8) * 0.4, np.float32))
+_FF_W2 = paddle.to_tensor(np.asarray(_rng5.randn(8, 4) * 0.4, np.float32))
+_LIN_W = paddle.to_tensor(np.asarray(_rng5.randn(5, 3) * 0.5, np.float32))
+_MOE_GATE = paddle.to_tensor(np.asarray(_rng5.randn(1, 4, 2), np.float32))
+_MOE_W0 = paddle.to_tensor(
+    np.asarray(_rng5.randn(2, 4, 8) * 0.4, np.float32))
+_MOE_B0 = paddle.to_tensor(np.zeros((2, 1, 8), np.float32))
+_MOE_W1 = paddle.to_tensor(
+    np.asarray(_rng5.randn(2, 8, 4) * 0.4, np.float32))
+_MOE_B1 = paddle.to_tensor(np.zeros((2, 1, 4), np.float32))
+_ROPE_SIN = paddle.to_tensor(np.asarray(
+    np.sin(np.arange(8)[:, None] / (10000 ** (np.arange(0, 4, 2) / 4))
+           .repeat(2)), np.float32))
+_ROPE_COS = paddle.to_tensor(np.asarray(
+    np.cos(np.arange(8)[:, None] / (10000 ** (np.arange(0, 4, 2) / 4))
+           .repeat(2)), np.float32))
+
+
+def _sweep5():
+    import paddle_tpu.geometric as geo
+    import paddle_tpu.incubate.nn.functional as IF
+    from paddle_tpu.base.tape import apply as _apply
+    from paddle_tpu.ops.flash_attention import flash_attention as flash_raw
+
+    def flash_q(x):
+        return _apply(
+            lambda q: flash_raw(q, _KC_ARR, _VC_ARR, causal=True), x,
+            op_name="flash_q").sum()
+
+    def flash_kv(x):
+        return _apply(
+            lambda k: flash_raw(_QKV.astype(np.float32), k,
+                                k * 0.5, causal=True), x,
+            op_name="flash_kv").sum()
+
+    def paged_decode(x):
+        from paddle_tpu.ops.paged_attention import (
+            alloc_paged_kv_caches, paged_attention_step)
+
+        caches = alloc_paged_kv_caches(1, 1, 8, 2, 4, np.float32,
+                                       block_size=4)
+        q = x.reshape([1, 1, 2, 4])
+        out, _ = paged_attention_step(
+            q, q * 0.5, q * 0.25, caches[0],
+            paddle.to_tensor(np.asarray(3, np.int32)), 1)
+        return out.sum()
+
+    return [
+        # hand-written attention vjps (raw kernel via tape.apply)
+        ("flash_attention_bwd_q", flash_q, _QKV),
+        ("flash_attention_bwd_kv", flash_kv, _QKV),
+        ("sdpa_gqa", lambda x: F.scaled_dot_product_attention(
+            x.reshape([1, 8, 2, 4]), _KC[:, :, :1], _VC[:, :, :1],
+            is_causal=True, training=False).sum(), _QKV.reshape(1, 8, 2, 4)),
+        ("paged_attention_decode", paged_decode, _rng5.randn(8)),
+        # CTC (hand-written dynamic program)
+        ("ctc_loss", lambda x: F.ctc_loss(
+            F.log_softmax(x.reshape([6, 1, 4]), -1), _CTC_LBL, _CTC_IL,
+            _CTC_LL, blank=0), _rng5.randn(6, 4)),
+        # deformable conv (bilinear-sampled gather)
+        ("deform_conv2d_x", lambda x: paddle.vision.ops.deform_conv2d(
+            x.reshape([1, 1, 4, 4]), _DCOFF, _DCW).sum(),
+            _rng5.randn(4, 4)),
+        # offsets pushed AWAY from 0: integer sampling positions are
+        # bilinear kinks where central differences straddle the corner
+        ("deform_conv2d_offset", lambda x: paddle.vision.ops.deform_conv2d(
+            _DCX, x.reshape([1, 8, 3, 3]) * 0.3, _DCW).sum(),
+            np.sign(_rng5.rand(8, 9) - 0.5)
+            * (_rng5.rand(8, 9) * 0.3 + 0.1)),
+        # geometric message passing
+        ("send_u_recv_sum", lambda x: geo.send_u_recv(
+            x, _SRC, _DST, "sum").sum() * 0.5, _rng5.randn(4, 4)),
+        ("send_u_recv_mean", lambda x: geo.send_u_recv(
+            x, _SRC, _DST, "mean").sum(), _rng5.randn(4, 4)),
+        ("send_ue_recv", lambda x: geo.send_ue_recv(
+            x, _EW, _SRC, _DST, "mul", "sum").sum(), _rng5.randn(4, 4)),
+        ("send_uv", lambda x: geo.send_uv(
+            x, x * 0.5 + 1.0, _SRC, _DST, "add").sum(), _rng5.randn(4, 4)),
+        ("segment_sum", lambda x: geo.segment_sum(x, _SEG).sum() * 0.7,
+         _rng5.randn(4, 3)),
+        ("segment_mean", lambda x: geo.segment_mean(x, _SEG).sum(),
+         _rng5.randn(4, 3)),
+        ("segment_max", lambda x: geo.segment_max(x, _SEG).sum(),
+         (_rng5.permutation(12).astype(np.float64) * 0.5).reshape(4, 3)),
+        # incubate fused ops
+        ("fused_linear_activation", lambda x: IF.fused_linear_activation(
+            x, _LIN_W, paddle.to_tensor(np.zeros(3, np.float32)),
+            activation="gelu").sum(), _rng5.randn(4, 5)),
+        ("fused_feedforward", lambda x: IF.fused_feedforward(
+            x, _FF_W1, _FF_W2, dropout1_rate=0.0, dropout2_rate=0.0,
+            training=False).sum(), _rng5.randn(2, 3, 4)),
+        ("fused_rotary_position_embedding",
+         lambda x: IF.fused_rotary_position_embedding(
+             x.reshape([1, 8, 2, 4]), None, None,
+             sin=_ROPE_SIN, cos=_ROPE_COS)[0].sum(), _QKV),
+        ("fused_ec_moe", lambda x: IF.fused_ec_moe(
+            x.reshape([1, 4, 4]), _MOE_GATE, _MOE_W0, _MOE_B0, _MOE_W1,
+            _MOE_B1, "gelu").sum(), _rng5.randn(4, 4)),
+    ]
+
+
+_SWEEP5 = _sweep5()
+# FD noise amplifiers: attention softmax chains and bilinear corners
+_LOOSE5 = {"flash_attention_bwd_q": (3e-2, 3e-3),
+           "flash_attention_bwd_kv": (3e-2, 3e-3),
+           "paged_attention_decode": (3e-2, 3e-3),
+           "deform_conv2d_offset": (3e-2, 3e-3),
+           "ctc_loss": (3e-2, 3e-3)}
+
+
+@pytest.mark.parametrize("name,op,data", _SWEEP5,
+                         ids=[s[0] for s in _SWEEP5])
+def test_numeric_grad_round5(name, op, data):
+    rtol, atol = _LOOSE5.get(name, (1e-2, 1e-3))
+    check_grad(op, np.asarray(data, np.float64), rtol=rtol, atol=atol)

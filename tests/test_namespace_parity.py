@@ -50,11 +50,25 @@ def _ref_all(path):
 
 @pytest.mark.parametrize("ns,path", sorted(_MODS.items()))
 def test_namespace_complete(ns, path):
+    """Every reference __all__ name must resolve. Names that resolve to
+    a GUIDANCE REFUSAL (resolves, but use raises NotImplementedError
+    naming the working alternative — marked ``_guidance_refusal``) are
+    counted separately so the parity number doesn't overstate: they are
+    honest API-surface placeholders, not implementations."""
     mod = paddle
     for part in ns.split("."):
         mod = getattr(mod, part)
-    missing = [n for n in _ref_all(path) if not hasattr(mod, n)]
+    missing, refusals = [], []
+    for n in _ref_all(path):
+        obj = getattr(mod, n, None)
+        if obj is None and not hasattr(mod, n):
+            missing.append(n)
+        elif getattr(obj, "_guidance_refusal", False):
+            refusals.append(n)
     assert not missing, f"{ns} missing {missing}"
+    if refusals:
+        print(f"[parity] {ns}: {len(refusals)} guidance refusal(s) "
+              f"(resolve-but-raise, not implementations): {refusals}")
 
 
 class TestCTC:
