@@ -265,6 +265,11 @@ def main():
         # bf16 moment STORAGE (f32 update math, f32 masters): the AdamW
         # pass is HBM-bound; halving its moment traffic buys ~5 ms/step
         moment_dtype="bfloat16" if on_tpu else None,
+        # BENCH_INTERLEAVE=1: apply each layer's AdamW update at its
+        # grad-finalization point inside backward instead of a serial
+        # tail — the >0.79-MFU experiment (BASELINE.md decomposition:
+        # ~13-19 ms of the step is optimizer HBM traffic after backward)
+        interleave_updates=os.environ.get("BENCH_INTERLEAVE", "0") == "1",
     )
 
     def step(ids, labels):

@@ -132,6 +132,11 @@ class AmpScaler:
     def step(self, optimizer):
         """Unscale (if needed) then step, skipping the update when inf/nan
         grads were found (ref: grad_scaler.py step)."""
+        if getattr(optimizer, "_interleave", False):
+            raise ValueError(
+                "GradScaler cannot drive an interleave_updates "
+                "optimizer: updates fire during backward with SCALED "
+                "grads, before unscale_/inf-skip can run")
         if not self._enable:
             optimizer.step()
             return

@@ -100,7 +100,8 @@ def registry(refresh: bool = False) -> Dict[str, OpRecord]:
                 # *.nn.functional) exporting the same op name would
                 # silently clobber an inventory entry — fail loudly
                 key = f"{mod_name}.{name}"
-                assert key not in out, f"op registry collision: {key}"
+                if key in out:  # not an assert: must survive python -O
+                    raise RuntimeError(f"op registry collision: {key}")
             out[key] = OpRecord(name, mod_name, sig, _doc_ref(fn) or mod_ref)
     _cache = out
     return out

@@ -122,6 +122,17 @@ class Optimizer:
         # rounding (subclasses expose use_stochastic_rounding=True)
         self._stochastic_rounding = False
         self._global_step = 0
+        # interleaved updates (subclasses expose interleave_updates=True):
+        # the tape applies each param's update the moment its gradient
+        # finalizes during backward — see _enable_interleaving
+        self._interleave = False
+        self._interleave_applied = set()  # params updated this cycle
+        # a NEW optimizer over these params takes ownership: strip any
+        # previous interleaving optimizer's hooks or the abandoned one
+        # would keep training the model on every backward
+        from ..base import tape as _tape
+
+        _tape.unregister_interleaved_params(self._parameter_list)
 
     # ------------------------------------------------------------------
     def _normalize_params(self, parameters):
@@ -204,11 +215,71 @@ class Optimizer:
         return store[param.name]
 
     # ------------------------------------------------------------------
+    # interleaved updates
+    # ------------------------------------------------------------------
+    def _enable_interleaving(self):
+        """Register every parameter for update-at-grad-finalization
+        (tape.register_interleaved_param). The update math is identical
+        to step(); only its POSITION in the traced program moves — each
+        param's HBM-bound update lands right after its backward layer,
+        where the scheduler can hide it under the remaining MXU-bound
+        grads instead of a serial tail (round-4 verdict Next #4; the
+        reference's answer is a fused kernel,
+        ref: paddle/phi/kernels/gpu/adamw_kernel.cu).
+
+        Scope: single param group, no grad clip and no optimizer-level
+        regularization (both need ALL grads before any update) — step()
+        still runs afterwards for the global-step counter and any param
+        whose grad never finalized."""
+        if len(self._param_groups) != 1:
+            raise ValueError(
+                "interleave_updates supports a single param group")
+        group = self._param_groups[0]
+        if (self._grad_clip is not None or self.regularization is not None
+                or group.get("grad_clip") is not None
+                or group.get("weight_decay") is not None):
+            raise ValueError(
+                "interleave_updates is incompatible with grad_clip/"
+                "weight_decay regularizers (they need all grads before "
+                "any update); use the optimizer's decoupled decay")
+        from ..base import tape as _tape
+
+        self._interleave = True
+        for p in self._param_groups[0]["params"]:
+            _tape.register_interleaved_param(p, self)
+
+    @no_grad()
+    def _interleave_apply(self, p):
+        g = p.grad
+        if g is None or p.stop_gradient:
+            return
+        if id(p) in self._interleave_applied:
+            raise RuntimeError(
+                "interleave_updates: a second backward() reached "
+                f"parameter {p.name!r} before step() — gradient "
+                "accumulation (multiple backwards per step) is "
+                "incompatible with interleaved updates; disable "
+                "interleave_updates for accumulation loops")
+        self._interleave_applied.add(id(p))
+        garr = g._data if isinstance(g, Tensor) else g
+        if self._grad_placement_fn is not None:
+            garr = self._grad_placement_fn(garr)
+        group = self._param_groups[0]
+        lr_scale = (p.optimize_attr.get("learning_rate", 1.0)
+                    if getattr(p, "optimize_attr", None) else 1.0)
+        self._update_param(
+            p, garr, lr_scale * float(group.get("learning_rate", 1.0)),
+            group)
+        # grad consumed: step() skips this param (grad is None there)
+        p.clear_grad()
+
+    # ------------------------------------------------------------------
     # step
     # ------------------------------------------------------------------
     @no_grad()
     def step(self):
         self._global_step += 1
+        self._interleave_applied.clear()
         for group in self._param_groups:
             params_grads = [
                 (p, p.grad) for p in group["params"] if not p.stop_gradient and p.grad is not None
@@ -441,13 +512,15 @@ class AdamW(_AdamBase):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
                  weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None, moment_dtype=None,
-                 use_stochastic_rounding=False):
+                 use_stochastic_rounding=False, interleave_updates=False):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, lazy_mode, multi_precision, name,
                          moment_dtype=moment_dtype,
                          use_stochastic_rounding=use_stochastic_rounding)
         self._coeff = float(weight_decay) if not callable(weight_decay) else weight_decay
         self._lr_ratio = lr_ratio
         self._apply_decay_param_fun = apply_decay_param_fun
+        if interleave_updates:
+            self._enable_interleaving()
 
     def _update_param(self, p, g, lr_scale, group):
         lr = self._lr() * lr_scale
