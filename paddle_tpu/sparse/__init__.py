@@ -88,6 +88,13 @@ class SparseCooTensor:
 
             return _apply(scatter, self._values_tensor,
                           op_name="sparse_to_dense")
+        if self._bcoo.data.dtype == jnp.bool_:
+            # jax BCOO todense scatter-adds, which rejects bool (isnan
+            # & friends): densify via int8 and cast back
+            b8 = jsparse.BCOO(
+                (self._bcoo.data.astype(jnp.int8), self._bcoo.indices),
+                shape=self._bcoo.shape)
+            return Tensor(b8.todense().astype(jnp.bool_), _internal=True)
         return Tensor(self._bcoo.todense(), _internal=True)
 
     def to_sparse_csr(self) -> "SparseCsrTensor":
@@ -97,7 +104,25 @@ class SparseCooTensor:
         return _dense_to_csr(dense)
 
     def coalesce(self) -> "SparseCooTensor":
-        return SparseCooTensor(self._bcoo.sum_duplicates())
+        if self._values_tensor is None:
+            return SparseCooTensor(self._bcoo.sum_duplicates())
+        # keep the gradient path: group duplicate indices host-side and
+        # scatter-add the LIVE values through the tape
+        from ..base.tape import apply as _apply
+
+        idx_np = np.asarray(jax.device_get(self._bcoo.indices))
+        uniq, inv = np.unique(idx_np, axis=0, return_inverse=True)
+        inv = jnp.asarray(inv.reshape(-1))
+        n = uniq.shape[0]
+
+        def f(v):
+            return jnp.zeros((n,) + v.shape[1:], v.dtype).at[inv].add(v)
+
+        nv = _apply(f, self._values_tensor, op_name="sparse_coalesce")
+        return SparseCooTensor(
+            jsparse.BCOO((nv._data, jnp.asarray(uniq, jnp.int32)),
+                         shape=self._bcoo.shape),
+            values_tensor=nv)
 
     def is_sparse_coo(self):
         return True
@@ -181,14 +206,21 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
                       stop_gradient=True):
     """ref: creation.py:54 — indices [ndim, nnz], values [nnz]."""
     idx = jnp.asarray(_unwrap(indices), jnp.int32)
+    # the reference's default is stop_gradient=True: grads flow back to
+    # the values only when the caller opts in (ref creation.py:54)
+    vt = values if isinstance(values, Tensor) and not stop_gradient else None
     vals = _unwrap(values)
     if dtype is not None:
         from ..base.dtype import canonical_dtype
 
         vals = vals.astype(canonical_dtype(dtype))
+        vt = None  # cast broke the identity; fall back to raw values
     if shape is None:
         shape = tuple(int(m) + 1 for m in np.asarray(jax.device_get(idx)).max(1))
-    return SparseCooTensor(jsparse.BCOO((vals, idx.T), shape=tuple(shape)))
+    # keep the LIVE tape Tensor so grads flow back through values()/
+    # to_dense()/matmul/_unary (same contract sparse.nn relies on)
+    return SparseCooTensor(jsparse.BCOO((vals, idx.T), shape=tuple(shape)),
+                           values_tensor=vt)
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
@@ -219,11 +251,32 @@ def _coo(x):
     raise TypeError(f"expected a sparse tensor, got {type(x)}")
 
 
+def _spmm(b, x, y, op_name):
+    """Differentiable sparse@dense core shared by matmul/mv/addmm:
+    routes through tape.apply when either the COO tensor carries its
+    live values Tensor or the dense operand is a live Tensor."""
+    vt = getattr(x, "_values_tensor", None)
+    if vt is None and not isinstance(y, Tensor):
+        return Tensor(b @ _unwrap(y), _internal=True)
+    from ..base.tape import apply as _apply
+
+    indices, shape = b.indices, b.shape
+
+    def f(v, yd):
+        return jsparse.BCOO((v, indices), shape=shape) @ yd
+
+    return _apply(
+        f, vt if vt is not None else Tensor(b.data, _internal=True),
+        y if isinstance(y, Tensor) else Tensor(_unwrap(y), _internal=True),
+        op_name=op_name)
+
+
 def matmul(x, y, name=None):
-    """sparse @ dense → dense (ref: sparse/matmul.py)."""
+    """sparse @ dense → dense (ref: sparse/matmul.py). Differentiable
+    w.r.t. BOTH the sparse values (when the COO tensor carries its live
+    values Tensor) and the dense operand."""
     b, _ = _coo(x)
-    yd = _unwrap(y)
-    return Tensor(b @ yd, _internal=True)
+    return _spmm(b, x, y, "sparse_matmul")
 
 
 def add(x, y, name=None):
@@ -262,6 +315,20 @@ def _unary(fn):
                 x.crows_arr, x.cols_arr, fn(x.values_arr), x._shape
             )
         b, _ = _coo(x)
+        vt = getattr(x, "_values_tensor", None)
+        if vt is not None:
+            new_vals = fn(b.data)
+            if jnp.issubdtype(new_vals.dtype, jnp.inexact):
+                from ..base.tape import apply as _apply
+
+                new_vt = _apply(fn, vt, op_name="sparse_unary")
+                return SparseCooTensor(
+                    jsparse.BCOO((new_vt._data, b.indices), shape=b.shape),
+                    values_tensor=new_vt)
+            # bool/int results (isnan, ...) have no gradient path and
+            # to_dense's scatter-add rejects them — drop the link
+            return SparseCooTensor(
+                jsparse.BCOO((new_vals, b.indices), shape=b.shape))
         return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
 
     return op
@@ -341,16 +408,20 @@ def divide(x, y, name=None):
 
 
 def mv(x, vec, name=None):
-    """sparse [M,N] @ dense [N] -> dense [M] (ref sparse/matmul.py mv)."""
+    """sparse [M,N] @ dense [N] -> dense [M] (ref sparse/matmul.py mv);
+    same autograd contract as matmul."""
     b, _ = _coo(x)
-    return Tensor(b @ _unwrap(vec), _internal=True)
+    return _spmm(b, x, vec, "sparse_mv")
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
-    """beta*input + alpha*(x@y) (ref sparse/matmul.py addmm)."""
+    """beta*input + alpha*(x@y) (ref sparse/matmul.py addmm); same
+    autograd contract as matmul."""
     b, _ = _coo(x)
-    yd = _unwrap(y)
-    return Tensor(beta * _unwrap(input) + alpha * (b @ yd), _internal=True)
+    prod = _spmm(b, x, y, "sparse_addmm")
+    inp = input if isinstance(input, Tensor) else Tensor(
+        _unwrap(input), _internal=True)
+    return inp * beta + prod * alpha
 
 
 def masked_matmul(x, y, mask, name=None):

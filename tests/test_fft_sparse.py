@@ -105,3 +105,68 @@ class TestSparse:
         s = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 2.0], [2, 2])
         c = s.coalesce()
         assert c.to_dense().numpy()[0, 1] == 3.0
+
+
+class TestSparseAutograd:
+    """r5: sparse COO carries its live values Tensor (opt-in via
+    stop_gradient=False, the reference's creation.py contract) so
+    creation -> matmul/mv/addmm/unary/coalesce -> to_dense all
+    differentiate through the tape."""
+
+    def _vals(self):
+        from paddle_tpu.base.tensor import Tensor
+
+        return Tensor(np.array([1.0, -2.0, 3.0], np.float32),
+                      stop_gradient=False, _internal=True)
+
+    def _idx(self):
+        return paddle.to_tensor(np.asarray([[0, 0, 1], [0, 2, 1]], np.int64))
+
+    def test_default_stop_gradient_blocks(self):
+        v = self._vals()
+        st = sparse.sparse_coo_tensor(self._idx(), v, [2, 3])
+        sparse.matmul(st, paddle.to_tensor(
+            np.ones((3, 2), np.float32))).sum().backward()
+        assert v.grad is None
+
+    def test_matmul_mv_addmm_grads(self):
+        for op, want in (
+            (lambda st: sparse.matmul(st, paddle.to_tensor(
+                np.ones((3, 2), np.float32))), [2.0, 2.0, 2.0]),
+            (lambda st: sparse.mv(st, paddle.to_tensor(
+                np.ones(3, np.float32))), [1.0, 1.0, 1.0]),
+            (lambda st: sparse.addmm(
+                paddle.to_tensor(np.zeros((2, 2), np.float32)), st,
+                paddle.to_tensor(np.ones((3, 2), np.float32)),
+                alpha=2.0), [4.0, 4.0, 4.0]),
+        ):
+            v = self._vals()
+            st = sparse.sparse_coo_tensor(self._idx(), v, [2, 3],
+                                          stop_gradient=False)
+            op(st).sum().backward()
+            np.testing.assert_allclose(v.grad.numpy(), want)
+
+    def test_unary_and_coalesce_grads(self):
+        v = self._vals()
+        st = sparse.sparse_coo_tensor(self._idx(), v, [2, 3],
+                                      stop_gradient=False)
+        sparse.relu(st).to_dense().sum().backward()
+        np.testing.assert_allclose(v.grad.numpy(), [1.0, 0.0, 1.0])
+        v.clear_grad()
+
+        dup = paddle.to_tensor(np.asarray([[0, 0, 0], [1, 1, 2]], np.int64))
+        sd = sparse.sparse_coo_tensor(dup, v, [2, 3], stop_gradient=False)
+        sc = sd.coalesce()
+        assert sc.nnz == 2  # duplicates merged
+        sc.to_dense().sum().backward()
+        np.testing.assert_allclose(v.grad.numpy(), [1.0, 1.0, 1.0])
+
+    def test_bool_unary_densifies(self):
+        from paddle_tpu.base.tensor import Tensor
+
+        v = Tensor(np.array([1.0, np.nan, 3.0], np.float32),
+                   stop_gradient=False, _internal=True)
+        st = sparse.sparse_coo_tensor(self._idx(), v, [2, 3],
+                                      stop_gradient=False)
+        d = sparse.isnan(st).to_dense().numpy()
+        assert d.dtype == np.bool_ and d.sum() == 1

@@ -776,6 +776,7 @@ _MOE_B1 = paddle.to_tensor(np.zeros((2, 1, 4), np.float32))
 _ROPE_SIN = paddle.to_tensor(np.asarray(
     np.sin(np.arange(8)[:, None] / (10000 ** (np.arange(0, 4, 2) / 4))
            .repeat(2)), np.float32))
+_SPMM_Y = paddle.to_tensor(np.asarray(_rng5.randn(3, 2), np.float32))
 _ROPE_COS = paddle.to_tensor(np.asarray(
     np.cos(np.arange(8)[:, None] / (10000 ** (np.arange(0, 4, 2) / 4))
            .repeat(2)), np.float32))
@@ -832,6 +833,20 @@ def _sweep5():
             _DCX, x.reshape([1, 8, 3, 3]) * 0.3, _DCW).sum(),
             np.sign(_rng5.rand(8, 9) - 0.5)
             * (_rng5.rand(8, 9) * 0.3 + 0.1)),
+        # sparse COO (live values Tensor threads the tape: creation ->
+        # matmul/unary -> to_dense are all differentiable, r5)
+        ("sparse_coo_matmul", lambda x: paddle.sparse.matmul(
+            paddle.sparse.sparse_coo_tensor(
+                paddle.to_tensor(np.asarray([[0, 0, 1], [0, 2, 1]],
+                                            np.int64)),
+                x, [2, 3], stop_gradient=False), _SPMM_Y).sum(),
+         _rng5.randn(3)),
+        ("sparse_relu_values", lambda x: paddle.sparse.nn.functional.relu(
+            paddle.sparse.sparse_coo_tensor(
+                paddle.to_tensor(np.asarray([[0, 1, 1], [1, 0, 2]],
+                                            np.int64)),
+                x, [2, 3], stop_gradient=False)).to_dense().sum(),
+         np.sign(_rng5.randn(3)) * (np.abs(_rng5.randn(3)) + 0.3)),
         # geometric message passing
         ("send_u_recv_sum", lambda x: geo.send_u_recv(
             x, _SRC, _DST, "sum").sum() * 0.5, _rng5.randn(4, 4)),
