@@ -208,13 +208,21 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
     idx = jnp.asarray(_unwrap(indices), jnp.int32)
     # the reference's default is stop_gradient=True: grads flow back to
     # the values only when the caller opts in (ref creation.py:54)
-    vt = values if isinstance(values, Tensor) and not stop_gradient else None
+    vt = (values if isinstance(values, Tensor) and not stop_gradient
+          and jnp.issubdtype(_unwrap(values).dtype, jnp.inexact) else None)
     vals = _unwrap(values)
     if dtype is not None:
         from ..base.dtype import canonical_dtype
 
-        vals = vals.astype(canonical_dtype(dtype))
-        vt = None  # cast broke the identity; fall back to raw values
+        dt = canonical_dtype(dtype)
+        if vt is not None and jnp.issubdtype(dt, jnp.inexact):
+            from ..base.tape import apply as _apply
+
+            vt = _apply(lambda v: v.astype(dt), vt, op_name="cast")
+            vals = vt._data
+        else:
+            vals = vals.astype(dt)
+            vt = None  # non-differentiable cast
     if shape is None:
         shape = tuple(int(m) + 1 for m in np.asarray(jax.device_get(idx)).max(1))
     # keep the LIVE tape Tensor so grads flow back through values()/
@@ -317,8 +325,8 @@ def _unary(fn):
         b, _ = _coo(x)
         vt = getattr(x, "_values_tensor", None)
         if vt is not None:
-            new_vals = fn(b.data)
-            if jnp.issubdtype(new_vals.dtype, jnp.inexact):
+            out_dtype = jax.eval_shape(fn, b.data).dtype  # zero FLOPs
+            if jnp.issubdtype(out_dtype, jnp.inexact):
                 from ..base.tape import apply as _apply
 
                 new_vt = _apply(fn, vt, op_name="sparse_unary")
@@ -327,8 +335,6 @@ def _unary(fn):
                     values_tensor=new_vt)
             # bool/int results (isnan, ...) have no gradient path and
             # to_dense's scatter-add rejects them — drop the link
-            return SparseCooTensor(
-                jsparse.BCOO((new_vals, b.indices), shape=b.shape))
         return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
 
     return op
@@ -437,6 +443,19 @@ def masked_matmul(x, y, mask, name=None):
         return _dense_to_csr(dense)
     b, _ = _coo(mask)
     rows, cols = b.indices[:, 0], b.indices[:, 1]
+    if isinstance(x, Tensor) or isinstance(y, Tensor):
+        # SDDMM differentiable w.r.t. both dense operands: the values
+        # ride the tape, so downstream to_dense/matmul keep the path
+        from ..base.tape import apply as _apply
+
+        nv = _apply(
+            lambda a, c: jnp.einsum("nk,nk->n", a[rows, :], c[:, cols].T),
+            x if isinstance(x, Tensor) else Tensor(xd, _internal=True),
+            y if isinstance(y, Tensor) else Tensor(yd, _internal=True),
+            op_name="sparse_masked_matmul")
+        return SparseCooTensor(
+            jsparse.BCOO((nv._data, b.indices), shape=tuple(mask.shape)),
+            values_tensor=nv)
     vals = jnp.einsum("nk,nk->n", xd[rows, :], yd[:, cols].T)
     return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=tuple(mask.shape)))
 
@@ -475,7 +494,14 @@ def reshape(x, shape, name=None):
 
 
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
-    """Reduce over axis; returns sparse like the reference."""
+    """Reduce over axis; returns sparse like the reference. A COO
+    input carrying its live values Tensor keeps the gradient path for
+    the full (axis=None) reduction — the sum of all nonzeros."""
+    vt = getattr(x, "_values_tensor", None)
+    if vt is not None and axis is None and dtype is None and not keepdim:
+        # scalar (axis=None) reduction stays dense like the reference's
+        # 0-d result; keepdim falls through to the structural path
+        return vt.sum()
     b, kind = _coo(x)
     dense = b.todense().sum(axis=axis, keepdims=keepdim)
     if dtype is not None:

@@ -893,3 +893,116 @@ _LOOSE5 = {"flash_attention_bwd_q": (3e-2, 3e-3),
 def test_numeric_grad_round5(name, op, data):
     rtol, atol = _LOOSE5.get(name, (1e-2, 1e-3))
     check_grad(op, np.asarray(data, np.float64), rtol=rtol, atol=atol)
+
+
+# ---- round-5b: the differentiable registry tail (linalg ----
+# ---- decompositions, signal, fused norms, misc)         ----
+
+_rng5b = np.random.RandomState(61)
+_SQ33 = _rng5b.randn(3, 3)  # keep: deleting reshuffles later draws
+_SIG = _rng5b.randn(64)
+_FRM = _rng5b.randn(6, 16)  # keep: deleting reshuffles later draws
+_RMS_W = paddle.to_tensor(np.abs(_rng5b.randn(6)).astype(np.float32) + 0.5)
+_LN_W = paddle.to_tensor(np.abs(_rng5b.randn(6)).astype(np.float32) + 0.5)
+_LN_B = paddle.to_tensor(_rng5b.randn(6).astype(np.float32))
+_MMB_Y = paddle.to_tensor(_rng5b.randn(5, 3).astype(np.float32) * 0.5)
+_MMB_B = paddle.to_tensor(_rng5b.randn(3).astype(np.float32))
+_EMB_IDX = paddle.to_tensor(np.asarray([0, 2, 1, 2], np.int64))
+_LSQ_Y = paddle.to_tensor(_rng5b.randn(4, 2).astype(np.float32))
+_SEG5 = paddle.to_tensor(np.asarray([0, 0, 1, 1], np.int64))
+_MSK_X = paddle.to_tensor(_rng5b.randn(3, 4).astype(np.float32))
+
+
+def _sweep5b():
+    import paddle_tpu.geometric as geo
+    import paddle_tpu.incubate.nn.functional as IF
+    import paddle_tpu.signal as S
+
+    def spd(x):
+        m = x.reshape([3, 3])
+        return m @ m.T * 0.1 + paddle.to_tensor(
+            (4.0 * np.eye(3)).astype(np.float32))
+
+    return [
+        # linalg decompositions (vjps via jax rules — still worth
+        # pinning: they're the remaining differentiable linalg tail)
+        ("qr_q", lambda x: paddle.linalg.qr(
+            x.reshape([4, 3]) + paddle.to_tensor(
+                (2.0 * np.eye(4, 3)).astype(np.float32)))[0].sum(),
+         _rng5b.randn(4, 3) * 0.3),
+        ("svd_singulars", lambda x: paddle.linalg.svd(
+            x.reshape([3, 3]) + paddle.to_tensor(
+                np.diag([3.0, 2.0, 1.0]).astype(np.float32)))[1].sum(),
+         _rng5b.randn(3, 3) * 0.2),
+        ("eigh_vals", lambda x: paddle.linalg.eigh(spd(x))[0].sum(),
+         _rng5b.randn(3, 3)),
+        ("lstsq_sol", lambda x: paddle.linalg.lstsq(
+            x.reshape([4, 3]) + paddle.to_tensor(
+                (2.0 * np.eye(4, 3)).astype(np.float32)), _LSQ_Y)[0].sum(),
+         _rng5b.randn(4, 3) * 0.3),
+        ("lu_packed", lambda x: paddle.linalg.lu(spd(x))[0].sum(),
+         _rng5b.randn(3, 3)),
+        # signal chain
+        ("stft_mag", lambda x: (S.stft(x, n_fft=16, hop_length=8,
+                                       center=False).abs() ** 2).sum(),
+         _SIG),
+        ("frame", lambda x: (S.frame(x, 16, 8) * 0.5).sum(), _SIG),
+        ("overlap_add", lambda x: S.overlap_add(
+            x.reshape([16, 6]), hop_length=8).sum() * 0.5,
+         _rng5b.randn(16, 6)),
+        ("istft_roundtrip", lambda x: S.istft(
+            S.stft(x, n_fft=16, hop_length=8), n_fft=16,
+            hop_length=8).sum(), _SIG),
+        # fused layers (XLA-fused epilogues)
+        ("swiglu", lambda x: IF.swiglu(x, x * 0.5 + 1.0).sum(),
+         _rng5b.randn(4, 6)),
+        ("fused_rms_norm", lambda x: IF.fused_rms_norm(
+            x, _RMS_W).sum(), _rng5b.randn(4, 6)),
+        ("fused_layer_norm", lambda x: IF.fused_layer_norm(
+            x, _LN_W, _LN_B, 1e-5).sum(), _rng5b.randn(4, 6)),
+        ("fused_matmul_bias", lambda x: IF.fused_matmul_bias(
+            x, _MMB_Y, _MMB_B).sum(), _rng5b.randn(4, 5)),
+        ("fused_dropout_add_eval", lambda x: IF.fused_dropout_add(
+            x, x * 0.25, p=0.5, training=False).sum(),
+         _rng5b.randn(4, 5)),
+        ("fused_bias_dropout_residual_ln", lambda x:
+         IF.fused_bias_dropout_residual_layer_norm(
+             x, x * 0.5, dropout_rate=0.0).sum(), _rng5b.randn(4, 6)),
+        # misc tail
+        ("embedding_weight_grad", lambda x: F.embedding(
+            _EMB_IDX, x).sum() * 0.5, _rng5b.randn(3, 4)),
+        ("segment_min", lambda x: __import__(
+            "paddle_tpu.geometric", fromlist=["x"]).segment_min(
+            x, _SEG5).sum(),
+         (_rng5b.permutation(12).astype(np.float64) * 0.5).reshape(4, 3)),
+        ("nanquantile", lambda x: paddle.nanquantile(
+            x, 0.5).sum(),
+         (_rng5b.permutation(16).astype(np.float64) * 0.3).reshape(4, 4)),
+        ("sparse_masked_matmul", lambda x: paddle.sparse.masked_matmul(
+            x.reshape([3, 4]), _MSK_X.t(),
+            paddle.sparse.sparse_coo_tensor(
+                paddle.to_tensor(np.asarray([[0, 1, 2], [0, 2, 1]],
+                                            np.int64)),
+                paddle.to_tensor(np.ones(3, np.float32)),
+                [3, 3])).to_dense().sum(), _rng5b.randn(3, 4)),
+        ("sparse_sum_values", lambda x: paddle.sparse.sum(
+            paddle.sparse.sparse_coo_tensor(
+                paddle.to_tensor(np.asarray([[0, 0, 1], [0, 2, 1]],
+                                            np.int64)),
+                x, [2, 3], stop_gradient=False)).sum(), _rng5b.randn(3)),
+    ]
+
+
+_SWEEP5B = _sweep5b()
+_LOOSE5B = {"qr_q": (3e-2, 3e-3), "svd_singulars": (3e-2, 3e-3),
+            "eigh_vals": (3e-2, 3e-3), "lstsq_sol": (3e-2, 3e-3),
+            "lu_packed": (3e-2, 3e-3),
+            "istft_roundtrip": (3e-2, 3e-3),
+            "stft_mag": (3e-2, 2e-1)}
+
+
+@pytest.mark.parametrize("name,op,data", _SWEEP5B,
+                         ids=[s[0] for s in _SWEEP5B])
+def test_numeric_grad_round5b(name, op, data):
+    rtol, atol = _LOOSE5B.get(name, (1e-2, 1e-3))
+    check_grad(op, np.asarray(data, np.float64), rtol=rtol, atol=atol)
