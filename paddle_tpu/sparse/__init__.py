@@ -435,10 +435,15 @@ def masked_matmul(x, y, mask, name=None):
     masked_matmul; the cuSPARSE SDDMM analogue). Computes per-nonzero
     row·col dot products — never materializes the dense product."""
     xd, yd = _unwrap(x), _unwrap(y)
+
+    def _sddmm(a, c, rows, cols):
+        # per-nonzero row-col dot products (the cuSPARSE SDDMM shape)
+        return jnp.einsum("nk,nk->n", a[rows, :], c[:, cols].T)
+
     if isinstance(mask, SparseCsrTensor):
         b = mask._to_bcoo()
         rows, cols = b.indices[:, 0], b.indices[:, 1]
-        vals = jnp.einsum("nk,nk->n", xd[rows, :], yd[:, cols].T)
+        vals = _sddmm(xd, yd, rows, cols)
         dense = jnp.zeros(mask.shape, vals.dtype).at[rows, cols].set(vals)
         return _dense_to_csr(dense)
     b, _ = _coo(mask)
@@ -449,14 +454,14 @@ def masked_matmul(x, y, mask, name=None):
         from ..base.tape import apply as _apply
 
         nv = _apply(
-            lambda a, c: jnp.einsum("nk,nk->n", a[rows, :], c[:, cols].T),
+            lambda a, c: _sddmm(a, c, rows, cols),
             x if isinstance(x, Tensor) else Tensor(xd, _internal=True),
             y if isinstance(y, Tensor) else Tensor(yd, _internal=True),
             op_name="sparse_masked_matmul")
         return SparseCooTensor(
             jsparse.BCOO((nv._data, b.indices), shape=tuple(mask.shape)),
             values_tensor=nv)
-    vals = jnp.einsum("nk,nk->n", xd[rows, :], yd[:, cols].T)
+    vals = _sddmm(xd, yd, rows, cols)
     return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=tuple(mask.shape)))
 
 
@@ -498,10 +503,28 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
     input carrying its live values Tensor keeps the gradient path for
     the full (axis=None) reduction — the sum of all nonzeros."""
     vt = getattr(x, "_values_tensor", None)
-    if vt is not None and axis is None and dtype is None and not keepdim:
-        # scalar (axis=None) reduction stays dense like the reference's
-        # 0-d result; keepdim falls through to the structural path
-        return vt.sum()
+    if vt is not None and axis is None:
+        # full reduction over live values: the gradient path survives
+        # every variant (keepdim wraps the scalar back into a 1-element
+        # COO with tape-linked values; dtype casts ride the tape)
+        out = vt.sum()
+        if dtype is not None:
+            from ..base.dtype import canonical_dtype
+            from ..base.tape import apply as _apply
+
+            dt = canonical_dtype(dtype)
+            out = _apply(lambda v: v.astype(dt), out, op_name="cast")
+        if not keepdim:
+            return out
+        from ..tensor.manipulation import reshape as _reshape
+
+        ndim = len(x.shape)
+        nv = _reshape(out, [1])
+        return SparseCooTensor(
+            jsparse.BCOO(
+                (nv._data, jnp.zeros((1, ndim), jnp.int32)),
+                shape=(1,) * ndim),
+            values_tensor=nv)
     b, kind = _coo(x)
     dense = b.todense().sum(axis=axis, keepdims=keepdim)
     if dtype is not None:

@@ -1,0 +1,106 @@
+"""Elastic kill-and-relaunch worker (2 real trainer processes).
+
+Run via paddle_tpu.distributed.launch. Trains a DP model over the
+2-process global mesh with periodic rank-0 checkpoints. On the FIRST
+incarnation, rank 1 dies mid-training (simulated hardware failure);
+JAX's coordination service then takes down rank 0 as well — the
+elastic contract on a real pod: the agent relaunches the whole job and
+training resumes from the last checkpoint (ref: the reference's
+elastic manager + fleet checkpoint resume,
+python/paddle/distributed/fleet/elastic/manager.py).
+
+env:
+  ELASTIC_DIR        — scratch dir (checkpoints + incarnation marker)
+  ELASTIC_KILL_STEP  — step at which rank 1 dies in incarnation 1
+  ELASTIC_TOTAL      — total steps to train
+"""
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+import paddle_tpu.optimizer as popt  # noqa: E402
+from paddle_tpu.base.tensor import Tensor  # noqa: E402
+
+
+def main():
+    scratch = os.environ["ELASTIC_DIR"]
+    kill_step = int(os.environ.get("ELASTIC_KILL_STEP", "-1"))
+    total = int(os.environ["ELASTIC_TOTAL"])
+    ckpt = os.path.join(scratch, "ckpt.pdparams")
+    opt_ckpt = os.path.join(scratch, "ckpt.pdopt")
+    meta = os.path.join(scratch, "ckpt.step")
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = popt.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    repl = NamedSharding(mesh, P())
+    start = 0
+    if os.path.exists(meta):  # resume from the last checkpoint
+        start = int(open(meta).read())
+        model.set_state_dict(paddle.load(ckpt))
+        opt.set_state_dict(paddle.load(opt_ckpt))
+        print(f"rank {rank}: resumed at step {start}", flush=True)
+    for p in model.parameters():
+        p._data = jax.device_put(np.asarray(p._data), repl)
+
+    def step_fn(x, y):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = paddle.jit.to_static(step_fn, layers=[model],
+                                    optimizers=[opt])
+    data_sh = NamedSharding(mesh, P("dp"))
+
+    rng = np.random.RandomState(7)
+    loss = None
+    for i in range(total):
+        x_np = rng.randn(8, 8).astype(np.float32)
+        y_np = rng.randint(0, 4, (8,)).astype(np.int64)
+        if i < start:
+            continue  # deterministic data schedule: replay the stream
+        gx = jax.make_array_from_process_local_data(
+            data_sh, x_np[rank * 4:(rank + 1) * 4], (8, 8))
+        gy = jax.make_array_from_process_local_data(
+            data_sh, y_np[rank * 4:(rank + 1) * 4], (8,))
+        loss = float(np.asarray(compiled(
+            Tensor(gx, _internal=True), Tensor(gy, _internal=True))._data))
+
+        done = i + 1
+        if rank == 0 and done % 4 == 0:
+            paddle.save(model.state_dict(), ckpt)
+            paddle.save(opt.state_dict(), opt_ckpt)
+            with open(meta + ".tmp", "w") as f:
+                f.write(str(done))
+            os.replace(meta + ".tmp", meta)
+        dist.barrier()
+        if (rank == 1 and done == kill_step
+                and not os.path.exists(os.path.join(scratch, "died"))):
+            open(os.path.join(scratch, "died"), "w").write("1")
+            print(f"rank 1: simulated failure at step {done}", flush=True)
+            os._exit(17)
+
+    print(f"rank {rank}: DONE final_loss={loss:.8f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -971,9 +971,7 @@ def _sweep5b():
         # misc tail
         ("embedding_weight_grad", lambda x: F.embedding(
             _EMB_IDX, x).sum() * 0.5, _rng5b.randn(3, 4)),
-        ("segment_min", lambda x: __import__(
-            "paddle_tpu.geometric", fromlist=["x"]).segment_min(
-            x, _SEG5).sum(),
+        ("segment_min", lambda x: geo.segment_min(x, _SEG5).sum(),
          (_rng5b.permutation(12).astype(np.float64) * 0.5).reshape(4, 3)),
         ("nanquantile", lambda x: paddle.nanquantile(
             x, 0.5).sum(),
