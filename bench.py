@@ -18,8 +18,10 @@ backoff, fails fast on real errors (compile/shape/import bugs retry
 zero times), and on final failure prints a structured diagnostics JSON
 line instead of a bare traceback. Knobs (env): BENCH_ATTEMPTS=5,
 BENCH_ATTEMPT_TIMEOUT=1800 s, BENCH_RETRY_DELAY=5 s (doubles each
-retry). BENCH_FORCE_FAIL=transient_until:N|fatal|hang_until:N is the
-test hook (tests/test_bench_guard.py).
+retry), BENCH_MAX_HANGS=2 (timeout-kills allowed before declaring the
+backend down — bounds a hung tunnel's burn of the capture window).
+BENCH_FORCE_FAIL=transient_until:N|fatal|hang_until:N is the test hook
+(tests/test_bench_guard.py).
 """
 from __future__ import annotations
 
@@ -87,9 +89,16 @@ def _supervise() -> int:
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "5"))
     timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "1800"))
     delay = float(os.environ.get("BENCH_RETRY_DELAY", "5"))
+    # transient ERRORS fail fast and deserve the full retry budget; a
+    # HANG burns the whole attempt timeout, so a hung tunnel must not
+    # consume attempts x timeout of the capture window (2 hangs ~= the
+    # tunnel is down, not flaky)
+    max_hangs = int(os.environ.get("BENCH_MAX_HANGS", "2"))
+    hangs = 0
     history = []
     for attempt in range(1, attempts + 1):
         env = dict(os.environ, BENCH_CHILD="1", BENCH_ATTEMPT=str(attempt))
+        hung = False
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
@@ -101,6 +110,7 @@ def _supervise() -> int:
                 return b.decode("utf-8", "replace") if isinstance(b, bytes) \
                     else (b or "")
             rc, out_s = -9, _txt(e.stdout)
+            hung = True  # OUR timeout kill — not an external SIGKILL
             err_s = _txt(e.stderr) + (
                 f"\n[bench supervisor] attempt killed after {timeout_s:.0f}s"
                 " (backend hang)")
@@ -124,6 +134,13 @@ def _supervise() -> int:
             f"(rc={rc}, {classification})\n")
         if classification == "fatal":
             break
+        if hung:
+            hangs += 1
+            if hangs >= max_hangs:
+                sys.stderr.write(
+                    f"[bench supervisor] {hangs} attempts hung for "
+                    f"{timeout_s:.0f}s each — backend down, stopping\n")
+                break
         if attempt < attempts:
             time.sleep(delay)
             delay *= 2

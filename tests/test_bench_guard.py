@@ -100,3 +100,15 @@ def test_transient_exhaustion_emits_history():
     assert err["final_classification"] == "transient"
     assert err["attempts"] == 2
     assert "Unable to initialize backend" in err["history"][-1]["stderr_tail"]
+
+
+@pytest.mark.quick
+def test_hang_budget_is_bounded():
+    # a hung tunnel must not burn attempts x timeout: after
+    # BENCH_MAX_HANGS timeout-kills the supervisor stops
+    p = _run("hang_until:99", attempts=5, timeout_s=3,
+             extra={"BENCH_MAX_HANGS": "2"})
+    assert p.returncode == 1
+    err = _metric_line(p.stdout)["error"]
+    assert err["attempts"] == 2  # stopped at the hang budget, not 5
+    assert "backend down" in p.stderr
