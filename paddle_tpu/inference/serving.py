@@ -73,11 +73,18 @@ class ContinuousBatchingEngine:
     def __init__(self, model, *, max_batch: int, max_len: int,
                  block_size: int = 64, num_blocks: int,
                  prompt_pad: Optional[int] = None,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None,
+                 decode_chunk: int = 1):
         """``num_blocks`` fixes the HBM budget (the pool allocates one
         extra trash block); ``max_len`` bounds any sequence's positions
         (tables carry ceil(max_len/block_size) slots per row);
         ``prompt_pad`` is the static prefill width (default: one block).
+
+        ``decode_chunk=K`` scans K decode steps in ONE device dispatch
+        (lax.scan; tokens + eos state carried on device — the
+        generate(decode_chunk=K) idiom) whenever every active slot has
+        at least K tokens of budget left; otherwise the engine falls
+        back to single steps. Admissions happen between chunks.
         """
         self.model = model
         self.B = int(max_batch)
@@ -109,6 +116,8 @@ class ContinuousBatchingEngine:
         self._params = list(model.parameters())
         self._prefill_jit = None
         self._decode_jit = None
+        self._chunk_jit = None
+        self.decode_chunk = max(1, int(decode_chunk))
         self.steps = 0
         self.decode_tokens = 0
 
@@ -148,8 +157,36 @@ class ContinuousBatchingEngine:
             return nxt, [(c.k_pool._data, c.v_pool._data)
                          for c in new_caches]
 
+        def decode_chunk(param_arrays, pools, tok, tables, cache_len,
+                         finished):
+            for p, a in zip(params, param_arrays):
+                p._data = a
+            eos = self.eos_token_id
+
+            def body(carry, _):
+                t, pl, cl, fin = carry
+                with no_grad():
+                    caches = self._caches_from(pl, tables)
+                    logits, new_caches = model.forward_with_cache(
+                        Tensor(t[:, None], _internal=True), caches,
+                        Tensor(cl, _internal=True))
+                nxt = jnp.argmax(
+                    logits._data[:, -1], axis=-1).astype(jnp.int32)
+                if eos is not None:
+                    nxt = jnp.where(fin, eos, nxt)
+                    fin = fin | (nxt == eos)
+                new_pl = [(c.k_pool._data, c.v_pool._data)
+                          for c in new_caches]
+                return (nxt, new_pl, cl + 1, fin), nxt
+
+            (t, pl, cl, fin), toks = jax.lax.scan(
+                body, (tok, pools, cache_len, finished), None,
+                length=self.decode_chunk)
+            return toks, pl  # toks: [K, B]
+
         self._prefill_jit = jax.jit(prefill, donate_argnums=(1,))
         self._decode_jit = jax.jit(decode, donate_argnums=(1,))
+        self._chunk_jit = jax.jit(decode_chunk, donate_argnums=(1,))
 
     def _run_jit(self, jit_fn, *args):
         """Invoke a compiled phase with the params' CURRENT host arrays
@@ -255,18 +292,30 @@ class ContinuousBatchingEngine:
                 slot = self._slots[i]
                 tok[i] = slot.req.out[-1]
                 cl[i] = slot.cache_len
-            nxt, self._pools = self._run_jit(
-                self._decode_jit, self._pools, jnp.asarray(tok),
-                jnp.asarray(self._tables), jnp.asarray(cl))
-            nxt = np.asarray(nxt)
+            k = self.decode_chunk
+            if k > 1 and min(self._slots[i].remaining for i in active) >= k:
+                finished = np.ones((self.B,), bool)
+                finished[active] = False
+                toks, self._pools = self._run_jit(
+                    self._chunk_jit, self._pools, jnp.asarray(tok),
+                    jnp.asarray(self._tables), jnp.asarray(cl),
+                    jnp.asarray(finished))
+                toks = np.asarray(toks)  # [K, B]
+            else:
+                nxt, self._pools = self._run_jit(
+                    self._decode_jit, self._pools, jnp.asarray(tok),
+                    jnp.asarray(self._tables), jnp.asarray(cl))
+                toks = np.asarray(nxt)[None]  # [1, B]
             for i in active:
                 slot = self._slots[i]
-                t = int(nxt[i])
-                slot.req.out.append(t)
-                slot.cache_len += 1
-                slot.remaining -= 1
-                self.decode_tokens += 1
-                self._finish_if_done(i, t)
+                for j in range(toks.shape[0]):
+                    t = int(toks[j, i])
+                    slot.req.out.append(t)
+                    slot.cache_len += 1
+                    slot.remaining -= 1
+                    self.decode_tokens += 1
+                    if self._finish_if_done(i, t):
+                        break
         self.steps += 1
         return [self._completed[r] for r in set(self._completed) - before]
 

@@ -149,3 +149,32 @@ class TestContinuousBatching:
         assert out2 == want  # serves the NEW weights
         assert out2 != out1 or np.allclose(before, after)
         np.testing.assert_array_equal(np.asarray(target._data), after)
+
+    def test_decode_chunk_matches_unchunked(self):
+        """decode_chunk=K scans K steps per dispatch; tokens must be
+        identical to the per-step engine (and hence to generate()),
+        including eos-mid-chunk truncation and evictions."""
+        model = _model()
+        rng = np.random.RandomState(6)
+        prompts = {r: rng.randint(0, 250, (3 + r,)) for r in range(4)}
+
+        def run(chunk, eos=None):
+            eng = ContinuousBatchingEngine(
+                model, max_batch=2, max_len=48, block_size=8,
+                num_blocks=12, prompt_pad=8, eos_token_id=eos,
+                decode_chunk=chunk)
+            for r, p in prompts.items():
+                eng.add_request(r, p, max_new_tokens=9)
+            return {r: q.out for r, q in eng.run().items()}
+
+        base = run(1)
+        chunked = run(3)
+        assert chunked == base
+        # eos mid-chunk: force an early stop on request 0
+        eos = base[0][4]
+        base_eos = run(1, eos=eos)
+        chunk_eos = run(3, eos=eos)
+        assert chunk_eos == base_eos
+        # stopped at the FIRST occurrence of the eos token
+        first = base[0].index(eos)
+        assert base_eos[0] == base[0][:first + 1]
