@@ -71,11 +71,34 @@ class AmpScaler:
     def is_use_dynamic_loss_scaling(self) -> bool:
         return self._use_dynamic_loss_scaling
 
+    @staticmethod
+    def _refuse_interleaved(when: str):
+        """Interleaved optimizers apply updates DURING backward — on
+        grads that are still scaled. By the time step() could object,
+        params and Adam moments are already corrupted, so the refusal
+        must fire BEFORE backward ever runs: here, on the pre-backward
+        surfaces (scale / unscale_). The check is deliberately
+        PROCESS-GLOBAL (scale() cannot see which params the loss
+        reaches): ANY registered interleave_updates optimizer refuses
+        scaling, so mixing interleaved training with a GradScaler in
+        one process is unsupported — conservative over-refusal beats
+        the silent corruption it replaces."""
+        from ..base import tape as _tape
+
+        if _tape._interleave_registry:
+            raise ValueError(
+                "GradScaler cannot drive an interleave_updates "
+                f"optimizer ({when}): interleaved updates would fire "
+                "during backward on SCALED grads, before unscale_/"
+                "inf-skip can run — construct the optimizer without "
+                "interleave_updates when using a GradScaler")
+
     # ------------------------------------------------------------------
     def scale(self, var):
         """Multiply the loss by the current scale (ref: grad_scaler.py scale)."""
         if not self._enable:
             return var
+        self._refuse_interleaved("refused at scale(), before backward")
         return var * Tensor(self._scale.astype(var._data.dtype), _internal=True)
 
     # ------------------------------------------------------------------
@@ -91,6 +114,8 @@ class AmpScaler:
         (check_finite_and_unscale semantics, traceable)."""
         if not self._enable:
             return
+        if getattr(optimizer, "_interleave", False):
+            self._refuse_interleaved("refused at unscale_()")
         state = self._opt_states.get(id(optimizer), OptimizerState.INIT)
         if state is OptimizerState.UNSCALED:
             raise RuntimeError("unscale_() has already been called on this optimizer since the last update()")

@@ -111,8 +111,10 @@ define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf (debug sanitize
 define_flag("check_nan_inf_level", 0, "0: abort on nan/inf, >0: log only (ref FLAGS_check_nan_inf_level)")
 define_flag("benchmark", False, "Block-until-ready after each op for timing")
 define_flag("host_trace_level", 1, "Host tracer verbosity (ref FLAGS_host_trace_level)")
-define_flag("comm_timeout_s", 1800.0, "Collective watchdog timeout seconds (ref comm_task_manager)")
+define_flag("comm_timeout_s", 1800.0, "Collective watchdog deadline seconds per blocking wait (ref comm_task_manager)")
 define_flag("comm_abort_on_timeout", True, "Watchdog aborts the process on a timed-out wait so the launcher relaunches (ref async error handling)")
+define_flag("comm_warn_fraction", 0.5, "Watchdog ladder: warn when a wait has consumed this fraction of its deadline")
+define_flag("comm_dump_fraction", 0.75, "Watchdog ladder: all-thread stack dump at this fraction of the deadline (abort fires at 1.0)")
 define_flag("enable_comm_dynamic_check", False, "Cross-rank shape/dtype check before collectives (ref FLAGS_enable_nccl_dynamic_check)")
 define_flag("use_stream_safe_allocator", True, "no-op on TPU; kept for parity")
 define_flag("eager_delete_tensor_gb", 0.0, "no-op on TPU; kept for parity")

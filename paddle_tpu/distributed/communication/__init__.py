@@ -266,6 +266,15 @@ def scatter(tensor: Tensor, tensor_list=None, src: int = 0, group: Optional[Grou
         mc = _mc_if_active(g, "scatter")
         if mc is not None:
             nproc = jax.process_count()
+            if tensor_list is not None and len(tensor_list) != nproc:
+                # catch this HERE: a mismatched stack otherwise reaches
+                # the compiled broadcast with different shapes on
+                # different processes — an opaque cross-process gloo
+                # size-mismatch or hang instead of an error
+                raise ValueError(
+                    f"scatter: len(tensor_list)={len(tensor_list)} must "
+                    f"equal the trainer process count ({nproc}) in "
+                    "multi-controller mode")
             base = np.asarray(_data(tensor))
             stacked = (np.asarray(x) if tensor_list is not None
                        else np.zeros((nproc, *base.shape), base.dtype))

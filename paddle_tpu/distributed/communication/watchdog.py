@@ -13,14 +13,20 @@ step's outputs while a peer host is dead (multi-host programs stall in
 dispatch until every process arrives). The watchdog therefore monitors
 host-side waits:
 
-- every monitored wait runs under :func:`watch`, which registers
-  ``(description, start_time)`` in a table;
-- a daemon thread wakes every few seconds; any wait older than
-  ``FLAGS comm_timeout_s`` triggers a report — all-thread stack dump
-  (the analogue of the reference dumping its comm trace buffer) — and,
-  if ``FLAGS comm_abort_on_timeout`` is set, ``os._exit(124)`` so the
-  launcher / elastic manager relaunches the job (the reference's
-  async-error-handling teardown path).
+- every monitored wait runs under :func:`watch`, which registers a
+  ``Deadline`` (paddle_tpu.utils.retries) of ``FLAGS comm_timeout_s``;
+- a daemon thread polls and escalates each wait up an ACTION LADDER at
+  fractions of its deadline (instead of one do-everything timeout):
+
+  1. **warn** at ``FLAGS comm_warn_fraction`` (default 0.5) — a log
+     line naming the wait, so a slow-but-alive peer shows up in logs
+     long before teardown;
+  2. **dump** at ``FLAGS comm_dump_fraction`` (default 0.75) — an
+     all-thread stack dump (the analogue of the reference dumping its
+     comm trace buffer) while the process is still alive to dump it;
+  3. **abort** at 1.0 — if ``FLAGS comm_abort_on_timeout`` is set,
+     ``os._exit(124)`` so the launcher / elastic manager relaunches the
+     job (the reference's async-error-handling teardown path).
 
 ``paddle_tpu.distributed.barrier`` and ``paddle_tpu.device.synchronize``
 run their blocking waits under :func:`watch`.
@@ -32,13 +38,18 @@ import itertools
 import os
 import sys
 import threading
-import time
 from contextlib import contextmanager
 from typing import Callable, Dict, Optional, Tuple
 
 from ...base import flags as _flags
+from ...utils.retries import Deadline
 
 _EXIT_CODE = 124  # conventional timeout exit; elastic treats any death as a scale event
+
+# ladder stages in escalation order: (name, fraction-flag); abort always
+# fires at the full deadline
+_STAGES = (("warn", "comm_warn_fraction"), ("dump", "comm_dump_fraction"),
+           ("abort", None))
 
 
 class CommWatchdog:
@@ -48,15 +59,19 @@ class CommWatchdog:
     _lock = threading.Lock()
 
     def __init__(self):
-        self._waits: Dict[int, Tuple[str, float]] = {}
+        # wid -> (description, Deadline); the Deadline is fixed at
+        # watch() entry so a mid-wait flag change cannot un-expire it
+        self._waits: Dict[int, Tuple[str, Deadline]] = {}
         self._ids = itertools.count()
         self._mu = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._kick = threading.Event()  # wakes the daemon on new registrations
-        self._reported: set = set()
-        # test seam: replaces the dump+abort action
+        self._stage_reached: Dict[int, int] = {}  # wid -> ladder index
+        # test seams: _on_timeout replaces the dump+abort actions (abort
+        # stage routes to it); _on_stage observes/replaces EVERY stage
         self._on_timeout: Optional[Callable[[str, float], None]] = None
+        self._on_stage: Optional[Callable[[str, str, float], None]] = None
 
     @classmethod
     def instance(cls) -> "CommWatchdog":
@@ -67,19 +82,23 @@ class CommWatchdog:
 
     # -- registration --------------------------------------------------
     @contextmanager
-    def watch(self, desc: str):
-        """Run a blocking wait under watchdog supervision."""
+    def watch(self, desc: str, deadline: Optional[Deadline] = None):
+        """Run a blocking wait under watchdog supervision. The wait's
+        budget is ``deadline`` (when the caller already has one) or a
+        fresh Deadline of ``FLAGS comm_timeout_s``."""
         wid = next(self._ids)
+        dl = deadline if deadline is not None else Deadline(
+            float(_flags.flag("comm_timeout_s")))
         with self._mu:
-            self._waits[wid] = (desc, time.monotonic())
+            self._waits[wid] = (desc, dl)
         self._ensure_thread()
         self._kick.set()  # re-evaluate the poll interval for this wait
         try:
-            yield
+            yield dl
         finally:
             with self._mu:
                 self._waits.pop(wid, None)
-                self._reported.discard(wid)
+                self._stage_reached.pop(wid, None)
 
     # -- daemon --------------------------------------------------------
     def _ensure_thread(self):
@@ -91,9 +110,30 @@ class CommWatchdog:
                 )
                 self._thread.start()
 
+    def _fractions(self):
+        # clamp to [0, 1]: a fraction flag set past 1.0 must not gate
+        # the ABORT stage behind an unreachable threshold (the ladder
+        # escalates in order, so an unreachable early stage would
+        # silently disable the relaunch safety net)
+        fr = []
+        for _name, flag in _STAGES:
+            fr.append(1.0 if flag is None
+                      else min(max(float(_flags.flag(flag)), 0.0), 1.0))
+        return fr
+
     def _poll_interval(self) -> float:
+        # resolve the smallest gap between ladder stages, not just the
+        # final deadline (warn at 0.5x needs finer polling than x/4),
+        # against the SHORTEST registered budget — a caller-supplied
+        # 0.2s Deadline under an hours-long flag still gets fine polls
         timeout = float(_flags.flag("comm_timeout_s"))
-        return max(0.05, min(5.0, timeout / 4.0))
+        with self._mu:
+            budgets = [dl.budget for _, dl in self._waits.values()
+                       if dl.budget is not None]
+        ref = min(budgets + [timeout])
+        fracs = sorted(set(self._fractions()))
+        gap = min([fracs[0]] + [b - a for a, b in zip(fracs, fracs[1:])])
+        return max(0.02, min(5.0, ref * max(gap, 0.125) / 2.0))
 
     def _run(self):
         while not self._stop.is_set():
@@ -101,38 +141,67 @@ class CommWatchdog:
             self._kick.clear()
             if self._stop.is_set():
                 break
-            timeout = float(_flags.flag("comm_timeout_s"))
-            now = time.monotonic()
+            fracs = self._fractions()
+            fired = []
             with self._mu:
-                expired = [
-                    (wid, desc, now - start)
-                    for wid, (desc, start) in self._waits.items()
-                    if now - start > timeout and wid not in self._reported
-                ]
-                for wid, _, _ in expired:
-                    self._reported.add(wid)
-            for _, desc, age in expired:
-                self._fire(desc, age)
+                for wid, (desc, dl) in self._waits.items():
+                    consumed = dl.fraction_consumed()
+                    reached = self._stage_reached.get(wid, 0)
+                    # escalate through every stage the wait has crossed
+                    # (a long poll gap must not skip the dump)
+                    while (reached < len(_STAGES)
+                           and consumed >= fracs[reached]):
+                        fired.append((_STAGES[reached][0], desc,
+                                      dl.elapsed()))
+                        reached += 1
+                        self._stage_reached[wid] = reached
+            for stage, desc, age in fired:
+                self._fire(stage, desc, age)
 
-    def _fire(self, desc: str, age: float):
-        if self._on_timeout is not None:
+    def _fire(self, stage: str, desc: str, age: float):
+        if self._on_stage is not None:
+            self._on_stage(stage, desc, age)
+            return
+        if stage == "abort" and self._on_timeout is not None:
             self._on_timeout(desc, age)
             return
         from ...utils import log as _log
 
-        msg = (
-            f"CommWatchdog: wait '{desc}' exceeded comm_timeout_s "
-            f"({age:.1f}s); a peer host is likely dead or the device hung."
-        )
-        _log.warning(msg)
-        sys.stderr.write(msg + "\n")
-        faulthandler.dump_traceback(all_threads=True, file=sys.stderr)
-        if bool(_flags.flag("comm_abort_on_timeout")):
-            sys.stderr.write(
-                f"CommWatchdog: aborting (exit {_EXIT_CODE}) for relaunch\n"
+        if stage == "warn":
+            if self._on_timeout is not None:
+                return  # the seam replaces ALL real actions, warn included
+            msg = (
+                f"CommWatchdog: wait '{desc}' has consumed "
+                f"{float(_flags.flag('comm_warn_fraction')):.0%} of its "
+                f"deadline ({age:.1f}s); a peer host may be slow or dead."
             )
-            sys.stderr.flush()
-            os._exit(_EXIT_CODE)
+            _log.warning(msg)
+            sys.stderr.write(msg + "\n")
+        elif stage == "dump":
+            if self._on_timeout is not None:
+                return  # seam replaces the dump+abort actions
+            msg = (
+                f"CommWatchdog: wait '{desc}' at "
+                f"{float(_flags.flag('comm_dump_fraction')):.0%} of its "
+                f"deadline ({age:.1f}s) — dumping all-thread stacks."
+            )
+            _log.warning(msg)
+            sys.stderr.write(msg + "\n")
+            faulthandler.dump_traceback(all_threads=True, file=sys.stderr)
+        elif stage == "abort":
+            msg = (
+                f"CommWatchdog: wait '{desc}' exceeded its deadline "
+                f"({age:.1f}s); a peer host is likely dead or the device "
+                "hung."
+            )
+            _log.warning(msg)
+            sys.stderr.write(msg + "\n")
+            if bool(_flags.flag("comm_abort_on_timeout")):
+                sys.stderr.write(
+                    f"CommWatchdog: aborting (exit {_EXIT_CODE}) for relaunch\n"
+                )
+                sys.stderr.flush()
+                os._exit(_EXIT_CODE)
 
     def stop(self):
         self._stop.set()
@@ -142,6 +211,6 @@ class CommWatchdog:
             self._thread = None
 
 
-def watch(desc: str):
+def watch(desc: str, deadline: Optional[Deadline] = None):
     """Context manager: supervise a blocking wait (module-level sugar)."""
-    return CommWatchdog.instance().watch(desc)
+    return CommWatchdog.instance().watch(desc, deadline)

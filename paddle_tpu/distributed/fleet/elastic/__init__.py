@@ -28,6 +28,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ....testing import chaos as _chaos
+from ....utils.retries import Deadline
 from ...store import KVStore, make_store
 
 __all__ = ["ElasticManager", "ELASTIC_EXIT_CODE"]
@@ -69,6 +71,8 @@ class ElasticManager:
 
     # -- membership ----------------------------------------------------
     def _beat(self):
+        if not _chaos.inject("elastic.heartbeat"):
+            return  # dropped by a chaos schedule — peers see the entry age
         self.store.set(
             self._hb_key, json.dumps({"node": self.node_id, "ts": time.time()})
         )
@@ -101,29 +105,33 @@ class ElasticManager:
         return self.rank_mapping().get(self.node_id, -1)
 
     # -- lifecycle -----------------------------------------------------
-    def register(self):
+    def register(self, deadline: Optional[Deadline] = None):
         """Join + start heartbeating (ref: manager.py start).
 
         Blocks until ≥ min_np nodes are alive AND the alive set is
         stable across two consecutive reads one heartbeat apart, so
         concurrently-joining nodes converge on the same world snapshot.
+        ``deadline`` bounds the whole assembly (default: a fresh
+        Deadline of ``elastic_timeout``); a caller threading its own
+        budget down passes it here and assembly never outlives it.
         """
+        dl = (deadline if deadline is not None
+              else Deadline(self.elastic_timeout))
         self._beat()
-        deadline = time.time() + self.elastic_timeout
         prev = None
         while True:
             cur = self.alive_nodes()
             if len(cur) >= self.min_np and cur == prev:
                 break
-            if time.time() > deadline:
+            if dl.expired():
                 if len(cur) < self.min_np:
                     raise TimeoutError(
                         f"only {len(cur)}/{self.min_np} nodes joined "
-                        f"within {self.elastic_timeout}s"
+                        f"within {dl.budget}s"
                     )
                 break  # settled-enough: membership kept churning
             prev = cur
-            time.sleep(self.heartbeat_interval)
+            dl.sleep(self.heartbeat_interval)
             self._beat()
         # adopt the snapshot the stability loop validated — a re-read
         # here could race a late joiner and diverge across nodes
@@ -152,13 +160,17 @@ class ElasticManager:
             self.alive_nodes() != self._registered_world
         )
 
-    def watch(self) -> int:
+    def watch(self, deadline: Optional[Deadline] = None) -> int:
         """Block until membership changes; returns ELASTIC_EXIT_CODE
-        (ref: manager.py watch → exit for relaunch)."""
+        (ref: manager.py watch → exit for relaunch). With a ``deadline``
+        the watch returns 0 when the budget expires with membership
+        intact — callers driving a bounded supervision loop regain
+        control instead of blocking forever."""
+        dl = deadline if deadline is not None else Deadline.unbounded()
         while not self.world_changed():
-            if self._stop.is_set():
+            if self._stop.is_set() or dl.expired():
                 return 0
-            time.sleep(self.heartbeat_interval)
+            dl.sleep(self.heartbeat_interval)
         return ELASTIC_EXIT_CODE
 
     def should_shrink(self) -> bool:

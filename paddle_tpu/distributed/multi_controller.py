@@ -76,7 +76,10 @@ def initialize_from_env(force: bool = False) -> bool:
     global _initialized_here
     if _initialized_here and not force:
         return True
-    if jax.distributed.is_initialized():
+    # older jax has no jax.distributed.is_initialized; treat it as "not
+    # initialized" (single-process runs proceed, multi-process runs on
+    # such versions initialize explicitly below)
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
         # the worker brought the service up itself (the previously
         # documented contract) — honor it rather than double-initialize
         _initialized_here = True
@@ -248,10 +251,14 @@ def eager_send(x, dst: int) -> None:
 
 def eager_recv(src: int, timeout_ms: int = 600_000) -> np.ndarray:
     me = jax.process_index()
-    seq = _p2p_seq[(src, me)] = _p2p_seq.get((src, me), 0) + 1
+    # the pair counter commits only AFTER a successful receive: a
+    # timed-out get followed by a retry must wait on the SAME seq the
+    # sender published, not permanently skip past it (pair desync)
+    seq = _p2p_seq.get((src, me), 0) + 1
     key = f"ptpu_p2p/{src}/{me}/{seq}"
     client = _kv_client()
     payload = client.blocking_key_value_get_bytes(key, timeout_ms)
+    _p2p_seq[(src, me)] = seq
     client.key_value_delete(key)
     return pickle.loads(payload)
 

@@ -68,16 +68,30 @@ def get_world_size(group: Optional[Group] = None) -> int:
     multi-controller → TRAINER (process) count, matching the eager
     collectives and the reference (world_size == number of trainer
     processes); single-controller → device count (each device is an
-    SPMD rank)."""
-    if group is not None:
-        return group.nranks
+    SPMD rank). Passing the DEFAULT (world) group explicitly reports
+    the same unit as passing no group — the two spellings must never
+    disagree (2 vs 4 in a 2-process x 2-device run). A non-default
+    subgroup still reports its device-level ``nranks``."""
     from . import multi_controller as _mc
 
+    if group is not None:
+        if _mc.active() and _is_default_group(group):
+            return jax.process_count()
+        return group.nranks
     if _mc.active():
         return jax.process_count()
     if is_initialized():
         return _collective._get_global_group().nranks
     return jax.device_count()
+
+
+def _is_default_group(group: Group) -> bool:
+    if not is_initialized():
+        return False
+    try:
+        return group is _collective._get_global_group()
+    except Exception:  # noqa: BLE001 — no global group yet
+        return False
 
 
 def get_rank(group: Optional[Group] = None) -> int:

@@ -28,6 +28,9 @@ import time
 import urllib.parse
 from typing import Dict, List, Optional
 
+from ..testing import chaos as _chaos
+from ..utils.retries import Deadline, RetryPolicy
+
 __all__ = ["KVStore", "FileKVStore", "TCPKVStore", "TCPStoreServer", "make_store"]
 
 
@@ -281,6 +284,13 @@ class TCPStoreServer:
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0):
         self._data: Dict[str, str] = {}
+        # request-dedup: rid -> result, so a client retrying a
+        # NON-IDEMPOTENT op (add, set_if_absent) whose RESPONSE was lost
+        # replays the cached answer instead of re-applying — exact-count
+        # barriers stay exact and the claim winner stays the winner.
+        # Bounded FIFO.
+        self._add_seen: Dict[str, object] = {}
+        self._add_order: List[str] = []
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -290,6 +300,12 @@ class TCPStoreServer:
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
+
+    def _remember(self, rid: str, result) -> None:
+        self._add_seen[rid] = result
+        self._add_order.append(rid)
+        while len(self._add_order) > 4096:
+            self._add_seen.pop(self._add_order.pop(0), None)
 
     def _serve(self):
         self._sock.settimeout(0.2)
@@ -348,14 +364,24 @@ class TCPStoreServer:
                 self._data.pop(req["k"], None)
                 return {"ok": True}
             if op == "set_if_absent":
-                if req["k"] in self._data:
-                    return {"ok": True, "v": False}
-                self._data[req["k"]] = (req["v"], now)
-                return {"ok": True, "v": True}
+                rid = req.get("rid")
+                if rid is not None and rid in self._add_seen:
+                    return {"ok": True, "v": self._add_seen[rid]}
+                won = req["k"] not in self._data
+                if won:
+                    self._data[req["k"]] = (req["v"], now)
+                if rid is not None:
+                    self._remember(rid, won)
+                return {"ok": True, "v": won}
             if op == "add":
+                rid = req.get("rid")
+                if rid is not None and rid in self._add_seen:
+                    return {"ok": True, "v": self._add_seen[rid]}
                 ent = self._data.get(req["k"])
                 cur = int(ent[0] if ent else "0") + int(req["amount"])
                 self._data[req["k"]] = (str(cur), now)
+                if rid is not None:
+                    self._remember(rid, cur)
                 return {"ok": True, "v": cur}
             return {"ok": False, "err": f"bad op {op!r}"}
 
@@ -365,12 +391,42 @@ class TCPStoreServer:
 
 
 class TCPKVStore(KVStore):
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
-        self.host, self.port, self.timeout = host, port, timeout
+    """One request per connection, with reconnect-with-backoff: a
+    connection reset / refused / timeout (master briefly overloaded,
+    TCP blip, server restarting) retries under the op's Deadline
+    instead of raising straight into the caller's heartbeat loop.
 
-    def _req(self, **payload):
+    ``timeout`` is the TOTAL per-operation budget (a Deadline); each
+    connection attempt gets the remaining slice. ``add`` carries a
+    request id the server dedups, so a retried increment whose first
+    response was lost stays EXACTLY-once (rpc barriers count exact
+    arrivals); everything else is idempotent under retry by nature.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 retry: Optional[RetryPolicy] = None):
+        self.host, self.port, self.timeout = host, port, timeout
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=4, base_delay=0.1, max_delay=2.0,
+            transient=self._is_transient)
+
+    @staticmethod
+    def _is_transient(exc: BaseException) -> bool:
+        # OSError covers reset/refused/timeout; ValueError: empty or
+        # truncated line-JSON response — the server closed mid-reply.
+        # RuntimeError (server-side op error) is NOT transient: the
+        # request reached a healthy server and the op itself failed.
+        return isinstance(exc, (OSError, ValueError))
+
+    def _req_once(self, payload: dict, timeout: Optional[float]):
+        if not _chaos.inject("store.request"):
+            # a dropped request is a LOST MESSAGE, not an empty reply:
+            # surface it as a transient error so the retry layer (and
+            # wait_alive) see a failure, never a fabricated response
+            raise ConnectionResetError(
+                "chaos: store request dropped (lost message)")
         with socket.create_connection(
-            (self.host, self.port), timeout=self.timeout
+            (self.host, self.port), timeout=timeout
         ) as conn, conn.makefile("rw") as f:
             f.write(json.dumps(payload) + "\n")
             f.flush()
@@ -378,6 +434,14 @@ class TCPKVStore(KVStore):
         if not resp.get("ok"):
             raise RuntimeError(f"TCP store error: {resp.get('err')}")
         return resp.get("v")
+
+    def _req(self, _deadline: Optional[Deadline] = None, **payload):
+        dl = (_deadline if _deadline is not None
+              else Deadline(self.timeout))
+        return self.retry.call(
+            lambda: self._req_once(payload, dl.timeout(self.timeout,
+                                                       floor=0.05)),
+            deadline=dl, describe=f"TCP store {payload.get('op')}")
 
     def set(self, key: str, value: str) -> None:
         self._req(op="set", k=key, v=value)
@@ -395,23 +459,47 @@ class TCPKVStore(KVStore):
         self._req(op="delete", k=key)
 
     def add(self, key: str, amount: int = 1) -> int:
-        return self._req(op="add", k=key, amount=amount)
+        # a request id makes the increment EXACTLY-once under retry: if
+        # the server applied it but the reply was lost, the retried
+        # request replays the cached result instead of re-incrementing
+        # (rpc barriers count exact arrivals)
+        import uuid
+
+        return self._req(op="add", k=key, amount=amount,
+                         rid=uuid.uuid4().hex)
 
     def set_if_absent(self, key: str, value: str) -> bool:
-        return bool(self._req(op="set_if_absent", k=key, v=value))
+        # same lost-reply hazard as add: without the rid, a retried
+        # claim finds its OWN key present and tells the rightful winner
+        # it lost (duplicate-rank detection would then abort the winner)
+        import uuid
 
-    def wait_alive(self, deadline: float = 30.0) -> None:
-        end = time.time() + deadline
-        while True:
-            try:
-                self._req(op="get", k="__ping__")
-                return
-            except OSError:
-                if time.time() > end:
-                    raise TimeoutError(
-                        f"TCP store {self.host}:{self.port} not reachable"
-                    ) from None
-                time.sleep(0.2)
+        return bool(self._req(op="set_if_absent", k=key, v=value,
+                              rid=uuid.uuid4().hex))
+
+    def wait_alive(self, deadline=30.0) -> None:
+        """Block until the server answers; ``deadline`` is seconds or a
+        Deadline. ONE retry discipline: a flat-backoff RetryPolicy over
+        the raw probe, bounded by the deadline alone (no second loop
+        stacked on _req's own retries), treating every transient —
+        connect failures AND truncated mid-restart replies — alike."""
+        dl = Deadline.coerce(deadline)
+        probe = RetryPolicy(max_attempts=1_000_000, base_delay=0.2,
+                            multiplier=1.0, transient=self._is_transient)
+        try:
+            probe.call(
+                lambda: self._req_once(
+                    {"op": "get", "k": "__ping__"},
+                    dl.timeout(self.timeout, floor=0.05)),
+                deadline=dl, describe="TCP store ping")
+        except (OSError, ValueError):
+            # transient exhaustion == the deadline ran out (attempts are
+            # effectively unbounded); server-side RuntimeError means the
+            # server IS alive and propagates as before
+            raise TimeoutError(
+                f"TCP store {self.host}:{self.port} not reachable "
+                f"within {dl.budget}s"
+            ) from None
 
 
 def make_store(location: str) -> KVStore:

@@ -142,13 +142,22 @@ class AutoCheckpoint:
     # -- saving ----------------------------------------------------------
     def _write(self, state: dict):
         from ...framework import io as fio
+        from ...testing import chaos as _chaos
 
+        if not _chaos.inject("ckpt.write"):
+            return  # dropped save: nothing reaches disk this interval
         step = state["step"]
         final = self._ckpt_path(step)
         tmp = final + f".{os.getpid()}.tmp"
         try:
             os.makedirs(tmp, exist_ok=True)
             fio.save(state, os.path.join(tmp, "state.pdparams"))
+            # chaos at the publish point: "kill" = a mid-save death,
+            # "drop" = the publish is abandoned — both leave a torn tmp
+            # (payload, no done marker) that resume() must never
+            # mistake for a valid checkpoint
+            if not _chaos.inject("ckpt.publish"):
+                return
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump({"step": step, "done": True,
                            "time": time.time()}, f)

@@ -41,6 +41,8 @@ import numpy as np
 from ..base.tape import no_grad
 from ..base.tensor import Tensor
 from ..ops.paged_attention import BlockManager, PagedLayerCache
+from ..testing import chaos as _chaos
+from ..utils.retries import Deadline
 
 __all__ = ["GenRequest", "ContinuousBatchingEngine"]
 
@@ -48,12 +50,22 @@ __all__ = ["GenRequest", "ContinuousBatchingEngine"]
 @dataclass
 class GenRequest:
     """One generation request (ref: the reference's serving request —
-    prompt ids + budget)."""
+    prompt ids + budget). ``deadline`` is the request's wall-clock
+    budget: admission rejects it once expired, and an in-flight slot is
+    EVICTED when it expires mid-decode — one stuck/abandoned client can
+    never pin a slot (its blocks recycle immediately). ``status`` is
+    "ok" for a normally finished request, "expired" for a rejected or
+    evicted one (whatever tokens were produced stay in ``out``)."""
 
     req_id: object
     prompt: np.ndarray  # [s] int
     max_new_tokens: int = 32
     out: List[int] = field(default_factory=list)
+    deadline: Optional[Deadline] = None
+    status: str = "ok"
+
+    def expired(self) -> bool:
+        return self.deadline is not None and self.deadline.expired()
 
 
 class _Slot:
@@ -202,7 +214,10 @@ class ContinuousBatchingEngine:
                 p._data = a
 
     # -- public API ------------------------------------------------------
-    def add_request(self, req_id, prompt, max_new_tokens: int = 32):
+    def add_request(self, req_id, prompt, max_new_tokens: int = 32,
+                    deadline=None):
+        """``deadline``: seconds or a ``Deadline`` — the request's total
+        budget (queue wait included). None = no deadline."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0 or prompt.size > self.prompt_pad:
             raise ValueError(
@@ -210,13 +225,29 @@ class ContinuousBatchingEngine:
                 f"{self.prompt_pad}]")
         if prompt.size + max_new_tokens > self.max_len:
             raise ValueError("prompt + max_new_tokens exceeds max_len")
-        req = GenRequest(req_id, prompt, max_new_tokens)
+        dl = None if deadline is None else Deadline.coerce(deadline)
+        req = GenRequest(req_id, prompt, max_new_tokens, deadline=dl)
         if self._blocks_needed(req) > self.manager.num_blocks:
             raise ValueError(
                 f"request needs {self._blocks_needed(req)} blocks but the "
                 f"pool only has {self.manager.num_blocks} — it could never "
                 "be admitted")
         self._queue.append(req)
+
+    def _expire(self, req: GenRequest):
+        req.status = "expired"
+        self._completed[req.req_id] = req
+
+    def _evict_expired(self):
+        """Reclaim slots whose request's deadline passed: free the
+        blocks, point the row at the trash block, surface the request as
+        completed-with-status-expired."""
+        for slot_idx, slot in enumerate(self._slots):
+            if slot.active and slot.req.expired():
+                self.manager.free_sequence(slot.req.req_id)
+                self._tables[slot_idx] = self._trash
+                self._expire(slot.req)
+                slot.req = None
 
     @property
     def num_active(self):
@@ -232,6 +263,10 @@ class ContinuousBatchingEngine:
         prefill per admission (per-slot isolation via the trash table).
         """
         for slot_idx, slot in enumerate(self._slots):
+            # admission rejects requests whose budget already expired
+            # while queued (the client gave up; don't burn a prefill)
+            while self._queue and self._queue[0].expired():
+                self._expire(self._queue.pop(0))
             if not self._queue or slot.active:
                 continue
             req = self._queue[0]
@@ -278,9 +313,14 @@ class ContinuousBatchingEngine:
         return done
 
     def step(self):
-        """One engine iteration: admit, then one decode step for every
-        active slot. Returns the requests completed this iteration."""
+        """One engine iteration: evict expired slots, admit, then one
+        decode step for every active slot. Returns the requests
+        completed this iteration (expired ones included, with
+        ``status == "expired"``)."""
+        if not _chaos.inject("serving.step"):
+            return []  # dropped engine iteration: no work this tick
         before = set(self._completed)
+        self._evict_expired()
         self._admit()
         active = [i for i, s in enumerate(self._slots) if s.active]
         if active:

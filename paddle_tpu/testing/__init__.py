@@ -1,0 +1,7 @@
+"""paddle_tpu.testing — test-support utilities (chaos fault injection).
+
+Kept import-light (stdlib only) so harness code can load in contexts
+that must not drag the framework in (the bench supervisor, tiny
+subprocess workers).
+"""
+from . import chaos  # noqa: F401

@@ -1,0 +1,289 @@
+"""Deterministic fault injection — the chaos harness.
+
+Robustness claims ("the heartbeat survives a store reset", "a torn save
+never blocks resume", "a hung attempt forfeits only its share of the
+budget") are only provable if the fault fires exactly where and when
+the test scheduled it. This module provides that: instrumented sites in
+the framework call :func:`inject(site)`; an installed
+:class:`ChaosSchedule` decides — deterministically, from an explicit
+(site, invocation-index) plan or a seeded per-site Bernoulli stream —
+whether that invocation hangs, resets, drops, slows, errors, or kills
+the process.
+
+Instrumented sites (grep for ``chaos.inject``):
+
+- ``store.request``      — every TCPKVStore request (reset/hang/slow)
+- ``elastic.heartbeat``  — each membership beat (drop = lose the beat)
+- ``ckpt.write``         — entering an auto-checkpoint save
+- ``ckpt.publish``       — just before the atomic rename (kill here
+  leaves a torn tmp dir that resume() must skip)
+- ``serving.step``       — each engine iteration
+- ``bench.attempt``      — the bench child, before any JAX import
+- ``train.step``         — opt-in: training loops/test workers call it
+
+Faults (``Fault.kind``): ``hang``/``slow`` (sleep ``arg`` seconds;
+``hang`` requires a positive arg), ``reset`` (raise
+ConnectionResetError), ``error`` (raise RuntimeError), ``drop``
+(inject returns False — the site skips the operation), ``kill``
+(``os._exit(int(arg))`` with an explicit code; with no arg, SIGKILL —
+the rc < 0 shape a real worker death has).
+
+Subprocess transport: ``PADDLE_CHAOS`` holds a spec string (see
+:meth:`ChaosSchedule.to_spec`); the first ``inject`` call in a process
+auto-installs it, so workers need zero harness code beyond their own
+``inject`` sites. Stdlib-only by design — loadable by path from the
+bench supervisor before any framework import.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "Fault",
+    "ChaosClock",
+    "ChaosSchedule",
+    "ChaosMonkey",
+    "install",
+    "uninstall",
+    "active",
+    "inject",
+    "monkey",
+]
+
+_KINDS = ("hang", "slow", "reset", "error", "drop", "kill")
+
+
+@dataclass(frozen=True)
+class Fault:
+    kind: str  # one of _KINDS
+    arg: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.kind == "hang" and self.arg <= 0:
+            # hang:0 would sleep zero seconds — a silent no-op that lets
+            # a "survives a hang" test pass vacuously
+            raise ValueError("hang needs a positive duration arg "
+                             "(e.g. 'site@1=hang:30')")
+
+
+class ChaosClock:
+    """A virtual monotonic clock: ``now()`` only advances via
+    ``sleep``/``advance``. Deadlines built on it expire exactly when the
+    test says time passed — no real waiting, no flaky margins."""
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        with self._lock:
+            return self._t
+
+    __call__ = now
+
+    def advance(self, seconds: float) -> None:
+        with self._lock:
+            self._t += float(seconds)
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)
+
+
+class ChaosSchedule:
+    """What fires where. Two deterministic sources, explicit plan wins:
+
+    - ``at(site, index, kind, arg)`` — fault the index-th invocation
+      (1-based) of ``site``.
+    - ``every(site, n, kind, arg)`` — fault every n-th invocation.
+    - ``with_probability(site, p, kind, arg)`` — seeded Bernoulli per
+      invocation; the draw depends only on (seed, site, index), so the
+      pattern is reproducible regardless of thread timing or call
+      order across sites.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._plan: Dict[Tuple[str, int], Fault] = {}
+        self._every: Dict[str, Tuple[int, Fault]] = {}
+        self._prob: Dict[str, Tuple[float, Fault]] = {}
+
+    # -- builders (chainable) ------------------------------------------
+    def at(self, site: str, index: int, kind: str,
+           arg: float = 0.0) -> "ChaosSchedule":
+        if index < 1:
+            raise ValueError("invocation indexes are 1-based")
+        self._plan[(site, int(index))] = Fault(kind, float(arg))
+        return self
+
+    def every(self, site: str, n: int, kind: str,
+              arg: float = 0.0) -> "ChaosSchedule":
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        self._every[site] = (int(n), Fault(kind, float(arg)))
+        return self
+
+    def with_probability(self, site: str, p: float, kind: str,
+                         arg: float = 0.0) -> "ChaosSchedule":
+        if not 0.0 <= p <= 1.0:
+            raise ValueError("p must be in [0, 1]")
+        self._prob[site] = (float(p), Fault(kind, float(arg)))
+        return self
+
+    # -- lookup ---------------------------------------------------------
+    def fault_for(self, site: str, index: int) -> Optional[Fault]:
+        hit = self._plan.get((site, index))
+        if hit is not None:
+            return hit
+        ev = self._every.get(site)
+        if ev is not None and index % ev[0] == 0:
+            return ev[1]
+        pr = self._prob.get(site)
+        if pr is not None:
+            p, fault = pr
+            # draw keyed by (seed, site, index): independent of call
+            # order, identical across processes with the same seed
+            if random.Random(f"{self.seed}:{site}:{index}").random() < p:
+                return fault
+        return None
+
+    # -- env transport --------------------------------------------------
+    # spec grammar (';'-separated clauses):
+    #   seed=S
+    #   site@IDX=kind:arg      explicit invocation
+    #   site/N=kind:arg        every N-th invocation
+    #   site%P=kind:arg        seeded Bernoulli(P)
+    def to_spec(self) -> str:
+        parts = [f"seed={self.seed}"]
+        for (site, idx), f in sorted(self._plan.items()):
+            parts.append(f"{site}@{idx}={f.kind}:{f.arg}")
+        for site, (n, f) in sorted(self._every.items()):
+            parts.append(f"{site}/{n}={f.kind}:{f.arg}")
+        for site, (p, f) in sorted(self._prob.items()):
+            parts.append(f"{site}%{p}={f.kind}:{f.arg}")
+        return ";".join(parts)
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "ChaosSchedule":
+        sched = cls()
+        for clause in filter(None, (c.strip() for c in spec.split(";"))):
+            key, _, val = clause.partition("=")
+            if key == "seed":
+                sched.seed = int(val)
+                continue
+            kind, _, arg_s = val.partition(":")
+            arg = float(arg_s) if arg_s else 0.0
+            if "@" in key:
+                site, idx = key.rsplit("@", 1)
+                sched.at(site, int(idx), kind, arg)
+            elif "/" in key:
+                site, n = key.rsplit("/", 1)
+                sched.every(site, int(n), kind, arg)
+            elif "%" in key:
+                site, p = key.rsplit("%", 1)
+                sched.with_probability(site, float(p), kind, arg)
+            else:
+                raise ValueError(f"bad chaos clause {clause!r}")
+        return sched
+
+
+@dataclass
+class ChaosMonkey:
+    """An installed schedule plus the observability the tests assert on:
+    per-site invocation counts and the log of fired faults."""
+
+    schedule: ChaosSchedule
+    clock: Optional[ChaosClock] = None
+    counts: Dict[str, int] = field(default_factory=dict)
+    events: List[Tuple[str, int, str]] = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def fire(self, site: str, index: Optional[int] = None) -> bool:
+        """Apply the scheduled fault (if any) for this invocation.
+        Returns False when the site should SKIP its operation (drop);
+        True otherwise. May raise or exit per the fault kind.
+        ``index`` overrides the per-process invocation counter — sites
+        that restart in a fresh process each round (the bench child)
+        pass their attempt number so schedules still line up."""
+        with self._lock:
+            idx = index if index is not None else self.counts.get(site, 0) + 1
+            self.counts[site] = idx
+            fault = self.schedule.fault_for(site, idx)
+            if fault is not None:
+                self.events.append((site, idx, fault.kind))
+        if fault is None:
+            return True
+        if fault.kind in ("hang", "slow"):
+            (self.clock.sleep if self.clock is not None
+             else time.sleep)(fault.arg)
+            return True
+        if fault.kind == "reset":
+            raise ConnectionResetError(
+                f"chaos: injected connection reset at {site}#{idx}")
+        if fault.kind == "error":
+            raise RuntimeError(f"chaos: injected error at {site}#{idx}")
+        if fault.kind == "drop":
+            return False
+        if fault.kind == "kill":
+            if fault.arg:
+                os._exit(int(fault.arg))  # explicit exit code
+            # no arg: die like real hardware — a signal, so supervisors
+            # observe rc < 0 (the transient classification a genuine
+            # worker death gets), not a clean-looking positive exit
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+        return True  # pragma: no cover — _KINDS is exhaustive
+
+
+_monkey: Optional[ChaosMonkey] = None
+_env_checked = False
+
+
+def install(schedule: ChaosSchedule,
+            clock: Optional[ChaosClock] = None) -> ChaosMonkey:
+    global _monkey
+    _monkey = ChaosMonkey(schedule=schedule, clock=clock)
+    return _monkey
+
+
+def uninstall() -> None:
+    global _monkey, _env_checked
+    _monkey = None
+    _env_checked = True  # an explicit uninstall also disables env pickup
+
+
+def monkey() -> Optional[ChaosMonkey]:
+    return _monkey
+
+
+@contextmanager
+def active(schedule: ChaosSchedule, clock: Optional[ChaosClock] = None):
+    mk = install(schedule, clock)
+    try:
+        yield mk
+    finally:
+        uninstall()
+
+
+def inject(site: str, index: Optional[int] = None) -> bool:
+    """Called by instrumented sites. No-op (returns True) unless a
+    schedule is installed — in-process via :func:`install`, or picked up
+    once from the ``PADDLE_CHAOS`` env spec (subprocess workers)."""
+    global _env_checked, _monkey
+    if _monkey is None:
+        if _env_checked:
+            return True
+        _env_checked = True
+        spec = os.environ.get("PADDLE_CHAOS")
+        if not spec:
+            return True
+        _monkey = ChaosMonkey(schedule=ChaosSchedule.from_spec(spec))
+    return _monkey.fire(site, index)

@@ -6,7 +6,9 @@ from base), and cpp_extension (native custom-op build + load).
 """
 from . import cpp_extension  # noqa: F401
 from . import log  # noqa: F401
+from . import retries  # noqa: F401
 from .log import get_logger  # noqa: F401
+from .retries import Deadline, RetryPolicy  # noqa: F401
 
 
 def try_import(module_name: str):

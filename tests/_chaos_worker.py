@@ -1,0 +1,94 @@
+"""Chaos kill-and-relaunch worker (single trainer, driven by
+tests/test_chaos.py).
+
+The worker joins an ElasticManager membership (FileKVStore over the
+scratch dir) under a Deadline, trains a small model with periodic
+AutoCheckpoint saves, and calls ``chaos.inject("train.step")`` once per
+step — the parent schedules a ``kill`` fault there via PADDLE_CHAOS for
+wave 1. The relaunch agent (the test, playing exactly the loop
+fleet.elastic/launch implement) restarts the worker without the chaos
+env; it resumes via ``AutoCheckpoint.resume()`` and must land on the
+SAME final loss as an uninterrupted run (deterministic data replay).
+
+env:
+  CHAOS_DIR    — scratch dir (membership + checkpoints)
+  CHAOS_TOTAL  — total steps to train
+  PADDLE_CHAOS — optional fault schedule (wave 1 only)
+"""
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:  # older jax: default is one CPU device already
+    pass
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+import paddle_tpu.optimizer as popt  # noqa: E402
+from paddle_tpu.distributed.fleet.elastic import ElasticManager  # noqa: E402
+from paddle_tpu.incubate.checkpoint.auto_checkpoint import (  # noqa: E402
+    AutoCheckpoint,
+)
+from paddle_tpu.testing import chaos  # noqa: E402
+from paddle_tpu.utils.retries import Deadline  # noqa: E402
+
+
+def main():
+    scratch = os.environ["CHAOS_DIR"]
+    total = int(os.environ["CHAOS_TOTAL"])
+
+    # one job-level budget, split across phases the documented way:
+    # membership assembly gets a slice, the rest belongs to training
+    job = Deadline(120.0)
+    manager = ElasticManager(
+        os.path.join(scratch, "membership"), node_id="worker-0", np=1,
+        heartbeat_interval=0.2, elastic_timeout=10.0,
+    )
+    manager.register(deadline=job.sub(fraction=0.25))
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = popt.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    # sync saves: the checkpoint for step N is durably on disk before
+    # step N+1 can run (so the scheduled kill always has a resume point)
+    ac = AutoCheckpoint(
+        os.path.join(scratch, "ckpts"), layers=[model], optimizers=[opt],
+        save_interval_steps=4, async_save=False,
+    )
+    nxt = ac.resume()  # next 1-based step to run; 0 on a fresh start
+    begin = nxt if nxt else 1
+    if nxt:
+        print(f"resumed at step {nxt}", flush=True)
+
+    rng = np.random.RandomState(7)
+    loss = None
+    for step in range(1, total + 1):
+        x_np = rng.randn(8, 8).astype(np.float32)
+        y_np = rng.randint(0, 4, (8,)).astype(np.int64)
+        if step < begin:
+            continue  # deterministic data schedule: replay the stream
+        # wave 1 dies here at the scheduled step; a 'drop' fault would
+        # instead skip this step's training (honored per the contract)
+        if not chaos.inject("train.step"):
+            continue
+        x = paddle.to_tensor(x_np)
+        y = paddle.to_tensor(y_np)
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        ac.step(step)
+    ac.wait()
+    manager.exit()
+    print(f"DONE final_loss={float(loss):.8f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

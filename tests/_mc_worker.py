@@ -147,8 +147,11 @@ def main():
     assert len(jax.local_devices()) == 2
     assert group.nranks == 4  # device-level world group
     # trainer-level units: world_size matches what the eager
-    # collectives use (process count), like the reference
+    # collectives use (process count), like the reference — and the
+    # two spellings agree (round-5 advisor: get_world_size() vs
+    # get_world_size(default_group) used to answer 2 vs 4)
     assert dist.get_world_size() == 2, dist.get_world_size()
+    assert dist.get_world_size(group) == 2, dist.get_world_size(group)
 
     check_collectives(rank, world)
     check_dp_loss_parity(rank, world)

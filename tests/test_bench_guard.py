@@ -112,3 +112,132 @@ def test_hang_budget_is_bounded():
     err = _metric_line(p.stdout)["error"]
     assert err["attempts"] == 2  # stopped at the hang budget, not 5
     assert "backend down" in p.stderr
+
+
+# ---- BENCH_TOTAL_BUDGET: the round-6 capture-window contract ----------
+# (round-5 verdict: BENCH_r05 died rc=124 because one hung attempt's
+# 1800s timeout outlived the driver's window — the supervisor now runs
+# under a TOTAL deadline and hung attempts forfeit only their share)
+
+
+@pytest.mark.quick
+def test_hung_attempts_fit_inside_total_budget():
+    """The acceptance bound: with a tunnel that hangs FOREVER, total
+    supervisor wall time stays inside BENCH_TOTAL_BUDGET and a
+    structured JSON record still comes out."""
+    import time as _time
+
+    budget = 8.0
+    t0 = _time.monotonic()
+    p = _run("hang_until:99", attempts=5,
+             extra={"BENCH_TOTAL_BUDGET": str(budget),
+                    # no per-attempt cap: the budget share alone must
+                    # bound each attempt (8/5 = 1.6s, not 1800s)
+                    "BENCH_ATTEMPT_TIMEOUT": "1800",
+                    "BENCH_MAX_HANGS": "99",
+                    "BENCH_RETRY_DELAY": "0.05"})
+    wall = _time.monotonic() - t0
+    assert p.returncode == 1
+    # margin covers interpreter startup + the final JSON write, not an
+    # extra attempt — slack smaller than any attempt slice can't hide a
+    # busted bound
+    assert wall < budget + 3.0, f"supervisor ran {wall:.1f}s > {budget}s"
+    obj = _metric_line(p.stdout)
+    err = obj["error"]
+    assert obj["value"] is None
+    assert err["total_budget_s"] == budget
+    assert err["elapsed_s"] <= budget + 1.0
+    assert err["attempts"] >= 2  # a hang forfeits its slice, not the window
+    assert all(h["classification"] == "transient" for h in err["history"])
+    assert all(h["timeout_s"] <= budget for h in err["history"])
+    # the first attempt gets the LION'S share (remaining minus a small
+    # per-retry reserve), not an equal budget/attempts split that would
+    # cap healthy long runs
+    assert err["history"][0]["timeout_s"] > budget / 5
+
+
+@pytest.mark.quick
+def test_budget_share_shrinks_per_attempt_timeout():
+    """Per-attempt timeout = min(BENCH_ATTEMPT_TIMEOUT, remaining minus
+    the retries' reserve): with a huge total budget the knob caps it;
+    with a small one the budget does, and a hung first attempt forfeits
+    its big slice so later attempts get only the reserved slivers."""
+    p = _run("hang_until:99", attempts=2, timeout_s=2,
+             extra={"BENCH_TOTAL_BUDGET": "3300",
+                    "BENCH_MAX_HANGS": "99"})
+    hist = _metric_line(p.stdout)["error"]["history"]
+    assert all(h["timeout_s"] == 2.0 for h in hist)  # knob won
+
+    p = _run("hang_until:99", attempts=4, timeout_s=1800,
+             extra={"BENCH_TOTAL_BUDGET": "10",
+                    "BENCH_MAX_HANGS": "99",
+                    "BENCH_RETRY_DELAY": "0.05"})
+    hist = _metric_line(p.stdout)["error"]["history"]
+    assert hist[0]["timeout_s"] > 10.0 / 4   # lion's share, not a split
+    assert all(h["timeout_s"] <= 10.0 for h in hist)
+    assert all(h["timeout_s"] < hist[0]["timeout_s"] for h in hist[1:])
+
+
+@pytest.mark.quick
+def test_budget_exhaustion_is_a_structured_stop():
+    """When the budget is too small even to start another child, the
+    supervisor stops with stop_reason='budget exhausted' instead of
+    looping or overrunning."""
+    p = _run("transient_until:99", attempts=50,
+             extra={"BENCH_TOTAL_BUDGET": "4",
+                    "BENCH_RETRY_DELAY": "3"})  # backoff eats the budget
+    assert p.returncode == 1
+    err = _metric_line(p.stdout)["error"]
+    assert err["stop_reason"] == "budget exhausted"
+    assert err["attempts"] < 50
+
+
+@pytest.mark.quick
+def test_chaos_schedule_drives_the_same_supervisor_paths():
+    """PADDLE_CHAOS (site bench.attempt, indexed by attempt number) is
+    the seeded-plan spelling of BENCH_FORCE_FAIL: error on attempts 1-2,
+    clean run on 3."""
+    env = {"PADDLE_CHAOS":
+           "bench.attempt@1=error;bench.attempt@2=error"}
+    p = _run("", attempts=3, extra=env)
+    # chaos 'error' raises RuntimeError — classified fatal (a real bug
+    # would look the same), so the supervisor must fail FAST, attempt 1
+    assert p.returncode == 1
+    err = _metric_line(p.stdout)["error"]
+    assert err["attempts"] == 1
+    assert "chaos: injected error" in err["history"][0]["stderr_tail"]
+
+    # a chaos reset is transient ("connection reset" is in the shared
+    # taxonomy): attempt 1 fails fast, attempt 2 runs clean and the
+    # supervisor delivers the metric line
+    p = _run("", attempts=2,
+             extra={"PADDLE_CHAOS": "bench.attempt@1=reset"})
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert _metric_line(p.stdout)["value"] > 0
+    assert "attempt 1/2 failed" in p.stderr
+    assert "transient" in p.stderr
+
+
+@pytest.mark.quick
+def test_chaos_kill_and_drop_look_like_worker_death_not_bugs():
+    """An arg-less chaos 'kill' dies by SIGKILL (rc < 0) and a 'drop'
+    vanishes with no metric line — both must classify TRANSIENT so a
+    seeded chaos plan can exercise retry-after-worker-death instead of
+    halting the capture as a fatal bug."""
+    p = _run("", attempts=2,
+             extra={"PADDLE_CHAOS":
+                    "bench.attempt@1=kill;bench.attempt@2=kill"})
+    assert p.returncode == 1
+    err = _metric_line(p.stdout)["error"]
+    assert err["attempts"] == 2  # retried, not fatal-stopped
+    assert all(h["classification"] == "transient" for h in err["history"])
+    assert all(h["rc"] < 0 for h in err["history"])  # real signal death
+
+    p = _run("", attempts=2,
+             extra={"PADDLE_CHAOS":
+                    "bench.attempt@1=drop;bench.attempt@2=drop"})
+    assert p.returncode == 1
+    err = _metric_line(p.stdout)["error"]
+    assert err["attempts"] == 2
+    assert all(h["classification"] == "transient" for h in err["history"])
+    assert "without a JSON metric line" in err["history"][0]["stderr_tail"]

@@ -62,3 +62,68 @@ def test_fast_wait_does_not_fire():
     finally:
         paddle.set_flags({"comm_timeout_s": old})
         wd._on_timeout = None
+
+
+def test_escalation_ladder_warn_dump_abort_in_order():
+    """The ladder fires warn → dump → abort at comm_warn_fraction /
+    comm_dump_fraction / 1.0 of the wait's Deadline, each exactly once,
+    in order (ref: the staged teardown the reference spreads between
+    its watchdog log, comm-trace dump, and async-error-handling abort)."""
+    wd = CommWatchdog.instance()
+    stages = []
+    wd._on_stage = lambda stage, desc, age: stages.append((stage, age))
+    old = paddle.get_flags(["comm_timeout_s"])["comm_timeout_s"]
+    paddle.set_flags({"comm_timeout_s": 0.4})
+    try:
+        release = threading.Event()
+
+        def long_wait():
+            with watch("laddered-wait"):
+                release.wait(5.0)
+
+        t = threading.Thread(target=long_wait)
+        t.start()
+        deadline = time.time() + 4.0
+        while len(stages) < 3 and time.time() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.2)  # extra polls must not re-fire any stage
+        release.set()
+        t.join()
+        assert [s for s, _ in stages] == ["warn", "dump", "abort"], stages
+        ages = [a for _, a in stages]
+        assert ages == sorted(ages)
+        assert ages[0] >= 0.4 * 0.5  # warn not before its fraction
+        assert ages[2] >= 0.4       # abort only past the full deadline
+    finally:
+        paddle.set_flags({"comm_timeout_s": old})
+        wd._on_stage = None
+
+
+def test_caller_deadline_overrides_the_flag():
+    """watch(deadline=...) supervises under the CALLER's budget — the
+    shared-Deadline contract — instead of the global flag."""
+    from paddle_tpu.utils.retries import Deadline
+
+    wd = CommWatchdog.instance()
+    stages = []
+    wd._on_stage = lambda stage, desc, age: stages.append(stage)
+    old = paddle.get_flags(["comm_timeout_s"])["comm_timeout_s"]
+    paddle.set_flags({"comm_timeout_s": 3600.0})  # the flag says "hours"
+    try:
+        release = threading.Event()
+
+        def long_wait():
+            with watch("budgeted-wait", deadline=Deadline(0.2)):
+                release.wait(5.0)
+
+        t = threading.Thread(target=long_wait)
+        t.start()
+        deadline = time.time() + 4.0
+        while "abort" not in stages and time.time() < deadline:
+            time.sleep(0.02)
+        release.set()
+        t.join()
+        assert "abort" in stages  # fired on the 0.2s budget, not 3600s
+    finally:
+        paddle.set_flags({"comm_timeout_s": old})
+        wd._on_stage = None
