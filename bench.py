@@ -21,10 +21,16 @@ share (a healthy long run is never capped at budget/attempts), while a
 hung attempt forfeits only its slice, never the whole window — so N
 attempts plus backoff always fit inside BENCH_TOTAL_BUDGET and the
 supervisor always emits a JSON line before the driver's capture window
-closes. Transient backend failures (init errors, connection loss,
-hangs) retry with exponential backoff; real errors (compile/shape/
-import bugs) fail fast; final failure prints a structured diagnostics
-JSON line instead of a bare traceback. Knobs (env):
+closes. Before any attempt, a PREFLIGHT device probe (a child that only
+enumerates devices, killed at ~90 s) answers "is the backend even
+there?" cheaply: two consecutive probe hangs mean the tunnel is down
+and the supervisor emits its structured failure within ~5 minutes
+instead of forfeiting full attempt slices (round-5 Next #1a). Transient
+backend failures (init errors, connection loss, hangs) retry with
+exponential backoff; real errors (compile/shape/ import bugs) fail
+fast; final failure prints a structured diagnostics JSON line instead
+of a bare traceback. Knobs (env):
+BENCH_PREFLIGHT=1 (0 skips the probe), BENCH_PROBE_TIMEOUT=90 s,
 BENCH_TOTAL_BUDGET=3300 s (the whole supervisor run, retries included),
 BENCH_ATTEMPTS=5, BENCH_ATTEMPT_TIMEOUT=1800 s (per-attempt cap; the
 budget share may shrink it further), BENCH_RETRY_DELAY=5 s (doubles
@@ -76,19 +82,100 @@ def _classify(stderr_text: str, rc: int) -> str:
     return _retries.classify_text(stderr_text)
 
 
-def _last_metric_line(stdout_text: str):
-    """The child's contract is one JSON metric line; tolerate log noise
-    around it by scanning from the end."""
+def _json_lines_from_end(stdout_text: str):
+    """(line, parsed) for each JSON line of ``stdout_text``, last
+    first — children emit ONE JSON line but log noise may surround it."""
     for line in reversed(stdout_text.strip().splitlines()):
         line = line.strip()
         if not line.startswith("{"):
             continue
         try:
-            obj = json.loads(line)
+            yield line, json.loads(line)
         except ValueError:
             continue
+
+
+def _last_metric_line(stdout_text: str):
+    for line, obj in _json_lines_from_end(stdout_text):
         if isinstance(obj, dict) and "metric" in obj:
             return line
+    return None
+
+
+def _probe_child() -> None:
+    """Preflight child: enumerate devices and print one JSON line —
+    nothing else. A hung tunnel hangs HERE, inside a ~90 s kill,
+    instead of inside a full-bench attempt's slice."""
+    if os.environ.get("PADDLE_CHAOS"):
+        chaos = _load_by_path("_ptpu_chaos", "paddle_tpu/testing/chaos.py")
+        if not chaos.inject("bench.probe",
+                            index=int(os.environ.get(
+                                "BENCH_PROBE_ATTEMPT", "1"))):
+            sys.exit(0)  # dropped probe: vanishes with no JSON line
+    spec = os.environ.get("BENCH_FORCE_FAIL", "")
+    if spec.startswith("probe_hang"):
+        _, _, n = spec.partition(":")
+        if int(os.environ.get("BENCH_PROBE_ATTEMPT", "1")) < int(n or 99):
+            time.sleep(10_000)
+    import jax
+
+    devs = jax.devices()
+    print(json.dumps({
+        "probe": "ok", "n_devices": len(devs),
+        "platform": devs[0].platform,
+        "device_kind": getattr(devs[0], "device_kind", str(devs[0])),
+    }))
+
+
+def _preflight(deadline, subprocess):
+    """Device-enumeration probe before any bench attempt (round-5
+    verdict Next #1a: BENCH_r05 burned the whole driver window on one
+    hung attempt). Two consecutive ~90 s hangs mean the backend is down
+    — the supervisor can then emit its structured failure within ~5
+    minutes instead of forfeiting full attempt slices. Returns
+    (ok, probe_history, stop_reason)."""
+    if os.environ.get("BENCH_PREFLIGHT", "1") != "1":
+        return True, [], None
+    probe_cap = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
+    history = []
+    for i in (1, 2):
+        timeout_s = min(probe_cap, max(deadline.remaining(), 1.0))
+        env = dict(os.environ, BENCH_PROBE="1", BENCH_PROBE_ATTEMPT=str(i))
+        hung = False
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=timeout_s,
+            )
+            rc, err_s = proc.returncode, proc.stderr
+            ok = rc == 0 and _last_json_line(proc.stdout) is not None
+        except subprocess.TimeoutExpired:
+            rc, ok, hung = -9, False, True
+            err_s = (f"[bench supervisor] device probe {i}/2 killed after "
+                     f"{timeout_s:.0f}s (backend hang)")
+        if ok:
+            if i > 1:
+                sys.stderr.write(
+                    f"[bench supervisor] device probe recovered on try {i}\n")
+            return True, history, None
+        history.append({
+            "probe": i, "rc": rc, "hung": hung,
+            "timeout_s": round(timeout_s, 2),
+            "stderr_tail": err_s[-600:],
+        })
+        sys.stderr.write(
+            f"[bench supervisor] device probe {i}/2 failed "
+            f"(rc={rc}{', hang' if hung else ''})\n")
+    if all(h["hung"] for h in history):
+        return False, history, "preflight device probe hung twice"
+    # two fast FAILURES (not hangs): the attempt loop's transient/fatal
+    # classifier owns those — it fails fast and keeps the retry budget
+    return True, history, None
+
+
+def _last_json_line(stdout_text: str):
+    for _, obj in _json_lines_from_end(stdout_text):
+        return obj
     return None
 
 
@@ -113,6 +200,24 @@ def _supervise() -> int:
     # two in a row means the output pipeline (not the backend) is broken
     history = []
     stop_reason = "attempts exhausted"
+    probe_ok, probe_history, probe_stop = _preflight(deadline, subprocess)
+    if not probe_ok:
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": None,
+            "unit": "tokens/s",
+            "vs_baseline": None,
+            "error": {
+                "final_classification": "transient",
+                "attempts": 0,
+                "stop_reason": probe_stop,
+                "total_budget_s": total_budget,
+                "elapsed_s": round(deadline.elapsed(), 2),
+                "history": [],
+                "preflight": probe_history,
+            },
+        }))
+        return 1
     # each FUTURE attempt keeps a small reserved slice (not an equal
     # share — an equal split would cap a healthy 700s run at
     # budget/attempts and kill captures the old 1800s knob allowed):
@@ -216,6 +321,7 @@ def _supervise() -> int:
             "total_budget_s": total_budget,
             "elapsed_s": round(deadline.elapsed(), 2),
             "history": history,
+            "preflight": probe_history,
         },
     }))
     return 1
@@ -437,7 +543,9 @@ def main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_CHILD") == "1":
+    if os.environ.get("BENCH_PROBE") == "1":
+        _probe_child()
+    elif os.environ.get("BENCH_CHILD") == "1":
         main()
     else:
         sys.exit(_supervise())

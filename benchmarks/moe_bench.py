@@ -12,6 +12,12 @@ Methodology: K train steps (fwd+bwd+SGD) in ONE lax.scan dispatch via
 jit.to_static multi_step, run-length differencing to cancel tunnel RTT
 (same as bench.py). Prints one JSON line per row.
 
+``--cpu`` runs a TIMED sort-vs-einsum comparison at E=32 on the CPU
+backend (sized up from the default off-TPU mechanics check, which is
+too small to time): one measured point for the claim that sort
+dispatch's O(N·k·H) traffic beats the dense mask's O(N·E·C·H) as E
+grows — the TPU sweep stays the real evidence once the tunnel is back.
+
 ref: python/paddle/incubate/distributed/models/moe/moe_layer.py:263
 (the reference's NCCL all-to-all MoE layer; no published perf numbers).
 """
@@ -71,7 +77,7 @@ def build_model(mode, h, f_dense, e, cf, layers, dispatch):
     return Stack()
 
 
-def measure(model, batch_tokens, h, steps, on_tpu):
+def measure(model, batch_tokens, h, steps, on_tpu, ks=None):
     import jax
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as popt
@@ -96,7 +102,7 @@ def measure(model, batch_tokens, h, steps, on_tpu):
         model.bfloat16()
 
     np.asarray(compiled(x)._data)  # create opt state / carry structure
-    k1, k2 = (4, steps) if on_tpu else (1, 3)
+    k1, k2 = ks if ks is not None else ((4, steps) if on_tpu else (1, 3))
     np.asarray(compiled.multi_step(x, steps=k1)._data)
     np.asarray(compiled.multi_step(x, steps=k2)._data)
 
@@ -113,7 +119,44 @@ def measure(model, batch_tokens, h, steps, on_tpu):
     return batch_tokens * (k2 - k1) / dt, 1000 * dt / (k2 - k1)
 
 
+def cpu_dispatch_point():
+    """The measured CPU point for the O(N·k·H)-vs-O(N·E·C·H) dispatch
+    claim (round-5 verdict Next #8): einsum vs sort at E=32, sized so
+    the timed region is dominated by dispatch work, not noise."""
+    import jax
+
+    dev = jax.devices()[0]
+    H, F, TOKENS, LAYERS = 128, 512, 4096, 2
+    E, CF = 32, 1.25
+    results = {}
+    for dispatch in ("einsum", "sort"):
+        model = build_model("moe", H, F, E, CF, LAYERS, dispatch)
+        tps, step_ms = measure(model, TOKENS, H, 0, False, ks=(2, 8))
+        results[dispatch] = (tps, step_ms)
+        print(json.dumps({
+            "row": "moe_cpu_point", "e": E, "cf": CF, "dispatch": dispatch,
+            "tokens_per_sec": round(tps, 1), "step_ms": round(step_ms, 3),
+            "h": H, "f_dense": F, "tokens": TOKENS, "layers": LAYERS,
+            "device": getattr(dev, "device_kind", str(dev)),
+        }), flush=True)
+    print(json.dumps({
+        "row": "moe_cpu_sort_vs_einsum_speedup", "e": E,
+        "value": round(results["sort"][0] / results["einsum"][0], 3),
+        "unit": "x (sort tokens/s / einsum tokens/s)",
+        "sort_faster": results["sort"][0] > results["einsum"][0],
+    }), flush=True)
+
+
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="timed sort vs einsum dispatch at E=32 on CPU")
+    if ap.parse_args().cpu:
+        cpu_dispatch_point()
+        return
+
     import jax
 
     dev = jax.devices()[0]

@@ -1,16 +1,29 @@
-"""Sustained continuous-batching throughput at fixed HBM.
+"""Continuous-batching serving benchmarks: sustained throughput at
+fixed HBM, and the mixed-prompt-length latency comparison chunked
+prefill exists for.
 
-The workload paged KV exists for (BASELINE.md serving-capacity row
-proved the memory win; this measures the serving LOOP): requests with
-mixed prompt lengths arrive continuously, finish at different times,
-and the engine recycles their blocks into new admissions — report
-sustained decode tokens/s and slot occupancy.
+Part 1 (sustained): requests with mixed prompt lengths arrive
+continuously, finish at different times, and the engine recycles their
+blocks into new admissions — report sustained decode tokens/s and slot
+occupancy (the workload paged KV exists for; BASELINE.md
+serving-capacity row proved the memory win, this measures the LOOP).
+
+Part 2 (mixed 128–4096): the same engine serves a workload whose
+prompt lengths span 128–4096 under BOTH prefill policies —
+whole-prompt (one padded prefill stalls every in-flight decode for the
+full prompt) and chunked (``prefill_chunk`` tokens per step under
+``max_num_batched_tokens``, decode-priority). Reports time-to-first-
+token and p50/p99 inter-token latency per mode; the acceptance claim
+is chunked p99 ITL strictly better than whole-prompt.
 
     PYTHONPATH="/root/repo:$PYTHONPATH" python benchmarks/serving_throughput.py
+    # --sustained-only / --mixed-only to run one part
 
 ref: python/paddle/incubate/nn/functional/block_multihead_attention.py
-(the reference's serving kernel; no published numbers in-tree).
+(the reference's serving kernel; no published numbers in-tree),
+Yu et al. OSDI'22 (Orca), Agrawal et al. OSDI'24 (Sarathi-Serve).
 """
+import argparse
 import json
 import time
 
@@ -21,31 +34,21 @@ from paddle_tpu.inference.serving import ContinuousBatchingEngine
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
 
-def main():
-    import jax
+def _pct(xs, p):
+    return round(float(np.percentile(xs, p)) * 1000, 2) if xs else None
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
+
+def sustained(model, config, on_tpu, dev):
     if on_tpu:
-        config = LlamaConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-            num_hidden_layers=8, num_attention_heads=16,
-            num_key_value_heads=16, max_position_embeddings=2048)
         B, MAX_LEN, BS, PAD = 64, 2048, 64, 512
         NUM_BLOCKS = B * (640 // BS) + 16  # ~640 live tokens/seq budget
         N_REQ, GEN = 192, 128
         prompt_lens = (256, 384, 512)
     else:  # mechanics check
-        config = LlamaConfig.tiny()
         B, MAX_LEN, BS, PAD = 4, 64, 8, 16
         NUM_BLOCKS = 4 * 4 + 2
         N_REQ, GEN = 12, 8
         prompt_lens = (5, 9, 14)
-
-    paddle.seed(0)
-    model = LlamaForCausalLM(config)
-    if on_tpu:
-        model.bfloat16()
 
     rng = np.random.RandomState(0)
     eng = ContinuousBatchingEngine(
@@ -82,7 +85,113 @@ def main():
             "steps": eng.steps, "wall_s": round(dt, 2),
             "device": getattr(dev, "device_kind", str(dev)),
         },
-    }))
+    }), flush=True)
+
+
+def _run_mixed_mode(model, config, *, chunked, B, MAX_LEN, BS, PAD, CHUNK,
+                    N_REQ, GEN, prompt_lens):
+    kw = dict(max_batch=B, max_len=MAX_LEN, block_size=BS,
+              num_blocks=B * (-(-MAX_LEN // BS)) + 4, decode_chunk=1)
+    if chunked:
+        kw.update(prefill_chunk=CHUNK)  # budget defaults to B + CHUNK
+    else:
+        kw.update(prompt_pad=PAD)
+    eng = ContinuousBatchingEngine(model, **kw)
+    # compile both phases outside the measured workload
+    eng.add_request("warm", np.ones(1, np.int32), max_new_tokens=2)
+    eng.run()
+
+    rng = np.random.RandomState(1)
+    t0 = time.perf_counter()
+    for i in range(N_REQ):
+        plen = int(prompt_lens[i % len(prompt_lens)])
+        eng.add_request(i, rng.randint(0, config.vocab_size, (plen,)),
+                        max_new_tokens=GEN)
+    done = eng.run()
+    wall = time.perf_counter() - t0
+    reqs = [done[i] for i in range(N_REQ)]
+    assert all(r.status == "ok" for r in reqs)
+    ttfts = [r.ttft() for r in reqs]
+    itls = [d for r in reqs for d in r.inter_token_latencies()]
+    toks = sum(len(r.out) for r in reqs)
+    return {
+        "mode": "chunked" if chunked else "whole_prompt",
+        "ttft_ms_p50": _pct(ttfts, 50), "ttft_ms_p99": _pct(ttfts, 99),
+        "itl_ms_p50": _pct(itls, 50), "itl_ms_p99": _pct(itls, 99),
+        "tokens_per_sec": round(toks / wall, 1),
+        "wall_s": round(wall, 2), "steps": eng.steps,
+        "max_step_tokens": eng.max_step_tokens,
+        "prefill_chunk": CHUNK if chunked else None,
+        "max_num_batched_tokens": eng.max_num_batched_tokens,
+        "prompt_pad": None if chunked else PAD,
+    }
+
+
+def mixed(model, config, on_tpu, dev):
+    """Mixed 128–4096 prompt lengths, whole-prompt vs chunked."""
+    if on_tpu:
+        B, MAX_LEN, BS, PAD, CHUNK = 16, 4352, 64, 4096, 512
+        N_REQ, GEN = 48, 64
+    else:
+        B, MAX_LEN, BS, PAD, CHUNK = 2, 4160, 64, 4096, 256
+        N_REQ, GEN = 6, 12
+    prompt_lens = (128, 4096, 512, 2048)
+
+    rows = []
+    for chunked in (False, True):
+        row = _run_mixed_mode(
+            model, config, chunked=chunked, B=B, MAX_LEN=MAX_LEN, BS=BS,
+            PAD=PAD, CHUNK=CHUNK, N_REQ=N_REQ, GEN=GEN,
+            prompt_lens=prompt_lens)
+        rows.append(row)
+        print(json.dumps({
+            "metric": "serving_mixed_prefill_latency",
+            "value": row["itl_ms_p99"], "unit": "ms (p99 ITL)",
+            "extra": {**row, "requests": N_REQ, "gen_per_req": GEN,
+                      "max_batch": B, "prompt_lens": list(prompt_lens),
+                      "device": getattr(dev, "device_kind", str(dev))},
+        }), flush=True)
+    whole, chunk = rows
+    print(json.dumps({
+        "metric": "serving_mixed_itl_p99_speedup",
+        "value": round(whole["itl_ms_p99"] / chunk["itl_ms_p99"], 2),
+        "unit": "x (whole-prompt p99 ITL / chunked p99 ITL)",
+        "extra": {
+            "chunked_p99_better":
+                chunk["itl_ms_p99"] < whole["itl_ms_p99"],
+            "ttft_ms_p50_whole": whole["ttft_ms_p50"],
+            "ttft_ms_p50_chunked": chunk["ttft_ms_p50"],
+        },
+    }), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sustained-only", action="store_true")
+    ap.add_argument("--mixed-only", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        config = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=4608)
+    else:
+        config = LlamaConfig.tiny(max_position_embeddings=4608)
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(config)
+    if on_tpu:
+        model.bfloat16()
+
+    if not args.mixed_only:
+        sustained(model, config, on_tpu, dev)
+    if not args.sustained_only:
+        mixed(model, config, on_tpu, dev)
 
 
 if __name__ == "__main__":

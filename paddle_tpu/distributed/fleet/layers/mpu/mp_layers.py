@@ -50,7 +50,9 @@ def _constrain(t, mesh, spec):
     from paddle_tpu.base import tape
 
     def f(x):
-        am = jax.sharding.get_abstract_mesh()
+        from paddle_tpu.utils.jax_compat import get_abstract_mesh
+
+        am = get_abstract_mesh()
         use = am if (am is not None and not am.empty) else mesh
         return jax.lax.with_sharding_constraint(
             x, jax.sharding.NamedSharding(use, spec)

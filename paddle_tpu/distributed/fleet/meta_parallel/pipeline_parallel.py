@@ -350,12 +350,12 @@ class PipelineLayer(nn.Layer):
                 stage = lax.axis_index("pp")
                 # VMA: microbatches and the carried state/outputs vary over
                 # pp (each stage computes different values); mark them so
-                # the scan carry typechecks under check_vma (pcast is the
-                # non-deprecated spelling, pvary the fallback on older jax)
-                if hasattr(lax, "pcast"):
-                    local_xs = lax.pcast(local_xs, ("pp",), to="varying")
-                else:
-                    local_xs = lax.pvary(local_xs, ("pp",))
+                # the scan carry typechecks under check_vma
+                # (version-bridged in utils.jax_compat; identity on
+                # pre-VMA jax)
+                from paddle_tpu.utils.jax_compat import pvary
+
+                local_xs = pvary(local_xs, ("pp",))
                 state = jnp.zeros_like(local_xs[0])
                 outputs = jnp.zeros_like(local_xs)
                 SV = S * V
@@ -423,7 +423,9 @@ class PipelineLayer(nn.Layer):
                 size > 1 and name not in manual
                 for name, size in dict(mesh.shape).items()
             )
-            return jax.shard_map(
+            from paddle_tpu.utils.jax_compat import shard_map as _shard_map
+
+            return _shard_map(
                 spmd, mesh=mesh, in_specs=in_specs, out_specs=x_spec,
                 axis_names=manual, check_vma=partial,
             )(xs, *stacked)

@@ -181,8 +181,10 @@ def _compiled(kind: str, shape, dtype, extra):
 
     # check_vma=False: all_gather/ppermute outputs ARE replicated but
     # the static varying-manual-axes check cannot infer it
-    fn = jax.shard_map(body, mesh=mesh, in_specs=spec,
-                       out_specs=PartitionSpec(), check_vma=False)
+    from ..utils.jax_compat import shard_map as _shard_map
+
+    fn = _shard_map(body, mesh=mesh, in_specs=spec,
+                    out_specs=PartitionSpec(), check_vma=False)
     return jax.jit(fn)
 
 

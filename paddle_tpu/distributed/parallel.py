@@ -124,7 +124,9 @@ def shard_map(fn, mesh=None, in_specs=None, out_specs=None, check_vma=False):
             is_leaf=lambda x: isinstance(x, Tensor),
         )
 
-    smapped = jax.shard_map(
+    from ..utils.jax_compat import shard_map as _shard_map
+
+    smapped = _shard_map(
         wrapped, mesh=mesh,
         in_specs=in_specs if in_specs is not None else P(mesh.axis_names[0]),
         out_specs=out_specs if out_specs is not None else P(mesh.axis_names[0]),

@@ -14,16 +14,36 @@ TPU-native design (single compiled program per phase, static shapes):
   ``cache_len`` [B] (the scalar-or-[B] contract of
   ops/paged_attention.py). Slot membership changes only change the
   TABLE CONTENTS and lengths — never shapes — so XLA compiles once.
-- ONE prefill program (prompts padded to ``prompt_pad``) admits a
-  request into a slot: rows other than the admitted one have their
-  table pointed entirely at a reserved TRASH block, so their scattered
-  writes land in a sacrificial page and live sequences are untouched
-  (the positions a padded prompt writes past its real length are
-  overwritten by later decode steps before they are ever attended).
+- ONE prefill program per static width admits prompt tokens into a
+  slot: rows not participating have their table pointed entirely at a
+  reserved TRASH block, so their scattered writes land in a sacrificial
+  page and live sequences are untouched (the positions a padded prompt
+  writes past its real length are overwritten by later decode steps
+  before they are ever attended).
 - ``BlockManager`` (ops/paged_attention.py) is the allocator; eviction
   returns a sequence's blocks to the free list, and the next admission
   may reuse them immediately — correctness is guaranteed by the tables
   alone, which is what the eviction test pins down.
+
+Two prefill policies:
+
+- Whole-prompt (default, ``prefill_chunk=None``): admission runs ONE
+  padded prefill of width ``prompt_pad`` — the Orca-style baseline. A
+  long prompt stalls every in-flight decode for its full prefill.
+- CHUNKED (``prefill_chunk=K``, Sarathi-Serve-style): prompts split
+  into K-token chunks, each chunk writing its KV at the slot's current
+  ``cache_len`` offset through the same block tables (the compiled
+  prefill program is width-polymorphic via retrace — one cached XLA
+  program per chunk width, nonzero per-row offsets drive RoPE and the
+  causal mask). Every engine step schedules at most
+  ``max_num_batched_tokens`` REAL tokens: the running decode batch
+  first (decode-priority, so inter-token latency stays flat), then
+  prefill chunks round-robin across prefilling slots for fairness.
+  Admission switches from whole-prompt-fits-``prompt_pad`` to
+  token-budget pacing + block availability (full prompt+budget block
+  reservation up front, so a mid-prefill slot can never deadlock on
+  allocation). Deadline eviction works mid-prefill: a partially
+  prefilled slot's blocks recycle immediately.
 
 Greedy decoding (temperature 0) — matching models.generation.generate's
 default — so engine outputs are token-identical to isolated generate()
@@ -31,6 +51,7 @@ runs, which is the correctness contract the tests assert.
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -52,10 +73,13 @@ class GenRequest:
     """One generation request (ref: the reference's serving request —
     prompt ids + budget). ``deadline`` is the request's wall-clock
     budget: admission rejects it once expired, and an in-flight slot is
-    EVICTED when it expires mid-decode — one stuck/abandoned client can
-    never pin a slot (its blocks recycle immediately). ``status`` is
-    "ok" for a normally finished request, "expired" for a rejected or
-    evicted one (whatever tokens were produced stay in ``out``)."""
+    EVICTED when it expires mid-decode or MID-PREFILL — one
+    stuck/abandoned client can never pin a slot (its blocks recycle
+    immediately). ``status`` is "ok" for a normally finished request,
+    "expired" for a rejected or evicted one (whatever tokens were
+    produced stay in ``out``). ``times[i]`` is the perf_counter stamp
+    when ``out[i]`` was produced; with ``t_submit`` it gives
+    time-to-first-token and inter-token latencies for free."""
 
     req_id: object
     prompt: np.ndarray  # [s] int
@@ -63,22 +87,36 @@ class GenRequest:
     out: List[int] = field(default_factory=list)
     deadline: Optional[Deadline] = None
     status: str = "ok"
+    t_submit: float = 0.0
+    times: List[float] = field(default_factory=list)
 
     def expired(self) -> bool:
         return self.deadline is not None and self.deadline.expired()
 
+    def ttft(self) -> Optional[float]:
+        """Seconds from submission to the first token (None if none)."""
+        return self.times[0] - self.t_submit if self.times else None
+
+    def inter_token_latencies(self) -> List[float]:
+        return [b - a for a, b in zip(self.times, self.times[1:])]
+
 
 class _Slot:
-    __slots__ = ("req", "cache_len", "remaining")
+    __slots__ = ("req", "cache_len", "remaining", "prefill_pos")
 
     def __init__(self):
         self.req: Optional[GenRequest] = None
         self.cache_len = 0
         self.remaining = 0
+        self.prefill_pos = 0  # prompt tokens written to KV so far
 
     @property
     def active(self):
         return self.req is not None
+
+    @property
+    def prefilling(self):
+        return self.req is not None and self.prefill_pos < self.req.prompt.size
 
 
 class ContinuousBatchingEngine:
@@ -86,17 +124,32 @@ class ContinuousBatchingEngine:
                  block_size: int = 64, num_blocks: int,
                  prompt_pad: Optional[int] = None,
                  eos_token_id: Optional[int] = None,
-                 decode_chunk: int = 1):
+                 decode_chunk: int = 1,
+                 prefill_chunk: Optional[int] = None,
+                 max_num_batched_tokens: Optional[int] = None):
         """``num_blocks`` fixes the HBM budget (the pool allocates one
         extra trash block); ``max_len`` bounds any sequence's positions
         (tables carry ceil(max_len/block_size) slots per row);
-        ``prompt_pad`` is the static prefill width (default: one block).
+        ``prompt_pad`` is the static whole-prompt prefill width
+        (default: one block; unused once chunking is on).
 
         ``decode_chunk=K`` scans K decode steps in ONE device dispatch
         (lax.scan; tokens + eos state carried on device — the
         generate(decode_chunk=K) idiom) whenever every active slot has
         at least K tokens of budget left; otherwise the engine falls
-        back to single steps. Admissions happen between chunks.
+        back to single steps. Admissions happen between chunks. With a
+        token budget the scan additionally requires no slot to be
+        mid-prefill and active*K to fit the budget.
+
+        ``prefill_chunk=C`` turns on chunked prefill: prompts (up to
+        ``max_len - max_new_tokens``, no longer capped by
+        ``prompt_pad``) are fed C tokens per scheduled chunk.
+        ``max_num_batched_tokens`` caps the REAL tokens any engine step
+        processes (default ``max_batch + prefill_chunk``: one full
+        decode round plus one chunk). It must cover a full decode round
+        (>= max_batch — the decode dispatch is indivisible) and one
+        chunk (>= prefill_chunk — otherwise a lone prefill could never
+        be scheduled).
         """
         self.model = model
         self.B = int(max_batch)
@@ -105,10 +158,39 @@ class ContinuousBatchingEngine:
         self.prompt_pad = int(prompt_pad or block_size)
         if self.prompt_pad > self.max_len:
             raise ValueError("prompt_pad exceeds max_len")
+        # generation parity: generate() refuses positions beyond the
+        # model's limit — the engine serves the same contract instead
+        # of silently extrapolating RoPE past it
+        limit = getattr(getattr(model, "config", None),
+                        "max_position_embeddings", None)
+        if limit is not None and self.max_len > limit:
+            raise ValueError(
+                f"max_len ({self.max_len}) exceeds the model's "
+                f"max_position_embeddings ({limit})")
         self.eos_token_id = eos_token_id
         self.manager = BlockManager(num_blocks, block_size)
         self._trash = num_blocks  # reserved sacrificial pool row
         self.max_blocks_per_seq = -(-self.max_len // block_size)
+
+        self.prefill_chunk = None if prefill_chunk is None \
+            else int(prefill_chunk)
+        if self.prefill_chunk is not None:
+            if not 0 < self.prefill_chunk <= self.max_len:
+                raise ValueError(
+                    f"prefill_chunk must be in [1, max_len={self.max_len}], "
+                    f"got {self.prefill_chunk}")
+            if max_num_batched_tokens is None:
+                max_num_batched_tokens = self.B + self.prefill_chunk
+            self.max_num_batched_tokens = int(max_num_batched_tokens)
+            floor = max(self.B, self.prefill_chunk)
+            if self.max_num_batched_tokens < floor:
+                raise ValueError(
+                    f"max_num_batched_tokens={self.max_num_batched_tokens} "
+                    f"must be >= max(max_batch, prefill_chunk)={floor}: a "
+                    "decode round is one indivisible dispatch and a lone "
+                    "prefill must be able to schedule one chunk")
+        else:
+            self.max_num_batched_tokens = None  # whole-prompt: unbudgeted
 
         was_training = model.training
         model.eval()
@@ -130,8 +212,12 @@ class ContinuousBatchingEngine:
         self._decode_jit = None
         self._chunk_jit = None
         self.decode_chunk = max(1, int(decode_chunk))
+        self._rr = 0  # round-robin start for chunk scheduling fairness
         self.steps = 0
         self.decode_tokens = 0
+        self.prefill_tokens = 0
+        self.last_step_tokens = 0
+        self.max_step_tokens = 0
 
     # -- compiled phases -------------------------------------------------
     def _caches_from(self, pools, tables_arr):
@@ -214,25 +300,37 @@ class ContinuousBatchingEngine:
                 p._data = a
 
     # -- public API ------------------------------------------------------
+    @property
+    def chunked(self) -> bool:
+        return self.prefill_chunk is not None
+
     def add_request(self, req_id, prompt, max_new_tokens: int = 32,
                     deadline=None):
         """``deadline``: seconds or a ``Deadline`` — the request's total
         budget (queue wait included). None = no deadline."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        if prompt.size == 0 or prompt.size > self.prompt_pad:
+        if prompt.size == 0:
+            raise ValueError("prompt length 0 not in [1, ...]")
+        if not self.chunked and prompt.size > self.prompt_pad:
             raise ValueError(
                 f"prompt length {prompt.size} not in [1, prompt_pad="
-                f"{self.prompt_pad}]")
+                f"{self.prompt_pad}] (enable prefill_chunk to serve "
+                "prompts beyond the whole-prompt pad)")
         if prompt.size + max_new_tokens > self.max_len:
             raise ValueError("prompt + max_new_tokens exceeds max_len")
         dl = None if deadline is None else Deadline.coerce(deadline)
-        req = GenRequest(req_id, prompt, max_new_tokens, deadline=dl)
+        req = GenRequest(req_id, prompt, max_new_tokens, deadline=dl,
+                         t_submit=time.perf_counter())
         if self._blocks_needed(req) > self.manager.num_blocks:
             raise ValueError(
                 f"request needs {self._blocks_needed(req)} blocks but the "
                 f"pool only has {self.manager.num_blocks} — it could never "
                 "be admitted")
         self._queue.append(req)
+
+    def _append_token(self, req: GenRequest, tok: int):
+        req.out.append(tok)
+        req.times.append(time.perf_counter())
 
     def _expire(self, req: GenRequest):
         req.status = "expired"
@@ -241,7 +339,9 @@ class ContinuousBatchingEngine:
     def _evict_expired(self):
         """Reclaim slots whose request's deadline passed: free the
         blocks, point the row at the trash block, surface the request as
-        completed-with-status-expired."""
+        completed-with-status-expired. Works mid-prefill too — a
+        partially prefilled slot's blocks recycle the same way (the
+        trash table makes the half-written KV unreachable)."""
         for slot_idx, slot in enumerate(self._slots):
             if slot.active and slot.req.expired():
                 self.manager.free_sequence(slot.req.req_id)
@@ -253,15 +353,26 @@ class ContinuousBatchingEngine:
     def num_active(self):
         return sum(s.active for s in self._slots)
 
-    def _blocks_needed(self, req):
-        total = max(int(req.prompt.size) + req.max_new_tokens,
-                    self.prompt_pad)
-        return -(-total // self.block_size)
+    @property
+    def num_prefilling(self):
+        return sum(s.prefilling for s in self._slots)
 
-    def _admit(self):
-        """Fill free slots from the queue while blocks last; one padded
-        prefill per admission (per-slot isolation via the trash table).
-        """
+    def _blocks_needed(self, req):
+        if self.chunked:
+            total = int(req.prompt.size) + req.max_new_tokens
+        else:
+            total = max(int(req.prompt.size) + req.max_new_tokens,
+                        self.prompt_pad)
+        return self.manager.blocks_for(total)
+
+    def _admit(self) -> int:
+        """Fill free slots from the queue while blocks last. Whole-
+        prompt mode runs one padded prefill per admission (per-slot
+        isolation via the trash table); chunked mode only binds the
+        slot and reserves its full block budget — the token-budget
+        scheduler feeds the prompt in chunks. Returns the number of
+        real tokens processed (whole-prompt admissions only)."""
+        used = 0
         for slot_idx, slot in enumerate(self._slots):
             # admission rejects requests whose budget already expired
             # while queued (the client gave up; don't burn a prefill)
@@ -270,19 +381,24 @@ class ContinuousBatchingEngine:
             if not self._queue or slot.active:
                 continue
             req = self._queue[0]
-            if self._blocks_needed(req) > self.manager.free_blocks:
+            total = self._blocks_needed(req) * self.block_size
+            if not self.manager.can_allocate(req.req_id, total):
                 break  # head-of-line; keep FIFO fairness
             self._queue.pop(0)
-            blocks = self.manager.allocate(
-                req.req_id,
-                max(req.prompt.size + req.max_new_tokens, self.prompt_pad))
+            blocks = self.manager.allocate(req.req_id, total)
             row = np.full((self.max_blocks_per_seq,), self._trash, np.int32)
             row[: len(blocks)] = blocks
             self._tables[slot_idx] = row
             slot.req = req
-            slot.cache_len = int(req.prompt.size)
             slot.remaining = req.max_new_tokens
 
+            if self.chunked:
+                slot.prefill_pos = 0
+                slot.cache_len = 0
+                continue
+
+            slot.prefill_pos = int(req.prompt.size)
+            slot.cache_len = int(req.prompt.size)
             # isolated prefill: only this row's table points at real
             # blocks; every other row scatters into the trash block
             iso = np.full_like(self._tables, self._trash)
@@ -295,10 +411,13 @@ class ContinuousBatchingEngine:
                 self._prefill_jit, self._pools, jnp.asarray(ids),
                 jnp.asarray(iso), jnp.zeros((self.B,), jnp.int32))
             first = int(np.asarray(toks)[slot_idx, req.prompt.size - 1])
-            req.out.append(first)
+            used += int(req.prompt.size)
+            self.prefill_tokens += int(req.prompt.size)
+            self._append_token(req, first)
             slot.remaining -= 1
             if self._finish_if_done(slot_idx, first):
                 continue
+        return used
 
     def _finish_if_done(self, slot_idx, last_tok) -> bool:
         slot = self._slots[slot_idx]
@@ -312,51 +431,162 @@ class ContinuousBatchingEngine:
             slot.req = None
         return done
 
+    def _schedule_prefill(self, budget_left: int) -> Dict[int, int]:
+        """Round-robin chunk scheduler: starting at the fairness
+        pointer, grant each prefilling slot one ``prefill_chunk``-sized
+        bite of its remaining prompt per pass until the leftover budget
+        cannot cover the next bite. Returns {slot_idx: real tokens}."""
+        chunk = self.prefill_chunk
+        order = sorted(
+            (i for i, s in enumerate(self._slots) if s.prefilling),
+            key=lambda i: (i - self._rr) % self.B)
+        sched = {i: 0 for i in order}
+        used, progress = 0, True
+        while progress:
+            progress = False
+            for i in order:
+                slot = self._slots[i]
+                rem = slot.req.prompt.size - slot.prefill_pos - sched[i]
+                if rem <= 0:
+                    continue
+                real = min(chunk, int(rem))
+                if used + real > budget_left:
+                    return {i: n for i, n in sched.items() if n}
+                sched[i] += real
+                used += real
+                progress = True
+        return {i: n for i, n in sched.items() if n}
+
+    def _prefill_step(self, budget_left: int) -> int:
+        """Execute this step's scheduled prefill chunks: one batched
+        dispatch per ROUND (every slot with work left advances one
+        chunk per round — multiple rounds when the budget grants a slot
+        several chunks). Each chunk writes its KV at the slot's current
+        ``cache_len`` offset through the slot's own block-table row;
+        non-participating rows are isolated via the trash table. The
+        slot whose final chunk lands also gets its first generated
+        token from that chunk's logits — no extra dispatch."""
+        sched = self._schedule_prefill(budget_left)
+        if not sched:
+            return 0
+        chunk = self.prefill_chunk
+        used = 0
+        if self._prefill_jit is None:
+            self._build_jits()
+        while sched:
+            ids = np.zeros((self.B, chunk), np.int32)
+            cl = np.zeros((self.B,), np.int32)
+            iso = np.full_like(self._tables, self._trash)
+            round_rows = []
+            for i in list(sched):
+                slot = self._slots[i]
+                start = slot.prefill_pos
+                real = min(chunk, slot.req.prompt.size - start, sched[i])
+                ids[i, :real] = slot.req.prompt[start:start + real]
+                cl[i] = start
+                iso[i] = self._tables[i]
+                round_rows.append((i, start, real))
+                sched[i] -= real
+                if sched[i] <= 0:
+                    del sched[i]
+            toks, self._pools = self._run_jit(
+                self._prefill_jit, self._pools, jnp.asarray(ids),
+                jnp.asarray(iso), jnp.asarray(cl))
+            toks = np.asarray(toks)  # [B, chunk]
+            for i, start, real in round_rows:
+                slot = self._slots[i]
+                slot.prefill_pos = start + real
+                slot.cache_len = slot.prefill_pos
+                self.prefill_tokens += real
+                used += real
+                if slot.prefill_pos == slot.req.prompt.size:
+                    first = int(toks[i, real - 1])
+                    self._append_token(slot.req, first)
+                    slot.remaining -= 1
+                    self._finish_if_done(i, first)
+        self._rr = (self._rr + 1) % self.B
+        return used
+
+    def _decode_step(self, budget_left: Optional[int]) -> int:
+        """One decode round for every decode-phase slot (single step or
+        a ``decode_chunk`` scan). Returns real tokens scheduled."""
+        active = [i for i, s in enumerate(self._slots)
+                  if s.active and not s.prefilling]
+        if not active:
+            return 0
+        if self._decode_jit is None:
+            self._build_jits()
+        tok = np.zeros((self.B,), np.int32)
+        cl = np.zeros((self.B,), np.int32)
+        for i in active:
+            slot = self._slots[i]
+            tok[i] = slot.req.out[-1]
+            cl[i] = slot.cache_len
+        tables = self._tables
+        if self.num_prefilling:
+            # the decode program writes EVERY row's (tok, cl) — rows
+            # mid-prefill hold real tables now, so their lane's dummy
+            # write (token 0 at position 0) would corrupt the KV their
+            # first chunk just laid down; point them at the trash block
+            # for this dispatch (inactive rows are already trashed)
+            tables = self._tables.copy()
+            for i, s in enumerate(self._slots):
+                if s.prefilling:
+                    tables[i] = self._trash
+        k = self.decode_chunk
+        scan_ok = (
+            k > 1
+            and min(self._slots[i].remaining for i in active) >= k
+            # under a token budget the K-step scan must fit it, and a
+            # mid-prefill slot must not be starved for K steps
+            and (budget_left is None
+                 or (len(active) * k <= budget_left
+                     and self.num_prefilling == 0)))
+        if scan_ok:
+            finished = np.ones((self.B,), bool)
+            finished[active] = False
+            toks, self._pools = self._run_jit(
+                self._chunk_jit, self._pools, jnp.asarray(tok),
+                jnp.asarray(tables), jnp.asarray(cl),
+                jnp.asarray(finished))
+            toks = np.asarray(toks)  # [K, B]
+        else:
+            nxt, self._pools = self._run_jit(
+                self._decode_jit, self._pools, jnp.asarray(tok),
+                jnp.asarray(tables), jnp.asarray(cl))
+            toks = np.asarray(nxt)[None]  # [1, B]
+        for i in active:
+            slot = self._slots[i]
+            for j in range(toks.shape[0]):
+                t = int(toks[j, i])
+                self._append_token(slot.req, t)
+                slot.cache_len += 1
+                slot.remaining -= 1
+                self.decode_tokens += 1
+                if self._finish_if_done(i, t):
+                    break
+        return len(active) * toks.shape[0]
+
     def step(self):
-        """One engine iteration: evict expired slots, admit, then one
-        decode step for every active slot. Returns the requests
-        completed this iteration (expired ones included, with
+        """One engine iteration: evict expired slots, admit, then the
+        token-budgeted work — the decode round first (decode-priority
+        keeps inter-token latency flat), leftover budget spent on
+        prefill chunks round-robin. Whole-prompt mode keeps the legacy
+        order (prefill inside admission, then decode). Returns the
+        requests completed this iteration (expired ones included, with
         ``status == "expired"``)."""
         if not _chaos.inject("serving.step"):
             return []  # dropped engine iteration: no work this tick
         before = set(self._completed)
         self._evict_expired()
-        self._admit()
-        active = [i for i, s in enumerate(self._slots) if s.active]
-        if active:
-            if self._decode_jit is None:
-                self._build_jits()
-            tok = np.zeros((self.B,), np.int32)
-            cl = np.zeros((self.B,), np.int32)
-            for i in active:
-                slot = self._slots[i]
-                tok[i] = slot.req.out[-1]
-                cl[i] = slot.cache_len
-            k = self.decode_chunk
-            if k > 1 and min(self._slots[i].remaining for i in active) >= k:
-                finished = np.ones((self.B,), bool)
-                finished[active] = False
-                toks, self._pools = self._run_jit(
-                    self._chunk_jit, self._pools, jnp.asarray(tok),
-                    jnp.asarray(self._tables), jnp.asarray(cl),
-                    jnp.asarray(finished))
-                toks = np.asarray(toks)  # [K, B]
-            else:
-                nxt, self._pools = self._run_jit(
-                    self._decode_jit, self._pools, jnp.asarray(tok),
-                    jnp.asarray(self._tables), jnp.asarray(cl))
-                toks = np.asarray(nxt)[None]  # [1, B]
-            for i in active:
-                slot = self._slots[i]
-                for j in range(toks.shape[0]):
-                    t = int(toks[j, i])
-                    slot.req.out.append(t)
-                    slot.cache_len += 1
-                    slot.remaining -= 1
-                    self.decode_tokens += 1
-                    if self._finish_if_done(i, t):
-                        break
+        used = self._admit()
+        budget = self.max_num_batched_tokens
+        used += self._decode_step(None if budget is None else budget - used)
+        if self.chunked:
+            used += self._prefill_step(budget - used)
         self.steps += 1
+        self.last_step_tokens = used
+        self.max_step_tokens = max(self.max_step_tokens, used)
         return [self._completed[r] for r in set(self._completed) - before]
 
     def run(self, max_steps: int = 100_000) -> Dict[object, GenRequest]:

@@ -33,7 +33,10 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() exact zero
                  # without inf-inf = nan hazards in the masked rows
 
-_SEM = pltpu.GridDimensionSemantics
+# newer jax exposes the dimension-semantics enum; older releases hang
+# PARALLEL/ARBITRARY directly off the pltpu module — same attribute
+# names either way, so the module doubles as the enum
+_SEM = getattr(pltpu, "GridDimensionSemantics", pltpu)
 
 
 def _block(size: int) -> int:

@@ -85,6 +85,18 @@ class BlockManager:
     def free_blocks(self) -> int:
         return len(self._free)
 
+    def blocks_for(self, num_tokens: int) -> int:
+        """Blocks needed to hold ``num_tokens`` positions (ceil)."""
+        return -(-int(num_tokens) // self.block_size)
+
+    def can_allocate(self, seq_id, num_tokens: int) -> bool:
+        """Admission probe: would ``allocate(seq_id, num_tokens)``
+        succeed right now? (Counts blocks the sequence already owns —
+        the serving engine's block-availability admission test, checked
+        WITHOUT mutating the free list.)"""
+        owned = len(self._owned.get(seq_id, []))
+        return self.blocks_for(num_tokens) - owned <= len(self._free)
+
     def allocate(self, seq_id, num_tokens: int) -> List[int]:
         """Ensure seq_id owns enough blocks for num_tokens; returns the
         full block list."""

@@ -39,15 +39,10 @@ _NEG = -1e30
 def _manual_axes() -> tuple:
     """Axis names bound manually in the current trace context (empty
     outside any shard_map). Single point of contact with the abstract-
-    mesh introspection API."""
-    am = jax.sharding.get_abstract_mesh()
-    if am is None or am.empty:
-        return ()
-    from jax.sharding import AxisType
+    mesh introspection API (version-bridged in utils.jax_compat)."""
+    from ..utils.jax_compat import manual_axis_names
 
-    return tuple(
-        n for n, t in zip(am.axis_names, am.axis_types) if t == AxisType.Manual
-    )
+    return manual_axis_names()
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
@@ -129,12 +124,11 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         return (k_t, v_t, m, l, acc), None
 
     def _varying(x):
-        # shard_map scans need device-varying carries; pcast is the
-        # non-deprecated spelling, pvary the fallback on older jax
-        axes = vary_axes or (axis_name,)
-        if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(x, axes, to="varying")
-        return jax.lax.pvary(x, axes)
+        # shard_map scans need device-varying carries (identity on
+        # pre-VMA jax — version-bridged in utils.jax_compat)
+        from ..utils.jax_compat import pvary
+
+        return pvary(x, vary_axes or (axis_name,))
 
     m0 = _varying(jnp.full((b, h, s_local), _NEG, jnp.float32))
     l0 = _varying(jnp.zeros((b, h, s_local), jnp.float32))
@@ -175,8 +169,9 @@ def sep_parallel_attention(q, k, v, mesh=None, axis_name: str = "sep",
       sequence shards; runs the ring body directly on the bound axis —
       this is what lets sep compose inside dp x sep x pp pipelines.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ..utils.jax_compat import shard_map
 
     from ..base.tape import apply
 

@@ -19,6 +19,8 @@ Instrumented sites (grep for ``chaos.inject``):
   leaves a torn tmp dir that resume() must skip)
 - ``serving.step``       — each engine iteration
 - ``bench.attempt``      — the bench child, before any JAX import
+- ``bench.probe``        — the bench preflight device-enumeration
+  child, before any JAX import (indexed by probe attempt)
 - ``train.step``         — opt-in: training loops/test workers call it
 
 Faults (``Fault.kind``): ``hang``/``slow`` (sleep ``arg`` seconds;

@@ -192,6 +192,95 @@ def test_budget_exhaustion_is_a_structured_stop():
     assert err["attempts"] < 50
 
 
+# ---- preflight device probe (round-5 verdict Next #1a) ----------------
+# BENCH_r05 was rc=124: one hung attempt's full slice outlived the
+# driver window. The probe answers "is the backend even there?" in a
+# ~90s-killed child BEFORE any attempt; two consecutive hangs emit the
+# structured failure within minutes.
+
+
+@pytest.mark.quick
+def test_preflight_hang_twice_fails_fast_with_structured_json():
+    import time as _time
+
+    t0 = _time.monotonic()
+    p = _run("probe_hang_until:99", attempts=5,
+             extra={"BENCH_PROBE_TIMEOUT": "2",
+                    "BENCH_TOTAL_BUDGET": "300"})
+    wall = _time.monotonic() - t0
+    assert p.returncode == 1
+    # 2 probes x 2s + interpreter startup — nowhere near an attempt slice
+    assert wall < 30, f"preflight stop took {wall:.1f}s"
+    obj = _metric_line(p.stdout)
+    assert obj["value"] is None
+    err = obj["error"]
+    assert err["stop_reason"] == "preflight device probe hung twice"
+    assert err["attempts"] == 0 and err["history"] == []
+    assert len(err["preflight"]) == 2
+    assert all(h["hung"] for h in err["preflight"])
+    assert "device probe 2/2 failed" in p.stderr
+
+
+@pytest.mark.quick
+def test_preflight_recovers_after_one_hang():
+    # one hung probe then a healthy one: the bench proceeds and delivers
+    p = _run("probe_hang_until:2", attempts=2,
+             extra={"BENCH_PROBE_TIMEOUT": "2"})
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert _metric_line(p.stdout)["value"] > 0
+    assert "device probe recovered on try 2" in p.stderr
+
+
+@pytest.mark.quick
+def test_preflight_skippable_and_probe_child_contract():
+    # BENCH_PREFLIGHT=0 must skip straight to the attempts
+    p = _run("fatal", attempts=2, extra={"BENCH_PREFLIGHT": "0"})
+    assert p.returncode == 1
+    assert "device probe" not in p.stderr
+    # the probe child itself prints one JSON line with the device count
+    env = dict(os.environ)
+    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                "BENCH_PROBE": "1"})
+    p = subprocess.run([sys.executable, BENCH], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0
+    probe = json.loads(p.stdout.strip().splitlines()[-1])
+    assert probe["probe"] == "ok" and probe["n_devices"] >= 1
+    assert probe["platform"] == "cpu"
+
+
+@pytest.mark.quick
+def test_preflight_probe_time_counts_against_total_budget():
+    """A hung probe must burn BUDGET, not extra wall time: the whole
+    run (probes + structured JSON) stays inside the deadline."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    p = _run("probe_hang_until:99", attempts=5,
+             extra={"BENCH_TOTAL_BUDGET": "6",
+                    "BENCH_PROBE_TIMEOUT": "90"})  # budget caps the probe
+    wall = _time.monotonic() - t0
+    assert p.returncode == 1
+    assert wall < 6 + 4.0, f"probe overran the budget: {wall:.1f}s"
+    err = _metric_line(p.stdout)["error"]
+    assert err["stop_reason"] == "preflight device probe hung twice"
+    assert all(h["timeout_s"] <= 6 for h in err["preflight"])
+
+
+@pytest.mark.quick
+def test_chaos_probe_site_drives_preflight():
+    """PADDLE_CHAOS site bench.probe (indexed by probe attempt) is the
+    seeded-plan spelling of the probe hang."""
+    p = _run("", attempts=2,
+             extra={"PADDLE_CHAOS":
+                    "bench.probe@1=hang:30;bench.probe@2=hang:30",
+                    "BENCH_PROBE_TIMEOUT": "2"})
+    assert p.returncode == 1
+    err = _metric_line(p.stdout)["error"]
+    assert err["stop_reason"] == "preflight device probe hung twice"
+    assert err["attempts"] == 0
+
+
 @pytest.mark.quick
 def test_chaos_schedule_drives_the_same_supervisor_paths():
     """PADDLE_CHAOS (site bench.attempt, indexed by attempt number) is

@@ -971,6 +971,76 @@ class TestPiecewiseCapture:
             np.testing.assert_allclose(np.asarray(pb._data),
                                        np.asarray(pa._data), rtol=1e-6)
 
+    def test_scheduler_step_after_break_stays_piecewise(self):
+        """ADVICE r5: the old substring hazard scan demoted the whole
+        function to eager whenever ANY ``.step(`` appeared after the
+        break — scheduler.step() / profiler.step() after a graph break
+        are autograd-free and must keep the compiled piecewise split."""
+        import paddle_tpu.nn as nn
+
+        class _Sched:  # lr-scheduler-shaped: step() but no autograd
+            def __init__(self):
+                self.n = 0
+
+            def step(self):
+                self.n += 1
+
+        paddle.seed(6)
+        m = nn.Linear(4, 3)
+        sched = _Sched()
+
+        def f(x):
+            y = m(x) * 2.0
+            if float(y.sum()) > -1e30:  # break; y is a CARRIED tensor
+                pass
+            sched.step()
+            stats = y.grad_fn if False else None  # .grad_fn must not trip
+            return y + 1.0 if stats is None else y
+
+        sf = pjit.to_static(f, layers=[m], full_graph=False)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with pytest.warns(UserWarning, match="piecewise"):
+            out1 = sf(x)
+        # the whole point: the split survives (the old substring scan
+        # saw ".step(" + the carried tensor y and demoted to eager)
+        assert sf._piecewise is not None and not sf._fallback_eager
+        assert not sf._piecewise._info["grad_hazard"]
+        out2 = sf(x)
+        assert sf._piecewise is not None and not sf._fallback_eager
+        # sched.step() sits in the COMPILED suffix: it ran at trace
+        # time only — the standard to_static host-side-effect contract
+        assert sched.n >= 1
+        np.testing.assert_allclose(out1.numpy(), (m(x) * 2.0 + 1.0).numpy(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out1.numpy(), out2.numpy(), rtol=1e-6)
+
+    def test_optimizer_step_after_break_still_hazards(self):
+        """The narrowed AST scan must still flag optimizer-shaped
+        receivers: ``optimizer.step()`` (or ``.grad`` reads) after the
+        break over a carried tensor demotes exactly as before."""
+        import ast as _ast
+        import textwrap
+
+        from paddle_tpu.jit import dy2static as d2s
+
+        def haz(src):
+            return d2s._autograd_hazard(_ast.parse(
+                textwrap.dedent(src)).body)
+
+        assert haz("optimizer.step()")
+        assert haz("opt.step()")
+        assert haz("self.optim.step()")
+        assert haz("adamw.step()")
+        assert haz("loss.backward()")
+        assert haz("g = paddle.grad(loss, xs)")
+        assert haz("print(p.grad)")
+        assert haz("opt_2.clear_grad()")
+        assert not haz("scheduler.step()")
+        assert not haz("profiler.step()")
+        assert not haz("lr_sched.step()")
+        assert not haz("node = y.grad_fn")
+        assert not haz("x = gradient_norm * 2")
+
     def test_later_call_unsafe_demotes_instead_of_raising(self):
         """A branch that binds a non-jaxable local only on SOME calls:
         the first call installs piecewise, a later call must demote to
