@@ -1,0 +1,54 @@
+"""graft-lint — static trace-safety / collective-correctness /
+deadline-discipline analysis for paddle_tpu, plus runtime sanitizers.
+
+CLI::
+
+    python -m paddle_tpu.analysis paddle_tpu/ [--select TRACE001,..]
+    graft-lint --list-rules            # console entry point
+
+Rules (see ``rules.py`` for the full table): TRACE001 host side
+effects in traced regions, TRACE002 tensor-valued control flow under
+jax.jit, RECOMP001 recompile/sync triggers in hot loops, COLL001
+rank-conditional collectives, DDL001 un-deadlined blocking calls,
+DONATE001 use-after-donation. Suppress per file with
+``# graft-lint: disable=RULE``; absorb existing debt with the
+committed ``baseline.json`` (regenerate via ``--write-baseline``).
+
+Runtime: :func:`recompile_guard` pins a code path to an exact XLA
+compile budget (see ``sanitizers.py``).
+"""
+from .core import (  # noqa: F401
+    Finding,
+    Rule,
+    all_rules,
+    analyze_paths,
+    analyze_source,
+    apply_baseline,
+    baseline_entries,
+    default_baseline_path,
+    load_baseline,
+    write_baseline,
+)
+from .sanitizers import (  # noqa: F401
+    CompileEvent,
+    RecompileError,
+    RecompileGuard,
+    recompile_guard,
+)
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+    "apply_baseline",
+    "baseline_entries",
+    "default_baseline_path",
+    "load_baseline",
+    "write_baseline",
+    "CompileEvent",
+    "RecompileError",
+    "RecompileGuard",
+    "recompile_guard",
+]

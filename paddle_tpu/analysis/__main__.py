@@ -1,0 +1,185 @@
+"""graft-lint CLI.
+
+    python -m paddle_tpu.analysis [paths] [--select RULE,..]
+                                  [--baseline FILE] [--write-baseline FILE]
+
+Exit status: 0 when every finding at/above ``--min-severity`` is
+absorbed by the baseline (or there are none), 1 otherwise, 2 on usage
+errors. The committed baseline at ``paddle_tpu/analysis/baseline.json``
+is picked up automatically so ``python -m paddle_tpu.analysis
+paddle_tpu/`` gates on NEW findings only.
+
+Project defaults come from ``[tool.graft-lint]`` in the nearest
+``pyproject.toml`` (``paths``/``baseline``/``min_severity``);
+command-line flags win over it.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+from .core import (
+    SEVERITY_ORDER,
+    all_rules,
+    analyze_paths,
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    write_baseline,
+)
+
+
+def _split_rules(value: str) -> List[str]:
+    return [r.strip() for r in value.split(",") if r.strip()]
+
+
+def _pyproject_defaults() -> Dict:
+    """The ``[tool.graft-lint]`` table from the nearest pyproject.toml
+    (cwd upward), {} when absent or no TOML parser is available."""
+    try:
+        import tomllib as toml  # py311+
+    except ImportError:
+        try:
+            import tomli as toml  # type: ignore[no-redef]
+        except ImportError:
+            return {}
+    d = os.getcwd()
+    while True:
+        pp = os.path.join(d, "pyproject.toml")
+        if os.path.isfile(pp):
+            try:
+                with open(pp, "rb") as fh:
+                    data = toml.load(fh)
+                cfg = data.get("tool", {}).get("graft-lint", {})
+                if cfg:
+                    cfg = dict(cfg)
+                    cfg["_dir"] = d  # baseline paths resolve from here
+                return cfg
+            except Exception:
+                return {}
+        parent = os.path.dirname(d)
+        if parent == d:
+            return {}
+        d = parent
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graft-lint",
+        description="trace-safety / collective-correctness / "
+                    "deadline-discipline analyzer for paddle_tpu",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories to lint (default: "
+                        "[tool.graft-lint] paths, else paddle_tpu)")
+    p.add_argument("--select", type=_split_rules, default=None,
+                   metavar="RULE,..", help="run only these rules")
+    p.add_argument("--ignore", type=_split_rules, default=None,
+                   metavar="RULE,..", help="skip these rules")
+    p.add_argument("--min-severity", choices=sorted(
+        SEVERITY_ORDER, key=SEVERITY_ORDER.get), default=None,
+        help="findings below this severity are printed but never fail "
+             "the run (default: [tool.graft-lint] min_severity, else "
+             "warning)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline JSON (default: the committed "
+                        "paddle_tpu/analysis/baseline.json when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline, report everything")
+    p.add_argument("--write-baseline", default=None, metavar="FILE",
+                   help="write the current findings as a new baseline "
+                        "and exit 0")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress per-finding output; summary only")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(all_rules().values(), key=lambda r: r.id):
+            print(f"{rule.id:10s} {rule.severity:8s} {rule.summary}")
+        return 0
+
+    # flags > [tool.graft-lint] > built-in defaults
+    cfg = _pyproject_defaults()
+    if not args.paths:
+        args.paths = list(cfg.get("paths", ())) or ["paddle_tpu"]
+    if args.min_severity is None:
+        args.min_severity = cfg.get("min_severity", "warning")
+        if args.min_severity not in SEVERITY_ORDER:
+            print(f"graft-lint: bad [tool.graft-lint] min_severity "
+                  f"{args.min_severity!r}", file=sys.stderr)
+            return 2
+    if args.baseline is None and cfg.get("baseline"):
+        cand = os.path.join(cfg.get("_dir", "."), cfg["baseline"])
+        if os.path.isfile(cand):
+            args.baseline = cand
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"graft-lint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    try:
+        findings = analyze_paths(
+            args.paths, select=args.select, ignore=args.ignore)
+    except ValueError as e:  # unknown rule id in --select/--ignore
+        print(f"graft-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print(f"graft-lint: wrote baseline with {len(findings)} "
+              f"finding(s) to {args.write_baseline}")
+        return 0
+
+    baselined = 0
+    if not args.no_baseline:
+        path = args.baseline or (
+            default_baseline_path()
+            if os.path.exists(default_baseline_path()) else None)
+        if path is not None:
+            try:
+                findings, baselined = apply_baseline(
+                    findings, load_baseline(path))
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"graft-lint: bad baseline {path}: {e}",
+                      file=sys.stderr)
+                return 2
+
+    floor = SEVERITY_ORDER[args.min_severity]
+    gating = [f for f in findings if SEVERITY_ORDER[f.severity] >= floor]
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "baselined": baselined,
+            "gating": len(gating),
+        }, indent=2))
+    else:
+        if not args.quiet:
+            for f in findings:
+                print(f.format())
+        by_sev = {}
+        for f in findings:
+            by_sev[f.severity] = by_sev.get(f.severity, 0) + 1
+        detail = ", ".join(
+            f"{n} {s}" for s, n in sorted(
+                by_sev.items(), key=lambda kv: -SEVERITY_ORDER[kv[0]]))
+        print(f"graft-lint: {len(findings)} new finding(s)"
+              + (f" ({detail})" if detail else "")
+              + (f", {baselined} baselined" if baselined else ""))
+    return 1 if gating else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,482 @@
+"""graft-lint core: Finding model, rule registry, suppressions,
+baseline, and the per-module analysis engine.
+
+Design (in the spirit of TorchDynamo's graph-break analysis and
+RacerD-style modular detection): each rule is a pure function over a
+:class:`ModuleContext` — one parsed module plus the pre-computed facts
+every rule needs (which functions are jit regions, which jit wrappers
+carry ``static_argnums``/``donate_argnums``, whether the module imports
+``utils.retries``). Rules yield :class:`Finding`s; the engine applies
+per-file ``# graft-lint: disable=RULE`` suppressions and the committed
+baseline, so self-lint can land clean while every NEW violation fails.
+
+Stdlib-only: the analyzer must run without jax/numpy installed (the
+runtime sanitizer half lives in ``sanitizers.py`` and imports jax
+lazily).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from .astutils import call_keyword, dotted_name, literal_int_tuple
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "ModuleContext",
+    "register_rule",
+    "all_rules",
+    "analyze_source",
+    "analyze_paths",
+    "iter_python_files",
+    "load_baseline",
+    "apply_baseline",
+    "baseline_entries",
+    "write_baseline",
+    "default_baseline_path",
+    "SEVERITY_ORDER",
+]
+
+SEVERITY_ORDER = {"note": 0, "warning": 1, "error": 2}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation: ``file:line:col`` + message + a fix hint."""
+
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+
+    def format(self, show_hint: bool = True) -> str:
+        s = f"{self.path}:{self.line}:{self.col}: {self.severity} " \
+            f"[{self.rule}] {self.message}"
+        if show_hint and self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+    def baseline_key(self) -> str:
+        """Line-number-independent fingerprint (rule x file): committed
+        baselines must survive unrelated edits shifting lines."""
+        return f"{_normalize_key_path(self.path)}::{self.rule}"
+
+    def to_json(self) -> Dict:
+        return {
+            "rule": self.rule, "severity": self.severity,
+            "path": self.path, "line": self.line, "col": self.col,
+            "message": self.message, "hint": self.hint,
+        }
+
+
+def _normalize_key_path(path: str) -> str:
+    """Baseline keys anchor at the package/tests directory so the same
+    baseline matches regardless of the cwd the analyzer ran from."""
+    parts = path.replace(os.sep, "/").split("/")
+    for anchor in ("paddle_tpu", "tests", "benchmarks"):
+        if anchor in parts:
+            return "/".join(parts[parts.index(anchor):])
+    return "/".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+
+@dataclass
+class Rule:
+    id: str
+    severity: str
+    summary: str
+    hint: str
+    check: Callable[["ModuleContext"], Iterator[Finding]]
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register_rule(rule_id: str, *, severity: str, summary: str,
+                  hint: str = ""):
+    """Decorator registering ``fn(ctx) -> iterator of (node, message
+    [, hint])`` tuples as a rule; the registry wraps them into
+    Findings."""
+    if severity not in SEVERITY_ORDER:
+        raise ValueError(f"unknown severity {severity!r}")
+
+    def deco(fn):
+        def check(ctx: "ModuleContext") -> Iterator[Finding]:
+            for item in fn(ctx):
+                node, message = item[0], item[1]
+                hint_ = item[2] if len(item) > 2 and item[2] else hint
+                yield Finding(
+                    rule=rule_id, severity=severity, path=ctx.path,
+                    line=getattr(node, "lineno", 0),
+                    col=getattr(node, "col_offset", 0) + 1,
+                    message=message, hint=hint_,
+                )
+
+        if rule_id in _RULES:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        _RULES[rule_id] = Rule(rule_id, severity, summary, hint, check)
+        return fn
+
+    return deco
+
+
+def all_rules() -> Dict[str, Rule]:
+    from . import rules  # noqa: F401 — importing registers the rules
+    return dict(_RULES)
+
+
+# ---------------------------------------------------------------------------
+# Module context: the facts rules share
+
+_JIT_CALLEES = ("jit", "pjit")
+_TRACE_CALLEES = _JIT_CALLEES + ("to_static",)
+
+
+@dataclass
+class JitRegion:
+    """A function whose body runs under trace."""
+
+    fndef: ast.AST  # FunctionDef | AsyncFunctionDef
+    kinds: Set[str] = field(default_factory=set)  # {"jit", "to_static"}
+    static_names: Set[str] = field(default_factory=set)
+    via: str = ""  # how it was detected, for messages
+
+
+@dataclass
+class JitWrapper:
+    """A NAME bound to a jit-compiled callable (``f = jax.jit(g, ...)``
+    or a decorated def) with the compile options rules care about."""
+
+    name: str
+    has_static: bool = False
+    donate: Tuple[int, ...] = ()
+
+
+class ModuleContext:
+    def __init__(self, src: str, path: str):
+        self.src = src
+        self.path = path
+        self.tree = ast.parse(src)
+        self.lines = src.splitlines()
+        # {id(fndef): JitRegion} — functions whose bodies are traced
+        self.jit_regions: Dict[int, JitRegion] = {}
+        # {name: JitWrapper} — names calling into compiled programs
+        self.jit_wrappers: Dict[str, JitWrapper] = {}
+        self.imports_retries = False
+        self._functions: List[ast.AST] = []
+        self._collect()
+
+    # -- collection ------------------------------------------------------
+    def functions(self) -> List[ast.AST]:
+        """Every FunctionDef/AsyncFunctionDef in the module, outermost
+        first (document order)."""
+        return list(self._functions)
+
+    def region_of(self, fndef: ast.AST) -> Optional[JitRegion]:
+        return self.jit_regions.get(id(fndef))
+
+    def _collect(self):
+        defs_by_name: Dict[str, List[ast.AST]] = {}
+        # {id(assign.value): bound name} — one pre-pass instead of a
+        # whole-tree walk per jit call site
+        assign_targets: Dict[int, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._functions.append(node)
+                defs_by_name.setdefault(node.name, []).append(node)
+            elif isinstance(node, ast.Assign) and node.targets:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    assign_targets[id(node.value)] = t.id
+                elif isinstance(t, ast.Attribute):
+                    assign_targets[id(node.value)] = t.attr
+            elif isinstance(node, ast.Import):
+                if any(a.name.endswith("retries") for a in node.names):
+                    self.imports_retries = True
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.endswith("retries") or any(
+                        a.name == "retries" for a in node.names):
+                    self.imports_retries = True
+        self._functions.sort(key=lambda n: (n.lineno, n.col_offset))
+
+        # 1) decorators: @jax.jit / @jit / @pjit / @to_static /
+        #    @partial(jax.jit, static_argnums=..., donate_argnums=...)
+        for fn in self._functions:
+            for dec in getattr(fn, "decorator_list", ()):
+                info = self._trace_entry_info(dec, fn)
+                if info is None:
+                    continue
+                kind, static_names, donate, has_static = info
+                region = self.jit_regions.setdefault(
+                    id(fn), JitRegion(fn, via=f"@{kind}"))
+                region.kinds.add(
+                    "to_static" if kind.endswith("to_static") else "jit")
+                region.static_names |= static_names
+                self._register_wrapper(fn.name, has_static, donate)
+
+        # 2) call sites: f = jax.jit(g, ...) / to_static(g) anywhere —
+        #    the NAME g (resolved against same-module defs) is a region,
+        #    and the BOUND name f is a wrapper for DONATE/RECOMP rules
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee is None or callee.split(".")[-1] not in _TRACE_CALLEES:
+                continue
+            kind = callee.split(".")[-1]
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                target_defs = []
+            else:
+                target_defs = defs_by_name.get(node.args[0].id, [])
+            static_names, donate, has_static = self._jit_options(
+                node, target_defs[0] if target_defs else None)
+            for fndef in target_defs:
+                region = self.jit_regions.setdefault(
+                    id(fndef), JitRegion(fndef, via=f"{callee}()"))
+                region.kinds.add(
+                    "to_static" if kind == "to_static" else "jit")
+                region.static_names |= static_names
+            # only the BOUND name calls the compiled program
+            # (`step = jax.jit(fn, ...)` -> `step`); the raw `fn` stays
+            # a plain function — eager calls to it donate/retrace
+            # nothing, so registering it would false-positive
+            # DONATE001/RECOMP001 on eager/reference paths
+            bound = assign_targets.get(id(node), "")
+            if bound:
+                self._register_wrapper(bound, has_static, donate)
+
+    def _register_wrapper(self, name: str, has_static: bool,
+                          donate: Tuple[int, ...]):
+        w = self.jit_wrappers.setdefault(name, JitWrapper(name))
+        w.has_static = w.has_static or has_static
+        w.donate = tuple(sorted(set(w.donate) | set(donate)))
+
+    def _trace_entry_info(self, dec: ast.expr, fn: ast.AST):
+        """(kind, static_param_names, donate_positions, has_static) for
+        a decorator marking ``fn`` as traced, else None."""
+        if isinstance(dec, ast.Call):
+            callee = dotted_name(dec.func)
+            tail = (callee or "").split(".")[-1]
+            if tail in ("partial",) and dec.args:
+                inner = dotted_name(dec.args[0])
+                if inner and inner.split(".")[-1] in _TRACE_CALLEES:
+                    s, d, hs = self._jit_options(dec, fn)
+                    return inner.split(".")[-1], s, d, hs
+                return None
+            if tail in _TRACE_CALLEES:
+                s, d, hs = self._jit_options(dec, fn)
+                return tail, s, d, hs
+            return None
+        callee = dotted_name(dec)
+        tail = (callee or "").split(".")[-1]
+        if tail in _TRACE_CALLEES:
+            return tail, set(), (), False
+        return None
+
+    @staticmethod
+    def _jit_options(call: ast.Call, fndef: Optional[ast.AST]):
+        """(static_param_names, donate_positions, has_static) from a
+        jit(...) call's keywords, resolving argnums to the wrapped
+        function's parameter names when its def is in this module."""
+        static_names: Set[str] = set()
+        has_static = False
+        params: List[str] = []
+        if fndef is not None:
+            a = fndef.args
+            params = [p.arg for p in (*a.posonlyargs, *a.args)]
+        v = call_keyword(call, "static_argnums")
+        if v is not None:
+            has_static = True
+            for i in literal_int_tuple(v) or ():
+                if 0 <= i < len(params):
+                    static_names.add(params[i])
+        v = call_keyword(call, "static_argnames")
+        if v is not None:
+            has_static = True
+            try:
+                names = ast.literal_eval(v)
+            except (ValueError, SyntaxError):
+                names = ()
+            if isinstance(names, str):
+                names = (names,)
+            static_names |= {n for n in names if isinstance(n, str)}
+        donate: Tuple[int, ...] = ()
+        v = call_keyword(call, "donate_argnums")
+        if v is not None:
+            donate = literal_int_tuple(v) or ()
+        return static_names, donate, has_static
+
+
+# ---------------------------------------------------------------------------
+# Suppressions: # graft-lint: disable=RULE1,RULE2   (per-file on a
+# comment-only line; scoped to one line when trailing code)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graft-lint\s*:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+def _collect_suppressions(src: str):
+    file_wide: Set[str] = set()
+    per_line: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(StringIO(src).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            line_no = tok.start[0]
+            prefix = tok.line[: tok.start[1]].strip()
+            if prefix:
+                per_line.setdefault(line_no, set()).update(rules)
+            else:
+                file_wide.update(rules)
+    except tokenize.TokenError:
+        pass
+    return file_wide, per_line
+
+
+def _suppressed(f: Finding, file_wide: Set[str],
+                per_line: Dict[int, Set[str]]) -> bool:
+    def hit(rules: Set[str]) -> bool:
+        return "all" in rules or "ALL" in rules or f.rule in rules
+
+    if hit(file_wide):
+        return True
+    return f.line in per_line and hit(per_line[f.line])
+
+
+# ---------------------------------------------------------------------------
+# Engine
+
+def analyze_source(src: str, path: str = "<string>", *,
+                   select: Optional[Iterable[str]] = None,
+                   ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the (selected) rules over one module's source. Returns the
+    findings that survive ``# graft-lint: disable=`` suppressions,
+    sorted by (line, col, rule). Baseline filtering is the caller's job
+    (see :func:`apply_baseline`)."""
+    rules = all_rules()
+    if select:
+        wanted = set(select)
+        unknown = wanted - set(rules)
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        rules = {k: v for k, v in rules.items() if k in wanted}
+    if ignore:
+        rules = {k: v for k, v in rules.items() if k not in set(ignore)}
+    try:
+        ctx = ModuleContext(src, path)
+    except SyntaxError as e:
+        return [Finding(
+            rule="PARSE000", severity="error", path=path,
+            line=e.lineno or 0, col=(e.offset or 0),
+            message=f"could not parse module: {e.msg}")]
+    file_wide, per_line = _collect_suppressions(src)
+    findings: List[Finding] = []
+    seen = set()  # nested loops can make a rule revisit the same node
+    for rule in rules.values():
+        for f in rule.check(ctx):
+            key = (f.rule, f.line, f.col, f.message)
+            if key not in seen and not _suppressed(f, file_wide, per_line):
+                seen.add(key)
+                findings.append(f)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d != "__pycache__" and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def analyze_paths(paths: Iterable[str], *,
+                  select: Optional[Iterable[str]] = None,
+                  ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for fp in iter_python_files(paths):
+        try:
+            with open(fp, encoding="utf-8") as fh:
+                src = fh.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        findings.extend(
+            analyze_source(src, fp, select=select, ignore=ignore))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Baseline: {"entries": {"<pkg-relative-path>::<RULE>": count}} — the
+# committed debt ledger. A finding is baselined while its key has
+# budget left; new findings (or more findings than the recorded count)
+# fail the gate. Keys are line-independent so refactors that merely
+# shift code don't churn the file.
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    entries = data.get("entries", data) if isinstance(data, dict) else {}
+    return {str(k): int(v) for k, v in entries.items()}
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[str, int]) -> Tuple[List[Finding], int]:
+    """(new_findings, baselined_count): consume baseline budget in
+    finding order; whatever exceeds it is new."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    used = 0
+    for f in findings:
+        k = f.baseline_key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            used += 1
+        else:
+            new.append(f)
+    return new, used
+
+
+def baseline_entries(findings: List[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        out[f.baseline_key()] = out.get(f.baseline_key(), 0) + 1
+    return dict(sorted(out.items()))
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    data = {
+        "tool": "graft-lint",
+        "version": 1,
+        "entries": baseline_entries(findings),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")

@@ -1,0 +1,464 @@
+"""The graft-lint rule set — every rule encodes a bug this repo
+actually hit (see ISSUE/ADVICE history):
+
+========= ======== ====================================================
+TRACE001  error    host side effect reachable inside a traced region
+                   (runs at trace time only — PR 2's dy2static
+                   "sched.step() ran once" contract, now machine-checked)
+TRACE002  error    tensor-valued ``if``/``while`` condition under
+                   ``jax.jit`` (the dy2static hazard, generalized:
+                   to_static converts these, raw jax.jit just fails or
+                   silently specializes)
+RECOMP001 warning  recompilation/sync triggers in hot loops: ``.item()``
+                   per step, or a varying Python scalar fed to a jit
+                   without ``static_argnums``
+COLL001   error    rank-conditional collective — one branch of an
+                   ``if rank == 0`` calls a collective the other side
+                   never matches (the ADVICE r5 opaque-gloo-hang shape)
+DDL001    warning  blocking call (socket recv/accept, queue.get,
+                   process.wait, bare sleep poll loop) in a module that
+                   imports utils.retries but without a Deadline threaded
+                   through the enclosing function (PR 1's discipline)
+DONATE001 error    array used after being passed to a jit with
+                   ``donate_argnums`` — the buffer is dead; XLA may have
+                   already overwritten it
+========= ======== ====================================================
+
+All rules are intraprocedural and name-based — modular by design
+(RacerD-style): no cross-module inference, so a clean file stays clean
+no matter what its imports do. False negatives are accepted; false
+positives are suppressible per file (``# graft-lint: disable=RULE``)
+or absorbed by the committed baseline.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .astutils import dotted_name, receiver_name, walk_scope
+from .core import ModuleContext, register_rule
+
+__all__: List[str] = []
+
+
+# ---------------------------------------------------------------------------
+# Taint: which names in a traced function hold tensors (arguments and
+# values derived from them). Attribute reads that return host metadata
+# and explicit concretizations STOP the taint.
+
+_META_ATTRS = {"shape", "ndim", "dtype", "size", "device", "sharding"}
+_CONCRETIZE_FUNCS = {"int", "float", "bool", "len", "isinstance", "range",
+                     "type", "str"}
+_CONCRETIZE_METHODS = {"item", "tolist", "numpy"}
+
+
+def _expr_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Constant):
+        return False
+    if isinstance(node, ast.Attribute):
+        if node.attr in _META_ATTRS:
+            return False
+        return _expr_tainted(node.value, tainted)
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in _CONCRETIZE_FUNCS:
+            return False
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _CONCRETIZE_METHODS:
+                return False
+            if _expr_tainted(fn.value, tainted):
+                return True  # tensor method: x.sum(), x.astype(...)
+        return any(_expr_tainted(a, tainted) for a in node.args) or any(
+            _expr_tainted(k.value, tainted) for k in node.keywords)
+    return any(_expr_tainted(c, tainted) for c in ast.iter_child_nodes(node))
+
+
+def _tainted_names(fndef: ast.AST, static_names: Set[str]) -> Set[str]:
+    args = fndef.args
+    tainted = {
+        a.arg for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
+        if a.arg not in static_names and a.arg != "self"
+    }
+    for a in (args.vararg, args.kwarg):
+        if a is not None:
+            tainted.add(a.arg)
+    # two forward passes over simple assignments: enough for the
+    # straight-line dataflow jit bodies actually contain
+    for _ in range(2):
+        for node in walk_scope(fndef):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                targets = [t] if isinstance(t, ast.Name) else [
+                    e for e in getattr(t, "elts", []) if isinstance(e, ast.Name)]
+                is_tainted = _expr_tainted(node.value, tainted)
+                for tn in targets:
+                    (tainted.add if is_tainted else tainted.discard)(tn.id)
+            elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name):
+                if _expr_tainted(node.value, tainted):
+                    tainted.add(node.target.id)
+    return tainted
+
+
+# ---------------------------------------------------------------------------
+# TRACE001 — host side effects inside traced regions
+
+_HOST_NAME_CALLS = {"print", "input", "open", "breakpoint"}
+# dotted prefixes whose calls touch host state; jax.debug.* /
+# jax.random.* / jnp.* are deliberately NOT here (trace-safe)
+_HOST_DOTTED = re.compile(
+    r"^(time\.(time|perf_counter|monotonic|sleep)"
+    r"|(np|numpy)\.random\.\w+"
+    r"|(np|numpy)\.(save|load|savez\w*)"
+    r"|random\.(random|randint|randrange|choice|shuffle|uniform|seed|"
+    r"gauss|normalvariate)"
+    r"|os\.(system|popen|remove|unlink|makedirs|mkdir)"
+    r"|logging\.\w+)$")
+
+
+@register_rule(
+    "TRACE001", severity="error",
+    summary="host side effect inside a traced (to_static/jax.jit) region",
+    hint="traced bodies run ONCE at trace time — the effect will not "
+         "repeat per call. Hoist it out of the jit region, or use "
+         "jax.debug.print / jax.random for in-graph equivalents; "
+         "silence a deliberate trace-time effect with "
+         "# graft-lint: disable=TRACE001",
+)
+def trace001(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    for fndef in ctx.functions():
+        region = ctx.region_of(fndef)
+        if region is None:
+            continue
+        for node in walk_scope(fndef):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in _HOST_NAME_CALLS:
+                yield node, (
+                    f"`{fn.id}(...)` inside traced function "
+                    f"`{fndef.name}` ({region.via}) executes at trace "
+                    "time only")
+                continue
+            dotted = dotted_name(fn)
+            if dotted and _HOST_DOTTED.match(dotted):
+                yield node, (
+                    f"host call `{dotted}(...)` inside traced function "
+                    f"`{fndef.name}` ({region.via}) executes at trace "
+                    "time only")
+
+
+# ---------------------------------------------------------------------------
+# TRACE002 — tensor-valued if/while conditions under jax.jit
+
+@register_rule(
+    "TRACE002", severity="error",
+    summary="tensor-valued `if`/`while` condition under jax.jit",
+    hint="a traced tensor has no concrete truth value: rewrite with "
+         "jnp.where / lax.cond / lax.while_loop, hoist the decision to "
+         "a static_argnums argument, or route the function through "
+         "to_static (whose dy2static pass converts it automatically)",
+)
+def trace002(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    for fndef in ctx.functions():
+        region = ctx.region_of(fndef)
+        # to_static-only regions are exempt: dy2static converts their
+        # tensor-dependent control flow into selects/while_loops
+        if region is None or "jit" not in region.kinds:
+            continue
+        tainted = _tainted_names(fndef, region.static_names)
+        if not tainted:
+            continue
+        for node in walk_scope(fndef):
+            if isinstance(node, (ast.If, ast.While)) and _expr_tainted(
+                    node.test, tainted):
+                kw = "if" if isinstance(node, ast.If) else "while"
+                yield node, (
+                    f"`{kw}` condition depends on traced value(s) "
+                    f"{sorted(n for n in tainted if _name_in(node.test, n))}"
+                    f" in jit function `{fndef.name}`")
+            elif isinstance(node, ast.IfExp) and _expr_tainted(
+                    node.test, tainted):
+                yield node, (
+                    "conditional expression branches on a traced value "
+                    f"in jit function `{fndef.name}`")
+
+
+def _name_in(expr: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(expr))
+
+
+# ---------------------------------------------------------------------------
+# RECOMP001 — recompilation / device-sync triggers in hot loops
+
+@register_rule(
+    "RECOMP001", severity="warning",
+    summary="recompile/sync trigger in a hot loop (.item() per step, or "
+            "a varying Python scalar passed to a jit without "
+            "static_argnums)",
+    hint=".item()/float() blocks on the device every iteration; a "
+         "varying Python scalar argument retraces the jit per distinct "
+         "value. Keep values on device (jnp.where on arrays), pass "
+         "scalars as 0-d arrays, or declare them static_argnums if "
+         "they take few values",
+)
+def recomp001(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    for fndef in ctx.functions():
+        if ctx.region_of(fndef) is not None:
+            continue  # inside a traced body .item() fails loudly already
+        for loop in walk_scope(fndef):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            loop_target = (
+                loop.target.id
+                if isinstance(loop, ast.For)
+                and isinstance(loop.target, ast.Name) else None)
+            for node in ast.walk(loop):
+                if node is loop or not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and fn.attr == "item":
+                    yield node, (
+                        "`.item()` inside a loop forces a device sync "
+                        "(and a host round-trip) every iteration")
+                    continue
+                # varying Python scalar into a known jit wrapper
+                callee = dotted_name(fn)
+                tail = (callee or "").split(".")[-1]
+                wrapper = ctx.jit_wrappers.get(tail)
+                if wrapper is None or wrapper.has_static:
+                    continue
+                if loop_target is None:
+                    continue
+                for pos, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Name) and arg.id == loop_target:
+                        yield node, (
+                            f"loop variable `{arg.id}` passed as plain "
+                            f"Python scalar to jit-compiled `{tail}` "
+                            f"(arg {pos}) — retraces every iteration; "
+                            "wrap in jnp.asarray or mark static_argnums")
+                        break
+
+
+# ---------------------------------------------------------------------------
+# COLL001 — rank-conditional collectives
+
+_RANKISH_NAME = re.compile(
+    r"(^|_)(rank|local_rank|node_rank|process_index|proc_id)$", re.I)
+_RANKISH_CALL = re.compile(
+    r"(^|\.)(get_rank|local_rank|process_index|node_rank)$")
+# calls EVERY rank must make (point-to-point send/recv excluded: a
+# rank-conditional send/recv pairing is the correct idiom)
+_COLLECTIVES = {
+    "broadcast", "all_reduce", "allreduce", "all_gather", "allgather",
+    "all_gather_object", "reduce_scatter", "all_to_all", "alltoall",
+    "barrier", "scatter", "scatter_object_list",
+    "eager_broadcast", "eager_all_reduce", "eager_all_gather",
+    "eager_all_gather_object", "eager_ppermute",
+}
+
+
+def _is_rank_conditional(test: ast.AST) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, (ast.Name, ast.Attribute)):
+            if _RANKISH_NAME.search(receiver_name(n) or ""):
+                return True
+        elif isinstance(n, ast.Call):
+            d = dotted_name(n.func)
+            if d and _RANKISH_CALL.search(d):
+                return True
+    return False
+
+
+def _collectives_called(stmts) -> Dict[str, ast.AST]:
+    out: Dict[str, ast.AST] = {}
+    for stmt in stmts:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call):
+                name = (dotted_name(n.func) or "").split(".")[-1]
+                if name in _COLLECTIVES:
+                    out.setdefault(name, n)
+    return out
+
+
+@register_rule(
+    "COLL001", severity="error",
+    summary="collective called on only one side of a rank-conditional "
+            "branch",
+    hint="every rank must reach the same collectives in the same order "
+         "or the job deadlocks (an opaque gloo/NCCL hang, not an "
+         "error). Hoist the collective out of the rank branch — use "
+         "broadcast(src=rank) / a no-op contribution on the other "
+         "side — and keep only logging/IO rank-conditional",
+)
+def coll001(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.If) or not _is_rank_conditional(
+                node.test):
+            continue
+        body = _collectives_called(node.body)
+        orelse = _collectives_called(node.orelse)
+        for name, call in body.items():
+            if name not in orelse:
+                yield call, (
+                    f"collective `{name}` is called only when the rank "
+                    "condition holds; other ranks never enter a "
+                    "matching call and the collective hangs")
+        for name, call in orelse.items():
+            if name not in body:
+                yield call, (
+                    f"collective `{name}` is called only on the else "
+                    "side of a rank condition; the selected rank never "
+                    "enters a matching call and the collective hangs")
+
+
+# ---------------------------------------------------------------------------
+# DDL001 — blocking calls without a Deadline in retries-disciplined
+# modules
+
+_QUEUEISH = re.compile(r"(^|_)(q|queue|inbox|mailbox|jobs|tasks|work)"
+                       r"(_|$|\d)", re.I)
+_DEADLINEISH = re.compile(r"deadline|budget", re.I)
+
+
+def _mentions_deadline(fndef: ast.AST) -> bool:
+    for n in ast.walk(fndef):
+        if isinstance(n, ast.Name) and (
+                n.id == "Deadline" or _DEADLINEISH.search(n.id)):
+            return True
+        if isinstance(n, ast.Attribute) and _DEADLINEISH.search(n.attr):
+            return True
+        if isinstance(n, ast.arg) and _DEADLINEISH.search(n.arg):
+            return True
+        if isinstance(n, ast.keyword) and n.arg and _DEADLINEISH.search(
+                n.arg):
+            return True
+    return False
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+@register_rule(
+    "DDL001", severity="warning",
+    summary="blocking call without a Deadline in a retries-disciplined "
+            "module",
+    hint="this module already imports utils.retries — thread a "
+         "Deadline through the enclosing function and bound the wait "
+         "(sock.settimeout(dl.timeout(...)), q.get(timeout=...), "
+         "proc.wait(timeout=...), dl.sleep(...)); see "
+         "utils/retries.py's module docstring for the discipline",
+)
+def ddl001(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    if not ctx.imports_retries:
+        return
+    for fndef in ctx.functions():
+        if _mentions_deadline(fndef):
+            continue
+        sets_timeout = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "settimeout"
+            for n in walk_scope(fndef))
+        for node in walk_scope(fndef):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                # bare time.sleep in a while loop: handled below via
+                # dotted form (time.sleep is an Attribute)
+                continue
+            if fn.attr in ("recv", "recv_into", "accept") and not \
+                    sets_timeout:
+                yield node, (
+                    f"`.{fn.attr}()` blocks indefinitely — no "
+                    "settimeout()/Deadline in this function")
+            elif fn.attr in ("wait", "communicate") and not node.args \
+                    and not _has_timeout(node):
+                yield node, (
+                    f"`.{fn.attr}()` with no timeout blocks "
+                    "indefinitely")
+            elif fn.attr == "get" and _QUEUEISH.search(
+                    receiver_name(fn.value) or "") and _blocking_get(node):
+                yield node, (
+                    f"`{receiver_name(fn.value)}.get()` with no timeout "
+                    "blocks indefinitely")
+        # bare sleep poll loops
+        for loop in walk_scope(fndef):
+            if not isinstance(loop, ast.While):
+                continue
+            for n in ast.walk(loop):
+                if isinstance(n, ast.Call) and dotted_name(n.func) in (
+                        "time.sleep", "sleep"):
+                    yield n, (
+                        "bare sleep inside a poll loop — the loop has "
+                        "no overall budget and can spin forever")
+                    break
+
+
+def _blocking_get(call: ast.Call) -> bool:
+    if _has_timeout(call):
+        return False
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return False
+    # dict.get(key[, default]) takes positional args; queue.get()'s
+    # blocking form is argument-free (or block=True)
+    return not call.args
+
+
+# ---------------------------------------------------------------------------
+# DONATE001 — use after donation
+
+@register_rule(
+    "DONATE001", severity="error",
+    summary="array used after being passed to a jit with donate_argnums",
+    hint="a donated buffer is dead after the call — XLA reuses its "
+         "memory for the outputs. Rebind the name to the result "
+         "(`x = f(x)`), or drop donate_argnums for buffers you still "
+         "read",
+)
+def donate001(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    donating = {n: w for n, w in ctx.jit_wrappers.items() if w.donate}
+    if not donating:
+        return
+    for fndef in ctx.functions():
+        if ctx.region_of(fndef) is not None:
+            continue
+        stores: Dict[str, List[int]] = {}
+        loads: Dict[str, List[ast.Name]] = {}
+        donations: List[Tuple[str, str, int]] = []  # (var, callee, line)
+        for node in walk_scope(fndef):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    stores.setdefault(node.id, []).append(node.lineno)
+                elif isinstance(node.ctx, ast.Load):
+                    loads.setdefault(node.id, []).append(node)
+            if isinstance(node, ast.Call):
+                tail = (dotted_name(node.func) or "").split(".")[-1]
+                w = donating.get(tail)
+                if w is None:
+                    continue
+                for pos in w.donate:
+                    if pos < len(node.args) and isinstance(
+                            node.args[pos], ast.Name):
+                        donations.append(
+                            (node.args[pos].id, tail, node.lineno))
+        for var, callee, call_line in donations:
+            # `x = f(x)` stores x on the call line itself — the donated
+            # name is rebound to the RESULT, so later reads are safe;
+            # any read before the next rebinding reads a dead buffer
+            rebinds = [ln for ln in stores.get(var, []) if ln >= call_line]
+            horizon = min(rebinds) if rebinds else float("inf")
+            for use in loads.get(var, []):
+                if call_line < use.lineno < horizon:
+                    yield use, (
+                        f"`{var}` was donated to jit-compiled "
+                        f"`{callee}` on line {call_line}; its buffer "
+                        "may already be overwritten here")
+                    break

@@ -1,0 +1,149 @@
+"""Runtime sanitizers — the dynamic companion to the static rules.
+
+:func:`recompile_guard` counts XLA compilations inside a ``with``
+block and fails when the budget is exceeded. It is the runtime proof
+behind RECOMP001: a serving/decode path that is SUPPOSED to compile
+one program per (chunk width, decode shape) can silently start
+recompiling per step after an innocent-looking change (a Python scalar
+leaking into the traced signature, a shape that stopped being padded);
+latency then quietly 10x's. Tests pin the expected compile count so
+the regression fails loudly instead.
+
+Implementation: jax logs one "Compiling <name> with global shapes and
+types [...]" record per XLA compilation (module ``jax._src.
+interpreters.pxla``, DEBUG level unless jax_log_compiles is set). The
+guard attaches a logging handler, parses those records into
+:class:`CompileEvent`s, and checks the count on exit. No private jax
+API is touched; if the logging shape ever changes the guard counts 0
+and pinned tests fail visibly rather than silently passing a
+regression (they assert an EXACT nonzero count on the warm-up run).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import re
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+__all__ = ["CompileEvent", "RecompileError", "RecompileGuard",
+           "recompile_guard"]
+
+# one logger per jax version family; 0.4.x emits from pxla, newer from
+# _src.compiler — listening on both costs nothing
+_COMPILE_LOGGERS = (
+    "jax._src.interpreters.pxla",
+    "jax._src.compiler",
+)
+_COMPILING_RE = re.compile(
+    r"Compiling (\S+)"
+    r"(?: with global shapes and types (.+?)(?:\. Argument mapping.*)?)?$")
+
+
+class RecompileError(AssertionError):
+    """The guarded block compiled more XLA programs than budgeted."""
+
+
+@dataclass(frozen=True)
+class CompileEvent:
+    name: str      # the jitted function's name as XLA sees it
+    shapes: str    # "[ShapedArray(int32[2,8]), ...]" — the arg shapes
+    message: str   # full log record, for diagnostics
+
+    def __str__(self):
+        return f"{self.name} {self.shapes}"
+
+
+class RecompileGuard:
+    """Collects CompileEvents; ``count()``/``events()`` filter by the
+    compiled function name (regex search)."""
+
+    def __init__(self, match: Optional[str] = None):
+        self._match = match
+        self._events: List[CompileEvent] = []
+        self._lock = threading.Lock()
+
+    def _record(self, message: str):
+        m = _COMPILING_RE.search(message)
+        if not m:
+            return
+        ev = CompileEvent(m.group(1), m.group(2) or "", message)
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self, match: Optional[str] = None) -> List[CompileEvent]:
+        pat = match if match is not None else self._match
+        with self._lock:
+            evs = list(self._events)
+        if pat is None:
+            return evs
+        rx = re.compile(pat)
+        return [e for e in evs if rx.search(e.name)]
+
+    def count(self, match: Optional[str] = None) -> int:
+        return len(self.events(match))
+
+    def names(self, match: Optional[str] = None) -> List[str]:
+        return [e.name for e in self.events(match)]
+
+
+class _GuardHandler(logging.Handler):
+    def __init__(self, guard: RecompileGuard):
+        super().__init__(level=logging.DEBUG)
+        self._guard = guard
+
+    def emit(self, record):
+        try:
+            self._guard._record(record.getMessage())
+        except Exception:  # noqa: BLE001 — logging must never raise
+            pass
+
+
+@contextlib.contextmanager
+def recompile_guard(max_compiles: Optional[int] = None,
+                    match: Optional[str] = None):
+    """Count XLA compilations in the block; raise :class:`RecompileError`
+    when more than ``max_compiles`` programs (whose names match
+    ``match``, a regex, when given) were compiled.
+
+    ``max_compiles=None`` only observes — read ``guard.count()`` /
+    ``guard.events()`` afterwards. ``max_compiles=0`` asserts the block
+    runs entirely on cached programs (the "warmed up, no silent
+    retrace" pin)::
+
+        with recompile_guard(match=r"prefill|decode") as g:
+            engine.run()            # warm-up: compiles the programs
+        assert g.count() == 2
+        with recompile_guard(max_compiles=0, match=r"prefill|decode"):
+            engine.run()            # steady state: cache hits only
+
+    Guards nest; each sees every compilation inside its own block.
+    """
+    guard = RecompileGuard(match)
+    handler = _GuardHandler(guard)
+    loggers = [logging.getLogger(n) for n in _COMPILE_LOGGERS]
+    saved = [(lg, lg.level, lg.propagate) for lg in loggers]
+    for lg in loggers:
+        # the compile records are DEBUG unless jax_log_compiles is on;
+        # lower only the two compile loggers, never the root — and stop
+        # propagation so the temporarily-DEBUG records don't spray
+        # through the application's root handler while the guard runs
+        if lg.getEffectiveLevel() > logging.DEBUG:
+            lg.setLevel(logging.DEBUG)
+            lg.propagate = False
+        lg.addHandler(handler)
+    try:
+        yield guard
+    finally:
+        for lg, lvl, prop in saved:
+            lg.removeHandler(handler)
+            lg.setLevel(lvl)
+            lg.propagate = prop
+    if max_compiles is not None and guard.count() > max_compiles:
+        evs = "\n  ".join(str(e) for e in guard.events())
+        raise RecompileError(
+            f"recompile_guard: {guard.count()} XLA compilation(s) in a "
+            f"block budgeted for {max_compiles}"
+            + (f" (match={match!r})" if match else "")
+            + f":\n  {evs}")

@@ -35,7 +35,12 @@ class CostModel:
             return out._data if isinstance(out, Tensor) else out
 
         compiled = jax.jit(pure).lower(*raw).compile()
-        cost = dict(compiled.cost_analysis() or {})
+        raw_cost = compiled.cost_analysis() or {}
+        if isinstance(raw_cost, (list, tuple)):
+            # jax <= 0.4.x returns a one-element list of per-device
+            # dicts; 0.5+ returns the dict directly
+            raw_cost = raw_cost[0] if raw_cost else {}
+        cost = dict(raw_cost)
         out = compiled(*raw)
         jax.block_until_ready(out)
         t0 = time.perf_counter()

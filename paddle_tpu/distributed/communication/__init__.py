@@ -402,14 +402,18 @@ def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None, sync_op: b
     )
 
 
-def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None, sync_op: bool = True):
-    """P2P recv — same SPMD pairing rule as :func:`send`."""
+def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None, sync_op: bool = True,
+         deadline=None):
+    """P2P recv — same SPMD pairing rule as :func:`send`. ``deadline``
+    (seconds or a ``utils.retries.Deadline``) bounds the multi-
+    controller blocking wait; callers splitting one job budget thread
+    it here (the DDL001 discipline)."""
     g = _resolve(group)
     x = _data(tensor)
     if not _is_traced(x):
         mc = _mc_if_active(g, "recv")
         if mc is not None:
-            arr = mc.eager_recv(src=src)
+            arr = mc.eager_recv(src=src, deadline=deadline)
             tensor._inplace_from(Tensor(jnp.asarray(arr), _internal=True))
             return
         _eager_guard(g, "recv")

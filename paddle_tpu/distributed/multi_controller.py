@@ -251,8 +251,20 @@ def eager_send(x, dst: int) -> None:
         f"ptpu_p2p/{me}/{dst}/{seq}", pickle.dumps(arr))
 
 
-def eager_recv(src: int, timeout_ms: int = 600_000) -> np.ndarray:
+def eager_recv(src: int, timeout_ms: int = 600_000,
+               deadline=None) -> np.ndarray:
+    """``deadline`` (seconds or a utils.retries.Deadline) caps the wait
+    below ``timeout_ms`` — callers splitting one job budget across a
+    recv sequence thread it here and the blocking get can never
+    outlive it (the DDL001 discipline)."""
     me = jax.process_index()
+    if deadline is not None:
+        from ..utils.retries import Deadline
+
+        dl = Deadline.coerce(deadline)
+        dl.check(f"eager_recv(src={src})")
+        timeout_ms = int(min(float(timeout_ms),
+                             dl.timeout(timeout_ms / 1000.0) * 1000.0))
     # the pair counter commits only AFTER a successful receive: a
     # timed-out get followed by a retry must wait on the SAME seq the
     # sender published, not permanently skip past it (pair desync)

@@ -51,7 +51,6 @@ from __future__ import annotations
 import ast
 import contextlib
 import inspect
-import re
 import sys
 import textwrap
 from typing import Any, Callable, Dict, List, Sequence, Tuple
@@ -1093,52 +1092,16 @@ def _stmt_names(stmts, ctx_type):
     return out
 
 
-_OPTIMIZERISH = re.compile(
-    r"(^|_)(opt|optim|optimizer|sgd|adam\w*|adagrad|rmsprop|lamb|lars|"
-    r"momentum)(_?\d+)?$", re.IGNORECASE)
-
-
 def _autograd_hazard(stmts) -> bool:
     """AST-level scan for autograd activity in the break/suffix of a
-    piecewise split (ADVICE r5: the old substring scan over the
-    unparsed source demoted on ANY ``.step(`` / ``.grad``-prefixed
-    token, so a safe split with ``scheduler.step()`` / ``profiler.
-    step()`` / ``.grad_fn`` after the break fell all the way back to
-    whole-function eager). Hazards:
+    piecewise split. The scan itself lives on the shared graft-lint
+    analyzer core (``analysis/astutils.py``) so the piecewise splitter
+    and the TRACE rules agree on one definition of "optimizer-shaped
+    receiver" — see ``analysis.astutils.autograd_hazard`` for the full
+    hazard list and the ADVICE-r5 history (substring scan → AST scan)."""
+    from ..analysis.astutils import autograd_hazard
 
-    - any ``*.backward(...)`` call;
-    - any ``*.grad(...)`` call or bare ``.grad`` attribute read (the
-      EXACT attribute — ``.grad_fn``/``.gradient`` don't match);
-    - ``.step()``/``.minimize()``/``.clear_grad()`` calls whose
-      receiver NAME looks like an optimizer (``opt``/``optimizer``/
-      ``sgd``/``adamw``/... — scheduler.step()/profiler.step() pass).
-
-    Deliberately name-based, not type-based (this is a static scan):
-    an optimizer bound to an unrecognizable name slips through HERE,
-    but the runtime tape backstop still catches it — a cotangent
-    reaching a carry-marked tensor raises and the caller demotes
-    (jit/__init__.py _check_carry / base/tape.py run_backward)."""
-
-    def _receiver_name(node):
-        if isinstance(node, ast.Name):
-            return node.id
-        if isinstance(node, ast.Attribute):  # self.opt.step() -> "opt"
-            return node.attr
-        return ""
-
-    for stmt in stmts:
-        for node in ast.walk(stmt):
-            if isinstance(node, ast.Attribute):
-                if node.attr == "backward" or node.attr == "grad":
-                    # covers x.backward()/loss.backward(), paddle.grad(
-                    # ...) and p.grad reads in one arm: the call forms
-                    # are Attribute nodes under a Call's func
-                    return True
-                if node.attr in ("step", "minimize", "clear_grad") \
-                        and _OPTIMIZERISH.search(
-                            _receiver_name(node.value)):
-                    return True
-    return False
+    return autograd_hazard(stmts)
 
 
 def split_at_break(fn: Callable, break_line: int):

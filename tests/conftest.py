@@ -68,6 +68,10 @@ _SLOW_FILES = {
 def pytest_configure(config):
     config.addinivalue_line("markers", "quick: fast subset (< 5 min total)")
     config.addinivalue_line("markers", "slow: heavyweight tests (CI shard 2)")
+    config.addinivalue_line(
+        "markers",
+        "analysis: graft-lint static-analysis + recompile-sanitizer gate "
+        "(standalone via `pytest -m analysis`, < 60 s)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -2,6 +2,7 @@
 fully present, plus numeric checks for the newly added long-tail ops
 (ref: python/paddle/__init__.py __all__; tensor/math.py additions)."""
 import ast
+import os
 
 import numpy as np
 import pytest
@@ -10,7 +11,16 @@ from scipy import special as sps
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 
+# the file-list sweeps read the reference checkout; containers without
+# it (the reference tree ships only on parity-audit boxes) skip them —
+# the numeric checks below still run everywhere
+_REFERENCE = "/root/reference/python/paddle"
+_needs_reference = pytest.mark.skipif(
+    not os.path.isdir(_REFERENCE),
+    reason=f"reference checkout not present at {_REFERENCE}")
 
+
+@_needs_reference
 def test_reference_tensor_methods_covered():
     """Every name in the reference's tensor_method_func list must be a
     Tensor method (ref: python/paddle/tensor/__init__.py)."""
@@ -46,6 +56,7 @@ def test_top_p_sampling_and_new_ops():
     assert tuple(t.shape) == (0,)
 
 
+@_needs_reference
 def test_reference_top_level_all_covered():
     src = open("/root/reference/python/paddle/__init__.py").read()
     names = None

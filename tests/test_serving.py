@@ -13,7 +13,10 @@ from paddle_tpu.inference.serving import ContinuousBatchingEngine
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.models.generation import generate
 
-pytestmark = pytest.mark.slow
+# NOTE: no module-level slow mark — this file is in conftest's
+# _SLOW_FILES, which auto-marks every test here slow EXCEPT those with
+# an explicit quick marker (TestRecompilePin: the compile-count gate
+# must run in the tier-1/-m analysis lanes)
 
 
 def _model():
@@ -358,3 +361,68 @@ class TestChunkedPrefill:
             prefill_chunk=8)
         eng2.add_request("big", np.zeros(40, np.int32), max_new_tokens=2)
         assert len(eng2._queue) == 1
+
+
+@pytest.mark.quick
+@pytest.mark.analysis
+class TestRecompilePin:
+    """ISSUE 3: the recompile_guard sanitizer pins the engine's compile
+    counts — the static-shape design promises ONE XLA program per
+    (prefill chunk width, decode batch shape), and a silent per-step
+    retrace (a Python scalar leaking into the traced signature, a shape
+    that stopped being padded) must fail THIS test instead of 10x'ing
+    latency in production."""
+
+    def test_one_compile_per_chunk_width_and_decode_shape(self):
+        from paddle_tpu.analysis import recompile_guard
+
+        model = _model()
+        rng = np.random.RandomState(21)
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=64, block_size=8, num_blocks=16,
+            prefill_chunk=8, max_num_batched_tokens=10)
+        # mixed prompts: sub-chunk, chunk-multiple, non-multiple — all
+        # must share the single width-8 prefill program
+        wave1 = {"a": 3, "b": 16, "c": 9}
+        for rid, n in wave1.items():
+            eng.add_request(rid, rng.randint(0, 250, (n,)),
+                            max_new_tokens=3)
+        with recompile_guard(match=r"^(prefill|decode)") as g:
+            done = eng.run()
+        assert set(done) == set(wave1)
+        # exactly one compile per phase program: one prefill (chunk
+        # width 8), one decode (batch shape [2]) — NOT one per prompt
+        # length and NOT one per engine step
+        assert sorted(g.names()) == ["decode", "prefill"], g.names()
+        for ev in g.events():
+            assert ev.shapes  # the (width/shape) identity is recorded
+
+        # steady state: a second mixed wave must be 100% cache hits
+        wave2 = {"d": 5, "e": 23, "f": 8}
+        for rid, n in wave2.items():
+            eng.add_request(rid, rng.randint(0, 250, (n,)),
+                            max_new_tokens=3)
+        with recompile_guard(max_compiles=0, match=r"^(prefill|decode)"):
+            done = eng.run()
+        assert set(wave2) <= set(done)  # run() returns cumulative map
+
+    def test_whole_prompt_mode_pins_too(self):
+        """Legacy (unchunked) mode: one prompt_pad-wide prefill program
+        + one decode program, then cache hits only."""
+        from paddle_tpu.analysis import recompile_guard
+
+        model = _model()
+        rng = np.random.RandomState(22)
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=32, block_size=8, num_blocks=8,
+            prompt_pad=8)
+        for rid in range(2):
+            eng.add_request(rid, rng.randint(0, 250, (4,)),
+                            max_new_tokens=2)
+        with recompile_guard(match=r"^(prefill|decode)") as g:
+            eng.run()
+        assert sorted(g.names()) == ["decode", "prefill"]
+        eng.add_request("late", rng.randint(0, 250, (6,)),
+                        max_new_tokens=2)
+        with recompile_guard(max_compiles=0, match=r"^(prefill|decode)"):
+            eng.run()
