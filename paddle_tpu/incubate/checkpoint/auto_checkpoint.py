@@ -12,7 +12,12 @@ relaunch). Differences by design:
 - saves go through ``framework.io.save`` (format-stable, the same
   files ``paddle.load`` reads) into ``<dir>/ckpt-<step>/``, written to
   a tmp directory and atomically renamed, with a ``meta.json`` done
-  marker — a killed save can never be mistaken for a valid checkpoint;
+  marker carrying a CRC32 + byte count of the payload — a killed save
+  can never be mistaken for a valid checkpoint, and a checkpoint whose
+  payload was later torn/truncated (partial flush, disk fault) fails
+  its checksum at resume: it is QUARANTINED (renamed ``*.corrupt``)
+  and resume falls back to the newest valid one instead of crashing
+  mid-restore;
 - ``async_save=True`` serializes on a background thread: jax arrays
   are immutable, so the train thread only captures REFERENCES (no
   device sync) and keeps stepping while the previous state writes out;
@@ -28,9 +33,24 @@ import os
 import shutil
 import threading
 import time
+import zlib
 from typing import Optional, Sequence
 
 ELASTIC_AUTO_CHECKPOINT_DIR = "PADDLE_AUTO_CHECKPOINT_DIR"  # env override
+
+
+def _crc32_file(path: str) -> int:
+    """Streaming CRC32 of a file (the integrity record ``meta.json``
+    carries per checkpoint). Deliberately a READ-BACK of the
+    just-written payload rather than a hash-during-serialize: it costs
+    one extra sequential read per save (on the async writer thread,
+    off the train path) and in exchange the recorded checksum covers
+    the write path itself — what resume() will actually load."""
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
 
 
 class AutoCheckpoint:
@@ -127,7 +147,8 @@ class AutoCheckpoint:
         except OSError:
             return out
         for name in names:
-            if not name.startswith("ckpt-") or name.endswith(".tmp"):
+            if (not name.startswith("ckpt-") or name.endswith(".tmp")
+                    or name.endswith(".corrupt")):
                 continue
             meta = os.path.join(self.dir, name, "meta.json")
             try:
@@ -158,9 +179,12 @@ class AutoCheckpoint:
             # mistake for a valid checkpoint
             if not _chaos.inject("ckpt.publish"):
                 return
+            payload = os.path.join(tmp, "state.pdparams")
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump({"step": step, "done": True,
-                           "time": time.time()}, f)
+                           "time": time.time(),
+                           "crc32": _crc32_file(payload),
+                           "payload_bytes": os.path.getsize(payload)}, f)
             try:
                 os.replace(tmp, final)  # atomic publish
             except OSError:
@@ -175,6 +199,20 @@ class AutoCheckpoint:
     def _prune(self):
         ckpts = self._list_ckpts()
         for _, path in ckpts[: -self.keep_last_k]:
+            shutil.rmtree(path, ignore_errors=True)
+        # quarantined post-mortem evidence is bounded the same way:
+        # keep the newest keep_last_k '*.corrupt' dirs (a persistently
+        # failing disk must not fill the volume with full-size
+        # corpses). Ordered by quarantine mtime, NOT name — the names
+        # interleave step numbers and pids lexicographically.
+        try:
+            corrupt = [os.path.join(self.dir, n)
+                       for n in os.listdir(self.dir)
+                       if n.endswith(".corrupt")]
+            corrupt.sort(key=lambda p: os.path.getmtime(p))
+        except OSError:
+            return
+        for path in corrupt[: -self.keep_last_k]:
             shutil.rmtree(path, ignore_errors=True)
 
     def save_now(self, step: int, block: bool = False):
@@ -221,15 +259,64 @@ class AutoCheckpoint:
             ) from err
 
     # -- resume ----------------------------------------------------------
+    def _verify(self, path: str) -> Optional[bool]:
+        """Checksum the payload against the ``meta.json`` record.
+        Tri-state: True = intact; False = PROVEN mismatch (truncation,
+        bit rot, torn flush) — quarantine it; None = could not read
+        right now (transient fs error) — skip WITHOUT quarantining, so
+        an NFS blip can never destroy a valid checkpoint. Checkpoints
+        written before CRC recording (no ``crc32`` key) pass — they
+        stay loadable. Any proven mismatch fails BEFORE a deserialize
+        is attempted."""
+        try:
+            with open(os.path.join(path, "meta.json")) as f:
+                meta = json.load(f)
+        except ValueError:
+            return False  # the marker itself is torn
+        except OSError:
+            return None
+        if "crc32" not in meta:
+            return True
+        payload = os.path.join(path, "state.pdparams")
+        try:
+            if ("payload_bytes" in meta
+                    and os.path.getsize(payload) != meta["payload_bytes"]):
+                return False
+            return _crc32_file(payload) == meta["crc32"]
+        except FileNotFoundError:
+            return False  # published marker but no payload: torn
+        except OSError:
+            return None
+
+    def _quarantine(self, path: str):
+        """Move a corrupt checkpoint out of the scan set (``*.corrupt``)
+        so every future resume skips it without re-hashing — kept on
+        disk for post-mortems rather than silently deleted. The suffix
+        carries pid+time so a re-saved-then-re-corrupted step (same
+        failing disk, same name) quarantines alongside the first
+        incident instead of colliding into the deletion fallback."""
+        dest = f"{path}.{os.getpid()}-{int(time.time() * 1000)}.corrupt"
+        try:
+            os.rename(path, dest)
+        except OSError:
+            shutil.rmtree(path, ignore_errors=True)
+
     def resume(self) -> int:
         """Restore the newest valid checkpoint into the registered
         layers/optimizers. Returns the NEXT step to run (saved step + 1),
-        or 0 when no valid checkpoint exists. Unloadable checkpoints are
-        skipped (next-newest wins) — a half-written save never blocks
+        or 0 when no valid checkpoint exists. Corrupt checkpoints
+        (checksum mismatch) are quarantined and unloadable ones skipped
+        (next-newest wins) — a half-written or torn save never blocks
         the relaunch."""
         from ...framework import io as fio
 
         for step, path in reversed(self._list_ckpts()):
+            intact = self._verify(path)
+            if intact is None:
+                continue  # transiently unreadable: try the next-newest
+            if intact is False:
+                self._quarantine(path)
+                continue
             try:
                 state = fio.load(os.path.join(path, "state.pdparams"))
             except Exception:  # noqa: BLE001 — fall back to older ckpt

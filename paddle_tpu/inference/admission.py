@@ -1,0 +1,258 @@
+"""Overload control for the serving engine: admit cheaply or shed at
+the front door.
+
+Under sustained overload an engine that accepts everything burns
+prefill tokens on requests it will later deadline-evict — the worst
+possible place to spend capacity. The production-proven shape (DAGOR,
+WeChat's adaptive overload control; SOSP'19 overload-control study) is
+the opposite: reject EXCESS AT SUBMIT TIME from a cheap load signal,
+keep a priority order so latency-sensitive traffic rides out the storm,
+and adapt the admission threshold to measured queueing delay rather
+than a static constant.
+
+Three cooperating pieces, all host-side and model-free:
+
+- :class:`EngineLoad` — the live load signal
+  :meth:`ContinuousBatchingEngine.load` snapshots every step: queue
+  depth, KV-block occupancy, token backlog (queued + in-flight work),
+  EWMA step latency/throughput, and the derived queueing-delay
+  estimate. Routers and tests read the same struct the controller
+  decides from.
+- :class:`AdmissionConfig` — the knobs: bounded waiting queue
+  (``max_queue``), shed watermarks, the degraded-mode KV watermarks
+  (pause prefill admission / clamp batch token grants), and the
+  DAGOR-style delay target driving the adaptive level.
+- :class:`AdmissionController` — the decision. Two priority classes
+  (``interactive`` ahead of ``batch``; deadline-aware ordering within a
+  class), watermark shedding of batch traffic, queue-full displacement
+  (an interactive arrival evicts the worst queued batch request instead
+  of being shed), a deadline-feasibility test (a request that cannot
+  finish inside its budget is shed now, not expired later), and an
+  adaptive admission level that tightens batch → everything as the
+  measured queueing delay crosses the target (hysteresis + hold to
+  avoid flapping).
+
+The controller is deliberately engine-agnostic: it consumes
+:class:`EngineLoad` values and returns verdicts, so it unit-tests
+without a model and could front any engine with the same signal.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import asdict, dataclass
+from typing import Optional, Tuple
+
+__all__ = [
+    "PRIORITIES",
+    "priority_rank",
+    "EngineLoad",
+    "AdmissionConfig",
+    "AdmissionController",
+]
+
+# lower rank = more important; admission order and shedding order both
+# key off this (batch absorbs the shedding first)
+PRIORITIES = ("interactive", "batch")
+_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+
+
+def priority_rank(priority: str) -> int:
+    try:
+        return _RANK[priority]
+    except KeyError:
+        raise ValueError(
+            f"unknown priority {priority!r}; expected one of {PRIORITIES}"
+        ) from None
+
+
+@dataclass
+class EngineLoad:
+    """One step's load snapshot (the struct ``engine.load()`` returns).
+
+    ``token_backlog`` counts REAL tokens of committed work: queued
+    prompts + their full generation budgets, plus the un-prefilled and
+    un-generated remainder of every in-flight slot.
+    ``est_queue_delay_s`` is ``token_backlog / tokens_per_step *
+    ewma_step_s`` — how long a new arrival waits before its work is
+    scheduled, at the measured service rate."""
+
+    queue_depth: int = 0
+    queue_limit: Optional[int] = None
+    queued_interactive: int = 0
+    queued_batch: int = 0
+    # tokens AHEAD of a new interactive arrival: in-flight remainders
+    # plus queued interactive work (priority insertion puts it in
+    # front of every queued batch request, so batch backlog does not
+    # delay it)
+    token_backlog_interactive: int = 0
+    active_slots: int = 0
+    max_batch: int = 0
+    prefilling: int = 0
+    kv_free_blocks: int = 0
+    kv_total_blocks: int = 0
+    kv_occupancy: float = 0.0
+    token_backlog: int = 0
+    tokens_per_step: float = 0.0
+    ewma_step_s: Optional[float] = None
+    est_queue_delay_s: float = 0.0
+    admission_level: int = 0
+    prefill_paused: bool = False
+    n_shed_interactive: int = 0
+    n_shed_batch: int = 0
+    n_expired: int = 0
+
+    @property
+    def queue_frac(self) -> float:
+        if not self.queue_limit:
+            return 0.0
+        return self.queue_depth / float(self.queue_limit)
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class AdmissionConfig:
+    """Knobs for :class:`AdmissionController` and the engine's degraded
+    modes. Defaults are deliberately permissive: only the bounded queue
+    and the expired-at-submit fast path are active until watermarks /
+    targets are tightened."""
+
+    max_queue: int = 64           # bounded waiting queue (DAGOR front door)
+    high_watermark: float = 0.85  # load score that sheds batch traffic
+    low_watermark: float = 0.5    # adaptive level relaxes below this
+    # degraded modes (engine-side): pause NEW admissions when KV blocks
+    # are scarce; clamp batch-class token grants under pressure. 1.0
+    # means "only when the pool is fully allocated" — effectively off.
+    kv_pause_watermark: float = 1.0
+    kv_clamp_watermark: float = 1.0
+    batch_clamp_tokens: Optional[int] = None  # None = never clamp
+    # DAGOR-style adaptation: tighten the admission level when the
+    # estimated queueing delay crosses the target (None = static)
+    target_delay_s: Optional[float] = None
+    level_hold: int = 8           # observations between level moves
+    ewma_alpha: float = 0.3
+    # shed requests that cannot finish inside their deadline at the
+    # measured service rate (margin > 1 sheds earlier)
+    deadline_feasibility: bool = True
+    feasibility_margin: float = 1.0
+
+    def __post_init__(self):
+        if self.max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise ValueError("ewma_alpha must be in (0, 1]")
+        if self.low_watermark > self.high_watermark:
+            raise ValueError("low_watermark must be <= high_watermark")
+
+
+class AdmissionController:
+    """Stateful front door: verdicts from load snapshots.
+
+    ``level`` is the adaptive priority threshold (DAGOR's admission
+    level collapsed to this engine's two classes): 0 admits every
+    class, 1 sheds batch, 2 sheds everything. It tightens one notch
+    when the delay EWMA exceeds ``target_delay_s`` and relaxes when the
+    EWMA falls below ``target_delay_s * low_watermark``, holding
+    ``level_hold`` observations between moves so one noisy step cannot
+    flap the threshold."""
+
+    MAX_LEVEL = 2
+
+    def __init__(self, config: Optional[AdmissionConfig] = None, *,
+                 clock=time.monotonic):
+        self.config = config if config is not None else AdmissionConfig()
+        self._clock = clock
+        self.level = 0
+        self.delay_ewma = 0.0
+        self._since_change = self.config.level_hold  # free first move
+
+    # -- load tracking --------------------------------------------------
+    def observe(self, load: EngineLoad, *,
+                allow_tighten: bool = True) -> None:
+        """Fold one load snapshot into the delay EWMA and maybe move
+        the admission level (hysteresis + hold). ``allow_tighten=False``
+        restricts this observation to DOWNWARD moves — the idle-decay
+        path, where the caller cannot vouch for a fresh service-rate
+        estimate."""
+        cfg = self.config
+        a = cfg.ewma_alpha
+        self.delay_ewma = (a * load.est_queue_delay_s
+                           + (1.0 - a) * self.delay_ewma)
+        self._since_change += 1
+        if cfg.target_delay_s is None or self._since_change < cfg.level_hold:
+            return
+        if (self.delay_ewma > cfg.target_delay_s
+                and self.level < self.MAX_LEVEL and allow_tighten):
+            self.level += 1
+            self._since_change = 0
+        elif (self.delay_ewma < cfg.target_delay_s * cfg.low_watermark
+                and self.level > 0):
+            self.level -= 1
+            self._since_change = 0
+
+    def score(self, load: EngineLoad) -> float:
+        """Composite load score in [0, inf): the worst of queue
+        pressure and (when a target is set) normalized queueing delay.
+        KV scarcity is handled by the engine's degraded modes, not the
+        shed score — a full pool at steady state is healthy."""
+        cfg = self.config
+        q = (load.queue_frac if load.queue_limit
+             else load.queue_depth / float(cfg.max_queue))
+        d = 0.0
+        if cfg.target_delay_s:
+            d = self.delay_ewma / cfg.target_delay_s
+        return max(q, d)
+
+    # -- the decision ---------------------------------------------------
+    def decide(self, req, load: EngineLoad) -> Tuple[str, str]:
+        """Verdict for one submission: ``("admit", "")``,
+        ``("shed", reason)``, or ``("displace", reason)`` — admit this
+        interactive request by shedding the worst queued batch request
+        (the engine performs the displacement). ``req`` needs
+        ``priority``, ``prompt``, ``max_new_tokens``, ``deadline``/
+        ``expired()`` — the engine's GenRequest shape."""
+        cfg = self.config
+        rank = priority_rank(req.priority)
+        if req.expired():
+            # fast path: a dead-on-arrival budget never enters the queue
+            return ("shed", "expired-at-submit")
+        if self.level >= 2:
+            return ("shed", "overload")
+        if self.level >= 1 and rank >= 1:
+            return ("shed", "overload-batch")
+        # feasibility BEFORE the queue-full/displace branch: a doomed
+        # arrival must never evict viable queued work only to expire
+        # itself — shedding it here loses zero requests
+        if (cfg.deadline_feasibility and req.deadline is not None
+                and load.ewma_step_s):
+            tps = max(load.tokens_per_step, 1.0)
+            own = int(req.prompt.size) + int(req.max_new_tokens)
+            service = own / tps * load.ewma_step_s
+            if rank == 0:
+                # interactive jumps ahead of queued batch work: only
+                # the class-aware backlog delays it — reasoning from
+                # the whole backlog would shed exactly the latency-
+                # sensitive traffic this controller exists to protect
+                wait = (load.token_backlog_interactive / tps
+                        * load.ewma_step_s)
+            else:
+                wait = load.est_queue_delay_s
+            if req.deadline.remaining() < (wait + service) * \
+                    cfg.feasibility_margin:
+                return ("shed", "deadline-infeasible")
+        if load.queue_depth >= cfg.max_queue:
+            if rank == 0 and load.queued_batch > 0:
+                return ("displace", "queue-full-displaces-batch")
+            return ("shed", "queue-full")
+        if rank >= 1 and self.score(load) >= cfg.high_watermark:
+            return ("shed", "watermark")
+        return ("admit", "")
+
+    def snapshot(self) -> dict:
+        return {
+            "level": self.level,
+            "delay_ewma_s": self.delay_ewma,
+            "target_delay_s": self.config.target_delay_s,
+            "max_queue": self.config.max_queue,
+        }

@@ -45,12 +45,26 @@ Two prefill policies:
   allocation). Deadline eviction works mid-prefill: a partially
   prefilled slot's blocks recycle immediately.
 
+Overload control (ISSUE 4): with an :class:`AdmissionConfig` the
+engine grows a front door — a bounded priority queue (interactive ahead
+of batch, deadline-aware within a class), watermark/adaptive-level load
+shedding at ``add_request`` time (``status="shed"``, never admitted),
+queue-full displacement (interactive arrivals evict the worst queued
+batch request), and degraded modes when KV blocks run scarce (pause new
+admissions; clamp batch-class token grants). ``engine.load()`` exposes
+the live load signal the controller decides from. ``fence()`` +
+``requeue()`` are the supervisor's crash-only recovery hooks (see
+inference/supervisor.py): a fenced engine refuses further steps, and
+requeue re-enters already-accepted work into a rebuilt engine without
+re-running admission control.
+
 Greedy decoding (temperature 0) — matching models.generation.generate's
 default — so engine outputs are token-identical to isolated generate()
 runs, which is the correctness contract the tests assert.
 """
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -64,8 +78,39 @@ from ..base.tensor import Tensor
 from ..ops.paged_attention import BlockManager, PagedLayerCache
 from ..testing import chaos as _chaos
 from ..utils.retries import Deadline
+from .admission import (
+    AdmissionConfig,
+    AdmissionController,
+    EngineLoad,
+    priority_rank,
+)
 
-__all__ = ["GenRequest", "ContinuousBatchingEngine"]
+__all__ = ["GenRequest", "ContinuousBatchingEngine", "EngineFenced"]
+
+
+class EngineFenced(RuntimeError):
+    """The engine was retired by its supervisor: a recovery already
+    rebuilt a replacement, so this instance must not touch its
+    (transferred) requests again. ``step()`` raises it after the fence
+    is set — the seam that lets an abandoned, formerly-hung step thread
+    wake up and exit without corrupting anything."""
+
+
+def _exec_lock_for(model) -> threading.Lock:
+    """Compiled-phase execution is serialized across engine instances
+    SHARING A MODEL: the traced bodies temporarily rebind the shared
+    Parameters' ``_data`` to tracers, so a supervisor-abandoned step
+    thread still inside a jit call must never overlap a replacement
+    engine's dispatch (the newcomer would capture tracers as inputs).
+    The lock lives on the model — engines over disjoint parameter sets
+    keep executing concurrently — and also gives ``_run_jit`` a safe
+    place to honor the fence: a runner that was blocked on it while
+    its engine was retired raises instead of working."""
+    lock = getattr(model, "__serving_exec_lock__", None)
+    if lock is None:
+        lock = threading.Lock()
+        model.__serving_exec_lock__ = lock
+    return lock
 
 
 @dataclass
@@ -75,10 +120,16 @@ class GenRequest:
     budget: admission rejects it once expired, and an in-flight slot is
     EVICTED when it expires mid-decode or MID-PREFILL — one
     stuck/abandoned client can never pin a slot (its blocks recycle
-    immediately). ``status`` is "ok" for a normally finished request,
-    "expired" for a rejected or evicted one (whatever tokens were
-    produced stay in ``out``). ``times[i]`` is the perf_counter stamp
-    when ``out[i]`` was produced; with ``t_submit`` it gives
+    immediately). ``status``: "ok" for a normally finished request,
+    "expired" for a deadline-evicted one (whatever tokens were produced
+    stay in ``out``), "shed" for one rejected at admission (overload
+    control — it never consumed any token budget; ``shed_reason`` says
+    why), "poisoned" for one quarantined by the supervisor after
+    repeatedly killing the engine. ``priority`` is the admission class
+    ("interactive" | "batch"); ``retries`` counts supervisor recoveries
+    this request was in flight for; ``clamped`` records a degraded-mode
+    ``max_new_tokens`` reduction. ``times[i]`` is the perf_counter
+    stamp when ``out[i]`` was produced; with ``t_submit`` it gives
     time-to-first-token and inter-token latencies for free."""
 
     req_id: object
@@ -89,6 +140,10 @@ class GenRequest:
     status: str = "ok"
     t_submit: float = 0.0
     times: List[float] = field(default_factory=list)
+    priority: str = "interactive"
+    shed_reason: Optional[str] = None
+    retries: int = 0
+    clamped: bool = False
 
     def expired(self) -> bool:
         return self.deadline is not None and self.deadline.expired()
@@ -126,7 +181,8 @@ class ContinuousBatchingEngine:
                  eos_token_id: Optional[int] = None,
                  decode_chunk: int = 1,
                  prefill_chunk: Optional[int] = None,
-                 max_num_batched_tokens: Optional[int] = None):
+                 max_num_batched_tokens: Optional[int] = None,
+                 admission: Optional[AdmissionConfig] = None):
         """``num_blocks`` fixes the HBM budget (the pool allocates one
         extra trash block); ``max_len`` bounds any sequence's positions
         (tables carry ceil(max_len/block_size) slots per row);
@@ -150,6 +206,14 @@ class ContinuousBatchingEngine:
         (>= max_batch — the decode dispatch is indivisible) and one
         chunk (>= prefill_chunk — otherwise a lone prefill could never
         be scheduled).
+
+        ``admission=AdmissionConfig(...)`` turns on overload control:
+        submissions run through an :class:`AdmissionController` (shed
+        vs admit vs displace), the waiting queue becomes a bounded
+        priority queue, and the KV watermarks drive the degraded modes
+        (pause new admissions / clamp batch token grants). Without it
+        the queue stays plain FIFO and every submission is accepted —
+        the pre-overload-control behaviour, bit for bit.
         """
         self.model = model
         self.B = int(max_batch)
@@ -218,6 +282,19 @@ class ContinuousBatchingEngine:
         self.prefill_tokens = 0
         self.last_step_tokens = 0
         self.max_step_tokens = 0
+        # overload control + supervision surface
+        self.admission = (None if admission is None
+                          else AdmissionController(admission))
+        self.n_shed = {"interactive": 0, "batch": 0}
+        self._pending_shed: List[GenRequest] = []  # sheds since drain
+        self.n_expired = 0  # accepted-then-expired (queue or in-flight)
+        self.prefill_paused = False  # degraded mode: KV blocks scarce
+        self.ewma_step_s: Optional[float] = None
+        self.ewma_step_tokens: Optional[float] = None
+        self.last_step_s = 0.0
+        self._fenced = False
+        self._exec_lock = _exec_lock_for(model)
+        self._phases_run: set = set()  # compiled phases dispatched so far
 
     # -- compiled phases -------------------------------------------------
     def _caches_from(self, pools, tables_arr):
@@ -292,23 +369,55 @@ class ContinuousBatchingEngine:
         restore them afterwards: the traced body writes tracers into
         p._data; leaving them there would leak tracers into the next
         eager/jit use."""
-        current = [p._data for p in self._params]
-        try:
-            return jit_fn(current, *args)
-        finally:
-            for p, a in zip(self._params, current):
-                p._data = a
+        with self._exec_lock:
+            if self._fenced:
+                raise EngineFenced(
+                    "engine was retired by its supervisor while waiting "
+                    "for the compiled-phase lock")
+            current = [p._data for p in self._params]
+            try:
+                out = jit_fn(current, *args)
+            finally:
+                for p, a in zip(self._params, current):
+                    p._data = a
+        if self._fenced:
+            # a slow (not hung-forever) dispatch that outlived the
+            # watchdog: abort BEFORE the caller applies results — the
+            # supervisor already harvested/requeued this engine's work
+            raise EngineFenced(
+                "engine was retired by its supervisor mid-dispatch")
+        return out
 
     # -- public API ------------------------------------------------------
     @property
     def chunked(self) -> bool:
         return self.prefill_chunk is not None
 
+    @property
+    def warmed_up(self) -> bool:
+        """True once every compiled phase this configuration can
+        dispatch has run at least once — i.e. no first-call XLA
+        compile remains. The supervisor keeps a step under the roomy
+        ``warmup_budget`` until then: phases compile lazily at their
+        FIRST DISPATCH (the decode program's can be many steps after
+        step 1 in chunked mode), and multi-second compile latency must
+        not be diagnosed as a hang."""
+        need = {"prefill", "decode"}
+        if self.decode_chunk > 1:
+            need.add("decode_chunk")
+        return need <= self._phases_run
+
     def add_request(self, req_id, prompt, max_new_tokens: int = 32,
-                    deadline=None):
+                    deadline=None, priority: str = "interactive"):
         """``deadline``: seconds or a ``Deadline`` — the request's total
-        budget (queue wait included). None = no deadline."""
+        budget (queue wait included). None = no deadline. ``priority``
+        is the admission class ("interactive" | "batch") — only
+        meaningful with admission control on, but always recorded.
+        Returns the :class:`GenRequest`; with admission control a shed
+        submission comes back immediately with ``status == "shed"``
+        (it is also surfaced through the completed map)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        priority_rank(priority)  # validate before accepting anything
         if prompt.size == 0:
             raise ValueError("prompt length 0 not in [1, ...]")
         if not self.chunked and prompt.size > self.prompt_pad:
@@ -320,13 +429,174 @@ class ContinuousBatchingEngine:
             raise ValueError("prompt + max_new_tokens exceeds max_len")
         dl = None if deadline is None else Deadline.coerce(deadline)
         req = GenRequest(req_id, prompt, max_new_tokens, deadline=dl,
-                         t_submit=time.perf_counter())
+                         t_submit=time.perf_counter(), priority=priority)
         if self._blocks_needed(req) > self.manager.num_blocks:
             raise ValueError(
                 f"request needs {self._blocks_needed(req)} blocks but the "
                 f"pool only has {self.manager.num_blocks} — it could never "
                 "be admitted")
-        self._queue.append(req)
+        # chaos site: the front door (drop = the submission is shed)
+        if not _chaos.inject("serving.submit"):
+            return self._shed(req, "chaos-drop")
+        if self.admission is None:
+            self._queue.append(req)
+            return req
+        # dead queue entries must not count against the arrival: sweep
+        # deadline-lapsed requests (zero token cost) before the load
+        # snapshot, or a queue full of expired work would shed live
+        # traffic as 'queue-full'/'deadline-infeasible'
+        self._expire_queued()
+        # decide() reads a fresh load snapshot, but tightening
+        # observations only run from step(): the level-hold hysteresis
+        # is denominated in ENGINE STEPS, so an arrival burst between
+        # steps cannot ratchet the admission level on a stale
+        # service-rate estimate. A DRAINED engine never steps, though —
+        # without the relax-only tick below, an elevated level would
+        # latch forever (shed submissions create no pending work, so
+        # nothing ever drives the decay).
+        load = self.load()
+        if load.active_slots == 0 and load.queue_depth == 0:
+            self.admission.observe(load, allow_tighten=False)
+        verdict, reason = self.admission.decide(req, load)
+        if verdict == "shed":
+            return self._shed(req, reason)
+        if verdict == "displace":
+            # queue full, arrival is interactive: the worst queued
+            # batch request (last in priority/deadline order) absorbs
+            # the shed so latency-sensitive traffic still gets in. The
+            # victim decide() saw can vanish if a step runs between the
+            # load snapshot and here — then the queue has room anyway,
+            # or (still full of interactive) the arrival is shed.
+            victim = next((r for r in reversed(self._queue)
+                           if priority_rank(r.priority) >= 1), None)
+            if victim is not None:
+                try:
+                    self._queue.remove(victim)
+                except ValueError:
+                    victim = None
+            if victim is not None:
+                self._shed(victim, "displaced")
+            elif len(self._queue) >= self.admission.config.max_queue:
+                return self._shed(req, "queue-full")
+        self._enqueue(req)
+        return req
+
+    def _shed(self, req: GenRequest, reason: str) -> GenRequest:
+        req.status = "shed"
+        req.shed_reason = reason
+        self.n_shed[req.priority] = self.n_shed.get(req.priority, 0) + 1
+        self._completed[req.req_id] = req
+        self._pending_shed.append(req)
+        return req
+
+    def drain_shed(self) -> List[GenRequest]:
+        """Return (and clear) the requests shed since the last drain.
+        Sheds happen BETWEEN steps, so they never appear in a step()
+        return — this is the supervisor's O(1)-per-shed way to harvest
+        them (incl. displacement victims that were accepted earlier)."""
+        out, self._pending_shed = self._pending_shed, []
+        return out
+
+    def _enqueue(self, req: GenRequest):
+        """Priority insert: interactive ahead of batch; within a class,
+        tighter deadline first (unbounded budgets last, arrival order
+        preserved — the sort key is fixed at insert time)."""
+        rem = (float("inf") if req.deadline is None
+               else req.deadline.remaining())
+        req._okey = (priority_rank(req.priority), rem)
+        lo = 0
+        while lo < len(self._queue) and self._queue[lo]._okey <= req._okey:
+            lo += 1
+        self._queue.insert(lo, req)
+
+    def requeue(self, req: GenRequest):
+        """Re-enter an ALREADY-ACCEPTED request (the supervisor's
+        recovery path): bypasses admission control — accepted work is
+        never shed for load — and resets generation progress so the
+        rebuilt engine reproduces the full output from scratch (greedy
+        decode keeps survivors token-exact). One exception: a request
+        THIS engine can never serve (journal replayed onto a smaller
+        pool / shorter max_len / tighter prompt_pad) is shed instead of
+        queued — a permanently unadmittable queue head would livelock
+        every request behind it."""
+        req.out, req.times, req.status = [], [], "ok"
+        if not req.t_submit:
+            req.t_submit = time.perf_counter()
+        self._completed.pop(req.req_id, None)
+        if (int(req.prompt.size) + req.max_new_tokens > self.max_len
+                or self._blocks_needed(req) > self.manager.num_blocks
+                or (not self.chunked
+                    and int(req.prompt.size) > self.prompt_pad)):
+            self._shed(req, "unservable-on-this-engine")
+            return
+        if self.admission is not None:
+            self._enqueue(req)
+        else:
+            self._queue.append(req)
+
+    def fence(self):
+        """Retire this engine: every subsequent ``step()`` raises
+        :class:`EngineFenced`. Called by the supervisor before it
+        rebuilds, so an abandoned hung step thread that later wakes up
+        cannot mutate requests now owned by the replacement engine."""
+        self._fenced = True
+
+    def _kv_occupancy(self) -> float:
+        """Allocated fraction of the KV block pool — the one definition
+        the load signal, the pause watermark, and the clamp watermark
+        all share."""
+        return 1.0 - self.manager.free_blocks / max(self.manager.num_blocks,
+                                                    1)
+
+    def load(self) -> EngineLoad:
+        """Live load snapshot (the admission controller's input and the
+        router/health surface): queue depth + class mix, KV occupancy,
+        committed-token backlog, and the measured service rate."""
+        # snapshot-style reads throughout: health()/router probes call
+        # this from outside the step thread, so a slot may finish (or
+        # the queue mutate) mid-scan — bind each reference once and
+        # tolerate a request vanishing between reads
+        queue = list(self._queue)
+        backlog = sum(int(r.prompt.size) + r.max_new_tokens for r in queue)
+        backlog_inter = sum(int(r.prompt.size) + r.max_new_tokens
+                            for r in queue
+                            if priority_rank(r.priority) == 0)
+        for slot in self._slots:
+            req = slot.req
+            if req is not None:
+                ahead = (int(req.prompt.size) - slot.prefill_pos
+                         + slot.remaining)
+                backlog += ahead
+                backlog_inter += ahead  # in-flight work delays everyone
+        tps = self.ewma_step_tokens or float(
+            self.max_num_batched_tokens or self.B)
+        delay = (backlog / max(tps, 1e-9)) * (self.ewma_step_s or 0.0)
+        cfg = self.admission.config if self.admission is not None else None
+        return EngineLoad(
+            queue_depth=len(queue),
+            queue_limit=None if cfg is None else cfg.max_queue,
+            queued_interactive=sum(
+                priority_rank(r.priority) == 0 for r in queue),
+            queued_batch=sum(
+                priority_rank(r.priority) >= 1 for r in queue),
+            token_backlog_interactive=backlog_inter,
+            active_slots=self.num_active,
+            max_batch=self.B,
+            prefilling=self.num_prefilling,
+            kv_free_blocks=self.manager.free_blocks,
+            kv_total_blocks=self.manager.num_blocks,
+            kv_occupancy=self._kv_occupancy(),
+            token_backlog=backlog,
+            tokens_per_step=tps,
+            ewma_step_s=self.ewma_step_s,
+            est_queue_delay_s=delay,
+            admission_level=0 if self.admission is None
+            else self.admission.level,
+            prefill_paused=self.prefill_paused,
+            n_shed_interactive=self.n_shed.get("interactive", 0),
+            n_shed_batch=self.n_shed.get("batch", 0),
+            n_expired=self.n_expired,
+        )
 
     def _append_token(self, req: GenRequest, tok: int):
         req.out.append(tok)
@@ -334,7 +604,22 @@ class ContinuousBatchingEngine:
 
     def _expire(self, req: GenRequest):
         req.status = "expired"
+        self.n_expired += 1
         self._completed[req.req_id] = req
+
+    def _expire_queued(self):
+        """Fast path (no token budget spent): ANY queued request whose
+        deadline lapsed before its first prefill chunk finishes as
+        ``expired`` right here — not just the head-of-line one the
+        admission loop happens to look at."""
+        live = []
+        for r in self._queue:
+            if r.expired():
+                self._expire(r)
+            else:
+                live.append(r)
+        if len(live) != len(self._queue):
+            self._queue[:] = live
 
     def _evict_expired(self):
         """Reclaim slots whose request's deadline passed: free the
@@ -357,12 +642,13 @@ class ContinuousBatchingEngine:
     def num_prefilling(self):
         return sum(s.prefilling for s in self._slots)
 
-    def _blocks_needed(self, req):
+    def _blocks_needed(self, req, max_new_tokens: Optional[int] = None):
+        new = req.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
         if self.chunked:
-            total = int(req.prompt.size) + req.max_new_tokens
+            total = int(req.prompt.size) + new
         else:
-            total = max(int(req.prompt.size) + req.max_new_tokens,
-                        self.prompt_pad)
+            total = max(int(req.prompt.size) + new, self.prompt_pad)
         return self.manager.blocks_for(total)
 
     def _admit(self) -> int:
@@ -371,26 +657,62 @@ class ContinuousBatchingEngine:
         isolation via the trash table); chunked mode only binds the
         slot and reserves its full block budget — the token-budget
         scheduler feeds the prompt in chunks. Returns the number of
-        real tokens processed (whole-prompt admissions only)."""
+        real tokens processed (whole-prompt admissions only).
+
+        Degraded modes (admission control only): when KV occupancy
+        crosses ``kv_pause_watermark`` NEW admissions pause — in-flight
+        decode keeps draining and freeing blocks — and above
+        ``kv_clamp_watermark`` batch-class token grants are clamped to
+        ``batch_clamp_tokens`` at slot-binding time (shrinking the
+        block reservation with them)."""
+        cfg = self.admission.config if self.admission is not None else None
+        if cfg is not None:
+            if self._kv_occupancy() >= cfg.kv_pause_watermark:
+                self.prefill_paused = True
+                return 0
+            self.prefill_paused = False
         used = 0
         for slot_idx, slot in enumerate(self._slots):
             # admission rejects requests whose budget already expired
-            # while queued (the client gave up; don't burn a prefill)
+            # while queued (the client gave up; don't burn a prefill).
+            # NOTE on ordering here and below: a supervisor recovering
+            # a hung step snapshots queue → slots → completed and
+            # relies on every request being visible in AT LEAST ONE of
+            # those at any instant — so a request is expired/bound
+            # BEFORE it leaves the queue (briefly visible twice, never
+            # zero times; the supervisor dedups).
             while self._queue and self._queue[0].expired():
-                self._expire(self._queue.pop(0))
+                self._expire(self._queue[0])
+                self._queue.pop(0)
             if not self._queue or slot.active:
                 continue
             req = self._queue[0]
-            total = self._blocks_needed(req) * self.block_size
-            if not self.manager.can_allocate(req.req_id, total):
+            # degraded mode: decide the clamp BEFORE the admission
+            # gate (under real KV scarcity the clamped footprint is
+            # exactly what makes the batch request admittable), but
+            # APPLY it only after the gate passes — a request merely
+            # peeked at during a transient pressure spike must not
+            # keep a stale clamp
+            clamp = (cfg is not None and cfg.batch_clamp_tokens is not None
+                     and priority_rank(req.priority) >= 1
+                     and req.max_new_tokens > cfg.batch_clamp_tokens
+                     and self._kv_occupancy() >= cfg.kv_clamp_watermark)
+            eff_new = cfg.batch_clamp_tokens if clamp else req.max_new_tokens
+            if not self.manager.can_allocate(
+                    req.req_id,
+                    self._blocks_needed(req, eff_new) * self.block_size):
                 break  # head-of-line; keep FIFO fairness
-            self._queue.pop(0)
-            blocks = self.manager.allocate(req.req_id, total)
+            if clamp:
+                req.max_new_tokens = int(cfg.batch_clamp_tokens)
+                req.clamped = True
+            blocks = self.manager.allocate(
+                req.req_id, self._blocks_needed(req) * self.block_size)
             row = np.full((self.max_blocks_per_seq,), self._trash, np.int32)
             row[: len(blocks)] = blocks
             self._tables[slot_idx] = row
             slot.req = req
             slot.remaining = req.max_new_tokens
+            self._queue.pop(0)  # bound above: leaves the queue LAST
 
             if self.chunked:
                 slot.prefill_pos = 0
@@ -410,6 +732,7 @@ class ContinuousBatchingEngine:
             toks, self._pools = self._run_jit(
                 self._prefill_jit, self._pools, jnp.asarray(ids),
                 jnp.asarray(iso), jnp.zeros((self.B,), jnp.int32))
+            self._phases_run.add("prefill")
             first = int(np.asarray(toks)[slot_idx, req.prompt.size - 1])
             used += int(req.prompt.size)
             self.prefill_tokens += int(req.prompt.size)
@@ -492,6 +815,7 @@ class ContinuousBatchingEngine:
             toks, self._pools = self._run_jit(
                 self._prefill_jit, self._pools, jnp.asarray(ids),
                 jnp.asarray(iso), jnp.asarray(cl))
+            self._phases_run.add("prefill")
             toks = np.asarray(toks)  # [B, chunk]
             for i, start, real in round_rows:
                 slot = self._slots[i]
@@ -549,11 +873,13 @@ class ContinuousBatchingEngine:
                 self._chunk_jit, self._pools, jnp.asarray(tok),
                 jnp.asarray(tables), jnp.asarray(cl),
                 jnp.asarray(finished))
+            self._phases_run.add("decode_chunk")
             toks = np.asarray(toks)  # [K, B]
         else:
             nxt, self._pools = self._run_jit(
                 self._decode_jit, self._pools, jnp.asarray(tok),
                 jnp.asarray(tables), jnp.asarray(cl))
+            self._phases_run.add("decode")
             toks = np.asarray(nxt)[None]  # [1, B]
         for i in active:
             slot = self._slots[i]
@@ -577,7 +903,13 @@ class ContinuousBatchingEngine:
         ``status == "expired"``)."""
         if not _chaos.inject("serving.step"):
             return []  # dropped engine iteration: no work this tick
+        if self._fenced:
+            raise EngineFenced(
+                "engine was retired by its supervisor; a replacement "
+                "already owns the requests")
+        t0 = time.perf_counter()
         before = set(self._completed)
+        self._expire_queued()
         self._evict_expired()
         used = self._admit()
         budget = self.max_num_batched_tokens
@@ -587,6 +919,20 @@ class ContinuousBatchingEngine:
         self.steps += 1
         self.last_step_tokens = used
         self.max_step_tokens = max(self.max_step_tokens, used)
+        self.last_step_s = time.perf_counter() - t0
+        if used > 0:
+            # service-rate EWMAs feed the admission delay estimate;
+            # idle ticks are excluded so a quiet engine does not decay
+            # its measured capacity toward zero
+            a = (self.admission.config.ewma_alpha
+                 if self.admission is not None else 0.3)
+            self.ewma_step_s = self.last_step_s if self.ewma_step_s is None \
+                else a * self.last_step_s + (1 - a) * self.ewma_step_s
+            self.ewma_step_tokens = float(used) \
+                if self.ewma_step_tokens is None \
+                else a * used + (1 - a) * self.ewma_step_tokens
+        if self.admission is not None:
+            self.admission.observe(self.load())
         return [self._completed[r] for r in set(self._completed) - before]
 
     def run(self, max_steps: int = 100_000) -> Dict[object, GenRequest]:

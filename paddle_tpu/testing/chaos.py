@@ -18,6 +18,9 @@ Instrumented sites (grep for ``chaos.inject``):
 - ``ckpt.publish``       — just before the atomic rename (kill here
   leaves a torn tmp dir that resume() must skip)
 - ``serving.step``       — each engine iteration
+- ``serving.submit``     — each ``add_request`` front-door entry
+  (drop = the submission is shed at admission)
+- ``serving.loop``       — each supervisor tick (inference/supervisor)
 - ``bench.attempt``      — the bench child, before any JAX import
 - ``bench.probe``        — the bench preflight device-enumeration
   child, before any JAX import (indexed by probe attempt)

@@ -1,0 +1,61 @@
+"""Serving-supervisor kill-and-relaunch worker (driven by
+tests/test_supervisor.py).
+
+Builds a deterministic tiny model + ServingSupervisor with a journal,
+submits a fixed request set (skipping ids already journaled by a
+previous life), drives the loop, and prints the harvested results as
+one JSON line. Wave 1 dies at a scheduled ``kill`` fault at
+``serving.step`` (PADDLE_CHAOS env transport); the relaunch — the test,
+playing the external agent crash-only recovery assumes — reruns this
+script WITHOUT the chaos env: the journal replay requeues accepted
+unfinished requests and restores completed ones, so every non-poisoned
+request ends token-identical to an isolated generate() run.
+
+env:
+  SUP_DIR      — journal directory (shared across waves)
+  SUP_NREQ     — number of requests to submit (default 4)
+  PADDLE_CHAOS — optional fault schedule (wave 1 only)
+"""
+import json
+import os
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.inference.serving import ContinuousBatchingEngine  # noqa: E402
+from paddle_tpu.inference.supervisor import ServingSupervisor  # noqa: E402
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM  # noqa: E402
+
+
+def main():
+    n_req = int(os.environ.get("SUP_NREQ", "4"))
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+
+    def factory():
+        return ContinuousBatchingEngine(
+            model, max_batch=2, max_len=32, block_size=8, num_blocks=8,
+            prompt_pad=8)
+
+    sup = ServingSupervisor(factory, journal_dir=os.environ["SUP_DIR"])
+    rng = np.random.RandomState(5)
+    for i in range(n_req):
+        prompt = rng.randint(0, 250, (3 + i % 4,))
+        rid = f"r{i}"
+        if rid not in sup.journaled_ids:
+            sup.submit(rid, prompt, max_new_tokens=3 + i % 3)
+    res = sup.run()
+    print(json.dumps({
+        "results": {str(rid): {"status": r.status,
+                               "out": [int(t) for t in r.out]}
+                    for rid, r in res.items()},
+        "restarts": sup.restarts,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,10 @@ _SLOW_FILES = {
     "test_shm_ring.py",           # multi-process dataloader epochs
     "test_fused_layers.py",       # fused-transformer decode parity
     "test_launch.py",             # launcher subprocess spawns
+    # ISSUE 4 robustness lane (`pytest -m robustness`): engine-backed
+    # overload/supervisor tests; pure-controller units are marked quick
+    "test_admission.py",
+    "test_supervisor.py",
 }
 
 
@@ -72,6 +76,10 @@ def pytest_configure(config):
         "markers",
         "analysis: graft-lint static-analysis + recompile-sanitizer gate "
         "(standalone via `pytest -m analysis`, < 60 s)")
+    config.addinivalue_line(
+        "markers",
+        "robustness: overload-control / chaos / self-healing serving "
+        "suite (standalone via `pytest -m robustness`)")
 
 
 def pytest_collection_modifyitems(config, items):
