@@ -10,12 +10,21 @@ Rules (see ``rules.py`` for the full table): TRACE001 host side
 effects in traced regions, TRACE002 tensor-valued control flow under
 jax.jit, RECOMP001 recompile/sync triggers in hot loops, COLL001
 rank-conditional collectives, DDL001 un-deadlined blocking calls,
-DONATE001 use-after-donation. Suppress per file with
+DONATE001 use-after-donation — plus the interprocedural graft-verify
+layer (``interproc.py``, on by default; ``--no-interprocedural``
+disables): COLL002 cross-function collective-schedule divergence,
+COLL003 cross-function send/recv peer mismatch, DDL002 un-threaded
+Deadline propagation, all computed over a project-wide call graph with
+per-function effect summaries. Suppress per file with
 ``# graft-lint: disable=RULE``; absorb existing debt with the
 committed ``baseline.json`` (regenerate via ``--write-baseline``).
 
 Runtime: :func:`recompile_guard` pins a code path to an exact XLA
-compile budget (see ``sanitizers.py``).
+compile budget; :func:`collective_contract` cross-checks the
+collective flight recorder's per-rank schedules and raises
+:class:`CollectiveScheduleMismatch` naming every rank's last-N
+schedule (see ``sanitizers.py`` and
+``distributed/communication/flight_recorder.py``).
 """
 from .core import (  # noqa: F401
     Finding,
@@ -30,9 +39,11 @@ from .core import (  # noqa: F401
     write_baseline,
 )
 from .sanitizers import (  # noqa: F401
+    CollectiveScheduleMismatch,
     CompileEvent,
     RecompileError,
     RecompileGuard,
+    collective_contract,
     recompile_guard,
 )
 
@@ -47,8 +58,10 @@ __all__ = [
     "default_baseline_path",
     "load_baseline",
     "write_baseline",
+    "CollectiveScheduleMismatch",
     "CompileEvent",
     "RecompileError",
     "RecompileGuard",
+    "collective_contract",
     "recompile_guard",
 ]

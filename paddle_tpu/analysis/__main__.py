@@ -2,12 +2,19 @@
 
     python -m paddle_tpu.analysis [paths] [--select RULE,..]
                                   [--baseline FILE] [--write-baseline FILE]
+                                  [--no-interprocedural] [--format github]
 
 Exit status: 0 when every finding at/above ``--min-severity`` is
 absorbed by the baseline (or there are none), 1 otherwise, 2 on usage
 errors. The committed baseline at ``paddle_tpu/analysis/baseline.json``
 is picked up automatically so ``python -m paddle_tpu.analysis
 paddle_tpu/`` gates on NEW findings only.
+
+The interprocedural pass (graft-verify: COLL002/COLL003/DDL002 over a
+project-wide call graph) is ON by default; ``--no-interprocedural``
+restricts the run to the modular per-file rules. ``--format github``
+emits GitHub workflow-command annotations (``::error file=..``) so a
+CI analysis lane can annotate PRs directly from the lint output.
 
 Project defaults come from ``[tool.graft-lint]`` in the nearest
 ``pyproject.toml`` (``paths``/``baseline``/``min_severity``);
@@ -66,11 +73,23 @@ def _pyproject_defaults() -> Dict:
         d = parent
 
 
+_EXIT_CODE_DOC = """\
+exit status:
+  0  clean — no finding at/above --min-severity survived the baseline
+     (also: --write-baseline and --list-rules runs)
+  1  new findings at/above --min-severity (the CI gate failure)
+  2  usage/configuration errors: unknown rule in --select/--ignore,
+     missing path, unreadable baseline, bad [tool.graft-lint] values
+"""
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="graft-lint",
         description="trace-safety / collective-correctness / "
                     "deadline-discipline analyzer for paddle_tpu",
+        epilog=_EXIT_CODE_DOC,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     p.add_argument("paths", nargs="*", default=None,
                    help="files or directories to lint (default: "
@@ -92,7 +111,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write-baseline", default=None, metavar="FILE",
                    help="write the current findings as a new baseline "
                         "and exit 0")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default="text",
+                   help="output mode: text (default), json, or github "
+                        "(::error/::warning/::notice workflow-command "
+                        "annotation lines for PR annotation)")
+    p.add_argument("--interprocedural", dest="interprocedural",
+                   action="store_true", default=True,
+                   help="run the interprocedural (graft-verify) pass: "
+                        "project-wide call graph + COLL002/COLL003/"
+                        "DDL002 (the default)")
+    p.add_argument("--no-interprocedural", dest="interprocedural",
+                   action="store_false",
+                   help="modular per-file rules only")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
     p.add_argument("-q", "--quiet", action="store_true",
@@ -105,7 +136,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.list_rules:
         for rule in sorted(all_rules().values(), key=lambda r: r.id):
-            print(f"{rule.id:10s} {rule.severity:8s} {rule.summary}")
+            scope = "interproc" if rule.scope == "project" else "module"
+            print(f"{rule.id:10s} {rule.severity:8s} {scope:9s} "
+                  f"{rule.summary}")
         return 0
 
     # flags > [tool.graft-lint] > built-in defaults
@@ -131,7 +164,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     try:
         findings = analyze_paths(
-            args.paths, select=args.select, ignore=args.ignore)
+            args.paths, select=args.select, ignore=args.ignore,
+            interprocedural=args.interprocedural)
     except ValueError as e:  # unknown rule id in --select/--ignore
         print(f"graft-lint: {e}", file=sys.stderr)
         return 2
@@ -165,6 +199,30 @@ def main(argv: Optional[List[str]] = None) -> int:
             "baselined": baselined,
             "gating": len(gating),
         }, indent=2))
+    elif args.format == "github":
+        # GitHub workflow commands: one annotation per NEW finding —
+        # the analysis lane pipes this straight into the job log and
+        # the PR gets inline file/line annotations. Newlines must be
+        # %0A-escaped (the command is one log line).
+        level = {"error": "error", "warning": "warning",
+                 "note": "notice"}
+
+        def esc_prop(v: str) -> str:
+            # property values additionally need ':'/',' escaped or
+            # GitHub mis-parses the property list
+            return (v.replace("%", "%25").replace("\r", "%0D")
+                    .replace("\n", "%0A").replace(":", "%3A")
+                    .replace(",", "%2C"))
+
+        for f in findings:
+            msg = f.message + (f" (hint: {f.hint})" if f.hint else "")
+            msg = msg.replace("%", "%25").replace("\r", "%0D") \
+                     .replace("\n", "%0A")
+            print(f"::{level[f.severity]} file={esc_prop(f.path)},"
+                  f"line={f.line},col={f.col},"
+                  f"title=graft-lint {f.rule}::{msg}")
+        print(f"graft-lint: {len(findings)} new finding(s), "
+              f"{baselined} baselined, {len(gating)} gating")
     else:
         if not args.quiet:
             for f in findings:

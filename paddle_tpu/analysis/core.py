@@ -98,42 +98,61 @@ class Rule:
     severity: str
     summary: str
     hint: str
-    check: Callable[["ModuleContext"], Iterator[Finding]]
+    check: Callable[..., Iterator[Finding]]
+    scope: str = "module"  # "module": fn(ModuleContext); "project":
+    #                         fn(interproc.ProjectContext)
 
 
 _RULES: Dict[str, Rule] = {}
 
 
 def register_rule(rule_id: str, *, severity: str, summary: str,
-                  hint: str = ""):
-    """Decorator registering ``fn(ctx) -> iterator of (node, message
-    [, hint])`` tuples as a rule; the registry wraps them into
-    Findings."""
+                  hint: str = "", scope: str = "module"):
+    """Decorator registering a rule. ``scope="module"`` rules take a
+    :class:`ModuleContext` and yield ``(node, message[, hint])``;
+    ``scope="project"`` rules take an ``interproc.ProjectContext`` and
+    yield ``(path, line, col, message[, hint])`` — the registry wraps
+    both into Findings."""
     if severity not in SEVERITY_ORDER:
         raise ValueError(f"unknown severity {severity!r}")
+    if scope not in ("module", "project"):
+        raise ValueError(f"unknown rule scope {scope!r}")
 
     def deco(fn):
-        def check(ctx: "ModuleContext") -> Iterator[Finding]:
-            for item in fn(ctx):
-                node, message = item[0], item[1]
-                hint_ = item[2] if len(item) > 2 and item[2] else hint
-                yield Finding(
-                    rule=rule_id, severity=severity, path=ctx.path,
-                    line=getattr(node, "lineno", 0),
-                    col=getattr(node, "col_offset", 0) + 1,
-                    message=message, hint=hint_,
-                )
+        if scope == "module":
+            def check(ctx: "ModuleContext") -> Iterator[Finding]:
+                for item in fn(ctx):
+                    node, message = item[0], item[1]
+                    hint_ = item[2] if len(item) > 2 and item[2] else hint
+                    yield Finding(
+                        rule=rule_id, severity=severity, path=ctx.path,
+                        line=getattr(node, "lineno", 0),
+                        col=getattr(node, "col_offset", 0) + 1,
+                        message=message, hint=hint_,
+                    )
+        else:
+            def check(project) -> Iterator[Finding]:
+                for item in fn(project):
+                    path, line, col, message = item[:4]
+                    hint_ = item[4] if len(item) > 4 and item[4] else hint
+                    yield Finding(
+                        rule=rule_id, severity=severity, path=path,
+                        line=line, col=col, message=message, hint=hint_,
+                    )
 
         if rule_id in _RULES:
             raise ValueError(f"duplicate rule id {rule_id}")
-        _RULES[rule_id] = Rule(rule_id, severity, summary, hint, check)
+        _RULES[rule_id] = Rule(rule_id, severity, summary, hint, check,
+                               scope)
         return fn
 
     return deco
 
 
 def all_rules() -> Dict[str, Rule]:
-    from . import rules  # noqa: F401 — importing registers the rules
+    # importing registers the rules (module scope, then project scope)
+    from . import rules  # noqa: F401
+    from . import interproc  # noqa: F401
     return dict(_RULES)
 
 
@@ -364,13 +383,8 @@ def _suppressed(f: Finding, file_wide: Set[str],
 # ---------------------------------------------------------------------------
 # Engine
 
-def analyze_source(src: str, path: str = "<string>", *,
-                   select: Optional[Iterable[str]] = None,
-                   ignore: Optional[Iterable[str]] = None) -> List[Finding]:
-    """Run the (selected) rules over one module's source. Returns the
-    findings that survive ``# graft-lint: disable=`` suppressions,
-    sorted by (line, col, rule). Baseline filtering is the caller's job
-    (see :func:`apply_baseline`)."""
+def _select_rules(select: Optional[Iterable[str]],
+                  ignore: Optional[Iterable[str]]) -> Dict[str, Rule]:
     rules = all_rules()
     if select:
         wanted = set(select)
@@ -380,30 +394,94 @@ def analyze_source(src: str, path: str = "<string>", *,
         rules = {k: v for k, v in rules.items() if k in wanted}
     if ignore:
         rules = {k: v for k, v in rules.items() if k not in set(ignore)}
+    return rules
+
+
+def _run_project_rules(project, rules: Dict[str, Rule]) -> List[Finding]:
+    findings: List[Finding] = []
+    seen = set()
+    for rule in rules.values():
+        if rule.scope != "project":
+            continue
+        for f in rule.check(project):
+            key = (f.rule, f.path, f.line, f.col, f.message)
+            if key not in seen:
+                seen.add(key)
+                findings.append(f)
+    return findings
+
+
+def _module_pass(src: str, path: str, rules: Dict[str, Rule]):
+    """(unsuppressed module-rule findings, parsed tree) — or a
+    PARSE000 finding and no tree when the module doesn't parse. The
+    tree is handed to the interprocedural summarizer so one parse
+    serves both passes."""
     try:
         ctx = ModuleContext(src, path)
     except SyntaxError as e:
         return [Finding(
             rule="PARSE000", severity="error", path=path,
             line=e.lineno or 0, col=(e.offset or 0),
-            message=f"could not parse module: {e.msg}")]
-    file_wide, per_line = _collect_suppressions(src)
+            message=f"could not parse module: {e.msg}")], None
     findings: List[Finding] = []
     seen = set()  # nested loops can make a rule revisit the same node
     for rule in rules.values():
+        if rule.scope != "module":
+            continue
         for f in rule.check(ctx):
             key = (f.rule, f.line, f.col, f.message)
-            if key not in seen and not _suppressed(f, file_wide, per_line):
+            if key not in seen:
                 seen.add(key)
+                findings.append(f)
+    return findings, ctx.tree
+
+
+def analyze_source(src: str, path: str = "<string>", *,
+                   select: Optional[Iterable[str]] = None,
+                   ignore: Optional[Iterable[str]] = None,
+                   interprocedural: bool = True) -> List[Finding]:
+    """Run the (selected) rules over one module's source — project-
+    scope (interprocedural) rules see a single-module project. Returns
+    the findings that survive ``# graft-lint: disable=`` suppressions,
+    sorted by (line, col, rule). Baseline filtering is the caller's
+    job (see :func:`apply_baseline`)."""
+    rules = _select_rules(select, ignore)
+    found, tree = _module_pass(src, path, rules)
+    if tree is None:
+        return found  # the PARSE000 finding
+    file_wide, per_line = _collect_suppressions(src)
+    findings = [f for f in found
+                if not _suppressed(f, file_wide, per_line)]
+    if interprocedural and any(
+            r.scope == "project" for r in rules.values()):
+        from . import interproc
+
+        project = interproc.build_project([(src, path, tree)],
+                                          finalize_cache=False)
+        for f in _run_project_rules(project, rules):
+            if not _suppressed(f, file_wide, per_line):
                 findings.append(f)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
 
 
 def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    # dedup by real path: overlapping arguments (`lint pkg pkg/sub`)
+    # must not yield a file twice — duplicate function summaries would
+    # make every name in those files ambiguous and silently disable
+    # the interprocedural rules over them (and double-report the
+    # per-module rules)
+    seen: Set[str] = set()
+
+    def emit(fp: str) -> Iterator[str]:
+        key = os.path.realpath(fp)
+        if key not in seen:
+            seen.add(key)
+            yield fp
+
     for p in paths:
         if os.path.isfile(p):
-            yield p
+            yield from emit(p)
             continue
         for dirpath, dirnames, filenames in os.walk(p):
             dirnames[:] = sorted(
@@ -411,21 +489,54 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
                 if d != "__pycache__" and not d.startswith("."))
             for fn in sorted(filenames):
                 if fn.endswith(".py"):
-                    yield os.path.join(dirpath, fn)
+                    yield from emit(os.path.join(dirpath, fn))
 
 
 def analyze_paths(paths: Iterable[str], *,
                   select: Optional[Iterable[str]] = None,
-                  ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+                  ignore: Optional[Iterable[str]] = None,
+                  interprocedural: bool = True) -> List[Finding]:
+    """Module rules per file, then (by default) one interprocedural
+    pass over the whole file set: the project-scope rules (COLL002/
+    COLL003/DDL002) see a project-wide call graph built from cached
+    per-file summaries."""
+    rules = _select_rules(select, ignore)
+    project_pass = interprocedural and any(
+        r.scope == "project" for r in rules.values())
+    if project_pass:
+        from . import interproc
     findings: List[Finding] = []
+    suppressions: Dict[str, Tuple[Set[str], Dict[int, Set[str]]]] = {}
+    summaries: List = []
     for fp in iter_python_files(paths):
         try:
             with open(fp, encoding="utf-8") as fh:
                 src = fh.read()
         except (OSError, UnicodeDecodeError):
             continue
-        findings.extend(
-            analyze_source(src, fp, select=select, ignore=ignore))
+        found, tree = _module_pass(src, fp, rules)
+        if tree is None:
+            findings.extend(found)  # PARSE000
+            continue
+        fw_pl = _collect_suppressions(src)
+        per_file = [f for f in found if not _suppressed(f, *fw_pl)]
+        per_file.sort(key=lambda f: (f.line, f.col, f.rule))
+        findings.extend(per_file)
+        if project_pass:
+            # summarize NOW (one parse serves both passes) so the tree
+            # and source can be freed before the next file, instead of
+            # holding every AST until the project pass
+            fs = interproc.summarize_path(fp, src=src, tree=tree)
+            if fs is not None:
+                summaries.append(fs)
+                suppressions[fp] = fw_pl
+    if project_pass:
+        project = interproc.build_project_from_summaries(summaries)
+        for f in _run_project_rules(project, rules):
+            fw, pl = suppressions.get(f.path, (set(), {}))
+            if not _suppressed(f, fw, pl):
+                findings.append(f)
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 

@@ -1,0 +1,1282 @@
+"""graft-verify — the interprocedural layer of graft-lint.
+
+The intraprocedural rules (rules.py) are modular by design: COLL001
+sees a rank-conditional collective only when the collective call sits
+textually inside the branch. The worst real deadlocks don't — the
+branch calls a helper, the helper (or ITS helper) issues the
+collective, and every rank hangs in a different function. This module
+adds what MPI-Checker-style analyses add to MPI code:
+
+1. a **project-wide call graph** over every analyzed file, with calls
+   resolved name-based (same-file definitions win, then a unique
+   project-wide definition; ambiguous names stay unresolved — false
+   negatives over false positives, the graft-lint contract);
+2. per-function **effect summaries** — the ordered sequence of
+   collective signatures (op), point-to-point signatures (send/recv +
+   peer), blocking calls, and calls into other project functions each
+   function can execute, with rank-conditional branches kept as
+   nested forks;
+3. **bottom-up evaluation over SCCs** (Tarjan): each function's set of
+   possible collective schedules is computed after its callees',
+   expanding rank-conditional branches under an explicit budget
+   (``MAX_SCHEDULES`` alternatives / ``MAX_SCHEDULE_LEN`` ops —
+   over-budget or recursive schedules become *unknown* and produce no
+   findings);
+4. three rules over those summaries:
+
+   ========= ======== =================================================
+   COLL002   error    cross-function schedule divergence: the two sides
+                      of a rank conditional transitively issue
+                      DIFFERENT collective sequences (no expansion of
+                      either side matches any expansion of the other)
+                      — the cross-rank deadlock COLL001 cannot see
+   COLL003   error    send/recv peer mismatch across call boundaries:
+                      a rank-conditional send is paired with a recv
+                      whose literal peer can never match (or the
+                      send/recv counts don't balance)
+   DDL002    warning  interprocedural Deadline propagation: a call into
+                      a project function that (transitively) blocks and
+                      exposes an optional ``deadline=`` parameter the
+                      caller never threads (and the caller handles no
+                      deadline of its own)
+   ========= ======== =================================================
+
+Summaries are pure data (no AST nodes), so they cache: an in-memory
+map keyed by (path, mtime, size) plus a JSON disk cache (cache dir
+``$GRAFT_LINT_CACHE_DIR`` or ``~/.cache/graft-lint``) keeps repeated
+CLI runs and the ``pytest -m analysis`` lane from re-summarizing an
+unchanged tree.
+
+Stdlib-only, like the rest of the analyzer.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Tuple
+
+from .astutils import NEW_SCOPE, call_keyword, dotted_name
+from .core import register_rule
+from .rules import (
+    _COLLECTIVES,
+    _DEADLINEISH,
+    _QUEUEISH,
+    _is_rank_conditional,
+    _mentions_deadline,
+)
+
+__all__ = [
+    "summarize_source",
+    "summarize_path",
+    "ProjectContext",
+    "build_project",
+    "cache_stats",
+    "MAX_SCHEDULES",
+    "MAX_SCHEDULE_LEN",
+]
+
+# Expansion budgets: each rank-conditional fork inside a CALLEE doubles
+# the schedule set; past these bounds the schedule becomes "unknown"
+# and no finding is reported (accepted false negatives).
+MAX_SCHEDULES = 16
+MAX_SCHEDULE_LEN = 64
+
+# receivers that mark a bare `send`/`recv`/`reduce`-style tail as the
+# distributed API rather than a socket/functools/etc. call
+_DISTISH = re.compile(
+    r"(^|\.|_)(dist|distributed|comm|communication|collective|mc|"
+    r"multi_controller)\w*$", re.I)
+
+# collectives whose NAME is unambiguous get recognized with any (or no)
+# receiver, matching COLL001; short generic names additionally require a
+# dist-ish receiver (`functools.reduce`/`itertools` must stay invisible)
+_AMBIGUOUS_COLLECTIVES = {"reduce", "gather", "barrier", "scatter"}
+_EXTRA_COLLECTIVES = {"reduce", "gather", "alltoall_single",
+                      "all_gather_into_tensor", "p2p_sendrecv",
+                      "eager_p2p"}
+_COLL_OPS = set(_COLLECTIVES) | _EXTRA_COLLECTIVES
+
+_SEND_TAILS = {"send", "isend", "eager_send"}
+_RECV_TAILS = {"recv", "irecv", "eager_recv"}
+_PEER_KWARGS = ("dst", "src", "peer")
+
+_TIMEOUTISH = re.compile(r"timeout|deadline|budget", re.I)
+
+
+# ---------------------------------------------------------------------------
+# Effect model — pure data, JSON-serializable
+
+
+@dataclass(frozen=True)
+class CollEffect:
+    op: str
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class P2PEffect:
+    kind: str  # "send" | "recv"
+    peer: Optional[int]  # literal peer rank when statically known
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class BlockEffect:
+    what: str
+    bounded: bool  # a literal timeout bounds the wait at the call site
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class CallEffect:
+    name: str  # tail name of the callee
+    self_call: bool  # receiver is `self` — resolve same-file only
+    has_receiver: bool  # dotted call (`obj.f(...)`) — the receiver
+    #                     fills a method target's `self` slot
+    hard_bounds: bool  # a timeout/deadline kwarg with a CONCRETE value
+    #                    (not a forwarded deadline-ish name, not None):
+    #                    blocking cannot propagate through this edge
+    kwargs: Tuple[str, ...]
+    nargs: int
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class RankBranch:
+    """A two-way fork in the effect stream. ``is_rank`` marks a
+    RANK-conditional fork (what COLL002/COLL003 report on); plain
+    ``if``/``else`` statements are also kept as forks — exactly one
+    side executes, so flattening them into a sequence would fabricate
+    schedules no rank ever runs (error-severity false positives)."""
+
+    rank_eq: Optional[int]  # literal K when the test is rank ==/!= K
+    eq_in_body: bool  # True: body is the `rank == K` side
+    line: int
+    col: int
+    body: Tuple = ()
+    orelse: Tuple = ()
+    is_rank: bool = True
+
+
+@dataclass(frozen=True)
+class LoopEffect:
+    """Effects under a loop: multiplicity is statically unknown, so a
+    schedule-relevant body (collectives/p2p/project calls) makes the
+    enclosing schedule *unknown* instead of pretending one iteration —
+    a looped all_reduce vs its unrolled twin must not read as a
+    deadlock. Blocking/deadline facts still see through it."""
+
+    line: int
+    col: int
+    body: Tuple = ()
+
+
+@dataclass(frozen=True)
+class FunctionSummary:
+    name: str
+    path: str
+    line: int
+    col: int
+    params: Tuple[str, ...]
+    deadline_param: Optional[str]  # first deadline-ish OPTIONAL param
+    deadline_param_pos: int
+    mentions_deadline: bool
+    sets_timeout: bool
+    effects: Tuple = ()
+
+    def fid(self) -> Tuple[str, int, str]:
+        return (self.path, self.line, self.name)
+
+
+@dataclass(frozen=True)
+class FileSummary:
+    path: str
+    imports_retries: bool
+    functions: Tuple[FunctionSummary, ...] = ()
+
+
+# -- JSON codec (for the disk cache) ----------------------------------------
+
+def _effect_to_json(e):
+    if isinstance(e, CollEffect):
+        return ["C", e.op, e.line, e.col]
+    if isinstance(e, P2PEffect):
+        return ["P", e.kind, e.peer, e.line, e.col]
+    if isinstance(e, BlockEffect):
+        return ["B", e.what, e.bounded, e.line, e.col]
+    if isinstance(e, CallEffect):
+        return ["L", e.name, e.self_call, e.has_receiver,
+                e.hard_bounds, list(e.kwargs), e.nargs, e.line, e.col]
+    if isinstance(e, RankBranch):
+        return ["R", e.rank_eq, e.eq_in_body, e.line, e.col,
+                [_effect_to_json(x) for x in e.body],
+                [_effect_to_json(x) for x in e.orelse], e.is_rank]
+    if isinstance(e, LoopEffect):
+        return ["O", e.line, e.col,
+                [_effect_to_json(x) for x in e.body]]
+    raise TypeError(type(e))
+
+
+def _effect_from_json(d):
+    tag = d[0]
+    if tag == "C":
+        return CollEffect(d[1], d[2], d[3])
+    if tag == "P":
+        return P2PEffect(d[1], d[2], d[3], d[4])
+    if tag == "B":
+        return BlockEffect(d[1], bool(d[2]), d[3], d[4])
+    if tag == "L":
+        return CallEffect(d[1], bool(d[2]), bool(d[3]), bool(d[4]),
+                          tuple(d[5]), d[6], d[7], d[8])
+    if tag == "R":
+        return RankBranch(d[1], bool(d[2]), d[3], d[4],
+                          tuple(_effect_from_json(x) for x in d[5]),
+                          tuple(_effect_from_json(x) for x in d[6]),
+                          bool(d[7]))
+    if tag == "O":
+        return LoopEffect(d[1], d[2],
+                          tuple(_effect_from_json(x) for x in d[3]))
+    raise ValueError(tag)
+
+
+def _file_to_json(fs: FileSummary):
+    return {
+        "path": fs.path,
+        "imports_retries": fs.imports_retries,
+        "functions": [
+            {
+                "name": f.name, "line": f.line, "col": f.col,
+                "params": list(f.params),
+                "deadline_param": f.deadline_param,
+                "deadline_param_pos": f.deadline_param_pos,
+                "mentions_deadline": f.mentions_deadline,
+                "sets_timeout": f.sets_timeout,
+                "effects": [_effect_to_json(e) for e in f.effects],
+            }
+            for f in fs.functions
+        ],
+    }
+
+
+def _file_from_json(d) -> FileSummary:
+    return FileSummary(
+        path=d["path"], imports_retries=d["imports_retries"],
+        functions=tuple(
+            FunctionSummary(
+                name=f["name"], path=d["path"], line=f["line"],
+                col=f["col"], params=tuple(f["params"]),
+                deadline_param=f["deadline_param"],
+                deadline_param_pos=f["deadline_param_pos"],
+                mentions_deadline=f["mentions_deadline"],
+                sets_timeout=f["sets_timeout"],
+                effects=tuple(_effect_from_json(e) for e in f["effects"]),
+            )
+            for f in d["functions"]
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Summarizer
+
+
+def _receiver_prefix(func: ast.AST) -> str:
+    """The dotted receiver of a call (`dist.comm` for
+    `dist.comm.all_reduce(...)`), "" for a bare name."""
+    d = dotted_name(func)
+    if d is None or "." not in d:
+        return ""
+    return d.rsplit(".", 1)[0]
+
+
+def _literal_int(node: Optional[ast.AST]) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _literal_int(node.operand)
+        return -inner if inner is not None else None
+    return None
+
+
+def _peer_of(call: ast.Call, tail: str) -> Optional[int]:
+    """The literal peer rank, read signature-aware: ``dst=``/``src=``
+    kwargs, else the KNOWN positional slot — arg 1 for
+    ``send(t, dst)``/``recv(t, src)``/``eager_send(x, dst)``, arg 0
+    for ``eager_recv(src, ...)``. Never 'any int literal in the call'
+    (a positional timeout must not be misread as a peer)."""
+    for kw in _PEER_KWARGS:
+        v = call_keyword(call, kw)
+        if v is not None:
+            return _literal_int(v)
+    pos = 0 if tail == "eager_recv" else 1
+    if pos < len(call.args):
+        return _literal_int(call.args[pos])
+    return None
+
+
+def _has_timeoutish_kwarg(call: ast.Call) -> bool:
+    return any(kw.arg and _TIMEOUTISH.search(kw.arg)
+               for kw in call.keywords)
+
+
+def _hard_bounds(call: ast.Call) -> bool:
+    """A timeout/deadline kwarg whose VALUE is concrete: forwarding a
+    deadline-ish name (``deadline=deadline``) merely propagates the
+    caller's — possibly None — budget, and ``deadline=None`` is no
+    bound at all; neither stops blocking from propagating up."""
+    for kw in call.keywords:
+        if not (kw.arg and _TIMEOUTISH.search(kw.arg)):
+            continue
+        if isinstance(kw.value, ast.Constant) and kw.value.value is None:
+            continue
+        forwards = any(
+            (isinstance(n, ast.Name) and _DEADLINEISH.search(n.id))
+            or (isinstance(n, ast.Attribute)
+                and _DEADLINEISH.search(n.attr))
+            for n in ast.walk(kw.value))
+        if not forwards:
+            return True
+    return False
+
+
+def _rank_literal(test: ast.AST) -> Tuple[Optional[int], bool]:
+    """(K, eq_in_body) for `rank ==/!= K` tests; (None, True) else."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        k = _literal_int(test.comparators[0])
+        if k is None:
+            k = _literal_int(test.left)
+        if k is not None:
+            if isinstance(test.ops[0], ast.Eq):
+                return k, True
+            if isinstance(test.ops[0], ast.NotEq):
+                return k, False
+    return None, True
+
+
+
+
+class _FnSummarizer:
+    """Builds one FunctionSummary from an ast.FunctionDef."""
+
+    def __init__(self, fndef: ast.AST, path: str):
+        self.fndef = fndef
+        self.path = path
+        self.sets_timeout = False
+
+    def run(self) -> FunctionSummary:
+        effects = tuple(self._stmts(self.fndef.body, in_loop=False))
+        args = self.fndef.args
+        params = [p.arg for p in (*args.posonlyargs, *args.args)]
+        dl_param, dl_pos = self._deadline_param(args, params)
+        return FunctionSummary(
+            name=self.fndef.name, path=self.path,
+            line=self.fndef.lineno, col=self.fndef.col_offset + 1,
+            params=tuple(params), deadline_param=dl_param,
+            deadline_param_pos=dl_pos,
+            mentions_deadline=_mentions_deadline(self.fndef),
+            sets_timeout=self.sets_timeout, effects=effects)
+
+    @staticmethod
+    def _deadline_param(args: ast.arguments,
+                        params: List[str]) -> Tuple[Optional[str], int]:
+        """The first deadline-ish parameter DEFAULTED to None — the
+        'optional bound' shape DDL002 asks callers to thread. Required
+        deadline params need no rule (Python enforces them); non-None
+        defaults already bound the wait."""
+        pos_defaults = args.defaults
+        offset = len(params) - len(pos_defaults)
+        for i, name in enumerate(params):
+            if not _DEADLINEISH.search(name):
+                continue
+            if i >= offset:
+                dft = pos_defaults[i - offset]
+                if isinstance(dft, ast.Constant) and dft.value is None:
+                    return name, i
+        for kwarg, dft in zip(args.kwonlyargs, args.kw_defaults):
+            if _DEADLINEISH.search(kwarg.arg) and isinstance(
+                    dft, ast.Constant) and dft.value is None:
+                return kwarg.arg, len(params) + 10_000  # kw-only
+        return None, -1
+
+    # -- statement walk ------------------------------------------------
+    def _stmts(self, stmts: Sequence[ast.stmt], in_loop: bool) -> List:
+        out: List = []
+        for stmt in stmts:
+            if isinstance(stmt, NEW_SCOPE):
+                continue  # nested defs own their effects
+            if isinstance(stmt, ast.If):
+                # EVERY if/else is a fork — exactly one side runs, so
+                # flattening would fabricate schedules no rank executes;
+                # only rank-conditional forks are reportable
+                is_rank = _is_rank_conditional(stmt.test)
+                k, eq_in_body = (_rank_literal(stmt.test) if is_rank
+                                 else (None, True))
+                out.append(RankBranch(
+                    rank_eq=k, eq_in_body=eq_in_body,
+                    line=stmt.lineno, col=stmt.col_offset + 1,
+                    body=tuple(self._stmts(stmt.body, in_loop)),
+                    orelse=tuple(self._stmts(stmt.orelse, in_loop)),
+                    is_rank=is_rank))
+                continue
+            if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                # the header (for-iter / first while-test) runs once;
+                # the body an UNKNOWN number of times — wrap it so the
+                # schedule expansion treats a looped collective as
+                # unknown instead of exactly-once
+                out.extend(self._header_calls(stmt, in_loop))
+                body = self._stmts(list(stmt.body) + list(stmt.orelse),
+                                   True)
+                if body:
+                    out.append(LoopEffect(
+                        line=stmt.lineno, col=stmt.col_offset + 1,
+                        body=tuple(body)))
+                continue
+            if isinstance(stmt, (ast.Try,) + (
+                    (ast.TryStar,) if hasattr(ast, "TryStar") else ())):
+                # a handler (except or 3.11+ except*) is an
+                # ALTERNATIVE continuation: fork it (normal path +
+                # normal-plus-handler) — appending it in sequence
+                # would fabricate a schedule in which both the try
+                # body AND every handler always run
+                out.extend(self._stmts(stmt.body, in_loop))
+                for h in stmt.handlers:
+                    h_eff = self._stmts(h.body, in_loop)
+                    if h_eff:
+                        out.append(RankBranch(
+                            rank_eq=None, eq_in_body=True,
+                            line=h.lineno, col=h.col_offset + 1,
+                            body=tuple(h_eff), orelse=(),
+                            is_rank=False))
+                out.extend(self._stmts(stmt.orelse, in_loop))
+                out.extend(self._stmts(stmt.finalbody, in_loop))
+                continue
+            if hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+                # each arm is an alternative continuation: fork every
+                # case body against "not taken" (a flattened sequence
+                # of ALL arms is a schedule no rank executes)
+                out.extend(self._expr_effects(stmt.subject, in_loop))
+                for case in stmt.cases:
+                    c_eff = self._stmts(case.body, in_loop)
+                    if c_eff:
+                        out.append(RankBranch(
+                            rank_eq=None, eq_in_body=True,
+                            line=case.pattern.lineno,
+                            col=case.pattern.col_offset + 1,
+                            body=tuple(c_eff), orelse=(),
+                            is_rank=False))
+                continue
+            out.extend(self._header_calls(stmt, in_loop))
+            for fname in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, fname, None)
+                if isinstance(sub, list) and sub and isinstance(
+                        sub[0], ast.stmt):
+                    out.extend(self._stmts(sub, in_loop))
+        return out
+
+    def _header_calls(self, stmt: ast.stmt, in_loop: bool) -> List:
+        out: List = []
+        for name, value in ast.iter_fields(stmt):
+            if name in ("body", "orelse", "finalbody", "handlers",
+                        "cases"):
+                continue
+            nodes = value if isinstance(value, list) else [value]
+            for n in nodes:
+                if isinstance(n, ast.AST):
+                    out.extend(self._expr_effects(n, in_loop))
+        return out
+
+    def _expr_effects(self, node: ast.AST, in_loop: bool) -> List:
+        """Effects of one expression in EVALUATION order (post-order:
+        a call's arguments run before the call itself, so
+        ``broadcast(all_reduce(t))`` records all_reduce first).
+        Conditional sub-expressions fork: ``a() if c else b()`` runs
+        ONE side, and short-circuit operands after the first may not
+        run at all — flattening either would fabricate schedules no
+        rank executes. Nested function/class/lambda/comprehension
+        scopes are summarized separately."""
+
+        def visit(n: ast.AST, acc: List) -> None:
+            if isinstance(n, NEW_SCOPE) and n is not node:
+                return
+            if isinstance(n, ast.IfExp):
+                visit(n.test, acc)
+                b: List = []
+                o: List = []
+                visit(n.body, b)
+                visit(n.orelse, o)
+                if b or o:
+                    acc.append(RankBranch(
+                        rank_eq=None, eq_in_body=True,
+                        line=n.lineno, col=n.col_offset + 1,
+                        body=tuple(b), orelse=tuple(o), is_rank=False))
+                return
+            if isinstance(n, ast.BoolOp):
+                visit(n.values[0], acc)
+                for v in n.values[1:]:  # short-circuit: may not run
+                    sub: List = []
+                    visit(v, sub)
+                    if sub:
+                        acc.append(RankBranch(
+                            rank_eq=None, eq_in_body=True,
+                            line=v.lineno, col=v.col_offset + 1,
+                            body=tuple(sub), orelse=(), is_rank=False))
+                return
+            for child in ast.iter_child_nodes(n):
+                visit(child, acc)
+            if isinstance(n, ast.Call):
+                eff = self._classify(n, in_loop)
+                if eff is not None:
+                    acc.append(eff)
+
+        out: List = []
+        visit(node, out)
+        return out
+
+    # -- call classification -------------------------------------------
+    def _classify(self, call: ast.Call, in_loop: bool):
+        d = dotted_name(call.func)
+        if d is None:
+            return None
+        tail = d.split(".")[-1]
+        prefix = _receiver_prefix(call.func)
+        line, col = call.lineno, call.col_offset + 1
+
+        if tail == "settimeout":
+            self.sets_timeout = True
+            return None
+
+        if tail in _COLL_OPS and (
+                tail not in _AMBIGUOUS_COLLECTIVES
+                or (prefix and _DISTISH.search(prefix))):
+            return CollEffect(tail, line, col)
+
+        distish = not prefix or bool(_DISTISH.search(prefix))
+        if tail in _SEND_TAILS and (distish or tail == "eager_send"):
+            return P2PEffect("send", _peer_of(call, tail), line, col)
+        if tail in _RECV_TAILS and (distish or tail == "eager_recv"):
+            return P2PEffect("recv", _peer_of(call, tail), line, col)
+
+        blocked = self._blocking(call, d, tail, prefix, in_loop)
+        if blocked is not None:
+            return blocked
+
+        if re.fullmatch(r"[A-Za-z_]\w*", tail) and not (
+                tail.startswith("__") and tail.endswith("__")):
+            return CallEffect(
+                name=tail,
+                self_call=prefix.split(".")[0] == "self" if prefix else False,
+                has_receiver=bool(prefix),
+                hard_bounds=_hard_bounds(call),
+                kwargs=tuple(kw.arg for kw in call.keywords if kw.arg),
+                nargs=len(call.args), line=line, col=col)
+        return None
+
+    @staticmethod
+    def _blocking(call: ast.Call, dotted: str, tail: str, prefix: str,
+                  in_loop: bool) -> Optional[BlockEffect]:
+        line, col = call.lineno, call.col_offset + 1
+        bounded = _has_timeoutish_kwarg(call)
+        if tail in ("recv", "recv_into", "accept") and prefix \
+                and not _DISTISH.search(prefix):
+            return BlockEffect(f".{tail}()", bounded, line, col)
+        if tail in ("wait", "communicate") and not call.args:
+            return BlockEffect(f".{tail}()", bounded, line, col)
+        if tail == "get" and prefix and _QUEUEISH.search(
+                prefix.split(".")[-1]) and not call.args:
+            block_kw = call_keyword(call, "block")
+            if isinstance(block_kw, ast.Constant) and \
+                    block_kw.value is False:
+                return None
+            return BlockEffect(f"{prefix}.get()", bounded, line, col)
+        if tail.startswith("blocking_key_value_get"):
+            # positional timeout_ms is the common call shape
+            return BlockEffect(f".{tail}()",
+                               bounded or len(call.args) > 1, line, col)
+        if dotted in ("time.sleep", "sleep") and in_loop:
+            return BlockEffect("sleep-poll loop", False, line, col)
+        return None
+
+
+def _module_imports_retries(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.endswith("retries") for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.endswith("retries") or any(
+                    a.name == "retries" for a in node.names):
+                return True
+    return False
+
+
+def summarize_source(src: str, path: str,
+                     tree: Optional[ast.AST] = None) -> FileSummary:
+    """``tree`` (when the caller already parsed ``src``) skips the
+    re-parse — the engine's module pass hands its AST through."""
+    if tree is None:
+        tree = ast.parse(src)
+    fns: List[FunctionSummary] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.append(_FnSummarizer(node, path).run())
+    fns.sort(key=lambda f: (f.line, f.col))
+    return FileSummary(path=path,
+                       imports_retries=_module_imports_retries(tree),
+                       functions=tuple(fns))
+
+
+# ---------------------------------------------------------------------------
+# Summary cache: in-memory keyed by (path, mtime, size) + JSON disk tier
+
+_CACHE_VERSION = 5  # bump when the summary/effect shapes change
+# (hits, misses) observable by tests; misses == real summarize runs
+_cache_stats = {"hits": 0, "misses": 0}
+_mem_cache: Dict[str, Tuple[float, int, FileSummary]] = {}
+_disk_loaded = False
+_disk_dirty = False
+
+
+def cache_stats() -> Dict[str, int]:
+    return dict(_cache_stats)
+
+
+def _cache_file() -> str:
+    root = os.environ.get("GRAFT_LINT_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "graft-lint")
+    return os.path.join(root, f"summaries-v{_CACHE_VERSION}.json")
+
+
+def _load_disk_cache() -> None:
+    global _disk_loaded
+    if _disk_loaded:
+        return
+    _disk_loaded = True
+    try:
+        with open(_cache_file(), encoding="utf-8") as fh:
+            data = json.load(fh)
+        for path, (mtime, size, fsj) in data.get("files", {}).items():
+            _mem_cache.setdefault(
+                path, (float(mtime), int(size), _file_from_json(fsj)))
+    except (OSError, ValueError, KeyError, TypeError):
+        pass  # corrupt/absent cache == cold cache
+
+
+def _save_disk_cache() -> None:
+    global _disk_dirty
+    if not _disk_dirty:
+        return
+    _disk_dirty = False
+    target = _cache_file()
+    try:
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(target),
+                                   suffix=".tmp")
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            json.dump({"files": {
+                p: [m, s, _file_to_json(fs)]
+                for p, (m, s, fs) in _mem_cache.items()
+                # drop dead entries (deleted trees, pytest tmp dirs) —
+                # the shared cache must not grow without bound
+                if os.path.exists(p)
+            }}, fh)
+        os.replace(tmp, target)
+    except OSError:
+        pass  # cache is best-effort
+
+
+def _rebind_path(fs: FileSummary, path: str) -> FileSummary:
+    """The cache keys by abspath but findings/suppressions key by the
+    path SPELLING the caller asked for — a hit recorded under another
+    spelling (relative vs absolute, or a previous process's cwd) must
+    be rebound or suppressions silently stop matching."""
+    if fs.path == path:
+        return fs
+    return FileSummary(
+        path=path, imports_retries=fs.imports_retries,
+        functions=tuple(
+            FunctionSummary(
+                name=f.name, path=path, line=f.line, col=f.col,
+                params=f.params, deadline_param=f.deadline_param,
+                deadline_param_pos=f.deadline_param_pos,
+                mentions_deadline=f.mentions_deadline,
+                sets_timeout=f.sets_timeout, effects=f.effects)
+            for f in fs.functions))
+
+
+def summarize_path(path: str, src: Optional[str] = None,
+                   tree: Optional[ast.AST] = None
+                   ) -> Optional[FileSummary]:
+    """FileSummary for ``path``, served from the mtime/size cache when
+    the file is unchanged; ``src``/``tree`` (when the caller already
+    holds them) skip the re-read/re-parse on a miss. None for
+    unreadable/unparseable files."""
+    global _disk_dirty
+    _load_disk_cache()
+    apath = os.path.abspath(path)
+    try:
+        st = os.stat(apath)
+    except OSError:
+        return None
+    hit = _mem_cache.get(apath)
+    if hit is not None and hit[0] == st.st_mtime and hit[1] == st.st_size:
+        _cache_stats["hits"] += 1
+        return _rebind_path(hit[2], path)
+    try:
+        if src is None:
+            with open(apath, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = None  # a held tree only matches a held src
+        fs = summarize_source(src, path, tree)
+    except (OSError, UnicodeDecodeError, SyntaxError):
+        return None
+    _cache_stats["misses"] += 1
+    _mem_cache[apath] = (st.st_mtime, st.st_size, fs)
+    _disk_dirty = True
+    return fs
+
+
+# ---------------------------------------------------------------------------
+# Project context: resolution, SCCs, budgeted schedule expansion
+
+# a schedule item: ("coll", op) | ("send", peer) | ("recv", peer)
+Schedule = Tuple[Tuple, ...]
+ScheduleSet = FrozenSet[Schedule]
+
+
+class ProjectContext:
+    def __init__(self, files: Sequence[FileSummary]):
+        self.files = list(files)
+        self.by_fid: Dict[Tuple, FunctionSummary] = {}
+        self.file_of: Dict[Tuple, FileSummary] = {}
+        self._by_name: Dict[str, List[FunctionSummary]] = {}
+        self._by_file_name: Dict[Tuple[str, str],
+                                 List[FunctionSummary]] = {}
+        for fs in self.files:
+            for fn in fs.functions:
+                self.by_fid[fn.fid()] = fn
+                self.file_of[fn.fid()] = fs
+                self._by_name.setdefault(fn.name, []).append(fn)
+                self._by_file_name.setdefault(
+                    (fs.path, fn.name), []).append(fn)
+        self._schedules: Dict[Tuple, Optional[ScheduleSet]] = {}
+        self._blocks: Dict[Tuple, bool] = {}
+        self._evaluate()
+
+    # -- resolution ----------------------------------------------------
+    def resolve(self, caller_path: str,
+                call: CallEffect) -> Optional[FunctionSummary]:
+        """Same-file definition first; else a project-unique one.
+        `self.x()` calls resolve same-file only (another class's method
+        of the same name is a different function)."""
+        local = self._by_file_name.get((caller_path, call.name), [])
+        if len(local) == 1:
+            return local[0]
+        if local or call.self_call:
+            return None  # ambiguous in-file, or foreign-file self call
+        cands = self._by_name.get(call.name, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    # -- bottom-up evaluation -------------------------------------------
+    def _call_edges(self, fn: FunctionSummary) -> List[Tuple]:
+        """(callee_fid, bounded) per resolved call: ``bounded`` marks a
+        call site that HARD-bounds the callee's wait (a concrete
+        timeout/deadline value, not a forwarded maybe-None one) —
+        blocking must not propagate through it."""
+        out = []
+
+        def walk(effects):
+            for e in effects:
+                if isinstance(e, CallEffect):
+                    target = self.resolve(fn.path, e)
+                    if target is not None:
+                        out.append((target.fid(), e.hard_bounds))
+                elif isinstance(e, RankBranch):
+                    walk(e.body)
+                    walk(e.orelse)
+                elif isinstance(e, LoopEffect):
+                    walk(e.body)
+
+        walk(fn.effects)
+        return out
+
+    def _evaluate(self) -> None:
+        """Tarjan SCCs over the resolved call graph, then schedules and
+        transitive-blocking facts in reverse topological (bottom-up)
+        order. Members of multi-node SCCs (and self-recursive
+        functions) get *unknown* schedules — recursion has no finite
+        expansion under the budget."""
+        call_edges = {fid: self._call_edges(fn)
+                      for fid, fn in self.by_fid.items()}
+        edges = {fid: [c for c, _bounded in es]
+                 for fid, es in call_edges.items()}
+        sccs = _tarjan(edges)  # reverse-topological order
+        for scc in sccs:
+            scc_set = set(scc)
+            recursive = len(scc) > 1 or any(
+                fid in edges[fid] for fid in scc)
+            # blocking is a monotone OR: any member blocking (directly
+            # or via an already-evaluated callee reached WITHOUT a
+            # deadline/timeout at the call site) marks the whole SCC
+            blocks = any(
+                self._direct_blocks(self.by_fid[fid]) or any(
+                    self._blocks.get(c, False)
+                    for c, bounded in call_edges[fid]
+                    if c not in scc_set and not bounded)
+                for fid in scc)
+            for fid in scc:
+                self._blocks[fid] = blocks
+            for fid in scc:
+                if recursive:
+                    self._schedules[fid] = None
+                else:
+                    self._schedules[fid] = self._expand(
+                        self.by_fid[fid].effects, self.by_fid[fid].path)
+
+    def _direct_blocks(self, fn: FunctionSummary) -> bool:
+        def walk(effects) -> bool:
+            for e in effects:
+                if isinstance(e, BlockEffect):
+                    if not e.bounded and (not fn.sets_timeout
+                                          or fn.deadline_param):
+                        # sets_timeout with NO deadline param = bounded
+                        # unconditionally; WITH one, the bound only
+                        # exists when the caller threads the deadline
+                        return True
+                elif isinstance(e, RankBranch):
+                    if walk(e.body) or walk(e.orelse):
+                        return True
+                elif isinstance(e, LoopEffect):
+                    if walk(e.body):
+                        return True
+            return False
+
+        return walk(fn.effects)
+
+    def blocks(self, fn: FunctionSummary) -> bool:
+        return self._blocks.get(fn.fid(), False)
+
+    def schedules_of(self, fn: FunctionSummary) -> Optional[ScheduleSet]:
+        return self._schedules.get(fn.fid())
+
+    def _expand(self, effects: Sequence,
+                caller_path: str) -> Optional[ScheduleSet]:
+        """The set of possible schedules for an effect list; None when
+        a callee is unknown/recursive or the budget is exceeded."""
+        acc: FrozenSet[Schedule] = frozenset({()})
+        for e in effects:
+            if isinstance(e, CollEffect):
+                acc = frozenset(s + (("coll", e.op),) for s in acc)
+            elif isinstance(e, P2PEffect):
+                acc = frozenset(s + ((e.kind, e.peer),) for s in acc)
+            elif isinstance(e, CallEffect):
+                target = self.resolve(caller_path, e)
+                if target is None:
+                    continue  # external/ambiguous: assumed effect-free
+                sub = self._schedules.get(target.fid())
+                if sub is None:
+                    return None
+                acc = frozenset(s + t for s in acc for t in sub)
+            elif isinstance(e, RankBranch):
+                b = self._expand(e.body, caller_path)
+                o = self._expand(e.orelse, caller_path)
+                if b is None or o is None:
+                    return None
+                acc = frozenset(s + t for s in acc for t in (b | o))
+            elif isinstance(e, LoopEffect):
+                sub = self._expand(e.body, caller_path)
+                if sub is None:
+                    return None
+                if sub != frozenset({()}):
+                    # schedule-relevant effects with statically
+                    # unknown multiplicity: the whole schedule is
+                    # unknown (a looped all_reduce vs its unrolled
+                    # twin must not read as a divergence)
+                    return None
+            if len(acc) > MAX_SCHEDULES or any(
+                    len(s) > MAX_SCHEDULE_LEN for s in acc):
+                return None
+        return acc
+
+    def expand(self, effects: Sequence,
+               caller_path: str) -> Optional[ScheduleSet]:
+        return self._expand(effects, caller_path)
+
+
+def _tarjan(edges: Dict[Tuple, List[Tuple]]) -> List[List[Tuple]]:
+    """Iterative Tarjan; returns SCCs in reverse topological order
+    (callees before callers)."""
+    index: Dict[Tuple, int] = {}
+    low: Dict[Tuple, int] = {}
+    on_stack: Dict[Tuple, bool] = {}
+    stack: List[Tuple] = []
+    sccs: List[List[Tuple]] = []
+    counter = [0]
+
+    for root in edges:
+        if root in index:
+            continue
+        work = [(root, iter(edges.get(root, ())))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack[root] = True
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for child in it:
+                if child not in edges:
+                    continue
+                if child not in index:
+                    index[child] = low[child] = counter[0]
+                    counter[0] += 1
+                    stack.append(child)
+                    on_stack[child] = True
+                    work.append((child, iter(edges.get(child, ()))))
+                    advanced = True
+                    break
+                if on_stack.get(child):
+                    low[node] = min(low[node], index[child])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+def build_project(sources: Sequence[Tuple],
+                  finalize_cache: bool = True,
+                  cache_held_sources: bool = False) -> ProjectContext:
+    """ProjectContext from (src_or_None, path[, tree]) tuples.
+    ``src=None`` reads through the mtime cache; held sources are
+    summarized directly UNLESS ``cache_held_sources`` — then the
+    path's on-disk stat keys the cache and ``src``/``tree`` just save
+    the re-read/re-parse (the analyze_paths shape, where every source
+    was read and parsed moments ago). Never set it for in-memory-only
+    sources (fixture strings whose fake path could shadow a real
+    file)."""
+    files: List[FileSummary] = []
+    for item in sources:
+        src, path = item[0], item[1]
+        tree = item[2] if len(item) > 2 else None
+        if src is None:
+            fs = summarize_path(path)
+        elif cache_held_sources and os.path.isfile(path):
+            fs = summarize_path(path, src=src, tree=tree)
+        else:
+            try:
+                fs = summarize_source(src, path, tree)
+            except SyntaxError:
+                fs = None
+        if fs is not None:
+            files.append(fs)
+    if finalize_cache:
+        _save_disk_cache()
+    return ProjectContext(files)
+
+
+def build_project_from_summaries(
+        summaries: Sequence[FileSummary]) -> ProjectContext:
+    """ProjectContext from already-built summaries (the analyze_paths
+    shape: each file summarized — and its AST freed — inside the
+    read loop instead of holding every tree until the project pass)."""
+    _save_disk_cache()
+    return ProjectContext(list(summaries))
+
+
+# ---------------------------------------------------------------------------
+# Rendering helpers
+
+
+def _fmt_schedule(sched: Schedule) -> str:
+    if not sched:
+        return "(no collectives)"
+    parts = []
+    for item in sched:
+        if item[0] == "coll":
+            parts.append(item[1])
+        else:
+            kind, peer = item
+            parts.append(f"{kind}({'peer=%s' % peer if peer is not None else '?'})")
+    return " -> ".join(parts)
+
+
+def _coll_only(s: ScheduleSet) -> FrozenSet[Schedule]:
+    return frozenset(
+        tuple(i for i in sched if i[0] == "coll") for sched in s)
+
+
+def _p2p_only(s: ScheduleSet) -> FrozenSet[Schedule]:
+    return frozenset(
+        tuple(i for i in sched if i[0] in ("send", "recv"))
+        for sched in s)
+
+
+def _direct_coll_ops(effects: Sequence) -> FrozenSet[str]:
+    """Op-name set of collectives textually in this branch (what
+    COLL001 already sees — used to dedupe COLL002 against it)."""
+    out = set()
+
+    def walk(effs):
+        for e in effs:
+            if isinstance(e, CollEffect):
+                out.add(e.op)
+            elif isinstance(e, RankBranch):
+                walk(e.body)
+                walk(e.orelse)
+            elif isinstance(e, LoopEffect):
+                walk(e.body)
+
+    walk(effects)
+    return frozenset(out)
+
+
+def _iter_rank_branches(effects: Sequence) -> Iterator[RankBranch]:
+    for e in effects:
+        if isinstance(e, RankBranch):
+            if e.is_rank:
+                yield e
+            yield from _iter_rank_branches(e.body)
+            yield from _iter_rank_branches(e.orelse)
+        elif isinstance(e, LoopEffect):
+            yield from _iter_rank_branches(e.body)
+
+
+# ---------------------------------------------------------------------------
+# COLL002 — cross-function schedule divergence
+
+
+@register_rule(
+    "COLL002", severity="error", scope="project",
+    summary="rank-conditional branches transitively issue different "
+            "collective schedules (cross-function deadlock)",
+    hint="every rank must reach the same collectives in the same order "
+         "or the job deadlocks silently until the CommWatchdog aborts. "
+         "Hoist the divergent helper calls out of the rank branch, or "
+         "make both helpers issue the same collective sequence; "
+         "silence a deliberate divergence with "
+         "# graft-lint: disable=COLL002",
+)
+def coll002(project: ProjectContext):
+    for fs in project.files:
+        for fn in fs.functions:
+            for rb in _iter_rank_branches(fn.effects):
+                direct_b = _direct_coll_ops(rb.body)
+                direct_o = _direct_coll_ops(rb.orelse)
+                # stand down ONLY for the shape COLL001 actually sees:
+                # ops outside its set (gather/reduce/...) must fall
+                # through to the schedule comparison or a direct
+                # gather-vs-reduce deadlock ships with zero findings
+                if (direct_b & _COLLECTIVES) != (direct_o & _COLLECTIVES):
+                    continue  # COLL001 already reports this shape
+                b = project.expand(rb.body, fn.path)
+                o = project.expand(rb.orelse, fn.path)
+                if b is None or o is None:
+                    continue  # unknown/over-budget: no finding
+                b, o = _coll_only(b), _coll_only(o)
+                if not b.isdisjoint(o):
+                    continue  # some expansion agrees — schedules can match
+                rep_b = min(b, default=())
+                rep_o = min(o, default=())
+                yield (fs.path, rb.line, rb.col,
+                       f"rank-conditional branches in `{fn.name}` "
+                       "transitively issue different collective "
+                       f"schedules: one side runs "
+                       f"[{_fmt_schedule(rep_b)}], the other "
+                       f"[{_fmt_schedule(rep_o)}] — the ranks deadlock "
+                       "in whichever callee diverges first")
+
+
+# ---------------------------------------------------------------------------
+# COLL003 — send/recv peer mismatch across call boundaries
+
+
+def _p2p_counts(sched: Schedule) -> Tuple[List[Optional[int]],
+                                          List[Optional[int]]]:
+    sends = [p for k, p in sched if k == "send"]
+    recvs = [p for k, p in sched if k == "recv"]
+    return sends, recvs
+
+
+def _has_p2p_outside(project: ProjectContext, fn: FunctionSummary,
+                     rb: RankBranch) -> bool:
+    """True when the function has p2p activity (direct or through
+    resolved calls) OUTSIDE the given rank branch — the branch's
+    sends/recvs may pair with it (e.g. an unconditional ring send
+    followed by rank-ordered recvs), so COLL003 must stand down."""
+
+    def walk(effects) -> bool:
+        for e in effects:
+            if e is rb:
+                continue
+            if isinstance(e, P2PEffect):
+                return True
+            if isinstance(e, (RankBranch, LoopEffect)):
+                if walk(e.body) or walk(getattr(e, "orelse", ())):
+                    return True
+            elif isinstance(e, CallEffect):
+                target = project.resolve(fn.path, e)
+                if target is None:
+                    continue
+                s = project.schedules_of(target)
+                if s is None or any(
+                        any(i[0] in ("send", "recv") for i in sched)
+                        for sched in s):
+                    return True
+        return False
+
+    return walk(fn.effects)
+
+
+@register_rule(
+    "COLL003", severity="error", scope="project",
+    summary="rank-conditional send/recv pairing whose peers or "
+            "directions cannot match (cross-function)",
+    hint="a rank-conditional send must be matched by a recv on the "
+         "other branch whose peer is the sending rank (and vice "
+         "versa) — a mis-peered or same-direction pairing blocks "
+         "forever. Fix the literal src/dst, or give the opposite "
+         "branch the complementary direction",
+)
+def coll003(project: ProjectContext):
+    for fs in project.files:
+        for fn in fs.functions:
+            for rb in _iter_rank_branches(fn.effects):
+                b = project.expand(rb.body, fn.path)
+                o = project.expand(rb.orelse, fn.path)
+                if b is None or o is None:
+                    continue
+                b, o = _p2p_only(b), _p2p_only(o)
+                # only the unambiguous single-schedule shape is checked
+                if len(b) != 1 or len(o) != 1:
+                    continue
+                (sb,), (so,) = tuple(b), tuple(o)
+                if not sb or not so:
+                    continue  # one-sided p2p may pair elsewhere
+                if _has_p2p_outside(project, fn, rb):
+                    continue  # may pair with p2p around the branch
+                sends_b, recvs_b = _p2p_counts(sb)
+                sends_o, recvs_o = _p2p_counts(so)
+                # DIRECTION check only: both sides sending (or both
+                # receiving) with no complementary endpoint anywhere
+                # is a definite deadlock. Count imbalance is NOT — a
+                # one-to-many scatter legitimately sends N times
+                # against each peer's single recv.
+                if (sends_b and sends_o and not recvs_b
+                        and not recvs_o) or (
+                        recvs_b and recvs_o and not sends_b
+                        and not sends_o):
+                    kind = "send" if sends_b else "recv"
+                    yield (fs.path, rb.line, rb.col,
+                           f"both rank branches in `{fn.name}` only "
+                           f"{kind} — no branch runs the matching "
+                           f"{'recv' if kind == 'send' else 'send'}, "
+                           "so every endpoint blocks forever")
+                    continue
+                if rb.rank_eq is None:
+                    continue
+                k = rb.rank_eq
+                eq_side, other = ((sb, so) if rb.eq_in_body
+                                  else (so, sb))
+                msg = None
+                for kind, peer in other:
+                    if peer is not None and peer != k:
+                        msg = (f"the non-`rank == {k}` branch of "
+                               f"`{fn.name}` calls {kind}(peer={peer}) "
+                               f"but its only counterpart runs on rank "
+                               f"{k} — the transfer never matches")
+                        break
+                if msg is None:
+                    for kind, peer in eq_side:
+                        if peer is not None and peer == k:
+                            msg = (f"rank {k}'s branch in `{fn.name}` "
+                                   f"calls {kind}(peer={peer}) — a "
+                                   "rank sending to/receiving from "
+                                   "itself never completes")
+                            break
+                if msg is not None:
+                    yield (fs.path, rb.line, rb.col, msg)
+
+
+# ---------------------------------------------------------------------------
+# DDL002 — interprocedural Deadline propagation
+
+
+def _passes_deadline(call: CallEffect, target: FunctionSummary) -> bool:
+    if any(_TIMEOUTISH.search(kw) for kw in call.kwargs):
+        return True
+    pos = target.deadline_param_pos
+    if call.has_receiver and target.params and \
+            target.params[0] in ("self", "cls"):
+        pos -= 1  # `c.fetch(k, dl)`: the receiver fills `self`
+    return 0 <= pos < call.nargs
+
+
+@register_rule(
+    "DDL002", severity="warning", scope="project",
+    summary="call into a (transitively) blocking function whose "
+            "optional deadline parameter the caller never threads",
+    hint="the callee can block indefinitely when its deadline "
+         "parameter stays None — thread a Deadline through the "
+         "enclosing function and pass it down "
+         "(see utils/retries.py's discipline); a call that is "
+         "deliberately unbounded can be silenced with "
+         "# graft-lint: disable=DDL002",
+)
+def ddl002(project: ProjectContext):
+    for fs in project.files:
+        for fn in fs.functions:
+            if fn.mentions_deadline:
+                continue  # the caller handles a deadline of its own
+
+            def walk(effects):
+                for e in effects:
+                    if isinstance(e, RankBranch):
+                        yield from walk(e.body)
+                        yield from walk(e.orelse)
+                    elif isinstance(e, LoopEffect):
+                        yield from walk(e.body)
+                    elif isinstance(e, CallEffect):
+                        yield e
+
+            for call in walk(fn.effects):
+                target = project.resolve(fn.path, call)
+                if target is None or target.deadline_param is None:
+                    continue
+                tfile = project.file_of.get(target.fid())
+                if not (fs.imports_retries
+                        or (tfile is not None
+                            and tfile.imports_retries)):
+                    continue  # outside the retries discipline
+                if not project.blocks(target):
+                    continue
+                if _passes_deadline(call, target):
+                    continue
+                yield (fs.path, call.line, call.col,
+                       f"`{target.name}()` can block indefinitely "
+                       f"(defined at {target.path}:{target.line}) and "
+                       f"accepts `{target.deadline_param}=`, but "
+                       f"`{fn.name}` never threads a Deadline through "
+                       "the call")

@@ -9,6 +9,16 @@ leaking into the traced signature, a shape that stopped being padded);
 latency then quietly 10x's. Tests pin the expected compile count so
 the regression fails loudly instead.
 
+:func:`collective_contract` is the dynamic companion to COLL002/
+COLL003: each rank's eager collectives append signatures to the
+collective flight recorder
+(``distributed/communication/flight_recorder.py``); the contract
+cross-checks all ranks' recorded schedules through a shared KV store
+and raises :class:`CollectiveScheduleMismatch` — naming every rank's
+last-N schedule — when they diverge. What the static rules prove
+impossible on the analyzable call graph, the contract catches at test
+time, and the CommWatchdog dumps at hang time.
+
 Implementation: jax logs one "Compiling <name> with global shapes and
 types [...]" record per XLA compilation (module ``jax._src.
 interpreters.pxla``, DEBUG level unless jax_log_compiles is set). The
@@ -28,7 +38,31 @@ from dataclasses import dataclass
 from typing import List, Optional
 
 __all__ = ["CompileEvent", "RecompileError", "RecompileGuard",
-           "recompile_guard"]
+           "recompile_guard", "CollectiveScheduleMismatch",
+           "collective_contract"]
+
+
+class CollectiveScheduleMismatch(AssertionError):
+    """Two ranks recorded different collective schedules — the
+    runtime-confirmed COLL002 deadlock shape. The message names every
+    rank's last-N recorded schedule and the first diverging entry."""
+
+
+def collective_contract(store, rank, world_size, *, last_n=32,
+                        deadline=None, recorder=None, tag="default"):
+    """Cross-check the collective flight recorder's schedule against
+    every peer through ``store`` (TCPKVStore/FileKVStore). Raises
+    :class:`CollectiveScheduleMismatch` on divergence; returns the
+    per-rank schedules (``{rank: [CollectiveSignature, ...]}``) on
+    agreement. Every rank must call it the same number of times — the
+    contract is itself a synchronization point. See
+    ``distributed/communication/flight_recorder.py`` for the recording
+    side; ``deadline`` (seconds or a ``utils.retries.Deadline``)
+    bounds the wait for peers' schedules (default 30 s)."""
+    from ..distributed.communication import flight_recorder as _fr
+
+    return _fr.contract(store, rank, world_size, last_n=last_n,
+                        deadline=deadline, recorder_=recorder, tag=tag)
 
 # one logger per jax version family; 0.4.x emits from pxla, newer from
 # _src.compiler — listening on both costs nothing
@@ -136,10 +170,17 @@ def recompile_guard(max_compiles: Optional[int] = None,
     try:
         yield guard
     finally:
+        # runs on EVERY exit — including an exception raised inside the
+        # guarded block — and restores each logger independently, so a
+        # failing guarded test can never leak the handler (or the
+        # DEBUG level) into later tests
         for lg, lvl, prop in saved:
-            lg.removeHandler(handler)
-            lg.setLevel(lvl)
-            lg.propagate = prop
+            try:
+                lg.removeHandler(handler)
+                lg.setLevel(lvl)
+                lg.propagate = prop
+            except Exception:  # noqa: BLE001 — restore the rest anyway
+                pass
     if max_compiles is not None and guard.count() > max_compiles:
         evs = "\n  ".join(str(e) for e in guard.events())
         raise RecompileError(

@@ -116,6 +116,7 @@ define_flag("comm_abort_on_timeout", True, "Watchdog aborts the process on a tim
 define_flag("comm_warn_fraction", 0.5, "Watchdog ladder: warn when a wait has consumed this fraction of its deadline")
 define_flag("comm_dump_fraction", 0.75, "Watchdog ladder: all-thread stack dump at this fraction of the deadline (abort fires at 1.0)")
 define_flag("enable_comm_dynamic_check", False, "Cross-rank shape/dtype check before collectives (ref FLAGS_enable_nccl_dynamic_check)")
+define_flag("comm_flight_recorder_len", 128, "Collective flight recorder ring size: last-N collective signatures kept per rank (dumped by the watchdog, cross-checked by collective_contract)")
 define_flag("use_stream_safe_allocator", True, "no-op on TPU; kept for parity")
 define_flag("eager_delete_tensor_gb", 0.0, "no-op on TPU; kept for parity")
 define_flag("log_level", 0, "VLOG-style verbosity for paddle_tpu.utils.log")

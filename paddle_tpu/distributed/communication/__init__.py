@@ -463,6 +463,10 @@ def barrier(group: Optional[Group] = None):
     from .watchdog import watch
 
     g = _resolve(group)
+    if jax.process_count() > 1:
+        from . import flight_recorder as _fr
+
+        _fr.record("barrier", group=str(g.id))
     with watch(f"barrier(group={g.id})"):
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils

@@ -188,6 +188,17 @@ class CommWatchdog:
             _log.warning(msg)
             sys.stderr.write(msg + "\n")
             faulthandler.dump_traceback(all_threads=True, file=sys.stderr)
+            # the analogue of the reference dumping its comm trace
+            # buffer: the last-N collective signatures this rank issued
+            # (and, when a contract store is attached, a schedule diff
+            # against every peer that published) — a real cross-rank
+            # hang yields a SCHEDULE DIFF, not just stacks
+            try:
+                from .flight_recorder import dump_on_watchdog
+
+                dump_on_watchdog(sys.stderr)
+            except Exception:  # noqa: BLE001 — diagnostics must not raise
+                pass
         elif stage == "abort":
             msg = (
                 f"CommWatchdog: wait '{desc}' exceeded its deadline "

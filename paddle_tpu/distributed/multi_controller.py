@@ -188,8 +188,40 @@ def _compiled(kind: str, shape, dtype, extra):
     return jax.jit(fn)
 
 
+_RECORD_OPS = {
+    "sum": "all_reduce[sum]", "max": "all_reduce[max]",
+    "min": "all_reduce[min]", "prod": "all_reduce[prod]",
+    "avg": "all_reduce[avg]", "gather": "all_gather",
+    "bcast": "broadcast", "p2p": "p2p_sendrecv", "perm": "ppermute",
+}
+
+
+def _record(op: str, x=None, peer=None, detail: str = "") -> None:
+    """Append a signature to the collective flight recorder BEFORE the
+    op executes (issue order is what the cross-rank contract and the
+    watchdog's hang dump compare; recording first means a hang still
+    shows the op this rank is stuck in)."""
+    from .communication import flight_recorder as _fr
+
+    shape: tuple = ()
+    dtype = ""
+    if x is not None:
+        # read metadata off the array when it has it — np.asarray on a
+        # device array would materialize the whole buffer to host just
+        # for .shape/.dtype
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            shape, dtype = tuple(x.shape), str(x.dtype)
+        else:
+            a = np.asarray(x)
+            shape, dtype = tuple(a.shape), str(a.dtype)
+    _fr.record(op, shape=shape, dtype=dtype, group="world", peer=peer,
+               detail=detail)
+
+
 def _run(kind: str, x, extra=None) -> np.ndarray:
     x = np.asarray(x)
+    _record(_RECORD_OPS.get(kind, kind), x,
+            detail="" if extra is None else f"extra={extra}")
     out = _compiled(kind, x.shape, str(x.dtype), extra)(_global_input(x))
     return np.asarray(out)  # fully replicated → readable on every host
 
@@ -245,6 +277,7 @@ def _kv_client():
 
 def eager_send(x, dst: int) -> None:
     me = jax.process_index()
+    _record("send", x, peer=int(dst))
     seq = _p2p_seq[(me, dst)] = _p2p_seq.get((me, dst), 0) + 1
     arr = np.ascontiguousarray(np.asarray(x))
     _kv_client().key_value_set_bytes(
@@ -265,6 +298,7 @@ def eager_recv(src: int, timeout_ms: int = 600_000,
         dl.check(f"eager_recv(src={src})")
         timeout_ms = int(min(float(timeout_ms),
                              dl.timeout(timeout_ms / 1000.0) * 1000.0))
+    _record("recv", peer=int(src))
     # the pair counter commits only AFTER a successful receive: a
     # timed-out get followed by a retry must wait on the SAME seq the
     # sender published, not permanently skip past it (pair desync)

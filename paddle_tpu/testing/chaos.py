@@ -24,6 +24,12 @@ Instrumented sites (grep for ``chaos.inject``):
 - ``bench.attempt``      — the bench child, before any JAX import
 - ``bench.probe``        — the bench preflight device-enumeration
   child, before any JAX import (indexed by probe attempt)
+- ``comm.reorder``       — each collective flight-recorder append
+  (``distributed/communication/flight_recorder.py``); a ``drop``
+  here DEFERS that collective's signature until the next
+  non-deferred one on this rank (FIFO across consecutive drops) —
+  the deterministic schedule swap ``collective_contract`` and the
+  COLL002 detector must catch
 - ``train.step``         — opt-in: training loops/test workers call it
 
 Faults (``Fault.kind``): ``hang``/``slow`` (sleep ``arg`` seconds;

@@ -1,0 +1,44 @@
+"""The seeded two-rank collective deadlock (ISSUE 5 acceptance).
+
+``train_step`` is the cross-function deadlock shape COLL001 cannot
+see: neither rank branch contains a collective TEXTUALLY — each calls
+a helper, and the helpers issue the same two collectives in opposite
+orders. Rank 0 enters all_reduce while rank 1 enters broadcast; on a
+real transport both block forever (the opaque hang the CommWatchdog
+eventually aborts).
+
+This file is used twice by the test suite:
+
+- **statically**: ``graft-lint --interprocedural`` (COLL002) must flag
+  ``train_step`` while COLL001 stays silent
+  (tests/test_analysis_interproc.py);
+- **dynamically**: tests/_fr_worker.py executes ``train_step`` on two
+  real processes with a schedule-recording ``dist`` shim, and
+  ``collective_contract()`` over a TCPKVStore must report the
+  divergence, naming both ranks' recorded schedules
+  (tests/test_flight_recorder.py).
+
+The ``dist`` handle is a parameter so the dynamic run can inject the
+recording shim; graft-lint's name-based analysis sees the
+``dist.all_reduce``/``dist.broadcast`` calls either way.
+"""
+
+
+def _sync_then_publish(dist, t):
+    """Rank 0's path: reduce gradients, then broadcast the result."""
+    dist.all_reduce(t)
+    dist.broadcast(t, src=0)
+
+
+def _publish_then_sync(dist, t):
+    """The other ranks' path: same collectives, swapped order."""
+    dist.broadcast(t, src=0)
+    dist.all_reduce(t)
+
+
+def train_step(dist, t, rank):  # graft-lint: the COLL002 seed
+    if rank == 0:
+        _sync_then_publish(dist, t)
+    else:
+        _publish_then_sync(dist, t)
+    return t

@@ -1,0 +1,77 @@
+"""Flight-recorder contract worker (plain subprocess, 2 ranks).
+
+Usage: ``python _fr_worker.py RANK STORE_PORT MODE``
+
+Each rank records the collective schedule its program issues into the
+collective flight recorder, then runs ``collective_contract()``
+against the parent's TCPStoreServer. Modes:
+
+- ``fixture``: execute ``_coll002_fixture.train_step`` — the seeded
+  cross-function deadlock. The rank branches issue swapped schedules,
+  so the contract must raise on BOTH ranks.
+- ``reorder``: both ranks run the IDENTICAL program (all_reduce then
+  broadcast); the parent sets ``PADDLE_CHAOS=comm.reorder@1=drop`` for
+  rank 1 only, so the chaos site defers rank 1's all_reduce behind its
+  broadcast — the dynamically injected schedule swap the contract must
+  catch.
+
+Exit codes: 0 = schedules agreed; 3 = CollectiveScheduleMismatch (the
+expected outcome for both modes; the diff is printed to stdout);
+anything else = harness failure.
+
+The ``dist`` shim records signatures exactly where the real
+multi-controller eager collectives would (the instrumented
+``multi_controller._record`` path) without needing a JAX coordination
+service — the contract and recorder are transport-independent.
+"""
+import sys
+
+
+class RecordingDist:
+    """Schedule-recording stand-in for paddle_tpu.distributed: each
+    call appends the signature the real eager collective would."""
+
+    def __init__(self, fr):
+        self._fr = fr
+
+    def all_reduce(self, t):
+        self._fr.record("all_reduce[sum]", (2,), "float32")
+
+    def broadcast(self, t, src=0):
+        self._fr.record("broadcast", (2,), "float32", detail=f"src={src}")
+
+
+def main():
+    rank, port, mode = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from paddle_tpu.analysis import (
+        CollectiveScheduleMismatch,
+        collective_contract,
+    )
+    from paddle_tpu.distributed.communication import flight_recorder as fr
+    from paddle_tpu.distributed.store import TCPKVStore
+
+    dist = RecordingDist(fr)
+    if mode == "fixture":
+        from _coll002_fixture import train_step
+
+        train_step(dist, object(), rank)
+    elif mode == "reorder":
+        # identical program on every rank — only the chaos injection
+        # (installed from PADDLE_CHAOS on rank 1) diverges the record
+        dist.all_reduce(None)
+        dist.broadcast(None, src=0)
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+    store = TCPKVStore("127.0.0.1", port)
+    try:
+        collective_contract(store, rank, 2, last_n=8, deadline=60.0)
+    except CollectiveScheduleMismatch as e:
+        print(f"CONTRACT_MISMATCH rank {rank}", flush=True)
+        print(str(e), flush=True)
+        raise SystemExit(3)
+    print(f"CONTRACT_OK rank {rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,19 @@ def check_collectives(rank, world):
     assert objs[1]["tag"] == "xx"
     print(f"rank {rank}: collectives OK", flush=True)
 
+    # the collective flight recorder saw every eager collective above,
+    # in issue order (ISSUE 5: the watchdog dumps this ring on a hang)
+    from paddle_tpu.distributed.communication import flight_recorder as fr
+
+    ops = [s.op for s in fr.recorder().snapshot()]
+    assert "all_reduce[sum]" in ops, ops
+    assert "all_gather" in ops, ops
+    assert ("send" in ops) and ("recv" in ops), ops
+    assert "broadcast" in ops, ops
+    assert ops.index("all_reduce[sum]") < ops.index("all_gather"), ops
+    print(f"rank {rank}: flight recorder OK ({len(ops)} signatures)",
+          flush=True)
+
 
 def check_dp_loss_parity(rank, world):
     import jax.numpy as jnp

@@ -478,12 +478,31 @@ def test_self_lint():
 class TestSelfLint:
     def test_cli_exits_zero_on_package(self):
         """The acceptance command: `python -m paddle_tpu.analysis
-        paddle_tpu/` with the committed baseline exits 0."""
+        paddle_tpu/` with the committed baseline exits 0 — the
+        interprocedural pass (COLL002/COLL003/DDL002) is ON by
+        default, so this also proves the graft-verify self-lint stays
+        clean with an EMPTY baseline."""
         proc = subprocess.run(
             [sys.executable, "-m", "paddle_tpu.analysis", "paddle_tpu"],
             cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "graft-lint:" in proc.stdout
+
+    def test_cli_interprocedural_explicit_flag_stays_clean(self):
+        """`graft-lint --interprocedural` (the spelled-out acceptance
+        form) over the package: zero new findings, empty baseline."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", "paddle_tpu",
+             "--interprocedural", "--no-baseline"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 new finding(s)" in proc.stdout
+
+    def test_committed_baseline_is_empty(self):
+        data = json.load(open(default_baseline_path()))
+        assert data["entries"] == {}, (
+            "the self-lint baseline must stay EMPTY: fix or "
+            "suppress-with-reason anything the rules find in-tree")
 
     def test_cli_fails_on_seeded_violation(self, tmp_path):
         bad = tmp_path / "bad.py"
@@ -594,3 +613,23 @@ class TestRecompileGuard:
             noisy_neighbor(jnp.ones(7))
         assert g.count() == 0
         assert g.count(match=r"noisy") == 1
+
+    def test_handler_detaches_on_exception_exit(self):
+        """ISSUE 5 satellite: a failing guarded test must not leak the
+        guard's logging handler (or the temporarily-lowered DEBUG
+        level) into later tests — the restore runs in a finally."""
+        import logging
+
+        from paddle_tpu.analysis import recompile_guard
+        from paddle_tpu.analysis.sanitizers import _COMPILE_LOGGERS
+
+        loggers = [logging.getLogger(n) for n in _COMPILE_LOGGERS]
+        before = [(lg.level, lg.propagate, list(lg.handlers))
+                  for lg in loggers]
+        with pytest.raises(RuntimeError, match="boom"):
+            with recompile_guard(max_compiles=0):
+                raise RuntimeError("boom")
+        after = [(lg.level, lg.propagate, list(lg.handlers))
+                 for lg in loggers]
+        assert after == before, "guard leaked handlers/levels on an " \
+                                "exception exit"

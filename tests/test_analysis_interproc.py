@@ -1,0 +1,889 @@
+"""graft-verify: the interprocedural rules (ISSUE 5).
+
+Every rule is proven both ways, matching PR 3's bar: >= 2 seeded true
+violations it must catch AND >= 2 near-misses it must NOT flag. Plus
+the engine mechanics the rules depend on: cross-file resolution,
+recursion/budget bail-outs, COLL001 dedup, suppressions, the summary
+cache, and the CLI surface (--interprocedural default, --format
+github, documented exit codes).
+
+Run standalone via ``pytest -m analysis``.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import analyze_paths, analyze_source
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO_ROOT, "tests", "_coll002_fixture.py")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_summary_cache(tmp_path_factory, monkeypatch):
+    """Point the summary disk cache (and the CLI subprocesses, which
+    inherit the env) at a throwaway dir — the suite must neither
+    pollute the developer's ~/.cache/graft-lint nor depend on what a
+    previous checkout wrote there."""
+    from paddle_tpu.analysis import interproc
+
+    cache_dir = tmp_path_factory.mktemp("graft-lint-cache")
+    monkeypatch.setenv("GRAFT_LINT_CACHE_DIR", str(cache_dir))
+    monkeypatch.setattr(interproc, "_mem_cache", {})
+    monkeypatch.setattr(interproc, "_disk_loaded", False)
+    monkeypatch.setattr(interproc, "_disk_dirty", False)
+
+
+def findings_for(src, rule, path="fixture.py"):
+    return analyze_source(textwrap.dedent(src), path, select=[rule])
+
+
+def lines_of(findings):
+    return [f.line for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# COLL002 — cross-function collective schedule divergence
+
+
+class TestColl002:
+    def test_catches_swapped_schedules_through_helpers(self):
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def sync_then_publish(t):
+            dist.all_reduce(t)
+            dist.broadcast(t, src=0)
+
+        def publish_then_sync(t):
+            dist.broadcast(t, src=0)
+            dist.all_reduce(t)
+
+        def train_step(t, rank):
+            if rank == 0:               # line 13: the deadlock
+                sync_then_publish(t)
+            else:
+                publish_then_sync(t)
+        """
+        got = findings_for(src, "COLL002")
+        assert lines_of(got) == [13]
+        assert got[0].severity == "error"
+        assert "all_reduce -> broadcast" in got[0].message
+        assert "broadcast -> all_reduce" in got[0].message
+        # COLL001 cannot see it: no collective is textually in a branch
+        assert findings_for(src, "COLL001") == []
+
+    def test_catches_one_sided_collective_two_calls_deep(self):
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def checkpoint(t):
+            shard_meta(t)
+
+        def shard_meta(t):
+            lst = []
+            dist.all_gather(lst, t)
+
+        def maybe_checkpoint(t):
+            if dist.get_rank() == 0:    # line 12
+                checkpoint(t)
+            else:
+                log_skip(t)
+
+        def log_skip(t):
+            print("skipping", t)
+        """
+        got = findings_for(src, "COLL002")
+        assert lines_of(got) == [12]
+        assert "all_gather" in got[0].message
+        assert findings_for(src, "COLL001") == []
+
+    def test_near_miss_same_schedule_via_different_helpers(self):
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def primary_path(t):
+            dist.all_reduce(t)
+            dist.broadcast(t, src=0)
+
+        def replica_path(t):
+            dist.all_reduce(t)
+            dist.broadcast(t, src=0)
+
+        def train_step(t, rank):
+            if rank == 0:
+                primary_path(t)
+            else:
+                replica_path(t)
+        """
+        assert findings_for(src, "COLL002") == []
+
+    def test_near_miss_plain_conditional_collective_variants(self):
+        """A data-conditional (non-rank) if/else choosing between two
+        all_reduce call sites is ONE collective either way — not a
+        sequence of two (review fix)."""
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def reduce_maybe_scaled(t, scaled):
+            if scaled:
+                dist.all_reduce(t * 2)
+            else:
+                dist.all_reduce(t)
+
+        def train_step(t, rank, scaled):
+            if rank == 0:
+                reduce_maybe_scaled(t, scaled)
+            else:
+                dist.all_reduce(t)
+        """
+        assert findings_for(src, "COLL002") == []
+
+    def test_near_miss_nested_calls_record_in_evaluation_order(self):
+        """`broadcast(all_reduce(t))` executes all_reduce FIRST — the
+        fused form and the two-statement form are the same schedule
+        (review fix: lexical order would invert nested calls)."""
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def fused(t):
+            dist.broadcast(dist.all_reduce(t), src=0)
+
+        def spelled_out(t):
+            dist.all_reduce(t)
+            dist.broadcast(t, src=0)
+
+        def train_step(t, rank):
+            if rank == 0:
+                fused(t)
+            else:
+                spelled_out(t)
+        """
+        assert findings_for(src, "COLL002") == []
+
+    def test_near_miss_rank_conditional_logging_helper(self):
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def log_metrics(t):
+            print("loss", t)
+
+        def train_step(t, rank):
+            if rank == 0:
+                log_metrics(t)
+            dist.all_reduce(t)          # unconditional: every rank
+        """
+        assert findings_for(src, "COLL002") == []
+
+    def test_near_miss_looped_collective_is_unknown_multiplicity(self):
+        """`for _ in range(2): all_reduce(t)` vs two literal calls is
+        the same runtime schedule — loop bodies have statically
+        unknown multiplicity, so no finding (review fix)."""
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def reduce_rounds(t):
+            for _ in range(2):
+                dist.all_reduce(t)
+
+        def reduce_twice(t):
+            dist.all_reduce(t)
+            dist.all_reduce(t)
+
+        def train_step(t, rank):
+            if rank == 0:
+                reduce_rounds(t)
+            else:
+                reduce_twice(t)
+        """
+        assert findings_for(src, "COLL002") == []
+
+    def test_near_miss_conditional_expression_forks(self):
+        """`a(t) if fast else b(t)` runs ONE side — the ternary twin
+        of an if/else helper is the same schedule set (review fix)."""
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def ternary(t, fast):
+            dist.all_reduce(t) if fast else dist.broadcast(t, src=0)
+
+        def spelled(t, fast):
+            if fast:
+                dist.all_reduce(t)
+            else:
+                dist.broadcast(t, src=0)
+
+        def train_step(t, rank, fast):
+            if rank == 0:
+                ternary(t, fast)
+            else:
+                spelled(t, fast)
+        """
+        assert findings_for(src, "COLL002") == []
+
+    def test_near_miss_short_circuit_operand_is_optional(self):
+        """`ok and dist.all_reduce(t)` may run zero collectives — it
+        must not read as an unconditional issue (review fix)."""
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def guarded(t, ok):
+            return ok and dist.all_reduce(t)
+
+        def plain(t):
+            dist.all_reduce(t)
+
+        def train_step(t, rank, ok):
+            if rank == 0:
+                guarded(t, ok)
+            else:
+                plain(t)
+        """
+        assert findings_for(src, "COLL002") == []
+
+    def test_near_miss_except_handler_is_an_alternative_path(self):
+        """A retry-once handler's collective is an ALTERNATIVE, not an
+        unconditional second issue — the normal paths agree, so no
+        finding (review fix)."""
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def reduce_with_retry(t):
+            try:
+                dist.all_reduce(t)
+            except RuntimeError:
+                dist.all_reduce(t)
+
+        def reduce_plain(t):
+            dist.all_reduce(t)
+
+        def train_step(t, rank):
+            if rank == 0:
+                reduce_with_retry(t)
+            else:
+                reduce_plain(t)
+        """
+        assert findings_for(src, "COLL002") == []
+
+    def test_catches_direct_ops_outside_coll001s_vocabulary(self):
+        """`gather` vs `reduce` directly in the branches: COLL001's
+        set lacks them, so COLL002 must NOT stand down (review fix —
+        previously a guaranteed deadlock shipped with zero
+        findings)."""
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def collect(t, rank):
+            if rank == 0:
+                dist.gather(t)
+            else:
+                dist.reduce(t)
+        """
+        assert [f.rule for f in findings_for(src, "COLL002")] == \
+            ["COLL002"]
+        assert findings_for(src, "COLL001") == []
+
+    def test_direct_mismatch_stays_coll001s_finding(self):
+        """A collective textually inside the branch is COLL001's
+        report; COLL002 must not double-report the same If."""
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def train_step(t, rank):
+            if rank == 0:
+                dist.broadcast(t, src=0)
+            return t
+        """
+        assert findings_for(src, "COLL002") == []
+        assert len(findings_for(src, "COLL001")) == 1
+
+    def test_recursion_bails_to_no_finding(self):
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def ring_pass(t, depth):
+            dist.all_reduce(t)
+            ring_pass(t, depth - 1)
+
+        def train_step(t, rank):
+            if rank == 0:
+                ring_pass(t, 3)
+            else:
+                dist.all_reduce(t)
+        """
+        assert findings_for(src, "COLL002") == []
+
+    def test_branch_budget_bails_to_no_finding(self):
+        """A callee whose rank-conditional forks exceed MAX_SCHEDULES
+        possible expansions is *unknown* — no finding, no blow-up."""
+        forks = "\n".join(
+            f"    if rank == {i}:\n"
+            f"        dist.all_reduce(t)\n"
+            f"    else:\n"
+            f"        dist.broadcast(t, src={i})"
+            for i in range(6)  # 2**6 = 64 > MAX_SCHEDULES
+        )
+        src = (
+            "import paddle_tpu.distributed as dist\n\n"
+            "def forked(t, rank):\n" + forks + "\n\n"
+            "def train_step(t, rank):\n"
+            "    if rank == 0:\n"
+            "        forked(t, rank)\n"
+            "    else:\n"
+            "        dist.all_reduce(t)\n"
+        )
+        assert analyze_source(src, "f.py", select=["COLL002"]) == []
+
+    def test_cross_file_resolution(self, tmp_path):
+        (tmp_path / "helpers.py").write_text(textwrap.dedent("""
+        import paddle_tpu.distributed as dist
+
+        def grad_sync_helper(t):
+            dist.all_reduce(t)
+        """))
+        (tmp_path / "train.py").write_text(textwrap.dedent("""
+        from helpers import grad_sync_helper
+
+        def step(t, rank):
+            if rank == 0:
+                grad_sync_helper(t)
+            else:
+                pass
+        """))
+        got = analyze_paths([str(tmp_path)], select=["COLL002"])
+        assert [f.rule for f in got] == ["COLL002"]
+        assert got[0].path.endswith("train.py")
+
+    def test_overlapping_path_arguments_do_not_mask_findings(
+            self, tmp_path):
+        """`graft-lint dir dir/file.py` must not summarize a file
+        twice — duplicate summaries would make its functions ambiguous
+        and silently disable the interprocedural rules (review fix)."""
+        f = tmp_path / "fx.py"
+        f.write_text(textwrap.dedent("""
+        import paddle_tpu.distributed as dist
+
+        def helper(t):
+            dist.all_reduce(t)
+
+        def step(t, rank):
+            if rank == 0:
+                helper(t)
+        """))
+        got = analyze_paths([str(tmp_path), str(f)], select=["COLL002"])
+        assert [f_.rule for f_ in got] == ["COLL002"]
+
+    def test_file_suppression_applies(self):
+        src = """
+        # graft-lint: disable=COLL002
+        import paddle_tpu.distributed as dist
+
+        def helper(t):
+            dist.all_reduce(t)
+
+        def step(t, rank):
+            if rank == 0:
+                helper(t)
+        """
+        assert findings_for(src, "COLL002") == []
+
+
+# ---------------------------------------------------------------------------
+# COLL003 — cross-function send/recv peer mismatch
+
+
+class TestColl003:
+    def test_catches_wrong_literal_peer_through_helpers(self):
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def push_to_worker(t):
+            dist.send(t, dst=1)
+
+        def pull_from_master(t):
+            dist.recv(t, src=2)         # wrong: master is rank 0
+
+        def exchange(t, rank):
+            if rank == 0:               # line 11
+                push_to_worker(t)
+            else:
+                pull_from_master(t)
+        """
+        got = findings_for(src, "COLL003")
+        assert lines_of(got) == [11]
+        assert got[0].severity == "error"
+        assert "recv(peer=2)" in got[0].message
+        assert "rank 0" in got[0].message
+
+    def test_catches_same_direction_pairing(self):
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def push_grads(t):
+            dist.send(t, dst=1)
+
+        def push_metrics(t):
+            dist.send(t, dst=0)         # should be recv(src=0)
+
+        def shuffle(t, rank):
+            if rank == 0:               # line 11
+                push_grads(t)
+            else:
+                push_metrics(t)
+        """
+        got = findings_for(src, "COLL003")
+        assert lines_of(got) == [11]
+        assert "only send" in got[0].message
+
+    def test_near_miss_one_to_many_scatter_counts(self):
+        """Rank 0 sending once per peer against each peer's single
+        recv is the standard world>2 scatter — count imbalance alone
+        is NOT a deadlock (review fix)."""
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def fan_out(t):
+            dist.send(t, dst=1)
+            dist.send(t, dst=2)
+
+        def take_one(t):
+            dist.recv(t, src=0)
+
+        def scatter_manual(t, rank):
+            if rank == 0:
+                fan_out(t)
+            else:
+                take_one(t)
+        """
+        assert findings_for(src, "COLL003") == []
+
+    def test_near_miss_correct_pairing_via_helpers(self):
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def push_to_worker(t):
+            dist.send(t, dst=1)
+
+        def pull_from_master(t):
+            dist.recv(t, src=0)
+
+        def exchange(t, rank):
+            if rank == 0:
+                push_to_worker(t)
+            else:
+                pull_from_master(t)
+        """
+        assert findings_for(src, "COLL003") == []
+
+    def test_near_miss_dynamic_peers_stay_clean(self):
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def push(t, peer):
+            dist.send(t, dst=peer)
+
+        def pull(t, peer):
+            dist.recv(t, src=peer)
+
+        def exchange(t, rank, peer):
+            if rank == 0:
+                push(t, peer)
+            else:
+                pull(t, peer)
+        """
+        assert findings_for(src, "COLL003") == []
+
+    def test_near_miss_plain_branch_in_helper_is_a_fork(self):
+        """A NON-rank if/else in a callee runs exactly one side — it
+        must not be flattened into 'two sends' (review fix)."""
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def push(t, fast):
+            if fast:
+                dist.send(t, dst=1)
+            else:
+                dist.send(t, dst=1)
+
+        def pull(t):
+            dist.recv(t, src=0)
+
+        def exchange(t, rank, fast):
+            if rank == 0:
+                push(t, fast)
+            else:
+                pull(t)
+        """
+        assert findings_for(src, "COLL003") == []
+
+    def test_near_miss_positional_timeout_is_not_a_peer(self):
+        """`eager_recv(src_var, 5000)` — the positional timeout_ms
+        must not be misread as the peer rank (review fix)."""
+        src = """
+        from paddle_tpu.distributed.multi_controller import (
+            eager_recv, eager_send)
+
+        def push(t):
+            eager_send(t, 1)
+
+        def pull(src_var):
+            return eager_recv(src_var, 5000)
+
+        def exchange(t, rank, src_var):
+            if rank == 0:
+                push(t)
+            else:
+                pull(src_var)
+        """
+        assert findings_for(src, "COLL003") == []
+
+    def test_near_miss_p2p_outside_the_branch_pairs_the_rest(self):
+        """Unconditional ring send followed by rank-ordered recvs:
+        both branches recv-only, but the matching sends sit right
+        before the branch — no finding (review fix)."""
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def recv_left(t):
+            dist.recv(t, src=1)
+
+        def recv_right(t):
+            dist.recv(t, src=0)
+
+        def ring_exchange(t, rank, world):
+            dist.send(t, dst=(rank + 1) % world)
+            if rank == 0:
+                recv_left(t)
+            else:
+                recv_right(t)
+        """
+        assert findings_for(src, "COLL003") == []
+
+    def test_near_miss_balanced_symmetric_exchange(self):
+        src = """
+        import paddle_tpu.distributed as dist
+
+        def master_side(t):
+            dist.send(t, dst=1)
+            dist.recv(t, src=1)
+
+        def worker_side(t):
+            dist.recv(t, src=0)
+            dist.send(t, dst=0)
+
+        def ping_pong(t, rank):
+            if rank == 0:
+                master_side(t)
+            else:
+                worker_side(t)
+        """
+        assert findings_for(src, "COLL003") == []
+
+
+# ---------------------------------------------------------------------------
+# DDL002 — interprocedural Deadline propagation
+
+
+class TestDdl002:
+    def test_catches_unthreaded_deadline_one_hop(self):
+        src = """
+        from paddle_tpu.utils.retries import Deadline
+
+        def fetch(sock, deadline=None):
+            if deadline is not None:
+                sock.settimeout(deadline.timeout(5.0))
+            return sock.recv(1024)
+
+        def orchestrate(sock):
+            return fetch(sock)          # line 10
+        """
+        got = findings_for(src, "DDL002")
+        assert lines_of(got) == [10]
+        assert got[0].severity == "warning"
+        assert "fetch()" in got[0].message
+        assert "deadline=" in got[0].message
+
+    def test_catches_transitively_blocking_callee(self):
+        src = """
+        from paddle_tpu.utils.retries import Deadline
+
+        def drain(work_q, deadline=None):
+            return work_q.get()
+
+        def collect(work_q, deadline=None):
+            return drain(work_q, deadline=deadline)
+
+        def top(work_q):
+            return collect(work_q)      # line 11: two hops above leaf
+        """
+        got = findings_for(src, "DDL002")
+        assert lines_of(got) == [11]
+        assert "collect()" in got[0].message
+
+    def test_near_miss_deadline_threaded(self):
+        src = """
+        from paddle_tpu.utils.retries import Deadline
+
+        def fetch(sock, deadline=None):
+            return sock.recv(1024)
+
+        def orchestrate(sock, dl):
+            return fetch(sock, deadline=dl)
+        """
+        assert findings_for(src, "DDL002") == []
+
+    def test_near_miss_positional_threading(self):
+        src = """
+        from paddle_tpu.utils.retries import Deadline
+
+        def fetch(sock, deadline=None):
+            return sock.recv(1024)
+
+        def orchestrate(sock):
+            return fetch(sock, Deadline(5.0))
+        """
+        assert findings_for(src, "DDL002") == []
+
+    def test_near_miss_callee_without_deadline_param(self):
+        """No thread-through point == DDL001's business, not DDL002's."""
+        src = """
+        from paddle_tpu.utils.retries import Deadline
+
+        def fetch(sock):
+            return sock.recv(1024)
+
+        def orchestrate(sock):
+            return fetch(sock)
+        """
+        assert findings_for(src, "DDL002") == []
+
+    def test_near_miss_bounded_callee(self):
+        src = """
+        from paddle_tpu.utils.retries import Deadline
+
+        def drain(work_q, deadline=None):
+            return work_q.get(timeout=5.0)
+
+        def top(work_q):
+            return drain(work_q)
+        """
+        assert findings_for(src, "DDL002") == []
+
+    def test_only_applies_inside_the_retries_discipline(self):
+        src = """
+        def fetch(sock, deadline=None):
+            return sock.recv(1024)
+
+        def orchestrate(sock):
+            return fetch(sock)
+        """
+        assert findings_for(src, "DDL002") == []
+
+    def test_method_call_positional_deadline_accounts_for_self(self):
+        """`c.fetch(k, dl)` fills the method's `self` slot with the
+        receiver — the positional deadline IS threaded (review fix)."""
+        src = """
+        from paddle_tpu.utils.retries import Deadline
+
+        class Client:
+            def fetch(self, key, deadline=None):
+                return self.sock.recv(1024)
+
+        def poll_ok(c, opts):
+            return c.fetch("k", opts.ttl)   # positional: threaded
+
+        def poll_bad(c):
+            return c.fetch("k")             # line 12: not threaded
+        """
+        got = findings_for(src, "DDL002")
+        assert lines_of(got) == [12]
+
+    def test_blocking_does_not_propagate_through_bounded_calls(self):
+        """A wrapper that hard-bounds its blocking callee at the call
+        site can never block indefinitely — its own callers stay
+        clean (review fix)."""
+        src = """
+        from paddle_tpu.utils.retries import Deadline
+
+        def drain(work_q, deadline=None):
+            return work_q.get()
+
+        def bounded_outer(work_q, deadline=None):
+            return drain(work_q, deadline=5.0)
+
+        def top(work_q):
+            return bounded_outer(work_q)
+        """
+        assert findings_for(src, "DDL002") == []
+
+
+# ---------------------------------------------------------------------------
+# The seeded acceptance fixture (shared with the dynamic reproduction in
+# tests/test_flight_recorder.py)
+
+
+class TestSeededDeadlockFixture:
+    def test_static_flags_fixture_that_coll001_misses(self):
+        got = analyze_paths([FIXTURE], select=["COLL002"])
+        assert [f.rule for f in got] == ["COLL002"]
+        assert "train_step" in got[0].message
+        # no pre-existing rule sees it: full default rule set minus
+        # COLL002 is silent on the fixture
+        rest = analyze_paths(
+            [FIXTURE], ignore=["COLL002"])
+        assert rest == [], "\n".join(f.format() for f in rest)
+
+
+# ---------------------------------------------------------------------------
+# Summary cache: per-file mtime/size keys, invalidation
+
+
+class TestSummaryCache:
+    def test_hit_then_invalidate_on_mtime_change(self, tmp_path):
+        from paddle_tpu.analysis import interproc
+
+        p = tmp_path / "mod.py"
+        p.write_text("def collect_a(t):\n    return t\n")
+        s1 = interproc.summarize_path(str(p))
+        stats1 = interproc.cache_stats()
+        s2 = interproc.summarize_path(str(p))
+        stats2 = interproc.cache_stats()
+        assert s2 is s1, "unchanged file must be served from cache"
+        assert stats2["misses"] == stats1["misses"]
+        assert stats2["hits"] == stats1["hits"] + 1
+
+        p.write_text("def collect_b(t):\n    return t\n")
+        os.utime(p, (1, 1))  # force a distinct mtime
+        s3 = interproc.summarize_path(str(p))
+        stats3 = interproc.cache_stats()
+        assert stats3["misses"] == stats2["misses"] + 1
+        assert [f.name for f in s3.functions] == ["collect_b"]
+
+    def test_cache_hit_rebinds_to_the_requested_path_spelling(
+            self, tmp_path, monkeypatch):
+        """Findings (and suppression lookups) key by the path the
+        caller passed; a cache hit recorded under another spelling
+        must be rebound, not returned verbatim (review fix)."""
+        p = tmp_path / "m.py"
+        p.write_text(textwrap.dedent("""
+        import paddle_tpu.distributed as dist
+
+        def helper(t):
+            dist.all_reduce(t)
+
+        def step(t, rank):
+            if rank == 0:
+                helper(t)
+        """))
+        monkeypatch.chdir(tmp_path)
+        f1 = analyze_paths(["m.py"], select=["COLL002"])
+        assert [f.path for f in f1] == ["m.py"]
+        f2 = analyze_paths([str(p)], select=["COLL002"])  # cache hit
+        assert [f.path for f in f2] == [str(p)]
+
+    def test_analysis_lane_stays_fast(self):
+        """The whole-package interprocedural pass (warm summaries) must
+        stay well inside the pytest -m analysis budget."""
+        import time
+
+        from paddle_tpu.analysis import interproc
+        from paddle_tpu.analysis.core import iter_python_files
+
+        pkg = os.path.join(REPO_ROOT, "paddle_tpu")
+        files = list(iter_python_files([pkg]))
+        interproc.build_project([(None, fp) for fp in files])  # warm
+        t0 = time.monotonic()
+        interproc.build_project([(None, fp) for fp in files])
+        assert time.monotonic() - t0 < 10.0
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+
+
+class TestInterprocCli:
+    def test_interprocedural_is_the_default_and_flags_fixture(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", FIXTURE,
+             "--no-baseline"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 1
+        assert "COLL002" in proc.stdout
+
+    def test_no_interprocedural_disables_the_pass(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", FIXTURE,
+             "--no-baseline", "--no-interprocedural"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_github_format_emits_annotations(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", FIXTURE,
+             "--no-baseline", "--format", "github"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 1
+        line = next(l for l in proc.stdout.splitlines()
+                    if l.startswith("::error "))
+        assert "file=" in line and ",line=" in line and ",col=" in line
+        assert "title=graft-lint COLL002" in line
+        assert "\n" not in line.split("::", 2)[2]
+
+    def test_github_format_escapes_property_values(self, tmp_path):
+        """A ','/':' in the linted path must be %-escaped in the
+        file= property or GitHub mis-parses the annotation
+        (review fix)."""
+        odd = tmp_path / "exp:v2,final"
+        odd.mkdir()
+        bad = odd / "bad.py"
+        bad.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            print(x)
+            return x
+        """))
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", str(bad),
+             "--no-baseline", "--format", "github"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 1
+        line = next(l for l in proc.stdout.splitlines()
+                    if l.startswith("::error "))
+        props = line.split("::", 2)[1]
+        assert "%3A" in props and "%2C" in props
+        assert "exp:v2,final" not in props
+
+    def test_github_format_clean_run_exits_zero(self, tmp_path):
+        ok = tmp_path / "ok.py"
+        ok.write_text("x = 1\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", str(ok),
+             "--no-baseline", "--format", "github"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0
+        assert "::error" not in proc.stdout
+
+    def test_help_documents_exit_codes(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", "--help"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0
+        assert "exit status" in proc.stdout
+        assert "--format" in proc.stdout
+        assert "--no-interprocedural" in proc.stdout
+
+    def test_list_rules_includes_interproc_scope(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", "--list-rules"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        for rid in ("COLL002", "COLL003", "DDL002"):
+            assert rid in proc.stdout
+        assert "interproc" in proc.stdout

@@ -59,4 +59,5 @@ def test_launcher_two_process_collectives_and_dp_parity(tmp_path):
     for r in (0, 1):
         assert f"MC_WORKER_OK rank {r}" in logs[r], detail
         assert "collectives OK" in logs[r], detail
+        assert "flight recorder OK" in logs[r], detail
         assert "DP loss parity OK" in logs[r], detail
