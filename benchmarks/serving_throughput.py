@@ -16,6 +16,14 @@ full prompt) and chunked (``prefill_chunk`` tokens per step under
 token and p50/p99 inter-token latency per mode; the acceptance claim
 is chunked p99 ITL strictly better than whole-prompt.
 
+Part 4 (``--router``, ISSUE 6): a 2-replica ClusterRouter serving a
+shared-prefix mixed-priority workload twice — engine prefix cache ON
+vs OFF — with prefix-affinity placement. Reports the measured cluster
+prefix-hit-rate, TTFT p50/p99 per mode (chunked prefill inside each
+replica, so cached tokens are chunks never scheduled), and per-replica
+routed/shed/expired counters. The acceptance claim: hit-rate > 0 and
+cache-on TTFT p50 strictly better than cache-off.
+
 Part 3 (``--overload``, ISSUE 4): offered load ≈ 2x measured capacity,
 mixed interactive/batch priorities with per-class deadlines, admission
 control ON. The overload-control claim: every rejection happens at
@@ -281,6 +289,100 @@ def overload(model, config, on_tpu, dev):
     }), flush=True)
 
 
+def router(model, config, on_tpu, dev):
+    """2-replica cluster, shared-prefix traffic, prefix cache on/off."""
+    from paddle_tpu.inference.cluster import ClusterRouter, InProcessReplica
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine as CBE
+
+    budget_s = float(os.environ.get("BENCH_TOTAL_BUDGET", "600"))
+    dl = Deadline(budget_s * 0.85)  # reserve tail for the JSON emit
+    if on_tpu:
+        B, MAX_LEN, BS, CHUNK, GEN = 8, 1024, 64, 256, 32
+        n_req, plen_prefix, tail_lens = 64, 512, (64, 128)
+        n_families = 4
+    else:
+        B, MAX_LEN, BS, CHUNK, GEN = 2, 128, 8, 16, 6
+        n_req, plen_prefix, tail_lens = 24, 32, (5, 9)
+        n_families = 2
+
+    rng = np.random.RandomState(3)
+    families = [rng.randint(0, config.vocab_size, (plen_prefix,))
+                for _ in range(n_families)]
+    workload = []
+    for i in range(n_req):
+        tail = rng.randint(0, config.vocab_size,
+                           (int(tail_lens[i % len(tail_lens)]),))
+        pri = "interactive" if i % 3 == 0 else "batch"
+        workload.append(
+            (i, np.concatenate([families[i % n_families], tail]), pri))
+
+    def run_mode(prefix_cache):
+        def factory():
+            return CBE(model, max_batch=B, max_len=MAX_LEN, block_size=BS,
+                       num_blocks=B * (-(-MAX_LEN // BS)) + 8,
+                       prefill_chunk=CHUNK, prefix_cache=prefix_cache)
+
+        reps = [InProcessReplica(f"r{i}", factory) for i in range(2)]
+        # warm both replicas' compiled phases outside the timed window
+        for rep in reps:
+            rep.supervisor.submit(f"warm-{rep.replica_id}",
+                                  np.ones(1, np.int32), max_new_tokens=2)
+            while rep.supervisor.pending:
+                rep.supervisor.step()
+        rt = ClusterRouter(reps, block_size=BS)
+        t0 = time.perf_counter()
+        for rid, prompt, pri in workload:
+            rt.submit(rid, prompt, max_new_tokens=GEN, priority=pri)
+        res = rt.run(deadline=dl.sub(fraction=0.45))
+        wall = time.perf_counter() - t0
+        assert all(res[rid]["status"] == "ok"
+                   for rid, _, _ in workload), "router workload lost work"
+        reqs = [r for rep in reps
+                for rid, r in rep.supervisor.results.items()
+                if not str(rid).startswith("warm")]
+        ttfts = [r.ttft() for r in reqs if r.ttft() is not None]
+        toks = sum(len(r.out) for r in reqs)
+        per_replica = []
+        for i, rep in enumerate(reps):
+            load = rep.load()
+            per_replica.append({
+                "replica": rep.replica_id,
+                "routed": rt.n_routed[i],
+                "shed": load["n_shed_interactive"] + load["n_shed_batch"],
+                "expired": load["n_expired"],
+                "prefix_hit_tokens": load["prefix"]["hit_tokens"],
+            })
+        return {
+            "prefix_cache": prefix_cache,
+            "prefix_hit_rate": round(rt.prefix_hit_rate(), 3),
+            "ttft_ms_p50": _pct(ttfts, 50), "ttft_ms_p99": _pct(ttfts, 99),
+            "tokens_per_sec": round(toks / wall, 1),
+            "wall_s": round(wall, 2),
+            "per_replica": per_replica,
+        }
+
+    off = run_mode(False)
+    on = run_mode(True)
+    print(json.dumps({
+        "metric": "cluster_router_prefix_hit_rate",
+        "value": on["prefix_hit_rate"],
+        "unit": "cached/prompt tokens over 2 replicas",
+        "extra": {
+            "cache_on": on, "cache_off": off,
+            "ttft_p50_speedup": round(
+                off["ttft_ms_p50"] / on["ttft_ms_p50"], 2)
+            if on["ttft_ms_p50"] else None,
+            "ttft_p50_improved":
+                (on["ttft_ms_p50"] or 0) < (off["ttft_ms_p50"] or 0),
+            "requests": n_req, "replicas": 2,
+            "prefix_len": plen_prefix, "families": n_families,
+            "prefill_chunk": CHUNK, "gen_per_req": GEN,
+            "budget_s": budget_s,
+            "device": getattr(dev, "device_kind", str(dev)),
+        },
+    }), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sustained-only", action="store_true")
@@ -288,6 +390,10 @@ def main():
     ap.add_argument("--overload", action="store_true",
                     help="run only the 2x-offered-load admission-control "
                          "scenario (under BENCH_TOTAL_BUDGET)")
+    ap.add_argument("--router", action="store_true",
+                    help="run only the 2-replica cluster-router shared-"
+                         "prefix scenario, prefix cache on vs off "
+                         "(under BENCH_TOTAL_BUDGET)")
     args = ap.parse_args()
 
     import jax
@@ -309,6 +415,9 @@ def main():
 
     if args.overload:
         overload(model, config, on_tpu, dev)
+        return
+    if args.router:
+        router(model, config, on_tpu, dev)
         return
     if not args.mixed_only:
         sustained(model, config, on_tpu, dev)

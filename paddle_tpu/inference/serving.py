@@ -75,7 +75,7 @@ import numpy as np
 
 from ..base.tape import no_grad
 from ..base.tensor import Tensor
-from ..ops.paged_attention import BlockManager, PagedLayerCache
+from ..ops.paged_attention import BlockManager, PagedLayerCache, PrefixCache
 from ..testing import chaos as _chaos
 from ..utils.retries import Deadline
 from .admission import (
@@ -182,7 +182,8 @@ class ContinuousBatchingEngine:
                  decode_chunk: int = 1,
                  prefill_chunk: Optional[int] = None,
                  max_num_batched_tokens: Optional[int] = None,
-                 admission: Optional[AdmissionConfig] = None):
+                 admission: Optional[AdmissionConfig] = None,
+                 prefix_cache: bool = False):
         """``num_blocks`` fixes the HBM budget (the pool allocates one
         extra trash block); ``max_len`` bounds any sequence's positions
         (tables carry ceil(max_len/block_size) slots per row);
@@ -206,6 +207,18 @@ class ContinuousBatchingEngine:
         (>= max_batch — the decode dispatch is indivisible) and one
         chunk (>= prefill_chunk — otherwise a lone prefill could never
         be scheduled).
+
+        ``prefix_cache=True`` turns on radix-style prefix KV reuse
+        (vLLM automatic-prefix-caching / SGLang RadixAttention class):
+        a finished prompt's FULL KV blocks stay pinned in a
+        :class:`~paddle_tpu.ops.paged_attention.PrefixCache`; a later
+        prompt sharing a block-aligned prefix ADOPTS those blocks
+        (ref-counted, copy-on-write) and prefill starts at the cached
+        ``cache_len`` offset — a shared system prompt / few-shot header
+        prefills once per engine, not once per request. Cached blocks
+        are reclaimed LRU-first when admissions run out of free blocks,
+        so the cache can never deadlock admission. Greedy decode keeps
+        cache-hit outputs token-identical to cold runs.
 
         ``admission=AdmissionConfig(...)`` turns on overload control:
         submissions run through an :class:`AdmissionController` (shed
@@ -233,6 +246,10 @@ class ContinuousBatchingEngine:
                 f"max_position_embeddings ({limit})")
         self.eos_token_id = eos_token_id
         self.manager = BlockManager(num_blocks, block_size)
+        self.prefix_cache = (PrefixCache(block_size, manager=self.manager)
+                             if prefix_cache else None)
+        self.prefix_hit_tokens = 0
+        self.prefix_forks = 0
         self._trash = num_blocks  # reserved sacrificial pool row
         self.max_blocks_per_seq = -(-self.max_len // block_size)
 
@@ -275,6 +292,7 @@ class ContinuousBatchingEngine:
         self._prefill_jit = None
         self._decode_jit = None
         self._chunk_jit = None
+        self._copy_jit = None  # COW block copy (prefix-cache forks)
         self.decode_chunk = max(1, int(decode_chunk))
         self._rr = 0  # round-robin start for chunk scheduling fairness
         self.steps = 0
@@ -408,11 +426,15 @@ class ContinuousBatchingEngine:
         return need <= self._phases_run
 
     def add_request(self, req_id, prompt, max_new_tokens: int = 32,
-                    deadline=None, priority: str = "interactive"):
+                    deadline=None, priority: str = "interactive",
+                    retries: int = 0):
         """``deadline``: seconds or a ``Deadline`` — the request's total
         budget (queue wait included). None = no deadline. ``priority``
         is the admission class ("interactive" | "batch") — only
         meaningful with admission control on, but always recorded.
+        ``retries`` seeds the recovery counter (cluster router /
+        journal replay resubmissions carry prior engine deaths so
+        poison quarantine counts per REQUEST, not per replica).
         Returns the :class:`GenRequest`; with admission control a shed
         submission comes back immediately with ``status == "shed"``
         (it is also surfaced through the completed map)."""
@@ -429,7 +451,8 @@ class ContinuousBatchingEngine:
             raise ValueError("prompt + max_new_tokens exceeds max_len")
         dl = None if deadline is None else Deadline.coerce(deadline)
         req = GenRequest(req_id, prompt, max_new_tokens, deadline=dl,
-                         t_submit=time.perf_counter(), priority=priority)
+                         t_submit=time.perf_counter(), priority=priority,
+                         retries=int(retries))
         if self._blocks_needed(req) > self.manager.num_blocks:
             raise ValueError(
                 f"request needs {self._blocks_needed(req)} blocks but the "
@@ -598,6 +621,32 @@ class ContinuousBatchingEngine:
             n_expired=self.n_expired,
         )
 
+    def prefix_stats(self) -> dict:
+        """Prefix-cache counters (zeros when the cache is off): the
+        router's affinity feedback and the bench's hit-rate source.
+        ``hit_rate`` is cached tokens / prompt tokens that entered a
+        slot — the fraction of prefill work the cache saved."""
+        total = self.prefill_tokens + self.prefix_hit_tokens
+        base = {
+            "enabled": self.prefix_cache is not None,
+            "hit_tokens": self.prefix_hit_tokens,
+            "prefill_tokens": self.prefill_tokens,
+            "forks": self.prefix_forks,
+            "hit_rate": (self.prefix_hit_tokens / total) if total else 0.0,
+        }
+        if self.prefix_cache is not None:
+            tree = self.prefix_cache.stats()
+            # NB: only tree-shape keys — the cache's own hits/hit_tokens
+            # are LOOKUP-side tallies (a head-of-line-blocked request
+            # re-probes every step) and must not clobber the engine's
+            # adopted-token truth above
+            base.update({
+                "nodes": tree["nodes"],
+                "lookups": tree["lookups"],
+                "evicted_blocks": tree["evicted_blocks"],
+            })
+        return base
+
     def _append_token(self, req: GenRequest, tok: int):
         req.out.append(tok)
         req.times.append(time.perf_counter())
@@ -651,6 +700,59 @@ class ContinuousBatchingEngine:
             total = max(int(req.prompt.size) + new, self.prompt_pad)
         return self.manager.blocks_for(total)
 
+    def _copy_block(self, src: int, dst: int) -> None:
+        """Copy one physical block's KV across every layer pool — the
+        device-side half of a copy-on-write fork (rare: only when a
+        prefill write starts INSIDE an adopted shared block, i.e. a
+        fully-cached prompt recomputing its last token). One compiled
+        program with DONATED pools (src/dst are traced scalars, so
+        every fork shares it): XLA updates the block in place instead
+        of materializing a fresh full-size pool per layer."""
+        if self._copy_jit is None:
+            def copy_block(pools, s, d):
+                return [(k.at[:, d].set(k[:, s]),
+                         v.at[:, d].set(v[:, s])) for k, v in pools]
+
+            self._copy_jit = jax.jit(copy_block, donate_argnums=(0,))
+        self._pools = self._copy_jit(
+            self._pools, jnp.asarray(src, jnp.int32),
+            jnp.asarray(dst, jnp.int32))
+
+    def _reserve_blocks(self, req, eff_new: int):
+        """Block-availability half of slot binding, prefix-cache aware.
+        Looks up the prompt's cached prefix, ADOPTS those blocks
+        (ref-counted — they can no longer be evicted out from under
+        this request), and checks the remaining shortfall against the
+        free list, reclaiming LRU cache entries when it runs short.
+        Returns ``(ok, cached_len, will_fork)``; on ``ok=False`` the
+        adoption is undone and nothing else was mutated (head-of-line
+        wait, exactly like the old ``can_allocate`` gate).
+
+        ``cached_len`` is capped at ``prompt.size - 1``: the first
+        generated token comes from the last prompt position's logits,
+        which only a real prefill dispatch produces — so a FULLY cached
+        prompt recomputes one token, and because that write position
+        lands INSIDE the last shared block, ``will_fork`` asks the
+        caller to copy-on-write it first."""
+        psize = int(req.prompt.size)
+        cached_len, cached_blocks = 0, []
+        if self.prefix_cache is not None:
+            cached_len, cached_blocks = self.prefix_cache.lookup(req.prompt)
+            if cached_len >= psize:
+                cached_len = psize - 1
+        will_fork = bool(cached_len % self.block_size)
+        need = (self._blocks_needed(req, eff_new) - len(cached_blocks)
+                + (1 if will_fork else 0))
+        if cached_blocks:
+            self.manager.adopt(req.req_id, cached_blocks)
+        if need > self.manager.free_blocks and self.prefix_cache is not None:
+            self.prefix_cache.evict(need - self.manager.free_blocks)
+        if need > self.manager.free_blocks:
+            if cached_blocks:
+                self.manager.free_sequence(req.req_id)
+            return False, 0, False
+        return True, cached_len, will_fork
+
     def _admit(self) -> int:
         """Fill free slots from the queue while blocks last. Whole-
         prompt mode runs one padded prefill per admission (per-slot
@@ -668,8 +770,19 @@ class ContinuousBatchingEngine:
         cfg = self.admission.config if self.admission is not None else None
         if cfg is not None:
             if self._kv_occupancy() >= cfg.kv_pause_watermark:
-                self.prefill_paused = True
-                return 0
+                if self.prefix_cache is not None:
+                    # reclaimable cached prefixes must not trip the
+                    # degraded pause into a permanent stall: free
+                    # enough idle cache to get back under the watermark
+                    # before concluding the pool is genuinely scarce
+                    want = int(np.ceil(
+                        (1.0 - cfg.kv_pause_watermark)
+                        * self.manager.num_blocks)) + 1
+                    self.prefix_cache.evict(
+                        max(want - self.manager.free_blocks, 0))
+                if self._kv_occupancy() >= cfg.kv_pause_watermark:
+                    self.prefill_paused = True
+                    return 0
             self.prefill_paused = False
         used = 0
         for slot_idx, slot in enumerate(self._slots):
@@ -698,15 +811,25 @@ class ContinuousBatchingEngine:
                      and req.max_new_tokens > cfg.batch_clamp_tokens
                      and self._kv_occupancy() >= cfg.kv_clamp_watermark)
             eff_new = cfg.batch_clamp_tokens if clamp else req.max_new_tokens
-            if not self.manager.can_allocate(
-                    req.req_id,
-                    self._blocks_needed(req, eff_new) * self.block_size):
+            ok, cached_len, will_fork = self._reserve_blocks(req, eff_new)
+            if not ok:
                 break  # head-of-line; keep FIFO fairness
             if clamp:
                 req.max_new_tokens = int(cfg.batch_clamp_tokens)
                 req.clamped = True
-            blocks = self.manager.allocate(
+            self.manager.allocate(
                 req.req_id, self._blocks_needed(req) * self.block_size)
+            if will_fork:
+                # the first prefill write (position cached_len) lands
+                # inside the last ADOPTED block: copy-on-write it so
+                # the cache (and any other reader) keeps its bytes
+                old, new = self.manager.fork(
+                    req.req_id, cached_len // self.block_size)
+                if new != old:
+                    self._copy_block(old, new)
+                    self.prefix_forks += 1
+            self.prefix_hit_tokens += cached_len
+            blocks = self.manager.owned_blocks(req.req_id)
             row = np.full((self.max_blocks_per_seq,), self._trash, np.int32)
             row[: len(blocks)] = blocks
             self._tables[slot_idx] = row
@@ -715,27 +838,38 @@ class ContinuousBatchingEngine:
             self._queue.pop(0)  # bound above: leaves the queue LAST
 
             if self.chunked:
-                slot.prefill_pos = 0
-                slot.cache_len = 0
+                slot.prefill_pos = cached_len
+                slot.cache_len = cached_len
                 continue
 
-            slot.prefill_pos = int(req.prompt.size)
-            slot.cache_len = int(req.prompt.size)
+            psize = int(req.prompt.size)
+            rem = psize - cached_len  # >= 1 by the cached_len cap
+            slot.prefill_pos = psize
+            slot.cache_len = psize
             # isolated prefill: only this row's table points at real
-            # blocks; every other row scatters into the trash block
+            # blocks; every other row scatters into the trash block.
+            # A cache hit starts the write at the cached offset and
+            # feeds only the un-cached remainder of the prompt.
             iso = np.full_like(self._tables, self._trash)
             iso[slot_idx] = row
             ids = np.zeros((self.B, self.prompt_pad), np.int32)
-            ids[slot_idx, : req.prompt.size] = req.prompt
+            ids[slot_idx, :rem] = req.prompt[cached_len:]
+            cl = np.zeros((self.B,), np.int32)
+            cl[slot_idx] = cached_len
             if self._prefill_jit is None:
                 self._build_jits()
             toks, self._pools = self._run_jit(
                 self._prefill_jit, self._pools, jnp.asarray(ids),
-                jnp.asarray(iso), jnp.zeros((self.B,), jnp.int32))
+                jnp.asarray(iso), jnp.asarray(cl))
             self._phases_run.add("prefill")
-            first = int(np.asarray(toks)[slot_idx, req.prompt.size - 1])
-            used += int(req.prompt.size)
-            self.prefill_tokens += int(req.prompt.size)
+            first = int(np.asarray(toks)[slot_idx, rem - 1])
+            used += rem
+            self.prefill_tokens += rem
+            if self.prefix_cache is not None:
+                # the prompt's full blocks now hold its exact KV: pin
+                # them for reuse BEFORE a possible same-step finish
+                # frees the sequence's own references
+                self.prefix_cache.insert(req.prompt, blocks)
             self._append_token(req, first)
             slot.remaining -= 1
             if self._finish_if_done(slot_idx, first):
@@ -825,6 +959,12 @@ class ContinuousBatchingEngine:
                 used += real
                 if slot.prefill_pos == slot.req.prompt.size:
                     first = int(toks[i, real - 1])
+                    if self.prefix_cache is not None:
+                        # pin the finished prompt's full blocks before
+                        # a same-chunk finish frees the sequence
+                        self.prefix_cache.insert(
+                            slot.req.prompt,
+                            self.manager.owned_blocks(slot.req.req_id))
                     self._append_token(slot.req, first)
                     slot.remaining -= 1
                     self._finish_if_done(i, first)

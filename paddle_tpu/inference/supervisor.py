@@ -52,7 +52,7 @@ from ..testing import chaos as _chaos
 from ..utils.retries import Deadline
 from .serving import GenRequest
 
-__all__ = ["ServingSupervisor", "SupervisorGaveUp"]
+__all__ = ["ServingSupervisor", "SupervisorGaveUp", "Journal"]
 
 
 class SupervisorGaveUp(RuntimeError):
@@ -184,6 +184,10 @@ class _Journal:
             "max_new_tokens": int(req.max_new_tokens),
             "priority": req.priority,
             "deadline_unix": expires,
+            # prior recoveries travel WITH the request: a cluster
+            # router replaying this journal onto a surviving replica
+            # must count engine deaths per request, not per replica
+            "retries": int(req.retries),
         })
 
     def complete(self, req: GenRequest):
@@ -288,7 +292,8 @@ class ServingSupervisor:
                 rid, np.asarray(rec["prompt"], np.int32),
                 int(rec["max_new_tokens"]),
                 deadline=None if remaining is None else Deadline(remaining),
-                priority=rec.get("priority", "interactive"))
+                priority=rec.get("priority", "interactive"),
+                retries=int(rec.get("retries", 0)))
             if remaining is not None and remaining <= 0:
                 # the budget ran out during the outage: close it as
                 # expired at zero token cost instead of serving a
@@ -308,10 +313,13 @@ class ServingSupervisor:
 
     # -- submission -----------------------------------------------------
     def submit(self, req_id, prompt, max_new_tokens: int = 32, *,
-               deadline=None, priority: str = "interactive") -> GenRequest:
+               deadline=None, priority: str = "interactive",
+               retries: int = 0) -> GenRequest:
         """Front door: runs the engine's admission control. Shed
         submissions are recorded as results immediately; accepted ones
         are journaled (when journaling) so a crash cannot lose them.
+        ``retries`` seeds the recovery counter for work resubmitted by
+        a cluster router after another replica's death.
 
         The returned handle reflects the SUBMISSION (status at the
         front door, shed_reason). Do not poll it for completion across
@@ -320,7 +328,7 @@ class ServingSupervisor:
         return value, keyed by ``req_id``."""
         req = self.engine.add_request(
             req_id, prompt, max_new_tokens, deadline=deadline,
-            priority=priority)
+            priority=priority, retries=retries)
         self.journaled_ids.add(req_id)
         if req.status != "shed" and self.journal is not None:
             self.journal.submit(req)
@@ -531,3 +539,9 @@ class ServingSupervisor:
             "total_expired": self._prior_expired + eng.n_expired,
             "load": eng.load().as_dict(),
         }
+
+
+# Public alias: the cluster router (inference/cluster.py) replays a dead
+# replica's journal through the same reader/compactor the in-process
+# resume path uses — one journal format, two recovery scopes.
+Journal = _Journal

@@ -92,6 +92,17 @@ class StaticFunction:
         # globals it references even exist
         self._orig_fn = fn
         self._needs_discovery = not self._layers and not self._optimizers
+        # the explicitly-passed state survives any guard-triggered
+        # rediscovery verbatim (only DISCOVERED bindings are guarded)
+        self._explicit_state = (list(self._layers), list(self._optimizers),
+                                list(self._scalers))
+        # captured-state guard (ROADMAP 5a / reference SOT guard.py):
+        # populated by _auto_discover with (kind, key, id) entries for
+        # every DISCOVERED global/closure binding; revalidated cheaply
+        # per call so rebinding a captured Layer/Optimizer triggers
+        # rediscovery+retrace (or raises) instead of silently threading
+        # the stale capture's state
+        self._capture_guard: List[Tuple[str, Any, int]] = []
         # dy2static: rewrite tensor-dependent if/while into runtime
         # dispatch (lax select/while under trace, plain Python eagerly)
         from . import dy2static as _d2s
@@ -137,10 +148,21 @@ class StaticFunction:
         from ..optimizer.optimizer import Optimizer
 
         candidates: List[Any] = []
+        sources: List[Tuple[str, Any]] = []  # parallel (kind, key) per
+        # candidate — "closure" keys are cell indexes, "global" keys are
+        # names; "self" is bound-method state (not rebindable, no guard)
         if fn_closure := getattr(fn, "__closure__", None):
-            candidates += [c.cell_contents for c in fn_closure if c.cell_contents is not None]
+            for i, c in enumerate(fn_closure):
+                try:
+                    contents = c.cell_contents
+                except ValueError:  # still-empty cell
+                    continue
+                if contents is not None:
+                    candidates.append(contents)
+                    sources.append(("closure", i))
         if hasattr(fn, "__self__"):
             candidates.append(fn.__self__)
+            sources.append(("self", None))
         # module-level step functions reference their model/optimizer as
         # GLOBALS, not closure cells; scan exactly the names loaded via
         # LOAD_GLOBAL (co_names alone also contains attribute names),
@@ -163,6 +185,7 @@ class StaticFunction:
                 obj = fn_globals.get(gname)
                 if obj is not None:
                     candidates.append(obj)
+                    sources.append(("global", gname))
 
         def innermost(o):
             # unwrap _inner_opt chains (HybridParallelOptimizer around
@@ -178,16 +201,83 @@ class StaticFunction:
             return o
 
         known_inner = {id(innermost(o)) for o in self._optimizers}
-        for obj in candidates:
-            if isinstance(obj, Layer) and obj not in self._layers:
-                self._layers.append(obj)
-            elif isinstance(obj, AmpScaler) and obj not in self._scalers:
-                self._scalers.append(obj)
+        self._capture_guard = []
+        for obj, (kind, key) in zip(candidates, sources):
+            stateful = False
+            if isinstance(obj, Layer):
+                stateful = True
+                if obj not in self._layers:
+                    self._layers.append(obj)
+            elif isinstance(obj, AmpScaler):
+                stateful = True
+                if obj not in self._scalers:
+                    self._scalers.append(obj)
             else:
                 inner = innermost(obj)
-                if inner is not None and id(inner) not in known_inner:
-                    known_inner.add(id(inner))
-                    self._optimizers.append(obj)
+                if inner is not None:
+                    stateful = True
+                    if id(inner) not in known_inner:
+                        known_inner.add(id(inner))
+                        self._optimizers.append(obj)
+            # guard every rebindable binding that contributed state —
+            # including dedup'd duplicates: rebinding ANY of them means
+            # the traced capture no longer reflects the source
+            if stateful and kind in ("closure", "global"):
+                self._capture_guard.append((kind, key, id(obj)))
+
+    # -- captured-state guard (ROADMAP 5a) -------------------------------
+    def _captures_valid(self) -> bool:
+        """O(#captures) identity check per call — the cheap half of the
+        reference's per-trace guard chain (SOT ``guard.py``): True iff
+        every discovered global/closure binding still holds the exact
+        object captured at discovery time."""
+        fn = self._orig_fn
+        for kind, key, oid in self._capture_guard:
+            if kind == "closure":
+                try:
+                    cur = fn.__closure__[key].cell_contents
+                except (ValueError, IndexError, TypeError):
+                    return False
+            else:
+                cur = fn.__globals__.get(key)
+            if id(cur) != oid:
+                return False
+        return True
+
+    def _revalidate_captures(self) -> bool:
+        """Retrace-or-raise on a stale capture: a rebound Layer/config
+        triggers full rediscovery (new cells, cleared jit cache — the
+        next call retraces against the CURRENT objects); a binding that
+        no longer holds any stateful object raises, because executing
+        the old compiled state thread would silently train the corpse
+        of the rebound model. Returns True when a rebind was detected
+        and state was rebuilt."""
+        if not self._capture_guard or self._captures_valid():
+            return False
+        had_cells = bool(self._cells)
+        explicit_l, explicit_o, explicit_s = self._explicit_state
+        self._layers = list(explicit_l)
+        self._optimizers = list(explicit_o)
+        self._scalers = list(explicit_s)
+        self._cells = []
+        self._auto_discover(self._orig_fn)
+        self._collect_cells()
+        self._jit_cache.clear()
+        self._last_lowered = None
+        if had_cells and not self._cells:
+            # leave the function RECOVERABLE: the next call after the
+            # user rebinds a valid object must rediscover from scratch
+            # (an empty guard would otherwise skip revalidation and
+            # bake the late rebind's parameters in as constants)
+            self._needs_discovery = True
+            raise RuntimeError(
+                "to_static captured-state guard: a Layer/Optimizer this "
+                "compiled function captured was rebound and no stateful "
+                "replacement was found at the same binding — the traced "
+                "program would silently run with stale parameters. "
+                "Rebind a compatible object or rebuild the "
+                "StaticFunction.")
+        return True
 
     def _collect_cells(self):
         cells, seen = [], set()
@@ -345,6 +435,8 @@ class StaticFunction:
         if self._needs_discovery:
             self._auto_discover(self._orig_fn)
             self._needs_discovery = False
+        else:
+            self._revalidate_captures()
         if not self._cells:
             self._collect_cells()
 
@@ -609,6 +701,17 @@ class StaticFunction:
             raise RuntimeError(
                 "multi_step requires one regular call first (to create "
                 "optimizer state and cache the carry structure)"
+            )
+        if self._revalidate_captures():
+            # a rebound capture breaks multi_step's contract (the scan
+            # carry needs lazily-created state — e.g. a fresh
+            # optimizer's accumulators — to exist BEFORE tracing); the
+            # rediscovery above already rebuilt cells and cleared the
+            # jit cache, the caller just has to warm up again
+            raise RuntimeError(
+                "multi_step: a captured Layer/Optimizer was rebound "
+                "since the warm-up call; call the function once again "
+                "before scanning"
             )
         if steps is not None:
             stacked_args = tree_util.tree_map(

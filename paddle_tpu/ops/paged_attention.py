@@ -34,14 +34,14 @@ B * max_len, and freed sequences return blocks to the pool.
 """
 from __future__ import annotations
 
-from typing import List, NamedTuple, Optional
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
-    "PagedLayerCache", "BlockManager", "contiguous_tables",
+    "PagedLayerCache", "BlockManager", "PrefixCache", "contiguous_tables",
     "alloc_paged_kv_caches", "paged_update_kv_cache", "paged_gather_kv",
 ]
 
@@ -73,17 +73,34 @@ class BlockManager:
     """Host-side free-list allocator for serving (ref: the block table
     management inside the reference's AppendAttention/BlockMHA serving
     path — here a small Python object, since the single-controller
-    runtime owns the whole batch)."""
+    runtime owns the whole batch).
+
+    Blocks are REF-COUNTED so a physical block can back several logical
+    owners at once (vLLM/SGLang-style prefix sharing): a sequence that
+    ``adopt``\\s a cached prefix block and the :class:`PrefixCache` that
+    pinned it each hold one reference; the block returns to the free
+    list only when the LAST reference drops. A shared block is
+    read-only by contract — an owner that must write into one calls
+    :meth:`fork` first (copy-on-write: the owner gets a private block,
+    the other readers keep the original untouched). Every physical
+    block counts ONCE in occupancy no matter how many owners share it:
+    ``free_blocks`` is physical, and ``can_allocate`` counts a
+    sequence's adopted (shared) blocks as already owned."""
 
     def __init__(self, num_blocks: int, block_size: int):
         self.block_size = block_size
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, -1, -1))
         self._owned: dict = {}
+        self._refs: Dict[int, int] = {}  # physical block -> live refs
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
+
+    def refcount(self, block: int) -> int:
+        """Live references on a physical block (0 = on the free list)."""
+        return self._refs.get(int(block), 0)
 
     def blocks_for(self, num_tokens: int) -> int:
         """Blocks needed to hold ``num_tokens`` positions (ceil)."""
@@ -92,14 +109,16 @@ class BlockManager:
     def can_allocate(self, seq_id, num_tokens: int) -> bool:
         """Admission probe: would ``allocate(seq_id, num_tokens)``
         succeed right now? (Counts blocks the sequence already owns —
-        the serving engine's block-availability admission test, checked
-        WITHOUT mutating the free list.)"""
+        adopted shared blocks included, each exactly once — the serving
+        engine's block-availability admission test, checked WITHOUT
+        mutating the free list.)"""
         owned = len(self._owned.get(seq_id, []))
         return self.blocks_for(num_tokens) - owned <= len(self._free)
 
     def allocate(self, seq_id, num_tokens: int) -> List[int]:
         """Ensure seq_id owns enough blocks for num_tokens; returns the
-        full block list."""
+        full block list (adopted shared blocks first, in logical
+        order — only the shortfall beyond them is newly allocated)."""
         owned = self._owned.setdefault(seq_id, [])
         need = -(-num_tokens // self.block_size) - len(owned)
         if need > len(self._free):
@@ -108,18 +127,261 @@ class BlockManager:
                 f"{len(self._free)} free (of {self.num_blocks})"
             )
         for _ in range(max(need, 0)):
-            owned.append(self._free.pop())
+            b = self._free.pop()
+            self._refs[b] = 1
+            owned.append(b)
         return list(owned)
+
+    def adopt(self, seq_id, blocks: List[int]) -> None:
+        """Append SHARED blocks to ``seq_id``'s logical block list (the
+        prefix-cache hit path): each gains one reference; nothing is
+        taken from the free list. Must run before :meth:`allocate` so
+        the adopted prefix keeps logical positions 0..len(blocks)-1."""
+        owned = self._owned.setdefault(seq_id, [])
+        for b in blocks:
+            b = int(b)
+            if self._refs.get(b, 0) <= 0:
+                raise RuntimeError(
+                    f"adopt of dead block {b}: it has no live reference "
+                    "(was it evicted between lookup and adopt?)")
+            self._refs[b] += 1
+            owned.append(b)
+
+    def fork(self, seq_id, logical_index: int) -> Tuple[int, int]:
+        """Copy-on-write: make ``seq_id``'s ``logical_index``-th block
+        PRIVATE before a write. Returns ``(old, new)`` physical ids —
+        equal when the block was already private (sole reference).
+        Otherwise one free block is consumed, the sequence's reference
+        moves onto it, and the caller must copy the pool contents
+        ``old -> new`` before writing (readers of ``old`` — the cache,
+        other sequences — keep their bytes untouched)."""
+        owned = self._owned[seq_id]
+        old = owned[logical_index]
+        if self._refs.get(old, 0) <= 1:
+            return old, old
+        if not self._free:
+            raise RuntimeError(
+                "paged KV cache exhausted: no free block for a "
+                "copy-on-write fork")
+        new = self._free.pop()
+        self._refs[new] = 1
+        self._refs[old] -= 1
+        owned[logical_index] = new
+        return old, new
+
+    def ref(self, block: int) -> None:
+        """Take an extra reference on a live block (the PrefixCache's
+        pin). Never resurrects a freed block."""
+        b = int(block)
+        if self._refs.get(b, 0) <= 0:
+            raise RuntimeError(f"ref of dead block {b}")
+        self._refs[b] += 1
+
+    def release(self, block: int) -> bool:
+        """Drop one reference; returns True when the block actually hit
+        the free list (last reference gone). A live-referenced block is
+        NEVER recycled."""
+        b = int(block)
+        refs = self._refs.get(b, 0)
+        if refs <= 0:
+            raise RuntimeError(f"release of dead block {b}")
+        if refs == 1:
+            del self._refs[b]
+            self._free.append(b)
+            return True
+        self._refs[b] = refs - 1
+        return False
 
     def free_sequence(self, seq_id) -> None:
         for b in self._owned.pop(seq_id, []):
-            self._free.append(b)
+            self.release(b)
+
+    def owned_blocks(self, seq_id) -> List[int]:
+        """The sequence's current logical block list (post-fork ids)."""
+        return list(self._owned.get(seq_id, []))
 
     def table_row(self, seq_id, max_blocks_per_seq: int) -> np.ndarray:
         row = np.zeros((max_blocks_per_seq,), np.int32)
         owned = self._owned.get(seq_id, [])
         row[: len(owned)] = owned
         return row
+
+
+class _PrefixNode:
+    __slots__ = ("children", "block", "stamp", "parent", "key")
+
+    def __init__(self, parent=None, key=None, block: Optional[int] = None):
+        self.children: Dict[tuple, "_PrefixNode"] = {}
+        self.block = block  # physical block id (None in matcher mode)
+        self.stamp = 0  # LRU clock value of the last touch
+        self.parent = parent
+        self.key = key
+
+
+class PrefixCache:
+    """Radix-style prefix index over prompt tokens at BLOCK granularity
+    (SGLang's RadixAttention idea collapsed onto the paged layout: the
+    natural reuse unit is one KV block, so the tree's edge label is one
+    block's worth of token ids).
+
+    Two modes:
+
+    - **manager mode** (``manager=`` a :class:`BlockManager`): each node
+      pins one physical block holding that chunk's KV — the cache takes
+      its own reference via ``manager.ref`` so finished sequences'
+      prefix blocks survive ``free_sequence`` and later identical
+      prefixes adopt them instead of re-prefilling. ``evict`` walks
+      leaves in LRU order releasing pins when the pool runs dry.
+    - **matcher mode** (``manager=None``): no blocks, just the trie —
+      the cluster router uses this to estimate how much of a prompt's
+      prefix a replica already holds, bounded by ``max_nodes``.
+
+    Only FULL blocks enter the tree (a partial tail block keeps
+    receiving decode writes, so sharing it would alias live state).
+    """
+
+    def __init__(self, block_size: int, manager: Optional[BlockManager]
+                 = None, max_nodes: Optional[int] = None):
+        self.block_size = int(block_size)
+        self.manager = manager
+        self.max_nodes = max_nodes
+        self.root = _PrefixNode()
+        self._clock = 0
+        self._nodes = 0
+        # incremental leaf registry (id(node) -> node): eviction picks
+        # LRU leaves constantly on the router's hot path, so a full
+        #-tree DFS per dropped node would be O(nodes) each time
+        self._leaf_reg: Dict[int, _PrefixNode] = {}
+        self.hits = 0
+        self.lookups = 0
+        self.hit_tokens = 0
+        self.evicted_blocks = 0
+
+    def _chunks(self, tokens) -> List[tuple]:
+        toks = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        bs = self.block_size
+        n_full = len(toks) // bs
+        return [tuple(toks[i * bs:(i + 1) * bs]) for i in range(n_full)]
+
+    def _touch(self, node: _PrefixNode) -> None:
+        self._clock += 1
+        node.stamp = self._clock
+
+    def lookup(self, tokens) -> Tuple[int, List[int]]:
+        """Longest cached prefix of ``tokens``: returns
+        ``(n_tokens, blocks)`` where ``n_tokens`` is a multiple of
+        ``block_size`` and ``blocks`` the pinned physical blocks in
+        logical order (empty in matcher mode). Touches the matched path
+        for LRU."""
+        self.lookups += 1
+        node, blocks, n = self.root, [], 0
+        for key in self._chunks(tokens):
+            child = node.children.get(key)
+            if child is None:
+                break
+            self._touch(child)
+            if child.block is not None:
+                blocks.append(child.block)
+            n += self.block_size
+            node = child
+        if n:
+            self.hits += 1
+            self.hit_tokens += n
+        return n, blocks
+
+    def insert(self, tokens, blocks: Optional[List[int]] = None) -> int:
+        """Register ``tokens``' full blocks. Idempotent: existing nodes
+        are kept (their pinned block stays authoritative); each NEW node
+        pins its block (manager mode). Returns the number of new nodes.
+        ``blocks`` must cover every full chunk in manager mode."""
+        chunks = self._chunks(tokens)
+        if self.manager is not None:
+            if blocks is None or len(blocks) < len(chunks):
+                raise ValueError(
+                    f"insert needs one block per full chunk: "
+                    f"{len(chunks)} chunks, "
+                    f"{0 if blocks is None else len(blocks)} blocks")
+        node, created = self.root, 0
+        for i, key in enumerate(chunks):
+            child = node.children.get(key)
+            if child is None:
+                block = None
+                if self.manager is not None:
+                    block = int(blocks[i])
+                    self.manager.ref(block)
+                child = _PrefixNode(parent=node, key=key, block=block)
+                node.children[key] = child
+                self._nodes += 1
+                created += 1
+                self._leaf_reg.pop(id(node), None)  # node grew a child
+                self._leaf_reg[id(child)] = child
+            self._touch(child)
+            node = child
+        if self.max_nodes is not None:
+            self._evict_nodes(self._nodes - self.max_nodes)
+        return created
+
+    # -- eviction --------------------------------------------------------
+    def _leaves(self) -> List[_PrefixNode]:
+        return list(self._leaf_reg.values())
+
+    def _drop_leaf(self, leaf: _PrefixNode) -> bool:
+        """Remove one leaf; returns True when its block actually became
+        free (last reference was the cache's pin)."""
+        freed = False
+        if leaf.block is not None and self.manager is not None:
+            freed = self.manager.release(leaf.block)
+            if freed:
+                self.evicted_blocks += 1
+        del leaf.parent.children[leaf.key]
+        self._nodes -= 1
+        self._leaf_reg.pop(id(leaf), None)
+        parent = leaf.parent
+        if parent is not self.root and not parent.children:
+            self._leaf_reg[id(parent)] = parent
+        return freed
+
+    def _evict_nodes(self, n: int) -> None:
+        while n > 0 and self._nodes > 0:
+            leaf = min(self._leaves(), key=lambda x: x.stamp)
+            self._drop_leaf(leaf)
+            n -= 1
+
+    def evict(self, need_blocks: int) -> int:
+        """Release LRU leaves until ``need_blocks`` physical blocks hit
+        the free list, dropping ONLY leaves whose pin is the last
+        reference (those free a block NOW). Leaves shared with a live
+        sequence are left cached — unpinning them frees nothing today
+        and would wipe the hot working set on one transient
+        unsatisfiable admission. Returns blocks actually freed (may be
+        short of ``need_blocks`` when nothing more is freeable)."""
+        freed = 0
+        while freed < need_blocks and self._nodes > 0:
+            sole = [lf for lf in self._leaves()
+                    if lf.block is not None
+                    and self.manager.refcount(lf.block) == 1]
+            if not sole:
+                break
+            if self._drop_leaf(min(sole, key=lambda x: x.stamp)):
+                freed += 1
+        return freed
+
+    def clear(self) -> None:
+        while self._nodes > 0:
+            self._drop_leaf(min(self._leaves(), key=lambda x: x.stamp))
+
+    @property
+    def nodes(self) -> int:
+        return self._nodes
+
+    def stats(self) -> dict:
+        return {
+            "nodes": self._nodes,
+            "lookups": self.lookups,
+            "hits": self.hits,
+            "hit_tokens": self.hit_tokens,
+            "evicted_blocks": self.evicted_blocks,
+        }
 
 
 def alloc_paged_kv_caches(
@@ -186,7 +448,16 @@ def paged_write_kv(kk, vv, k_pool, v_pool, tables, cl, s: int):
     positions = _per_seq_positions(cl, b, s)  # [B, s]
     logical = positions // bs  # [B, s]
     off = positions % bs  # [B, s]
-    phys = jnp.take_along_axis(tables, logical, axis=1)  # [B, s]
+    # Padded lanes can run PAST the table row (a fixed-width prefill
+    # starting at a nonzero offset — the prefix-cache hit path — or a
+    # chunk tail near max_len). take_along_axis would CLAMP them onto
+    # the row's last entry, aliasing the garbage onto a real block's
+    # early offsets; route them to an out-of-range pool row instead so
+    # the scatter DROPS them (jax .at[].set drops OOB updates).
+    nbt = tables.shape[1]
+    phys = jnp.take_along_axis(
+        tables, jnp.minimum(logical, nbt - 1), axis=1)  # [B, s]
+    phys = jnp.where(logical < nbt, phys, k_pool.shape[1])
     # consecutive advanced indices (dims 1,2) keep their position, so
     # the value layout is [kvh, B, s, D]
     k_pool = k_pool.at[:, phys, off].set(

@@ -21,6 +21,10 @@ Instrumented sites (grep for ``chaos.inject``):
 - ``serving.submit``     — each ``add_request`` front-door entry
   (drop = the submission is shed at admission)
 - ``serving.loop``       — each supervisor tick (inference/supervisor)
+- ``cluster.route``      — each router placement decision
+  (inference/cluster.py); a ``drop`` here deterministically MISROUTES
+  the request to the next live replica — the correctness-under-
+  misroute envelope the router tests pin down
 - ``bench.attempt``      — the bench child, before any JAX import
 - ``bench.probe``        — the bench preflight device-enumeration
   child, before any JAX import (indexed by probe attempt)

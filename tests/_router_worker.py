@@ -1,0 +1,52 @@
+"""Cluster replica worker (driven by tests/test_cluster.py).
+
+One real replica process: connects to the test's TCPKVStore, builds a
+deterministic tiny model (paddle.seed(0) + LlamaConfig.tiny — identical
+weights in every process, so greedy outputs are token-exact across the
+fleet), and runs a :class:`ReplicaServer` over a journaled
+:class:`ServingSupervisor`. The kill-one-replica test launches two of
+these, schedules a ``kill`` fault at ``serving.step`` in ONE of them
+(PADDLE_CHAOS env transport), and asserts the router's journal-replay
+recovery finishes every accepted request token-exactly on the survivor.
+
+env:
+  ROUTER_STORE_PORT   — the test's TCPStoreServer port
+  ROUTER_REPLICA_ID   — this replica's id (store namespace)
+  ROUTER_JOURNAL_DIR  — journal directory (read by the router on death)
+  ROUTER_BUDGET       — serve-loop wall budget in seconds (default 120)
+  PADDLE_CHAOS        — optional fault schedule (the victim only)
+"""
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distributed.store import TCPKVStore  # noqa: E402
+from paddle_tpu.inference.cluster import ReplicaServer  # noqa: E402
+from paddle_tpu.inference.serving import ContinuousBatchingEngine  # noqa: E402
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM  # noqa: E402
+
+
+def main():
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+
+    def factory():
+        # prompt_pad holds the test's shared-prefix prompts (16-token
+        # prefix + short tails) so a real process boundary exercises
+        # the prefix cache, not just the journal recovery
+        return ContinuousBatchingEngine(
+            model, max_batch=2, max_len=32, block_size=8, num_blocks=14,
+            prompt_pad=24, prefix_cache=True)
+
+    store = TCPKVStore("127.0.0.1", int(os.environ["ROUTER_STORE_PORT"]))
+    server = ReplicaServer(
+        store, os.environ["ROUTER_REPLICA_ID"], factory,
+        journal_dir=os.environ["ROUTER_JOURNAL_DIR"])
+    server.serve(deadline=float(os.environ.get("ROUTER_BUDGET", "120")))
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,10 @@ def pytest_configure(config):
         "markers",
         "robustness: overload-control / chaos / self-healing serving "
         "suite (standalone via `pytest -m robustness`)")
+    config.addinivalue_line(
+        "markers",
+        "cluster: replica-router / prefix-cache / multi-process serving "
+        "suite (standalone via `pytest -m cluster`)")
 
 
 def pytest_collection_modifyitems(config, items):

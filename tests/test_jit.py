@@ -112,6 +112,115 @@ class TestToStatic:
         np.testing.assert_allclose(out, eager, rtol=1e-5, atol=1e-6)
 
 
+class TestCapturedStateGuard:
+    """ROADMAP 5a: StaticFunction records the identity of every
+    discovered global/closure capture at first call and revalidates per
+    call — rebinding a captured Layer retraces against the NEW object
+    (reference-guard semantics, SOT guard.py) instead of silently
+    threading the stale capture's parameters."""
+
+    def test_closure_rebind_retraces_to_new_layer(self):
+        net = _make_net(0)
+        x, _ = _make_data()
+        xt = paddle.to_tensor(x)
+
+        def fwd(t):
+            return net(t)
+
+        compiled = paddle.jit.to_static(fwd)  # auto-discovery path
+        out1 = compiled(xt).numpy()
+        np.testing.assert_allclose(out1, net(xt).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        old_net = net
+        net = _make_net(99)  # REBIND the captured closure cell
+        out2 = compiled(xt).numpy()
+        # the compiled function must now serve the NEW layer's weights
+        np.testing.assert_allclose(out2, net(xt).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        assert not np.allclose(out2, old_net(xt).numpy(), atol=1e-5)
+
+    def test_mutating_captured_layer_weights_is_served(self):
+        """In-place parameter mutation (same object) needs no guard —
+        state is re-read every call; the guard must not retrace here."""
+        net = _make_net(1)
+        x, _ = _make_data()
+        xt = paddle.to_tensor(x)
+
+        def fwd(t):
+            return net(t)
+
+        compiled = paddle.jit.to_static(fwd)
+        compiled(xt)
+        runs_before = compiled._pure_runs
+        with paddle.no_grad():
+            for p in net.parameters():
+                p.set_value(p.numpy() * 0.5)
+        out = compiled(xt).numpy()
+        np.testing.assert_allclose(out, net(xt).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        assert compiled._pure_runs == runs_before  # no retrace
+
+    def test_rebind_to_none_raises_instead_of_stale_capture(self):
+        net = _make_net(2)
+        x, _ = _make_data()
+        xt = paddle.to_tensor(x)
+
+        def fwd(t):
+            return net(t)
+
+        compiled = paddle.jit.to_static(fwd)
+        compiled(xt)
+        net = None  # the binding no longer holds ANY stateful object
+        with pytest.raises(RuntimeError, match="captured-state guard"):
+            compiled(xt)
+        # recoverable: rebinding a valid layer after the raise must
+        # rediscover it (not bake its params in as trace constants)
+        net = _make_net(55)
+        out = compiled(xt).numpy()
+        np.testing.assert_allclose(out, net(xt).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_rebound_optimizer_state_threads_fresh(self):
+        """Rebinding the optimizer global mid-training must thread the
+        NEW optimizer's accumulators, not keep stepping the old ones."""
+        x, y = _make_data()
+        xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+        net = _make_net(3)
+        o = opt.AdamW(learning_rate=0.01, parameters=net.parameters())
+
+        def step(xb, yb):
+            loss = F.mse_loss(net(xb), yb)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return loss
+
+        compiled = paddle.jit.to_static(step)
+        for _ in range(3):
+            compiled(xt, yt)
+        old_o = o
+        o = opt.AdamW(learning_rate=0.01, parameters=net.parameters())
+        compiled(xt, yt)
+        # the fresh optimizer stepped (its accumulators exist and its
+        # counter advanced); the orphan stayed where it was
+        assert o._global_step == 1
+        assert old_o._global_step == 3
+        assert o._accumulators
+
+    def test_explicit_layers_are_never_guarded(self):
+        """Explicitly-passed layers are the user's contract — rebinding
+        the variable that happened to also be in scope must not touch
+        the compiled function."""
+        net = _make_net(4)
+        x, _ = _make_data()
+        xt = paddle.to_tensor(x)
+        compiled = paddle.jit.to_static(lambda t: net(t), layers=[net])
+        out1 = compiled(xt).numpy()
+        net = _make_net(77)  # rebinding is irrelevant: explicit capture
+        out2 = compiled(xt).numpy()
+        np.testing.assert_allclose(out1, out2)
+
+
 class TestSaveLoad:
     def test_save_load_roundtrip(self, tmp_path):
         net = _make_net(4)

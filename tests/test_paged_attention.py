@@ -9,6 +9,7 @@ from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.models.generation import generate
 from paddle_tpu.ops.paged_attention import (
     BlockManager,
+    PrefixCache,
     alloc_paged_kv_caches,
     contiguous_tables,
 )
@@ -119,6 +120,176 @@ class TestBlockManager:
         )
         k = caches[0].k_pool  # [kvh, blocks, bs, d]
         assert k.shape[1] == 8  # 4 seqs * 2 blocks, not 4 * 4
+
+
+@pytest.mark.quick
+class TestCopyOnWriteBlocks:
+    """COW invariants (ISSUE 6 acceptance): a live-referenced block is
+    never recycled, fork-on-write preserves the readers' block, and
+    shared blocks count exactly once in allocation accounting."""
+
+    def test_shared_block_survives_owner_free(self):
+        bm = BlockManager(num_blocks=4, block_size=4)
+        a = bm.allocate("a", 8)  # 2 private blocks
+        bm.ref(a[0])             # cache-style pin on the first
+        assert bm.refcount(a[0]) == 2
+        bm.free_sequence("a")
+        # a's private block recycled; the pinned one stays allocated
+        assert bm.free_blocks == 3
+        assert bm.refcount(a[0]) == 1 and bm.refcount(a[1]) == 0
+        assert a[0] not in bm._free
+        bm.release(a[0])
+        assert bm.free_blocks == 4
+
+    def test_adopt_counts_shared_blocks_exactly_once(self):
+        bm = BlockManager(num_blocks=4, block_size=4)
+        a = bm.allocate("a", 8)           # blocks 0,1 of the pool
+        bm.adopt("b", a)                  # b shares both
+        # b needs 3 blocks for 12 tokens but already owns 2 shared ones:
+        # exactly ONE new block must suffice (and occupancy counted the
+        # shared pair once — 2 free of 4, not 0)
+        assert bm.free_blocks == 2
+        assert bm.can_allocate("b", 12)
+        owned = bm.allocate("b", 12)
+        assert owned[:2] == a and len(owned) == 3
+        assert bm.free_blocks == 1
+        # freeing b drops its refs; a's blocks stay allocated via a
+        bm.free_sequence("b")
+        assert bm.free_blocks == 2
+        assert [bm.refcount(x) for x in a] == [1, 1]
+
+    def test_fork_on_write_preserves_reader_block(self):
+        bm = BlockManager(num_blocks=4, block_size=4)
+        a = bm.allocate("a", 4)
+        bm.adopt("b", a)
+        old, new = bm.fork("b", 0)
+        assert old == a[0] and new != old
+        assert bm.owned_blocks("b") == [new]
+        assert bm.owned_blocks("a") == [old]  # reader untouched
+        assert bm.refcount(old) == 1 and bm.refcount(new) == 1
+        # a sole-owner fork is the identity (no block consumed)
+        free_before = bm.free_blocks
+        old2, new2 = bm.fork("a", 0)
+        assert old2 == new2 == a[0] and bm.free_blocks == free_before
+
+    def test_fork_without_free_block_raises(self):
+        bm = BlockManager(num_blocks=2, block_size=4)
+        a = bm.allocate("a", 8)
+        bm.adopt("b", [a[0]])
+        with pytest.raises(RuntimeError, match="fork"):
+            bm.fork("b", 0)
+
+    def test_dead_block_ops_raise(self):
+        bm = BlockManager(num_blocks=2, block_size=4)
+        a = bm.allocate("a", 4)
+        bm.free_sequence("a")
+        with pytest.raises(RuntimeError, match="dead block"):
+            bm.ref(a[0])
+        with pytest.raises(RuntimeError, match="dead block"):
+            bm.release(a[0])
+        with pytest.raises(RuntimeError, match="dead block"):
+            bm.adopt("b", a)
+
+
+@pytest.mark.quick
+class TestPagedWriteOverflow:
+    def test_positions_past_table_row_are_dropped_not_clamped(self):
+        """Write lanes whose logical block exceeds the table row must
+        be dropped by the scatter, never clamped onto the row's last
+        entry (which would corrupt that block's early offsets)."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.paged_attention import paged_write_kv
+
+        bs, d = 8, 4
+        k_pool = jnp.zeros((1, 2, bs, d))
+        v_pool = jnp.zeros((1, 2, bs, d))
+        tables = jnp.asarray([[0, 1]], jnp.int32)  # row capacity: 16
+        kk = jnp.ones((1, 4, 1, d))  # 4 tokens at positions 14..17
+        k2, v2 = paged_write_kv(kk, kk * 2, k_pool, v_pool, tables,
+                                jnp.asarray([14], jnp.int32), 4)
+        k2 = np.asarray(k2)
+        # positions 14,15 land in block 1 offsets 6,7
+        assert (k2[0, 1, 6:] == 1.0).all()
+        # positions 16,17 are PAST the row: dropped — block 1's early
+        # offsets (the clamp target) and block 0 stay untouched
+        assert (k2[0, 1, :6] == 0.0).all()
+        assert (k2[0, 0] == 0.0).all()
+        assert (np.asarray(v2)[0, 1, 6:] == 2.0).all()
+
+
+@pytest.mark.quick
+class TestPrefixCache:
+    def test_lookup_matches_longest_full_block_prefix(self):
+        bm = BlockManager(num_blocks=8, block_size=4)
+        pc = PrefixCache(4, manager=bm)
+        toks = np.arange(10)          # 2 full blocks + partial tail
+        blocks = bm.allocate("a", 10)
+        pc.insert(toks, blocks)
+        assert pc.nodes == 2          # the tail block never enters
+        assert [bm.refcount(b) for b in blocks] == [2, 2, 1]
+        n, got = pc.lookup(toks)
+        assert n == 8 and got == blocks[:2]
+        # diverging second block matches only the first
+        other = np.concatenate([np.arange(4), np.full(6, 99)])
+        n, got = pc.lookup(other)
+        assert n == 4 and got == blocks[:1]
+        assert pc.lookup(np.full(3, 7))[0] == 0
+
+    def test_insert_is_idempotent(self):
+        bm = BlockManager(num_blocks=8, block_size=4)
+        pc = PrefixCache(4, manager=bm)
+        toks = np.arange(8)
+        b1 = bm.allocate("a", 8)
+        assert pc.insert(toks, b1) == 2
+        b2 = bm.allocate("b", 8)
+        assert pc.insert(toks, b2) == 0  # existing nodes kept
+        assert pc.lookup(toks)[1] == b1
+        assert [bm.refcount(b) for b in b2] == [1, 1]
+
+    def test_evict_lru_frees_only_unreferenced(self):
+        bm = BlockManager(num_blocks=4, block_size=2)
+        pc = PrefixCache(2, manager=bm)
+        live = bm.allocate("live", 2)
+        pc.insert([1, 2], live)            # pinned AND owned by "live"
+        dead = bm.allocate("gone", 4)
+        pc.insert([3, 4, 5, 6], dead)
+        bm.free_sequence("gone")           # cache pin keeps both alive
+        assert bm.free_blocks == 1
+        freed = pc.evict(1)
+        assert freed == 1 and bm.free_blocks == 2
+        # a shortfall larger than what sole-ref leaves can free stops
+        # instead of wiping the tree: the live sequence's block stays
+        # cached (unpinning it would free nothing) and is never recycled
+        assert pc.evict(10) == 1
+        assert pc.nodes == 1
+        assert pc.lookup([1, 2])[0] == 2   # still served from cache
+        assert bm.refcount(live[0]) == 2   # live + cache pin
+
+    def test_lru_order_prefers_stale_leaves(self):
+        bm = BlockManager(num_blocks=6, block_size=2)
+        pc = PrefixCache(2, manager=bm)
+        a = bm.allocate("a", 2)
+        pc.insert([1, 2], a)
+        b = bm.allocate("b", 2)
+        pc.insert([3, 4], b)
+        bm.free_sequence("a")
+        bm.free_sequence("b")
+        pc.lookup([1, 2])                  # refresh a
+        pc.evict(1)                        # b (stale) goes first
+        assert pc.lookup([1, 2])[0] == 2
+        assert pc.lookup([3, 4])[0] == 0
+
+    def test_matcher_mode_bounds_nodes(self):
+        pc = PrefixCache(2, max_nodes=3)
+        pc.insert([1, 2, 3, 4])
+        pc.insert([5, 6])
+        assert pc.nodes == 3
+        pc.lookup([1, 2, 3, 4])            # refresh the 1-2-3-4 path
+        pc.insert([7, 8])                  # evicts the LRU leaf (5-6)
+        assert pc.nodes == 3
+        assert pc.lookup([1, 2, 3, 4])[0] == 4
+        assert pc.lookup([5, 6])[0] == 0
 
 
 class TestBlockMultiheadAttention:

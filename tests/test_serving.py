@@ -363,6 +363,135 @@ class TestChunkedPrefill:
         assert len(eng2._queue) == 1
 
 
+class TestPrefixReuse:
+    """ISSUE 6: radix-style prefix KV reuse. The contract is twofold:
+    cache hits save prefill tokens (measured via ``prefix_stats``), and
+    outputs stay token-identical to isolated generate() runs — the KV a
+    later request adopts is bit-for-bit what its own prefill would have
+    written."""
+
+    def test_shared_prefix_hits_and_stays_token_exact(self):
+        model = _model()
+        rng = np.random.RandomState(3)
+        prefix = rng.randint(0, 250, (16,))  # 2 full blocks at bs=8
+        tails = {"a": rng.randint(0, 250, (5,)),
+                 "b": rng.randint(0, 250, (3,)),
+                 "c": rng.randint(0, 250, (7,))}
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=64, block_size=8, num_blocks=12,
+            prompt_pad=24, prefix_cache=True)
+        outs = {}
+        for rid, tail in tails.items():
+            p = np.concatenate([prefix, tail])
+            eng.add_request(rid, p, max_new_tokens=4)
+            outs[rid] = (p, eng.run()[rid])
+        for rid, (p, req) in outs.items():
+            assert req.status == "ok"
+            want = _reference_tokens(model, p, 4)
+            assert req.out == want, (rid, req.out, want)
+        # b and c each reused the 16-token prefix a prefilled
+        assert eng.prefix_hit_tokens == 32
+        st = eng.prefix_stats()
+        assert st["enabled"] and st["hit_rate"] > 0.3
+        # prefill skipped exactly the cached tokens
+        assert eng.prefill_tokens == sum(
+            16 + t.size for t in tails.values()) - 32
+
+    def test_fully_cached_prompt_forks_and_preserves_readers(self):
+        """A prompt whose length is an exact block multiple and fully
+        cached recomputes only its last token — the write lands inside
+        the last SHARED block, so copy-on-write must fork it and the
+        cache's copy must keep serving later requests byte-exact."""
+        model = _model()
+        rng = np.random.RandomState(4)
+        p = rng.randint(0, 250, (16,))  # exactly 2 blocks
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=64, block_size=8, num_blocks=12,
+            prompt_pad=16, prefix_cache=True)
+        want = _reference_tokens(model, p, 5)
+        for rid in ("cold", "hot", "again"):
+            eng.add_request(rid, p, max_new_tokens=5)
+            req = eng.run()[rid]
+            assert req.out == want, (rid, req.out, want)
+        assert eng.prefix_forks >= 2          # hot + again both forked
+        assert eng.prefix_hit_tokens == 30    # 15 cached tokens twice
+
+    def test_chunked_mode_prefix_reuse_token_exact(self):
+        model = _model()
+        rng = np.random.RandomState(5)
+        prefix = rng.randint(0, 250, (24,))
+        a = np.concatenate([prefix, rng.randint(0, 250, (9,))])
+        b = np.concatenate([prefix, rng.randint(0, 250, (4,))])
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=64, block_size=8, num_blocks=16,
+            prefill_chunk=8, prefix_cache=True)
+        eng.add_request("a", a, max_new_tokens=4)
+        done = eng.run()
+        eng.add_request("b", b, max_new_tokens=6)
+        done = eng.run()
+        assert done["a"].out == _reference_tokens(model, a, 4)
+        assert done["b"].out == _reference_tokens(model, b, 6)
+        assert eng.prefix_hit_tokens == 24    # b adopted 3 full blocks
+        # b's prefill fed only the un-cached remainder
+        assert eng.prefill_tokens == a.size + (b.size - 24)
+
+    def test_offset_prefill_near_max_len_stays_exact(self):
+        """Regression: a cache-hit whole-prompt prefill writes its full
+        static ``prompt_pad`` width starting at the cached offset; the
+        padded lanes then run PAST the table row. They must be DROPPED
+        — take_along_axis clamping would alias the garbage onto the
+        last real block's early offsets and corrupt prompt KV written
+        in the same dispatch."""
+        model = _model()
+        rng = np.random.RandomState(8)
+        p = rng.randint(0, 250, (28,))  # fills the row to its last block
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=8,
+            prompt_pad=28, prefix_cache=True)
+        want = _reference_tokens(model, p, 4)
+        for rid in ("cold", "hot"):  # hot: cached_len=24, writes 24..51
+            eng.add_request(rid, p, max_new_tokens=4)
+            assert eng.run()[rid].out == want, rid
+        assert eng.prefix_hit_tokens == 24
+
+    def test_cache_eviction_keeps_admission_alive(self):
+        """A pool mostly full of cached prefixes must still admit new
+        work: LRU cache entries are reclaimed instead of head-of-line
+        blocking (the cache can never deadlock admission)."""
+        model = _model()
+        rng = np.random.RandomState(6)
+        # pool of 6 blocks; each request needs 2 (pad 8 + 4 gen -> 12
+        # tokens) and caches 1 full prompt block; distinct prompts, so
+        # the cache only ever GROWS until eviction kicks in
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=32, block_size=8, num_blocks=6,
+            prompt_pad=8, prefix_cache=True)
+        prompts = {i: rng.randint(0, 250, (8,)) for i in range(6)}
+        for rid, p in prompts.items():
+            eng.add_request(rid, p, max_new_tokens=4)
+        done = eng.run()
+        assert set(done) == set(prompts)
+        for rid, p in prompts.items():
+            assert done[rid].status == "ok"
+            assert done[rid].out == _reference_tokens(model, p, 4)
+        assert eng.prefix_cache.evicted_blocks > 0
+
+    def test_cache_off_is_bit_for_bit_legacy(self):
+        """prefix_cache=False (the default) keeps the exact legacy
+        behaviour — zero stats, no cache object."""
+        model = _model()
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=4,
+            prompt_pad=8)
+        assert eng.prefix_cache is None
+        p = np.arange(5) % 250
+        eng.add_request("x", p, max_new_tokens=3)
+        assert eng.run()["x"].out == _reference_tokens(model, p, 3)
+        assert eng.prefix_stats() == {
+            "enabled": False, "hit_tokens": 0, "prefill_tokens": 5,
+            "forks": 0, "hit_rate": 0.0}
+
+
 @pytest.mark.quick
 @pytest.mark.analysis
 class TestRecompilePin:
