@@ -34,9 +34,11 @@ import numpy as np
 from ....base import random as _random
 from ....base.tape import apply
 from ....base.tensor import Tensor
+from ....nn.clip import ClipGradByGlobalNorm, _sq_sum
 from ....nn.layer.layers import Layer
 
-__all__ = ["ExpertMLP", "TopKGate", "MoELayer"]
+__all__ = ["ExpertMLP", "TopKGate", "MoELayer",
+           "ClipGradForMOEByGlobalNorm", "is_expert_param"]
 
 
 class ExpertMLP(Layer):
@@ -314,3 +316,79 @@ def place_experts_on_mesh(layer: Layer, mesh, ep_axis: str = "ep"):
         p._data = jax.device_put(
             p._data, NamedSharding(mesh, PartitionSpec(*spec))
         )
+
+
+def is_expert_param(p) -> bool:
+    """Default expert-parameter predicate: anything carrying the
+    ``ep_axis`` sharding hint (ExpertMLP's stacked weights) or an
+    explicit ``is_expert`` flag (per-rank expert instances ported from
+    the reference)."""
+    return getattr(p, "ep_axis", None) is not None or bool(
+        getattr(p, "is_expert", False))
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    """Expert-aware global-norm clip (ref: incubate/distributed/models/
+    moe/grad_clip.py ClipGradForMOEByGlobalNorm — cited in this
+    module's docstring; the plain ``ClipGradByGlobalNorm`` is silently
+    WRONG for expert-parallel training).
+
+    Why the plain clip is wrong under EP: expert parameters are
+    PARTITIONED over the ``ep`` group (each rank owns E/ep experts)
+    while every other parameter is replicated. A local global-norm
+    therefore sees only 1/ep of the expert grad mass — every rank
+    computes a DIFFERENT, too-large scale, clipping too little AND
+    divergently across ranks (replicated params receive different
+    updates → silent desync). The fix (reference semantics):
+
+        global_norm^2 = norm^2(replicated grads)
+                      + allreduce_sum_over_ep(norm^2(local expert grads))
+
+    then ONE shared scale applies to all grads. In single-controller
+    mode (this repo's default: experts are stacked global arrays, jax
+    shards them transparently) the local expert norm already covers
+    every expert, so ``moe_group=None`` skips the allreduce and the
+    result equals the dense clip exactly — the parity test pins that.
+    Multi-controller ranks pass their ``ep`` group.
+    """
+
+    def __init__(self, clip_norm=1.0, is_expert_param_func=None,
+                 moe_group=None):
+        super().__init__(clip_norm)
+        self.is_expert = (is_expert_param_func if is_expert_param_func
+                          is not None else is_expert_param)
+        self.moe_group = moe_group
+
+    def _reduce_expert_sq(self, sq):
+        """Sum the local expert squared-norm over the EP group. The
+        seam the simulated-shard parity test overrides; real mc ranks
+        go through distributed.all_reduce."""
+        if self.moe_group is None:
+            return sq
+        from ....distributed import get_world_size
+        from ....distributed.communication import all_reduce
+
+        if get_world_size(self.moe_group) <= 1:
+            return sq
+        all_reduce(sq, group=self.moe_group)
+        return sq
+
+    def _total_sq(self, clippable):
+        """The expert-aware aggregation: expert squared-norms sum
+        locally then allreduce over the EP group; everything downstream
+        (sqrt, scale, apply) is the inherited dense clip."""
+        expert_sq = None
+        normal_sq = None
+        for p, g in clippable:
+            s = _sq_sum(g)
+            if self.is_expert(p):
+                expert_sq = s if expert_sq is None else expert_sq + s
+            else:
+                normal_sq = s if normal_sq is None else normal_sq + s
+        if expert_sq is not None:
+            expert_sq = self._reduce_expert_sq(expert_sq)
+        parts = [s for s in (normal_sq, expert_sq) if s is not None]
+        total = parts[0]
+        for s in parts[1:]:
+            total = total + s
+        return total

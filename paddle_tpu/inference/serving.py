@@ -58,6 +58,31 @@ inference/supervisor.py): a fenced engine refuses further steps, and
 requeue re-enters already-accepted work into a rebuilt engine without
 re-running admission control.
 
+Speculative decoding (``spec_decode_k=K``): each decode round, a
+:class:`~paddle_tpu.inference.speculative.DraftProposer` (default:
+the zero-dispatch n-gram prompt-lookup proposer) drafts up to K tokens
+per decode-phase slot and ONE batched verify dispatch — the prefill
+program at static width K+1, same trash-table isolation — scores all
+K+1 positions, computing the per-slot greedy accepted-length ON DEVICE
+(a proposed-tokens lane + cumprod prefix-match beside the existing
+token/eos lanes). Greedy accept-prefix makes every emitted token
+byte-identical to ``decode_chunk=1`` output: a draft is accepted only
+by EQUALLING the argmax, and rejected drafts' KV writes land at
+positions the causal mask hides until the next contiguous dispatch
+overwrites them (the same already-relied-on invariant that covers
+padded prefill writes). The scheduler accounts the dispatched K+1
+positions per slot against ``max_num_batched_tokens`` (falling back to
+plain decode when the budget can't cover a verify round) and credits
+the VARIABLE accepted-length per slot against budgets/deadlines;
+``spec_stats()`` reports proposed/accepted/acceptance-rate.
+
+``kv_dtype="int8"`` allocates quantized KV pools (per-block scale
+pools ride the same physical block ids — see ops/paged_attention.py),
+halving KV bytes per slot; COW forks copy scale rows with value rows
+so prefix reuse and cluster routing work unchanged. Both levers
+compose: the verify dispatch reads/writes the quantized pools like any
+other phase.
+
 Greedy decoding (temperature 0) — matching models.generation.generate's
 default — so engine outputs are token-identical to isolated generate()
 runs, which is the correctness contract the tests assert.
@@ -84,6 +109,7 @@ from .admission import (
     EngineLoad,
     priority_rank,
 )
+from .speculative import DraftProposer, NgramProposer
 
 __all__ = ["GenRequest", "ContinuousBatchingEngine", "EngineFenced"]
 
@@ -183,7 +209,10 @@ class ContinuousBatchingEngine:
                  prefill_chunk: Optional[int] = None,
                  max_num_batched_tokens: Optional[int] = None,
                  admission: Optional[AdmissionConfig] = None,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 spec_decode_k: Optional[int] = None,
+                 draft_proposer: Optional[DraftProposer] = None,
+                 kv_dtype: Optional[str] = None):
         """``num_blocks`` fixes the HBM budget (the pool allocates one
         extra trash block); ``max_len`` bounds any sequence's positions
         (tables carry ceil(max_len/block_size) slots per row);
@@ -219,6 +248,17 @@ class ContinuousBatchingEngine:
         are reclaimed LRU-first when admissions run out of free blocks,
         so the cache can never deadlock admission. Greedy decode keeps
         cache-hit outputs token-identical to cold runs.
+
+        ``spec_decode_k=K`` turns on self-speculative decoding (see
+        module docstring): ``draft_proposer`` supplies the drafts
+        (default :class:`NgramProposer` — prompt-lookup, no second
+        model); rounds where no slot has a draft fall back to the
+        plain decode/scan path at zero cost. Greedy accept-prefix
+        keeps outputs token-identical to ``spec_decode_k=None``.
+
+        ``kv_dtype="int8"`` quantizes the KV pools (per-block scale
+        pools; ~2x KV capacity at an int8-weights-class quality cost —
+        the rel-err gate in tests/test_spec_decode.py pins it).
 
         ``admission=AdmissionConfig(...)`` turns on overload control:
         submissions run through an :class:`AdmissionController` (shed
@@ -273,6 +313,21 @@ class ContinuousBatchingEngine:
         else:
             self.max_num_batched_tokens = None  # whole-prompt: unbudgeted
 
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
+        self.spec_k = None if spec_decode_k is None else int(spec_decode_k)
+        if self.spec_k is not None and self.spec_k < 1:
+            raise ValueError(f"spec_decode_k must be >= 1, got {self.spec_k}")
+        self.proposer = (draft_proposer if draft_proposer is not None
+                         else NgramProposer())
+        self.spec_proposed = 0   # real draft tokens sent to verify
+        self.spec_accepted = 0   # of those, greedy-accepted
+        self.spec_emitted = 0    # tokens emitted by verify dispatches
+        self.spec_dispatches = 0
+        self.spec_slot_rounds = 0  # slot-participations in dispatches
+
         was_training = model.training
         model.eval()
         self._restore_training = was_training
@@ -281,8 +336,9 @@ class ContinuousBatchingEngine:
             num_blocks=num_blocks + 1,
             tables=np.full((self.B, self.max_blocks_per_seq), self._trash,
                            np.int32),
+            kv_dtype=kv_dtype,
         )
-        self._pools = [(c.k_pool._data, c.v_pool._data) for c in caches]
+        self._pools = self._pools_from(caches)
         self._tables = np.full(
             (self.B, self.max_blocks_per_seq), self._trash, np.int32)
         self._slots = [_Slot() for _ in range(self.B)]
@@ -292,6 +348,7 @@ class ContinuousBatchingEngine:
         self._prefill_jit = None
         self._decode_jit = None
         self._chunk_jit = None
+        self._spec_jit = None  # k+1-wide verify + device accepted-length
         self._copy_jit = None  # COW block copy (prefix-cache forks)
         self.decode_chunk = max(1, int(decode_chunk))
         self._rr = 0  # round-robin start for chunk scheduling fairness
@@ -300,6 +357,7 @@ class ContinuousBatchingEngine:
         self.prefill_tokens = 0
         self.last_step_tokens = 0
         self.max_step_tokens = 0
+        self._step_spec_overcharge = 0
         # overload control + supervision surface
         self.admission = (None if admission is None
                           else AdmissionController(admission))
@@ -315,13 +373,29 @@ class ContinuousBatchingEngine:
         self._phases_run: set = set()  # compiled phases dispatched so far
 
     # -- compiled phases -------------------------------------------------
+    @staticmethod
+    def _pools_from(caches):
+        """Per-layer pool tuples for the donated jit carry: (k, v) for
+        float pools, (k, v, k_scale, v_scale) for int8 — one shape for
+        every compiled phase."""
+        out = []
+        for c in caches:
+            if getattr(c, "k_scale", None) is not None:
+                out.append((c.k_pool._data, c.v_pool._data,
+                            c.k_scale._data, c.v_scale._data))
+            else:
+                out.append((c.k_pool._data, c.v_pool._data))
+        return out
+
     def _caches_from(self, pools, tables_arr):
         t = Tensor(tables_arr, _internal=True)
-        return [
-            PagedLayerCache(Tensor(k, _internal=True),
-                            Tensor(v, _internal=True), t, False)
-            for k, v in pools
-        ]
+        caches = []
+        for entry in pools:
+            scales = tuple(Tensor(s, _internal=True) for s in entry[2:])
+            caches.append(PagedLayerCache(
+                Tensor(entry[0], _internal=True),
+                Tensor(entry[1], _internal=True), t, False, *scales))
+        return caches
 
     def _build_jits(self):
         model, params = self.model, self._params
@@ -335,8 +409,7 @@ class ContinuousBatchingEngine:
                     Tensor(ids, _internal=True), caches,
                     Tensor(cache_len, _internal=True))
             toks = jnp.argmax(logits._data, axis=-1)  # [B, s_pad]
-            return toks, [(c.k_pool._data, c.v_pool._data)
-                          for c in new_caches]
+            return toks, self._pools_from(new_caches)
 
         def decode(param_arrays, pools, tok, tables, cache_len):
             for p, a in zip(params, param_arrays):
@@ -347,8 +420,7 @@ class ContinuousBatchingEngine:
                     Tensor(tok[:, None], _internal=True), caches,
                     Tensor(cache_len, _internal=True))
             nxt = jnp.argmax(logits._data[:, -1], axis=-1).astype(jnp.int32)
-            return nxt, [(c.k_pool._data, c.v_pool._data)
-                         for c in new_caches]
+            return nxt, self._pools_from(new_caches)
 
         def decode_chunk(param_arrays, pools, tok, tables, cache_len,
                          finished):
@@ -368,8 +440,7 @@ class ContinuousBatchingEngine:
                 if eos is not None:
                     nxt = jnp.where(fin, eos, nxt)
                     fin = fin | (nxt == eos)
-                new_pl = [(c.k_pool._data, c.v_pool._data)
-                          for c in new_caches]
+                new_pl = self._pools_from(new_caches)
                 return (nxt, new_pl, cl + 1, fin), nxt
 
             (t, pl, cl, fin), toks = jax.lax.scan(
@@ -377,9 +448,30 @@ class ContinuousBatchingEngine:
                 length=self.decode_chunk)
             return toks, pl  # toks: [K, B]
 
+        def spec_verify(param_arrays, pools, ids, tables, cache_len,
+                        drafts):
+            """ONE dispatch scoring all k+1 positions: the prefill path
+            at width k+1 plus a drafts lane — the greedy accepted
+            length (cumprod of prefix matches against the argmax one
+            position back) comes back per slot, so the host only
+            slices tokens, never logits."""
+            for p, a in zip(params, param_arrays):
+                p._data = a
+            with no_grad():
+                caches = self._caches_from(pools, tables)
+                logits, new_caches = model.forward_with_cache(
+                    Tensor(ids, _internal=True), caches,
+                    Tensor(cache_len, _internal=True))
+            toks = jnp.argmax(
+                logits._data, axis=-1).astype(jnp.int32)  # [B, k+1]
+            ok = (drafts == toks[:, :-1]).astype(jnp.int32)  # [B, k]
+            acc = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)  # [B]
+            return toks, acc, self._pools_from(new_caches)
+
         self._prefill_jit = jax.jit(prefill, donate_argnums=(1,))
         self._decode_jit = jax.jit(decode, donate_argnums=(1,))
         self._chunk_jit = jax.jit(decode_chunk, donate_argnums=(1,))
+        self._spec_jit = jax.jit(spec_verify, donate_argnums=(1,))
 
     def _run_jit(self, jit_fn, *args):
         """Invoke a compiled phase with the params' CURRENT host arrays
@@ -647,6 +739,26 @@ class ContinuousBatchingEngine:
             })
         return base
 
+    def spec_stats(self) -> dict:
+        """Speculative-decoding counters (zeros when off), the
+        acceptance-rate feedback the bench rows report. A slot in a
+        verify dispatch always emits >= 1 token where the plain decode
+        path emits exactly 1, so ``tokens_per_slot_round`` is the
+        realized per-slot decode-speed multiplier."""
+        return {
+            "enabled": self.spec_k is not None,
+            "k": self.spec_k,
+            "proposed": self.spec_proposed,
+            "accepted": self.spec_accepted,
+            "acceptance_rate": (self.spec_accepted / self.spec_proposed
+                                if self.spec_proposed else 0.0),
+            "dispatches": self.spec_dispatches,
+            "emitted": self.spec_emitted,
+            "tokens_per_slot_round": (
+                self.spec_emitted / self.spec_slot_rounds
+                if self.spec_slot_rounds else 0.0),
+        }
+
     def _append_token(self, req: GenRequest, tok: int):
         req.out.append(tok)
         req.times.append(time.perf_counter())
@@ -707,11 +819,13 @@ class ContinuousBatchingEngine:
         fully-cached prompt recomputing its last token). One compiled
         program with DONATED pools (src/dst are traced scalars, so
         every fork shares it): XLA updates the block in place instead
-        of materializing a fresh full-size pool per layer."""
+        of materializing a fresh full-size pool per layer. Scale pools
+        (int8 KV) copy the same row — a forked block's quantization
+        scales travel with its bytes."""
         if self._copy_jit is None:
             def copy_block(pools, s, d):
-                return [(k.at[:, d].set(k[:, s]),
-                         v.at[:, d].set(v[:, s])) for k, v in pools]
+                return [tuple(a.at[:, d].set(a[:, s]) for a in entry)
+                        for entry in pools]
 
             self._copy_jit = jax.jit(copy_block, donate_argnums=(0,))
         self._pools = self._copy_jit(
@@ -971,9 +1085,75 @@ class ContinuousBatchingEngine:
         self._rr = (self._rr + 1) % self.B
         return used
 
+    def _propose_drafts(self, active) -> Optional[tuple]:
+        """Draft up to ``spec_k`` tokens per decode-phase slot from its
+        full history (prompt + generated). Returns ``(drafts, n_real)``
+        — [B, k] int32 (zero-padded) and {slot: real draft count} — or
+        None when NO slot produced a draft (the round falls back to
+        the plain decode path at zero dispatch cost)."""
+        drafts = np.zeros((self.B, self.spec_k), np.int32)
+        n_real: Dict[int, int] = {}
+        any_draft = False
+        for i in active:
+            req = self._slots[i].req
+            hist = np.concatenate(
+                [req.prompt, np.asarray(req.out, np.int32)])
+            d = np.asarray(
+                self.proposer.propose(hist, self.spec_k),
+                np.int32).reshape(-1)[: self.spec_k]
+            n_real[i] = int(d.size)
+            if d.size:
+                drafts[i, : d.size] = d
+                any_draft = True
+        return (drafts, n_real) if any_draft else None
+
+    def _spec_step(self, active, tables, cl, drafts, n_real) -> int:
+        """One speculative round: verify dispatch + host accept walk.
+        Emits 1..k+1 tokens per slot (variable tokens/step); returns
+        the k+1 real positions per slot the dispatch processed."""
+        k = self.spec_k
+        ids = np.zeros((self.B, k + 1), np.int32)
+        for i in active:
+            ids[i, 0] = self._slots[i].req.out[-1]
+            ids[i, 1:] = drafts[i]
+        toks, acc, self._pools = self._run_jit(
+            self._spec_jit, self._pools, jnp.asarray(ids),
+            jnp.asarray(tables), jnp.asarray(cl), jnp.asarray(drafts))
+        self._phases_run.add("spec_verify")
+        toks = np.asarray(toks)  # [B, k+1]
+        acc = np.asarray(acc)  # [B]
+        self.spec_dispatches += 1
+        self.spec_slot_rounds += len(active)
+        emitted_before = self.spec_emitted
+        for i in active:
+            slot = self._slots[i]
+            # emitted = accepted prefix + the bonus token from the
+            # last accepted position's logits, clamped to the slot's
+            # remaining budget (deadline/budget accounting sees the
+            # true variable-length grant)
+            m = min(int(acc[i]) + 1, slot.remaining)
+            self.spec_proposed += n_real.get(i, 0)
+            self.spec_accepted += min(int(acc[i]), n_real.get(i, 0))
+            for j in range(m):
+                t = int(toks[i, j])
+                self._append_token(slot.req, t)
+                slot.cache_len += 1
+                slot.remaining -= 1
+                self.decode_tokens += 1
+                self.spec_emitted += 1
+                if self._finish_if_done(i, t):
+                    break
+        # the budget is charged the k+1 dispatched positions per slot,
+        # but only the emitted tokens drain real backlog — step() feeds
+        # the difference back out of the service-rate telemetry
+        self._step_spec_overcharge += (
+            len(active) * (k + 1) - (self.spec_emitted - emitted_before))
+        return len(active) * (k + 1)
+
     def _decode_step(self, budget_left: Optional[int]) -> int:
-        """One decode round for every decode-phase slot (single step or
-        a ``decode_chunk`` scan). Returns real tokens scheduled."""
+        """One decode round for every decode-phase slot (speculative
+        verify, single step, or a ``decode_chunk`` scan). Returns real
+        tokens scheduled."""
         active = [i for i, s in enumerate(self._slots)
                   if s.active and not s.prefilling]
         if not active:
@@ -997,6 +1177,18 @@ class ContinuousBatchingEngine:
             for i, s in enumerate(self._slots):
                 if s.prefilling:
                     tables[i] = self._trash
+        if self.spec_k is not None and (
+                budget_left is None
+                # under a token budget a verify round charges
+                # active*(k+1) and could eat the whole step's budget
+                # every step — fall back to plain decode (active
+                # tokens) while a slot is mid-prefill so its chunks
+                # keep landing (same starvation guard as the scan)
+                or (len(active) * (self.spec_k + 1) <= budget_left
+                    and self.num_prefilling == 0)):
+            proposed = self._propose_drafts(active)
+            if proposed is not None:
+                return self._spec_step(active, tables, cl, *proposed)
         k = self.decode_chunk
         scan_ok = (
             k > 1
@@ -1051,6 +1243,7 @@ class ContinuousBatchingEngine:
         before = set(self._completed)
         self._expire_queued()
         self._evict_expired()
+        self._step_spec_overcharge = 0
         used = self._admit()
         budget = self.max_num_batched_tokens
         used += self._decode_step(None if budget is None else budget - used)
@@ -1063,14 +1256,19 @@ class ContinuousBatchingEngine:
         if used > 0:
             # service-rate EWMAs feed the admission delay estimate;
             # idle ticks are excluded so a quiet engine does not decay
-            # its measured capacity toward zero
+            # its measured capacity toward zero. A speculative verify
+            # round is CHARGED k+1 positions per slot (dispatch cost)
+            # but only drains the emitted tokens of real backlog — the
+            # delay estimate must see the drain rate, or spec engines
+            # overstate capacity by (k+1)/(1+accepted)
+            real = used - self._step_spec_overcharge
             a = (self.admission.config.ewma_alpha
                  if self.admission is not None else 0.3)
             self.ewma_step_s = self.last_step_s if self.ewma_step_s is None \
                 else a * self.last_step_s + (1 - a) * self.ewma_step_s
-            self.ewma_step_tokens = float(used) \
+            self.ewma_step_tokens = float(real) \
                 if self.ewma_step_tokens is None \
-                else a * used + (1 - a) * self.ewma_step_tokens
+                else a * real + (1 - a) * self.ewma_step_tokens
         if self.admission is not None:
             self.admission.observe(self.load())
         return [self._completed[r] for r in set(self._completed) - before]

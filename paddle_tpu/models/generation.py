@@ -59,7 +59,8 @@ class _KVCacheState:
     ``block_size`` switches to the paged (block-table) cache layout
     (ops/paged_attention.py)."""
 
-    def __init__(self, model, batch, max_len, block_size=None):
+    def __init__(self, model, batch, max_len, block_size=None,
+                 kv_dtype=None):
         from ..nn.layer.layers import Layer
 
         class Holder(Layer):
@@ -81,20 +82,32 @@ class _KVCacheState:
         )
         self.paged = block_size is not None
         kwargs = {"block_size": block_size} if self.paged else {}
+        if kv_dtype is not None:
+            kwargs["kv_dtype"] = kv_dtype
         caches = model.init_cache(batch, max_len, **kwargs)
         self.n = len(caches)
         self.shapes_dtypes = []
+        self.quantized = False
         if self.paged:
             from ..ops.paged_attention import PagedLayerCache  # noqa: F401
 
             self._tables = caches[0].block_tables
             self._contiguous = bool(getattr(caches[0], "contiguous", False))
+            self.quantized = getattr(caches[0], "k_scale", None) is not None
             for i, c in enumerate(caches):
                 self.holder.register_buffer(f"k{i}", c.k_pool, persistable=False)
                 self.holder.register_buffer(f"v{i}", c.v_pool, persistable=False)
                 self.shapes_dtypes.append(
                     (tuple(c.k_pool.shape), c.k_pool._data.dtype)
                 )
+                if self.quantized:
+                    # int8 KV: the per-block scale pools are device
+                    # state exactly like the value pools — registered
+                    # so to_static threads + donates them with the rest
+                    self.holder.register_buffer(
+                        f"ks{i}", c.k_scale, persistable=False)
+                    self.holder.register_buffer(
+                        f"vs{i}", c.v_scale, persistable=False)
         else:
             for i, (k, v) in enumerate(caches):
                 self.holder.register_buffer(f"k{i}", k, persistable=False)
@@ -111,6 +124,9 @@ class _KVCacheState:
                     self.holder._buffers[f"v{i}"],
                     self._tables,
                     self._contiguous,
+                    *((self.holder._buffers[f"ks{i}"],
+                       self.holder._buffers[f"vs{i}"])
+                      if self.quantized else ()),
                 )
                 for i in range(self.n)
             ]
@@ -124,11 +140,18 @@ class _KVCacheState:
             k, v = (c.k_pool, c.v_pool) if self.paged else (c[0], c[1])
             self.holder._buffers[f"k{i}"]._data = k._data
             self.holder._buffers[f"v{i}"]._data = v._data
+            if self.quantized:
+                self.holder._buffers[f"ks{i}"]._data = c.k_scale._data
+                self.holder._buffers[f"vs{i}"]._data = c.v_scale._data
 
     def reset(self):
         for i, (shape, dt) in enumerate(self.shapes_dtypes):
             self.holder._buffers[f"k{i}"]._data = jnp.zeros(shape, dt)
             self.holder._buffers[f"v{i}"]._data = jnp.zeros(shape, dt)
+            if self.quantized:
+                for nm in (f"ks{i}", f"vs{i}"):
+                    buf = self.holder._buffers[nm]
+                    buf._data = jnp.zeros(buf._data.shape, buf._data.dtype)
         tok = self.holder._buffers["tok"]
         tok._data = jnp.zeros(tok._data.shape, jnp.int32)
         fin = self.holder._buffers["finished"]
@@ -152,7 +175,8 @@ def _sample(logits, temperature: float, top_k: int):
 
 
 def _get_compiled(model, b, s, max_len, temperature, top_k, use_jit,
-                  block_size=None, chunked=False, eos_token_id=None):
+                  block_size=None, chunked=False, eos_token_id=None,
+                  kv_dtype=None, spec_k=None):
     """Build (or fetch) the prefill/decode programs + cache state for
     this (batch, prompt-len, max-len, sampling) signature.
 
@@ -160,26 +184,32 @@ def _get_compiled(model, b, s, max_len, temperature, top_k, use_jit,
     and eos-finished mask as HOLDER BUFFERS (device state) instead of
     passing the token host-side — so ``decode.multi_step`` can scan K
     steps in one dispatch. The eos logic is baked into the step, hence
-    eos_token_id joins the cache key."""
+    eos_token_id joins the cache key.
+
+    ``spec_k=K`` additionally builds the speculative VERIFY program —
+    the cached step at width K+1 returning the argmax at EVERY
+    position — and the return grows to a 4-tuple
+    ``(state, prefill, decode, verify)``."""
     from .. import jit
 
     key = (b, s, max_len, temperature, top_k, use_jit, block_size,
-           chunked, eos_token_id if chunked else None)
+           chunked, eos_token_id if chunked else None, kv_dtype, spec_k)
     store = getattr(model, "_generation_programs", None)
     if store is None:
         store = model._generation_programs = {}
     if key in store:
-        state, prefill, decode = store.pop(key)  # re-insert as newest
-        store[key] = (state, prefill, decode)
-        state.reset()
-        return state, prefill, decode
+        entry = store.pop(key)  # re-insert as newest
+        store[key] = entry
+        entry[0].reset()
+        return entry
     # bound the program cache: each entry pins full KV buffers + two
     # compiled programs; varying prompt lengths would otherwise grow
     # device memory without limit (LRU, insertion-ordered dict)
     while len(store) >= 4:
         store.pop(next(iter(store)))
 
-    state = _KVCacheState(model, b, max_len, block_size=block_size)
+    state = _KVCacheState(model, b, max_len, block_size=block_size,
+                          kv_dtype=kv_dtype)
 
     def prefill(ids, cur_len):
         logits, new = model.forward_with_cache(ids, state.caches(), cur_len)
@@ -216,11 +246,29 @@ def _get_compiled(model, b, s, max_len, temperature, top_k, use_jit,
             state.set(new)
             return _sample(logits[:, -1], temperature, top_k)
 
+    verify = None
+    if spec_k:
+        def verify(ids, cur_len):
+            """Speculative verify: feed [B, spec_k+1] candidate tokens
+            at positions cur_len.., write their KV, return the greedy
+            argmax at EVERY position (the accept rule runs host-side
+            on these K+1 ints — logits never leave the device)."""
+            logits, new = model.forward_with_cache(
+                ids, state.caches(), cur_len)
+            state.set(new)
+            return apply(
+                lambda lg: jnp.argmax(lg, axis=-1).astype(jnp.int32),
+                logits, op_name="verify_argmax")
+
     if use_jit:
         prefill = jit.to_static(prefill, layers=[model, state.holder])
         decode = jit.to_static(decode, layers=[model, state.holder])
-    store[key] = (state, prefill, decode)
-    return state, prefill, decode
+        if verify is not None:
+            verify = jit.to_static(verify, layers=[model, state.holder])
+    entry = ((state, prefill, decode) if verify is None
+             else (state, prefill, decode, verify))
+    store[key] = entry
+    return entry
 
 
 def _decode_chunked(state, decode, first_tok, s, max_new_tokens,
@@ -259,11 +307,103 @@ def _decode_chunked(state, decode, first_tok, s, max_new_tokens,
     return out
 
 
+def _decode_speculative(decode, verify, input_ids, first_tok, s,
+                        max_new_tokens, k, eos_token_id, proposer):
+    """Drive speculative generation: per round, draft k tokens per row
+    (n-gram prompt lookup by default), ONE verify dispatch scores all
+    k+1 positions, and every row advances by the BATCH-MIN accepted
+    prefix + 1 (a uniform advance keeps the scalar ``cur_len`` the
+    dense cache-write contract needs; the serving engine's per-slot
+    ragged accept lives in inference/serving.py). Token-exact vs the
+    plain loop: accepted drafts EQUAL the argmax by construction, and
+    the tail (< k+1 positions of budget left) falls back to single-step
+    decode. Returns the [B] per-position token arrays (host int32)."""
+    from .. import to_tensor
+    from ..inference.speculative import accept_length
+
+    b = int(input_ids.shape[0])
+    prompt_np = np.asarray(
+        input_ids.numpy() if hasattr(input_ids, "numpy") else input_ids,
+        np.int32)
+    first_np = np.asarray(first_tok.numpy(), np.int32).reshape(b)
+    hist = [list(prompt_np[r]) + [int(first_np[r])] for r in range(b)]
+    finished = np.zeros((b,), bool)
+    if eos_token_id is not None:
+        finished |= first_np == eos_token_id
+    out = [first_np]
+    done = 1
+    while done < max_new_tokens:
+        if eos_token_id is not None and finished.all():
+            while done < max_new_tokens:  # frozen rows: no dispatches
+                out.append(out[-1])
+                done += 1
+            break
+        cur = s + done - 1  # position of the token out[-1] writes
+        # tail: a k+1-wide verify would write KV past max_len (the
+        # dense cache's dynamic_update_slice would SHIFT the window)
+        no_spec = done + k > max_new_tokens
+        if not no_spec:
+            drafts = np.zeros((b, k), np.int32)
+            any_draft = False
+            for r in range(b):
+                if finished[r]:
+                    continue  # frozen; full-accept forced below
+                d = np.asarray(proposer.propose(
+                    np.asarray(hist[r], np.int32), k),
+                    np.int32).reshape(-1)[:k]
+                drafts[r, : d.size] = d
+                any_draft = any_draft or d.size > 0
+            # no row has draft signal: a k+1-wide verify would spend
+            # (k+1)x the decode compute to advance ~1 token — take the
+            # plain step instead (the engine path's zero-cost fallback)
+            no_spec = not any_draft
+        if no_spec:
+            tok = decode(to_tensor(out[-1]),
+                         to_tensor(np.asarray(cur, np.int32)))
+            t = np.asarray(tok.numpy(), np.int32).reshape(b)
+            if eos_token_id is not None:
+                t = np.where(finished, eos_token_id, t).astype(np.int32)
+                finished = finished | (t == eos_token_id)
+            for r in range(b):
+                hist[r].append(int(t[r]))
+            out.append(t)
+            done += 1
+            continue
+        ids_step = np.concatenate([out[-1][:, None], drafts], axis=1)
+        toks = verify(to_tensor(ids_step),
+                      to_tensor(np.asarray(cur, np.int32)))
+        toks_np = np.asarray(toks.numpy(), np.int32)  # [B, k+1]
+        # batch-min accept: rows that accepted more re-propose next
+        # round (still exact — an accepted prefix of a correct prefix
+        # is correct); finished rows must not drag the minimum down.
+        # ONE implementation of the exactness-critical accept rule:
+        # speculative.accept_length (the engine's device cumprod is
+        # pinned against it in tests)
+        acc = np.asarray([
+            k if finished[r]
+            else accept_length(drafts[r], toks_np[r, :-1])
+            for r in range(b)])
+        m = min(int(acc.min()) + 1, max_new_tokens - done)
+        for j in range(m):
+            t = toks_np[:, j]
+            if eos_token_id is not None:
+                t = np.where(finished, eos_token_id, t)
+                finished = finished | (t == eos_token_id)
+            for r in range(b):
+                hist[r].append(int(t[r]))
+            out.append(t.astype(np.int32))
+        done += m
+    return out
+
+
 def generate(model, input_ids, max_new_tokens: int = 32,
              temperature: float = 0.0, top_k: int = 0,
              eos_token_id: Optional[int] = None, use_jit: bool = True,
              block_size: Optional[int] = None,
-             decode_chunk: Optional[int] = None):
+             decode_chunk: Optional[int] = None,
+             kv_dtype: Optional[str] = None,
+             speculative_k: Optional[int] = None,
+             draft_proposer=None):
     """Generate ``max_new_tokens`` continuations of ``input_ids``
     ([B, S] int Tensor) with KV caching. Returns [B, S + new] ids.
 
@@ -280,13 +420,35 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     device) — the serving idiom when host↔device latency dominates
     per-token dispatch. Token-identical to the per-token loop; eos rows
     freeze in-program, and generation stops at the first chunk whose
-    rows are all finished."""
+    rows are all finished.
+
+    ``speculative_k=K`` turns on self-speculative decoding (greedy
+    only): a :class:`~paddle_tpu.inference.speculative.DraftProposer`
+    (default n-gram prompt lookup — no second model, no extra
+    dispatches) drafts K tokens per round and ONE verify dispatch
+    scores all K+1 positions; rows advance by the batch-min accepted
+    prefix + 1. Token-identical to the plain loop by greedy
+    accept-prefix construction. ``kv_dtype="int8"`` (requires
+    ``block_size``) quantizes the KV pools per block — both levers
+    compose."""
     from .. import to_tensor
     from ..base.tape import no_grad
 
     b, s = input_ids.shape
     if max_new_tokens <= 0:
         return input_ids
+    if speculative_k is not None:
+        if int(speculative_k) < 1:
+            raise ValueError(
+                f"speculative_k must be >= 1, got {speculative_k}")
+        if temperature != 0:
+            raise ValueError(
+                "speculative decoding is greedy-only: the accept rule "
+                "is argmax-prefix equality (temperature must be 0)")
+        if decode_chunk:
+            raise ValueError(
+                "speculative_k and decode_chunk are alternative decode "
+                "drivers — pass one, not both")
     max_len = s + max_new_tokens
     limit = getattr(getattr(model, "config", None), "max_position_embeddings", None)
     if limit is not None and max_len > limit:
@@ -298,12 +460,37 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     was_training = model.training
     model.eval()
     chunked = bool(decode_chunk) and use_jit and max_new_tokens > 2
+    spec = None if speculative_k is None else min(
+        int(speculative_k), max(max_new_tokens - 1, 1))
     try:
         with no_grad():
+            if spec is not None:
+                from ..inference.speculative import NgramProposer
+
+                state, prefill, decode, verify = _get_compiled(
+                    model, b, s, max_len, temperature, top_k, use_jit,
+                    block_size=block_size, eos_token_id=eos_token_id,
+                    kv_dtype=kv_dtype, spec_k=spec,
+                )
+                zero = to_tensor(np.asarray(0, np.int32))
+                tok = prefill(input_ids, zero)
+                out = _decode_speculative(
+                    decode, verify, input_ids, tok, s, max_new_tokens,
+                    spec, eos_token_id,
+                    draft_proposer if draft_proposer is not None
+                    else NgramProposer(),
+                )
+                from ..tensor.manipulation import concat
+
+                new_tokens = to_tensor(
+                    np.stack(out, axis=1).astype(np.int32))  # [B, new]
+                return concat(
+                    [input_ids, new_tokens.astype(input_ids.dtype)], axis=1
+                )
             state, prefill, decode = _get_compiled(
                 model, b, s, max_len, temperature, top_k, use_jit,
                 block_size=block_size, chunked=chunked,
-                eos_token_id=eos_token_id,
+                eos_token_id=eos_token_id, kv_dtype=kv_dtype,
             )
             zero = to_tensor(np.asarray(0, np.int32))
             tok = prefill(input_ids, zero)

@@ -199,10 +199,11 @@ class GPTForCausalLM(nn.Layer):
         return self.lm_head(self.transformer(input_ids))
 
     def init_cache(self, batch: int, max_len: int, dtype=None,
-                   block_size=None, num_blocks=None, tables=None):
+                   block_size=None, num_blocks=None, tables=None,
+                   kv_dtype=None):
         """Dense caches by default; ``block_size`` switches to the paged
         (block-table) layout (ops/paged_attention.py) — same protocol as
-        LlamaForCausalLM.init_cache."""
+        LlamaForCausalLM.init_cache (incl. ``kv_dtype="int8"``)."""
         c = self.config
         dt = dtype or self.transformer.wte.weight.dtype
         head_dim = c.hidden_size // c.num_attention_heads
@@ -212,8 +213,12 @@ class GPTForCausalLM(nn.Layer):
             return alloc_paged_kv_caches(
                 c.num_hidden_layers, batch, max_len, c.num_attention_heads,
                 head_dim, dt, block_size=block_size, num_blocks=num_blocks,
-                tables=tables,
+                tables=tables, kv_dtype=kv_dtype,
             )
+        if kv_dtype is not None:
+            raise ValueError(
+                "kv_dtype quantization requires the paged cache "
+                "(pass block_size)")
         from .generation import alloc_kv_caches
 
         return alloc_kv_caches(

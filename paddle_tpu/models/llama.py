@@ -248,10 +248,12 @@ class LlamaForCausalLM(nn.Layer):
     # -- KV-cache generation (see models/generation.py) -----------------
     def init_cache(self, batch: int, max_len: int, dtype=None,
                    block_size: Optional[int] = None, num_blocks=None,
-                   tables=None):
+                   tables=None, kv_dtype: Optional[str] = None):
         """Dense caches by default; pass ``block_size`` for a paged
         (block-table) cache (ref: block_multihead_attention serving
-        layout — see ops/paged_attention.py)."""
+        layout — see ops/paged_attention.py). ``kv_dtype="int8"``
+        (paged only) quantizes the KV pools with per-block scale
+        pools."""
         c = self.config
         dt = dtype or self.llama.embed_tokens.weight.dtype
         head_dim = c.hidden_size // c.num_attention_heads
@@ -261,8 +263,12 @@ class LlamaForCausalLM(nn.Layer):
             return alloc_paged_kv_caches(
                 c.num_hidden_layers, batch, max_len, c.num_key_value_heads,
                 head_dim, dt, block_size=block_size, num_blocks=num_blocks,
-                tables=tables,
+                tables=tables, kv_dtype=kv_dtype,
             )
+        if kv_dtype is not None:
+            raise ValueError(
+                "kv_dtype quantization requires the paged cache "
+                "(pass block_size)")
         from .generation import alloc_kv_caches
 
         return alloc_kv_caches(

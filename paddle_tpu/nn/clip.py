@@ -16,6 +16,11 @@ from ..base.tape import apply
 __all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm", "clip_grad_norm_", "clip_grad_value_"]
 
 
+def _sq_sum(g):
+    return apply(lambda a: jnp.sum(jnp.square(a.astype(jnp.float32))), g,
+                 op_name="sq_sum")
+
+
 class ClipGradBase:
     def __call__(self, params_grads):
         return self._clip(params_grads)
@@ -64,20 +69,21 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
         self.group_name = group_name
 
+    def _total_sq(self, clippable):
+        """Total fp32 squared grad norm — the aggregation seam
+        expert-parallel variants override (moe.ClipGradForMOEByGlobalNorm
+        allreduces the expert share over the ep group before summing)."""
+        sq_sums = [_sq_sum(g) for _, g in clippable]
+        total = sq_sums[0]
+        for s in sq_sums[1:]:
+            total = total + s
+        return total
+
     def _clip(self, params_grads):
         clippable = [(p, g) for p, g in params_grads if g is not None and getattr(p, "need_clip", True)]
         if not clippable:
             return params_grads
-        grads = [g for _, g in clippable]
-
-        def _sq(a):
-            return jnp.sum(jnp.square(a.astype(jnp.float32)))
-
-        sq_sums = [apply(_sq, g, op_name="sq_sum") for g in grads]
-        total = sq_sums[0]
-        for s in sq_sums[1:]:
-            total = total + s
-        global_norm = apply(lambda t: jnp.sqrt(t), total, op_name="global_norm")
+        global_norm = apply(lambda t: jnp.sqrt(t), self._total_sq(clippable), op_name="global_norm")
         scale = apply(
             lambda n: jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0),
             global_norm,

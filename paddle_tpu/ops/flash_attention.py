@@ -38,6 +38,11 @@ NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() exact zero
 # names either way, so the module doubles as the enum
 _SEM = getattr(pltpu, "GridDimensionSemantics", pltpu)
 
+# same jax-version bridge for the compiler-params dataclass (renamed
+# TPUCompilerParams -> CompilerParams across jax releases)
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 
 def _block(size: int) -> int:
     """Largest MXU-friendly block dividing ``size``."""
@@ -149,7 +154,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, interpret: bool):
             pltpu.VMEM((bq, 128), jnp.float32),  # running denom
             pltpu.VMEM((bq, d), jnp.float32),    # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=(
                 _SEM.PARALLEL, _SEM.PARALLEL, _SEM.PARALLEL, _SEM.ARBITRARY,
             ),
@@ -280,7 +285,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale: float, causal: bool, interpret: boo
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda b, hh, iq, ik: (b, hh, iq, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=(
                 _SEM.PARALLEL, _SEM.PARALLEL, _SEM.PARALLEL, _SEM.ARBITRARY,
             ),
@@ -311,7 +316,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale: float, causal: bool, interpret: boo
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=(
                 _SEM.PARALLEL, _SEM.PARALLEL, _SEM.PARALLEL, _SEM.ARBITRARY,
             ),

@@ -31,6 +31,24 @@ Design:
 The memory win over the dense [B, max_len, ...] cache: the pool is
 sized by blocks actually needed (sum of ceil(len/bs)), not
 B * max_len, and freed sequences return blocks to the pool.
+
+Int8 KV quantization (``kv_dtype="int8"``): pools store int8 values
+plus PER-BLOCK SCALE POOLS [kv_heads, num_blocks, block_size] holding
+one absmax scale per cached token per head — halving KV bytes (the
+decode roofline at serving batch sizes is KV-bandwidth bound, so bytes
+are throughput). Scales live in pool rows indexed by the SAME physical
+block ids as the values, so BlockManager ``fork``/``adopt`` and the
+PrefixCache carry them with the block for free — COW and prefix reuse
+work unchanged. Writes quantize in the same scatter (amax over
+head_dim per new token: a single per-block scale would force a
+read-modify-write requantization of the whole block every time a new
+token raised its amax — per-entry scales keep the write an O(s)
+scatter); reads dequantize in-register: the TPU Pallas decode kernel
+takes ``QuantizedTensor`` pages natively, and the gather/prefill path
+multiplies scales back after the gather. The quantization convention
+(q = rint(x * 127.5 / amax), dequant = q * amax / 127.5) matches
+jax.experimental.pallas.ops.tpu.paged_attention.quantization_utils so
+both paths decode the same bytes identically.
 """
 from __future__ import annotations
 
@@ -43,6 +61,7 @@ import numpy as np
 __all__ = [
     "PagedLayerCache", "BlockManager", "PrefixCache", "contiguous_tables",
     "alloc_paged_kv_caches", "paged_update_kv_cache", "paged_gather_kv",
+    "paged_write_kv", "paged_decode_attention",
 ]
 
 
@@ -53,12 +72,23 @@ class PagedLayerCache(NamedTuple):
     table is the identity layout (sequence b owns blocks
     [b*n, (b+1)*n)) — generate()'s case — unlocking the reshape-view
     attention path that skips both the fancy-index gather and the
-    Pallas kernel's per-page DMAs."""
+    Pallas kernel's per-page DMAs.
+
+    ``k_scale``/``v_scale`` (None for float pools) are the int8-KV
+    per-block scale pools [kv_heads, num_blocks, block_size]: one
+    absmax per cached token per head, row-indexed by the same physical
+    block ids as the value pools."""
 
     k_pool: object  # Tensor [kv_heads, num_blocks, block_size, head_dim]
     v_pool: object
     block_tables: object  # Tensor [batch, max_blocks_per_seq] int32
     contiguous: bool = False
+    k_scale: object = None  # Tensor [kv_heads, num_blocks, block_size]
+    v_scale: object = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
 def contiguous_tables(batch: int, max_len: int, block_size: int) -> np.ndarray:
@@ -389,10 +419,17 @@ def alloc_paged_kv_caches(
     head_dim: int, dtype, block_size: int = 64,
     num_blocks: Optional[int] = None,
     tables: Optional[np.ndarray] = None,
+    kv_dtype: Optional[str] = None,
 ) -> List[PagedLayerCache]:
-    """Per-layer paged caches with a shared block table."""
+    """Per-layer paged caches with a shared block table.
+
+    ``kv_dtype="int8"`` allocates int8 value pools plus per-block f32
+    scale pools (see module docstring); ``dtype`` then only sets the
+    COMPUTE dtype reads dequantize into."""
     from ..base.tensor import Tensor
 
+    if kv_dtype not in (None, "int8"):
+        raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
     per_seq = -(-max_len // block_size)
     if tables is None:
         tables = contiguous_tables(batch, max_len, block_size)
@@ -405,18 +442,51 @@ def alloc_paged_kv_caches(
     if num_blocks is None:
         num_blocks = int(tables.max()) + 1
     tables_t = Tensor(jnp.asarray(tables, jnp.int32), _internal=True)
+    pool_dt = jnp.int8 if kv_dtype == "int8" else dtype
     caches = []
     for _ in range(num_layers):
         k = Tensor(
-            jnp.zeros((num_kv_heads, num_blocks, block_size, head_dim), dtype),
+            jnp.zeros((num_kv_heads, num_blocks, block_size, head_dim),
+                      pool_dt),
             _internal=True,
         )
         v = Tensor(
-            jnp.zeros((num_kv_heads, num_blocks, block_size, head_dim), dtype),
+            jnp.zeros((num_kv_heads, num_blocks, block_size, head_dim),
+                      pool_dt),
             _internal=True,
         )
-        caches.append(PagedLayerCache(k, v, tables_t, is_contig))
+        if kv_dtype == "int8":
+            ks = Tensor(jnp.zeros((num_kv_heads, num_blocks, block_size),
+                                  jnp.float32), _internal=True)
+            vs = Tensor(jnp.zeros((num_kv_heads, num_blocks, block_size),
+                                  jnp.float32), _internal=True)
+            caches.append(
+                PagedLayerCache(k, v, tables_t, is_contig, ks, vs))
+        else:
+            caches.append(PagedLayerCache(k, v, tables_t, is_contig))
     return caches
+
+
+# int8 KV convention — MUST match the Pallas paged-attention kernel's
+# quantization_utils (MAX_INT8 = 127.5; dequant = q * amax / 127.5) so
+# the kernel's in-register dequant and the gather fallback agree
+# bit-for-bit on the same pool bytes. The clip keeps the amax element
+# itself from rounding to +128 and wrapping in int8.
+_KV_QMAX = 127.5
+
+
+def _kv_quantize(x):
+    """[B, s, kvh, D] float -> (int8 values, per-token amax [B, s, kvh])."""
+    h = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    h = jnp.maximum(h, 1e-8)
+    q = jnp.clip(jnp.rint(x.astype(jnp.float32) * (_KV_QMAX / h[..., None])),
+                 -127, 127).astype(jnp.int8)
+    return q, h
+
+
+def _kv_dequantize(q, h, dtype):
+    """Invert :func:`_kv_quantize`: ``h`` broadcasts over head_dim."""
+    return (q.astype(jnp.float32) * (h[..., None] / _KV_QMAX)).astype(dtype)
 
 
 def _validate_cache_len(cl, b: int):
@@ -439,27 +509,46 @@ def _per_seq_positions(cl, b: int, s: int):
     return cl[:, None] + jnp.arange(s)[None, :]
 
 
-def paged_write_kv(kk, vv, k_pool, v_pool, tables, cl, s: int):
-    """Scatter s new tokens (starting at position ``cl``, scalar or
-    per-sequence [B]) into the [kvh, blocks, bs, D] pools; returns the
-    updated pools."""
-    bs = k_pool.shape[2]
-    b = kk.shape[0]
+def _write_positions(tables, cl, b: int, s: int, bs: int, pool_rows: int):
+    """(phys, off) [B, s] scatter targets with OOB lanes routed past
+    the pool. Padded lanes can run PAST the table row (a fixed-width
+    prefill starting at a nonzero offset — the prefix-cache hit path —
+    or a chunk tail near max_len). take_along_axis would CLAMP them
+    onto the row's last entry, aliasing the garbage onto a real block's
+    early offsets; route them to an out-of-range pool row instead so
+    the scatter DROPS them (jax .at[].set drops OOB updates)."""
     positions = _per_seq_positions(cl, b, s)  # [B, s]
     logical = positions // bs  # [B, s]
     off = positions % bs  # [B, s]
-    # Padded lanes can run PAST the table row (a fixed-width prefill
-    # starting at a nonzero offset — the prefix-cache hit path — or a
-    # chunk tail near max_len). take_along_axis would CLAMP them onto
-    # the row's last entry, aliasing the garbage onto a real block's
-    # early offsets; route them to an out-of-range pool row instead so
-    # the scatter DROPS them (jax .at[].set drops OOB updates).
     nbt = tables.shape[1]
     phys = jnp.take_along_axis(
         tables, jnp.minimum(logical, nbt - 1), axis=1)  # [B, s]
-    phys = jnp.where(logical < nbt, phys, k_pool.shape[1])
+    phys = jnp.where(logical < nbt, phys, pool_rows)
+    return phys, off
+
+
+def paged_write_kv(kk, vv, k_pool, v_pool, tables, cl, s: int,
+                   k_scale=None, v_scale=None):
+    """Scatter s new tokens (starting at position ``cl``, scalar or
+    per-sequence [B]) into the [kvh, blocks, bs, D] pools; returns the
+    updated pools. With int8 pools pass the scale pools — new tokens
+    quantize in the same scatter and the 4-tuple
+    ``(k_pool, v_pool, k_scale, v_scale)`` comes back."""
+    bs = k_pool.shape[2]
+    b = kk.shape[0]
+    phys, off = _write_positions(tables, cl, b, s, bs, k_pool.shape[1])
     # consecutive advanced indices (dims 1,2) keep their position, so
     # the value layout is [kvh, B, s, D]
+    if k_scale is not None:
+        qk, hk = _kv_quantize(kk)
+        qv, hv = _kv_quantize(vv)
+        k_pool = k_pool.at[:, phys, off].set(jnp.moveaxis(qk, 2, 0))
+        v_pool = v_pool.at[:, phys, off].set(jnp.moveaxis(qv, 2, 0))
+        k_scale = k_scale.at[:, phys, off].set(
+            jnp.moveaxis(hk, 2, 0).astype(k_scale.dtype))
+        v_scale = v_scale.at[:, phys, off].set(
+            jnp.moveaxis(hv, 2, 0).astype(v_scale.dtype))
+        return k_pool, v_pool, k_scale, v_scale
     k_pool = k_pool.at[:, phys, off].set(
         jnp.moveaxis(kk.astype(k_pool.dtype), 2, 0)
     )
@@ -470,38 +559,69 @@ def paged_write_kv(kk, vv, k_pool, v_pool, tables, cl, s: int):
 
 
 def paged_update_kv_cache(kk, vv, k_pool, v_pool, tables, cl, s: int,
-                          contiguous: bool = False):
+                          contiguous: bool = False,
+                          k_scale=None, v_scale=None):
     """Scatter + gather protocol for PREFILL (or the non-TPU fallback):
     returns (k_pool, v_pool, kc_view, vc_view, mask) where the views
     are the gathered [B, max_len, kv_heads, head_dim] caches and the
     mask is identical to the dense ``update_kv_cache`` mask — raw jnp
-    arrays, same protocol as generation.update_kv_cache."""
-    k_pool, v_pool = paged_write_kv(kk, vv, k_pool, v_pool, tables, cl, s)
-    kc, vc = paged_gather_kv(k_pool, v_pool, tables, contiguous=contiguous)
+    arrays, same protocol as generation.update_kv_cache. With int8
+    pools (scales passed) the views come back DEQUANTIZED to ``kk``'s
+    dtype and the return grows to
+    ``(k_pool, v_pool, k_scale, v_scale, kc, vc, mask)``."""
+    if k_scale is not None:
+        k_pool, v_pool, k_scale, v_scale = paged_write_kv(
+            kk, vv, k_pool, v_pool, tables, cl, s,
+            k_scale=k_scale, v_scale=v_scale)
+        kc, vc = paged_gather_kv(
+            k_pool, v_pool, tables, contiguous=contiguous,
+            k_scale=k_scale, v_scale=v_scale, out_dtype=kk.dtype)
+    else:
+        k_pool, v_pool = paged_write_kv(
+            kk, vv, k_pool, v_pool, tables, cl, s)
+        kc, vc = paged_gather_kv(k_pool, v_pool, tables,
+                                 contiguous=contiguous)
     max_len = kc.shape[1]
     b = kk.shape[0]
     q_pos = _per_seq_positions(cl, b, s)  # [B, s]
     # [B, 1, s, max_len] causal mask (broadcasts over heads)
     mask = jnp.arange(max_len)[None, None, None, :] <= q_pos[:, None, :, None]
+    if k_scale is not None:
+        return k_pool, v_pool, k_scale, v_scale, kc, vc, mask
     return k_pool, v_pool, kc, vc, mask
 
 
-def paged_gather_kv(k_pool, v_pool, tables, contiguous: bool = False):
+def paged_gather_kv(k_pool, v_pool, tables, contiguous: bool = False,
+                    k_scale=None, v_scale=None, out_dtype=None):
     """[B, max_blocks] tables -> padded [B, max_blocks*bs, kvh, D] views.
 
     ``contiguous=True`` (identity table layout — generate()'s case)
     replaces the fancy-index gather with a reshape+transpose XLA fuses
     into the consumer: pool rows [b*per, (b+1)*per) ARE sequence b's
     blocks in order, so ``k_pool[:, tables]`` is exactly
-    ``k_pool.reshape(kvh, B, per*bs, d)``."""
+    ``k_pool.reshape(kvh, B, per*bs, d)``.
+
+    Int8 pools (scales passed): the gathered views dequantize to
+    ``out_dtype`` (the scales gather through the same table
+    indexing — a freed/forked block's scales travel with its bytes)."""
     b, nb = tables.shape
     kvh, _, bs, d = k_pool.shape
     if contiguous and k_pool.shape[1] == b * nb:
         kc = jnp.moveaxis(k_pool.reshape(kvh, b, nb * bs, d), 0, 2)
         vc = jnp.moveaxis(v_pool.reshape(kvh, b, nb * bs, d), 0, 2)
+        if k_scale is not None:
+            sk = jnp.moveaxis(k_scale.reshape(kvh, b, nb * bs), 0, 2)
+            sv = jnp.moveaxis(v_scale.reshape(kvh, b, nb * bs), 0, 2)
+            kc = _kv_dequantize(kc, sk, out_dtype or jnp.float32)
+            vc = _kv_dequantize(vc, sv, out_dtype or jnp.float32)
         return kc, vc
     kc = jnp.moveaxis(k_pool[:, tables], 0, 3).reshape(b, nb * bs, kvh, d)
     vc = jnp.moveaxis(v_pool[:, tables], 0, 3).reshape(b, nb * bs, kvh, d)
+    if k_scale is not None:
+        sk = jnp.moveaxis(k_scale[:, tables], 0, 3).reshape(b, nb * bs, kvh)
+        sv = jnp.moveaxis(v_scale[:, tables], 0, 3).reshape(b, nb * bs, kvh)
+        kc = _kv_dequantize(kc, sk, out_dtype or jnp.float32)
+        vc = _kv_dequantize(vc, sv, out_dtype or jnp.float32)
     return kc, vc
 
 
@@ -526,7 +646,28 @@ def paged_attention_step(q, k, v, cache: "PagedLayerCache", cur_len, s: int,
     from ..base.tape import apply
 
     contiguous = bool(getattr(cache, "contiguous", False))
+    quant = getattr(cache, "k_scale", None) is not None
     if s == 1:
+        if quant:
+            def pstep_decode_q(qq, kk, vv, kp, vp, ks, vs, tbl, cl):
+                if rope_fn is not None:
+                    qq, kk = rope_fn(qq, kk, cl)
+                kp, vp, ks, vs = paged_write_kv(
+                    kk, vv, kp, vp, tbl, cl, 1, k_scale=ks, v_scale=vs)
+                out = paged_decode_attention(
+                    qq, kp, vp, tbl, cl, contiguous=contiguous,
+                    k_scale=ks, v_scale=vs)
+                return out, kp, vp, ks, vs
+
+            out, k_pool, v_pool, ks, vs = apply(
+                pstep_decode_q, q, k, v, cache.k_pool, cache.v_pool,
+                cache.k_scale, cache.v_scale, cache.block_tables, cur_len,
+                op_name="paged_decode",
+            )
+            return out, PagedLayerCache(
+                k_pool, v_pool, cache.block_tables, contiguous, ks, vs
+            )
+
         def pstep_decode(qq, kk, vv, kp, vp, tbl, cl):
             if rope_fn is not None:
                 qq, kk = rope_fn(qq, kk, cl)
@@ -542,6 +683,24 @@ def paged_attention_step(q, k, v, cache: "PagedLayerCache", cur_len, s: int,
         )
         return out, PagedLayerCache(
             k_pool, v_pool, cache.block_tables, contiguous
+        )
+
+    if quant:
+        def pstep_q(qq, kk, vv, kp, vp, ks, vs, tbl, cl):
+            if rope_fn is not None:
+                qq, kk = rope_fn(qq, kk, cl)
+            kp, vp, ks, vs, kc, vc, mask = paged_update_kv_cache(
+                kk, vv, kp, vp, tbl, cl, s, contiguous=contiguous,
+                k_scale=ks, v_scale=vs)
+            return qq, kp, vp, ks, vs, kc, vc, mask
+
+        q_t, k_pool, v_pool, ks, vs, kc, vc, mask = apply(
+            pstep_q, q, k, v, cache.k_pool, cache.v_pool,
+            cache.k_scale, cache.v_scale, cache.block_tables, cur_len,
+            op_name="paged_kv_cache_update",
+        )
+        return q_t, kc, vc, mask, PagedLayerCache(
+            k_pool, v_pool, cache.block_tables, contiguous, ks, vs
         )
 
     def pstep(qq, kk, vv, kp, vp, tbl, cl):
@@ -569,7 +728,8 @@ def _largest_divisor(n: int, cap: int) -> int:
 
 
 def paged_decode_attention(q, k_pool, v_pool, tables, cache_len,
-                           contiguous: bool = False):
+                           contiguous: bool = False,
+                           k_scale=None, v_scale=None):
     """Single-token decode attention over the paged cache.
 
     q: [B, 1, num_heads, D]; pools [kvh, blocks, bs, D]; cache_len:
@@ -601,7 +761,13 @@ def paged_decode_attention(q, k_pool, v_pool, tables, cache_len,
       paged layout exists to avoid; the kernel reads only live pages.
       The gather runs only when the kernel can't tile (head_dim %
       128 or block_size % 8) or off-TPU. All paths are
-      token-identical."""
+      token-identical.
+
+    Int8 pools (``k_scale``/``v_scale`` passed): the kernel path wraps
+    the pools + scale pools as ``QuantizedTensor`` pages — the Pallas
+    kernel dequantizes in-register per page DMA (same convention, see
+    ``_KV_QMAX``) — and the gather fallback dequantizes the gathered
+    view to ``q.dtype``."""
     b, s, h, d = q.shape
     assert s == 1, "paged_decode_attention is the s==1 decode path"
     cache_len = _validate_cache_len(cache_len, b)
@@ -621,12 +787,23 @@ def paged_decode_attention(q, k_pool, v_pool, tables, cache_len,
             paged_attention as _paged_attention_kernel,
         )
 
+        k_pages, v_pages = k_pool, v_pool
+        if k_scale is not None:
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                quantization_utils as _qu,
+            )
+
+            # scales gain the kernel's trailing keepdims axis; the
+            # kernel DMAs the scale page alongside the value page and
+            # dequantizes in-register (from_int8: q * h / 127.5)
+            k_pages = _qu.QuantizedTensor(k_pool, k_scale[..., None])
+            v_pages = _qu.QuantizedTensor(v_pool, v_scale[..., None])
         lengths = jnp.broadcast_to(cache_len + 1, (b,)).astype(jnp.int32)
         pages_per_seq = tables.shape[1]
         scale = jnp.asarray(1.0 / np.sqrt(d), q.dtype)
         out = _paged_attention_kernel(
             q[:, 0] * scale,  # kernel applies no 1/sqrt(d) itself
-            k_pool, v_pool,
+            k_pages, v_pages,
             lengths, tables,
             pages_per_compute_block=_largest_divisor(pages_per_seq, 8),
         )
@@ -636,7 +813,9 @@ def paged_decode_attention(q, k_pool, v_pool, tables, cache_len,
     # (keeps paged-vs-dense parity by construction)
     from ..nn.functional.attention import _naive_attention
 
-    kc, vc = paged_gather_kv(k_pool, v_pool, tables, contiguous=contiguous)
+    kc, vc = paged_gather_kv(k_pool, v_pool, tables, contiguous=contiguous,
+                             k_scale=k_scale, v_scale=v_scale,
+                             out_dtype=q.dtype)
     max_len = kc.shape[1]
     # [B or 1, 1, 1, S] — per-sequence lengths mask their own tails
     mask = (

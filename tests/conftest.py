@@ -84,6 +84,10 @@ def pytest_configure(config):
         "markers",
         "cluster: replica-router / prefix-cache / multi-process serving "
         "suite (standalone via `pytest -m cluster`)")
+    config.addinivalue_line(
+        "markers",
+        "spec: speculative-decoding + int8-KV quick lane "
+        "(standalone via `pytest -m spec`)")
 
 
 def pytest_collection_modifyitems(config, items):
