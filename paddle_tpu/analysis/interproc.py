@@ -100,8 +100,14 @@ _EXTRA_COLLECTIVES = {"reduce", "gather", "alltoall_single",
                       "eager_p2p"}
 _COLL_OPS = set(_COLLECTIVES) | _EXTRA_COLLECTIVES
 
-_SEND_TAILS = {"send", "isend", "eager_send"}
-_RECV_TAILS = {"recv", "irecv", "eager_recv"}
+# send_handoff/recv_handoff: the disagg KV-handoff legs
+# (inference/disagg.py) — cross-ROLE p2p, so effect summaries carry
+# them like send/recv (unambiguous names: no dist-ish receiver needed,
+# same as eager_send/eager_recv)
+_SEND_TAILS = {"send", "isend", "eager_send", "send_handoff"}
+_RECV_TAILS = {"recv", "irecv", "eager_recv", "recv_handoff"}
+_UNAMBIGUOUS_P2P = {"eager_send", "eager_recv",
+                    "send_handoff", "recv_handoff"}
 _PEER_KWARGS = ("dst", "src", "peer")
 
 _TIMEOUTISH = re.compile(r"timeout|deadline|budget", re.I)
@@ -560,9 +566,9 @@ class _FnSummarizer:
             return CollEffect(tail, line, col)
 
         distish = not prefix or bool(_DISTISH.search(prefix))
-        if tail in _SEND_TAILS and (distish or tail == "eager_send"):
+        if tail in _SEND_TAILS and (distish or tail in _UNAMBIGUOUS_P2P):
             return P2PEffect("send", _peer_of(call, tail), line, col)
-        if tail in _RECV_TAILS and (distish or tail == "eager_recv"):
+        if tail in _RECV_TAILS and (distish or tail in _UNAMBIGUOUS_P2P):
             return P2PEffect("recv", _peer_of(call, tail), line, col)
 
         blocked = self._blocking(call, d, tail, prefix, in_loop)

@@ -56,8 +56,12 @@ __all__ = [
 
 # ops whose signatures are legitimately rank-divergent (the two
 # endpoints of a transfer record mirrored entries) — the cross-rank
-# contract skips them; COLL003 owns their static pairing
-_RANK_DIVERGENT_OPS = ("send", "recv")
+# contract skips them; COLL003 owns their static pairing. The disagg
+# KV-handoff legs (inference/disagg.py) are the cross-ROLE analogue:
+# the prefill side records handoff_send where the decode side records
+# handoff_recv, so a hang dump can name both roles' schedules without
+# the contract calling the asymmetry a divergence.
+_RANK_DIVERGENT_OPS = ("send", "recv", "handoff_send", "handoff_recv")
 
 
 @dataclass(frozen=True)
@@ -371,6 +375,19 @@ def _hang_dump_exchange(store, rank: int, world_size: int,
             out.append(
                 "published schedules agree — the hang is not a "
                 "schedule divergence among the ranks above")
+            # still print WHAT each rank issued: for a cross-role hang
+            # (disagg handoff legs are rank-divergent and excluded from
+            # the diff) the peer's last ops are the evidence — e.g. a
+            # decode worker stuck because the prefill role stopped
+            # sending shows exactly where the sender's schedule ends
+            out.append("published schedules:")
+            for r in sorted(schedules):
+                out.append(f"  rank {r}:")
+                entries = schedules[r]
+                if not entries:
+                    out.append("    (no collectives recorded)")
+                for sig in entries:
+                    out.append(f"    {sig.format()}")
         file.write("\n".join(out) + "\n")
     except Exception as e:  # noqa: BLE001 — diagnostics must not raise
         try:

@@ -20,9 +20,12 @@ auth, do not expose the port beyond the cluster network.
 """
 from __future__ import annotations
 
+import base64
+import binascii
 import json
 import os
 import socket
+import struct
 import threading
 import time
 import urllib.parse
@@ -31,7 +34,18 @@ from typing import Dict, List, Optional
 from ..testing import chaos as _chaos
 from ..utils.retries import Deadline, RetryPolicy
 
-__all__ = ["KVStore", "FileKVStore", "TCPKVStore", "TCPStoreServer", "make_store"]
+__all__ = [
+    "KVStore", "FileKVStore", "MemKVStore", "TCPKVStore", "TCPStoreServer",
+    "CorruptBlobError", "make_store",
+]
+
+
+class CorruptBlobError(ValueError):
+    """A ``get_bytes`` frame failed its length/CRC32 check. Subclasses
+    ValueError ON PURPOSE: the store retry classifiers already treat
+    ValueError as transient (a truncated line-JSON reply), and a
+    corrupted blob has the same remedy — re-read/re-send — so
+    ``RetryPolicy`` retries it instead of a caller importing garbage."""
 
 
 class KVStore:
@@ -65,6 +79,95 @@ class KVStore:
         (file mtime / server receive time) — so liveness comparisons are
         immune to cross-node wall-clock skew."""
         raise NotImplementedError
+
+    # -- bulk blobs (KV-block handoff hygiene) --------------------------
+    # Store values are strings (the TCP transport is line-JSON), so raw
+    # bytes ride base64 inside a LENGTH-PREFIXED, CRC32-TAILED frame:
+    #
+    #     b64( !I payload_len | payload | !I crc32(payload) )
+    #
+    # get_bytes verifies both before returning — a truncated or
+    # bit-flipped value surfaces as CorruptBlobError (transient) rather
+    # than silently handing garbage to an importer. Implemented on the
+    # base class over set/get so every backend gets the same frame.
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        frame = (struct.pack("!I", len(data)) + data
+                 + struct.pack("!I", binascii.crc32(data) & 0xFFFFFFFF))
+        self.set(key, base64.b64encode(frame).decode("ascii"))
+
+    def get_bytes(self, key: str) -> Optional[bytes]:
+        raw = self.get(key)
+        if raw is None:
+            return None
+        try:
+            frame = base64.b64decode(raw.encode("ascii"), validate=True)
+        except (ValueError, binascii.Error) as e:
+            raise CorruptBlobError(
+                f"blob {key!r}: not a base64 frame ({e})") from None
+        if len(frame) < 8:
+            raise CorruptBlobError(
+                f"blob {key!r}: frame too short ({len(frame)} bytes)")
+        (n,) = struct.unpack("!I", frame[:4])
+        if len(frame) != n + 8:
+            raise CorruptBlobError(
+                f"blob {key!r}: length prefix says {n} payload bytes, "
+                f"frame holds {len(frame) - 8}")
+        payload = frame[4:4 + n]
+        (want,) = struct.unpack("!I", frame[4 + n:])
+        got = binascii.crc32(payload) & 0xFFFFFFFF
+        if got != want:
+            raise CorruptBlobError(
+                f"blob {key!r}: CRC32 mismatch (stored {want:#010x}, "
+                f"computed {got:#010x})")
+        return payload
+
+
+class MemKVStore(KVStore):
+    """In-process dict-backed store: the zero-infrastructure transport
+    for single-process tests and the disagg handoff's in-process-queue
+    mode. Thread-safe; ``dump`` ages come from per-key set times."""
+
+    def __init__(self):
+        self._data: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, value: str) -> None:
+        with self._lock:
+            self._data[key] = (value, time.time())
+
+    def get(self, key: str) -> Optional[str]:
+        with self._lock:
+            ent = self._data.get(key)
+        return None if ent is None else ent[0]
+
+    def keys(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+    def dump(self, prefix: str = "") -> List[tuple]:
+        now = time.time()
+        with self._lock:
+            return [(k, v, now - ts)
+                    for k, (v, ts) in sorted(self._data.items())
+                    if k.startswith(prefix)]
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        with self._lock:
+            cur = int(self._data.get(key, ("0", 0.0))[0]) + amount
+            self._data[key] = (str(cur), time.time())
+            return cur
+
+    def set_if_absent(self, key: str, value: str) -> bool:
+        with self._lock:
+            if key in self._data:
+                return False
+            self._data[key] = (value, time.time())
+            return True
 
 
 class FileKVStore(KVStore):

@@ -116,6 +116,13 @@ def _result(req_id, status: str, out=(), **extra) -> dict:
     return rec
 
 
+# the disagg handoff router (inference/disagg.py) speaks the same
+# record/result/remaining-budget wire contract — one format from the
+# cluster router through the journal to the prefill/decode pools
+result_record = _result
+remaining_budget = _remaining_budget
+
+
 # ---------------------------------------------------------------------------
 # Replica transports
 

@@ -83,6 +83,26 @@ so prefix reuse and cluster routing work unchanged. Both levers
 compose: the verify dispatch reads/writes the quantized pools like any
 other phase.
 
+Disaggregated prefill/decode (``role=``, ISSUE 8): production stacks
+separate the two phases into POOLS (DistServe-style) so a 4096-token
+prefill never shares a compiled program or a batch with latency-
+critical decode. ``role="prefill_only"`` turns this engine into a
+prefill worker: prompts admit and prefill exactly as before, but when
+a prompt's last chunk lands the slot is RELEASED and the request parks
+in the handoff-ready set (first generated token already attached —
+it came from the prefill logits) with its KV blocks still allocated;
+``drain_prefilled()`` + ``export_kv()`` + ``release_handoff()`` are
+the handoff layer's pickup counter (see inference/disagg.py).
+``role="decode_only"`` marks a decode worker: ``import_kv()`` places
+an exported prompt's blocks into this engine's own pool + a free slot
+and resumes decode at the cached offset. A decode-role engine keeps
+the FULL prefill machinery — when the prefill pool is down, the
+handoff router submits prompts to it directly and it serves them
+colocated (chunked prefill), the measured graceful-degradation path.
+Token-exactness across the boundary is by construction: the exported
+bytes ARE the prefill engine's pool rows, and decode attends only
+positions its own dispatches wrote or the import placed.
+
 Greedy decoding (temperature 0) — matching models.generation.generate's
 default — so engine outputs are token-identical to isolated generate()
 runs, which is the correctness contract the tests assert.
@@ -100,7 +120,12 @@ import numpy as np
 
 from ..base.tape import no_grad
 from ..base.tensor import Tensor
-from ..ops.paged_attention import BlockManager, PagedLayerCache, PrefixCache
+from ..ops.paged_attention import (
+    BlockImportError,
+    BlockManager,
+    PagedLayerCache,
+    PrefixCache,
+)
 from ..testing import chaos as _chaos
 from ..utils.retries import Deadline
 from .admission import (
@@ -212,7 +237,8 @@ class ContinuousBatchingEngine:
                  prefix_cache: bool = False,
                  spec_decode_k: Optional[int] = None,
                  draft_proposer: Optional[DraftProposer] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 role: str = "unified"):
         """``num_blocks`` fixes the HBM budget (the pool allocates one
         extra trash block); ``max_len`` bounds any sequence's positions
         (tables carry ceil(max_len/block_size) slots per row);
@@ -260,6 +286,16 @@ class ContinuousBatchingEngine:
         pools; ~2x KV capacity at an int8-weights-class quality cost —
         the rel-err gate in tests/test_spec_decode.py pins it).
 
+        ``role`` selects the engine's place in a disaggregated
+        deployment (module docstring): "unified" (default — serve
+        everything), "prefill_only" (no decode dispatches; finished
+        prefills park handoff-ready with their blocks held, the first
+        token attached), "decode_only" (a decode worker taking
+        ``import_kv`` handoffs; behaviourally a unified engine, so
+        colocated-fallback prompts still serve). A prefill-only engine
+        reserves NO decode-growth blocks — its block budget is the
+        prompt alone.
+
         ``admission=AdmissionConfig(...)`` turns on overload control:
         submissions run through an :class:`AdmissionController` (shed
         vs admit vs displace), the waiting queue becomes a bounded
@@ -268,6 +304,17 @@ class ContinuousBatchingEngine:
         the queue stays plain FIFO and every submission is accepted —
         the pre-overload-control behaviour, bit for bit.
         """
+        if role not in ("unified", "prefill_only", "decode_only"):
+            raise ValueError(
+                f"role must be 'unified', 'prefill_only' or "
+                f"'decode_only', got {role!r}")
+        self.role = role
+        # finished prefills awaiting export (prefill_only role): req_id
+        # -> GenRequest; the KV blocks stay allocated under the req_id
+        # until export_kv + release_handoff (or expiry/abandon)
+        self._handoff_ready: Dict[object, GenRequest] = {}
+        self.n_imported = 0   # decode side: requests entered via import
+        self.n_handed_off = 0  # prefill side: exports released
         self.model = model
         self.B = int(max_batch)
         self.block_size = int(block_size)
@@ -512,9 +559,12 @@ class ContinuousBatchingEngine:
         FIRST DISPATCH (the decode program's can be many steps after
         step 1 in chunked mode), and multi-second compile latency must
         not be diagnosed as a hang."""
-        need = {"prefill", "decode"}
-        if self.decode_chunk > 1:
-            need.add("decode_chunk")
+        if self.role == "prefill_only":
+            need = {"prefill"}  # this engine never dispatches decode
+        else:
+            need = {"prefill", "decode"}
+            if self.decode_chunk > 1:
+                need.add("decode_chunk")
         return need <= self._phases_run
 
     def add_request(self, req_id, prompt, max_new_tokens: int = 32,
@@ -794,6 +844,14 @@ class ContinuousBatchingEngine:
                 self._tables[slot_idx] = self._trash
                 self._expire(slot.req)
                 slot.req = None
+        # handoff-ready work whose budget lapsed before export: the
+        # blocks recycle and the request closes here — a dead client's
+        # KV must not sit pinned waiting for a transfer nobody needs
+        for rid in [r for r, q in self._handoff_ready.items()
+                    if q.expired()]:
+            req = self._handoff_ready.pop(rid)
+            self.manager.free_sequence(rid)
+            self._expire(req)
 
     @property
     def num_active(self):
@@ -806,6 +864,11 @@ class ContinuousBatchingEngine:
     def _blocks_needed(self, req, max_new_tokens: Optional[int] = None):
         new = req.max_new_tokens if max_new_tokens is None \
             else int(max_new_tokens)
+        if self.role == "prefill_only":
+            # no decode happens here: the block budget is the prompt
+            # alone (padded-prefill writes past the owned blocks land
+            # in the trash row by the OOB-drop scatter contract)
+            new = 0
         if self.chunked:
             total = int(req.prompt.size) + new
         else:
@@ -986,8 +1049,9 @@ class ContinuousBatchingEngine:
                 self.prefix_cache.insert(req.prompt, blocks)
             self._append_token(req, first)
             slot.remaining -= 1
-            if self._finish_if_done(slot_idx, first):
-                continue
+            if not self._finish_if_done(slot_idx, first) \
+                    and self.role == "prefill_only":
+                self._to_handoff(slot_idx)
         return used
 
     def _finish_if_done(self, slot_idx, last_tok) -> bool:
@@ -1001,6 +1065,130 @@ class ContinuousBatchingEngine:
             self._completed[req.req_id] = req
             slot.req = None
         return done
+
+    # -- disaggregated prefill/decode handoff ---------------------------
+    def _to_handoff(self, slot_idx: int) -> None:
+        """Prefill-role slot release: the prompt's KV is complete and
+        the first token attached, so the SLOT frees for the next prompt
+        while the BLOCKS stay allocated under the req_id until
+        ``export_kv`` + ``release_handoff`` (or deadline expiry). The
+        table row is trashed — no further dispatch may touch the rows
+        being exported."""
+        slot = self._slots[slot_idx]
+        req = slot.req
+        self._handoff_ready[req.req_id] = req
+        self._tables[slot_idx] = self._trash
+        slot.req = None
+
+    def drain_prefilled(self) -> List[GenRequest]:
+        """Return (and claim) the requests whose prefill finished since
+        the last drain — the handoff layer's pickup counter. Each
+        returned request still OWNS its KV blocks; the caller must
+        ``export_kv`` + ``release_handoff`` (successful transfer) or
+        ``release_handoff`` alone (abandon: blocks recycle, the caller
+        re-routes the request)."""
+        out = list(self._handoff_ready.values())
+        self._handoff_ready.clear()
+        return out
+
+    def export_kv(self, req_id, kv_len: Optional[int] = None):
+        """Gather a handoff-ready request's KV blocks into host arrays:
+        ``(pages, scales, meta)`` per
+        :meth:`~paddle_tpu.ops.paged_attention.BlockManager.export_blocks`,
+        with ``meta["kv_len"]`` = the positions actually written
+        (``kv_len``, normally the prompt length the caller drained).
+        IDEMPOTENT — blocks stay allocated, so a failed transfer leg
+        re-exports the identical bytes; call :meth:`release_handoff`
+        only once the transfer is acked."""
+        if not _chaos.inject("handoff.export"):
+            raise ConnectionResetError(
+                "chaos: KV export dropped (lost message)")
+        if kv_len is None:
+            kv_len = (len(self.manager.owned_blocks(req_id))
+                      * self.block_size)
+        pages, scales, meta = self.manager.export_blocks(
+            req_id, self._pools, num_tokens=int(kv_len))
+        meta["kv_len"] = int(min(
+            int(kv_len), meta["num_blocks"] * self.block_size))
+        return pages, scales, meta
+
+    def release_handoff(self, req_id) -> None:
+        """Drop the exported request's block ownership (transfer acked,
+        or the caller is abandoning the handoff): blocks recycle via
+        the ref-counted free — prefix-cache pins survive."""
+        self.manager.free_sequence(req_id)
+        self.n_handed_off += 1
+
+    def import_kv(self, req: GenRequest, first_token: int,
+                  pages, scales, meta) -> None:
+        """Decode-side entry for a transferred prompt: place the
+        exported blocks into this engine's pool (fresh physical ids),
+        bind a free slot, and resume decode at the cached offset with
+        ``first_token`` already emitted (it came from the prefill
+        engine's logits — the decode dispatch that follows writes its
+        KV at position ``kv_len`` exactly as a local prefill's first
+        decode would).
+
+        Raises :class:`~paddle_tpu.ops.paged_attention.BlockImportError`
+        (transient: retry under the request's deadline) when no slot or
+        not enough free blocks are available RIGHT NOW; ValueError for
+        config mismatches no retry can fix. Failure leaves no state
+        behind — the import is atomic."""
+        if self._fenced:
+            raise EngineFenced(
+                "engine was retired by its supervisor; a replacement "
+                "already owns the requests")
+        psize = int(req.prompt.size)
+        kv_len = int(meta.get("kv_len", meta["num_blocks"]
+                              * self.block_size))
+        if kv_len != psize:
+            raise ValueError(
+                f"import_kv: transferred kv_len {kv_len} != prompt "
+                f"length {psize}")
+        if psize + req.max_new_tokens > self.max_len:
+            raise ValueError(
+                "import_kv: prompt + max_new_tokens exceeds max_len")
+        slot_idx = next(
+            (i for i, s in enumerate(self._slots) if not s.active), None)
+        if slot_idx is None:
+            raise BlockImportError(
+                "no free decode slot for the imported request")
+        total = self.manager.blocks_for(psize + req.max_new_tokens)
+        if total > self.manager.num_blocks:
+            # permanent: the pool can NEVER fit this payload (smaller
+            # pool than the exporter's) — must be ValueError so the
+            # caller falls back to colocated serving instead of
+            # retrying a BlockImportError that can't ever succeed
+            raise ValueError(
+                f"import_kv: needs {total} blocks to import + decode, "
+                f"pool has {self.manager.num_blocks} TOTAL")
+        if total > self.manager.free_blocks:
+            raise BlockImportError(
+                f"need {total} free blocks to import + decode, "
+                f"{self.manager.free_blocks} free")
+        self._pools, _ = self.manager.import_blocks(
+            req.req_id, pages, scales, meta, self._pools)
+        try:
+            self.manager.allocate(req.req_id, psize + req.max_new_tokens)
+        except RuntimeError as e:  # raced another import on the tail
+            self.manager.free_sequence(req.req_id)
+            raise BlockImportError(str(e)) from None
+        blocks = self.manager.owned_blocks(req.req_id)
+        row = np.full((self.max_blocks_per_seq,), self._trash, np.int32)
+        row[: len(blocks)] = blocks
+        self._tables[slot_idx] = row
+        slot = self._slots[slot_idx]
+        req.out, req.times, req.status = [], [], "ok"
+        if not req.t_submit:
+            req.t_submit = time.perf_counter()
+        slot.req = req
+        slot.prefill_pos = psize
+        slot.cache_len = psize
+        slot.remaining = req.max_new_tokens
+        self._append_token(req, int(first_token))
+        slot.remaining -= 1
+        self.n_imported += 1
+        self._finish_if_done(slot_idx, int(first_token))
 
     def _schedule_prefill(self, budget_left: int) -> Dict[int, int]:
         """Round-robin chunk scheduler: starting at the fairness
@@ -1081,7 +1269,9 @@ class ContinuousBatchingEngine:
                             self.manager.owned_blocks(slot.req.req_id))
                     self._append_token(slot.req, first)
                     slot.remaining -= 1
-                    self._finish_if_done(i, first)
+                    if not self._finish_if_done(i, first) \
+                            and self.role == "prefill_only":
+                        self._to_handoff(i)
         self._rr = (self._rr + 1) % self.B
         return used
 
@@ -1154,6 +1344,8 @@ class ContinuousBatchingEngine:
         """One decode round for every decode-phase slot (speculative
         verify, single step, or a ``decode_chunk`` scan). Returns real
         tokens scheduled."""
+        if self.role == "prefill_only":
+            return 0  # decode belongs to the other pool
         active = [i for i, s in enumerate(self._slots)
                   if s.active and not s.prefilling]
         if not active:

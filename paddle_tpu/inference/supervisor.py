@@ -248,8 +248,8 @@ class ServingSupervisor:
         # ...and the grace is itself bounded: a workload that never
         # dispatches some phase (max_new_tokens=1 never decodes) must
         # not leave hang detection at the roomy budget forever — after
-        # warmup_max_steps per incarnation the strict budget applies
-        # regardless
+        # warmup_max_steps GRANTS of the roomy budget per incarnation
+        # the strict budget applies regardless
         self.warmup_max_steps = int(warmup_max_steps)
         self.max_request_retries = int(max_request_retries)
         self.max_consecutive_failures = int(max_consecutive_failures)
@@ -268,6 +268,16 @@ class ServingSupervisor:
         self._journaled_done: set = set()
         self.journal = None if journal_dir is None else _Journal(journal_dir)
         self.journaled_ids: set = set()
+        # highest retries value journaled per id: lets a mailbox-fed
+        # server distinguish a stale re-read of a consumed submission
+        # (same retries — skip) from a router REQUEUE of work this
+        # worker already served (router bumps retries — accept)
+        self.journaled_retries: Dict[object, int] = {}
+        # warmup-budget grants this incarnation (vs engine.steps: a
+        # role engine's missing phase — e.g. a decode_only worker's
+        # colocated-fallback prefill — can first compile long after
+        # step warmup_max_steps, and must still get the compile grace)
+        self._warmup_grants = 0
         self.engine = engine_factory()
         self._runner = _StepRunner(self.engine)
         if self.journal is not None:
@@ -278,6 +288,10 @@ class ServingSupervisor:
         pending, completed = self.journal.replay()
         self.journal.compact(pending, completed)
         self.journaled_ids = set(pending) | set(completed)
+        for rid, rec in list(pending.items()) + list(completed.items()):
+            self.journaled_retries[rid] = max(
+                self.journaled_retries.get(rid, 0),
+                int(rec.get("retries", 0)))
         for rid, rec in completed.items():
             req = GenRequest(rid, np.zeros(0, np.int32))
             req.status, req.out = rec.get("status", "ok"), rec.get("out", [])
@@ -330,6 +344,8 @@ class ServingSupervisor:
             req_id, prompt, max_new_tokens, deadline=deadline,
             priority=priority, retries=retries)
         self.journaled_ids.add(req_id)
+        self.journaled_retries[req_id] = max(
+            self.journaled_retries.get(req_id, 0), int(retries))
         if req.status != "shed" and self.journal is not None:
             self.journal.submit(req)
         # harvest every shed this submission caused: the request itself
@@ -347,10 +363,52 @@ class ServingSupervisor:
             self._journaled_done.add(req.req_id)
             self.journal.complete(req)
 
+    # -- disaggregated-serving hooks ------------------------------------
+    def submit_imported(self, req: GenRequest) -> None:
+        """Journal a request that entered the engine OUTSIDE the front
+        door (a disagg KV import bypasses ``add_request``): a relaunch
+        of this decode worker replays it and — the KV pages having died
+        with the process — serves it by colocated re-prefill,
+        token-exact. No-op without a journal."""
+        self.journaled_ids.add(req.req_id)
+        self.journaled_retries[req.req_id] = max(
+            self.journaled_retries.get(req.req_id, 0), int(req.retries))
+        if self.journal is not None:
+            self.journal.submit(req)
+
+    def mark_transferred(self, req: GenRequest) -> None:
+        """Close a prefill-role request's journal entry once its KV
+        handoff was ACKED: ownership moved to the decode pool, so a
+        relaunch of THIS worker must not re-prefill it (the router's
+        own table still covers a later decode-side death). Recorded as
+        a ``complete`` with status "transferred" — routers treat that
+        status as a baton pass, not a final result."""
+        if self.journal is not None \
+                and req.req_id not in self._journaled_done:
+            self._journaled_done.add(req.req_id)
+            was = req.status
+            req.status = "transferred"
+            self.journal.complete(req)
+            req.status = was
+
     # -- the supervised loop --------------------------------------------
     @property
     def pending(self) -> bool:
         return bool(self.engine._queue or self.engine.num_active)
+
+    def _step_budget(self):
+        """Strict ``step_budget``, or ``warmup_budget`` while compiled
+        phases are still missing. Counted in GRANTS, not engine steps:
+        a role engine's missing phase (e.g. a decode_only worker's
+        colocated-fallback prefill) can first compile thousands of
+        steps in, and must still get the compile grace — while the
+        grant cap keeps a permanently wedged dispatch escalating."""
+        budget = self.step_budget
+        if (budget is not None and not self.engine.warmed_up
+                and self._warmup_grants < self.warmup_max_steps):
+            self._warmup_grants += 1
+            budget = self.warmup_budget
+        return budget
 
     def step(self) -> list:
         """One supervised engine iteration: run ``engine.step()`` on
@@ -360,10 +418,7 @@ class ServingSupervisor:
         if not _chaos.inject("serving.loop"):
             return []  # dropped supervisor tick
         runner = self._runner
-        budget = self.step_budget
-        if (budget is not None and not self.engine.warmed_up
-                and self.engine.steps < self.warmup_max_steps):
-            budget = self.warmup_budget  # compile grace, still bounded
+        budget = self._step_budget()
         dl = Deadline(budget)
         runner.begin()
         stages = ((self.warn_fraction, "warn"),
@@ -390,6 +445,18 @@ class ServingSupervisor:
                 if self.dump_stacks:
                     faulthandler.dump_traceback(
                         all_threads=True, file=sys.stderr)
+                    # disagg: a decode-worker hang is only debuggable
+                    # against the PREFILL side's schedule — with a
+                    # handoff contract attached, the flight-recorder
+                    # dump names BOTH roles' recorded schedules
+                    try:
+                        from ..distributed.communication import (
+                            flight_recorder as _fr,
+                        )
+
+                        _fr.dump_on_watchdog(sys.stderr)
+                    except Exception:  # noqa: BLE001 — diagnostics only
+                        pass
             else:  # hung: the full budget elapsed
                 self._note("hung", f"step exceeded its {budget:.3f}"
                                    "s budget")
@@ -446,6 +513,11 @@ class ServingSupervisor:
         queued_snap = list(eng._queue)
         inflight_snap = [r for r in [s.req for s in eng._slots]
                          if r is not None]
+        # prefill-role engines park finished prefills handoff-ready
+        # (out of both queue and slots) until the handoff layer drains
+        # them: their KV dies with this engine, so they recover exactly
+        # like in-flight work — requeued for a fresh prefill
+        inflight_snap += list(getattr(eng, "_handoff_ready", {}).values())
         # harvest whatever completed before the fault (incl. shed and
         # expired requests only present in the engine's map)
         harvested = set()
@@ -485,6 +557,7 @@ class ServingSupervisor:
         self._prior_expired += eng.n_expired
         self.engine = self._factory()
         self._runner = _StepRunner(self.engine)
+        self._warmup_grants = 0  # fresh incarnation: fresh compile grace
         for req in survivors:  # longest-waiting work first
             self.engine.requeue(req)
         for req in queued:

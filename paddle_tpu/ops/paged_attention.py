@@ -59,10 +59,18 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
-    "PagedLayerCache", "BlockManager", "PrefixCache", "contiguous_tables",
-    "alloc_paged_kv_caches", "paged_update_kv_cache", "paged_gather_kv",
-    "paged_write_kv", "paged_decode_attention",
+    "PagedLayerCache", "BlockManager", "BlockImportError", "PrefixCache",
+    "contiguous_tables", "alloc_paged_kv_caches", "paged_update_kv_cache",
+    "paged_gather_kv", "paged_write_kv", "paged_decode_attention",
 ]
+
+
+class BlockImportError(RuntimeError):
+    """A KV-block import could not be placed RIGHT NOW (destination
+    pool too full / no free slot). Classified TRANSIENT by the disagg
+    handoff's retry policy: decode drains free blocks continuously, so
+    the correct reaction is backoff-and-retry under the request's
+    deadline, not failure."""
 
 
 class PagedLayerCache(NamedTuple):
@@ -235,6 +243,109 @@ class BlockManager:
         owned = self._owned.get(seq_id, [])
         row[: len(owned)] = owned
         return row
+
+    # -- KV-block export/import (disaggregated prefill/decode) ----------
+    def export_blocks(self, seq_id, pools,
+                      num_tokens: Optional[int] = None):
+        """Gather ``seq_id``'s KV blocks out of the pools into host
+        arrays for a cross-engine handoff. ``pools`` is the engine's
+        per-layer pool list — ``(k, v)`` tuples of
+        [kvh, blocks, bs, D] arrays, or ``(k, v, k_scale, v_scale)``
+        for int8 pools (scale rows ride along: the per-block scales are
+        indexed by the SAME physical ids, so a quantized block's bytes
+        and its dequant scales travel together).
+
+        Returns ``(pages, scales, meta)``: ``pages`` is
+        [layers, 2, kvh, n, bs, D] (k then v), ``scales`` is
+        [layers, 2, kvh, n, bs] or None, ``meta`` describes the frame.
+        ``num_tokens`` limits the export to the blocks actually holding
+        KV (a prefill-role engine allocates no decode-growth blocks,
+        but a prefix-cache tail may over-own).
+
+        READ-ONLY by construction: adopted/COW-shared blocks are
+        gathered without touching refcounts — other readers (the
+        prefix cache, sibling sequences) keep their blocks."""
+        owned = self._owned.get(seq_id)
+        if not owned:
+            raise KeyError(f"export_blocks: unknown sequence {seq_id!r}")
+        n = len(owned)
+        if num_tokens is not None:
+            n = min(self.blocks_for(num_tokens), n)
+        idx = np.asarray(owned[:n], np.int64)
+        # gather ON DEVICE first: asarray of the full pool would copy
+        # the whole [kvh, num_blocks, bs, D] array to host per layer
+        # per k/v just to keep a few exported rows
+        pages = np.stack([
+            np.stack([np.asarray(entry[0][:, idx]),
+                      np.asarray(entry[1][:, idx])])
+            for entry in pools])
+        scales = None
+        if len(pools[0]) >= 4:
+            scales = np.stack([
+                np.stack([np.asarray(entry[2][:, idx]),
+                          np.asarray(entry[3][:, idx])])
+                for entry in pools])
+        meta = {
+            "num_blocks": int(n),
+            "block_size": int(self.block_size),
+            "layers": int(pages.shape[0]),
+            "dtype": str(pages.dtype),
+            "quantized": scales is not None,
+        }
+        return pages, scales, meta
+
+    def import_blocks(self, seq_id, pages, scales, meta, pools):
+        """Inverse of :meth:`export_blocks`: allocate fresh PRIVATE
+        blocks for ``seq_id`` (physical ids need not — and generally do
+        not — match the exporter's) and write the exported rows into
+        this engine's pools. Returns ``(new_pools, blocks)``.
+
+        Raises :class:`BlockImportError` (transient — retry under the
+        request's deadline) when the destination pool is too full;
+        config mismatches (block size, layer count, quantization) are
+        ValueError — no retry can fix those. On ANY failure nothing is
+        left allocated."""
+        n = int(meta["num_blocks"])
+        if int(meta["block_size"]) != self.block_size:
+            raise ValueError(
+                f"import_blocks: exporter block_size "
+                f"{meta['block_size']} != local {self.block_size}")
+        if int(meta["layers"]) != len(pools):
+            raise ValueError(
+                f"import_blocks: exporter has {meta['layers']} layers, "
+                f"local pools {len(pools)}")
+        if bool(meta.get("quantized")) != (len(pools[0]) >= 4):
+            raise ValueError(
+                "import_blocks: quantized/float pool mismatch between "
+                "exporter and importer")
+        if self._owned.get(seq_id):
+            raise ValueError(
+                f"import_blocks: sequence {seq_id!r} already owns blocks")
+        if n > self.num_blocks:
+            raise ValueError(  # permanent: can never fit in this pool
+                f"import_blocks: {n} blocks exceed the pool's total "
+                f"size {self.num_blocks}")
+        if n > len(self._free):
+            raise BlockImportError(
+                f"paged KV pool too full to import {n} blocks "
+                f"({len(self._free)} free of {self.num_blocks})")
+        blocks = self.allocate(seq_id, n * self.block_size)
+        idx = jnp.asarray(blocks, jnp.int32)
+        new_pools = []
+        for li, entry in enumerate(pools):
+            k = entry[0].at[:, idx].set(
+                jnp.asarray(pages[li, 0], entry[0].dtype))
+            v = entry[1].at[:, idx].set(
+                jnp.asarray(pages[li, 1], entry[1].dtype))
+            if len(entry) >= 4:
+                ks = entry[2].at[:, idx].set(
+                    jnp.asarray(scales[li, 0], entry[2].dtype))
+                vs = entry[3].at[:, idx].set(
+                    jnp.asarray(scales[li, 1], entry[3].dtype))
+                new_pools.append((k, v, ks, vs))
+            else:
+                new_pools.append((k, v))
+        return new_pools, blocks
 
 
 class _PrefixNode:

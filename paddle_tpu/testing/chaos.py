@@ -34,6 +34,16 @@ Instrumented sites (grep for ``chaos.inject``):
   non-deferred one on this rank (FIFO across consecutive drops) —
   the deterministic schedule swap ``collective_contract`` and the
   COLL002 detector must catch
+- ``handoff.export``     — each KV-block export on a prefill-role
+  engine (inference/serving.py ``export_kv``)
+- ``handoff.transfer``   — each store write of a handoff transfer leg
+  (part puts and the commit record, inference/disagg.py); a byte
+  site — ``corrupt`` flips a payload bit, ``drop`` loses the leg,
+  ``kill`` mid-parts leaves the partial transfer the decode side
+  must discard
+- ``handoff.import``     — each committed transfer the decode side
+  verifies + imports (inference/disagg.py); a ``drop`` defers the
+  import to the next poll
 - ``train.step``         — opt-in: training loops/test workers call it
 
 Faults (``Fault.kind``): ``hang``/``slow`` (sleep ``arg`` seconds;
@@ -41,7 +51,10 @@ Faults (``Fault.kind``): ``hang``/``slow`` (sleep ``arg`` seconds;
 ConnectionResetError), ``error`` (raise RuntimeError), ``drop``
 (inject returns False — the site skips the operation), ``kill``
 (``os._exit(int(arg))`` with an explicit code; with no arg, SIGKILL —
-the rc < 0 shape a real worker death has).
+the rc < 0 shape a real worker death has), ``corrupt`` (byte sites
+only, via :func:`inject_bytes`: flip bit ``arg`` of the payload —
+the fault CRC framing must catch; plain ``inject`` treats it as a
+no-op).
 
 Subprocess transport: ``PADDLE_CHAOS`` holds a spec string (see
 :meth:`ChaosSchedule.to_spec`); the first ``inject`` call in a process
@@ -68,10 +81,11 @@ __all__ = [
     "uninstall",
     "active",
     "inject",
+    "inject_bytes",
     "monkey",
 ]
 
-_KINDS = ("hang", "slow", "reset", "error", "drop", "kill")
+_KINDS = ("hang", "slow", "reset", "error", "drop", "kill", "corrupt")
 
 
 @dataclass(frozen=True)
@@ -227,14 +241,39 @@ class ChaosMonkey:
         ``index`` overrides the per-process invocation counter — sites
         that restart in a fresh process each round (the bench child)
         pass their attempt number so schedules still line up."""
+        fault = self._draw(site, index)
+        if fault is None or fault.kind == "corrupt":
+            return True  # corrupt is meaningful only at byte sites
+        return self._act(site, fault)
+
+    def fire_bytes(self, site: str, data: bytes,
+                   index: Optional[int] = None) -> Optional[bytes]:
+        """:meth:`fire` for byte-payload sites: returns the payload
+        (bit-flipped under a ``corrupt`` fault — bit ``arg`` counted
+        from the payload start), or None on a ``drop`` (the site loses
+        the message). Other kinds behave exactly like :meth:`fire`."""
+        fault = self._draw(site, index)
+        if fault is None:
+            return data
+        if fault.kind == "corrupt":
+            bit = int(fault.arg) % max(len(data) * 8, 1)
+            out = bytearray(data)
+            if out:
+                out[bit // 8] ^= 1 << (bit % 8)
+            return bytes(out)
+        return data if self._act(site, fault) else None
+
+    def _draw(self, site: str, index: Optional[int]) -> Optional[Fault]:
         with self._lock:
             idx = index if index is not None else self.counts.get(site, 0) + 1
             self.counts[site] = idx
             fault = self.schedule.fault_for(site, idx)
             if fault is not None:
                 self.events.append((site, idx, fault.kind))
-        if fault is None:
-            return True
+        return fault
+
+    def _act(self, site: str, fault: Fault) -> bool:
+        idx = self.counts.get(site, 0)
         if fault.kind in ("hang", "slow"):
             (self.clock.sleep if self.clock is not None
              else time.sleep)(fault.arg)
@@ -302,3 +341,21 @@ def inject(site: str, index: Optional[int] = None) -> bool:
             return True
         _monkey = ChaosMonkey(schedule=ChaosSchedule.from_spec(spec))
     return _monkey.fire(site, index)
+
+
+def inject_bytes(site: str, data: bytes,
+                 index: Optional[int] = None) -> Optional[bytes]:
+    """:func:`inject` for byte-payload sites (the KV handoff transfer
+    legs): returns the payload — bit-flipped under a ``corrupt``
+    fault — or None when the site should DROP the message. No-op
+    (returns ``data``) unless a schedule is installed."""
+    global _env_checked, _monkey
+    if _monkey is None:
+        if _env_checked:
+            return data
+        _env_checked = True
+        spec = os.environ.get("PADDLE_CHAOS")
+        if not spec:
+            return data
+        _monkey = ChaosMonkey(schedule=ChaosSchedule.from_spec(spec))
+    return _monkey.fire_bytes(site, data, index)

@@ -88,6 +88,11 @@ def pytest_configure(config):
         "markers",
         "spec: speculative-decoding + int8-KV quick lane "
         "(standalone via `pytest -m spec`)")
+    config.addinivalue_line(
+        "markers",
+        "disagg: disaggregated prefill/decode + KV-handoff suite "
+        "(quick-lane units; the 2-process kill test rides the slow "
+        "lane; standalone via `pytest -m disagg`)")
 
 
 def pytest_collection_modifyitems(config, items):

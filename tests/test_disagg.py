@@ -1,0 +1,961 @@
+"""Disaggregated prefill/decode with crash-safe KV handoff (ISSUE 8).
+
+Layers of proof:
+
+- ``TestBlobFrame`` — the store's length-prefixed CRC32 blob hygiene:
+  round trip, bit-flip detection, transient classification.
+- ``TestChaosBytes`` — the ``corrupt`` fault kind + ``inject_bytes``.
+- ``TestExportImport`` — model-free ``BlockManager`` round-trip
+  exactness: bf16 and int8 pools (scale rows carried), COW-shared
+  blocks (export does not break refs), ragged tables, and
+  import-into-fuller-pool failing as a clean retryable error.
+- ``TestEngineRoles`` — the ``role=`` scheduler changes and the
+  engine-level export/import seam, token-exact vs ``generate()``.
+- ``TestDisaggRouter`` — in-process prefill pool + decode pool over a
+  ``MemKVStore``: token-exact handoffs (whole-prompt, chunked, int8,
+  speculative decode), corrupt-transfer nack/resend, partial-transfer
+  discard, kill-one-prefill-worker requeue onto the survivor, and
+  prefill-pool-down colocated fallback.
+- ``TestHangDumpNamesBothRoles`` — the flight-recorder extension: a
+  hang dump with a handoff contract attached prints BOTH roles'
+  recorded schedules.
+- ``TestProcessDisaggKill`` (slow lane) — two REAL worker processes
+  over a TCPKVStore; the prefill worker dies to a scheduled ``kill``
+  mid-handoff; zero requests lost, survivors token-exact, the partial
+  transfer discarded, new prompts served via colocated fallback.
+"""
+import base64
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.store import (
+    CorruptBlobError,
+    FileKVStore,
+    MemKVStore,
+    TCPKVStore,
+)
+from paddle_tpu.inference.disagg import (
+    DecodeWorker,
+    DisaggRouter,
+    DisaggServer,
+    HandoffPayload,
+    KVHandoffReceiver,
+    KVHandoffSender,
+    PrefillWorker,
+)
+from paddle_tpu.inference.serving import ContinuousBatchingEngine
+from paddle_tpu.ops.paged_attention import BlockImportError, BlockManager
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosSchedule
+from paddle_tpu.utils.retries import Deadline
+
+pytestmark = pytest.mark.disagg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_monkey():
+    yield
+    chaos.uninstall()
+
+
+def _model():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _reference(model, prompt, max_new):
+    from paddle_tpu.models.generation import generate
+
+    ids = paddle.to_tensor(np.asarray(prompt, np.int64)[None])
+    out = generate(model, ids, max_new_tokens=max_new, use_jit=False)
+    return list(np.asarray(out.numpy())[0][len(prompt):])
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestBlobFrame:
+    def test_roundtrip_mem_and_file(self, tmp_path):
+        data = bytes(range(256)) * 41
+        for store in (MemKVStore(), FileKVStore(str(tmp_path / "kv"))):
+            store.put_bytes("b", data)
+            assert store.get_bytes("b") == data
+            assert store.get_bytes("absent") is None
+            store.put_bytes("empty", b"")
+            assert store.get_bytes("empty") == b""
+
+    def test_bit_flip_raises_corrupt(self):
+        store = MemKVStore()
+        store.put_bytes("b", b"payload bytes" * 50)
+        frame = bytearray(base64.b64decode(store.get("b")))
+        frame[100] ^= 0x10
+        store.set("b", base64.b64encode(bytes(frame)).decode())
+        with pytest.raises(CorruptBlobError, match="CRC32 mismatch"):
+            store.get_bytes("b")
+
+    def test_truncation_and_garbage_raise_corrupt(self):
+        store = MemKVStore()
+        store.put_bytes("b", b"x" * 100)
+        whole = store.get("b")
+        store.set("b", whole[: len(whole) // 2])
+        with pytest.raises(CorruptBlobError):
+            store.get_bytes("b")
+        store.set("b", "!!not base64!!")
+        with pytest.raises(CorruptBlobError):
+            store.get_bytes("b")
+
+    def test_corrupt_is_transient_for_store_retry(self):
+        # the whole point: RetryPolicy re-reads instead of the handoff
+        # importing garbage
+        assert TCPKVStore._is_transient(CorruptBlobError("x"))
+        from paddle_tpu.inference.disagg import _handoff_transient
+
+        assert _handoff_transient(CorruptBlobError("x"))
+        assert _handoff_transient(BlockImportError("pool full"))
+        assert not _handoff_transient(KeyError("fatal"))
+
+
+class TestChaosBytes:
+    def test_corrupt_flips_exactly_one_bit(self):
+        data = bytes(64)
+        with chaos.active(
+                ChaosSchedule().at("site.bytes", 2, "corrupt", 19)):
+            first = chaos.inject_bytes("site.bytes", data)
+            second = chaos.inject_bytes("site.bytes", data)
+        assert first == data
+        diff = [(i, b) for i, b in enumerate(second) if b]
+        assert diff == [(19 // 8, 1 << (19 % 8))]
+
+    def test_drop_returns_none_and_plain_inject_ignores_corrupt(self):
+        with chaos.active(ChaosSchedule()
+                          .at("site.bytes", 1, "drop")
+                          .at("site.plain", 1, "corrupt")):
+            assert chaos.inject_bytes("site.bytes", b"x") is None
+            assert chaos.inject("site.plain") is True  # no-op kind here
+
+    def test_error_kind_still_raises_through_bytes(self):
+        with chaos.active(ChaosSchedule().at("site.bytes", 1, "error")):
+            with pytest.raises(RuntimeError, match="injected error"):
+                chaos.inject_bytes("site.bytes", b"x")
+
+
+# ---------------------------------------------------------------------------
+
+
+def _make_pools(layers=2, kvh=2, blocks=8, bs=4, d=8, quant=False,
+                seed=0):
+    rng = np.random.RandomState(seed)
+    pools = []
+    for _ in range(layers):
+        k = jnp.asarray(rng.randn(kvh, blocks, bs, d), jnp.float32)
+        v = jnp.asarray(rng.randn(kvh, blocks, bs, d), jnp.float32)
+        if quant:
+            k = jnp.asarray(rng.randint(-127, 128, (kvh, blocks, bs, d)),
+                            jnp.int8)
+            v = jnp.asarray(rng.randint(-127, 128, (kvh, blocks, bs, d)),
+                            jnp.int8)
+            ks = jnp.asarray(rng.rand(kvh, blocks, bs), jnp.float32)
+            vs = jnp.asarray(rng.rand(kvh, blocks, bs), jnp.float32)
+            pools.append((k, v, ks, vs))
+        else:
+            pools.append((k, v))
+    return pools
+
+
+class TestExportImport:
+    def test_roundtrip_exact_ragged_tables(self):
+        """Non-contiguous physical blocks on the exporter, a different
+        layout on the importer: the per-token KV view must round-trip
+        byte-exact."""
+        src = BlockManager(8, 4)
+        src.allocate("a", 8)  # takes two blocks
+        src.allocate("x", 10)  # 3 blocks
+        src.free_sequence("a")  # holes -> x's ids stay, free list ragged
+        src.allocate("b", 4)
+        pools = _make_pools()
+        pages, scales, meta = src.export_blocks("x", pools, num_tokens=10)
+        assert scales is None and meta["num_blocks"] == 3
+        dst = BlockManager(16, 4)
+        dst.allocate("occupant", 20)  # different free-list shape
+        dpools = _make_pools(seed=9)
+        dpools, blocks = dst.import_blocks("x", pages, None, meta, dpools)
+        assert len(blocks) == 3
+        src_row = np.asarray(src.owned_blocks("x"))
+        dst_row = np.asarray(blocks)
+        for entry_s, entry_d in zip(pools, dpools):
+            ks = np.asarray(entry_s[0])[:, src_row]
+            kd = np.asarray(entry_d[0])[:, dst_row]
+            np.testing.assert_array_equal(ks, kd)
+            vs = np.asarray(entry_s[1])[:, src_row]
+            vd = np.asarray(entry_d[1])[:, dst_row]
+            np.testing.assert_array_equal(vs, vd)
+
+    def test_roundtrip_int8_scales_carried(self):
+        src = BlockManager(8, 4)
+        src.allocate("q", 9)
+        pools = _make_pools(quant=True)
+        pages, scales, meta = src.export_blocks("q", pools, num_tokens=9)
+        assert pages.dtype == np.int8 and scales is not None
+        assert meta["quantized"]
+        dst = BlockManager(8, 4)
+        dpools = _make_pools(quant=True, seed=7)
+        dpools, blocks = dst.import_blocks("q", pages, scales, meta,
+                                           dpools)
+        srow = np.asarray(src.owned_blocks("q"))
+        drow = np.asarray(blocks)
+        for es, ed in zip(pools, dpools):
+            for j in range(4):  # k, v, k_scale, v_scale
+                np.testing.assert_array_equal(
+                    np.asarray(es[j])[:, srow], np.asarray(ed[j])[:, drow])
+
+    def test_export_respects_cow_refs(self):
+        """Exporting a sequence that ADOPTED shared blocks must not
+        touch refcounts — the prefix cache and sibling readers keep
+        their pins."""
+        mgr = BlockManager(8, 4)
+        shared = mgr.allocate("donor", 8)
+        for b in shared:
+            mgr.ref(b)  # the cache's pin
+        mgr.free_sequence("donor")
+        mgr.adopt("reader", shared)
+        before = {b: mgr.refcount(b) for b in shared}
+        pools = _make_pools()
+        pages, _, meta = mgr.export_blocks("reader", pools)
+        assert {b: mgr.refcount(b) for b in shared} == before
+        assert meta["num_blocks"] == 2
+        mgr.free_sequence("reader")
+        assert all(mgr.refcount(b) == 1 for b in shared)  # pin survives
+
+    def test_import_into_fuller_pool_is_clean_retryable(self):
+        src = BlockManager(8, 4)
+        src.allocate("big", 20)  # 5 blocks
+        pools = _make_pools()
+        pages, _, meta = src.export_blocks("big", pools)
+        dst = BlockManager(8, 4)
+        dst.allocate("hog", 26)  # leaves 1 free
+        dpools = _make_pools(seed=3)
+        free_before = dst.free_blocks
+        with pytest.raises(BlockImportError, match="too full"):
+            dst.import_blocks("big", pages, None, meta, dpools)
+        # nothing allocated, nothing owned: a retry starts clean
+        assert dst.free_blocks == free_before
+        assert dst.owned_blocks("big") == []
+        # a pool too small in TOTAL is permanent, not retryable
+        with pytest.raises(ValueError, match="total"):
+            BlockManager(4, 4).import_blocks(
+                "big", pages, None, meta, _make_pools())
+
+    def test_config_mismatch_is_fatal_valueerror(self):
+        src = BlockManager(8, 4)
+        src.allocate("q", 4)
+        pools = _make_pools()
+        pages, _, meta = src.export_blocks("q", pools)
+        with pytest.raises(ValueError, match="block_size"):
+            BlockManager(8, 8).import_blocks(
+                "q", pages, None, meta, _make_pools())
+        bad = dict(meta, layers=5)
+        with pytest.raises(ValueError, match="layers"):
+            BlockManager(8, 4).import_blocks(
+                "q", pages, None, bad, _make_pools())
+
+    def test_num_tokens_limits_exported_blocks(self):
+        mgr = BlockManager(8, 4)
+        mgr.allocate("q", 16)  # 4 blocks owned
+        pools = _make_pools()
+        _, _, meta = mgr.export_blocks("q", pools, num_tokens=5)
+        assert meta["num_blocks"] == 2  # ceil(5/4)
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestEngineRoles:
+    def test_role_validation(self):
+        model = _model()
+        with pytest.raises(ValueError, match="role"):
+            ContinuousBatchingEngine(
+                model, max_batch=1, max_len=16, block_size=8,
+                num_blocks=4, role="both")
+
+    def test_prefill_only_parks_handoff_ready_never_decodes(self):
+        model = _model()
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=32, block_size=8, num_blocks=4,
+            prompt_pad=8, role="prefill_only")
+        prompt = np.arange(5) + 7
+        eng.add_request("r", prompt, max_new_tokens=4)
+        eng.run()
+        ready = eng.drain_prefilled()
+        assert [r.req_id for r in ready] == ["r"]
+        req = ready[0]
+        # the first token IS the prefill logits' argmax
+        assert req.out == [_reference(model, prompt, 1)[0]]
+        assert "decode" not in eng._phases_run
+        assert eng.num_active == 0  # the slot freed for the next prompt
+        assert eng.manager.owned_blocks("r")  # blocks held for export
+        # prefill-only reserves no decode growth: 1 block for 5+pad(8)
+        assert len(eng.manager.owned_blocks("r")) == 1
+        eng.release_handoff("r")
+        assert not eng.manager.owned_blocks("r")
+
+    def test_engine_export_import_resumes_token_exact(self):
+        model = _model()
+        pf = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=4,
+            prompt_pad=8, role="prefill_only")
+        dx = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=8,
+            prompt_pad=8, role="decode_only")
+        prompt = np.arange(6) + 3
+        pf.add_request("r", prompt, max_new_tokens=5)
+        pf.run()
+        (req,) = pf.drain_prefilled()
+        pages, scales, meta = pf.export_kv("r", kv_len=prompt.size)
+        assert meta["kv_len"] == prompt.size
+        pf.release_handoff("r")
+        from paddle_tpu.inference.serving import GenRequest
+
+        req2 = GenRequest("r", prompt, 5)
+        dx.import_kv(req2, req.out[0], pages, scales, meta)
+        dx.run()
+        assert req2.status == "ok"
+        assert req2.out == _reference(model, prompt, 5)
+        assert dx.n_imported == 1
+
+    def test_import_without_slot_or_blocks_is_retryable(self):
+        model = _model()
+        pf = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=4,
+            prompt_pad=8, role="prefill_only")
+        prompt = np.arange(6)
+        pf.add_request("r", prompt, max_new_tokens=4)
+        pf.run()
+        (req,) = pf.drain_prefilled()
+        pages, scales, meta = pf.export_kv("r", kv_len=prompt.size)
+        from paddle_tpu.inference.serving import GenRequest
+
+        # pool BIG ENOUGH in total but occupied right now: transient —
+        # decode drains continuously, a retry can succeed
+        dx = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=4,
+            prompt_pad=8)
+        dx.manager.allocate("hog", 3 * 8)
+        with pytest.raises(BlockImportError):
+            dx.import_kv(GenRequest("r", prompt, 20), req.out[0],
+                         pages, scales, meta)
+        assert dx.manager.owned_blocks("r") == []  # atomic failure
+
+        # pool too small in TOTAL: permanent config skew — ValueError
+        # (a BlockImportError here would retry forever), so the decode
+        # worker's colocated-fallback path takes over
+        dx2 = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=1,
+            prompt_pad=8)
+        with pytest.raises(ValueError):
+            dx2.import_kv(GenRequest("r", prompt, 20), req.out[0],
+                          pages, scales, meta)
+        assert dx2.manager.owned_blocks("r") == []
+
+    def test_expired_handoff_ready_is_swept(self):
+        model = _model()
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=4,
+            prompt_pad=8, role="prefill_only")
+        eng.add_request("r", np.arange(5), max_new_tokens=4,
+                        deadline=Deadline(0.05))
+        eng.step()
+        assert "r" in eng._handoff_ready
+        time.sleep(0.06)
+        eng.step()
+        assert "r" not in eng._handoff_ready
+        assert eng._completed["r"].status == "expired"
+        assert not eng.manager.owned_blocks("r")  # blocks recycled
+
+
+# ---------------------------------------------------------------------------
+
+
+def _factories(model, *, chunk=None, kv_dtype=None, spec_k=None,
+               pf_blocks=8, dx_blocks=16, max_len=32, max_batch=2):
+    def pf_factory():
+        kw = dict(max_batch=max_batch, max_len=max_len, block_size=8,
+                  num_blocks=pf_blocks, kv_dtype=kv_dtype,
+                  role="prefill_only")
+        if chunk:
+            kw["prefill_chunk"] = chunk
+        else:
+            kw["prompt_pad"] = 16
+        return ContinuousBatchingEngine(model, **kw)
+
+    def dx_factory():
+        kw = dict(max_batch=max_batch, max_len=max_len, block_size=8,
+                  num_blocks=dx_blocks, kv_dtype=kv_dtype,
+                  spec_decode_k=spec_k, role="decode_only")
+        if chunk:
+            kw["prefill_chunk"] = chunk
+        else:
+            kw["prompt_pad"] = 16
+        return ContinuousBatchingEngine(model, **kw)
+
+    return pf_factory, dx_factory
+
+
+class TestDisaggRouter:
+    def test_handoff_roundtrip_token_exact(self):
+        model = _model()
+        pf_f, dx_f = _factories(model)
+        store = MemKVStore()
+        pf = PrefillWorker("pf0", pf_f, store, ["dx0"])
+        dx = DecodeWorker("dx0", dx_f, store)
+        router = DisaggRouter([pf], [dx])
+        rng = np.random.RandomState(0)
+        prompts = {f"q{i}": rng.randint(0, 250, (5 + i,))
+                   for i in range(4)}
+        for rid, p in prompts.items():
+            pool, idx = router.submit(rid, p, max_new_tokens=4)
+            assert pool == "prefill"
+        res = router.run(deadline=240)
+        for rid, p in prompts.items():
+            assert res[rid]["status"] == "ok", res[rid]
+            assert res[rid]["out"] == _reference(model, p, 4), rid
+        assert router.n_fallback == 0
+        assert router.n_handoff_failed == 0
+        assert dx.supervisor.engine.n_imported == 4
+        assert pf.supervisor.engine.n_handed_off == 4
+
+    def test_chunked_int8_spec_compose_across_handoff(self):
+        """The full lever stack rides one handoff: chunked prefill on
+        the prefill pool, int8 KV pages + scale rows in transit,
+        speculative decode on the decode pool — still token-exact vs
+        a unified engine with the same KV dtype."""
+        model = _model()
+        pf_f, dx_f = _factories(model, chunk=8, kv_dtype="int8",
+                                spec_k=2, max_len=64, dx_blocks=32,
+                                pf_blocks=16)
+        store = MemKVStore()
+        pf = PrefillWorker("pf0", pf_f, store, ["dx0"])
+        dx = DecodeWorker("dx0", dx_f, store)
+        router = DisaggRouter([pf], [dx])
+        rng = np.random.RandomState(3)
+        prompts = {f"c{i}": rng.randint(0, 250, (11 + 7 * i,))
+                   for i in range(3)}
+        for rid, p in prompts.items():
+            router.submit(rid, p, max_new_tokens=5)
+        res = router.run(deadline=240)
+        # reference: UNIFIED engine, same int8 pools (int8 KV shifts
+        # logits a hair vs bf16 generate; the disagg contract is
+        # exactness vs the unified engine at the same config)
+        ref = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=64, block_size=8, num_blocks=32,
+            prefill_chunk=8, kv_dtype="int8")
+        for rid, p in prompts.items():
+            ref.add_request(rid, p, max_new_tokens=5)
+        want = ref.run()
+        for rid in prompts:
+            assert res[rid]["status"] == "ok"
+            assert res[rid]["out"] == list(want[rid].out), rid
+        assert dx.supervisor.engine.n_imported == 3
+
+    def test_corrupt_transfer_nacked_and_resent(self):
+        model = _model()
+        pf_f, dx_f = _factories(model, max_batch=1, pf_blocks=4,
+                                dx_blocks=8)
+        store = MemKVStore()
+        pf = PrefillWorker("pf0", pf_f, store, ["dx0"])
+        dx = DecodeWorker("dx0", dx_f, store)
+        router = DisaggRouter([pf], [dx])
+        with chaos.active(
+                ChaosSchedule().at("handoff.transfer", 1, "corrupt", 77)):
+            p = np.arange(5) + 3
+            router.submit("x", p, max_new_tokens=4)
+            res = router.run(deadline=240)
+        assert res["x"]["status"] == "ok"
+        assert res["x"]["out"] == _reference(model, p, 4)
+        assert pf.senders[0].n_nacked >= 1  # the CRC frame caught it
+        assert dx.receiver.n_nacked >= 1
+        assert router.n_handoff_failed == 0  # the resend delivered
+
+    def test_dropped_import_defers_not_loses(self):
+        model = _model()
+        pf_f, dx_f = _factories(model, max_batch=1, pf_blocks=4,
+                                dx_blocks=8)
+        store = MemKVStore()
+        pf = PrefillWorker("pf0", pf_f, store, ["dx0"])
+        dx = DecodeWorker("dx0", dx_f, store)
+        router = DisaggRouter([pf], [dx])
+        with chaos.active(
+                ChaosSchedule().at("handoff.import", 1, "drop")):
+            p = np.arange(6) + 1
+            router.submit("d", p, max_new_tokens=4)
+            res = router.run(deadline=240)
+        assert res["d"]["status"] == "ok"
+        assert res["d"]["out"] == _reference(model, p, 4)
+
+    def test_partial_transfer_is_discarded(self):
+        """Parts without a commit — a sender killed mid-handoff — are
+        never imported."""
+        store = MemKVStore()
+        sender = KVHandoffSender(store, "dx0", n_parts=3)
+        payload = HandoffPayload(
+            req_id="half", prompt=np.arange(4, dtype=np.int32),
+            first_token=1, max_new_tokens=4, priority="interactive",
+            deadline_unix=None, retries=0,
+            pages=np.zeros((1, 2, 1, 1, 4, 2), np.float32), scales=None,
+            meta={"num_blocks": 1, "block_size": 4, "layers": 1,
+                  "dtype": "float32", "quantized": False, "kv_len": 4})
+        data = payload.pack()
+        # write 2 of 3 parts, NO commit (the mid-handoff death shape)
+        parts = sender._split(data)
+        store.put_bytes("disagg/dx0/xfer/pf-00000001/part/0000", parts[0])
+        store.put_bytes("disagg/dx0/xfer/pf-00000001/part/0001", parts[1])
+        receiver = KVHandoffReceiver(store, "dx0")
+        assert receiver.recv_handoff() == []
+        assert receiver.n_received == 0
+        assert store.get("disagg/dx0/ack/pf-00000001") is None
+
+    @staticmethod
+    def _tiny_payload(req_id):
+        return HandoffPayload(
+            req_id=req_id, prompt=np.arange(4, dtype=np.int32),
+            first_token=1, max_new_tokens=4, priority="interactive",
+            deadline_unix=None, retries=0,
+            pages=np.zeros((1, 2, 1, 1, 4, 2), np.float32), scales=None,
+            meta={"num_blocks": 1, "block_size": 4, "layers": 1,
+                  "dtype": "float32", "quantized": False, "kv_len": 4})
+
+    def test_relaunched_sender_does_not_read_stale_acks(self):
+        """Acks persist in the store BY DESIGN (relaunched-receiver
+        idempotence) and a relaunched sender's seq counter restarts at
+        0 — without the per-incarnation nonce, its first transfer would
+        alias the previous life's settled seq and falsely settle off
+        the stale "ok" while the receiver never saw the payload."""
+        store = MemKVStore()
+        receiver = KVHandoffReceiver(store, "dx0")
+        s1 = KVHandoffSender(store, "dx0", sender_id="pf0")
+        seq1 = s1.send_handoff(self._tiny_payload("r1"))
+        assert [p.req_id for p in receiver.recv_handoff()] == ["r1"]
+        assert s1.poll_ack(seq1) == "ok"
+        # relaunch: a FRESH sender instance, same worker id
+        s2 = KVHandoffSender(store, "dx0", sender_id="pf0")
+        seq2 = s2.send_handoff(self._tiny_payload("r2"))
+        assert seq2 != seq1
+        # the stale incarnation's ack must NOT settle the new transfer
+        assert s2.poll_ack(seq2) is None
+        assert [p.req_id for p in receiver.recv_handoff()] == ["r2"]
+        assert s2.poll_ack(seq2) == "ok"
+
+    def test_settled_transfer_records_are_gcd(self):
+        """Settled transfers (ok AND nack) drop their parts + commit
+        from the store — only the ack persists — so the receiver's
+        per-pump key scan stays O(unsettled), not O(lifetime)."""
+        store = MemKVStore()
+        receiver = KVHandoffReceiver(store, "dx0")
+        sender = KVHandoffSender(store, "dx0", n_parts=2)
+        seq = sender.send_handoff(self._tiny_payload("g1"))
+        assert [p.req_id for p in receiver.recv_handoff()] == ["g1"]
+        assert list(store.keys("disagg/dx0/xfer/")) == []
+        assert store.get(f"disagg/dx0/ack/{seq}") == "ok"
+        # nacked transfer: same GC (the resend is a FRESH transfer)
+        data = self._tiny_payload("g2").pack()
+        store.put_bytes("disagg/dx0/xfer/bad-0001/part/0000", data)
+        store.set("disagg/dx0/xfer/bad-0001/commit", json.dumps(
+            {"req_id": "g2", "parts": 1, "bytes": len(data),
+             "crc": 12345}))  # wrong whole-payload CRC
+        assert receiver.recv_handoff() == []
+        assert receiver.n_nacked == 1
+        assert list(store.keys("disagg/dx0/xfer/")) == []
+        assert str(store.get("disagg/dx0/ack/bad-0001")).startswith(
+            "corrupt:")
+
+    def test_orphaned_partial_transfer_gcd_after_grace(self):
+        """A sender killed mid-parts leaves parts with no commit — the
+        dead sender can't clean them, so the receiver GCs them after
+        the grace window (never acking: the router's recovery owns the
+        request). Inside the grace they stay (a slow live sender may
+        still be uploading)."""
+        store = MemKVStore()
+        receiver = KVHandoffReceiver(store, "dx0", orphan_grace=0.05)
+        data = self._tiny_payload("o1").pack()
+        store.put_bytes("disagg/dx0/xfer/dead-0001/part/0000", data)
+        assert receiver.recv_handoff() == []
+        assert list(store.keys("disagg/dx0/xfer/"))  # in grace: kept
+        time.sleep(0.06)
+        assert receiver.recv_handoff() == []
+        assert list(store.keys("disagg/dx0/xfer/")) == []  # GC'd
+        assert receiver.n_orphans_gcd == 1
+        assert store.get("disagg/dx0/ack/dead-0001") is None
+
+    def test_config_skew_import_falls_back_colocated(self):
+        """A payload that can NEVER import here (block-size skew →
+        ValueError, not the transient BlockImportError) must not crash
+        the decode worker: the prompt rides the payload, so the worker
+        serves it colocated — token-exact, nothing lost."""
+        model = _model()
+        pf_f, _ = _factories(model, pf_blocks=4)
+
+        def dx_factory():  # block_size 4 vs the exporter's 8
+            return ContinuousBatchingEngine(
+                model, max_batch=2, max_len=32, block_size=4,
+                num_blocks=16, prompt_pad=16, role="decode_only")
+
+        store = MemKVStore()
+        pf = PrefillWorker("pf0", pf_f, store, ["dx0"])
+        dx = DecodeWorker("dx0", dx_factory, store)
+        router = DisaggRouter([pf], [dx])
+        p = np.arange(6) + 2
+        router.submit("skew", p, max_new_tokens=4)
+        res = router.run(deadline=240)
+        assert res["skew"]["status"] == "ok"
+        assert res["skew"]["out"] == _reference(model, p, 4)
+        assert dx.supervisor.engine.n_imported == 0  # served colocated
+        assert dx.alive()
+
+    def test_kill_prefill_worker_requeues_onto_survivor(self, tmp_path):
+        """Two prefill workers; one dies with accepted-but-unfinished
+        work: journal ∪ routing table requeue it token-exact onto the
+        SURVIVING prefill worker (no fallback needed)."""
+        model = _model()
+        pf_f, dx_f = _factories(model, max_batch=1, pf_blocks=4,
+                                dx_blocks=16)
+        store = MemKVStore()
+        pfs = [PrefillWorker(f"pf{i}", pf_f, store, ["dx0"],
+                             journal_dir=str(tmp_path / f"pf{i}"))
+               for i in range(2)]
+        dx = DecodeWorker("dx0", dx_f, store)
+        router = DisaggRouter(pfs, [dx])
+        rng = np.random.RandomState(5)
+        prompts = {f"k{i}": rng.randint(0, 250, (5 + i,))
+                   for i in range(4)}
+        where = {rid: router.submit(rid, p, max_new_tokens=4)
+                 for rid, p in prompts.items()}
+        victims = [r for r, w in where.items() if w == ("prefill", 0)]
+        assert victims  # least-routed placement spread the work
+        pfs[0].kill()
+        res = router.run(deadline=240)
+        assert router.dead_prefill == {0}
+        for rid, p in prompts.items():
+            assert res[rid]["status"] == "ok", (rid, res[rid])
+            assert res[rid]["out"] == _reference(model, p, 4), rid
+        assert router.n_fallback == 0  # the survivor took the requeue
+        for rid in victims:
+            assert router.retries[rid] == 1
+        ev = [e for e in router.events if e[0] == "prefill-dead"]
+        assert len(ev) == 1 and ev[0][1] == "pf0"
+
+    def test_prefill_pool_down_colocated_fallback_no_shed(self):
+        """The graceful-degradation path: with the prefill pool EMPTY,
+        new prompts serve via the decode workers' own (unified-path)
+        prefill — no outage, nothing shed, token-exact."""
+        model = _model()
+        pf_f, dx_f = _factories(model, max_batch=1, pf_blocks=4,
+                                dx_blocks=16)
+        store = MemKVStore()
+        pf = PrefillWorker("pf0", pf_f, store, ["dx0"])
+        dx = DecodeWorker("dx0", dx_f, store)
+        router = DisaggRouter([pf], [dx])
+        pf.kill()
+        router.check_workers()
+        rng = np.random.RandomState(6)
+        prompts = {f"f{i}": rng.randint(0, 250, (4 + i,))
+                   for i in range(3)}
+        for rid, p in prompts.items():
+            pool, _ = router.submit(rid, p, max_new_tokens=4)
+            assert pool == "decode"  # colocated placement, immediately
+        res = router.run(deadline=240)
+        for rid, p in prompts.items():
+            assert res[rid]["status"] == "ok"
+            assert res[rid]["out"] == _reference(model, p, 4), rid
+        assert router.n_fallback == 3
+        load = dx.load()
+        assert load["n_shed_interactive"] + load["n_shed_batch"] == 0
+
+
+# ---------------------------------------------------------------------------
+
+
+class _FakeWorker:
+    """Minimal DisaggServer-shaped worker for serve-plumbing units."""
+
+    replica_id = "dx9"
+
+    def __init__(self, completed=()):
+        self.got = []
+        self._completed = list(completed)
+        sup = type("S", (), {})()
+        sup.journaled_ids = {"r"}
+        sup.journaled_retries = {"r": 0}
+        self.supervisor = sup
+
+    def submit(self, rec):
+        self.got.append(rec)
+
+    def poll_completed(self):
+        return [self._completed.pop(0)] if self._completed else []
+
+    def load(self):
+        return None
+
+    def pending(self):
+        return False
+
+    def active(self):
+        return False
+
+    def pump(self, deadline=None):
+        pass
+
+
+class TestReviewHardening:
+    def test_requeue_with_bumped_retries_not_dropped(self):
+        """The _pull replay guard must drop a stale re-read of a
+        consumed submission (same retries) but ACCEPT a router requeue
+        of work this worker already served — the decode side died
+        after the baton pass, and the router bumps retries on every
+        requeue."""
+        store = MemKVStore()
+        w = _FakeWorker()
+        srv = DisaggServer(store, w, contract_rank=1)
+        store.set("cluster/dx9/req/00000000",
+                  json.dumps({"req_id": "r", "retries": 0}))  # stale
+        store.set("cluster/dx9/req/00000001",
+                  json.dumps({"req_id": "r", "retries": 1}))  # requeue
+        assert srv._pull() == 1
+        assert [rec["retries"] for rec in w.got] == [1]
+
+    def test_marker_then_result_both_delivered(self):
+        """One request can publish several records (\"transferred\",
+        then a final result after a requeue); ProcessReplica dedups by
+        key, so a fixed done/<rid> slot would swallow every record
+        after the first."""
+        from paddle_tpu.inference.cluster import ProcessReplica
+
+        store = MemKVStore()
+        w = _FakeWorker(completed=[
+            {"req_id": "r", "status": "transferred", "target": "dx0"},
+            {"req_id": "r", "status": "ok", "out": [1, 2]},
+        ])
+        srv = DisaggServer(store, w, contract_rank=1)
+        srv._publish()
+        srv._publish()
+        rep = ProcessReplica(store, "dx9")
+        got = rep.poll_completed()
+        assert sorted(r["status"] for r in got) == ["ok", "transferred"]
+        assert rep.poll_completed() == []  # each delivered exactly once
+
+    def test_sender_cooldown_skips_timed_out_channel(self):
+        """A decode channel whose transfer just ack-timed-out is
+        skipped for a cooldown window instead of eating every other
+        handoff's full ack budget; with EVERY channel down the picker
+        still returns one (stranding the handoff would be worse)."""
+        model = _model()
+        pf_f, _ = _factories(model)
+        pf = PrefillWorker("pf0", pf_f, MemKVStore(), ["dx0", "dx1"])
+        pf._down_until["dx1"] = time.monotonic() + 60
+        assert {pf._pick_sender().channel for _ in range(4)} == {"dx0"}
+        pf._down_until["dx0"] = time.monotonic() + 60
+        assert pf._pick_sender().channel in ("dx0", "dx1")
+
+    def test_warmup_grace_tracks_missing_phase_not_steps(self):
+        """A decode_only worker can serve imported handoffs for
+        thousands of steps before its colocated-fallback prefill first
+        compiles; the compile grace must still apply then (bounded by
+        GRANTS, not by engine step count)."""
+        from paddle_tpu.inference.supervisor import ServingSupervisor
+
+        model = _model()
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, max_batch=1, max_len=32, block_size=8,
+                num_blocks=8, prompt_pad=16, role="decode_only")
+
+        sup = ServingSupervisor(factory, step_budget=5.0,
+                                warmup_budget=120.0, warmup_max_steps=4)
+        sup.engine.steps = 1000  # long past any step-count warmup cap
+        assert not sup.engine.warmed_up
+        assert sup._step_budget() == 120.0  # grace despite step count
+        sup._warmup_grants = sup.warmup_max_steps
+        assert sup._step_budget() == 5.0  # ...but the grant cap holds
+
+
+class TestHangDumpNamesBothRoles:
+    def test_dump_names_prefill_and_decode_schedules(self):
+        """A decode-worker hang dump with the handoff contract attached
+        prints BOTH roles' recorded schedules — and the mirrored
+        handoff legs are NOT called a divergence (rank-divergent by
+        design, like send/recv)."""
+        import io
+
+        from paddle_tpu.distributed.communication import flight_recorder
+
+        flight_recorder.reset()
+        try:
+            store = MemKVStore()
+            # the prefill role (rank 0) published its schedule when IT
+            # dumped; here we stand it up directly
+            pf_ring = flight_recorder.FlightRecorder(capacity=8)
+            pf_ring.record("handoff_send", shape=(2, 2, 2, 1, 8, 4),
+                           dtype="float32", group="disagg/dx0",
+                           detail="req=q0")
+            store.set("graft/fr_hang/0", json.dumps({
+                "published_at": time.time(),
+                "schedule": [s.to_json() for s in pf_ring.snapshot()]}))
+            # the decode role (rank 1) hangs and dumps
+            flight_recorder.record(
+                "handoff_recv", shape=(2, 2, 2, 1, 8, 4),
+                dtype="float32", group="disagg/dx0", detail="req=q0")
+            flight_recorder.attach_contract(store, 1, 2)
+            buf = io.StringIO()
+            flight_recorder.dump_on_watchdog(buf)
+            for _ in range(100):  # the exchange thread may lag the call
+                if "rank 0" in buf.getvalue():
+                    break
+                time.sleep(0.05)
+            out = buf.getvalue()
+            assert "handoff_recv" in out  # this role's ring
+            assert "rank 0" in out and "handoff_send" in out
+            assert "schedules agree" in out  # mirrored legs != divergence
+        finally:
+            flight_recorder.reset()
+
+    def test_interproc_models_handoff_p2p(self):
+        """graft-verify's effect summaries carry the handoff legs as
+        p2p ops — the cross-role schedule is analyzable."""
+        from paddle_tpu.analysis.interproc import summarize_source
+
+        src = (
+            "def pf(sender, payload, deadline):\n"
+            "    return sender.send_handoff(payload, deadline=deadline)\n"
+            "def dx(receiver):\n"
+            "    return receiver.recv_handoff()\n"
+        )
+        summary = summarize_source(src, "fixture.py")
+        effects = {f.name: [type(e).__name__ for e in f.effects]
+                   for f in summary.functions}
+        assert effects["pf"] == ["P2PEffect"]
+        assert effects["dx"] == ["P2PEffect"]
+
+
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestProcessDisaggKill:
+    def test_kill_prefill_mid_handoff_zero_lost(self, tmp_path):
+        """ISSUE 8 acceptance: real prefill + decode worker processes
+        over a TCPKVStore. A scheduled chaos kill fires MID-TRANSFER in
+        the prefill worker (after one committed handoff, partway
+        through the parts of the next), so the store holds a partial
+        transfer. The decode side must discard it; the router's
+        journal-replay recovery requeues every accepted request — the
+        prefill pool now being EMPTY, via colocated fallback — with
+        zero losses and token-exact outputs."""
+        from paddle_tpu.distributed.store import TCPStoreServer
+        from paddle_tpu.inference.cluster import ProcessReplica
+
+        server = TCPStoreServer("127.0.0.1", 0)
+        procs, logs = [], []
+        N_PARTS = 4  # per-transfer legs = 4 parts + 1 commit = 5
+        # transfer 1 completes (legs 1-5); the kill at leg 7 dies ON
+        # part 2 of transfer 2 -> exactly one part written, no commit
+        kill_spec = "handoff.transfer@7=kill"
+        try:
+            reps = []
+            for rid, role, spec in (("pf0", "prefill", kill_spec),
+                                    ("dx0", "decode", None)):
+                env = dict(os.environ)
+                env.pop("PADDLE_CHAOS", None)
+                env.pop("XLA_FLAGS", None)
+                env.update({
+                    "DISAGG_ROLE": role,
+                    "DISAGG_STORE_PORT": str(server.port),
+                    "DISAGG_WORKER_ID": rid,
+                    "DISAGG_JOURNAL_DIR": str(tmp_path / rid),
+                    "DISAGG_DECODE_IDS": "dx0",
+                    "DISAGG_BUDGET": "240",
+                    "DISAGG_N_PARTS": str(N_PARTS),
+                    "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": REPO + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""),
+                })
+                if spec:
+                    env["PADDLE_CHAOS"] = spec
+                log = open(tmp_path / f"{rid}.log", "w")
+                logs.append(log)
+                p = subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(REPO, "tests", "_disagg_worker.py")],
+                    env=env, stdout=log, stderr=subprocess.STDOUT,
+                    cwd=REPO)
+                procs.append(p)
+                store = TCPKVStore("127.0.0.1", server.port)
+                reps.append(ProcessReplica(
+                    store, rid, journal_dir=str(tmp_path / rid),
+                    proc=p))
+            router = DisaggRouter([reps[0]], [reps[1]])
+
+            dl = Deadline(180)
+            store = TCPKVStore("127.0.0.1", server.port)
+            while not dl.expired():
+                hbs = [store.get(f"cluster/{r}/hb")
+                       for r in ("pf0", "dx0")]
+                if all(h is not None for h in hbs):
+                    break
+                time.sleep(0.25)
+            assert all(store.get(f"cluster/{r}/hb") is not None
+                       for r in ("pf0", "dx0")), "workers never heartbeat"
+
+            rng = np.random.RandomState(9)
+            prompts = {f"q{i}": rng.randint(0, 250, (16,))
+                       for i in range(5)}
+            for rid, p in prompts.items():
+                router.submit(rid, p, max_new_tokens=4)
+            res = router.run(deadline=240)
+
+            assert router.dead_prefill == {0}, "the kill never fired"
+            model = _model()
+            for rid, p in prompts.items():
+                assert rid in res, f"request {rid} was LOST"
+                assert res[rid]["status"] == "ok", (rid, res[rid])
+                want = _reference(model, p, 4)
+                assert res[rid]["out"] == want, (rid, res[rid]["out"],
+                                                 want)
+            # the partial transfer: parts present, commit absent, never
+            # acked — the decode side discarded it by construction
+            xfer = store.keys("disagg/dx0/xfer/")
+            part_seqs = {k.split("/xfer/")[1].split("/part/")[0]
+                         for k in xfer if "/part/" in k}
+            commit_seqs = {k.split("/xfer/")[1].rsplit("/", 1)[0]
+                           for k in xfer if k.endswith("/commit")}
+            partial = part_seqs - commit_seqs
+            assert partial, (
+                "expected a partial (killed mid-parts) transfer "
+                f"in the store; xfer keys: {xfer}")
+            for seq in partial:
+                assert store.get(f"disagg/dx0/ack/{seq}") is None
+            # the requeue went through colocated fallback (prefill
+            # pool down), not a shed storm
+            assert router.n_fallback > 0
+            ev = [e for e in router.events if e[0] == "prefill-dead"]
+            assert len(ev) == 1 and ev[0][1] == "pf0"
+            router.stop(deadline=20.0)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=10)
+            for log in logs:
+                log.close()
+            server.stop()

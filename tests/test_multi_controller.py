@@ -17,7 +17,16 @@ import socket
 import subprocess
 import sys
 
+import jax
 import pytest
+
+# the workers pin jax_num_cpu_devices=2 per process; jax builds without
+# that config option (e.g. 0.4.37) cannot run this scenario at all —
+# skip cleanly instead of failing the slow lane on such containers
+pytestmark = pytest.mark.skipif(
+    not hasattr(jax.config, "jax_num_cpu_devices"),
+    reason="this jax build lacks the jax_num_cpu_devices config option "
+           "the 2-process workers require")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "_mc_worker.py")
