@@ -102,6 +102,7 @@ from . import geometric  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import onnx  # noqa: F401
 from . import inference  # noqa: F401
+from . import training  # noqa: F401
 from . import audio  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 

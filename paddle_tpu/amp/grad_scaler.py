@@ -44,6 +44,7 @@ class AmpScaler:
         incr_every_n_steps: int = 1000,
         decr_every_n_nan_or_inf: int = 2,
         use_dynamic_loss_scaling: bool = True,
+        on_skip=None,
     ):
         if incr_ratio <= 1.0:
             raise ValueError("incr_ratio should be > 1")
@@ -61,6 +62,16 @@ class AmpScaler:
         self._bad_steps = jnp.asarray(0, jnp.int32)
         self._found_inf = jnp.asarray(False)
         self._opt_states: Dict[int, OptimizerState] = {}
+        # found_inf skip observability: host-side counters advanced at
+        # update() time, where the skip decision is settled. Counted
+        # only when found_inf is CONCRETE — inside a to_static trace it
+        # is a tracer and the threaded device state owns the semantics;
+        # callers on that path read _found_inf after the compiled step
+        # (jit restores a concrete value) instead of these counters.
+        self._n_skipped_steps = 0
+        self._last_skip_step = -1
+        self._n_updates = 0
+        self._on_skip = on_skip
 
     # ------------------------------------------------------------------
     def is_enable(self) -> bool:
@@ -184,6 +195,18 @@ class AmpScaler:
         """Advance the dynamic loss scale (ref: grad_scaler.py update)."""
         if not self._enable:
             return
+        if not isinstance(self._found_inf, jax.core.Tracer):
+            # observable skips: a silently-dropped step is an anomaly
+            # signal (the training supervisor's detector subscribes via
+            # on_skip); counters only advance on concrete values so a
+            # trace never leaks a tracer into host state
+            step_ix = self._n_updates
+            self._n_updates += 1
+            if bool(np.asarray(self._found_inf)):
+                self._n_skipped_steps += 1
+                self._last_skip_step = step_ix
+                if self._on_skip is not None:
+                    self._on_skip(step_ix)
         if self._use_dynamic_loss_scaling:
             found = self._found_inf
             # consecutive counters: a good step resets bad and vice versa
@@ -210,6 +233,24 @@ class AmpScaler:
             return optimizer.step()
         self.step(optimizer)
         self.update()
+
+    # ------------------------------------------------------------------
+    @property
+    def n_skipped_steps(self) -> int:
+        """How many update() cycles found inf/nan grads and skipped the
+        optimizer step (eager path; see update() for the jit caveat)."""
+        return self._n_skipped_steps
+
+    @property
+    def last_skip_step(self) -> int:
+        """0-based update() index of the most recent skipped step, or
+        -1 when no step has been skipped."""
+        return self._last_skip_step
+
+    def set_on_skip(self, callback) -> None:
+        """Install/replace the on-skip observer: ``callback(step_ix)``
+        fires at update() time for every skipped step."""
+        self._on_skip = callback
 
     # ------------------------------------------------------------------
     def get_scale_value(self) -> float:

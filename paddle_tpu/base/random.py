@@ -99,6 +99,57 @@ def next_key():
     return _default_generator.split()
 
 
+# -- checkpointable RNG state ------------------------------------------------
+# jax typed PRNG keys (key<fry> dtype) cannot pass through np.asarray, so
+# checkpoint writers (AutoCheckpoint, the training supervisor's peer
+# snapshots) lower them to plain uint32 arrays first. The tag dict keeps
+# the encoded form self-describing inside a pickled state tree.
+_KEY_TAG = "__paddle_tpu_prng_key__"
+
+
+def _encode_key(key):
+    if isinstance(key, dict) and key.get(_KEY_TAG) == 1:
+        return key  # already encoded (encoding is idempotent)
+    key_data = getattr(jax.random, "key_data", None)
+    raw = key_data(key) if key_data is not None else key
+    return {_KEY_TAG: 1, "data": np.asarray(jax.device_get(raw))}
+
+
+def _decode_key(enc):
+    if not (isinstance(enc, dict) and enc.get(_KEY_TAG) == 1):
+        return enc  # already a live key (in-memory snapshot path)
+    wrap = getattr(jax.random, "wrap_key_data", None)
+    data = jax.numpy.asarray(enc["data"])
+    # old jax without typed keys: the raw uint32 array IS the key
+    return wrap(data) if wrap is not None else data
+
+
+def encode_rng_state(state):
+    """Lower a :func:`get_rng_state`-shaped dict's PRNG keys to plain
+    numpy payloads — safe to pickle/``framework.io.save`` and to ship
+    across processes (peer-replicated snapshots)."""
+    return {
+        "default": _encode_key(state["default"]),
+        "tracker": {k: _encode_key(v)
+                    for k, v in state["tracker"].items()},
+    }
+
+
+def serializable_rng_state():
+    """:func:`encode_rng_state` of the CURRENT global RNG state."""
+    return encode_rng_state(get_rng_state())
+
+
+def restore_rng_state(state):
+    """Inverse of :func:`serializable_rng_state`; also accepts a live
+    :func:`get_rng_state` dict (keys pass through untouched)."""
+    set_rng_state({
+        "default": _decode_key(state["default"]),
+        "tracker": {k: _decode_key(v)
+                    for k, v in state["tracker"].items()},
+    })
+
+
 class RNGStatesTracker:
     """Named RNG branches for hybrid parallelism.
 

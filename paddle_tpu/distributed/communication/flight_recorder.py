@@ -49,6 +49,8 @@ __all__ = [
     "record",
     "reset",
     "attach_contract",
+    "register_dump_extra",
+    "unregister_dump_extra",
     "contract",
     "schedule_diff",
     "dump_on_watchdog",
@@ -60,8 +62,13 @@ __all__ = [
 # KV-handoff legs (inference/disagg.py) are the cross-ROLE analogue:
 # the prefill side records handoff_send where the decode side records
 # handoff_recv, so a hang dump can name both roles' schedules without
-# the contract calling the asymmetry a divergence.
-_RANK_DIVERGENT_OPS = ("send", "recv", "handoff_send", "handoff_recv")
+# the contract calling the asymmetry a divergence. ``train_step`` is
+# the training supervisor's per-step telemetry beacon
+# (training/telemetry.py): its detail carries per-rank step times and
+# gradient fingerprints — divergent by nature, but exactly what a hang
+# dump should print (the last steps each rank completed, and how slow).
+_RANK_DIVERGENT_OPS = ("send", "recv", "handoff_send", "handoff_recv",
+                       "train_step")
 
 
 @dataclass(frozen=True)
@@ -178,6 +185,27 @@ _recorder_lock = threading.Lock()
 # (store, rank, world_size) when a contract has been attached — lets
 # the watchdog publish/fetch schedules while the process still can
 _contract_binding: Optional[Tuple] = None
+# extra sections appended to the watchdog dump: fn(file) callables
+# registered by subsystems with hang-relevant evidence of their own
+# (training.telemetry names persistent stragglers here, so a hang dump
+# answers "WHO is slow", not just "we are hung")
+_dump_extras: List = []
+
+
+def register_dump_extra(fn) -> None:
+    """Append ``fn(file)`` to the watchdog dump. Re-registering the
+    same callable is a no-op; :func:`unregister_dump_extra` removes one
+    (retired subsystem instances must not keep writing stale evidence
+    into dumps — or be retained forever); :func:`reset` clears all."""
+    if fn not in _dump_extras:
+        _dump_extras.append(fn)
+
+
+def unregister_dump_extra(fn) -> None:
+    try:
+        _dump_extras.remove(fn)
+    except ValueError:
+        pass
 
 
 def recorder() -> FlightRecorder:
@@ -196,11 +224,12 @@ def record(op: str, shape: Tuple[int, ...] = (), dtype: str = "",
 
 
 def reset() -> None:
-    """Drop the recorder and any contract binding (tests)."""
+    """Drop the recorder, contract binding and dump extras (tests)."""
     global _recorder, _contract_binding
     with _recorder_lock:
         _recorder = None
         _contract_binding = None
+        del _dump_extras[:]
 
 
 def attach_contract(store, rank: int, world_size: int) -> None:
@@ -410,6 +439,16 @@ def dump_on_watchdog(file) -> None:
     previous incident."""
     rec = recorder()
     rec.dump(file, header="CollectiveFlightRecorder (watchdog dump)")
+    for extra in list(_dump_extras):
+        try:
+            extra(file)
+        except Exception as e:  # noqa: BLE001 — diagnostics must not raise
+            try:
+                file.write(f"CollectiveFlightRecorder: dump extra "
+                           f"{getattr(extra, '__qualname__', extra)!r} "
+                           f"failed ({type(e).__name__}: {e})\n")
+            except Exception:  # noqa: BLE001
+                pass
     binding = _contract_binding
     if binding is None:
         return

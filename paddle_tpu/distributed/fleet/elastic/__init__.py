@@ -48,7 +48,8 @@ class ElasticManager:
 
     def __init__(self, store_dir: str | KVStore, node_id: Optional[str] = None,
                  np=1, heartbeat_interval: float = 2.0,
-                 elastic_timeout: float = 30.0):
+                 elastic_timeout: float = 30.0,
+                 max_beat_failures: Optional[int] = None):
         """``store_dir``: a shared-directory path, a ``tcp://host:port``
         store location, or a KVStore instance."""
         self.store_dir = store_dir if isinstance(store_dir, str) else None
@@ -68,6 +69,20 @@ class ElasticManager:
         self._thread: Optional[threading.Thread] = None
         self._registered_world: Optional[List[str]] = None
         self.need_sync = False
+        # heartbeat self-diagnosis: a beat thread that cannot reach the
+        # store for longer than the eviction window is functionally a
+        # dead node — peers have already (or will imminently) evict it,
+        # so keeping a zombie thread silently retrying just hides the
+        # failure from the trainer. Default threshold ≈ the number of
+        # beats that fit in elastic_timeout (min 3): self-declared death
+        # lines up with peer-declared death.
+        if max_beat_failures is None:
+            max_beat_failures = max(
+                3, int(elastic_timeout / max(heartbeat_interval, 1e-6)))
+        self.max_beat_failures = int(max_beat_failures)
+        self._beat_failures = 0
+        self._last_beat_error: Optional[BaseException] = None
+        self._dead = False
 
     # -- membership ----------------------------------------------------
     def _beat(self):
@@ -141,14 +156,25 @@ class ElasticManager:
             while not self._stop.wait(self.heartbeat_interval):
                 # a transient store error (TCP reset, brief master
                 # overload) must not kill the heartbeat — a dead beat
-                # thread gets a healthy node evicted
+                # thread gets a healthy node evicted. But REPEATED
+                # failures past max_beat_failures mean the node cannot
+                # advertise liveness at all: mark self dead, keep the
+                # last error for health(), and stop beating (silently
+                # retrying forever would hide the failure from the
+                # trainer while peers evict us anyway).
                 try:
                     self._beat()
+                    self._beat_failures = 0
                     if self.world_changed():
                         self.need_sync = True
-                except (OSError, ValueError, RuntimeError):
+                except (OSError, ValueError, RuntimeError) as e:
                     # OSError: connect/reset; ValueError: truncated
                     # response mid-close; RuntimeError: server-side error
+                    self._beat_failures += 1
+                    self._last_beat_error = e
+                    if self._beat_failures >= self.max_beat_failures:
+                        self._dead = True
+                        return
                     continue
 
         self._thread = threading.Thread(target=loop, daemon=True)
@@ -175,6 +201,27 @@ class ElasticManager:
 
     def should_shrink(self) -> bool:
         return len(self.alive_nodes()) < self.min_np
+
+    def health(self) -> dict:
+        """Structured liveness self-report: whether THIS node is still
+        advertising (beat thread alive and under the failure threshold),
+        how many consecutive beats have failed, and the last beat error
+        — the surface the training supervisor and tests read instead of
+        inferring node health from peers' eviction decisions."""
+        beating = (self._thread is not None and self._thread.is_alive()
+                   and not self._stop.is_set())
+        return {
+            "node_id": self.node_id,
+            "alive": not self._dead and beating,
+            "dead": self._dead,
+            "beating": beating,
+            "consecutive_beat_failures": self._beat_failures,
+            "last_beat_error": (None if self._last_beat_error is None
+                                else repr(self._last_beat_error)),
+            "max_beat_failures": self.max_beat_failures,
+            "registered_world": self._registered_world,
+            "rank": self.rank(),
+        }
 
     def exit(self):
         """Leave cleanly (ref: manager.py exit): stop beating, remove

@@ -17,7 +17,7 @@ from typing import Any
 import jax
 import numpy as np
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "dumps", "loads"]
 
 _PROTOCOL = 4
 
@@ -102,6 +102,18 @@ def save(obj: Any, path: str, pickle_protocol: int = _PROTOCOL, **configs):
     payload = _to_serializable(obj)
     with open(path, "wb") as f:
         pickle.dump(payload, f, protocol=pickle_protocol)
+
+
+def dumps(obj: Any, pickle_protocol: int = _PROTOCOL) -> bytes:
+    """:func:`save` to bytes instead of a file — the wire format the
+    training supervisor's peer-replicated snapshots ship over the KV
+    store (``put_bytes`` adds length+CRC framing on top)."""
+    return pickle.dumps(_to_serializable(obj), protocol=pickle_protocol)
+
+
+def loads(data: bytes, return_numpy: bool = False) -> Any:
+    """Inverse of :func:`dumps`."""
+    return _from_serializable(pickle.loads(data), return_numpy)
 
 
 def load(path: str, return_numpy: bool = False, **configs) -> Any:

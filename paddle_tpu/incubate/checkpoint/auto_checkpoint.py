@@ -81,6 +81,9 @@ class AutoCheckpoint:
         async_save: bool = True,
         extra_state=None,
         set_extra_state=None,
+        track_rng: bool = True,
+        data_cursor=None,
+        copy_capture: bool = False,
     ):
         directory = directory or os.getenv(ELASTIC_AUTO_CHECKPOINT_DIR)
         if not directory:
@@ -100,40 +103,73 @@ class AutoCheckpoint:
         self.async_save = bool(async_save)
         self._extra_state = extra_state
         self._set_extra_state = set_extra_state
+        # token-exact resume needs more than params+moments: the RNG
+        # streams (dropout masks, data augmentation) and the dataloader
+        # position must both land back where the saved step left them —
+        # otherwise resume restarts the epoch iterator and the resumed
+        # run silently diverges from the uninterrupted one. ``track_rng``
+        # records base.random's full state (keys lowered to plain
+        # arrays); ``data_cursor`` is any object with ``state_dict()`` /
+        # ``set_state_dict()`` (e.g. training.DataCursor).
+        self.track_rng = bool(track_rng)
+        self.data_cursor = data_cursor
+        # copy_capture=True: capture DEVICE COPIES instead of
+        # references. Reference capture is safe for eager training (jax
+        # arrays are immutable) but a jit.to_static step compiled with
+        # donate_state=True (the default) DELETES the old param/moment
+        # buffers on its next call — an async save racing that step
+        # would pickle tombstones and fail. The training supervisor
+        # sets this to match its own copy_snapshots.
+        self.copy_capture = bool(copy_capture)
         self._last_save_time = time.monotonic()
         self._worker: Optional[threading.Thread] = None
         self._save_error: Optional[BaseException] = None
 
     # -- state capture ---------------------------------------------------
     @staticmethod
-    def _snapshot(obj):
+    def _snapshot(obj, copy: bool = False):
         """Capture VALUES, not live Tensor references: jax arrays are
         immutable, so pinning the current ``_data`` in a FRESH Tensor
         wrapper fixes this step's state even while the train thread
         keeps rebinding the Parameters — without it an async save could
         serialize a torn mix of step-N and step-N+1 weights. Fresh
         Tensors (not raw arrays) keep the serialized tree's types
-        identical to a synchronous save."""
+        identical to a synchronous save. ``copy=True`` additionally
+        device-copies each leaf (donated compiled state deletes the
+        referenced buffers — see ``copy_capture``)."""
         if isinstance(obj, dict):
-            return {k: AutoCheckpoint._snapshot(v) for k, v in obj.items()}
+            return {k: AutoCheckpoint._snapshot(v, copy)
+                    for k, v in obj.items()}
         if isinstance(obj, (list, tuple)) and not hasattr(obj, "_fields"):
-            return type(obj)(AutoCheckpoint._snapshot(v) for v in obj)
+            return type(obj)(AutoCheckpoint._snapshot(v, copy) for v in obj)
         data = getattr(obj, "_data", None)
         if data is not None:
             from ...base.tensor import Tensor
 
+            if copy:
+                import jax.numpy as jnp
+
+                data = jnp.copy(data)
             return Tensor(data, _internal=True)
         return obj
 
     def _capture(self, step: int) -> dict:
+        cp = self.copy_capture
         state = {
             "step": int(step),
-            "model": [self._snapshot(l.state_dict()) for l in self.layers],
-            "optim": [self._snapshot(o.state_dict())
+            "model": [self._snapshot(l.state_dict(), cp)
+                      for l in self.layers],
+            "optim": [self._snapshot(o.state_dict(), cp)
                       for o in self.optimizers],
         }
         if self._extra_state is not None:
             state["extra"] = self._extra_state()
+        if self.track_rng:
+            from ...base import random as _random
+
+            state["rng"] = _random.serializable_rng_state()
+        if self.data_cursor is not None:
+            state["cursor"] = self.data_cursor.state_dict()
         return state
 
     # -- paths -----------------------------------------------------------
@@ -327,8 +363,30 @@ class AutoCheckpoint:
                 opt.set_state_dict(sd)
             if self._set_extra_state is not None and "extra" in state:
                 self._set_extra_state(state["extra"])
+            if self.track_rng and "rng" in state:
+                from ...base import random as _random
+
+                _random.restore_rng_state(state["rng"])
+            if self.data_cursor is not None and "cursor" in state:
+                self.data_cursor.set_state_dict(state["cursor"])
             return step + 1
         return 0
+
+    def latest_step(self) -> Optional[int]:
+        """Step of the newest VERIFIED checkpoint, without loading it —
+        the training supervisor compares this against the peer-RAM
+        tier's step to pick the freshest recovery source. Mirrors
+        resume()'s triage: transiently-unreadable checkpoints are
+        skipped, proven-corrupt ones quarantined."""
+        for step, path in reversed(self._list_ckpts()):
+            intact = self._verify(path)
+            if intact is None:
+                continue
+            if intact is False:
+                self._quarantine(path)
+                continue
+            return step
+        return None
 
 
 class TrainEpochRange:

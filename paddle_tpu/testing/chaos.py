@@ -45,6 +45,21 @@ Instrumented sites (grep for ``chaos.inject``):
   verifies + imports (inference/disagg.py); a ``drop`` defers the
   import to the next poll
 - ``train.step``         — opt-in: training loops/test workers call it
+- ``train.nan``          — each supervised training step
+  (training/supervisor.py); a ``drop`` poisons that step's batch with
+  NaN — loss/grads go non-finite and the optimizer step corrupts the
+  params, exactly what anomaly-triggered rollback must undo
+- ``train.spike``        — each supervised training step; a ``drop``
+  scales the batch so the loss spikes finite-but-huge — the EWMA+MAD
+  gate's case (non-finite checks never fire)
+- ``train.sdc``          — each supervised training step; a ``drop``
+  perturbs one batch element slightly — loss stays plausible but the
+  gradient fingerprint diverges from the dp peers', the silent-data-
+  corruption shape only cross-rank fingerprint exchange catches
+- ``ckpt.peer``          — each peer-snapshot publish leg
+  (training/peer_snapshot.py); a byte site — ``corrupt`` flips a
+  payload bit (the put_bytes CRC framing must catch it at restore),
+  ``drop`` loses the publish (recovery falls to an older tier)
 
 Faults (``Fault.kind``): ``hang``/``slow`` (sleep ``arg`` seconds;
 ``hang`` requires a positive arg), ``reset`` (raise

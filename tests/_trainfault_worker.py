@@ -1,0 +1,126 @@
+"""Fault-tolerant-training worker (one dp replica rank, driven by
+tests/test_trainfault.py::TestTwoProcessKillPeerResume and
+benchmarks/trainfault_bench.py).
+
+Each rank trains an IDENTICAL tiny model on an identical data stream
+(bit-exact dp replicas without needing multi-controller jax), under a
+TrainingSupervisor wired to the shared TCP store: peer-replicated
+in-memory snapshots (PeerReplicator) and cross-rank telemetry
+(TrainTelemetry). ``chaos.inject("train.step")`` at the top of every
+step is the kill site; ``train.nan``/``train.spike``/``train.sdc``
+fire inside the supervisor itself.
+
+On start the worker calls ``resume()``: a relaunched rank restores
+from the freshest verified tier (peer RAM preferred; disk only when
+TF_DIR is set) and reports which one it used.
+
+env:
+  TF_STORE   — host:port of the parent's TCPStoreServer
+  TF_RANK    — this rank (0-based)
+  TF_WORLD   — world size
+  TF_TOTAL   — total steps to train
+  TF_TAG     — key namespace (one per wave)
+  TF_DIR     — optional scratch dir: enables the disk AutoCheckpoint tier
+  TF_SNAP    — snapshot/peer interval (default 5)
+  PADDLE_CHAOS — optional fault schedule
+"""
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 1)
+except AttributeError:  # older jax: default is one CPU device already
+    pass
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+import paddle_tpu.optimizer as popt  # noqa: E402
+from paddle_tpu.distributed.store import TCPKVStore  # noqa: E402
+from paddle_tpu.incubate.checkpoint.auto_checkpoint import (  # noqa: E402
+    AutoCheckpoint,
+)
+from paddle_tpu.testing import chaos  # noqa: E402
+from paddle_tpu.training import (  # noqa: E402
+    PeerReplicator,
+    TrainingSupervisor,
+    TrainTelemetry,
+)
+from paddle_tpu.utils.retries import Deadline  # noqa: E402
+
+
+def main():
+    host, port = os.environ["TF_STORE"].rsplit(":", 1)
+    rank = int(os.environ["TF_RANK"])
+    world = int(os.environ["TF_WORLD"])
+    total = int(os.environ["TF_TOTAL"])
+    tag = os.environ.get("TF_TAG", "tfw")
+    snap = int(os.environ.get("TF_SNAP", "5"))
+
+    store = TCPKVStore(host, int(port), timeout=10.0)
+    store.wait_alive(deadline=Deadline(30.0))
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = popt.AdamW(learning_rate=1e-2, parameters=model.parameters())
+
+    rng = np.random.RandomState(7)
+    data = [
+        (rng.randn(8, 8).astype(np.float32),
+         rng.randint(0, 4, (8,)).astype(np.int64))
+        for _ in range(64)
+    ]
+
+    def batch_fn(i):
+        return data[(i - 1) % len(data)]
+
+    def step_fn(batch):
+        # the kill site: a scheduled 'kill' dies mid-step, exactly like
+        # a real worker death (state for this step never completes)
+        if not chaos.inject("train.step"):
+            pass  # a 'drop' here would skip nothing — sites are opt-in
+        x = paddle.to_tensor(batch[0])
+        y = paddle.to_tensor(batch[1])
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    ac = None
+    if os.environ.get("TF_DIR"):
+        ac = AutoCheckpoint(
+            os.path.join(os.environ["TF_DIR"], f"rank-{rank}"),
+            layers=[model], optimizers=[opt],
+            save_interval_steps=snap, async_save=False)
+    sup = TrainingSupervisor(
+        step_fn, batch_fn, layers=[model], optimizers=[opt],
+        snapshot_interval=snap,
+        peer=PeerReplicator(store, rank, world, tag=tag),
+        auto_checkpoint=ac,
+        telemetry=TrainTelemetry(store, rank, world, tag=tag,
+                                 straggler_patience=10_000),
+        telemetry_interval=2,
+    )
+    start = sup.resume()
+    tier = "fresh"
+    for kind, detail in sup.events:
+        if kind == "resume":
+            tier = ("peer" if "peer RAM" in detail
+                    else "disk" if "disk" in detail else "fresh")
+    print(f"resumed step={start} tier={tier}", flush=True)
+
+    rep = sup.run(total)
+    sup.peer.wait()
+    print(f"DONE rank={rank} final_loss={rep['final_loss']:.8f} "
+          f"rollbacks={rep['rollbacks']} "
+          f"quarantined={rep['quarantined']}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

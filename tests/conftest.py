@@ -93,6 +93,12 @@ def pytest_configure(config):
         "disagg: disaggregated prefill/decode + KV-handoff suite "
         "(quick-lane units; the 2-process kill test rides the slow "
         "lane; standalone via `pytest -m disagg`)")
+    config.addinivalue_line(
+        "markers",
+        "trainfault: fault-tolerant training suite — anomaly detection/"
+        "rollback/peer-snapshot/telemetry units (quick lane; the "
+        "2-process kill->peer-RAM-resume proof rides the slow lane; "
+        "standalone via `pytest -m trainfault`)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -437,3 +437,115 @@ class TestTrainEpochRange:
         # iterating again resumes past the completed epochs (no repeat)
         assert list(r2) == []
         assert r2.start_epoch == 5
+
+
+class TestResumeExactness:
+    """Satellite (ISSUE 9): the snapshot dict now records the RNG state
+    and the dataloader cursor, and resume round-trips AdamW moments +
+    the LR-scheduler step count exactly — token-exact rollback's disk
+    tier."""
+
+    def _rig(self, tmp_path, cursor=None):
+        from paddle_tpu.optimizer.lr import StepDecay
+
+        paddle.seed(0)
+        model = nn.Linear(4, 3)
+        sched = StepDecay(learning_rate=0.01, step_size=5)
+        optimizer = opt.AdamW(learning_rate=sched,
+                              parameters=model.parameters())
+        ac = AutoCheckpoint(str(tmp_path), layers=[model],
+                            optimizers=[optimizer], save_interval_steps=4,
+                            async_save=False, data_cursor=cursor)
+        return model, optimizer, sched, ac
+
+    def _steps(self, model, optimizer, sched, ac, start, n):
+        rng = np.random.RandomState(7)
+        for step in range(1, start + n):
+            x_np = rng.randn(8, 4).astype(np.float32)
+            y_np = rng.randint(0, 3, (8,)).astype(np.int64)
+            if step < start:
+                continue
+            loss = F.cross_entropy(model(paddle.to_tensor(x_np)),
+                                   paddle.to_tensor(y_np))
+            loss.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+            sched.step()
+            ac.step(step)
+        return float(loss)
+
+    def test_adamw_moments_and_sched_step_round_trip(self, tmp_path):
+        model, optimizer, sched, ac = self._rig(tmp_path)
+        self._steps(model, optimizer, sched, ac, 1, 8)  # ckpt at 4, 8
+        want_m = {k: np.asarray(v._data if hasattr(v, "_data") else v)
+                  for k, v in optimizer.state_dict().items()
+                  if hasattr(v, "_data")}
+        want_epoch = sched.last_epoch
+
+        model2, optimizer2, sched2, ac2 = self._rig(tmp_path)
+        assert ac2.resume() == 9
+        got = optimizer2.state_dict()
+        # positional remap: compare per-accumulator in parameter order
+        got_m = {k: np.asarray(v._data if hasattr(v, "_data") else v)
+                 for k, v in got.items() if hasattr(v, "_data")}
+        assert len(got_m) == len(want_m)
+        for (wk, wv), (gk, gv) in zip(sorted(want_m.items()),
+                                      sorted(got_m.items())):
+            np.testing.assert_array_equal(wv, gv)
+        assert optimizer2._global_step == optimizer._global_step
+        assert sched2.last_epoch == want_epoch
+        assert sched2() == sched()
+
+    def test_rng_state_round_trips(self, tmp_path):
+        model, optimizer, sched, ac = self._rig(tmp_path)
+        self._steps(model, optimizer, sched, ac, 1, 4)
+        paddle.seed(1234)
+        _ = paddle.randn([3])       # advance the stream past the save
+        ac.save_now(5, block=True)
+        want = np.asarray(paddle.randn([4])._data)  # post-save draws
+
+        model2, optimizer2, sched2, ac2 = self._rig(tmp_path)
+        paddle.seed(999)  # a DIFFERENT stream the resume must replace
+        assert ac2.resume() == 6
+        got = np.asarray(paddle.randn([4])._data)
+        np.testing.assert_array_equal(want, got)
+
+    def test_data_cursor_round_trips(self, tmp_path):
+        from paddle_tpu.training import DataCursor
+
+        cursor = DataCursor(lambda i: i)
+        cursor.quarantine(7)
+        model, optimizer, sched, ac = self._rig(tmp_path, cursor=cursor)
+        self._steps(model, optimizer, sched, ac, 1, 4)
+
+        cursor2 = DataCursor(lambda i: i)
+        model2, optimizer2, sched2, ac2 = self._rig(tmp_path,
+                                                    cursor=cursor2)
+        assert ac2.resume() == 5
+        assert cursor2.quarantined == [7]
+
+    def test_resumed_training_matches_uninterrupted(self, tmp_path):
+        model, optimizer, sched, ac = self._rig(tmp_path / "ref")
+        want = self._steps(model, optimizer, sched, ac, 1, 12)
+
+        model1, optimizer1, sched1, ac1 = self._rig(tmp_path / "re")
+        self._steps(model1, optimizer1, sched1, ac1, 1, 8)  # ckpt at 8
+        model2, optimizer2, sched2, ac2 = self._rig(tmp_path / "re")
+        start = ac2.resume()
+        assert start == 9
+        got = self._steps(model2, optimizer2, sched2, ac2, start, 4)
+        np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+    def test_latest_step_reports_newest_verified(self, tmp_path):
+        model, optimizer, sched, ac = self._rig(tmp_path)
+        assert ac.latest_step() is None
+        self._steps(model, optimizer, sched, ac, 1, 8)
+        assert ac.latest_step() == 8
+        # corrupt the newest payload: latest_step quarantines it and
+        # reports the older intact checkpoint
+        newest = os.path.join(str(tmp_path), "ckpt-" + "8".zfill(12),
+                              "state.pdparams")
+        with open(newest, "r+b") as f:
+            f.seek(10)
+            f.write(b"\xff\xff")
+        assert ac.latest_step() == 4

@@ -82,3 +82,68 @@ class TestElastic:
         time.sleep(0.5)
         assert "node-ghost" not in a.alive_nodes()
         a.exit()
+
+
+class TestHeartbeatSelfDiagnosis:
+    """Satellite (ISSUE 9): repeated beat failures must not be silently
+    swallowed forever — the manager marks itself dead, surfaces the
+    error via health(), and stops advertising liveness."""
+
+    def test_chaos_failing_store_marks_self_dead(self, tmp_path):
+        from paddle_tpu.testing import chaos
+        from paddle_tpu.testing.chaos import ChaosSchedule
+
+        m = _mgr(tmp_path, "node-a", np=1, heartbeat_interval=0.02,
+                 max_beat_failures=3)
+        m.register()
+        try:
+            assert m.health()["alive"]
+            # every beat from here on errors (the chaos-failing store)
+            with chaos.active(ChaosSchedule().every(
+                    "elastic.heartbeat", 1, "error")):
+                deadline = time.time() + 5.0
+                while not m.health()["dead"] and time.time() < deadline:
+                    time.sleep(0.02)
+            h = m.health()
+            assert h["dead"] and not h["alive"]
+            assert h["consecutive_beat_failures"] >= 3
+            assert "injected error" in h["last_beat_error"]
+            # liveness is no longer advertised: the beat thread exited,
+            # so the stored entry ages out instead of refreshing
+            m._thread.join(2.0)
+            assert not m._thread.is_alive()
+            v1 = m.store.get("nodes/node-a")
+            time.sleep(0.1)
+            assert m.store.get("nodes/node-a") == v1
+        finally:
+            m.exit()
+            chaos.uninstall()
+
+    def test_transient_failures_below_threshold_recover(self, tmp_path):
+        from paddle_tpu.testing import chaos
+        from paddle_tpu.testing.chaos import ChaosSchedule
+
+        m = _mgr(tmp_path, "node-a", np=1, heartbeat_interval=0.02,
+                 max_beat_failures=50)
+        m.register()
+        try:
+            # a SHORT failure streak (below the threshold), then healthy
+            # beats again — the streak resets and the node stays alive
+            # (transient blips must not kill healthy nodes)
+            with chaos.active(ChaosSchedule()
+                              .every("elastic.heartbeat", 1, "error")):
+                deadline = time.time() + 5.0
+                while (m.health()["consecutive_beat_failures"] < 2
+                       and time.time() < deadline):
+                    time.sleep(0.01)
+            assert m.health()["consecutive_beat_failures"] >= 2
+            deadline = time.time() + 5.0
+            while (m.health()["consecutive_beat_failures"] > 0
+                   and time.time() < deadline):
+                time.sleep(0.01)
+            h = m.health()
+            assert h["alive"] and not h["dead"]
+            assert h["consecutive_beat_failures"] == 0
+        finally:
+            m.exit()
+            chaos.uninstall()
