@@ -36,6 +36,19 @@ costs single-digit ms, so unified chunked wins on CPU; the latency-
 independence claim is the TPU column, where a real model's chunk
 stalls decode for tens of ms and transfers ride ICI/DMA.
 
+Part 6 (``--overlap``, ISSUE 10): the async host/device pipelining
+A/B — the SAME decode-heavy chunked workload served by the sync engine
+(blocking D2H fetch + full table/cache_len re-upload every step) and
+the ``overlap=True`` engine (device-resident step state, lag-1 copy
+ring, dirty-slot uploads). Reports per mode: decode tokens/s, the
+decode-phase host-blocked fraction (blocked-in-fetch seconds / step
+seconds, steady-state delta), and H2D upload bytes per decode token —
+the two quantities the pipeline exists to shrink — plus a BITWISE
+output-stream equality check (the token-exactness acceptance gate).
+On CPU the dispatch itself is cheap, so the blocked-fraction drop is
+the mechanism proof; the tok/s win is the TPU column (dispatch/RTT
+dominates serving-size decode there — BASELINE.md decode rows).
+
 Part 3 (``--overload``, ISSUE 4): offered load ≈ 2x measured capacity,
 mixed interactive/batch priorities with per-class deadlines, admission
 control ON. The overload-control claim: every rejection happens at
@@ -579,6 +592,96 @@ def disagg(model, config, on_tpu, dev):
     }), flush=True)
 
 
+def overlap_ab(model, config, on_tpu, dev):
+    """Part 6 (``--overlap``, ISSUE 10): sync vs async-pipelined engine
+    over one decode-heavy workload — host-blocked fraction, H2D bytes
+    per decode token, tok/s, and the bitwise stream-equality gate."""
+    budget_s = float(os.environ.get("BENCH_TOTAL_BUDGET", "600"))
+    dl = Deadline(budget_s * 0.85)  # reserve tail for the JSON emit
+    if on_tpu:
+        B, MAX_LEN, BS, CHUNK, GEN = 16, 1024, 64, 256, 128
+        n_req, plens = 48, (128, 256)
+    else:
+        B, MAX_LEN, BS, CHUNK, GEN = 4, 128, 8, 16, 24
+        n_req, plens = 12, (5, 9, 14)
+
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, config.vocab_size,
+                           (int(plens[i % len(plens)]),))
+               for i in range(n_req)]
+
+    def run_mode(overlap):
+        eng = ContinuousBatchingEngine(
+            model, max_batch=B, max_len=MAX_LEN, block_size=BS,
+            num_blocks=B * (-(-MAX_LEN // BS)) + 4, prefill_chunk=CHUNK,
+            overlap=overlap)
+        # warm every compiled phase (prefill, decode, update_slot)
+        # outside the measured window, then DELTA the transfer/blocked
+        # counters so the row is steady-state, not warmup
+        eng.add_request("warm", np.ones(1, np.int32), max_new_tokens=4)
+        eng.run()
+        base = eng.overlap_stats()
+        dec0, steps0 = eng.decode_tokens, eng.steps
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            eng.add_request(i, p, max_new_tokens=GEN)
+        done = eng.run()
+        wall = time.perf_counter() - t0
+        st = eng.overlap_stats()
+        streams = {i: list(done[i].out) for i in range(n_req) if i in done}
+        assert all(done[i].status == "ok" for i in range(n_req))
+        dec = eng.decode_tokens - dec0
+        busy = st["busy_s"] - base["busy_s"]
+        blocked = st["host_blocked_s"] - base["host_blocked_s"]
+        row = {
+            "mode": "overlap" if overlap else "sync",
+            "decode_tokens_per_sec": round(dec / wall, 1),
+            "host_blocked_frac": round(blocked / busy, 4) if busy else None,
+            "host_blocked_s": round(blocked, 4),
+            "h2d_decode_bytes_per_token": round(
+                (st["h2d_decode_bytes"] - base["h2d_decode_bytes"])
+                / max(dec, 1), 1),
+            "dispatches": st["dispatches"] - base["dispatches"],
+            "tokens_per_dispatch": round(
+                dec / max(st["dispatches"] - base["dispatches"], 1), 2),
+            "wall_s": round(wall, 2), "steps": eng.steps - steps0,
+        }
+        return streams, row
+
+    sync_streams, sync_row = run_mode(False)
+    # honor the budget between modes: a blown-out sync half (slow TPU
+    # compile, wedged tunnel) still emits its JSON row inside the
+    # window instead of dying mid-A/B with no output at all
+    ovl_streams, ovl_row = (None, None)
+    if not dl.expired():
+        ovl_streams, ovl_row = run_mode(True)
+    identical = ovl_streams is not None and sync_streams == ovl_streams
+    print(json.dumps({
+        "metric": "serving_overlap_host_blocked_frac",
+        "value": ovl_row["host_blocked_frac"] if ovl_row else None,
+        "unit": "blocked/busy (overlap mode; sync row beside)",
+        "extra": {
+            "overlap": ovl_row, "sync": sync_row,
+            "identical_streams": identical,
+            "stopped_early": ovl_row is None,
+            "blocked_frac_drop_x": round(
+                sync_row["host_blocked_frac"]
+                / ovl_row["host_blocked_frac"], 2)
+            if ovl_row and ovl_row["host_blocked_frac"] else None,
+            "h2d_bytes_drop_x": round(
+                sync_row["h2d_decode_bytes_per_token"]
+                / ovl_row["h2d_decode_bytes_per_token"], 2)
+            if ovl_row and ovl_row["h2d_decode_bytes_per_token"]
+            else None,
+            "requests": n_req, "gen_per_req": GEN, "max_batch": B,
+            "prefill_chunk": CHUNK, "budget_s": budget_s,
+            "device": getattr(dev, "device_kind", str(dev)),
+        },
+    }), flush=True)
+    assert ovl_row is None or identical, \
+        "overlap output streams diverged from sync"
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sustained-only", action="store_true")
@@ -596,6 +699,12 @@ def main():
                          "4096-token prefills, 2-process KV handoff vs "
                          "unified chunked, plus the kill-the-prefill-"
                          "pool fallback phase (under BENCH_TOTAL_BUDGET)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="run only the async host/device pipelining "
+                         "A/B: sync vs overlap=True engine over the "
+                         "same decode-heavy workload — host-blocked "
+                         "fraction, H2D bytes/token, tok/s, bitwise "
+                         "stream equality (under BENCH_TOTAL_BUDGET)")
     args = ap.parse_args()
 
     import jax
@@ -623,6 +732,9 @@ def main():
         return
     if args.disagg:
         disagg(model, config, on_tpu, dev)
+        return
+    if args.overlap:
+        overlap_ab(model, config, on_tpu, dev)
         return
     if not args.mixed_only:
         sustained(model, config, on_tpu, dev)

@@ -22,6 +22,14 @@ DDL001    warning  blocking call (socket recv/accept, queue.get,
 DONATE001 error    array used after being passed to a jit with
                    ``donate_argnums`` — the buffer is dead; XLA may have
                    already overwritten it
+HOTSYNC001 warning blocking ``np.asarray``/``.item()``/``device_get``
+                   on a jitted output inside a ``while``/``for`` loop or
+                   a ``step`` function of an inference/ module — the
+                   serving hot path; the device idles while the host
+                   blocks (ISSUE 10's async-pipeline gap). Sanctioned
+                   escape: start ``copy_to_host_async()`` on the value
+                   first (the copy-ring idiom), or route the fetch
+                   through the engine's accounted ``_fetch`` seam
 ========= ======== ====================================================
 
 All rules are intraprocedural and name-based — modular by design
@@ -410,6 +418,124 @@ def _blocking_get(call: ast.Call) -> bool:
     # dict.get(key[, default]) takes positional args; queue.get()'s
     # blocking form is argument-free (or block=True)
     return not call.args
+
+
+# ---------------------------------------------------------------------------
+# HOTSYNC001 — blocking device sync on a jitted output in a serving hot
+# loop (ISSUE 10: the async host/device pipelining gap)
+
+_FETCH_CALLS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+                "jax.device_get"}
+
+
+def _jit_output_names(fndef: ast.AST) -> Dict[str, int]:
+    """Names assigned from a jit-wrapper invocation in this function:
+    ``x = self._decode_jit(...)``, ``toks, pools = self._run_jit(...)``
+    — the values a blocking fetch forces the host to wait on. Returns
+    {name: first assignment line}."""
+    out: Dict[str, int] = {}
+    for node in walk_scope(fndef):
+        if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call):
+            continue
+        tail = (dotted_name(node.value.func) or "").split(".")[-1]
+        if not (tail.endswith("_jit") or tail in ("run_jit", "_run_jit")):
+            continue
+        for t in node.targets:
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            for e in elts:
+                if isinstance(e, ast.Name):
+                    out.setdefault(e.id, node.lineno)
+    return out
+
+
+def _async_copied_names(fndef: ast.AST) -> Dict[str, int]:
+    """Names on which ``copy_to_host_async()`` was started — the
+    sanctioned copy-ring idiom: by the time the gather runs, the D2H
+    copy (and, pipelined, the compute) is already in flight."""
+    out: Dict[str, int] = {}
+    for node in walk_scope(fndef):
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) \
+                and node.func.attr == "copy_to_host_async" \
+                and isinstance(node.func.value, ast.Name):
+            out.setdefault(node.func.value.id, node.lineno)
+    return out
+
+
+def _hot_fetches(scope: ast.AST, jit_names: Dict[str, int],
+                 asynced: Dict[str, int]):
+    """(call, name, kind) for blocking fetches of jit outputs inside
+    ``scope``, skipping names whose async copy started earlier."""
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        name = kind = None
+        dotted = dotted_name(node.func)
+        if dotted in _FETCH_CALLS and node.args and isinstance(
+                node.args[0], ast.Name):
+            name, kind = node.args[0].id, dotted
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" \
+                and isinstance(node.func.value, ast.Name):
+            name, kind = node.func.value.id, ".item()"
+        if name is None or name not in jit_names:
+            continue
+        if name in asynced and asynced[name] < node.lineno:
+            continue  # copy-ring idiom: the copy is already in flight
+        yield node, name, kind
+
+
+@register_rule(
+    "HOTSYNC001", severity="warning",
+    summary="blocking fetch of a jitted output in a serving hot loop "
+            "(np.asarray/.item()/device_get on a *_jit result inside a "
+            "while/for loop or step function of an inference/ module)",
+    hint="the device idles while the host blocks — the dispatch/RTT "
+         "gap the async engine pipeline closes (ISSUE 10). Keep the "
+         "value device-resident across steps (feed the jit output "
+         "straight into the next dispatch), or start "
+         "x.copy_to_host_async() and harvest it a step later (the "
+         "copy-ring idiom); a deliberate sync point can be silenced "
+         "with # graft-lint: disable=HOTSYNC001",
+)
+def hotsync001(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    # the serving hot path lives under inference/ — ops/reference code
+    # fetches eagerly by design and must not be flagged
+    parts = ctx.path.replace("\\", "/").split("/")
+    if "inference" not in parts:
+        return
+    for fndef in ctx.functions():
+        if ctx.region_of(fndef) is not None:
+            continue  # inside a traced body there is no host fetch
+        jit_names = _jit_output_names(fndef)
+        if not jit_names:
+            continue
+        asynced = _async_copied_names(fndef)
+        seen: Set[int] = set()
+        stepish = fndef.name == "step" or fndef.name.endswith("_step")
+        for loop in walk_scope(fndef):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for call, name, kind in _hot_fetches(loop, jit_names,
+                                                 asynced):
+                if id(call) in seen:
+                    continue
+                seen.add(id(call))
+                yield call, (
+                    f"`{kind}` blocks on jitted output `{name}` inside "
+                    f"a loop in `{fndef.name}` — the engine hot path "
+                    "stalls on a device sync every iteration")
+        if stepish:
+            for call, name, kind in _hot_fetches(fndef, jit_names,
+                                                 asynced):
+                if id(call) in seen:
+                    continue
+                seen.add(id(call))
+                yield call, (
+                    f"`{kind}` blocks on jitted output `{name}` in "
+                    f"step function `{fndef.name}` — this runs every "
+                    "engine iteration and stalls the dispatch pipeline")
 
 
 # ---------------------------------------------------------------------------

@@ -100,6 +100,14 @@ class EngineLoad:
     n_shed_interactive: int = 0
     n_shed_batch: int = 0
     n_expired: int = 0
+    # host/device pipelining occupancy (ISSUE 10): fraction of recent
+    # step time the host spent BLOCKED on device fetches (EWMA), and
+    # the async pipeline's current in-flight dispatch count. A replica
+    # with a high blocked fraction is host-bound — more work queued on
+    # it returns later than its queue depth alone suggests, so the
+    # router scores it down.
+    host_blocked_frac: float = 0.0
+    dispatch_depth: int = 0
 
     @property
     def queue_frac(self) -> float:

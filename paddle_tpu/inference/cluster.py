@@ -371,8 +371,15 @@ class ClusterRouter:
         busy     = wq * queue_frac + wkv * kv_occupancy
                  + wd * squash(est_queue_delay_s)
                  + wl * squash(ewma_step_s)
+                 + wb * host_blocked_frac
         score    = busy - affinity_weight * prefix_fraction
                         - session_weight  * session_match
+
+    ``host_blocked_frac`` (ISSUE 10) is the replica engine's measured
+    fraction of step time spent BLOCKED on device fetches: a host-bound
+    replica (sync fetch loop, or an overlap pipeline that degraded to
+    draining) services its queue slower than its depth suggests, so it
+    scores as busier at equal queue/KV occupancy.
 
     ``squash(x) = x / (1 + x)`` keeps unbounded seconds-valued signals
     commensurable with the [0, 1] fractions without magic scale
@@ -386,6 +393,7 @@ class ClusterRouter:
                  session_weight: float = 1.0,
                  queue_weight: float = 1.0, kv_weight: float = 1.0,
                  delay_weight: float = 1.0, latency_weight: float = 0.25,
+                 blocked_weight: float = 0.5,
                  max_prefix_nodes: int = 4096):
         if not replicas:
             raise ValueError("need at least one replica")
@@ -398,6 +406,7 @@ class ClusterRouter:
         self.kv_weight = float(kv_weight)
         self.delay_weight = float(delay_weight)
         self.latency_weight = float(latency_weight)
+        self.blocked_weight = float(blocked_weight)
         self._prefix = [PrefixCache(self.block_size,
                                     max_nodes=max_prefix_nodes)
                         for _ in self.replicas]
@@ -439,7 +448,9 @@ class ClusterRouter:
                 + self.delay_weight
                 * self._squash(load.get("est_queue_delay_s"))
                 + self.latency_weight
-                * self._squash(load.get("ewma_step_s")))
+                * self._squash(load.get("ewma_step_s"))
+                + self.blocked_weight
+                * float(load.get("host_blocked_frac", 0.0)))
         affinity = 0.0
         if session is not None and self._sessions.get(session) == idx:
             affinity += self.session_weight

@@ -22,6 +22,15 @@ them, engineered as a CRASH-ONLY protocol:
   wait, import retry) runs under a :class:`Deadline` carved from the
   request's remaining budget, with :class:`RetryPolicy` backoff on
   transient failures.
+- **Pipelining-transparent** (ISSUE 10) — workers inherit the
+  engine's async host/device pipeline through their ``engine_factory``
+  (``overlap=True``; serving.py module docstring): a decode worker's
+  hot loop then recycles sampled tokens on device and harvests through
+  the copy ring, an imported request's slot reaches the persistent
+  device state via the ordinary dirty-slot upload, and a prefill
+  worker's handoff-ready parking simply happens one harvest later —
+  ``pending()``/``pump()`` need no changes because a slot stays bound
+  until its tokens land.
 - **Survivable** — a prefill worker killed MID-handoff leaves parts
   without a commit; the decode side simply never imports the partial
   transfer, and the router's recovery (supervisor journal replay ∪ its

@@ -103,6 +103,31 @@ Token-exactness across the boundary is by construction: the exported
 bytes ARE the prefill engine's pool rows, and decode attends only
 positions its own dispatches wrote or the import placed.
 
+Async host/device pipelining (``overlap=True``, ISSUE 10): the sync
+loop blocks on a full D2H token fetch every decode step and re-uploads
+block tables + cache_len from host — the device idles while the host
+schedules (the vLLM-v1 "async scheduling" gap). Overlap mode closes it
+with LAG-1 SCHEDULING: (a) **device-resident token recycling** — the
+decode/scan/verify programs carry ``(tok, tables, cache_len,
+finished)`` ON DEVICE across steps, so decode step N+1 consumes step
+N's sampled-token array directly and no jitted output round-trips
+through host on the critical path; (b) an **async D2H copy ring** —
+each dispatch's token array starts a ``copy_to_host_async`` and parks
+in a FIFO ring; the host harvests step N's entry (eos/finish/detok/
+journal bookkeeping) WHILE step N+1 runs on device; (c) **dirty-slot
+incremental upload** — block tables/cache_len/finished live on device
+and only slots that JOIN or LEAVE at a dispatch boundary are re-
+uploaded (one small ``update_slot`` program per dirty row) instead of
+whole-array rebuilds per step. A slot that finishes in entry N may be
+over-issued one extra dispatch before the host learns it: the extra
+token is discarded at harvest and its KV write lands behind the causal
+mask until the block's next owner overwrites it — the same invariant
+that already covers padded prefill writes — so output streams are
+token-exact BY CONSTRUCTION (the A/B bench asserts bitwise equality).
+``overlap_stats()`` reports dispatches / host-blocked seconds /
+overlap fraction / H2D-D2H bytes, and ``load()`` gains
+``host_blocked_frac`` for admission + router scoring.
+
 Greedy decoding (temperature 0) — matching models.generation.generate's
 default — so engine outputs are token-identical to isolated generate()
 runs, which is the correctness contract the tests assert.
@@ -111,6 +136,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -208,13 +234,18 @@ class GenRequest:
 
 
 class _Slot:
-    __slots__ = ("req", "cache_len", "remaining", "prefill_pos")
+    __slots__ = ("req", "cache_len", "remaining", "prefill_pos",
+                 "pending_first")
 
     def __init__(self):
         self.req: Optional[GenRequest] = None
         self.cache_len = 0
         self.remaining = 0
         self.prefill_pos = 0  # prompt tokens written to KV so far
+        # overlap mode: prefill done but the first generated token is
+        # still riding the async copy ring — the slot must not join a
+        # decode dispatch until it lands
+        self.pending_first = False
 
     @property
     def active(self):
@@ -223,6 +254,28 @@ class _Slot:
     @property
     def prefilling(self):
         return self.req is not None and self.prefill_pos < self.req.prompt.size
+
+    @property
+    def decode_ready(self):
+        return (self.req is not None and not self.pending_first
+                and self.prefill_pos >= self.req.prompt.size
+                and bool(self.req.out))
+
+
+class _RingEntry:
+    """One in-flight dispatch whose token results the host has not yet
+    harvested. ``rows`` snapshots (slot_idx, request[, extra]) at
+    DISPATCH time — harvest credits tokens to the request the dispatch
+    actually served, and an identity check against the slot's current
+    request discards the ≤1-step over-issue for rows that finished or
+    were evicted while the entry was in flight."""
+
+    __slots__ = ("kind", "arrays", "rows")
+
+    def __init__(self, kind, arrays, rows):
+        self.kind = kind        # "decode" | "spec" | "first"
+        self.arrays = arrays    # device arrays to fetch
+        self.rows = rows
 
 
 class ContinuousBatchingEngine:
@@ -238,7 +291,8 @@ class ContinuousBatchingEngine:
                  spec_decode_k: Optional[int] = None,
                  draft_proposer: Optional[DraftProposer] = None,
                  kv_dtype: Optional[str] = None,
-                 role: str = "unified"):
+                 role: str = "unified",
+                 overlap: bool = False):
         """``num_blocks`` fixes the HBM budget (the pool allocates one
         extra trash block); ``max_len`` bounds any sequence's positions
         (tables carry ceil(max_len/block_size) slots per row);
@@ -295,6 +349,14 @@ class ContinuousBatchingEngine:
         colocated-fallback prompts still serve). A prefill-only engine
         reserves NO decode-growth blocks — its block budget is the
         prompt alone.
+
+        ``overlap=True`` turns on the async host/device pipeline (lag-1
+        scheduling; module docstring): decode dispatches consume the
+        previous dispatch's on-device token array, tables/cache_len/
+        finished persist on device with dirty-slot incremental upload,
+        and the host harvests tokens one step behind through an async
+        D2H copy ring. Output streams stay token-identical to
+        ``overlap=False`` — only WHEN the host sees each token changes.
 
         ``admission=AdmissionConfig(...)`` turns on overload control:
         submissions run through an :class:`AdmissionController` (shed
@@ -397,6 +459,23 @@ class ContinuousBatchingEngine:
         self._chunk_jit = None
         self._spec_jit = None  # k+1-wide verify + device accepted-length
         self._copy_jit = None  # COW block copy (prefix-cache forks)
+        self._update_jit = None  # dirty-slot upload (overlap mode)
+        # async host/device pipelining (overlap mode)
+        self.overlap = bool(overlap)
+        self.pipeline_depth = 1 if self.overlap else 0
+        self._ring: deque = deque()  # in-flight _RingEntry FIFO
+        self._dstate = None  # (tok, tables, cache_len, finished) on device
+        self._dirty: set = set()  # slot rows needing device upload
+        # host/device overlap telemetry (tracked in BOTH modes so the
+        # A/B bench compares like for like)
+        self.n_dispatches = 0       # decode-phase dispatches
+        self.host_blocked_s = 0.0   # cumulative seconds blocked in D2H
+        self.busy_s = 0.0           # cumulative step() wall seconds
+        self.ewma_blocked_frac: Optional[float] = None
+        self.h2d_bytes = 0          # total host->device upload bytes
+        self.h2d_decode_bytes = 0   # ...on the decode-phase path only
+        self.d2h_bytes = 0          # device->host fetch bytes
+        self._harvested_step = 0    # real tokens harvested this step
         self.decode_chunk = max(1, int(decode_chunk))
         self._rr = 0  # round-robin start for chunk scheduling fairness
         self.steps = 0
@@ -445,9 +524,24 @@ class ContinuousBatchingEngine:
         return caches
 
     def _build_jits(self):
+        """Every phase program is STATE-ADVANCING: it returns the next
+        step's ``(tok, cache_len, finished)`` lanes beside its token
+        output, so overlap mode can feed dispatch N's device outputs
+        straight into dispatch N+1 without a host round-trip. Sync mode
+        runs the SAME programs and simply ignores the state lanes —
+        one compiled program per phase serves both modes (the
+        recompile-pin contract is unchanged). ``cache_len`` advances
+        are clamped at ``max_len`` so inactive/trash rows cannot drift
+        into out-of-range positions across long overlap runs."""
         model, params = self.model, self._params
+        max_len = self.max_len
 
-        def prefill(param_arrays, pools, ids, tables, cache_len):
+        def prefill(param_arrays, pools, ids, tables, cache_len,
+                    last_idx):
+            """Returns only the per-row token at ``last_idx`` (the
+            completing chunk's final real position) — the ONE int per
+            row the host ever reads from a prefill, so the D2H copy is
+            [B] ints instead of the whole [B, width] token array."""
             for p, a in zip(params, param_arrays):
                 p._data = a
             with no_grad():
@@ -455,19 +549,27 @@ class ContinuousBatchingEngine:
                 logits, new_caches = model.forward_with_cache(
                     Tensor(ids, _internal=True), caches,
                     Tensor(cache_len, _internal=True))
-            toks = jnp.argmax(logits._data, axis=-1)  # [B, s_pad]
-            return toks, self._pools_from(new_caches)
+            toks = jnp.argmax(logits._data, axis=-1)  # [B, width]
+            firsts = toks[jnp.arange(toks.shape[0]),
+                          last_idx].astype(jnp.int32)  # [B]
+            return firsts, self._pools_from(new_caches)
 
-        def decode(param_arrays, pools, tok, tables, cache_len):
+        def decode(param_arrays, pools, tok, tables, cache_len,
+                   finished):
             for p, a in zip(params, param_arrays):
                 p._data = a
+            eos = self.eos_token_id
             with no_grad():
                 caches = self._caches_from(pools, tables)
                 logits, new_caches = model.forward_with_cache(
                     Tensor(tok[:, None], _internal=True), caches,
                     Tensor(cache_len, _internal=True))
             nxt = jnp.argmax(logits._data[:, -1], axis=-1).astype(jnp.int32)
-            return nxt, self._pools_from(new_caches)
+            if eos is not None:
+                nxt = jnp.where(finished, eos, nxt)
+                finished = finished | (nxt == eos)
+            cl2 = jnp.minimum(cache_len + 1, max_len)
+            return nxt, cl2, finished, self._pools_from(new_caches)
 
         def decode_chunk(param_arrays, pools, tok, tables, cache_len,
                          finished):
@@ -488,22 +590,31 @@ class ContinuousBatchingEngine:
                     nxt = jnp.where(fin, eos, nxt)
                     fin = fin | (nxt == eos)
                 new_pl = self._pools_from(new_caches)
-                return (nxt, new_pl, cl + 1, fin), nxt
+                return (nxt, new_pl, jnp.minimum(cl + 1, max_len),
+                        fin), nxt
 
             (t, pl, cl, fin), toks = jax.lax.scan(
                 body, (tok, pools, cache_len, finished), None,
                 length=self.decode_chunk)
-            return toks, pl  # toks: [K, B]
+            return toks, t, cl, fin, pl  # toks: [K, B]
 
-        def spec_verify(param_arrays, pools, ids, tables, cache_len,
-                        drafts):
+        def spec_verify(param_arrays, pools, tok, tables, cache_len,
+                        finished, drafts):
             """ONE dispatch scoring all k+1 positions: the prefill path
             at width k+1 plus a drafts lane — the greedy accepted
             length (cumprod of prefix matches against the argmax one
             position back) comes back per slot, so the host only
-            slices tokens, never logits."""
+            slices tokens, never logits. The continuation lanes
+            (``tok`` = the bonus token at the last accepted position,
+            ``cache_len + accepted + 1``) are computed ON DEVICE so
+            overlap mode chains verify rounds without a host sync; an
+            eos inside the accepted prefix sets ``finished`` (the host
+            finishes the slot at harvest — any device-side over-advance
+            lands on a slot the host is about to retire)."""
             for p, a in zip(params, param_arrays):
                 p._data = a
+            eos = self.eos_token_id
+            ids = jnp.concatenate([tok[:, None], drafts], axis=1)
             with no_grad():
                 caches = self._caches_from(pools, tables)
                 logits, new_caches = model.forward_with_cache(
@@ -512,13 +623,33 @@ class ContinuousBatchingEngine:
             toks = jnp.argmax(
                 logits._data, axis=-1).astype(jnp.int32)  # [B, k+1]
             ok = (drafts == toks[:, :-1]).astype(jnp.int32)  # [B, k]
-            acc = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)  # [B]
-            return toks, acc, self._pools_from(new_caches)
+            acc = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)  # [B], <= k
+            nxt = toks[jnp.arange(toks.shape[0]), acc]
+            cl2 = jnp.minimum(cache_len + acc + 1, max_len)
+            if eos is not None:
+                pos = jnp.arange(toks.shape[1])[None, :]
+                hit = (toks == eos) & (pos <= acc[:, None])
+                nxt = jnp.where(finished, eos, nxt)
+                finished = finished | jnp.any(hit, axis=1)
+            return toks, acc, nxt, cl2, finished, \
+                self._pools_from(new_caches)
+
+        def update_slot(state, i, row, cl_i, tok_i, fin_i):
+            """Dirty-slot incremental upload: rewrite ONE row of the
+            persistent device step state (a slot joined or left at a
+            dispatch boundary). Traced row index — one compiled
+            program serves every slot."""
+            tok, tables, cl, fin = state
+            return (tok.at[i].set(tok_i), tables.at[i].set(row),
+                    cl.at[i].set(cl_i), fin.at[i].set(fin_i))
 
         self._prefill_jit = jax.jit(prefill, donate_argnums=(1,))
         self._decode_jit = jax.jit(decode, donate_argnums=(1,))
         self._chunk_jit = jax.jit(decode_chunk, donate_argnums=(1,))
         self._spec_jit = jax.jit(spec_verify, donate_argnums=(1,))
+        # NOT donated: the tok lane doubles as a ring-fetch target, and
+        # a donated buffer would be invalidated under the async copy
+        self._update_jit = jax.jit(update_slot)
 
     def _run_jit(self, jit_fn, *args):
         """Invoke a compiled phase with the params' CURRENT host arrays
@@ -544,6 +675,134 @@ class ContinuousBatchingEngine:
             raise EngineFenced(
                 "engine was retired by its supervisor mid-dispatch")
         return out
+
+    # -- host<->device transfer discipline -------------------------------
+    def _h2d(self, x, *, decode: bool = False):
+        """The ONE host->device upload path: counts bytes (the A/B
+        bench's per-token-upload metric) and returns the device array.
+        ``decode=True`` marks uploads on the decode-phase critical
+        path — the bytes persistent device state exists to eliminate."""
+        n = int(getattr(x, "nbytes", 0))
+        self.h2d_bytes += n
+        if decode:
+            self.h2d_decode_bytes += n
+        return jnp.asarray(x)
+
+    @staticmethod
+    def _start_async_copies(arrays) -> None:
+        for a in arrays:
+            start = getattr(a, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:  # pragma: no cover - backend quirk
+                    pass
+
+    def _fetch(self, *arrays, copies_started: bool = False):
+        """The ONE device->host fetch path: starts an async copy on
+        every array FIRST (unless the ring already did at dispatch
+        time), then gathers — so by the time the blocking gather runs,
+        the copies (and in overlap mode the compute, a whole step
+        earlier) are already in flight. Time spent actually blocked is
+        accounted to ``host_blocked_s`` — the decode-phase host-blocked
+        fraction overlap mode exists to shrink."""
+        if not copies_started:
+            self._start_async_copies(arrays)
+        t0 = time.perf_counter()
+        out = tuple(np.asarray(a) for a in arrays)
+        self.host_blocked_s += time.perf_counter() - t0
+        for o in out:
+            self.d2h_bytes += int(o.nbytes)
+        return out if len(out) > 1 else out[0]
+
+    # -- persistent device step state (overlap mode) ----------------------
+    def _mark_dirty(self, slot_idx: int) -> None:
+        """Record a slot-membership change: the device-resident row is
+        stale and must be re-uploaded before the next overlap decode
+        dispatch. Over-marking is harmless (the flush derives the row
+        content from host truth); UNDER-marking is the bug class the
+        device-vs-host invariant test pins down."""
+        if self.overlap:
+            self._dirty.add(slot_idx)
+
+    def _ensure_dstate(self):
+        if self._dstate is not None:
+            return
+        B, mb = self.B, self.max_blocks_per_seq
+        self._dstate = (
+            self._h2d(np.zeros((B,), np.int32), decode=True),
+            self._h2d(np.full((B, mb), self._trash, np.int32),
+                      decode=True),
+            self._h2d(np.zeros((B,), np.int32), decode=True),
+            self._h2d(np.ones((B,), bool), decode=True),
+        )
+
+    def _flush_dirty(self) -> None:
+        """Upload every dirty slot's row into the persistent device
+        state — the ONLY steady-state H2D traffic in overlap mode (a
+        steadily decoding batch has zero dirty slots, so zero upload
+        bytes per step). A slot is decode-eligible on device iff it is
+        active, past prefill, and its first token has landed; every
+        other state maps to the trash row, exactly like the sync
+        dispatch's table isolation."""
+        if not self.overlap or not self._dirty:
+            return
+        self._ensure_dstate()
+        if self._update_jit is None:
+            self._build_jits()
+        state = self._dstate
+        mb = self.max_blocks_per_seq
+        for i in sorted(self._dirty):
+            slot = self._slots[i]
+            if slot.decode_ready:
+                row = np.ascontiguousarray(self._tables[i], np.int32)
+                cl_i, tok_i = slot.cache_len, slot.req.out[-1]
+                fin_i = False
+            else:
+                row = np.full((mb,), self._trash, np.int32)
+                cl_i, tok_i, fin_i = 0, 0, True
+            state = self._update_jit(
+                state, self._h2d(np.int32(i), decode=True),
+                self._h2d(row, decode=True),
+                self._h2d(np.int32(cl_i), decode=True),
+                self._h2d(np.int32(tok_i), decode=True),
+                self._h2d(np.bool_(fin_i), decode=True))
+        self._dstate = state
+        self._dirty.clear()
+
+    def _push_entry(self, kind, arrays, rows):
+        """Queue a dispatch's token outputs on the async D2H copy ring:
+        the copies start NOW, the host reads them a step later."""
+        self._start_async_copies(arrays)
+        self._ring.append(_RingEntry(kind, arrays, rows))
+
+    def _harvest(self, *, drain: bool = False) -> int:
+        """Process ring entries down to ``pipeline_depth`` (all of them
+        with ``drain=True``): fetch each entry's tokens — usually
+        already on host thanks to the async copy — and run the host
+        bookkeeping (append/eos/finish/free) the sync loop did inline.
+        Returns real tokens emitted, also accumulated into
+        ``_harvested_step`` (one overlap step can harvest from several
+        points: the lag-1 pop, the spec sync point, the idle drain)."""
+        target = 0 if drain else self.pipeline_depth
+        real = 0
+        while len(self._ring) > target:
+            e = self._ring.popleft()
+            if e.kind == "spec":
+                toks, acc = self._fetch(*e.arrays, copies_started=True)
+                real += self._apply_spec(toks, acc, e.rows)
+            elif e.kind == "decode":
+                toks = self._fetch(e.arrays[0], copies_started=True)
+                if toks.ndim == 1:
+                    toks = toks[None]  # single step: [B] -> [1, B]
+                real += self._apply_decode(toks, e.rows)
+            else:  # "first": a prefill round's completing rows
+                firsts = self._fetch(e.arrays[0], copies_started=True)
+                for i, req in e.rows:
+                    real += self._apply_first_token(i, req,
+                                                    int(firsts[i]))
+        self._harvested_step += real
+        return real
 
     # -- public API ------------------------------------------------------
     @property
@@ -761,6 +1020,8 @@ class ContinuousBatchingEngine:
             n_shed_interactive=self.n_shed.get("interactive", 0),
             n_shed_batch=self.n_shed.get("batch", 0),
             n_expired=self.n_expired,
+            host_blocked_frac=self.ewma_blocked_frac or 0.0,
+            dispatch_depth=len(self._ring),
         )
 
     def prefix_stats(self) -> dict:
@@ -844,6 +1105,8 @@ class ContinuousBatchingEngine:
                 self._tables[slot_idx] = self._trash
                 self._expire(slot.req)
                 slot.req = None
+                slot.pending_first = False
+                self._mark_dirty(slot_idx)
         # handoff-ready work whose budget lapsed before export: the
         # blocks recycle and the request closes here — a dead client's
         # KV must not sit pinned waiting for a transfer nobody needs
@@ -1007,11 +1270,13 @@ class ContinuousBatchingEngine:
                     self.prefix_forks += 1
             self.prefix_hit_tokens += cached_len
             blocks = self.manager.owned_blocks(req.req_id)
-            row = np.full((self.max_blocks_per_seq,), self._trash, np.int32)
-            row[: len(blocks)] = blocks
+            row = self.manager.table_row(
+                req.req_id, self.max_blocks_per_seq, fill=self._trash)
             self._tables[slot_idx] = row
             slot.req = req
             slot.remaining = req.max_new_tokens
+            slot.pending_first = False
+            self._mark_dirty(slot_idx)
             self._queue.pop(0)  # bound above: leaves the queue LAST
 
             if self.chunked:
@@ -1033,13 +1298,14 @@ class ContinuousBatchingEngine:
             ids[slot_idx, :rem] = req.prompt[cached_len:]
             cl = np.zeros((self.B,), np.int32)
             cl[slot_idx] = cached_len
+            last_idx = np.zeros((self.B,), np.int32)
+            last_idx[slot_idx] = rem - 1
             if self._prefill_jit is None:
                 self._build_jits()
-            toks, self._pools = self._run_jit(
-                self._prefill_jit, self._pools, jnp.asarray(ids),
-                jnp.asarray(iso), jnp.asarray(cl))
+            firsts, self._pools = self._run_jit(
+                self._prefill_jit, self._pools, self._h2d(ids),
+                self._h2d(iso), self._h2d(cl), self._h2d(last_idx))
             self._phases_run.add("prefill")
-            first = int(np.asarray(toks)[slot_idx, rem - 1])
             used += rem
             self.prefill_tokens += rem
             if self.prefix_cache is not None:
@@ -1047,11 +1313,14 @@ class ContinuousBatchingEngine:
                 # them for reuse BEFORE a possible same-step finish
                 # frees the sequence's own references
                 self.prefix_cache.insert(req.prompt, blocks)
-            self._append_token(req, first)
-            slot.remaining -= 1
-            if not self._finish_if_done(slot_idx, first) \
-                    and self.role == "prefill_only":
-                self._to_handoff(slot_idx)
+            if self.overlap:
+                # the first token rides the copy ring; until it lands
+                # the slot must not join a decode dispatch
+                slot.pending_first = True
+                self._push_entry("first", (firsts,), [(slot_idx, req)])
+            else:
+                first = int(self._fetch(firsts)[slot_idx])
+                self._apply_first_token(slot_idx, req, first)
         return used
 
     def _finish_if_done(self, slot_idx, last_tok) -> bool:
@@ -1064,7 +1333,81 @@ class ContinuousBatchingEngine:
             self._tables[slot_idx] = self._trash
             self._completed[req.req_id] = req
             slot.req = None
+            slot.pending_first = False
+            self._mark_dirty(slot_idx)
         return done
+
+    def _apply_first_token(self, slot_idx: int, req: GenRequest,
+                           first: int) -> int:
+        """Host bookkeeping for a completed prefill's first generated
+        token (inline in sync mode; at harvest, one step later, in
+        overlap mode). Returns tokens emitted (0 when the slot was
+        evicted while the token was in flight)."""
+        slot = self._slots[slot_idx]
+        if slot.req is not req:
+            return 0  # evicted/reassigned while in flight: discard
+        slot.pending_first = False
+        self._append_token(req, first)
+        slot.remaining -= 1
+        self._mark_dirty(slot_idx)
+        if not self._finish_if_done(slot_idx, first) \
+                and self.role == "prefill_only":
+            self._to_handoff(slot_idx)
+        return 1
+
+    def _apply_decode(self, toks: np.ndarray, rows) -> int:
+        """Credit one decode dispatch's tokens ([K, B]) to its rows.
+        The identity guard discards the ≤1-step over-issue: a row whose
+        request finished or was evicted after dispatch no longer owns
+        its slot, and its extra token must not be appended (the sync
+        loop would never have produced it)."""
+        n = 0
+        for i, req in rows:
+            slot = self._slots[i]
+            if slot.req is not req:
+                continue
+            for j in range(toks.shape[0]):
+                t = int(toks[j, i])
+                self._append_token(req, t)
+                slot.cache_len += 1
+                slot.remaining -= 1
+                self.decode_tokens += 1
+                n += 1
+                if self._finish_if_done(i, t):
+                    break
+        return n
+
+    def _apply_spec(self, toks: np.ndarray, acc: np.ndarray, rows) -> int:
+        """Credit one speculative verify dispatch: emit the accepted
+        prefix + bonus token per row, clamped by the row's remaining
+        budget, with the same over-issue identity guard."""
+        self.spec_dispatches += 1
+        emitted = 0
+        charged = 0
+        for i, req, n_real in rows:
+            self.spec_slot_rounds += 1
+            charged += self.spec_k + 1
+            slot = self._slots[i]
+            if slot.req is not req:
+                continue
+            m = min(int(acc[i]) + 1, slot.remaining)
+            self.spec_proposed += n_real
+            self.spec_accepted += min(int(acc[i]), n_real)
+            for j in range(m):
+                t = int(toks[i, j])
+                self._append_token(req, t)
+                slot.cache_len += 1
+                slot.remaining -= 1
+                self.decode_tokens += 1
+                self.spec_emitted += 1
+                emitted += 1
+                if self._finish_if_done(i, t):
+                    break
+        # the budget is charged the k+1 dispatched positions per slot,
+        # but only the emitted tokens drain real backlog — step() feeds
+        # the difference back out of the service-rate telemetry
+        self._step_spec_overcharge += charged - emitted
+        return emitted
 
     # -- disaggregated prefill/decode handoff ---------------------------
     def _to_handoff(self, slot_idx: int) -> None:
@@ -1079,6 +1422,8 @@ class ContinuousBatchingEngine:
         self._handoff_ready[req.req_id] = req
         self._tables[slot_idx] = self._trash
         slot.req = None
+        slot.pending_first = False
+        self._mark_dirty(slot_idx)
 
     def drain_prefilled(self) -> List[GenRequest]:
         """Return (and claim) the requests whose prefill finished since
@@ -1173,10 +1518,8 @@ class ContinuousBatchingEngine:
         except RuntimeError as e:  # raced another import on the tail
             self.manager.free_sequence(req.req_id)
             raise BlockImportError(str(e)) from None
-        blocks = self.manager.owned_blocks(req.req_id)
-        row = np.full((self.max_blocks_per_seq,), self._trash, np.int32)
-        row[: len(blocks)] = blocks
-        self._tables[slot_idx] = row
+        self._tables[slot_idx] = self.manager.table_row(
+            req.req_id, self.max_blocks_per_seq, fill=self._trash)
         slot = self._slots[slot_idx]
         req.out, req.times, req.status = [], [], "ok"
         if not req.t_submit:
@@ -1185,9 +1528,11 @@ class ContinuousBatchingEngine:
         slot.prefill_pos = psize
         slot.cache_len = psize
         slot.remaining = req.max_new_tokens
+        slot.pending_first = False
         self._append_token(req, int(first_token))
         slot.remaining -= 1
         self.n_imported += 1
+        self._mark_dirty(slot_idx)
         self._finish_if_done(slot_idx, int(first_token))
 
     def _schedule_prefill(self, budget_left: int) -> Dict[int, int]:
@@ -1235,6 +1580,7 @@ class ContinuousBatchingEngine:
         while sched:
             ids = np.zeros((self.B, chunk), np.int32)
             cl = np.zeros((self.B,), np.int32)
+            last_idx = np.zeros((self.B,), np.int32)
             iso = np.full_like(self._tables, self._trash)
             round_rows = []
             for i in list(sched):
@@ -1243,16 +1589,17 @@ class ContinuousBatchingEngine:
                 real = min(chunk, slot.req.prompt.size - start, sched[i])
                 ids[i, :real] = slot.req.prompt[start:start + real]
                 cl[i] = start
+                last_idx[i] = real - 1
                 iso[i] = self._tables[i]
                 round_rows.append((i, start, real))
                 sched[i] -= real
                 if sched[i] <= 0:
                     del sched[i]
-            toks, self._pools = self._run_jit(
-                self._prefill_jit, self._pools, jnp.asarray(ids),
-                jnp.asarray(iso), jnp.asarray(cl))
+            firsts, self._pools = self._run_jit(
+                self._prefill_jit, self._pools, self._h2d(ids),
+                self._h2d(iso), self._h2d(cl), self._h2d(last_idx))
             self._phases_run.add("prefill")
-            toks = np.asarray(toks)  # [B, chunk]
+            done_rows = []
             for i, start, real in round_rows:
                 slot = self._slots[i]
                 slot.prefill_pos = start + real
@@ -1260,18 +1607,23 @@ class ContinuousBatchingEngine:
                 self.prefill_tokens += real
                 used += real
                 if slot.prefill_pos == slot.req.prompt.size:
-                    first = int(toks[i, real - 1])
                     if self.prefix_cache is not None:
                         # pin the finished prompt's full blocks before
                         # a same-chunk finish frees the sequence
                         self.prefix_cache.insert(
                             slot.req.prompt,
                             self.manager.owned_blocks(slot.req.req_id))
-                    self._append_token(slot.req, first)
-                    slot.remaining -= 1
-                    if not self._finish_if_done(i, first) \
-                            and self.role == "prefill_only":
-                        self._to_handoff(i)
+                    done_rows.append((i, slot.req))
+            if done_rows:
+                if self.overlap:
+                    for i, _ in done_rows:
+                        self._slots[i].pending_first = True
+                        self._mark_dirty(i)
+                    self._push_entry("first", (firsts,), done_rows)
+                else:
+                    vals = self._fetch(firsts)  # [B] ints, not [B, chunk]
+                    for i, req in done_rows:
+                        self._apply_first_token(i, req, int(vals[i]))
         self._rr = (self._rr + 1) % self.B
         return used
 
@@ -1297,67 +1649,46 @@ class ContinuousBatchingEngine:
                 any_draft = True
         return (drafts, n_real) if any_draft else None
 
-    def _spec_step(self, active, tables, cl, drafts, n_real) -> int:
-        """One speculative round: verify dispatch + host accept walk.
-        Emits 1..k+1 tokens per slot (variable tokens/step); returns
-        the k+1 real positions per slot the dispatch processed."""
+    def _spec_step(self, active, tok, tables, cl, fin, drafts,
+                   n_real) -> int:
+        """One SYNC speculative round: verify dispatch + host accept
+        walk. Emits 1..k+1 tokens per slot (variable tokens/step);
+        returns the k+1 real positions per slot the dispatch
+        processed."""
         k = self.spec_k
-        ids = np.zeros((self.B, k + 1), np.int32)
-        for i in active:
-            ids[i, 0] = self._slots[i].req.out[-1]
-            ids[i, 1:] = drafts[i]
-        toks, acc, self._pools = self._run_jit(
-            self._spec_jit, self._pools, jnp.asarray(ids),
-            jnp.asarray(tables), jnp.asarray(cl), jnp.asarray(drafts))
+        toks, acc, _, _, _, self._pools = self._run_jit(
+            self._spec_jit, self._pools, self._h2d(tok, decode=True),
+            self._h2d(tables, decode=True), self._h2d(cl, decode=True),
+            self._h2d(fin, decode=True), self._h2d(drafts, decode=True))
         self._phases_run.add("spec_verify")
-        toks = np.asarray(toks)  # [B, k+1]
-        acc = np.asarray(acc)  # [B]
-        self.spec_dispatches += 1
-        self.spec_slot_rounds += len(active)
-        emitted_before = self.spec_emitted
-        for i in active:
-            slot = self._slots[i]
-            # emitted = accepted prefix + the bonus token from the
-            # last accepted position's logits, clamped to the slot's
-            # remaining budget (deadline/budget accounting sees the
-            # true variable-length grant)
-            m = min(int(acc[i]) + 1, slot.remaining)
-            self.spec_proposed += n_real.get(i, 0)
-            self.spec_accepted += min(int(acc[i]), n_real.get(i, 0))
-            for j in range(m):
-                t = int(toks[i, j])
-                self._append_token(slot.req, t)
-                slot.cache_len += 1
-                slot.remaining -= 1
-                self.decode_tokens += 1
-                self.spec_emitted += 1
-                if self._finish_if_done(i, t):
-                    break
-        # the budget is charged the k+1 dispatched positions per slot,
-        # but only the emitted tokens drain real backlog — step() feeds
-        # the difference back out of the service-rate telemetry
-        self._step_spec_overcharge += (
-            len(active) * (k + 1) - (self.spec_emitted - emitted_before))
+        self.n_dispatches += 1
+        toks, acc = self._fetch(toks, acc)
+        rows = [(i, self._slots[i].req, n_real.get(i, 0)) for i in active]
+        self._apply_spec(toks, acc, rows)
         return len(active) * (k + 1)
 
+    def _decode_rows(self):
+        return [i for i, s in enumerate(self._slots) if s.decode_ready]
+
     def _decode_step(self, budget_left: Optional[int]) -> int:
-        """One decode round for every decode-phase slot (speculative
-        verify, single step, or a ``decode_chunk`` scan). Returns real
-        tokens scheduled."""
+        """One SYNC decode round for every decode-phase slot
+        (speculative verify, single step, or a ``decode_chunk`` scan).
+        Returns real tokens scheduled."""
         if self.role == "prefill_only":
             return 0  # decode belongs to the other pool
-        active = [i for i, s in enumerate(self._slots)
-                  if s.active and not s.prefilling]
+        active = self._decode_rows()
         if not active:
             return 0
         if self._decode_jit is None:
             self._build_jits()
         tok = np.zeros((self.B,), np.int32)
         cl = np.zeros((self.B,), np.int32)
+        fin = np.ones((self.B,), bool)
         for i in active:
             slot = self._slots[i]
             tok[i] = slot.req.out[-1]
             cl[i] = slot.cache_len
+            fin[i] = False
         tables = self._tables
         if self.num_prefilling:
             # the decode program writes EVERY row's (tok, cl) — rows
@@ -1369,69 +1700,140 @@ class ContinuousBatchingEngine:
             for i, s in enumerate(self._slots):
                 if s.prefilling:
                     tables[i] = self._trash
-        if self.spec_k is not None and (
-                budget_left is None
-                # under a token budget a verify round charges
-                # active*(k+1) and could eat the whole step's budget
-                # every step — fall back to plain decode (active
-                # tokens) while a slot is mid-prefill so its chunks
-                # keep landing (same starvation guard as the scan)
-                or (len(active) * (self.spec_k + 1) <= budget_left
-                    and self.num_prefilling == 0)):
+        if self._spec_gate(active, budget_left):
             proposed = self._propose_drafts(active)
             if proposed is not None:
-                return self._spec_step(active, tables, cl, *proposed)
+                return self._spec_step(active, tok, tables, cl, fin,
+                                       *proposed)
         k = self.decode_chunk
-        scan_ok = (
+        if self._scan_gate(active, budget_left):
+            toks, _, _, _, self._pools = self._run_jit(
+                self._chunk_jit, self._pools, self._h2d(tok, decode=True),
+                self._h2d(tables, decode=True), self._h2d(cl, decode=True),
+                self._h2d(fin, decode=True))
+            self._phases_run.add("decode_chunk")
+            self.n_dispatches += 1
+            toks = np.asarray(self._fetch(toks))  # [K, B]
+        else:
+            nxt, _, _, self._pools = self._run_jit(
+                self._decode_jit, self._pools, self._h2d(tok, decode=True),
+                self._h2d(tables, decode=True), self._h2d(cl, decode=True),
+                self._h2d(fin, decode=True))
+            self._phases_run.add("decode")
+            self.n_dispatches += 1
+            toks = np.asarray(self._fetch(nxt))[None]  # [1, B]
+        self._apply_decode(toks, [(i, self._slots[i].req) for i in active])
+        return len(active) * toks.shape[0]
+
+    # -- shared scheduling gates -----------------------------------------
+    def _spec_gate(self, active, budget_left) -> bool:
+        """Under a token budget a verify round charges active*(k+1) and
+        could eat the whole step's budget every step — fall back to
+        plain decode (active tokens) while a slot is mid-prefill so its
+        chunks keep landing (same starvation guard as the scan)."""
+        return self.spec_k is not None and (
+            budget_left is None
+            or (len(active) * (self.spec_k + 1) <= budget_left
+                and self.num_prefilling == 0))
+
+    def _scan_gate(self, active, budget_left) -> bool:
+        """A K-step scan must fit every active slot's remaining budget
+        and the step's token budget, and must not starve a mid-prefill
+        slot for K steps."""
+        k = self.decode_chunk
+        return (
             k > 1
             and min(self._slots[i].remaining for i in active) >= k
-            # under a token budget the K-step scan must fit it, and a
-            # mid-prefill slot must not be starved for K steps
             and (budget_left is None
                  or (len(active) * k <= budget_left
                      and self.num_prefilling == 0)))
-        if scan_ok:
-            finished = np.ones((self.B,), bool)
-            finished[active] = False
-            toks, self._pools = self._run_jit(
-                self._chunk_jit, self._pools, jnp.asarray(tok),
-                jnp.asarray(tables), jnp.asarray(cl),
-                jnp.asarray(finished))
+
+    # -- the overlap decode dispatch --------------------------------------
+    def _dispatch_decode_async(self, budget_left: Optional[int]) -> int:
+        """Issue this step's decode round straight from the persistent
+        device state — no host reads, no per-step table/cache_len
+        uploads — and park its token outputs on the copy ring. Mid-
+        prefill and pending-first rows are already trash on device (the
+        dirty flush derives row content from host truth), so no
+        per-dispatch table copy is needed. Returns budget charged."""
+        if self.role == "prefill_only":
+            return 0
+        active = self._decode_rows()
+        if not active:
+            return 0
+        if self._ring and self._spec_gate(active, budget_left):
+            # speculative rounds keep ONE sync point: the host-side
+            # proposer needs the COMPLETE emitted history — drafting
+            # against a tail that lags the in-flight dispatch would
+            # misalign every draft with its verify position and
+            # collapse acceptance (a k+1-wide dispatch per ~1 emitted
+            # token, worse than plain decode). The verify ids/state
+            # still ride device-resident; a device-side proposer would
+            # remove this drain too.
+            self._harvest(drain=True)
+            self._flush_dirty()  # harvest may have changed membership
+            active = self._decode_rows()
+            if not active:
+                return 0
+        if self._decode_jit is None:
+            self._build_jits()
+        self._ensure_dstate()
+        tokd, tabd, cld, find = self._dstate
+        if self._spec_gate(active, budget_left):
+            proposed = self._propose_drafts(active)
+            if proposed is not None:
+                drafts, n_real = proposed
+                toks, acc, tok2, cl2, fin2, self._pools = self._run_jit(
+                    self._spec_jit, self._pools, tokd, tabd, cld, find,
+                    self._h2d(drafts, decode=True))
+                self._phases_run.add("spec_verify")
+                self.n_dispatches += 1
+                self._dstate = (tok2, tabd, cl2, fin2)
+                self._push_entry(
+                    "spec", (toks, acc),
+                    [(i, self._slots[i].req, n_real.get(i, 0))
+                     for i in active])
+                return len(active) * (self.spec_k + 1)
+        rows = [(i, self._slots[i].req) for i in active]
+        if self._scan_gate(active, budget_left):
+            toks, tok2, cl2, fin2, self._pools = self._run_jit(
+                self._chunk_jit, self._pools, tokd, tabd, cld, find)
             self._phases_run.add("decode_chunk")
-            toks = np.asarray(toks)  # [K, B]
-        else:
-            nxt, self._pools = self._run_jit(
-                self._decode_jit, self._pools, jnp.asarray(tok),
-                jnp.asarray(tables), jnp.asarray(cl))
-            self._phases_run.add("decode")
-            toks = np.asarray(nxt)[None]  # [1, B]
-        for i in active:
-            slot = self._slots[i]
-            for j in range(toks.shape[0]):
-                t = int(toks[j, i])
-                self._append_token(slot.req, t)
-                slot.cache_len += 1
-                slot.remaining -= 1
-                self.decode_tokens += 1
-                if self._finish_if_done(i, t):
-                    break
-        return len(active) * toks.shape[0]
+            self.n_dispatches += 1
+            self._dstate = (tok2, tabd, cl2, fin2)
+            self._push_entry("decode", (toks,), rows)
+            return len(active) * self.decode_chunk
+        nxt, cl2, fin2, self._pools = self._run_jit(
+            self._decode_jit, self._pools, tokd, tabd, cld, find)
+        self._phases_run.add("decode")
+        self.n_dispatches += 1
+        # the sampled-token output IS the next dispatch's input lane:
+        # device-resident token recycling, zero host round-trips
+        self._dstate = (nxt, tabd, cl2, fin2)
+        self._push_entry("decode", (nxt,), rows)
+        return len(active)
 
     def step(self):
-        """One engine iteration: evict expired slots, admit, then the
-        token-budgeted work — the decode round first (decode-priority
-        keeps inter-token latency flat), leftover budget spent on
-        prefill chunks round-robin. Whole-prompt mode keeps the legacy
-        order (prefill inside admission, then decode). Returns the
-        requests completed this iteration (expired ones included, with
-        ``status == "expired"``)."""
+        """One engine iteration. Sync mode: evict expired slots, admit,
+        then the token-budgeted work — the decode round first
+        (decode-priority keeps inter-token latency flat), leftover
+        budget spent on prefill chunks round-robin; whole-prompt mode
+        keeps the legacy order (prefill inside admission, then decode).
+        Overlap mode (lag-1): flush dirty slots, DISPATCH this step's
+        decode from device state, then harvest the PREVIOUS step's
+        tokens and do the host scheduling work while the device runs.
+        Returns the requests completed this iteration (expired ones
+        included, with ``status == "expired"``)."""
         if not _chaos.inject("serving.step"):
             return []  # dropped engine iteration: no work this tick
         if self._fenced:
             raise EngineFenced(
                 "engine was retired by its supervisor; a replacement "
                 "already owns the requests")
+        if self.overlap:
+            return self._step_overlap()
         t0 = time.perf_counter()
+        blocked0 = self.host_blocked_s
         before = set(self._completed)
         self._expire_queued()
         self._evict_expired()
@@ -1441,35 +1843,123 @@ class ContinuousBatchingEngine:
         used += self._decode_step(None if budget is None else budget - used)
         if self.chunked:
             used += self._prefill_step(budget - used)
+        real = used - self._step_spec_overcharge
+        self._finish_step(t0, blocked0, used, real)
+        if self.admission is not None:
+            self.admission.observe(self.load())
+        return [self._completed[r] for r in set(self._completed) - before]
+
+    def _step_overlap(self):
+        """The lag-1 pipelined iteration (module docstring): the decode
+        dispatch for step N+1 is issued BEFORE step N's tokens are
+        processed, so the host's bookkeeping/scheduling work runs while
+        the device computes. Slot membership changes (admissions,
+        prefill completions, finishes, evictions) land in the dirty set
+        and reach the device at the NEXT step's flush — dispatch
+        boundaries, exactly as the device-state design requires."""
+        t0 = time.perf_counter()
+        blocked0 = self.host_blocked_s
+        before = set(self._completed)
+        self._step_spec_overcharge = 0
+        budget = self.max_num_batched_tokens
+        self._harvested_step = 0
+        # 1) membership changes decided last step reach the device
+        self._flush_dirty()
+        # 2) dispatch this step's decode round (no host sync)
+        used = self._dispatch_decode_async(budget)
+        dispatched = used > 0
+        # 3) harvest the previous entry while the device runs this one.
+        # When NOTHING was dispatched there is no compute to overlap
+        # with — drain fully, or a pending-first slot's token would sit
+        # un-harvested (and the slot starved of decode) for as long as
+        # another slot's long prefill keeps the step busy
+        self._harvest(drain=not dispatched)
+        # 4) host scheduling work, overlapped with device compute
+        self._expire_queued()
+        self._evict_expired()
+        a_used = self._admit()
+        used += a_used
+        pf = 0
+        if self.chunked:
+            pf = self._prefill_step(budget - used)
+            used += pf
+        if self._ring and not self.num_active and not self._queue:
+            # the engine just went idle with an over-issued dispatch
+            # still in flight (every row already finished): fetch +
+            # discard so no driver sees a dangling pipeline
+            self._harvest(drain=True)
+        real = self._harvested_step + a_used + pf
+        self._finish_step(t0, blocked0, used, real)
+        if self.admission is not None:
+            self.admission.observe(self.load())
+        return [self._completed[r] for r in set(self._completed) - before]
+
+    def _finish_step(self, t0: float, blocked0: float, used: int,
+                     real: int) -> None:
+        """Shared per-step accounting: wall/blocked-time EWMAs and the
+        service-rate estimate. ``used`` is the budget charged (verify
+        rounds charge k+1 per slot); ``real`` is tokens that actually
+        drained backlog — the delay estimate must see the drain rate,
+        or spec/pipelined engines overstate capacity."""
         self.steps += 1
         self.last_step_tokens = used
         self.max_step_tokens = max(self.max_step_tokens, used)
         self.last_step_s = time.perf_counter() - t0
-        if used > 0:
-            # service-rate EWMAs feed the admission delay estimate;
+        self.busy_s += self.last_step_s
+        a = (self.admission.config.ewma_alpha
+             if self.admission is not None else 0.3)
+        if used > 0 or real > 0:
             # idle ticks are excluded so a quiet engine does not decay
-            # its measured capacity toward zero. A speculative verify
-            # round is CHARGED k+1 positions per slot (dispatch cost)
-            # but only drains the emitted tokens of real backlog — the
-            # delay estimate must see the drain rate, or spec engines
-            # overstate capacity by (k+1)/(1+accepted)
-            real = used - self._step_spec_overcharge
-            a = (self.admission.config.ewma_alpha
-                 if self.admission is not None else 0.3)
+            # its measured capacity toward zero
             self.ewma_step_s = self.last_step_s if self.ewma_step_s is None \
                 else a * self.last_step_s + (1 - a) * self.ewma_step_s
             self.ewma_step_tokens = float(real) \
                 if self.ewma_step_tokens is None \
                 else a * real + (1 - a) * self.ewma_step_tokens
-        if self.admission is not None:
-            self.admission.observe(self.load())
-        return [self._completed[r] for r in set(self._completed) - before]
+            blocked = self.host_blocked_s - blocked0
+            frac = min(blocked / self.last_step_s, 1.0) \
+                if self.last_step_s > 0 else 0.0
+            self.ewma_blocked_frac = frac \
+                if self.ewma_blocked_frac is None \
+                else a * frac + (1 - a) * self.ewma_blocked_frac
+
+    def overlap_stats(self) -> dict:
+        """Host/device pipelining counters (tracked in BOTH modes, so
+        the sync engine provides the A/B baseline): decode-phase
+        dispatches, cumulative host-blocked seconds, the overlap
+        fraction (1 - blocked/busy), tokens per dispatch, and the
+        H2D/D2H byte ledgers the persistent-device-state design exists
+        to shrink."""
+        toks = self.decode_tokens
+        return {
+            "enabled": self.overlap,
+            "pipeline_depth": self.pipeline_depth,
+            "in_flight": len(self._ring),
+            "dispatches": self.n_dispatches,
+            "host_blocked_s": self.host_blocked_s,
+            "busy_s": self.busy_s,
+            "host_blocked_frac": (self.host_blocked_s / self.busy_s
+                                  if self.busy_s > 0 else 0.0),
+            "overlap_frac": (1.0 - self.host_blocked_s / self.busy_s
+                             if self.busy_s > 0 else 0.0),
+            "tokens_per_dispatch": (toks / self.n_dispatches
+                                    if self.n_dispatches else 0.0),
+            "h2d_bytes": self.h2d_bytes,
+            "h2d_decode_bytes": self.h2d_decode_bytes,
+            "h2d_decode_bytes_per_token": (self.h2d_decode_bytes / toks
+                                           if toks else 0.0),
+            "d2h_bytes": self.d2h_bytes,
+        }
 
     def run(self, max_steps: int = 100_000) -> Dict[object, GenRequest]:
         """Drain the queue + active slots; returns {req_id: GenRequest}."""
         while (self._queue or self.num_active) and max_steps > 0:
             self.step()
             max_steps -= 1
+        if self._ring:
+            # an entry dispatched for rows that all finished at the
+            # final harvest: fetch + discard so nothing dangles
+            self._harvest(drain=True)
         if self._restore_training:
             self.model.train()
         return dict(self._completed)

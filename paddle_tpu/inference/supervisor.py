@@ -495,6 +495,13 @@ class ServingSupervisor:
     def _recover(self, *, reason: str, exc: Optional[BaseException]) -> list:
         eng = self.engine
         eng.fence()
+        # snapshot the async pipeline's in-flight depth AT the fence:
+        # entries dispatched but never harvested die with this engine —
+        # their rows' requests are still visible in the slot snapshot
+        # below (a slot stays bound until its tokens are harvested), so
+        # the requeue replays them from scratch, token-exact; the depth
+        # is recorded so operators can see a crash landed mid-pipeline
+        inflight_dispatches = len(getattr(eng, "_ring", ()))
         self._runner.retire()
         self.restarts += 1
         self._failures += 1
@@ -549,6 +556,9 @@ class ServingSupervisor:
         detail = (f"{reason}: restart #{self.restarts}, requeue "
                   f"{len(survivors)} in-flight + {len(queued)} queued, "
                   f"poisoned {len(inflight) - len(survivors)}")
+        if inflight_dispatches:
+            detail += (f", {inflight_dispatches} un-harvested pipeline "
+                       "dispatch(es) dropped")
         if exc is not None:
             detail += f" ({exc!r})"
         self._note("recover", detail)
@@ -611,6 +621,11 @@ class ServingSupervisor:
                 for k in set(self._prior_shed) | set(eng.n_shed)},
             "total_expired": self._prior_expired + eng.n_expired,
             "load": eng.load().as_dict(),
+            # async-pipeline occupancy (ISSUE 10): zeros/disabled on
+            # engines without the overlap machinery
+            "overlap": (eng.overlap_stats()
+                        if hasattr(eng, "overlap_stats")
+                        else {"enabled": False}),
         }
 
 

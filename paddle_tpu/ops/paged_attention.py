@@ -238,8 +238,12 @@ class BlockManager:
         """The sequence's current logical block list (post-fork ids)."""
         return list(self._owned.get(seq_id, []))
 
-    def table_row(self, seq_id, max_blocks_per_seq: int) -> np.ndarray:
-        row = np.zeros((max_blocks_per_seq,), np.int32)
+    def table_row(self, seq_id, max_blocks_per_seq: int,
+                  fill: int = 0) -> np.ndarray:
+        """The sequence's block-table row, padded with ``fill`` (the
+        serving engine passes its trash block id so unused table slots
+        scatter into the sacrificial page)."""
+        row = np.full((max_blocks_per_seq,), fill, np.int32)
         owned = self._owned.get(seq_id, [])
         row[: len(owned)] = owned
         return row

@@ -77,7 +77,10 @@ def main():
         kw = dict(max_batch=max_batch, max_len=max_len, block_size=8,
                   num_blocks=blocks,
                   role="prefill_only" if role == "prefill"
-                  else "decode_only")
+                  else "decode_only",
+                  # ISSUE 10: disagg workers inherit the async
+                  # host/device pipeline through their factory
+                  overlap=bool(os.environ.get("DISAGG_OVERLAP")))
         if chunk:
             kw["prefill_chunk"] = int(chunk)
         else:

@@ -14,6 +14,9 @@ request ends token-identical to an isolated generate() run.
 env:
   SUP_DIR      — journal directory (shared across waves)
   SUP_NREQ     — number of requests to submit (default 4)
+  SUP_OVERLAP  — non-empty: engines run the async host/device
+                 pipeline (overlap=True; a kill then lands with the
+                 copy ring mid-flight — ISSUE 10's crash shape)
   PADDLE_CHAOS — optional fault schedule (wave 1 only)
 """
 import json
@@ -39,7 +42,7 @@ def main():
     def factory():
         return ContinuousBatchingEngine(
             model, max_batch=2, max_len=32, block_size=8, num_blocks=8,
-            prompt_pad=8)
+            prompt_pad=8, overlap=bool(os.environ.get("SUP_OVERLAP")))
 
     sup = ServingSupervisor(factory, journal_dir=os.environ["SUP_DIR"])
     rng = np.random.RandomState(5)

@@ -66,6 +66,10 @@ _SLOW_FILES = {
     # overload/supervisor tests; pure-controller units are marked quick
     "test_admission.py",
     "test_supervisor.py",
+    # ISSUE 10 async-pipelining lane: the core parity/recompile/metric
+    # gates are explicitly marked quick; the full matrix (spec/int8/
+    # disagg-role engines compile extra programs) rides the slow lane
+    "test_serving_overlap.py",
 }
 
 
@@ -99,6 +103,12 @@ def pytest_configure(config):
         "rollback/peer-snapshot/telemetry units (quick lane; the "
         "2-process kill->peer-RAM-resume proof rides the slow lane; "
         "standalone via `pytest -m trainfault`)")
+    config.addinivalue_line(
+        "markers",
+        "overlap: async host/device pipelining suite — overlap-vs-sync "
+        "token-exactness matrix, device-state invariants, recompile "
+        "pin, crash-mid-pipeline recovery (standalone via "
+        "`pytest -m overlap`)")
 
 
 def pytest_collection_modifyitems(config, items):

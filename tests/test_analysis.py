@@ -381,6 +381,113 @@ class TestDonate001:
 
 
 # ---------------------------------------------------------------------------
+# HOTSYNC001 — blocking fetch of a jitted output in a serving hot loop
+
+
+INFER_PATH = "paddle_tpu/inference/fixture.py"
+
+
+class TestHotsync001:
+    def test_catches_blocking_fetch_in_while_loop(self):
+        src = """
+        import numpy as np
+
+        class Engine:
+            def run(self):
+                while self.pending():
+                    toks, self._pools = self._run_jit(
+                        self._decode_jit, self._pools)
+                    out = np.asarray(toks)      # line 9: device sync
+                return out
+        """
+        got = findings_for(src, "HOTSYNC001", path=INFER_PATH)
+        assert lines_of(got) == [9]
+        assert "hot path" in got[0].message or "loop" in got[0].message
+
+    def test_catches_item_in_step_function(self):
+        """A fetch in a `step`/`*_step` function is flagged even
+        without a lexical loop — step() IS the loop body (run() and
+        the supervisor call it every engine iteration)."""
+        src = """
+        import numpy as np
+
+        class Engine:
+            def _decode_step(self):
+                nxt = decode_jit(self._pools, self._tok)
+                first = nxt.item()              # line 7: device sync
+                return first
+        """
+        got = findings_for(src, "HOTSYNC001", path=INFER_PATH)
+        assert lines_of(got) == [7]
+        assert ".item()" in got[0].message
+
+    def test_near_miss_copy_to_host_async_is_sanctioned(self):
+        """The copy-ring idiom: starting the async D2H copy first means
+        the later gather does not stall the dispatch pipeline."""
+        src = """
+        import numpy as np
+
+        class Engine:
+            def step(self):
+                toks, self._pools = self._run_jit(
+                    self._decode_jit, self._pools)
+                toks.copy_to_host_async()       # copy already in flight
+                out = np.asarray(toks)
+                return out
+        """
+        assert findings_for(src, "HOTSYNC001", path=INFER_PATH) == []
+
+    def test_near_miss_host_value_and_cold_path_stay_clean(self):
+        """np.asarray on a host value in a loop, and a jit fetch
+        OUTSIDE any loop in a non-step function (a one-off drain /
+        debug probe), are both fine."""
+        src = """
+        import numpy as np
+
+        class Engine:
+            def collect(self, reqs):
+                out = []
+                while reqs:
+                    r = reqs.pop()
+                    out.append(np.asarray(r.prompt))   # host array
+                return out
+
+            def debug_probe(self):
+                toks, self._pools = self._run_jit(
+                    self._decode_jit, self._pools)
+                return np.asarray(toks)      # cold path: not a loop
+        """
+        assert findings_for(src, "HOTSYNC001", path=INFER_PATH) == []
+
+    def test_near_miss_outside_inference_modules(self):
+        """The rule scopes to inference/ — ops/bench/reference code
+        fetches eagerly by design."""
+        src = """
+        import numpy as np
+
+        def step(pools):
+            toks = decode_jit(pools)
+            return np.asarray(toks)
+        """
+        assert findings_for(
+            src, "HOTSYNC001", path="paddle_tpu/ops/fixture.py") == []
+        # ...and the identical source IS flagged under inference/
+        assert lines_of(findings_for(
+            src, "HOTSYNC001", path=INFER_PATH)) == [6]
+
+    def test_suppression_comment_works(self):
+        src = """
+        import numpy as np
+
+        class Engine:
+            def step(self):
+                toks = self._decode_jit(self._pools)
+                return np.asarray(toks)  # graft-lint: disable=HOTSYNC001
+        """
+        assert findings_for(src, "HOTSYNC001", path=INFER_PATH) == []
+
+
+# ---------------------------------------------------------------------------
 # Engine mechanics: suppressions, baseline, shared autograd-hazard core
 
 

@@ -1,0 +1,480 @@
+"""Async host/device pipelining for the serving engine (ISSUE 10).
+
+Correctness contract: ``overlap=True`` changes WHEN the host sees each
+token (lag-1, through the async copy ring), never WHICH tokens — every
+configuration's output stream must be bitwise-identical to the sync
+engine's, which is itself pinned token-identical to isolated
+generate() runs. The parity matrix here crosses the pipeline with
+every lever that pumps through the decode loop: whole-prompt, chunked
+prefill, decode_chunk scans, speculative decoding, prefix cache, int8
+KV, and the decode_only disagg role.
+
+Run standalone via ``pytest -m overlap``.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import ContinuousBatchingEngine, GenRequest
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import generate
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosSchedule
+from paddle_tpu.utils.retries import Deadline
+
+pytestmark = pytest.mark.overlap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _reference(model, prompt, max_new):
+    ids = paddle.to_tensor(np.asarray(prompt, np.int64)[None])
+    out = generate(model, ids, max_new_tokens=max_new, use_jit=False)
+    return list(np.asarray(out.numpy())[0][len(prompt):])
+
+
+def _serve(model, workload, *, overlap, **kw):
+    """Run one engine over (rid, prompt, max_new) and return
+    ({rid: out}, engine)."""
+    eng = ContinuousBatchingEngine(model, overlap=overlap, **kw)
+    for rid, prompt, max_new in workload:
+        eng.add_request(rid, prompt, max_new_tokens=max_new)
+    done = eng.run()
+    return {rid: done[rid].out for rid, _, _ in workload}, eng
+
+
+def _ab(model, workload, **kw):
+    """Sync vs overlap over the same workload; asserts bitwise-equal
+    streams and returns both engines for counter checks."""
+    sync_out, sync_eng = _serve(model, workload, overlap=False, **kw)
+    ovl_out, ovl_eng = _serve(model, workload, overlap=True, **kw)
+    assert sync_out == ovl_out, (kw, sync_out, ovl_out)
+    return sync_out, sync_eng, ovl_eng
+
+
+def _workload(rng, n=4, lens=(5, 11, 3, 8), gens=(6, 4, 8, 5),
+              vocab=250):
+    return [(f"r{i}", rng.randint(0, vocab, (lens[i % len(lens)],)),
+             gens[i % len(gens)]) for i in range(n)]
+
+
+@pytest.mark.quick
+class TestOverlapParityCore:
+    """The quick half of the exactness matrix: the three decode-loop
+    shapes every deployment uses."""
+
+    def test_whole_prompt_and_chunked_and_scan_parity(self):
+        model = _model()
+        rng = np.random.RandomState(0)
+        wl = _workload(rng)
+        ref = {rid: _reference(model, p, n) for rid, p, n in wl}
+
+        for kw in (
+            dict(max_batch=3, max_len=64, block_size=8, num_blocks=24,
+                 prompt_pad=16),
+            dict(max_batch=3, max_len=64, block_size=8, num_blocks=24,
+                 prefill_chunk=4, max_num_batched_tokens=8),
+            dict(max_batch=3, max_len=64, block_size=8, num_blocks=24,
+                 prompt_pad=16, decode_chunk=4),
+        ):
+            out, _, ovl = _ab(model, wl, **kw)
+            assert out == ref, kw  # both modes match generate()
+            stats = ovl.overlap_stats()
+            assert stats["enabled"] and stats["pipeline_depth"] == 1
+            assert stats["in_flight"] == 0  # run() drained the ring
+
+    def test_eos_and_one_token_budget_edges(self):
+        """The ≤1-step over-issue edges: a slot that finishes on its
+        very first decode (max_new_tokens=1 / immediate eos) is still
+        in flight when the host learns it — the extra token must be
+        discarded, not appended."""
+        model = _model()
+        p = np.random.RandomState(2).randint(0, 250, (4,))
+        ref = _reference(model, p, 8)
+        eos = ref[2]
+
+        for kw, want in (
+            (dict(eos_token_id=eos), ref[:3]),   # stop AT the eos token
+            (dict(), ref[:1]),                   # one-token budget
+        ):
+            n = 8 if kw else 1
+            outs = {}
+            for overlap in (False, True):
+                eng = ContinuousBatchingEngine(
+                    model, max_batch=1, max_len=32, block_size=8,
+                    num_blocks=4, prompt_pad=8, overlap=overlap, **kw)
+                eng.add_request("x", p, max_new_tokens=n)
+                outs[overlap] = eng.run()["x"].out
+                assert eng.manager.free_blocks == 4  # blocks recycled
+            assert outs[False] == outs[True] == want, kw
+
+    def test_h2d_decode_bytes_per_token_drop(self):
+        """The persistent-device-state claim, measured: steady-state
+        decode in overlap mode uploads (nearly) nothing, while the sync
+        loop re-uploads tok+tables+cache_len+finished every step."""
+        model = _model()
+        rng = np.random.RandomState(3)
+        wl = [(f"r{i}", rng.randint(0, 250, (4,)), 12) for i in range(2)]
+        _, sync_eng, ovl_eng = _ab(
+            model, wl, max_batch=2, max_len=64, block_size=8,
+            num_blocks=16, prompt_pad=8)
+        s = sync_eng.overlap_stats()
+        o = ovl_eng.overlap_stats()
+        assert o["h2d_decode_bytes_per_token"] < \
+            s["h2d_decode_bytes_per_token"], (s, o)
+        # host-blocked time is tracked in both modes (the A/B metric)
+        assert s["host_blocked_s"] > 0
+        assert o["dispatches"] >= s["dispatches"]  # ≤1-step over-issue
+
+    def test_device_state_matches_host_mirror(self):
+        """The induction invariant the dirty-slot design rests on: with
+        the ring drained, every decode-ready slot's device (tok,
+        cache_len, finished) equals the host mirror."""
+        model = _model()
+        rng = np.random.RandomState(4)
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=64, block_size=8, num_blocks=16,
+            prompt_pad=8, overlap=True)
+        for i in range(3):  # 3 requests over 2 slots: one waits
+            eng.add_request(i, rng.randint(0, 250, (5,)),
+                            max_new_tokens=8)
+        for _ in range(4):
+            eng.step()
+        eng._harvest(drain=True)
+        tok, tables, cl, fin = (np.asarray(a) for a in eng._dstate)
+        checked = 0
+        for i, slot in enumerate(eng._slots):
+            if not slot.decode_ready or i in eng._dirty:
+                continue
+            assert cl[i] == slot.cache_len, (i, cl[i], slot.cache_len)
+            assert tok[i] == slot.req.out[-1]
+            assert not fin[i]
+            np.testing.assert_array_equal(tables[i], eng._tables[i])
+            checked += 1
+        assert checked > 0  # the invariant was actually exercised
+        eng.run()
+
+
+@pytest.mark.quick
+class TestOverlapObservability:
+    def test_overlap_stats_and_load_fields(self):
+        model = _model()
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=4,
+            prompt_pad=8, overlap=True)
+        eng.add_request("x", np.arange(4) + 1, max_new_tokens=4)
+        eng.run()
+        st = eng.overlap_stats()
+        for key in ("enabled", "pipeline_depth", "in_flight",
+                    "dispatches", "host_blocked_s", "busy_s",
+                    "host_blocked_frac", "overlap_frac",
+                    "tokens_per_dispatch", "h2d_bytes",
+                    "h2d_decode_bytes", "h2d_decode_bytes_per_token",
+                    "d2h_bytes"):
+            assert key in st, key
+        assert st["dispatches"] > 0 and st["busy_s"] > 0
+        assert 0.0 <= st["host_blocked_frac"] <= 1.0
+        load = eng.load()
+        assert 0.0 <= load.host_blocked_frac <= 1.0
+        assert load.dispatch_depth == 0  # drained
+        assert "host_blocked_frac" in load.as_dict()
+
+    def test_router_scores_down_host_bound_replicas(self):
+        """Equal queue/KV/delay signals, different host_blocked_frac:
+        the router must prefer the replica whose host is not the
+        bottleneck."""
+        from paddle_tpu.inference.cluster import ClusterRouter
+
+        class FakeReplica:
+            def __init__(self, rid, blocked):
+                self.replica_id = rid
+                self._blocked = blocked
+
+            def alive(self):
+                return True
+
+            def load(self):
+                return {"queue_depth": 0, "queue_limit": 8,
+                        "kv_occupancy": 0.0, "est_queue_delay_s": 0.0,
+                        "ewma_step_s": 0.01,
+                        "host_blocked_frac": self._blocked}
+
+        reps = [FakeReplica("busy", 0.9), FakeReplica("idle", 0.0)]
+        rt = ClusterRouter(reps, block_size=8)
+        picks = [rt.route(np.arange(8) + i) for i in range(4)]
+        assert picks == [1, 1, 1, 1]  # always the un-blocked replica
+
+    def test_supervisor_health_reports_overlap(self):
+        from paddle_tpu.inference.supervisor import ServingSupervisor
+
+        model = _model()
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, max_batch=1, max_len=32, block_size=8,
+                num_blocks=4, prompt_pad=8, overlap=True)
+
+        sup = ServingSupervisor(factory)
+        sup.submit("x", np.arange(3) + 1, 3)
+        while sup.pending:
+            sup.step()
+        h = sup.health()
+        assert h["overlap"]["enabled"] is True
+        assert h["load"]["dispatch_depth"] == 0
+
+
+@pytest.mark.quick
+@pytest.mark.analysis
+class TestOverlapRecompilePin:
+    def test_async_loop_adds_zero_steady_state_compiles(self):
+        """The pipeline's programs (fused decode, update_slot) compile
+        ONCE at warmup; after the first wave a fresh mixed wave must be
+        100% executable-cache hits — the async loop adds ZERO
+        steady-state compiles."""
+        from paddle_tpu.analysis import recompile_guard
+
+        model = _model()
+        rng = np.random.RandomState(21)
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=64, block_size=8, num_blocks=16,
+            prefill_chunk=8, max_num_batched_tokens=10, overlap=True)
+        wave1 = {"a": 3, "b": 16, "c": 9}
+        for rid, n in wave1.items():
+            eng.add_request(rid, rng.randint(0, 250, (n,)),
+                            max_new_tokens=3)
+        with recompile_guard(match=r"^(prefill|decode|update)") as g:
+            done = eng.run()
+        assert set(wave1) <= set(done)
+        # one prefill (chunk width), one decode, one dirty-slot upload
+        assert sorted(set(g.names())) == \
+            ["decode", "prefill", "update_slot"], g.names()
+
+        wave2 = {"d": 5, "e": 23, "f": 8}
+        for rid, n in wave2.items():
+            eng.add_request(rid, rng.randint(0, 250, (n,)),
+                            max_new_tokens=3)
+        with recompile_guard(max_compiles=0):  # NOTHING recompiles
+            done = eng.run()
+        assert set(wave2) <= set(done)
+
+
+class TestOverlapParityFull:
+    """The slow half of the matrix: the levers that compile extra
+    programs (spec verify, int8 pools) and the disagg role."""
+
+    def test_spec_decode_parity_with_real_acceptance(self):
+        model = _model()
+        # self-requoting prompts so the n-gram proposer has signal
+        base = np.asarray([7, 9, 11, 7, 9, 11, 7, 9], np.int32)
+        wl = [("a", base, 10), ("b", np.asarray(base[::-1]), 8)]
+        kw = dict(max_batch=2, max_len=64, block_size=8, num_blocks=16,
+                  prefill_chunk=8, max_num_batched_tokens=32,
+                  spec_decode_k=2)
+        _, sync_eng, ovl_eng = _ab(model, wl, **kw)
+        assert sync_eng.spec_stats()["dispatches"] > 0
+        assert ovl_eng.spec_stats()["dispatches"] > 0
+        # spec rounds drain the ring before proposing (the host
+        # proposer's one sync point), so drafts align with their
+        # verify positions and acceptance keeps real signal — not just
+        # the output stream
+        assert sync_eng.spec_stats()["acceptance_rate"] > 0
+        assert ovl_eng.spec_stats()["acceptance_rate"] > 0
+
+    def test_prefix_cache_parity(self):
+        model = _model()
+        rng = np.random.RandomState(6)
+        fam = rng.randint(0, 250, (16,))
+        wl = [(f"r{i}",
+               np.concatenate([fam, rng.randint(0, 250, (4 + i,))]), 5)
+              for i in range(3)]
+        _, _, ovl = _ab(model, wl, max_batch=2, max_len=64, block_size=8,
+                        num_blocks=24, prefill_chunk=8,
+                        max_num_batched_tokens=12, prefix_cache=True)
+        assert ovl.prefix_stats()["hit_tokens"] > 0
+
+    def test_int8_kv_parity(self):
+        model = _model()
+        rng = np.random.RandomState(7)
+        wl = _workload(rng, n=3)
+        _ab(model, wl, max_batch=2, max_len=64, block_size=8,
+            num_blocks=16, prompt_pad=16, kv_dtype="int8")
+
+    def test_decode_only_role_colocated_parity(self):
+        """A decode worker's graceful-degradation path (colocated
+        chunked serving) inherits the pipeline unchanged."""
+        model = _model()
+        rng = np.random.RandomState(8)
+        wl = _workload(rng, n=3)
+        _ab(model, wl, max_batch=2, max_len=64, block_size=8,
+            num_blocks=24, prefill_chunk=4, max_num_batched_tokens=8,
+            role="decode_only")
+
+    def test_import_kv_into_overlap_decode_worker(self):
+        """The disagg handoff lands in the persistent device state via
+        the ordinary dirty-slot upload: an imported prompt resumes
+        decode token-exact on an overlap decode worker."""
+        model = _model()
+        pf = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=4,
+            prompt_pad=8, role="prefill_only")
+        dx = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=8,
+            prompt_pad=8, role="decode_only", overlap=True)
+        prompt = np.arange(6) + 3
+        pf.add_request("r", prompt, max_new_tokens=5)
+        pf.run()
+        (req,) = pf.drain_prefilled()
+        pages, scales, meta = pf.export_kv("r", kv_len=prompt.size)
+        pf.release_handoff("r")
+        req2 = GenRequest("r", prompt, 5)
+        dx.import_kv(req2, req.out[0], pages, scales, meta)
+        dx.run()
+        assert req2.status == "ok"
+        assert req2.out == _reference(model, prompt, 5)
+
+    def test_no_decode_starvation_during_long_prefill(self):
+        """Decode-priority survives the pipeline: a slot whose prefill
+        completed must start decoding while ANOTHER slot's long prompt
+        is still prefilling — its first token must not sit on the ring
+        until the prefill ends (when no decode dispatch was issued,
+        the step drains instead of holding pipeline depth)."""
+        model = _model()
+        rng = np.random.RandomState(11)
+        p_long = rng.randint(0, 250, (48,))
+        p_short = rng.randint(0, 250, (4,))
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=80, block_size=8, num_blocks=24,
+            prefill_chunk=4, max_num_batched_tokens=6, overlap=True)
+        eng.add_request("long", p_long, max_new_tokens=2)
+        eng.add_request("short", p_short, max_new_tokens=8)
+        short_done_step = None
+        for _ in range(80):
+            eng.step()
+            if short_done_step is None and \
+                    "short" in eng._completed:
+                short_done_step = eng.steps
+                # the long prompt must still be mid-prefill: decode ran
+                # CONCURRENTLY with its chunks, not after them
+                assert eng.num_prefilling == 1, \
+                    "short finished only after the long prefill ended"
+            if not (eng._queue or eng.num_active):
+                break
+        assert short_done_step is not None
+        done = eng.run()
+        assert done["short"].out == _reference(model, p_short, 8)
+        assert done["long"].out == _reference(model, p_long, 2)
+
+    def test_expiry_mid_pipeline_keeps_survivors_exact(self):
+        """A deadline eviction while that slot's dispatch is still in
+        flight: the evicted request keeps only its harvested tokens,
+        the survivor's stream stays bitwise-exact, and the over-issued
+        write is masked (the recycled blocks serve a new request
+        correctly)."""
+        model = _model()
+        rng = np.random.RandomState(9)
+        p_doomed = rng.randint(0, 250, (5,))
+        p_live = rng.randint(0, 250, (7,))
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=32, block_size=8, num_blocks=8,
+            prompt_pad=8, overlap=True)
+        doomed = eng.add_request("doomed", p_doomed, max_new_tokens=10)
+        eng.add_request("live", p_live, max_new_tokens=6)
+        for _ in range(3):
+            eng.step()
+        assert eng._ring  # a dispatch is in flight right now
+        doomed.deadline = Deadline(0.0)  # expire it mid-pipeline
+        done = eng.run()
+        assert done["doomed"].status == "expired"
+        assert done["live"].status == "ok"
+        assert done["live"].out == _reference(model, p_live, 6)
+        # the freed blocks serve a newcomer token-exact (over-issued
+        # writes landed behind the causal mask)
+        p_new = rng.randint(0, 250, (6,))
+        eng.add_request("new", p_new, max_new_tokens=4)
+        done = eng.run()
+        assert done["new"].out == _reference(model, p_new, 4)
+
+
+class TestOverlapSupervised:
+    """Crash-only recovery composes with the pipeline: a fault landing
+    with dispatches in flight requeues token-exact."""
+
+    def test_crash_mid_pipeline_requeues_token_exact(self):
+        from paddle_tpu.inference.supervisor import ServingSupervisor
+
+        model = _model()
+        rng = np.random.RandomState(10)
+        wl = _workload(rng, n=3)
+        want = {rid: _reference(model, p, n) for rid, p, n in wl}
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, max_batch=2, max_len=64, block_size=8,
+                num_blocks=16, prompt_pad=16, overlap=True)
+
+        sup = ServingSupervisor(factory)
+        for rid, p, n in wl:
+            sup.submit(rid, p, max_new_tokens=n)
+        # crash at step 4: slots are mid-decode with ring entries in
+        # flight — the fence drops them, the requeue replays from
+        # scratch on a fresh engine
+        with chaos.active(ChaosSchedule().at("serving.step", 4, "error")):
+            res = sup.run()
+        assert sup.restarts == 1
+        assert {r: res[r].out for r in want} == want
+        assert all(res[r].status == "ok" for r in want)
+        # the fence snapshotted the in-flight pipeline depth
+        recover = [d for k, d in sup.events if k == "recover"]
+        assert recover and "pipeline dispatch" in recover[0]
+
+    @pytest.mark.slow
+    def test_kill_relaunch_journal_resume_token_exact_overlap(
+            self, tmp_path):
+        """The kill shape: chaos SIGKILLs the worker process at
+        ``serving.step`` while the overlap ring is mid-flight; the
+        journal relaunch completes every request token-exact."""
+        n_req = 4
+        model = _model()
+        rng = np.random.RandomState(5)
+        want = {}
+        for i in range(n_req):
+            prompt = rng.randint(0, 250, (3 + i % 4,))
+            want[f"r{i}"] = _reference(model, prompt, 3 + i % 3)
+
+        def run_worker(spec=None):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)
+            env.pop("PADDLE_CHAOS", None)
+            env["PYTHONPATH"] = REPO + os.pathsep + env.get(
+                "PYTHONPATH", "")
+            env["SUP_DIR"] = str(tmp_path)
+            env["SUP_NREQ"] = str(n_req)
+            env["SUP_OVERLAP"] = "1"
+            if spec:
+                env["PADDLE_CHAOS"] = spec
+            return subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tests", "_supervisor_worker.py")],
+                env=env, cwd=REPO, capture_output=True, text=True,
+                timeout=240)
+
+        w1 = run_worker(spec="serving.step@3=kill:21")
+        assert w1.returncode == 21, (w1.returncode, w1.stderr[-2000:])
+        w2 = run_worker()
+        assert w2.returncode == 0, w2.stderr[-2000:]
+        results = json.loads(
+            w2.stdout.strip().splitlines()[-1])["results"]
+        for rid, tokens in want.items():
+            assert results[rid]["status"] == "ok", (rid, results[rid])
+            assert results[rid]["out"] == [int(t) for t in tokens], rid
