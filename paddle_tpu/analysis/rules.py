@@ -30,6 +30,11 @@ HOTSYNC001 warning blocking ``np.asarray``/``.item()``/``device_get``
                    escape: start ``copy_to_host_async()`` on the value
                    first (the copy-ring idiom), or route the fetch
                    through the engine's accounted ``_fetch`` seam
+OBS001    error    obs span/metric call inside a traced region — the
+                   span or counter bump runs ONCE at trace time, so the
+                   timeline shows one phantom event and the metric
+                   undercounts forever (ISSUE 12: observability calls
+                   belong on the host side of the jit boundary)
 ========= ======== ====================================================
 
 All rules are intraprocedural and name-based — modular by design
@@ -588,3 +593,62 @@ def donate001(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
                         f"`{callee}` on line {call_line}; its buffer "
                         "may already be overwritten here")
                     break
+
+
+# ---------------------------------------------------------------------------
+# OBS001 — obs span/metric calls inside traced regions
+
+# the paddle_tpu.obs module-level API (by conventional alias: the repo
+# imports it as `_obs` / `obs`; fully dotted paths also match)
+_OBS_MODULES = re.compile(r"^(_?obs|paddle_tpu\.obs(\.trace)?)$")
+_OBS_API_CALLS = {"span", "start_span", "finish_span", "instant",
+                  "new_trace_id"}
+# registry accessors (by conventional alias) whose handle factories
+# mint/bump metric series: `registry().counter(...)`,
+# `_obs_registry().histogram(...).observe(...)`
+_OBS_REGISTRY_FNS = re.compile(r"^(_?obs_?registry|registry)$")
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+@register_rule(
+    "OBS001", severity="error",
+    summary="obs span/metric call inside a traced (to_static/jax.jit) "
+            "region",
+    hint="traced bodies run ONCE at trace time: the span records a "
+         "single phantom event and the counter bumps once, ever. Move "
+         "the observation to the host call site around the jit "
+         "boundary (time the dispatch, not the graph); silence a "
+         "deliberate trace-time annotation with "
+         "# graft-lint: disable=OBS001",
+)
+def obs001(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    for fndef in ctx.functions():
+        region = ctx.region_of(fndef)
+        if region is None:
+            continue
+        for node in walk_scope(fndef):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            base = dotted_name(fn.value)
+            if (base and _OBS_MODULES.match(base)
+                    and fn.attr in _OBS_API_CALLS):
+                yield node, (
+                    f"`{base}.{fn.attr}(...)` inside traced function "
+                    f"`{fndef.name}` ({region.via}) records at trace "
+                    "time only")
+                continue
+            # registry().counter("x").inc() — the factory call is the
+            # reliable anchor (the .inc()/.observe() tail is too
+            # generic a name to match on its own)
+            if (fn.attr in _METRIC_FACTORIES
+                    and isinstance(fn.value, ast.Call)):
+                reg = dotted_name(fn.value.func)
+                if reg and _OBS_REGISTRY_FNS.match(reg.split(".")[-1]):
+                    yield node, (
+                        f"metric series `{reg}().{fn.attr}(...)` "
+                        f"created inside traced function "
+                        f"`{fndef.name}` ({region.via}) — the handle "
+                        "and any bump on it run at trace time only")

@@ -39,7 +39,7 @@ from typing import List, Optional
 
 __all__ = ["CompileEvent", "RecompileError", "RecompileGuard",
            "recompile_guard", "CollectiveScheduleMismatch",
-           "collective_contract"]
+           "collective_contract", "COMPILE_LOGGERS", "COMPILING_RE"]
 
 
 class CollectiveScheduleMismatch(AssertionError):
@@ -65,14 +65,20 @@ def collective_contract(store, rank, world_size, *, last_n=32,
                         deadline=deadline, recorder_=recorder, tag=tag)
 
 # one logger per jax version family; 0.4.x emits from pxla, newer from
-# _src.compiler — listening on both costs nothing
-_COMPILE_LOGGERS = (
+# _src.compiler — listening on both costs nothing. Public: the obs
+# compile-event hook (paddle_tpu/obs/compile.py) listens on the SAME
+# seam, so the guard and the timeline can never disagree about what
+# counts as a compilation.
+COMPILE_LOGGERS = (
     "jax._src.interpreters.pxla",
     "jax._src.compiler",
 )
-_COMPILING_RE = re.compile(
+COMPILING_RE = re.compile(
     r"Compiling (\S+)"
     r"(?: with global shapes and types (.+?)(?:\. Argument mapping.*)?)?$")
+# back-compat aliases (pre-obs private names)
+_COMPILE_LOGGERS = COMPILE_LOGGERS
+_COMPILING_RE = COMPILING_RE
 
 
 class RecompileError(AssertionError):

@@ -174,6 +174,15 @@ class AdmissionController:
         self.level = 0
         self.delay_ewma = 0.0
         self._since_change = self.config.level_hold  # free first move
+        # obs registry mirror (ISSUE 12): the controller's adaptive
+        # state, readable from `python -m paddle_tpu.obs dump` without
+        # holding a reference to the engine
+        from ..obs.metrics import registry as _reg
+        self._g_level = _reg().gauge(
+            "admission_level", help="adaptive admission level (0..2)")
+        self._g_delay = _reg().gauge(
+            "admission_delay_ewma_seconds",
+            help="EWMA of the estimated queueing delay")
 
     # -- load tracking --------------------------------------------------
     def observe(self, load: EngineLoad, *,
@@ -187,8 +196,10 @@ class AdmissionController:
         a = cfg.ewma_alpha
         self.delay_ewma = (a * load.est_queue_delay_s
                            + (1.0 - a) * self.delay_ewma)
+        self._g_delay.set(self.delay_ewma)
         self._since_change += 1
         if cfg.target_delay_s is None or self._since_change < cfg.level_hold:
+            self._g_level.set(self.level)
             return
         if (self.delay_ewma > cfg.target_delay_s
                 and self.level < self.MAX_LEVEL and allow_tighten):
@@ -198,6 +209,7 @@ class AdmissionController:
                 and self.level > 0):
             self.level -= 1
             self._since_change = 0
+        self._g_level.set(self.level)
 
     def score(self, load: EngineLoad) -> float:
         """Composite load score in [0, inf): the worst of queue

@@ -60,6 +60,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from .. import obs as _obs
 from ..ops.paged_attention import PrefixCache
 from ..testing import chaos as _chaos
 from ..utils.retries import Deadline
@@ -81,11 +82,15 @@ class NoLiveReplica(RuntimeError):
 
 def make_record(req_id, prompt, max_new_tokens: int = 32, *,
                 deadline=None, priority: str = "interactive",
-                session: Optional[str] = None, retries: int = 0) -> dict:
+                session: Optional[str] = None, retries: int = 0,
+                trace=None) -> dict:
     """The wire/journal-compatible request record. The deadline is
     carried as an ABSOLUTE unix expiry (wall time is the only clock two
     processes share) so every hop — router -> store -> replica ->
-    journal -> requeue — grants only the REMAINING budget."""
+    journal -> requeue — grants only the REMAINING budget. ``trace``
+    (a ``{"trace_id", "span_id"}`` dict or anything
+    :func:`paddle_tpu.obs.trace_ctx` accepts) rides the record so the
+    receiving worker's spans parent under the submitter's."""
     prompt = np.asarray(prompt, np.int32).reshape(-1)
     expires = None
     if deadline is not None:
@@ -100,6 +105,7 @@ def make_record(req_id, prompt, max_new_tokens: int = 32, *,
         "deadline_unix": expires,
         "session": session,
         "retries": int(retries),
+        "trace": _obs.trace_ctx(trace),
     }
 
 
@@ -156,7 +162,8 @@ class InProcessReplica:
             int(rec["max_new_tokens"]),
             deadline=_remaining_budget(rec),
             priority=rec.get("priority", "interactive"),
-            retries=int(rec.get("retries", 0)))
+            retries=int(rec.get("retries", 0)),
+            trace=rec.get("trace"))
 
     def poll_completed(self) -> List[dict]:
         out = []
@@ -313,7 +320,8 @@ class ReplicaServer:
                 int(rec["max_new_tokens"]),
                 deadline=_remaining_budget(rec),
                 priority=rec.get("priority", "interactive"),
-                retries=int(rec.get("retries", 0)))
+                retries=int(rec.get("retries", 0)),
+                trace=rec.get("trace"))
             n += 1
         return n
 
@@ -483,15 +491,21 @@ class ClusterRouter:
     # -- submission ------------------------------------------------------
     def submit(self, req_id, prompt, max_new_tokens: int = 32, *,
                deadline=None, priority: str = "interactive",
-               session: Optional[str] = None) -> int:
+               session: Optional[str] = None, trace=None) -> int:
         """Route + dispatch one request; returns the replica index it
         was placed on. Results arrive via :meth:`poll` / :meth:`run`,
-        keyed by ``req_id`` — across any number of replica deaths."""
-        rec = make_record(
-            req_id, prompt, max_new_tokens, deadline=deadline,
-            priority=priority, session=session,
-            retries=self.retries.get(req_id, 0))
-        idx = self.route(rec["prompt"], session=session)
+        keyed by ``req_id`` — across any number of replica deaths.
+        ``trace`` joins an upstream trace; otherwise a fresh one is
+        minted here so the replica's admission span parents under this
+        ``route`` span."""
+        with _obs.span("route", parent=_obs.trace_ctx(trace),
+                       tid="router", req=str(req_id)) as sp:
+            rec = make_record(
+                req_id, prompt, max_new_tokens, deadline=deadline,
+                priority=priority, session=session,
+                retries=self.retries.get(req_id, 0), trace=sp.ctx())
+            idx = self.route(rec["prompt"], session=session)
+            sp.args["replica"] = self.replicas[idx].replica_id
         self._dispatch(rec, idx)
         return idx
 
@@ -691,7 +705,7 @@ class ClusterRouter:
                 except Exception:  # noqa: BLE001 — snapshot best-effort
                     entry["load"] = None
             reps.append(entry)
-        return {
+        return _obs.health_envelope("router", {
             "replicas": reps,
             "dead": sorted(self.dead),
             "inflight": len(self.inflight),
@@ -701,4 +715,4 @@ class ClusterRouter:
             "misroutes": self.n_misroutes,
             "recoveries": self.n_recoveries,
             "sessions": len(self._sessions),
-        }
+        })

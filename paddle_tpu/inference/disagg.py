@@ -82,6 +82,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from .. import obs as _obs
 from ..base.dtype import convert_dtype
 from ..distributed.communication import flight_recorder as _fr
 from ..distributed.store import CorruptBlobError
@@ -136,6 +137,10 @@ class HandoffPayload:
     pages: np.ndarray
     scales: Optional[np.ndarray]
     meta: dict
+    # carryable trace context ({"trace_id", "span_id"} or None): rides
+    # the CRC-framed header so the decode worker's spans parent under
+    # the prefill-side trace across the process boundary (ISSUE 12)
+    trace: Optional[dict] = None
 
     @classmethod
     def from_request(cls, req: GenRequest, pages, scales,
@@ -148,7 +153,8 @@ class HandoffPayload:
             first_token=int(req.out[0]),
             max_new_tokens=int(req.max_new_tokens), priority=req.priority,
             deadline_unix=expires, retries=int(req.retries),
-            pages=pages, scales=scales, meta=dict(meta))
+            pages=pages, scales=scales, meta=dict(meta),
+            trace=_obs.trace_ctx(req))
 
     def remaining_budget(self) -> Optional[float]:
         return (None if self.deadline_unix is None
@@ -156,12 +162,14 @@ class HandoffPayload:
 
     def to_request(self) -> GenRequest:
         rem = self.remaining_budget()
+        t = self.trace or {}
         return GenRequest(
             self.req_id, np.asarray(self.prompt, np.int32),
             int(self.max_new_tokens),
             deadline=None if rem is None else Deadline(max(rem, 0.0)),
             t_submit=time.perf_counter(), priority=self.priority,
-            retries=int(self.retries))
+            retries=int(self.retries),
+            trace_id=t.get("trace_id"), span_id=t.get("span_id"))
 
     # -- wire format ----------------------------------------------------
     # !I header_len | header json | pages bytes | scales bytes
@@ -177,6 +185,7 @@ class HandoffPayload:
             "priority": self.priority,
             "deadline_unix": self.deadline_unix,
             "retries": int(self.retries),
+            "trace": self.trace,
             "meta": self.meta,
             "pages": {"shape": list(self.pages.shape),
                       "dtype": str(self.pages.dtype)},
@@ -224,7 +233,8 @@ class HandoffPayload:
             priority=header.get("priority", "interactive"),
             deadline_unix=header.get("deadline_unix"),
             retries=int(header.get("retries", 0)),
-            pages=pages, scales=scales, meta=dict(header["meta"]))
+            pages=pages, scales=scales, meta=dict(header["meta"]),
+            trace=header.get("trace"))
 
 
 # ---------------------------------------------------------------------------
@@ -288,7 +298,11 @@ class KVHandoffSender:
                    detail=f"req={payload.req_id}")
         self._seq += 1
         seq = f"{self.sender_id}-{self.incarnation}-{self._seq:08d}"
-        self._put_transfer(seq, payload.req_id, data, dl)
+        with _obs.span("handoff_send",
+                       parent=_obs.trace_ctx(payload.trace),
+                       tid="handoff", channel=self.channel, seq=seq,
+                       req=str(payload.req_id), bytes=len(data)):
+            self._put_transfer(seq, payload.req_id, data, dl)
         self.n_sent += 1
         return seq
 
@@ -406,6 +420,11 @@ class KVHandoffReceiver:
 
     def _settle(self, seq: str, commit_key: str
                 ) -> Optional[HandoffPayload]:
+        # the span starts BEFORE the trace context is known (it rides
+        # the payload being assembled); Span is mutable, so the parent
+        # is attached once the header verifies
+        sp = _obs.start_span("handoff_recv", tid="handoff",
+                             channel=self.channel, seq=seq)
         try:
             payload = self._assemble(seq, commit_key)
         except (CorruptBlobError, ValueError, KeyError) as e:
@@ -417,12 +436,19 @@ class KVHandoffReceiver:
                            f"corrupt:{type(e).__name__}: {e}"[:200])
             self.n_nacked += 1
             self._gc(seq)
+            _obs.finish_span(sp, verdict="nack",
+                             error=type(e).__name__)
             return None
+        t = payload.trace or {}
+        if t.get("trace_id"):
+            sp.trace_id = t["trace_id"]
+            sp.parent_id = t.get("span_id")
         self._done_seqs.add(seq)
         self.store.set(f"{self.ns}/ack/{seq}", "ok")
         self._gc(seq)
         if payload.req_id in self._seen_reqs:
             self.n_duplicates += 1  # resend of an imported request
+            _obs.finish_span(sp, verdict="duplicate")
             return None
         self._seen_reqs.add(payload.req_id)
         self.n_received += 1
@@ -430,6 +456,8 @@ class KVHandoffReceiver:
                    dtype=str(payload.pages.dtype),
                    group=f"disagg/{self.channel}",
                    detail=f"req={payload.req_id}")
+        _obs.finish_span(sp, verdict="ok", req=str(payload.req_id),
+                         bytes=int(payload.pages.nbytes))
         return payload
 
     def _assemble(self, seq: str,
@@ -533,7 +561,8 @@ class PrefillWorker:
             int(rec["max_new_tokens"]),
             deadline=remaining_budget(rec),
             priority=rec.get("priority", "interactive"),
-            retries=int(rec.get("retries", 0)))
+            retries=int(rec.get("retries", 0)),
+            trace=rec.get("trace"))
 
     def pending(self) -> bool:
         return (not self._dead) and (
@@ -749,7 +778,8 @@ class DecodeWorker:
             int(rec["max_new_tokens"]),
             deadline=remaining_budget(rec),
             priority=rec.get("priority", "interactive"),
-            retries=int(rec.get("retries", 0)))
+            retries=int(rec.get("retries", 0)),
+            trace=rec.get("trace"))
 
     def pending(self) -> bool:
         return (not self._dead) and (
@@ -823,7 +853,7 @@ class DecodeWorker:
                 self.supervisor.submit(
                     req.req_id, req.prompt, req.max_new_tokens,
                     deadline=rem, priority=req.priority,
-                    retries=req.retries)
+                    retries=req.retries, trace=req)
                 continue
             self.supervisor.submit_imported(req)
         self._pending_imports = still
@@ -881,16 +911,21 @@ class DisaggRouter:
                 if i not in self.dead_decode and w.alive()]
 
     def submit(self, req_id, prompt, max_new_tokens: int = 32, *,
-               deadline=None, priority: str = "interactive"
-               ) -> Tuple[str, int]:
+               deadline=None, priority: str = "interactive",
+               trace=None) -> Tuple[str, int]:
         """Route one request; returns ``(pool, index)`` — pool is
         "prefill" normally, "decode" when the prefill pool is down
         (colocated fallback). Results arrive via :meth:`poll` /
         :meth:`run`, keyed by ``req_id``, across any worker deaths."""
-        rec = make_record(req_id, prompt, max_new_tokens,
-                          deadline=deadline, priority=priority,
-                          retries=self.retries.get(req_id, 0))
-        return self._place(rec)
+        with _obs.span("route", parent=_obs.trace_ctx(trace),
+                       tid="router", req=str(req_id)) as sp:
+            rec = make_record(req_id, prompt, max_new_tokens,
+                              deadline=deadline, priority=priority,
+                              retries=self.retries.get(req_id, 0),
+                              trace=sp.ctx())
+            pool, idx = self._place(rec)
+            sp.args["pool"], sp.args["worker"] = pool, idx
+        return pool, idx
 
     def _place(self, rec: dict,
                exclude_prefill: Sequence[int] = ()) -> Tuple[str, int]:
@@ -1123,7 +1158,7 @@ class DisaggRouter:
                     e["load"] = None
             return e
 
-        return {
+        return _obs.health_envelope("disagg", {
             "prefill": [entry(w, i, self.dead_prefill)
                         for i, w in enumerate(self.prefill)],
             "decode": [entry(w, i, self.dead_decode)
@@ -1135,7 +1170,7 @@ class DisaggRouter:
             "fallback": self.n_fallback,
             "handoff_failed": self.n_handoff_failed,
             "recoveries": self.n_recoveries,
-        }
+        })
 
 
 # ---------------------------------------------------------------------------

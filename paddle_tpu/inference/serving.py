@@ -134,6 +134,8 @@ runs, which is the correctness contract the tests assert.
 """
 from __future__ import annotations
 
+import contextlib
+import itertools
 import threading
 import time
 from collections import deque
@@ -144,7 +146,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs as _obs
 from ..base.tape import no_grad
+from ..obs.metrics import MetricAttr, registry as _obs_registry
 from ..base.tensor import Tensor
 from ..ops.paged_attention import (
     BlockImportError,
@@ -221,6 +225,11 @@ class GenRequest:
     shed_reason: Optional[str] = None
     retries: int = 0
     clamped: bool = False
+    # distributed-tracing context (ISSUE 12): minted at admission or
+    # adopted from an upstream leg (router wire record / disagg handoff
+    # header), so every leg's span lands under ONE trace_id
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
 
     def expired(self) -> bool:
         return self.deadline is not None and self.deadline.expired()
@@ -270,15 +279,153 @@ class _RingEntry:
     request discards the ≤1-step over-issue for rows that finished or
     were evicted while the entry was in flight."""
 
-    __slots__ = ("kind", "arrays", "rows")
+    __slots__ = ("kind", "arrays", "rows", "span")
 
     def __init__(self, kind, arrays, rows):
         self.kind = kind        # "decode" | "spec" | "first"
         self.arrays = arrays    # device arrays to fetch
         self.rows = rows
+        self.span = None        # open obs "dispatch" span (issue→harvest)
+
+
+class _ShedCounts:
+    """Dict-shaped view over the per-priority ``serving_shed_total``
+    registry series: ``eng.n_shed["interactive"]``, ``.get()``,
+    ``.items()`` and dict equality all behave exactly like the plain
+    dict this used to be, but the counts live in the obs registry
+    (labels ``engine=<id>, priority=<class>``)."""
+
+    __slots__ = ("_labels", "_handles")
+
+    def __init__(self, labels: dict):
+        self._labels = dict(labels)
+        self._handles: Dict[str, object] = {}
+        for pri in ("interactive", "batch"):
+            self[pri] = 0
+
+    def _h(self, pri: str):
+        h = self._handles.get(pri)
+        if h is None:
+            h = _obs_registry().counter(
+                "serving_shed_total",
+                {**self._labels, "priority": str(pri)},
+                help="requests shed at admission, by priority class")
+            self._handles[pri] = h
+        return h
+
+    def __getitem__(self, pri) -> int:
+        return int(self._h(pri).value)
+
+    def __setitem__(self, pri, v) -> None:
+        self._h(pri).set_(float(v))
+
+    def get(self, pri, default=0):
+        h = self._handles.get(pri)
+        return int(h.value) if h is not None else default
+
+    def keys(self):
+        return self._handles.keys()
+
+    def values(self):
+        return [int(h.value) for h in self._handles.values()]
+
+    def items(self):
+        return [(k, int(h.value)) for k, h in self._handles.items()]
+
+    def __iter__(self):
+        return iter(self._handles)
+
+    def __len__(self) -> int:
+        return len(self._handles)
+
+    def __eq__(self, other):
+        if isinstance(other, (dict, _ShedCounts)):
+            return dict(self.items()) == dict(other.items()) \
+                if isinstance(other, _ShedCounts) \
+                else dict(self.items()) == other
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return repr(dict(self.items()))
+
+
+_ENGINE_IDS = itertools.count(1)
 
 
 class ContinuousBatchingEngine:
+    # ISSUE 12: every stats counter below is a registry-backed series
+    # (label engine=<id>). The data descriptors keep `self.steps += 1`
+    # and external writes (`eng.ewma_step_s = None` in the overload
+    # bench) byte-identical to the old plain attributes while the
+    # numbers live in the process-global obs registry — EngineLoad,
+    # prefix_stats(), spec_stats() and overlap_stats() are now VIEWS
+    # over these series.
+    n_imported = MetricAttr(
+        "serving_kv_imported_total", as_int=True,
+        help="decode side: requests entered via KV import")
+    n_handed_off = MetricAttr(
+        "serving_kv_handed_off_total", as_int=True,
+        help="prefill side: KV exports released after ack")
+    prefix_hit_tokens = MetricAttr(
+        "serving_prefix_hit_tokens_total", as_int=True,
+        help="prompt tokens served from the prefix cache")
+    prefix_forks = MetricAttr(
+        "serving_prefix_forks_total", as_int=True,
+        help="copy-on-write block forks from adopted prefixes")
+    spec_proposed = MetricAttr(
+        "serving_spec_proposed_total", as_int=True,
+        help="real draft tokens sent to verify")
+    spec_accepted = MetricAttr(
+        "serving_spec_accepted_total", as_int=True,
+        help="draft tokens greedy-accepted by verify")
+    spec_emitted = MetricAttr(
+        "serving_spec_emitted_total", as_int=True,
+        help="tokens emitted by verify dispatches")
+    spec_dispatches = MetricAttr(
+        "serving_spec_dispatches_total", as_int=True,
+        help="speculative verify dispatches")
+    spec_slot_rounds = MetricAttr(
+        "serving_spec_slot_rounds_total", as_int=True,
+        help="slot participations in verify dispatches")
+    n_dispatches = MetricAttr(
+        "serving_dispatches_total", as_int=True,
+        help="decode-phase device dispatches")
+    host_blocked_s = MetricAttr(
+        "serving_host_blocked_seconds_total",
+        help="cumulative seconds the host blocked on D2H fetches")
+    busy_s = MetricAttr(
+        "serving_busy_seconds_total",
+        help="cumulative step() wall seconds")
+    h2d_bytes = MetricAttr(
+        "serving_h2d_bytes_total", as_int=True,
+        help="host->device upload bytes")
+    h2d_decode_bytes = MetricAttr(
+        "serving_h2d_decode_bytes_total", as_int=True,
+        help="host->device bytes on the decode-phase critical path")
+    d2h_bytes = MetricAttr(
+        "serving_d2h_bytes_total", as_int=True,
+        help="device->host fetch bytes")
+    steps = MetricAttr(
+        "serving_steps_total", as_int=True, help="engine iterations")
+    decode_tokens = MetricAttr(
+        "serving_decode_tokens_total", as_int=True,
+        help="decode-phase tokens emitted")
+    prefill_tokens = MetricAttr(
+        "serving_prefill_tokens_total", as_int=True,
+        help="prompt tokens prefilled (cache hits excluded)")
+    n_expired = MetricAttr(
+        "serving_expired_total", as_int=True,
+        help="accepted-then-expired requests (queue or in-flight)")
+    ewma_blocked_frac = MetricAttr(
+        "serving_host_blocked_frac", kind="gauge",
+        help="EWMA of the per-step host-blocked fraction")
+    ewma_step_s = MetricAttr(
+        "serving_ewma_step_seconds", kind="gauge",
+        help="EWMA of non-idle step wall time")
+    ewma_step_tokens = MetricAttr(
+        "serving_ewma_step_tokens", kind="gauge",
+        help="EWMA of real tokens drained per non-idle step")
+
     def __init__(self, model, *, max_batch: int, max_len: int,
                  block_size: int = 64, num_blocks: int,
                  prompt_pad: Optional[int] = None,
@@ -371,6 +518,24 @@ class ContinuousBatchingEngine:
                 f"role must be 'unified', 'prefill_only' or "
                 f"'decode_only', got {role!r}")
         self.role = role
+        # obs identity FIRST: every MetricAttr write below routes into
+        # registry series labeled engine=<id>, so the labels must exist
+        # before the first counter assignment
+        self._obs_id = f"eng{next(_ENGINE_IDS)}"
+        self._obs_labels = {"engine": self._obs_id}
+        _reg = _obs_registry()
+        self._h_ttft = _reg.histogram(
+            "serving_ttft_seconds", self._obs_labels,
+            help="seconds from submission to first token")
+        self._h_itl = _reg.histogram(
+            "serving_itl_seconds", self._obs_labels,
+            help="inter-token latency seconds")
+        self._h_queue = _reg.histogram(
+            "serving_queue_delay_seconds", self._obs_labels,
+            help="seconds from submission to slot binding")
+        self._c_requests = _reg.counter(
+            "serving_requests_total", self._obs_labels,
+            help="requests submitted (shed ones included)")
         # finished prefills awaiting export (prefill_only role): req_id
         # -> GenRequest; the KV blocks stay allocated under the req_id
         # until export_kv + release_handoff (or expiry/abandon)
@@ -487,7 +652,7 @@ class ContinuousBatchingEngine:
         # overload control + supervision surface
         self.admission = (None if admission is None
                           else AdmissionController(admission))
-        self.n_shed = {"interactive": 0, "batch": 0}
+        self.n_shed = _ShedCounts(self._obs_labels)
         self._pending_shed: List[GenRequest] = []  # sheds since drain
         self.n_expired = 0  # accepted-then-expired (queue or in-flight)
         self.prefill_paused = False  # degraded mode: KV blocks scarce
@@ -774,7 +939,16 @@ class ContinuousBatchingEngine:
         """Queue a dispatch's token outputs on the async D2H copy ring:
         the copies start NOW, the host reads them a step later."""
         self._start_async_copies(arrays)
-        self._ring.append(_RingEntry(kind, arrays, rows))
+        e = _RingEntry(kind, arrays, rows)
+        if _obs.enabled():
+            # device-timeline span: dispatch issue → harvest (closed in
+            # _harvest, possibly many steps later). Parent under the
+            # first row's request so a single-request trace shows its
+            # dispatches; co-batched requests ride in args.
+            e.span = _obs.start_span(
+                "dispatch", parent=(rows[0][1] if rows else None),
+                tid="device", kind=kind, rows=len(rows))
+        self._ring.append(e)
 
     def _harvest(self, *, drain: bool = False) -> int:
         """Process ring entries down to ``pipeline_depth`` (all of them
@@ -788,6 +962,12 @@ class ContinuousBatchingEngine:
         real = 0
         while len(self._ring) > target:
             e = self._ring.popleft()
+            if e.span is not None:
+                _obs.finish_span(e.span)  # issue → harvest pickup
+            hsp = (_obs.start_span("harvest", parent=e.span,
+                                   tid="serve", kind=e.kind)
+                   if e.span is not None and _obs.enabled() else None)
+            got0 = real
             if e.kind == "spec":
                 toks, acc = self._fetch(*e.arrays, copies_started=True)
                 real += self._apply_spec(toks, acc, e.rows)
@@ -801,6 +981,8 @@ class ContinuousBatchingEngine:
                 for i, req in e.rows:
                     real += self._apply_first_token(i, req,
                                                     int(firsts[i]))
+            if hsp is not None:
+                _obs.finish_span(hsp, tokens=real - got0)
         self._harvested_step += real
         return real
 
@@ -828,7 +1010,7 @@ class ContinuousBatchingEngine:
 
     def add_request(self, req_id, prompt, max_new_tokens: int = 32,
                     deadline=None, priority: str = "interactive",
-                    retries: int = 0):
+                    retries: int = 0, trace=None):
         """``deadline``: seconds or a ``Deadline`` — the request's total
         budget (queue wait included). None = no deadline. ``priority``
         is the admission class ("interactive" | "batch") — only
@@ -836,6 +1018,10 @@ class ContinuousBatchingEngine:
         ``retries`` seeds the recovery counter (cluster router /
         journal replay resubmissions carry prior engine deaths so
         poison quarantine counts per REQUEST, not per replica).
+        ``trace`` is an optional upstream trace context (a Span, a
+        ``{"trace_id", "span_id"}`` dict, or any object carrying those
+        attributes): when given, this request's spans parent under it;
+        otherwise a fresh trace is minted here.
         Returns the :class:`GenRequest`; with admission control a shed
         submission comes back immediately with ``status == "shed"``
         (it is also surfaced through the completed map)."""
@@ -859,6 +1045,21 @@ class ContinuousBatchingEngine:
                 f"request needs {self._blocks_needed(req)} blocks but the "
                 f"pool only has {self.manager.num_blocks} — it could never "
                 "be admitted")
+        ctx = _obs.trace_ctx(trace)
+        req.trace_id = (ctx or {}).get("trace_id") or _obs.new_trace_id()
+        self._c_requests.inc()
+        with _obs.span("admission", trace_id=req.trace_id, parent=ctx,
+                       tid="serve", req=str(req_id),
+                       priority=priority) as sp:
+            req.span_id = sp.span_id
+            out = self._decide_admission(req)
+            sp.args["verdict"] = ("shed" if out.status == "shed"
+                                  else "admit")
+        return out
+
+    def _decide_admission(self, req: GenRequest) -> GenRequest:
+        """The admission verdict path (chaos gate + overload control) —
+        the body the request's root ``admission`` span wraps."""
         # chaos site: the front door (drop = the submission is shed)
         if not _chaos.inject("serving.submit"):
             return self._shed(req, "chaos-drop")
@@ -1072,11 +1273,30 @@ class ContinuousBatchingEngine:
 
     def _append_token(self, req: GenRequest, tok: int):
         req.out.append(tok)
-        req.times.append(time.perf_counter())
+        now = time.perf_counter()
+        req.times.append(now)
+        # SLO histograms: the ONE token-emission point feeds TTFT and
+        # inter-token latency for every path (prefill first token,
+        # decode, spec verify, KV import)
+        if len(req.times) == 1:
+            self._h_ttft.observe(now - req.t_submit)
+        else:
+            self._h_itl.observe(now - req.times[-2])
+
+    @staticmethod
+    def _finish_req_spans(req: GenRequest, **args) -> None:
+        """Close any open per-request spans (prefill/decode) — called
+        at completion, expiry, and handoff release."""
+        for attr in ("_sp_prefill", "_sp_decode"):
+            sp = getattr(req, attr, None)
+            if sp is not None:
+                _obs.finish_span(sp, **args)
+                setattr(req, attr, None)
 
     def _expire(self, req: GenRequest):
         req.status = "expired"
         self.n_expired += 1
+        self._finish_req_spans(req, error="expired")
         self._completed[req.req_id] = req
 
     def _expire_queued(self):
@@ -1277,6 +1497,11 @@ class ContinuousBatchingEngine:
             slot.remaining = req.max_new_tokens
             slot.pending_first = False
             self._mark_dirty(slot_idx)
+            self._h_queue.observe(time.perf_counter() - req.t_submit)
+            req._sp_prefill = _obs.start_span(
+                "prefill", parent=req, tid="serve",
+                prompt_tokens=int(req.prompt.size),
+                cached_tokens=int(cached_len))
             self._queue.pop(0)  # bound above: leaves the queue LAST
 
             if self.chunked:
@@ -1331,6 +1556,7 @@ class ContinuousBatchingEngine:
         if done:
             self.manager.free_sequence(req.req_id)
             self._tables[slot_idx] = self._trash
+            self._finish_req_spans(req, tokens=len(req.out))
             self._completed[req.req_id] = req
             slot.req = None
             slot.pending_first = False
@@ -1347,9 +1573,18 @@ class ContinuousBatchingEngine:
         if slot.req is not req:
             return 0  # evicted/reassigned while in flight: discard
         slot.pending_first = False
+        sp = getattr(req, "_sp_prefill", None)
+        if sp is not None:
+            _obs.finish_span(sp)
+            req._sp_prefill = None
         self._append_token(req, first)
         slot.remaining -= 1
         self._mark_dirty(slot_idx)
+        if self.role != "prefill_only":
+            # prefill-only engines never decode: the decode span opens
+            # on the decode worker at KV import instead
+            req._sp_decode = _obs.start_span("decode", parent=req,
+                                             tid="serve")
         if not self._finish_if_done(slot_idx, first) \
                 and self.role == "prefill_only":
             self._to_handoff(slot_idx)
@@ -1533,6 +1768,10 @@ class ContinuousBatchingEngine:
         slot.remaining -= 1
         self.n_imported += 1
         self._mark_dirty(slot_idx)
+        # the imported request's decode leg parents under whatever
+        # context the handoff header carried (set by the caller on req)
+        req._sp_decode = _obs.start_span("decode", parent=req,
+                                         tid="serve", imported=True)
         self._finish_if_done(slot_idx, int(first_token))
 
     def _schedule_prefill(self, budget_left: int) -> Dict[int, int]:
@@ -1656,6 +1895,9 @@ class ContinuousBatchingEngine:
         returns the k+1 real positions per slot the dispatch
         processed."""
         k = self.spec_k
+        sp_d = (_obs.start_span(
+            "dispatch", parent=self._slots[active[0]].req, tid="device",
+            kind="spec", rows=len(active)) if _obs.enabled() else None)
         toks, acc, _, _, _, self._pools = self._run_jit(
             self._spec_jit, self._pools, self._h2d(tok, decode=True),
             self._h2d(tables, decode=True), self._h2d(cl, decode=True),
@@ -1663,8 +1905,14 @@ class ContinuousBatchingEngine:
         self._phases_run.add("spec_verify")
         self.n_dispatches += 1
         toks, acc = self._fetch(toks, acc)
+        if sp_d is not None:
+            _obs.finish_span(sp_d)
         rows = [(i, self._slots[i].req, n_real.get(i, 0)) for i in active]
+        hsp = (_obs.start_span("harvest", parent=sp_d, tid="serve",
+                               kind="spec") if sp_d is not None else None)
         self._apply_spec(toks, acc, rows)
+        if hsp is not None:
+            _obs.finish_span(hsp)
         return len(active) * (k + 1)
 
     def _decode_rows(self):
@@ -1706,6 +1954,9 @@ class ContinuousBatchingEngine:
                 return self._spec_step(active, tok, tables, cl, fin,
                                        *proposed)
         k = self.decode_chunk
+        sp_d = (_obs.start_span(
+            "dispatch", parent=self._slots[active[0]].req, tid="device",
+            kind="decode", rows=len(active)) if _obs.enabled() else None)
         if self._scan_gate(active, budget_left):
             toks, _, _, _, self._pools = self._run_jit(
                 self._chunk_jit, self._pools, self._h2d(tok, decode=True),
@@ -1722,7 +1973,14 @@ class ContinuousBatchingEngine:
             self._phases_run.add("decode")
             self.n_dispatches += 1
             toks = np.asarray(self._fetch(nxt))[None]  # [1, B]
-        self._apply_decode(toks, [(i, self._slots[i].req) for i in active])
+        if sp_d is not None:
+            _obs.finish_span(sp_d)
+        hsp = (_obs.start_span("harvest", parent=sp_d, tid="serve",
+                               kind="decode") if sp_d is not None else None)
+        self._apply_decode(toks,
+                           [(i, self._slots[i].req) for i in active])
+        if hsp is not None:
+            _obs.finish_span(hsp)
         return len(active) * toks.shape[0]
 
     # -- shared scheduling gates -----------------------------------------

@@ -48,6 +48,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import obs as _obs
 from ..testing import chaos as _chaos
 from ..utils.retries import Deadline
 from .serving import GenRequest
@@ -328,7 +329,7 @@ class ServingSupervisor:
     # -- submission -----------------------------------------------------
     def submit(self, req_id, prompt, max_new_tokens: int = 32, *,
                deadline=None, priority: str = "interactive",
-               retries: int = 0) -> GenRequest:
+               retries: int = 0, trace=None) -> GenRequest:
         """Front door: runs the engine's admission control. Shed
         submissions are recorded as results immediately; accepted ones
         are journaled (when journaling) so a crash cannot lose them.
@@ -342,7 +343,7 @@ class ServingSupervisor:
         return value, keyed by ``req_id``."""
         req = self.engine.add_request(
             req_id, prompt, max_new_tokens, deadline=deadline,
-            priority=priority, retries=retries)
+            priority=priority, retries=retries, trace=trace)
         self.journaled_ids.add(req_id)
         self.journaled_retries[req_id] = max(
             self.journaled_retries.get(req_id, 0), int(retries))
@@ -590,22 +591,30 @@ class ServingSupervisor:
             req.req_id, req.prompt, req.max_new_tokens,
             deadline=req.deadline, t_submit=req.t_submit,
             priority=req.priority, retries=req.retries,
-            clamped=req.clamped)
+            clamped=req.clamped, trace_id=req.trace_id,
+            span_id=req.span_id)
 
     def _note(self, kind: str, detail: str):
         self.events.append((kind, detail))
+        # watchdog/recovery escalations land on the obs timeline as
+        # instant events beside the request spans (ISSUE 12)
+        _obs.instant(f"supervisor_{kind}", tid="supervisor",
+                     detail=detail)
         if kind in ("warn", "dump", "hung"):
             sys.stderr.write(f"ServingSupervisor: {detail}\n")
 
     # -- health surface -------------------------------------------------
     def health(self) -> dict:
         """Structured snapshot for routers/probes: supervisor state,
-        restart/poison counts, and the live engine load signal."""
+        restart/poison counts, and the live engine load signal. Wrapped
+        in the shared, registry-sourced :func:`paddle_tpu.obs
+        .health_envelope` (``schema_version``/``kind``/...), so every
+        health() surface carries the same top-level keys."""
         status_counts: Dict[str, int] = {}
         for r in self.results.values():
             status_counts[r.status] = status_counts.get(r.status, 0) + 1
         eng = self.engine
-        return {
+        return _obs.health_envelope("supervisor", {
             "state": "serving" if self.pending else "idle",
             "restarts": self.restarts,
             "consecutive_failures": self._failures,
@@ -626,7 +635,7 @@ class ServingSupervisor:
             "overlap": (eng.overlap_stats()
                         if hasattr(eng, "overlap_stats")
                         else {"enabled": False}),
-        }
+        })
 
 
 # Public alias: the cluster router (inference/cluster.py) replays a dead

@@ -1,0 +1,124 @@
+"""paddle_tpu.obs — the unified observability layer (ISSUE 12).
+
+One subsystem, three planes, one timeline:
+
+- **Metrics** (:mod:`.metrics`): a process-global registry of named
+  counters / gauges / log-bucketed histograms with frozen-tuple
+  labels. Every legacy stats surface (``EngineLoad``,
+  ``prefix_stats()``, ``spec_stats()``, ``overlap_stats()``, the
+  ``health()`` envelopes, ``TrainTelemetry`` step times, the
+  admission counters) is now a VIEW over this registry: old call
+  signatures return their historical keys, the numbers live here.
+  Built-in SLO histograms: ``serving_ttft_seconds``,
+  ``serving_itl_seconds``, ``serving_queue_delay_seconds`` with
+  p50/p95/p99 accessors (:func:`slo_summary`).
+- **Traces** (:mod:`.trace`): Dapper-style per-request spans carried on
+  ``GenRequest`` → cluster wire records → the disagg handoff payload
+  header, collected in a bounded per-process ring, exported as Chrome
+  trace-event JSON (Perfetto-loadable) and stitched across worker
+  processes by trace_id.
+- **Device/compile events** (:mod:`.compile`): XLA compile count +
+  wall time from the same jax compile-log seam ``recompile_guard``
+  uses; dispatch→harvest spans from the serving engine's async copy
+  ring; supervisor watchdog / rollback / chaos instants.
+
+CLI: ``python -m paddle_tpu.obs dump|prom|trace [file]``.
+"""
+from .compile import (
+    compile_events_installed,
+    install_compile_events,
+    uninstall_compile_events,
+)
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricAttr,
+    MetricsRegistry,
+    labels_of,
+    registry,
+)
+from .trace import (
+    Span,
+    TraceRing,
+    enabled,
+    export_chrome_trace,
+    finish_span,
+    instant,
+    new_trace_id,
+    ring,
+    set_enabled,
+    set_process_label,
+    span,
+    start_span,
+    stitch_traces,
+    trace_ctx,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricAttr", "MetricsRegistry",
+    "registry", "labels_of",
+    "Span", "TraceRing", "new_trace_id", "span", "start_span",
+    "finish_span", "instant", "trace_ctx", "ring", "set_enabled",
+    "enabled", "set_process_label", "export_chrome_trace",
+    "stitch_traces",
+    "install_compile_events", "uninstall_compile_events",
+    "compile_events_installed",
+    "slo_summary", "HEALTH_SCHEMA_VERSION", "health_envelope",
+]
+
+# SLO histograms the serving engine feeds (seconds)
+SLO_HISTOGRAMS = (
+    "serving_ttft_seconds",
+    "serving_itl_seconds",
+    "serving_queue_delay_seconds",
+)
+
+
+def slo_summary() -> dict:
+    """p50/p95/p99 + count for the built-in TTFT / inter-token-latency
+    / queue-delay histograms, aggregated over every label set."""
+    out = {}
+    reg = registry()
+    for name in SLO_HISTOGRAMS:
+        agg = Histogram()
+        m = reg._metrics.get(name)
+        if m is not None:
+            for h in list(m.series.values()) + list(m.overflow):
+                agg._n += h._n
+                agg._sum += h._sum
+                agg._zero += h._zero
+                agg._min = min(agg._min, h._min)
+                agg._max = max(agg._max, h._max)
+                for i, c in h._counts.items():
+                    agg._counts[i] = agg._counts.get(i, 0) + c
+        out[name] = agg.to_dict()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The shared health() envelope (ISSUE 12 satellite: the two-shapes
+# drift fix). Every health() surface wraps its legacy payload with the
+# same versioned top-level keys, each sourced from the registry.
+
+HEALTH_SCHEMA_VERSION = 1
+
+# the common top-level keys every health() shape now carries, beyond
+# its legacy payload; the schema regression test pins this exact set
+HEALTH_COMMON_KEYS = ("schema_version", "kind", "shed_total",
+                      "expired_total", "requests_total")
+
+
+def health_envelope(kind: str, payload: dict) -> dict:
+    """Wrap one surface's legacy health payload with the shared,
+    registry-sourced envelope keys. Legacy keys stay at the top level
+    (old readers keep indexing them); the envelope keys win on
+    collision only for ``schema_version``/``kind``."""
+    reg = registry()
+    out = dict(payload)
+    out["schema_version"] = HEALTH_SCHEMA_VERSION
+    out["kind"] = str(kind)
+    out["shed_total"] = int(reg.total("serving_shed_total"))
+    out["expired_total"] = int(reg.total("serving_expired_total"))
+    out["requests_total"] = int(reg.total("serving_requests_total"))
+    return out

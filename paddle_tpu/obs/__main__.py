@@ -1,0 +1,110 @@
+"""``python -m paddle_tpu.obs`` — render observability state.
+
+Subcommands::
+
+    dump  [file.jsonl]   # JSON metrics snapshot (current process, or
+                         # the LAST line of a snapshot_jsonl file)
+    prom  [file.jsonl]   # Prometheus text exposition of the same
+    trace [out.json]     # Chrome trace-event JSON from this process's
+                         # ring (mostly useful with --stitch)
+    trace --stitch a.json b.json ... [-o out.json] [--trace-id ID]
+                         # merge per-worker ring dumps by trace_id
+
+A fresh interpreter has an empty registry, so ``dump``/``prom``
+without a file mostly matter for smoke tests; the file forms are the
+operational path (workers append snapshots via
+``registry().snapshot_jsonl(path)`` and dump their rings at exit).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .metrics import registry
+from .trace import export_chrome_trace, ring, stitch_traces
+
+
+def _load_last_snapshot(path: str) -> dict:
+    last = None
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                last = line
+    if last is None:
+        raise SystemExit(f"{path}: no snapshot lines")
+    return json.loads(last)
+
+
+def _snap_to_text(snap: dict) -> str:
+    """Prometheus-ish text from a JSON snapshot (file path: we only
+    have the serialized values, not live histograms)."""
+    lines = []
+    for name in sorted(snap.get("metrics", {})):
+        m = snap["metrics"][name]
+        lines.append(f"# TYPE {name} {m.get('kind', 'untyped')}")
+        for s in m.get("series", []):
+            labels = s.get("labels", {})
+            body = ",".join(f'{k}="{v}"'
+                            for k, v in sorted(labels.items()))
+            lab = "{" + body + "}" if body else ""
+            v = s.get("value")
+            if isinstance(v, dict):  # serialized histogram
+                for kk in ("count", "sum", "p50", "p95", "p99"):
+                    if v.get(kk) is not None:
+                        lines.append(f"{name}_{kk}{lab} {v[kk]}")
+            elif v is not None:
+                lines.append(f"{name}{lab} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m paddle_tpu.obs")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    d = sub.add_parser("dump", help="JSON metrics snapshot")
+    d.add_argument("file", nargs="?", help="snapshot JSONL to render "
+                   "(default: current process registry)")
+    p = sub.add_parser("prom", help="Prometheus text exposition")
+    p.add_argument("file", nargs="?")
+    t = sub.add_parser("trace", help="Chrome trace-event JSON")
+    t.add_argument("dumps", nargs="*",
+                   help="with --stitch: per-worker ring-dump JSON files")
+    t.add_argument("--stitch", action="store_true",
+                   help="merge ring-dump files instead of exporting "
+                        "this process's ring")
+    t.add_argument("--trace-id", default=None,
+                   help="restrict the stitch to one trace")
+    t.add_argument("-o", "--out", default=None,
+                   help="write the Chrome trace JSON here "
+                        "(default: stdout)")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "dump":
+        snap = (_load_last_snapshot(args.file) if args.file
+                else registry().snapshot())
+        print(json.dumps(snap, indent=2, sort_keys=True))
+        return 0
+    if args.cmd == "prom":
+        if args.file:
+            sys.stdout.write(_snap_to_text(_load_last_snapshot(args.file)))
+        else:
+            sys.stdout.write(registry().expose_text())
+        return 0
+    # trace
+    if args.stitch:
+        dumps = []
+        for fp in args.dumps:
+            with open(fp, encoding="utf-8") as fh:
+                dumps.append(json.load(fh))
+        events = stitch_traces(dumps, trace_id=args.trace_id)
+    else:
+        events = ring().dump()
+    doc = export_chrome_trace(events, path=args.out)
+    if args.out is None:
+        print(json.dumps({"traceEvents": doc}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

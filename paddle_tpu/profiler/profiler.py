@@ -1,10 +1,21 @@
-"""Profiler core (ref: python/paddle/profiler/profiler.py:346)."""
+"""Profiler core (ref: python/paddle/profiler/profiler.py:346).
+
+Since ISSUE 12 this is a thin adapter over :mod:`paddle_tpu.obs`: every
+:class:`RecordEvent` doubles as an obs span (so user annotations land
+on the same Perfetto timeline as the serving/request spans) and step /
+event durations feed registry histograms readable via
+``python -m paddle_tpu.obs dump``. The jax.profiler device trace
+integration is unchanged.
+"""
 from __future__ import annotations
 
 import enum
 import os
 import time
 from typing import Callable, Iterable, Optional, Union
+
+from .. import obs as _obs
+from ..obs.metrics import registry as _obs_registry
 
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
@@ -76,11 +87,14 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
 
 class RecordEvent:
     """User span annotation (ref: profiler/utils.py RecordEvent) —
-    shows up in the XLA device trace via TraceAnnotation."""
+    shows up in the XLA device trace via TraceAnnotation AND as an obs
+    span named ``profiler:<name>`` on the host trace timeline, with the
+    duration folded into the ``profiler_event_seconds`` histogram."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._ctx = None
+        self._sp = None
         self.begin_ns = None
         self.end_ns = None
 
@@ -90,12 +104,21 @@ class RecordEvent:
         self.begin_ns = time.perf_counter_ns()
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
+        if _obs.enabled():
+            self._sp = _obs.start_span(f"profiler:{self.name}",
+                                       tid="profiler")
 
     def end(self):
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
             self.end_ns = time.perf_counter_ns()
+            _obs.finish_span(self._sp)
+            self._sp = None
+            _obs_registry().histogram(
+                "profiler_event_seconds", {"name": self.name},
+                help="RecordEvent span durations").observe(
+                    (self.end_ns - self.begin_ns) * 1e-9)
             if _active_profiler is not None:
                 _active_profiler._events.append(
                     (self.name, self.end_ns - self.begin_ns))
@@ -165,7 +188,11 @@ class Profiler:
     def step(self, num_steps: int = 1):
         now = time.perf_counter()
         if self._last_step_t is not None:
-            self._step_times.append((now - self._last_step_t) / num_steps)
+            per = (now - self._last_step_t) / num_steps
+            self._step_times.append(per)
+            _obs_registry().histogram(
+                "profiler_step_seconds",
+                help="Profiler.step() inter-step wall time").observe(per)
         self._last_step_t = now
         self.step_num += num_steps
         new_state = self._scheduler(self.step_num)

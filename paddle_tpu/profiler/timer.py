@@ -6,6 +6,8 @@ from __future__ import annotations
 import time
 from typing import Optional
 
+from ..obs.metrics import registry as _obs_registry
+
 __all__ = ["benchmark", "Benchmark"]
 
 
@@ -50,6 +52,14 @@ class Benchmark:
                 e.reader_cost_avg += (self._reader_cost - e.reader_cost_avg) / n
                 if batch_size and e.batch_cost_avg > 0:
                     e.ips_avg = batch_size / e.batch_cost_avg
+                # obs registry mirror (ISSUE 12): the live averages as
+                # gauges, so the throughput line shows up in obs dumps
+                reg = _obs_registry()
+                reg.gauge("benchmark_ips",
+                          help="benchmark() samples/s").set(e.ips_avg)
+                reg.gauge("benchmark_batch_cost_seconds",
+                          help="benchmark() batch cost avg").set(
+                              e.batch_cost_avg)
         self._step_t0 = now
 
     def step_info(self, unit: str = "samples") -> str:

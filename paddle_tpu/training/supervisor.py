@@ -56,6 +56,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import obs as _obs
 from ..incubate.checkpoint.auto_checkpoint import AutoCheckpoint
 from ..testing import chaos as _chaos
 from .anomaly import Anomaly, AnomalyDetector, unpack_health
@@ -562,6 +563,7 @@ class TrainingSupervisor:
 
     def _note(self, kind: str, detail: str):
         self.events.append((kind, detail))
+        _obs.instant(f"train_{kind}", tid="train", detail=detail)
         if kind in ("rollback", "quarantine", "gave_up", "peer_error",
                     "resume_peer_failed"):
             sys.stderr.write(f"TrainingSupervisor: {kind}: {detail}\n")

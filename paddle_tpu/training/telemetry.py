@@ -39,6 +39,7 @@ import numpy as np
 
 from ..distributed.communication import flight_recorder as _fr
 from ..distributed.store import KVStore
+from ..obs.metrics import registry as _obs_registry
 from ..utils.retries import Deadline, RetryPolicy
 
 __all__ = ["TrainTelemetry", "TelemetryVerdict", "grad_fingerprint"]
@@ -113,6 +114,12 @@ class TrainTelemetry:
         self._stragglers: List[int] = []
         self.last_verdict: Optional[TelemetryVerdict] = None
         self.n_published = 0
+        # obs registry mirror (ISSUE 12): step times land in a shared
+        # histogram so `python -m paddle_tpu.obs dump` shows training
+        # latency percentiles without reaching into the store rings
+        self._h_step = _obs_registry().histogram(
+            "train_step_seconds", {"tag": self.tag, "rank": self.rank},
+            help="per-rank training step wall time")
         # persistent stragglers get NAMED in the watchdog hang dump;
         # close() unregisters (a rebuilt supervisor incarnation must not
         # leave its dead telemetry writing stale verdicts into dumps)
@@ -136,6 +143,7 @@ class TrainTelemetry:
         self._ewma_dt = (step_time if self._ewma_dt is None
                          else self._ewma_dt + 0.2 * (step_time
                                                      - self._ewma_dt))
+        self._h_step.observe(float(step_time))
         rec = {"step": int(step), "dt": float(step_time),
                "ewma_dt": float(self._ewma_dt), "fp": fingerprint}
         _fr.record("train_step", group=f"{self.tag}/dp",

@@ -34,6 +34,9 @@ env:
   DISAGG_MAX_LEN      — engine max_len (default 32)
   DISAGG_BLOCKS       — engine num_blocks (default 16)
   DISAGG_BATCH        — engine max_batch (default 2)
+  DISAGG_TRACE_DUMP   — non-empty: write this process's obs trace-ring
+                        dump (JSON list of span dicts) to the path on
+                        serve-loop exit, for cross-process stitching
   PADDLE_CHAOS        — optional fault schedule (the victim only)
 """
 import json
@@ -44,6 +47,7 @@ import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import obs  # noqa: E402
 from paddle_tpu.distributed.store import TCPKVStore  # noqa: E402
 from paddle_tpu.inference.disagg import (  # noqa: E402
     DecodeWorker,
@@ -105,12 +109,20 @@ def main():
             wid, factory, store, journal_dir=journal_dir,
             steps_per_pump=int(
                 os.environ.get("DISAGG_STEPS_PER_PUMP", "1")))
+    obs.set_process_label(f"{role}:{wid}")
     crank = os.environ.get("DISAGG_CONTRACT_RANK")
-    DisaggServer(
-        store, worker,
-        contract_rank=None if crank is None else int(crank),
-        contract_world=int(os.environ.get("DISAGG_CONTRACT_WORLD", "2")),
-    ).serve(deadline=float(os.environ.get("DISAGG_BUDGET", "120")))
+    try:
+        DisaggServer(
+            store, worker,
+            contract_rank=None if crank is None else int(crank),
+            contract_world=int(
+                os.environ.get("DISAGG_CONTRACT_WORLD", "2")),
+        ).serve(deadline=float(os.environ.get("DISAGG_BUDGET", "120")))
+    finally:
+        dump_path = os.environ.get("DISAGG_TRACE_DUMP")
+        if dump_path:
+            with open(dump_path, "w", encoding="utf-8") as fh:
+                json.dump(obs.ring().dump(), fh)
 
 
 if __name__ == "__main__":

@@ -109,6 +109,12 @@ def pytest_configure(config):
         "token-exactness matrix, device-state invariants, recompile "
         "pin, crash-mid-pipeline recovery (standalone via "
         "`pytest -m overlap`)")
+    config.addinivalue_line(
+        "markers",
+        "obs: observability suite — metrics registry units, legacy-"
+        "stats parity, health-schema pin, trace stitch/export "
+        "(quick-lane; the 2-process stitched trace rides the slow "
+        "lane; standalone via `pytest -m obs`)")
 
 
 def pytest_collection_modifyitems(config, items):

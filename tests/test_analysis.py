@@ -488,6 +488,70 @@ class TestHotsync001:
 
 
 # ---------------------------------------------------------------------------
+# OBS001 — obs span/metric calls inside traced regions
+
+
+class TestObs001:
+    def test_catches_spans_and_metric_factories_under_jit(self):
+        src = """
+        import jax
+        from paddle_tpu import obs as _obs
+        from paddle_tpu.obs.metrics import registry as _obs_registry
+
+        @jax.jit
+        def step(x):
+            with _obs.span("decode_math"):   # line 8: trace-time span
+                y = x * 2
+            _obs.instant("stepped")          # line 10
+            _obs_registry().counter("steps_total").inc()  # line 11
+            return y
+
+        def fwd(x):
+            _obs.start_span("fwd")           # line 15
+            return x + 1
+        fwd_s = to_static(fwd)
+        """
+        got = findings_for(src, "OBS001")
+        assert lines_of(got) == [8, 10, 11, 15]
+        assert all(f.severity == "error" for f in got)
+        assert "trace time" in got[0].message
+
+    def test_near_misses_stay_clean(self):
+        src = """
+        import jax
+        from paddle_tpu import obs as _obs
+
+        @jax.jit
+        def step(x):
+            # a non-obs receiver whose method happens to be named
+            # span/instant must not match
+            y = doc.span(x)
+            z = clock.instant()
+            return y + z
+
+        def host_loop(x):
+            # obs on the host side of the jit boundary: the POINT
+            with _obs.span("dispatch"):
+                out = step(x)
+            _obs.instant("harvested")
+            return out
+        """
+        assert findings_for(src, "OBS001") == []
+
+    def test_suppression_comment_works(self):
+        src = """
+        import jax
+        from paddle_tpu import obs as _obs
+
+        @jax.jit
+        def step(x):
+            _obs.instant("trace-time marker")  # graft-lint: disable=OBS001
+            return x
+        """
+        assert findings_for(src, "OBS001") == []
+
+
+# ---------------------------------------------------------------------------
 # Engine mechanics: suppressions, baseline, shared autograd-hazard core
 
 

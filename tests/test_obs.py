@@ -1,0 +1,442 @@
+"""Observability layer (ISSUE 12): registry units, legacy-stats
+parity, the shared health() schema, and per-request trace stitching.
+
+Quick lane (``pytest -m obs``): histogram bucketing, the label
+cardinality cap, snapshot determinism, old-stats-API parity over a
+real engine, the health-envelope schema pin, the Chrome-trace JSON
+schema, and an in-process 2-worker disagg trace proving ONE trace_id
+yields a connected admission→handoff→decode span tree. The slow lane
+re-proves the stitch across two REAL worker processes (ring dumps via
+``DISAGG_TRACE_DUMP``, merged with the driver's own ring).
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import obs
+from paddle_tpu.obs.metrics import Histogram, MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _model():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _engine(role="unified", **kw):
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 8)
+    kw.setdefault("prompt_pad", 8)
+    return ContinuousBatchingEngine(_model(), role=role, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Registry units
+
+
+class TestHistogram:
+    def test_log_bucketing_and_percentiles(self):
+        h = Histogram()
+        for v in (0.001, 0.01, 0.1, 1.0, 10.0):
+            for _ in range(20):
+                h.observe(v)
+        assert h.count == 100
+        # p50 falls in the middle value's bucket: within the ~9%
+        # geometric-midpoint error of 0.1
+        assert 0.08 <= h.percentile(50) <= 0.13
+        # tail percentiles never exceed the observed max
+        assert h.percentile(99) <= 10.0
+        assert h.to_dict()["max"] == 10.0
+
+    def test_zero_bucket_and_bounds(self):
+        h = Histogram()
+        h.observe(0.0)
+        h.observe(-1.0)
+        h.observe(0.5)
+        bounds = h.bounds_counts()
+        assert bounds[0] == (0.0, 2)  # non-positive lands in the zero bucket
+        assert h.percentile(50) == 0.0
+        # cumulative count across buckets equals n
+        assert sum(c for _, c in bounds) == 3
+
+    def test_empty_histogram_reads_none(self):
+        h = Histogram()
+        assert h.percentile(50) is None
+        d = h.to_dict()
+        assert d["count"] == 0 and d["min"] is None and d["p99"] is None
+
+
+class TestRegistry:
+    def test_label_cardinality_cap_keeps_handles_live(self):
+        reg = MetricsRegistry(max_series=4)
+        handles = [reg.counter("t_cap_total", {"k": str(i)})
+                   for i in range(8)]
+        for h in handles:
+            h.inc(2.0)
+        # exports admit only max_series label sets...
+        assert reg.series_count("t_cap_total") == 4
+        snap = reg.snapshot()["metrics"]["t_cap_total"]["series"]
+        overflow = [s for s in snap
+                    if s["labels"].get("obs_overflow") == "true"]
+        assert len(overflow) == 1
+        assert overflow[0]["dropped_series"] == 4
+        # ...but every caller's own handle stays exact (parity contract)
+        assert all(h.value == 2.0 for h in handles)
+        assert reg.total("t_cap_total") == 16.0
+
+    def test_snapshot_is_deterministic(self):
+        reg = MetricsRegistry()
+        reg.counter("t_b_total", {"z": "1", "a": "2"}).inc()
+        reg.counter("t_a_total").inc(3)
+        reg.gauge("t_g").set(None)
+        reg.histogram("t_h_seconds").observe(0.25)
+        s1 = json.dumps(reg.snapshot(), sort_keys=True)
+        s2 = json.dumps(reg.snapshot(), sort_keys=True)
+        assert s1 == s2
+        names = list(reg.snapshot()["metrics"])
+        assert names == sorted(names)
+
+    def test_kind_collision_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("t_kind")
+        with pytest.raises(ValueError, match="counter"):
+            reg.gauge("t_kind")
+
+    def test_prometheus_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("t_req_total", {"engine": "e1"},
+                    help="requests").inc(5)
+        reg.histogram("t_lat_seconds").observe(0.1)
+        reg.gauge("t_unset").set(None)  # None gauges are skipped
+        text = reg.expose_text()
+        assert '# TYPE t_req_total counter' in text
+        assert 't_req_total{engine="e1"} 5.0' in text
+        assert "t_lat_seconds_bucket" in text
+        assert 't_lat_seconds_count 1' in text
+        # unset gauges keep their TYPE header but emit no sample line
+        assert "\nt_unset " not in text
+
+
+# ---------------------------------------------------------------------------
+# Legacy stats surfaces are views over the registry (parity)
+
+
+class TestLegacyParity:
+    def _run(self, eng, n=2, toks=4):
+        for i in range(n):
+            eng.add_request(f"p{i}", np.arange(6, dtype=np.int32) + i,
+                            max_new_tokens=toks)
+        eng.run()
+
+    def test_engine_counters_keep_types_and_registry_agrees(self):
+        from paddle_tpu.obs.metrics import registry
+
+        eng = self._make_and_run()
+        # the legacy reads: ints stay ints, EWMAs stay Optional floats
+        assert isinstance(eng.decode_tokens, int)
+        assert isinstance(eng.steps, int)
+        # first new token per request is emitted by prefill; the
+        # remaining max_new_tokens-1 are decode steps
+        assert eng.decode_tokens == 2 * 3
+        assert isinstance(eng.n_shed.get("batch", 0), int)
+        assert eng.n_shed == {"interactive": 0, "batch": 0}
+        assert eng.n_expired == 0
+        assert eng.ewma_step_s is None or eng.ewma_step_s > 0
+        # the numbers live in the registry, labeled by engine id
+        labels = {"engine": eng._obs_id}
+        assert registry().value(
+            "serving_decode_tokens_total", labels) == float(
+                eng.decode_tokens)
+        assert registry().value(
+            "serving_steps_total", labels) == float(eng.steps)
+        assert registry().value(
+            "serving_requests_total", labels) == 2.0
+        # external writes go through too (the bench's reset idiom)
+        eng.ewma_step_s = None
+        assert eng.ewma_step_s is None
+        assert registry().value("serving_ewma_step_seconds",
+                                labels) is None
+
+    def _make_and_run(self):
+        eng = _engine()
+        self._run(eng)
+        return eng
+
+    def test_stats_dicts_keep_their_keys(self):
+        eng = self._make_and_run()
+        assert set(eng.prefix_stats()) >= {
+            "enabled", "hit_tokens", "prefill_tokens", "forks",
+            "hit_rate"}
+        assert set(eng.spec_stats()) == {
+            "enabled", "k", "proposed", "accepted", "acceptance_rate",
+            "dispatches", "emitted", "tokens_per_slot_round"}
+        ov = eng.overlap_stats()
+        assert {"enabled", "dispatches", "host_blocked_s",
+                "h2d_bytes", "d2h_bytes"} <= set(ov)
+        load = eng.load().as_dict()
+        assert {"queue_depth", "kv_occupancy", "token_backlog",
+                "ewma_step_s", "est_queue_delay_s",
+                "host_blocked_frac"} <= set(load)
+
+    def test_slo_histograms_fill_and_summarize(self):
+        eng = self._make_and_run()
+        s = obs.slo_summary()
+        assert s["serving_ttft_seconds"]["count"] >= 2
+        assert s["serving_itl_seconds"]["count"] >= 2 * 3
+        assert s["serving_queue_delay_seconds"]["count"] >= 2
+        assert s["serving_ttft_seconds"]["p50"] is not None
+
+
+# ---------------------------------------------------------------------------
+# The shared health() envelope (the two-shapes-drift fix)
+
+
+class TestHealthSchema:
+    def test_common_keys_are_pinned(self):
+        # the regression pin: every health surface carries exactly
+        # these shared keys on top of its legacy payload
+        assert obs.HEALTH_COMMON_KEYS == (
+            "schema_version", "kind", "shed_total", "expired_total",
+            "requests_total")
+        assert obs.HEALTH_SCHEMA_VERSION == 1
+
+    def test_supervisor_router_disagg_share_the_envelope(self, tmp_path):
+        from paddle_tpu.distributed.store import MemKVStore
+        from paddle_tpu.inference.cluster import (ClusterRouter,
+                                                  InProcessReplica)
+        from paddle_tpu.inference.disagg import (DecodeWorker,
+                                                 DisaggRouter,
+                                                 PrefillWorker)
+        from paddle_tpu.inference.supervisor import ServingSupervisor
+
+        sup = ServingSupervisor(_engine)
+        router = ClusterRouter([InProcessReplica("r0", _engine)])
+        store = MemKVStore()
+        disagg = DisaggRouter(
+            [PrefillWorker("pf0", lambda: _engine("prefill_only"),
+                           store, ["dx0"])],
+            [DecodeWorker("dx0", _engine, store)])
+        shapes = {"supervisor": sup.health(),
+                  "router": router.health(),
+                  "disagg": disagg.health()}
+        for kind, h in shapes.items():
+            for key in obs.HEALTH_COMMON_KEYS:
+                assert key in h, (kind, key)
+            assert h["schema_version"] == obs.HEALTH_SCHEMA_VERSION
+            assert h["kind"] == kind
+            assert isinstance(h["shed_total"], int)
+            assert isinstance(h["requests_total"], int)
+        # legacy keys survive at the top level
+        assert "restarts" in shapes["supervisor"]
+        assert "replicas" in shapes["router"]
+        assert "prefill" in shapes["disagg"] and "decode" in \
+            shapes["disagg"]
+
+
+# ---------------------------------------------------------------------------
+# Traces: chrome export schema + the 2-worker stitch
+
+
+def _span_tree(events):
+    """(roots, orphans) over the completed spans of one trace."""
+    spans = [e for e in events if e.get("ph") != "i"]
+    ids = {e["span_id"] for e in spans}
+    roots = [e for e in spans if not e.get("parent_id")]
+    orphans = [e for e in spans
+               if e.get("parent_id") and e["parent_id"] not in ids]
+    return spans, roots, orphans
+
+
+class TestChromeTrace:
+    def test_export_schema(self, tmp_path):
+        tid = obs.new_trace_id()
+        with obs.span("outer", trace_id=tid, tid="serve") as sp:
+            with obs.span("inner", parent=sp, tid="serve"):
+                pass
+        obs.instant("marker", trace_id=tid)
+        events = [e for e in obs.ring().dump()
+                  if e.get("trace_id") == tid]
+        assert len(events) == 3
+        path = str(tmp_path / "trace.json")
+        doc = obs.export_chrome_trace(events, path=path)
+        with open(path, encoding="utf-8") as fh:
+            loaded = json.load(fh)
+        assert set(loaded) == {"traceEvents", "displayTimeUnit"}
+        evs = loaded["traceEvents"]
+        metas = [e for e in evs if e["ph"] == "M"]
+        assert any(e["name"] == "process_name" for e in metas)
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"outer", "inner"}
+        for e in xs:
+            assert {"name", "cat", "ph", "ts", "pid", "tid",
+                    "dur", "args"} <= set(e)
+            assert e["args"]["trace_id"] == tid
+        (inst,) = [e for e in evs if e["ph"] == "i"]
+        assert inst["s"] == "p"
+        assert doc  # the returned event list mirrors the file
+
+    def test_stitch_filters_and_orders(self):
+        tid = obs.new_trace_id()
+        a = [{"name": "b", "ts": 2.0, "span_id": "s2",
+              "trace_id": tid, "ph": "X"}]
+        b = [{"name": "a", "ts": 1.0, "span_id": "s1",
+              "trace_id": tid, "ph": "X"},
+             {"name": "other", "ts": 0.5, "span_id": "s0",
+              "trace_id": "ffff", "ph": "X"}]
+        out = obs.stitch_traces([a, b], trace_id=tid)
+        assert [e["name"] for e in out] == ["a", "b"]
+
+
+class TestDisaggTraceStitchInProcess:
+    def test_one_trace_id_connected_tree(self):
+        from paddle_tpu.distributed.store import MemKVStore
+        from paddle_tpu.inference.disagg import (DecodeWorker,
+                                                 DisaggRouter,
+                                                 PrefillWorker)
+
+        store = MemKVStore()
+        pf = PrefillWorker("pf0", lambda: _engine("prefill_only",
+                                                  num_blocks=4),
+                           store, ["dx0"])
+        dc = DecodeWorker("dx0", lambda: _engine("decode_only"), store)
+        router = DisaggRouter([pf], [dc])
+        pool, _ = router.submit("t0", np.arange(6, dtype=np.int32) + 3,
+                                max_new_tokens=4)
+        assert pool == "prefill"
+        out = []
+        for _ in range(400):
+            pf.pump()
+            dc.pump()
+            out = router.poll()
+            if out:
+                break
+        assert out and out[0]["status"] == "ok"
+        # recover the request's trace_id from its route span
+        routes = [e for e in obs.ring().dump()
+                  if e["name"] == "route"
+                  and e.get("args", {}).get("req") == "t0"]
+        assert routes, "route span missing"
+        tid = routes[-1]["trace_id"]
+        events = obs.stitch_traces([obs.ring().dump()], trace_id=tid)
+        spans, roots, orphans = _span_tree(events)
+        names = {e["name"] for e in spans}
+        assert {"route", "admission", "prefill", "handoff_send",
+                "handoff_recv", "decode", "dispatch",
+                "harvest"} <= names
+        assert [r["name"] for r in roots] == ["route"]
+        assert orphans == []
+
+
+@pytest.mark.slow
+class TestProcessDisaggTraceStitch:
+    def test_two_process_stitched_chrome_trace(self, tmp_path):
+        """ISSUE 12 acceptance: one request traced end-to-end across a
+        REAL 2-process disagg deployment produces a single stitched
+        Chrome-trace JSON with admission, route, prefill, handoff
+        (both roles), decode-dispatch, and harvest spans under one
+        trace_id."""
+        from paddle_tpu.distributed.store import (TCPKVStore,
+                                                  TCPStoreServer)
+        from paddle_tpu.inference.cluster import ProcessReplica
+        from paddle_tpu.inference.disagg import DisaggRouter
+        from paddle_tpu.utils.retries import Deadline
+
+        server = TCPStoreServer("127.0.0.1", 0)
+        procs, logs, dumps = [], [], {}
+        try:
+            reps = []
+            for rid, role in (("pf0", "prefill"), ("dx0", "decode")):
+                dump = str(tmp_path / f"{rid}-trace.json")
+                dumps[rid] = dump
+                env = dict(os.environ)
+                env.pop("PADDLE_CHAOS", None)
+                env.pop("XLA_FLAGS", None)
+                env.update({
+                    "DISAGG_ROLE": role,
+                    "DISAGG_STORE_PORT": str(server.port),
+                    "DISAGG_WORKER_ID": rid,
+                    "DISAGG_JOURNAL_DIR": str(tmp_path / rid),
+                    "DISAGG_DECODE_IDS": "dx0",
+                    "DISAGG_BUDGET": "180",
+                    "DISAGG_TRACE_DUMP": dump,
+                    "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": REPO + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""),
+                })
+                log = open(tmp_path / f"{rid}.log", "w")
+                logs.append(log)
+                p = subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(REPO, "tests", "_disagg_worker.py")],
+                    env=env, stdout=log, stderr=subprocess.STDOUT,
+                    cwd=REPO)
+                procs.append(p)
+                store = TCPKVStore("127.0.0.1", server.port)
+                reps.append(ProcessReplica(
+                    store, rid, journal_dir=str(tmp_path / rid),
+                    proc=p))
+            router = DisaggRouter([reps[0]], [reps[1]])
+
+            dl = Deadline(120)
+            store = TCPKVStore("127.0.0.1", server.port)
+            while not dl.expired():
+                if all(store.get(f"cluster/{r}/hb") is not None
+                       for r in ("pf0", "dx0")):
+                    break
+                time.sleep(0.25)
+
+            router.submit("t0", np.arange(8, dtype=np.int32) + 1,
+                          max_new_tokens=4)
+            res = router.run(deadline=150)
+            assert res["t0"]["status"] == "ok", res
+            router.stop(deadline=20.0)
+            for p in procs:
+                p.wait(timeout=60)
+            # the driver's ring (route span) + both workers' dumps
+            ring_dumps = [obs.ring().dump()]
+            for rid, path in dumps.items():
+                with open(path, encoding="utf-8") as fh:
+                    ring_dumps.append(json.load(fh))
+            routes = [e for e in ring_dumps[0]
+                      if e["name"] == "route"
+                      and e.get("args", {}).get("req") == "t0"]
+            tid = routes[-1]["trace_id"]
+            events = obs.stitch_traces(ring_dumps, trace_id=tid)
+            spans, roots, orphans = _span_tree(events)
+            names = {e["name"] for e in spans}
+            assert {"route", "admission", "prefill", "handoff_send",
+                    "handoff_recv", "decode", "dispatch",
+                    "harvest"} <= names, names
+            assert [r["name"] for r in roots] == ["route"]
+            assert orphans == []
+            # spans from all three processes landed in one tree
+            assert len({e.get("pid") for e in spans}) == 3
+            out_path = str(tmp_path / "stitched.json")
+            obs.export_chrome_trace(events, path=out_path)
+            with open(out_path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            assert len([e for e in doc["traceEvents"]
+                        if e["ph"] == "X"]) == len(spans)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=10)
+            for log in logs:
+                log.close()
+            server.stop()
