@@ -1,0 +1,469 @@
+"""Open-loop trace-driven load harness (ISSUE 14).
+
+Closed-loop drivers (submit, wait, submit) let a slow server throttle
+its own workload — the measured "latency" is then a function of the
+harness, not the scheduler (the coordinated-omission trap the serving
+papers this stack follows call out; Sarathi-Serve, DistServe). This
+harness is OPEN-LOOP: a seeded schedule fixes every arrival instant
+up front, and the driver submits at those instants regardless of what
+has completed. Queues build when the server falls behind — that
+build-up IS the signal the SLO report grades.
+
+The schedule generator composes four effects, all from one
+``random.Random(seed)`` stream (pure python — byte-reproducible
+across platforms, unlike numpy's generators across versions):
+
+- **Poisson arrivals** via exponential gaps at the envelope's peak
+  rate, thinned against the instantaneous rate (Lewis-Shedler): a
+  candidate at ``t`` survives with probability ``rate(t)/rate_max``.
+- **Burst episodes** — seeded windows covering ``burst_frac`` of the
+  horizon multiply the rate by ``burst_factor`` (the flash-crowd
+  shape single-rate Poisson can't produce).
+- **Diurnal ramp** — one sinusoid period compressed into the horizon
+  (amplitude ``diurnal_amp``), so a short run still sweeps through
+  trough and peak load.
+- **Heavy-tailed lengths** — lognormal prompt/output token counts
+  (clamped), the observed production shape: most requests short, a
+  fat tail of long ones.
+- **Zipf tenant mix** — tenant ``k`` drawn with weight
+  ``1/(k+1)^zipf_s``: one dominant tenant, a long tail of small ones,
+  the shape per-tenant attainment accounting exists for.
+
+``generate_schedule`` is pure and deterministic: same spec -> the
+same ``schedule_json`` bytes (the acceptance gate). The driver layer
+(:class:`EngineFront` / :class:`RouterFront`) adapts any front door —
+``ContinuousBatchingEngine``, ``ClusterRouter``, ``DisaggRouter`` —
+behind submit/pump/harvest, and the report is
+``paddle_tpu.obs.slo.attainment_report`` over the harvested
+per-token timestamps, plus a stitched Chrome trace of the run.
+
+    PYTHONPATH="/root/repo:$PYTHONPATH" python benchmarks/loadgen.py --smoke
+
+``--smoke`` runs the CPU mechanics check: a seeded schedule over a
+2-replica in-process ClusterRouter (tiny Llama, 3 zipf tenants) under
+``BENCH_TOTAL_BUDGET``, bench.py's preflight device probe included,
+and emits one JSON metric line with the per-tenant attainment table.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import sys
+import time
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # direct `python benchmarks/loadgen.py` runs
+    sys.path.insert(0, _REPO)
+
+
+# ---------------------------------------------------------------------------
+# schedule generation (pure, deterministic — no framework imports)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TraceSpec:
+    """The seeded workload shape. ``n_requests`` arrivals over roughly
+    ``duration_s`` schedule-seconds (the thinned process runs past the
+    horizon if the tail needs it; the driver can compress real time
+    with ``time_scale``)."""
+
+    seed: int = 0
+    n_requests: int = 48
+    duration_s: float = 8.0
+    burst_factor: float = 3.0     # rate multiplier inside burst windows
+    burst_frac: float = 0.15      # fraction of horizon under bursts
+    diurnal_amp: float = 0.5      # sinusoid amplitude, 0 <= amp < 1
+    tenants: int = 3
+    zipf_s: float = 1.2           # tenant-mix skew
+    batch_frac: float = 0.25      # P(priority == "batch")
+    prompt_len_median: float = 10.0
+    prompt_len_sigma: float = 0.5
+    prompt_len_max: int = 24
+    output_len_median: float = 6.0
+    output_len_sigma: float = 0.5
+    output_len_max: int = 12
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def _zipf_cdf(n: int, s: float) -> List[float]:
+    w = [1.0 / (k + 1) ** s for k in range(n)]
+    tot = sum(w)
+    acc, out = 0.0, []
+    for x in w:
+        acc += x / tot
+        out.append(acc)
+    return out
+
+
+def _burst_windows(rng: random.Random,
+                   spec: TraceSpec) -> List[Tuple[float, float]]:
+    """Seeded burst episodes covering ~burst_frac of the horizon."""
+    windows: List[Tuple[float, float]] = []
+    covered, target = 0.0, spec.burst_frac * spec.duration_s
+    while covered < target:
+        width = rng.uniform(0.03, 0.10) * spec.duration_s
+        start = rng.uniform(0.0, spec.duration_s - width)
+        windows.append((start, start + width))
+        covered += width
+    return windows
+
+
+def generate_schedule(spec: TraceSpec) -> List[dict]:
+    """The open-loop arrival trace: ``n_requests`` entries sorted by
+    arrival time ``t`` (seconds from run start), each with tenant,
+    priority, lengths, and a per-request prompt seed. Deterministic in
+    ``spec`` alone."""
+    if not 0.0 <= spec.diurnal_amp < 1.0:
+        raise ValueError("diurnal_amp must be in [0, 1)")
+    rng = random.Random(spec.seed)
+    bursts = _burst_windows(rng, spec)
+    cdf = _zipf_cdf(spec.tenants, spec.zipf_s)
+    base_rate = spec.n_requests / spec.duration_s
+    rate_max = base_rate * (1.0 + spec.diurnal_amp) * spec.burst_factor
+
+    def rate(t: float) -> float:
+        r = base_rate * (1.0 + spec.diurnal_amp
+                         * math.sin(2.0 * math.pi * t / spec.duration_s))
+        if any(a <= (t % spec.duration_s) < b for a, b in bursts):
+            r *= spec.burst_factor
+        return r
+
+    def _length(median: float, sigma: float, cap: int) -> int:
+        v = rng.lognormvariate(math.log(median), sigma)
+        return max(1, min(int(cap), int(round(v))))
+
+    out: List[dict] = []
+    t = 0.0
+    while len(out) < spec.n_requests:
+        # Lewis-Shedler thinning: candidates at the envelope's peak
+        # rate, kept with probability rate(t)/rate_max
+        t += rng.expovariate(rate_max)
+        if rng.random() * rate_max > rate(t):
+            continue
+        u = rng.random()
+        tenant = next(k for k, c in enumerate(cdf) if u <= c)
+        out.append({
+            "i": len(out),
+            "req_id": f"lg-{spec.seed}-{len(out):04d}",
+            "t": round(t, 6),
+            "tenant": f"tenant{tenant}",
+            "priority": ("batch" if rng.random() < spec.batch_frac
+                         else "interactive"),
+            "prompt_len": _length(spec.prompt_len_median,
+                                  spec.prompt_len_sigma,
+                                  spec.prompt_len_max),
+            "max_new_tokens": _length(spec.output_len_median,
+                                      spec.output_len_sigma,
+                                      spec.output_len_max),
+            "prompt_seed": rng.getrandbits(32),
+        })
+    return out
+
+
+def schedule_json(spec: TraceSpec, schedule: List[dict]) -> str:
+    """Canonical bytes for the schedule — the reproducibility gate:
+    equal specs must serialize byte-identically."""
+    return json.dumps({"schema": "paddle_tpu.loadgen/1",
+                       "spec": spec.to_dict(), "schedule": schedule},
+                      sort_keys=True, indent=2)
+
+
+# ---------------------------------------------------------------------------
+# front-door adapters
+# ---------------------------------------------------------------------------
+
+class EngineFront:
+    """Drive a bare ``ContinuousBatchingEngine``."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def submit(self, item: dict, prompt) -> None:
+        self.engine.add_request(
+            item["req_id"], prompt, item["max_new_tokens"],
+            priority=item["priority"], tenant=item["tenant"])
+
+    def pump(self) -> None:
+        self.engine.step()
+
+    def unfinished(self, ids) -> int:
+        return sum(1 for r in ids if r not in self.engine._completed)
+
+    def harvest(self, ids) -> List[object]:
+        return [self.engine._completed.get(r) for r in ids]
+
+
+class RouterFront:
+    """Drive a ``ClusterRouter`` or ``DisaggRouter`` (both expose
+    ``submit(req_id, prompt, n, *, priority, tenant)`` and
+    ``step() -> [result dicts]``). Per-token timestamps are harvested
+    from the worker supervisors' GenRequests; a request only the
+    router-level result dict knows about (e.g. finished on a replica
+    that later died) degrades to status-only accounting."""
+
+    def __init__(self, router):
+        self.router = router
+        self.results: Dict[object, dict] = {}
+
+    def submit(self, item: dict, prompt) -> None:
+        self.router.submit(
+            item["req_id"], prompt, item["max_new_tokens"],
+            priority=item["priority"], tenant=item["tenant"])
+
+    def pump(self) -> None:
+        for d in self.router.step():
+            self.results[d["req_id"]] = d
+
+    def unfinished(self, ids) -> int:
+        return sum(1 for r in ids if r not in self.results)
+
+    def _workers(self):
+        for attr in ("replicas", "prefill", "decode"):
+            for w in getattr(self.router, attr, ()):
+                yield w
+
+    def harvest(self, ids) -> List[object]:
+        by_id: Dict[object, object] = {}
+        for w in self._workers():
+            sup = getattr(w, "supervisor", None)
+            if sup is not None:
+                by_id.update(sup.results)
+        out: List[object] = []
+        for rid in ids:
+            if rid in by_id:
+                out.append(by_id[rid])
+            elif rid in self.results:
+                d = dict(self.results[rid])
+                d.setdefault("times", [])
+                out.append(d)
+            else:
+                out.append(None)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the open-loop driver
+# ---------------------------------------------------------------------------
+
+def run_schedule(front, schedule: List[dict], *, vocab_size: int,
+                 time_scale: float = 1.0, deadline=None,
+                 drain_s: float = 60.0) -> Tuple[List[object], float]:
+    """Submit every schedule entry at its arrival instant (scaled by
+    ``time_scale``), pumping the front door between arrivals but NEVER
+    gating a submission on completions; then drain. Returns
+    ``(per-request records, wall_s)`` — records are GenRequest-shaped
+    (or ``None`` for requests the deadline abandoned)."""
+    import numpy as np
+
+    ids = [item["req_id"] for item in schedule]
+    prompts = {
+        item["req_id"]: np.random.RandomState(
+            item["prompt_seed"] % (2 ** 32)).randint(
+                0, vocab_size, (item["prompt_len"],)).astype(np.int32)
+        for item in schedule
+    }
+    t0 = time.perf_counter()
+    for item in schedule:
+        due = t0 + item["t"] * time_scale
+        while time.perf_counter() < due:
+            front.pump()
+        front.submit(item, prompts[item["req_id"]])
+    t_drain = time.perf_counter()
+    while front.unfinished(ids):
+        if time.perf_counter() - t_drain > drain_s:
+            break
+        if deadline is not None and deadline.remaining() <= 0:
+            break
+        front.pump()
+    wall = time.perf_counter() - t0
+    return front.harvest(ids), wall
+
+
+def _lost(rid: str, item: dict) -> dict:
+    return {"req_id": rid, "tenant": item["tenant"],
+            "priority": item["priority"], "status": "lost",
+            "t_submit": 0.0, "times": [], "out": []}
+
+
+def run_report(front, spec: TraceSpec, slo_spec, *, vocab_size: int,
+               time_scale: float = 1.0, deadline=None,
+               drain_s: float = 60.0) -> dict:
+    """generate + drive + grade: the one-call harness."""
+    from paddle_tpu.obs import slo as _slo
+
+    schedule = generate_schedule(spec)
+    recs, wall = run_schedule(front, schedule, vocab_size=vocab_size,
+                              time_scale=time_scale, deadline=deadline,
+                              drain_s=drain_s)
+    recs = [r if r is not None else _lost(item["req_id"], item)
+            for r, item in zip(recs, schedule)]
+    return _slo.attainment_report(
+        recs, slo_spec, wall,
+        extra={"trace_spec": spec.to_dict(), "time_scale": time_scale})
+
+
+# ---------------------------------------------------------------------------
+# the --smoke scenario (CPU mechanics check; the TPU row reuses it)
+# ---------------------------------------------------------------------------
+
+def _probe_child() -> None:
+    """Preflight child (bench.py's idiom): enumerate devices, print one
+    JSON line. A hung tunnel hangs HERE under a ~90 s kill instead of
+    inside the load run."""
+    import jax
+
+    devs = jax.devices()
+    print(json.dumps({"probe": "ok", "n_devices": len(devs),
+                      "platform": devs[0].platform}))
+
+
+def _preflight(deadline) -> Optional[dict]:
+    """Two device probes before the run; both hanging means the backend
+    is down — return the structured failure instead of burning the
+    budget. None = proceed."""
+    if os.environ.get("BENCH_PREFLIGHT", "1") != "1":
+        return None
+    import subprocess
+
+    cap = float(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
+    history = []
+    for i in (1, 2):
+        timeout_s = min(cap, max(deadline.remaining(), 1.0))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=dict(os.environ, BENCH_PROBE="1"),
+                capture_output=True, text=True, timeout=timeout_s)
+            ok, hung = proc.returncode == 0 and proc.stdout.strip(), False
+        except subprocess.TimeoutExpired:
+            ok, hung = False, True
+        if ok:
+            return None
+        history.append({"probe": i, "hung": hung,
+                        "timeout_s": round(timeout_s, 2)})
+    return {"metric": "loadgen_smoke", "error": "preflight_failed",
+            "probes": history}
+
+
+def smoke(args) -> dict:
+    from paddle_tpu.utils.retries import Deadline
+
+    budget_s = float(os.environ.get("BENCH_TOTAL_BUDGET", "600"))
+    dl = Deadline(budget_s * 0.85)  # reserve tail for the JSON emit
+    fail = _preflight(dl)
+    if fail is not None:
+        return fail
+
+    import paddle_tpu as paddle
+    from paddle_tpu import obs as _obs
+    from paddle_tpu.inference.cluster import ClusterRouter, InProcessReplica
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.obs.slo import SLOClass, SLOSpec
+
+    paddle.seed(0)
+    config = LlamaConfig.tiny()
+    model = LlamaForCausalLM(config)
+
+    def factory():
+        return ContinuousBatchingEngine(
+            model, max_batch=4, max_len=48, block_size=8, num_blocks=28,
+            prompt_pad=24)
+
+    replicas = [InProcessReplica(f"rep{i}", factory) for i in range(2)]
+    router = ClusterRouter(replicas, block_size=8)
+    front = RouterFront(router)
+
+    spec = TraceSpec(seed=args.seed, n_requests=args.requests,
+                     duration_s=args.duration, tenants=args.tenants)
+    # CPU targets: generous enough that a healthy tiny-model run meets
+    # most of them, tight enough that the attainment fractions are not
+    # trivially 1.0 for the dominant tenant under its own bursts
+    slo_spec = SLOSpec(
+        default=SLOClass(ttft_s=8.0, itl_p95_s=2.0, e2e_s=20.0),
+        per_priority={"batch": SLOClass(ttft_s=15.0, e2e_s=30.0)},
+        per_tenant={"tenant0": SLOClass(ttft_s=6.0)})
+
+    report = run_report(front, spec, slo_spec,
+                        vocab_size=config.vocab_size,
+                        time_scale=args.time_scale, deadline=dl,
+                        drain_s=min(60.0, max(5.0, dl.remaining())))
+    if args.report_out:
+        from paddle_tpu.obs.slo import report_json
+        with open(args.report_out, "w", encoding="utf-8") as fh:
+            fh.write(report_json(report))
+    if args.trace_out:
+        from paddle_tpu.obs.trace import export_chrome_trace, ring, \
+            stitch_traces
+        export_chrome_trace(stitch_traces([ring().dump()]),
+                            path=args.trace_out)
+    ov = report["overall"]
+    return {
+        "metric": "loadgen_goodput_under_slo",
+        "value": ov["goodput_tokens_per_s"],
+        "unit": "tok/s",
+        "extra": {
+            "requests": ov["requests"],
+            "attainment_all": ov["attainment"]["all"],
+            "ttft_p99_s": ov["ttft"]["p99"],
+            "itl_p95_p99_s": ov["itl_p95"]["p99"],
+            "tenants": {
+                t: {"requests": row["requests"],
+                    "attainment_all": row["attainment"]["all"],
+                    "ttft_p50_s": row["ttft"]["p50"],
+                    "ttft_p99_s": row["ttft"]["p99"],
+                    "goodput_tokens_per_s": row["goodput_tokens_per_s"]}
+                for t, row in report["tenants"].items()},
+            "fleet_snapshot_series": len(
+                _obs.registry().snapshot().get("metrics", {})),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open-loop trace-driven load harness")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU mechanics run: 2-replica in-process "
+                         "router, 3 zipf tenants, under "
+                         "BENCH_TOTAL_BUDGET")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--duration", type=float, default=4.0,
+                    help="schedule horizon in seconds")
+    ap.add_argument("--tenants", type=int, default=3)
+    ap.add_argument("--time-scale", type=float, default=1.0,
+                    help="multiply schedule times (e.g. 0.5 = 2x "
+                         "faster offered load)")
+    ap.add_argument("--schedule-only", action="store_true",
+                    help="print the canonical schedule JSON and exit "
+                         "(no model, no framework import)")
+    ap.add_argument("--report-out", default=None,
+                    help="write the full attainment report JSON here")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the stitched Chrome trace here")
+    args = ap.parse_args(argv)
+
+    if args.schedule_only:
+        spec = TraceSpec(seed=args.seed, n_requests=args.requests,
+                         duration_s=args.duration, tenants=args.tenants)
+        print(schedule_json(spec, generate_schedule(spec)))
+        return 0
+    if not args.smoke:
+        ap.error("pick a scenario: --smoke or --schedule-only")
+    print(json.dumps(smoke(args)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    if os.environ.get("BENCH_PROBE") == "1":
+        _probe_child()
+        raise SystemExit(0)
+    raise SystemExit(main())

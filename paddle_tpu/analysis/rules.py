@@ -35,6 +35,16 @@ OBS001    error    obs span/metric call inside a traced region — the
                    timeline shows one phantom event and the metric
                    undercounts forever (ISSUE 12: observability calls
                    belong on the host side of the jit boundary)
+OBS002    warning  unbounded dynamic label value in a metric factory
+                   call on the serving/training path — an f-string,
+                   %%-format, ``.format()`` or concat built inline as a
+                   label value (or metric name) mints a fresh series
+                   per distinct value; per-request ids blow the
+                   registry's cardinality cap and everything after the
+                   cap folds into the overflow bucket (ISSUE 14: label
+                   values must come from a bounded set — pass the
+                   variable through ``str()`` and let the cap account
+                   for it, don't interpolate ids into the value)
 ========= ======== ====================================================
 
 All rules are intraprocedural and name-based — modular by design
@@ -652,3 +662,98 @@ def obs001(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
                         f"created inside traced function "
                         f"`{fndef.name}` ({region.via}) — the handle "
                         "and any bump on it run at trace time only")
+
+
+# ---------------------------------------------------------------------------
+# OBS002 — unbounded dynamic label values on the serving/training path
+
+# registry handles by conventional alias: the bound name (`_reg`,
+# `reg`, `registry`) or the accessor call (`registry()`, `_reg()`,
+# `_obs_registry()`, `_obs.registry()`)
+_OBS002_RECEIVER = re.compile(r"^_?(obs_)?reg(istry)?$")
+
+
+def _obs002_is_metric_factory(fn: ast.Attribute) -> bool:
+    if fn.attr not in _METRIC_FACTORIES:
+        return False
+    recv = fn.value
+    if isinstance(recv, ast.Call):  # registry().counter(...)
+        name = dotted_name(recv.func)
+    else:  # _reg.counter(...)
+        name = dotted_name(recv)
+    return bool(name and _OBS002_RECEIVER.match(name.split(".")[-1]))
+
+
+def _obs002_dynamic(node: ast.AST) -> Optional[str]:
+    """The inline string-construction shapes that interpolate an
+    unbounded value straight into a label. A plain variable or
+    ``str(x)`` is NOT flagged — the value may still be unbounded, but
+    the cardinality cap accounts for it and the fix is at the source;
+    inline interpolation is the shape that smuggles request ids into
+    series keys."""
+    if isinstance(node, ast.JoinedStr):
+        return "f-string"
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Mod):
+            return "%-format"
+        if isinstance(node.op, ast.Add) and any(
+                isinstance(s, ast.JoinedStr)
+                or (isinstance(s, ast.Constant) and isinstance(s.value, str))
+                for s in (node.left, node.right)):
+            return "string concat"
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "format"):
+        return ".format()"
+    return None
+
+
+@register_rule(
+    "OBS002", severity="warning",
+    summary="inline-interpolated label value in a metric factory call "
+            "on the serving/training path (f-string/%%-format/"
+            ".format()/concat as a label value or metric name)",
+    hint="every distinct interpolated value mints a new series — a "
+         "request or step id in a label blows the registry's "
+         "max_series cap and folds the tail into the overflow bucket. "
+         "Label values must come from a bounded set (tenant, "
+         "priority, bucket); pass variables as `str(x)` so the cap "
+         "governs them, and keep ids in trace spans, not series keys. "
+         "A deliberately bounded interpolation can be silenced with "
+         "# graft-lint: disable=OBS002",
+)
+def obs002(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    # the hot registries live on the serving/training paths; tools and
+    # one-shot scripts may label however they like
+    parts = ctx.path.replace("\\", "/").split("/")
+    if "inference" not in parts and "training" not in parts:
+        return
+    for fndef in ctx.functions():
+        for node in walk_scope(fndef):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and _obs002_is_metric_factory(node.func)):
+                continue
+            factory = node.func.attr
+            args = list(node.args)
+            kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+            name_arg = args[0] if args else kwargs.get("name")
+            shape = _obs002_dynamic(name_arg) if name_arg is not None \
+                else None
+            if shape is not None:
+                yield name_arg, (
+                    f"metric NAME built with {shape} in "
+                    f"`.{factory}(...)` ({fndef.name}) — every distinct "
+                    "value is a whole new metric family")
+            labels_arg = args[1] if len(args) > 1 else kwargs.get("labels")
+            if isinstance(labels_arg, ast.Dict):
+                for key, val in zip(labels_arg.keys, labels_arg.values):
+                    shape = _obs002_dynamic(val)
+                    if shape is None:
+                        continue
+                    kname = (repr(key.value)
+                             if isinstance(key, ast.Constant) else "<key>")
+                    yield val, (
+                        f"label {kname} value built with {shape} in "
+                        f"`.{factory}(...)` ({fndef.name}) — an "
+                        "unbounded interpolated value mints a series "
+                        "per distinct string")

@@ -55,12 +55,14 @@ efficiency may.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import agg as _obs_agg
 from ..ops.paged_attention import PrefixCache
 from ..testing import chaos as _chaos
 from ..utils.retries import Deadline
@@ -83,7 +85,7 @@ class NoLiveReplica(RuntimeError):
 def make_record(req_id, prompt, max_new_tokens: int = 32, *,
                 deadline=None, priority: str = "interactive",
                 session: Optional[str] = None, retries: int = 0,
-                trace=None) -> dict:
+                trace=None, tenant: str = "default") -> dict:
     """The wire/journal-compatible request record. The deadline is
     carried as an ABSOLUTE unix expiry (wall time is the only clock two
     processes share) so every hop — router -> store -> replica ->
@@ -102,6 +104,7 @@ def make_record(req_id, prompt, max_new_tokens: int = 32, *,
         "prompt": [int(t) for t in prompt],
         "max_new_tokens": int(max_new_tokens),
         "priority": priority,
+        "tenant": str(tenant),
         "deadline_unix": expires,
         "session": session,
         "retries": int(retries),
@@ -163,7 +166,8 @@ class InProcessReplica:
             deadline=_remaining_budget(rec),
             priority=rec.get("priority", "interactive"),
             retries=int(rec.get("retries", 0)),
-            trace=rec.get("trace"))
+            trace=rec.get("trace"),
+            tenant=rec.get("tenant", "default"))
 
     def poll_completed(self) -> List[dict]:
         out = []
@@ -290,6 +294,7 @@ class ReplicaServer:
 
     def __init__(self, store, replica_id: str, engine_factory, *,
                  journal_dir: str, poll_interval: float = 0.02,
+                 obs_publish_interval: float = 0.5,
                  **supervisor_kwargs):
         self.store = store
         self.replica_id = str(replica_id)
@@ -300,6 +305,12 @@ class ReplicaServer:
         self._taken: Set[str] = set()
         self._published: Set = set()
         self._hb = 0
+        # fleet observability (ISSUE 14): this worker's registry dump +
+        # trace ring publish under obs/rep-<id>/ in the SAME store the
+        # cluster protocol already shares, rate-limited off the poll loop
+        self._obs_pub = _obs_agg.Publisher(
+            store, f"rep-{self.replica_id}",
+            interval_s=float(obs_publish_interval))
 
     def _pull(self) -> int:
         """Ingest new request records; returns how many."""
@@ -321,7 +332,8 @@ class ReplicaServer:
                 deadline=_remaining_budget(rec),
                 priority=rec.get("priority", "interactive"),
                 retries=int(rec.get("retries", 0)),
-                trace=rec.get("trace"))
+                trace=rec.get("trace"),
+                tenant=rec.get("tenant", "default"))
             n += 1
         return n
 
@@ -338,26 +350,43 @@ class ReplicaServer:
         self.store.set(self.ns + "/load", json.dumps(d))
         self._hb += 1
         self.store.set(self.ns + "/hb", str(self._hb))
+        self._obs_pub.maybe_publish()
 
     def serve(self, deadline=None) -> None:
         """Serve until ``stop`` is posted or the Deadline runs out.
         Every blocking edge is bounded: store ops carry their own
-        per-op budget, idle waits go through ``Deadline.sleep``."""
+        per-op budget, idle waits go through ``Deadline.sleep``.
+
+        At exit — normal stop, deadline, or a crash unwinding through
+        here — the final registry dump is flushed to the store and, when
+        ``CLUSTER_TRACE_DUMP`` names a file, the trace ring is dumped
+        there the way ``DISAGG_TRACE_DUMP`` does for disagg workers, so
+        cluster-mode runs stitch complete traces."""
         dl = Deadline.coerce(deadline)
-        self._publish()  # first heartbeat: visible before any work
-        while not dl.expired():
-            if self.store.get(self.ns + "/stop"):
-                break
-            took = self._pull()
-            if self.supervisor.pending:
-                self.supervisor.step()
-            elif not took:
-                if dl.budget is None:
-                    time.sleep(self.poll_interval)
-                else:
-                    dl.sleep(self.poll_interval)
+        try:
+            self._publish()  # first heartbeat: visible before any work
+            while not dl.expired():
+                if self.store.get(self.ns + "/stop"):
+                    break
+                took = self._pull()
+                if self.supervisor.pending:
+                    self.supervisor.step()
+                elif not took:
+                    if dl.budget is None:
+                        time.sleep(self.poll_interval)
+                    else:
+                        dl.sleep(self.poll_interval)
+                self._publish()
             self._publish()
-        self._publish()
+        finally:
+            try:
+                self._obs_pub.publish()
+            except Exception:
+                pass  # the store may be the thing that died
+            dump_path = os.environ.get("CLUSTER_TRACE_DUMP")
+            if dump_path:
+                with open(dump_path, "w", encoding="utf-8") as fh:
+                    json.dump(_obs.ring().dump(), fh)
 
 
 # ---------------------------------------------------------------------------
@@ -491,18 +520,21 @@ class ClusterRouter:
     # -- submission ------------------------------------------------------
     def submit(self, req_id, prompt, max_new_tokens: int = 32, *,
                deadline=None, priority: str = "interactive",
-               session: Optional[str] = None, trace=None) -> int:
+               session: Optional[str] = None, trace=None,
+               tenant: str = "default") -> int:
         """Route + dispatch one request; returns the replica index it
         was placed on. Results arrive via :meth:`poll` / :meth:`run`,
         keyed by ``req_id`` — across any number of replica deaths.
         ``trace`` joins an upstream trace; otherwise a fresh one is
         minted here so the replica's admission span parents under this
-        ``route`` span."""
+        ``route`` span. ``tenant`` rides the wire record end-to-end
+        (replica admission, journal, requeue-on-death)."""
         with _obs.span("route", parent=_obs.trace_ctx(trace),
-                       tid="router", req=str(req_id)) as sp:
+                       tid="router", req=str(req_id),
+                       tenant=str(tenant)) as sp:
             rec = make_record(
                 req_id, prompt, max_new_tokens, deadline=deadline,
-                priority=priority, session=session,
+                priority=priority, session=session, tenant=tenant,
                 retries=self.retries.get(req_id, 0), trace=sp.ctx())
             idx = self.route(rec["prompt"], session=session)
             sp.args["replica"] = self.replicas[idx].replica_id
@@ -715,4 +747,7 @@ class ClusterRouter:
             "misroutes": self.n_misroutes,
             "recoveries": self.n_recoveries,
             "sessions": len(self._sessions),
+            # per-tenant SLO view (ISSUE 14) — in-process replicas only
+            # (process replicas' registries live behind obs/agg)
+            "tenants": _obs.tenant_slo_table(),
         })

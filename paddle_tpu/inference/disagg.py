@@ -141,6 +141,9 @@ class HandoffPayload:
     # the CRC-framed header so the decode worker's spans parent under
     # the prefill-side trace across the process boundary (ISSUE 12)
     trace: Optional[dict] = None
+    # tenant identity rides the handoff too (ISSUE 14): the decode-side
+    # SLO histograms must land on the submitting tenant's series
+    tenant: str = "default"
 
     @classmethod
     def from_request(cls, req: GenRequest, pages, scales,
@@ -154,7 +157,7 @@ class HandoffPayload:
             max_new_tokens=int(req.max_new_tokens), priority=req.priority,
             deadline_unix=expires, retries=int(req.retries),
             pages=pages, scales=scales, meta=dict(meta),
-            trace=_obs.trace_ctx(req))
+            trace=_obs.trace_ctx(req), tenant=req.tenant)
 
     def remaining_budget(self) -> Optional[float]:
         return (None if self.deadline_unix is None
@@ -168,7 +171,7 @@ class HandoffPayload:
             int(self.max_new_tokens),
             deadline=None if rem is None else Deadline(max(rem, 0.0)),
             t_submit=time.perf_counter(), priority=self.priority,
-            retries=int(self.retries),
+            retries=int(self.retries), tenant=self.tenant,
             trace_id=t.get("trace_id"), span_id=t.get("span_id"))
 
     # -- wire format ----------------------------------------------------
@@ -183,6 +186,7 @@ class HandoffPayload:
             "first_token": int(self.first_token),
             "max_new_tokens": int(self.max_new_tokens),
             "priority": self.priority,
+            "tenant": self.tenant,
             "deadline_unix": self.deadline_unix,
             "retries": int(self.retries),
             "trace": self.trace,
@@ -234,7 +238,8 @@ class HandoffPayload:
             deadline_unix=header.get("deadline_unix"),
             retries=int(header.get("retries", 0)),
             pages=pages, scales=scales, meta=dict(header["meta"]),
-            trace=header.get("trace"))
+            trace=header.get("trace"),
+            tenant=header.get("tenant", "default"))
 
 
 # ---------------------------------------------------------------------------
@@ -562,7 +567,8 @@ class PrefillWorker:
             deadline=remaining_budget(rec),
             priority=rec.get("priority", "interactive"),
             retries=int(rec.get("retries", 0)),
-            trace=rec.get("trace"))
+            trace=rec.get("trace"),
+            tenant=rec.get("tenant", "default"))
 
     def pending(self) -> bool:
         return (not self._dead) and (
@@ -779,7 +785,8 @@ class DecodeWorker:
             deadline=remaining_budget(rec),
             priority=rec.get("priority", "interactive"),
             retries=int(rec.get("retries", 0)),
-            trace=rec.get("trace"))
+            trace=rec.get("trace"),
+            tenant=rec.get("tenant", "default"))
 
     def pending(self) -> bool:
         return (not self._dead) and (
@@ -853,7 +860,7 @@ class DecodeWorker:
                 self.supervisor.submit(
                     req.req_id, req.prompt, req.max_new_tokens,
                     deadline=rem, priority=req.priority,
-                    retries=req.retries, trace=req)
+                    retries=req.retries, trace=req, tenant=req.tenant)
                 continue
             self.supervisor.submit_imported(req)
         self._pending_imports = still
@@ -912,15 +919,18 @@ class DisaggRouter:
 
     def submit(self, req_id, prompt, max_new_tokens: int = 32, *,
                deadline=None, priority: str = "interactive",
-               trace=None) -> Tuple[str, int]:
+               trace=None, tenant: str = "default") -> Tuple[str, int]:
         """Route one request; returns ``(pool, index)`` — pool is
         "prefill" normally, "decode" when the prefill pool is down
         (colocated fallback). Results arrive via :meth:`poll` /
-        :meth:`run`, keyed by ``req_id``, across any worker deaths."""
+        :meth:`run`, keyed by ``req_id``, across any worker deaths.
+        ``tenant`` rides the wire record and the handoff header."""
         with _obs.span("route", parent=_obs.trace_ctx(trace),
-                       tid="router", req=str(req_id)) as sp:
+                       tid="router", req=str(req_id),
+                       tenant=str(tenant)) as sp:
             rec = make_record(req_id, prompt, max_new_tokens,
                               deadline=deadline, priority=priority,
+                              tenant=tenant,
                               retries=self.retries.get(req_id, 0),
                               trace=sp.ctx())
             pool, idx = self._place(rec)

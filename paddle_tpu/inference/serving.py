@@ -225,6 +225,10 @@ class GenRequest:
     shed_reason: Optional[str] = None
     retries: int = 0
     clamped: bool = False
+    # tenant identity (ISSUE 14): rides the journal, the cluster wire
+    # record and the disagg handoff header, and labels the SLO
+    # histograms — per-tenant attainment needs the dimension end-to-end
+    tenant: str = "default"
     # distributed-tracing context (ISSUE 12): minted at admission or
     # adopted from an upstream leg (router wire record / disagg handoff
     # header), so every leg's span lands under ONE trace_id
@@ -524,15 +528,16 @@ class ContinuousBatchingEngine:
         self._obs_id = f"eng{next(_ENGINE_IDS)}"
         self._obs_labels = {"engine": self._obs_id}
         _reg = _obs_registry()
-        self._h_ttft = _reg.histogram(
-            "serving_ttft_seconds", self._obs_labels,
-            help="seconds from submission to first token")
-        self._h_itl = _reg.histogram(
-            "serving_itl_seconds", self._obs_labels,
-            help="inter-token latency seconds")
-        self._h_queue = _reg.histogram(
-            "serving_queue_delay_seconds", self._obs_labels,
-            help="seconds from submission to slot binding")
+        # SLO histogram series carry a tenant label (ISSUE 14): the
+        # label sets PARTITION the observations (one observe per event,
+        # on the request's tenant series), so slo_summary's cross-series
+        # merge stays exact while per-tenant breakdowns come for free.
+        # The registry's cardinality cap bounds the exported set; handle
+        # acquisition is cached per tenant off the hot path.
+        self._slo_hists: Dict[str, tuple] = {}
+        self._c_tenant_req: Dict[str, object] = {}
+        self._h_ttft, self._h_itl, self._h_queue = \
+            self._slo_handles("default")
         self._c_requests = _reg.counter(
             "serving_requests_total", self._obs_labels,
             help="requests submitted (shed ones included)")
@@ -1008,9 +1013,45 @@ class ContinuousBatchingEngine:
                 need.add("decode_chunk")
         return need <= self._phases_run
 
+    def _slo_handles(self, tenant: str):
+        """(ttft, itl, queue-delay) histogram handles for one tenant's
+        series (labels ``engine=<id>, tenant=<t>``), cached so the
+        per-token path pays one dict hit, not a registry walk. Past the
+        registry cardinality cap the handles stay fully live — exports
+        fold them into the ``obs_overflow`` series instead."""
+        hs = self._slo_hists.get(tenant)
+        if hs is None:
+            reg = _obs_registry()
+            lab = {**self._obs_labels, "tenant": str(tenant)}
+            hs = (
+                reg.histogram("serving_ttft_seconds", lab,
+                              help="seconds from submission to first token"),
+                reg.histogram("serving_itl_seconds", lab,
+                              help="inter-token latency seconds"),
+                reg.histogram("serving_queue_delay_seconds", lab,
+                              help="seconds from submission to slot binding"),
+            )
+            self._slo_hists[tenant] = hs
+        return hs
+
+    def _tenant_requests(self, tenant: str):
+        """Per-tenant submission counter handle
+        (``serving_tenant_requests_total``) — separate name from
+        ``serving_requests_total`` so the envelope's fleet total never
+        double-counts."""
+        h = self._c_tenant_req.get(tenant)
+        if h is None:
+            h = _obs_registry().counter(
+                "serving_tenant_requests_total",
+                {**self._obs_labels, "tenant": str(tenant)},
+                help="requests submitted, by tenant")
+            self._c_tenant_req[tenant] = h
+        return h
+
     def add_request(self, req_id, prompt, max_new_tokens: int = 32,
                     deadline=None, priority: str = "interactive",
-                    retries: int = 0, trace=None):
+                    retries: int = 0, trace=None,
+                    tenant: str = "default"):
         """``deadline``: seconds or a ``Deadline`` — the request's total
         budget (queue wait included). None = no deadline. ``priority``
         is the admission class ("interactive" | "batch") — only
@@ -1021,7 +1062,10 @@ class ContinuousBatchingEngine:
         ``trace`` is an optional upstream trace context (a Span, a
         ``{"trace_id", "span_id"}`` dict, or any object carrying those
         attributes): when given, this request's spans parent under it;
-        otherwise a fresh trace is minted here.
+        otherwise a fresh trace is minted here. ``tenant`` names the
+        submitting tenant — it labels this request's SLO histogram
+        series and rides every downstream leg (journal, cluster wire
+        record, disagg handoff).
         Returns the :class:`GenRequest`; with admission control a shed
         submission comes back immediately with ``status == "shed"``
         (it is also surfaced through the completed map)."""
@@ -1039,7 +1083,7 @@ class ContinuousBatchingEngine:
         dl = None if deadline is None else Deadline.coerce(deadline)
         req = GenRequest(req_id, prompt, max_new_tokens, deadline=dl,
                          t_submit=time.perf_counter(), priority=priority,
-                         retries=int(retries))
+                         retries=int(retries), tenant=str(tenant))
         if self._blocks_needed(req) > self.manager.num_blocks:
             raise ValueError(
                 f"request needs {self._blocks_needed(req)} blocks but the "
@@ -1048,9 +1092,10 @@ class ContinuousBatchingEngine:
         ctx = _obs.trace_ctx(trace)
         req.trace_id = (ctx or {}).get("trace_id") or _obs.new_trace_id()
         self._c_requests.inc()
+        self._tenant_requests(req.tenant).inc()
         with _obs.span("admission", trace_id=req.trace_id, parent=ctx,
                        tid="serve", req=str(req_id),
-                       priority=priority) as sp:
+                       priority=priority, tenant=req.tenant) as sp:
             req.span_id = sp.span_id
             out = self._decide_admission(req)
             sp.args["verdict"] = ("shed" if out.status == "shed"
@@ -1277,11 +1322,13 @@ class ContinuousBatchingEngine:
         req.times.append(now)
         # SLO histograms: the ONE token-emission point feeds TTFT and
         # inter-token latency for every path (prefill first token,
-        # decode, spec verify, KV import)
+        # decode, spec verify, KV import) — on the request's tenant
+        # series (cached handle lookup, one dict hit)
+        h_ttft, h_itl, _ = self._slo_handles(req.tenant)
         if len(req.times) == 1:
-            self._h_ttft.observe(now - req.t_submit)
+            h_ttft.observe(now - req.t_submit)
         else:
-            self._h_itl.observe(now - req.times[-2])
+            h_itl.observe(now - req.times[-2])
 
     @staticmethod
     def _finish_req_spans(req: GenRequest, **args) -> None:
@@ -1497,7 +1544,8 @@ class ContinuousBatchingEngine:
             slot.remaining = req.max_new_tokens
             slot.pending_first = False
             self._mark_dirty(slot_idx)
-            self._h_queue.observe(time.perf_counter() - req.t_submit)
+            self._slo_handles(req.tenant)[2].observe(
+                time.perf_counter() - req.t_submit)
             req._sp_prefill = _obs.start_span(
                 "prefill", parent=req, tid="serve",
                 prompt_tokens=int(req.prompt.size),

@@ -184,6 +184,7 @@ class _Journal:
             "prompt": [int(t) for t in req.prompt],
             "max_new_tokens": int(req.max_new_tokens),
             "priority": req.priority,
+            "tenant": req.tenant,
             "deadline_unix": expires,
             # prior recoveries travel WITH the request: a cluster
             # router replaying this journal onto a surviving replica
@@ -308,6 +309,7 @@ class ServingSupervisor:
                 int(rec["max_new_tokens"]),
                 deadline=None if remaining is None else Deadline(remaining),
                 priority=rec.get("priority", "interactive"),
+                tenant=rec.get("tenant", "default"),
                 retries=int(rec.get("retries", 0)))
             if remaining is not None and remaining <= 0:
                 # the budget ran out during the outage: close it as
@@ -329,7 +331,8 @@ class ServingSupervisor:
     # -- submission -----------------------------------------------------
     def submit(self, req_id, prompt, max_new_tokens: int = 32, *,
                deadline=None, priority: str = "interactive",
-               retries: int = 0, trace=None) -> GenRequest:
+               retries: int = 0, trace=None,
+               tenant: str = "default") -> GenRequest:
         """Front door: runs the engine's admission control. Shed
         submissions are recorded as results immediately; accepted ones
         are journaled (when journaling) so a crash cannot lose them.
@@ -343,7 +346,8 @@ class ServingSupervisor:
         return value, keyed by ``req_id``."""
         req = self.engine.add_request(
             req_id, prompt, max_new_tokens, deadline=deadline,
-            priority=priority, retries=retries, trace=trace)
+            priority=priority, retries=retries, trace=trace,
+            tenant=tenant)
         self.journaled_ids.add(req_id)
         self.journaled_retries[req_id] = max(
             self.journaled_retries.get(req_id, 0), int(retries))
@@ -591,8 +595,8 @@ class ServingSupervisor:
             req.req_id, req.prompt, req.max_new_tokens,
             deadline=req.deadline, t_submit=req.t_submit,
             priority=req.priority, retries=req.retries,
-            clamped=req.clamped, trace_id=req.trace_id,
-            span_id=req.span_id)
+            clamped=req.clamped, tenant=req.tenant,
+            trace_id=req.trace_id, span_id=req.span_id)
 
     def _note(self, kind: str, detail: str):
         self.events.append((kind, detail))
@@ -635,6 +639,9 @@ class ServingSupervisor:
             "overlap": (eng.overlap_stats()
                         if hasattr(eng, "overlap_stats")
                         else {"enabled": False}),
+            # per-tenant SLO view (ISSUE 14): one hot tenant's pain is
+            # visible here instead of averaged into the fleet totals
+            "tenants": _obs.tenant_slo_table(),
         })
 
 

@@ -64,7 +64,8 @@ __all__ = [
     "stitch_traces",
     "install_compile_events", "uninstall_compile_events",
     "compile_events_installed",
-    "slo_summary", "HEALTH_SCHEMA_VERSION", "health_envelope",
+    "slo_summary", "tenant_slo_table",
+    "HEALTH_SCHEMA_VERSION", "health_envelope",
 ]
 
 # SLO histograms the serving engine feeds (seconds)
@@ -75,24 +76,69 @@ SLO_HISTOGRAMS = (
 )
 
 
-def slo_summary() -> dict:
+def slo_summary(*, by_tenant: bool = False) -> dict:
     """p50/p95/p99 + count for the built-in TTFT / inter-token-latency
-    / queue-delay histograms, aggregated over every label set."""
+    / queue-delay histograms, aggregated over every label set. The SLO
+    series carry a ``tenant`` label (default tenant ``"default"``), so
+    the label sets PARTITION the observations and the merged totals
+    stay exact. ``by_tenant=True`` adds a ``"tenants"`` key: per-tenant
+    sub-summaries (same shape per metric), with every past-the-cap
+    overflow handle folded into one ``"(overflow)"`` tenant."""
     out = {}
+    tenants: dict = {}
     reg = registry()
     for name in SLO_HISTOGRAMS:
         agg = Histogram()
         m = reg._metrics.get(name)
         if m is not None:
-            for h in list(m.series.values()) + list(m.overflow):
-                agg._n += h._n
-                agg._sum += h._sum
-                agg._zero += h._zero
-                agg._min = min(agg._min, h._min)
-                agg._max = max(agg._max, h._max)
-                for i, c in h._counts.items():
-                    agg._counts[i] = agg._counts.get(i, 0) + c
+            for labels, h in list(m.series.items()):
+                agg.merge(h)
+                if by_tenant:
+                    t = dict(labels).get("tenant", "default")
+                    bucket = tenants.setdefault(t, {}).setdefault(
+                        name, Histogram())
+                    bucket.merge(h)
+            for h in list(m.overflow):
+                agg.merge(h)
+                if by_tenant:
+                    bucket = tenants.setdefault("(overflow)", {}).setdefault(
+                        name, Histogram())
+                    bucket.merge(h)
         out[name] = agg.to_dict()
+    if by_tenant:
+        out["tenants"] = {
+            t: {name: h.to_dict() for name, h in sorted(per.items())}
+            for t, per in sorted(tenants.items())
+        }
+    return out
+
+
+def tenant_slo_table() -> dict:
+    """Compact per-tenant SLO view for the health() surfaces: requests
+    submitted (``serving_tenant_requests_total``) plus TTFT/ITL p50 and
+    p99 per tenant. Tenants past the registry cardinality cap fold into
+    ``"(overflow)"`` — visible, counted, never unbounded."""
+    full = slo_summary(by_tenant=True)
+    reg = registry()
+    req_by_tenant: dict = {}
+    m = reg._metrics.get("serving_tenant_requests_total")
+    if m is not None:
+        for labels, h in list(m.series.items()):
+            t = dict(labels).get("tenant", "default")
+            req_by_tenant[t] = req_by_tenant.get(t, 0) + int(h.value)
+        if m.overflow:
+            req_by_tenant["(overflow)"] = req_by_tenant.get(
+                "(overflow)", 0) + int(sum(h.value for h in m.overflow))
+    out = {}
+    for t in sorted(set(full.get("tenants", {})) | set(req_by_tenant)):
+        per = full.get("tenants", {}).get(t, {})
+        ttft = per.get("serving_ttft_seconds", {})
+        itl = per.get("serving_itl_seconds", {})
+        out[t] = {
+            "requests": req_by_tenant.get(t, 0),
+            "ttft_p50": ttft.get("p50"), "ttft_p99": ttft.get("p99"),
+            "itl_p50": itl.get("p50"), "itl_p99": itl.get("p99"),
+        }
     return out
 
 

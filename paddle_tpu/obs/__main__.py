@@ -9,6 +9,13 @@ Subcommands::
                          # ring (mostly useful with --stitch)
     trace --stitch a.json b.json ... [-o out.json] [--trace-id ID]
                          # merge per-worker ring dumps by trace_id
+    agg STORE [--prefix obs] [--summary] [--trace-out f] [--trace-id ID]
+                         # fleet aggregation (ISSUE 14): merge every
+                         # obs/<id>/ publication in a KVStore (STORE is
+                         # tcp://host:port or a FileKVStore directory)
+                         # into one snapshot — counters summed, gauges
+                         # per-source, histograms bucket-merged —
+                         # optionally also the stitched fleet trace
 
 A fresh interpreter has an empty registry, so ``dump``/``prom``
 without a file mostly matter for smoke tests; the file forms are the
@@ -78,6 +85,19 @@ def main(argv=None) -> int:
     t.add_argument("-o", "--out", default=None,
                    help="write the Chrome trace JSON here "
                         "(default: stdout)")
+    a = sub.add_parser("agg", help="merge fleet publications from a "
+                                   "KVStore into one snapshot")
+    a.add_argument("store", help="store location: tcp://host:port or a "
+                                 "FileKVStore directory")
+    a.add_argument("--prefix", default="obs",
+                   help="publication key prefix (default: obs)")
+    a.add_argument("--summary", action="store_true",
+                   help="print the fleet SLO/counter digest "
+                        "(fleet_summary) instead of the merged snapshot")
+    a.add_argument("--trace-out", default=None,
+                   help="also write the stitched fleet Chrome trace here")
+    a.add_argument("--trace-id", default=None,
+                   help="restrict the stitched trace to one trace id")
     args = ap.parse_args(argv)
 
     if args.cmd == "dump":
@@ -90,6 +110,20 @@ def main(argv=None) -> int:
             sys.stdout.write(_snap_to_text(_load_last_snapshot(args.file)))
         else:
             sys.stdout.write(registry().expose_text())
+        return 0
+    if args.cmd == "agg":
+        from ..distributed.store import make_store
+        from . import agg
+
+        store = make_store(args.store)
+        doc = (agg.fleet_summary(store, prefix=args.prefix)
+               if args.summary
+               else agg.fleet_snapshot(store, prefix=args.prefix))
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        if args.trace_out:
+            events = agg.fleet_trace(store, prefix=args.prefix,
+                                     trace_id=args.trace_id)
+            export_chrome_trace(events, path=args.trace_out)
         return 0
     # trace
     if args.stitch:

@@ -201,6 +201,47 @@ class Histogram:
             "p99": self.p99,
         }
 
+    # -- full-fidelity state (fleet aggregation) ------------------------
+    # ``to_dict`` is the human/summary view and DROPS the buckets; the
+    # aggregator needs them back, so cross-process publication rides
+    # ``state_dict``/``merge_state`` instead.
+
+    def state_dict(self) -> dict:
+        """JSON-safe full state: buckets included, so a remote copy can
+        be bucket-merged losslessly (unlike ``to_dict``)."""
+        return {
+            "counts": {str(i): c for i, c in sorted(self._counts.items())},
+            "zero": self._zero,
+            "n": self._n,
+            "sum": self._sum,
+            "min": None if self._n == 0 else self._min,
+            "max": None if self._n == 0 else self._max,
+        }
+
+    def merge_state(self, state: dict) -> None:
+        """Bucket-merge a ``state_dict`` into this histogram: counts
+        add, min/max widen — the union stream's histogram, exactly."""
+        for i, c in state.get("counts", {}).items():
+            i = int(i)
+            self._counts[i] = self._counts.get(i, 0) + int(c)
+        self._zero += int(state.get("zero", 0))
+        self._n += int(state.get("n", 0))
+        self._sum += float(state.get("sum", 0.0))
+        lo, hi = state.get("min"), state.get("max")
+        if lo is not None and lo < self._min:
+            self._min = float(lo)
+        if hi is not None and hi > self._max:
+            self._max = float(hi)
+
+    def merge(self, other: "Histogram") -> None:
+        self.merge_state(other.state_dict())
+
+    @classmethod
+    def from_state(cls, state: dict) -> "Histogram":
+        h = cls()
+        h.merge_state(state)
+        return h
+
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
@@ -331,6 +372,27 @@ class MetricsRegistry:
             out[name] = {"kind": m.kind, "help": m.help,
                          "series": series}
         return {"schema": "paddle_tpu.obs.metrics/1", "metrics": out}
+
+    def dump_state(self) -> dict:
+        """Full-fidelity export for cross-process aggregation: unlike
+        ``snapshot()`` (whose histograms collapse to summary stats),
+        this keeps every histogram's buckets and the raw overflow
+        handles, so a remote aggregator can bucket-merge losslessly.
+        Schema ``paddle_tpu.obs.metrics/state1``."""
+        def _state(h):
+            return (h.state_dict() if isinstance(h, Histogram)
+                    else h.value)
+
+        out: Dict[str, dict] = {}
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            series = [{"labels": dict(labels),
+                       "state": _state(m.series[labels])}
+                      for labels in sorted(m.series)]
+            out[name] = {"kind": m.kind, "help": m.help,
+                         "series": series,
+                         "overflow": [_state(h) for h in m.overflow]}
+        return {"schema": "paddle_tpu.obs.metrics/state1", "metrics": out}
 
     def snapshot_jsonl(self, path: str) -> dict:
         """Append one JSON line (the snapshot) to ``path``; returns the

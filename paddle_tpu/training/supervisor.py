@@ -259,6 +259,23 @@ class TrainingSupervisor:
         self.events: List[Tuple[str, str]] = []
         self.last_loss: Optional[float] = None
         self._step = 0
+        # goodput ledger (ISSUE 14): every second of run() wall time is
+        # attributed to exactly one bucket — productive (healthy
+        # FIRST-TIME step compute), rollback (anomalous step compute +
+        # restore + replayed-step compute), checkpoint (snapshot/
+        # auto-checkpoint/peer-wait), stall (everything else: data,
+        # detector, telemetry, loop overhead)
+        self._wall: Dict[str, float] = {
+            "productive": 0.0, "rollback": 0.0,
+            "checkpoint": 0.0, "stall": 0.0,
+        }
+        self._wall_gauges = {
+            b: _obs.registry().gauge(
+                "training_wall_seconds", {"bucket": b},
+                help="run() wall time attributed per goodput bucket")
+            for b in self._wall
+        }
+        self._goodput_high_water = 0  # highest step ever healthy
 
     # -- state capture / restore ----------------------------------------
     def _snap_tree(self, obj):
@@ -473,8 +490,11 @@ class TrainingSupervisor:
         step = int(start) if start is not None else self._step + 1
         if not self._snapshots:
             # the rollback floor: state as of "before step `step`"
+            t_ck = time.monotonic()
             self._take_snapshot(step - 1)
+            self._ledger("checkpoint", time.monotonic() - t_ck)
         while step <= total_steps:
+            t_iter = time.monotonic()
             batch = self._corrupt(self.cursor.batch(step))
             t0 = time.monotonic()
             out = self.step_fn(batch)
@@ -503,17 +523,39 @@ class TrainingSupervisor:
                         anomaly = Anomaly("sdc", verdict.detail)
                         self.detector._flag(anomaly)
             if anomaly is not None:
+                t_roll = time.monotonic()
                 step = self._handle_anomaly(step, anomaly)
+                now = time.monotonic()
+                # the anomalous step's compute was wasted work — it
+                # rides the rollback bucket along with the restore
+                self._ledger("rollback", dt + (now - t_roll))
+                self._ledger("stall",
+                             max(0.0, (now - t_iter) - dt
+                                 - (now - t_roll)))
                 continue
             # healthy step: let the tiers advance
             self.last_loss = loss
             self._step = step
             self._retries_at.pop(step, None)
+            t_ck = time.monotonic()
             if self.auto_checkpoint is not None:
                 self.auto_checkpoint.step(step)
             if step % self.snapshot_interval == 0:
                 self._take_snapshot(step)
+            now = time.monotonic()
+            ck = now - t_ck
+            if step > self._goodput_high_water:
+                self._goodput_high_water = step
+                self._ledger("productive", dt)
+            else:
+                # a REPLAYED step: healthy this time, but the run only
+                # needs it because an anomaly threw the first execution
+                # away — rollback cost, not progress
+                self._ledger("rollback", dt)
+            self._ledger("checkpoint", ck)
+            self._ledger("stall", max(0.0, (now - t_iter) - dt - ck))
             step += 1
+        t_ck = time.monotonic()
         if self.auto_checkpoint is not None:
             self.auto_checkpoint.wait()
         if self.peer is not None:
@@ -521,7 +563,19 @@ class TrainingSupervisor:
                 self.peer.wait()
             except RuntimeError as e:
                 self._note("peer_error", str(e))
+        self._ledger("checkpoint", time.monotonic() - t_ck)
         return self.report()
+
+    # -- goodput ledger (ISSUE 14) ---------------------------------------
+    def _ledger(self, bucket: str, seconds: float) -> None:
+        self._wall[bucket] += seconds
+        self._wall_gauges[bucket].set(self._wall[bucket])
+
+    def goodput_frac(self) -> Optional[float]:
+        """Fraction of attributed run() wall time spent on healthy
+        first-time steps. None before any wall time accrues."""
+        total = sum(self._wall.values())
+        return self._wall["productive"] / total if total > 0 else None
 
     def _handle_anomaly(self, step: int, anomaly: Anomaly) -> int:
         """Roll back; returns the step to run next."""
@@ -608,5 +662,8 @@ class TrainingSupervisor:
             "telemetry": tele,
             "scaler_skips": (self.scaler.n_skipped_steps
                              if self.scaler is not None else None),
+            "wall_seconds": {b: round(v, 6)
+                             for b, v in sorted(self._wall.items())},
+            "goodput_frac": self.goodput_frac(),
             "events": list(self.events[-20:]),
         }

@@ -23,6 +23,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import obs  # noqa: E402
 from paddle_tpu.distributed.store import TCPKVStore  # noqa: E402
 from paddle_tpu.inference.cluster import ReplicaServer  # noqa: E402
 from paddle_tpu.inference.serving import ContinuousBatchingEngine  # noqa: E402
@@ -31,6 +32,9 @@ from paddle_tpu.models import LlamaConfig, LlamaForCausalLM  # noqa: E402
 
 def main():
     paddle.seed(0)
+    # name this process's track so stitched fleet traces and published
+    # metrics snapshots are attributable to the replica, not a bare pid
+    obs.set_process_label(f"router-{os.environ['ROUTER_REPLICA_ID']}")
     model = LlamaForCausalLM(LlamaConfig.tiny())
 
     def factory():

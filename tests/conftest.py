@@ -115,6 +115,13 @@ def pytest_configure(config):
         "stats parity, health-schema pin, trace stitch/export "
         "(quick-lane; the 2-process stitched trace rides the slow "
         "lane; standalone via `pytest -m obs`)")
+    config.addinivalue_line(
+        "markers",
+        "slo: load-harness + fleet-SLO suite — seeded open-loop "
+        "schedule determinism, attainment math, tenant labels, "
+        "cardinality cap, KVStore aggregation (quick-lane; the real "
+        "multi-process router aggregation proof rides the slow lane; "
+        "standalone via `pytest -m slo`)")
 
 
 def pytest_collection_modifyitems(config, items):

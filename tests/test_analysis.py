@@ -552,6 +552,86 @@ class TestObs001:
 
 
 # ---------------------------------------------------------------------------
+# OBS002 — unbounded dynamic label values on the serving/training path
+
+
+class TestObs002:
+    PATH = "paddle_tpu/inference/engine.py"
+
+    def test_catches_inline_interpolated_label_values(self):
+        src = """
+        from paddle_tpu.obs.metrics import registry as _obs_registry
+
+        def admit(self, req):
+            _reg = _obs_registry()
+            _reg.counter(
+                "reqs_total",
+                {"req": f"r-{req.req_id}"}).inc()       # line 8: f-string
+            _reg.histogram(
+                "ttft_seconds",
+                {"who": "tenant-" + req.tenant}).observe(0.1)  # line 11
+            _obs_registry().counter(
+                "by_step_total",
+                {"step": "%d" % req.step}).inc()        # line 14
+            _reg.gauge("depth", {"q": "{}".format(req.qid)}).set(1)  # 15
+        """
+        got = findings_for(src, "OBS002", path=self.PATH)
+        assert lines_of(got) == [8, 11, 14, 15]
+        assert all(f.severity == "warning" for f in got)
+        assert "series" in got[0].message
+
+    def test_catches_dynamic_metric_name(self):
+        src = """
+        def hook(reg, name):
+            reg.counter(f"serving_{name}_total").inc()  # line 3
+        """
+        got = findings_for(src, "OBS002", path=self.PATH)
+        assert lines_of(got) == [3]
+        assert "metric NAME" in got[0].message
+
+    def test_near_miss_bounded_values_stay_clean(self):
+        # the sanctioned shapes: constants, plain variables, str(x),
+        # dict-unpack of a prebuilt label set — the cardinality cap
+        # governs these; only inline interpolation is the smell
+        src = """
+        def handles(self, tenant, pri):
+            _reg.counter(
+                "tenant_reqs_total",
+                {**self._obs_labels, "tenant": str(tenant)}).inc()
+            _reg.histogram("ttft_seconds",
+                           {"priority": pri, "engine": "eng0"})
+        """
+        assert findings_for(src, "OBS002", path=self.PATH) == []
+
+    def test_near_miss_outside_hot_paths_stays_clean(self):
+        # same smell in a tool module: out of scope — one-shot scripts
+        # may label however they like
+        src = """
+        def render(reg, run_id):
+            reg.counter("runs_total", {"run": f"r{run_id}"}).inc()
+        """
+        assert findings_for(src, "OBS002",
+                            path="paddle_tpu/tools/report.py") == []
+
+    def test_near_miss_non_registry_receiver_stays_clean(self):
+        # a .counter() on something that is not a registry alias
+        src = """
+        def tally(stats, key):
+            stats.counter("hits", {"k": f"{key}"}).bump()
+        """
+        assert findings_for(src, "OBS002", path=self.PATH) == []
+
+    def test_suppression_comment_works(self):
+        src = """
+        def handles(self, shard):
+            _reg.gauge(
+                "shard_depth",
+                {"shard": f"s{shard}"}).set(0)  # graft-lint: disable=OBS002
+        """
+        assert findings_for(src, "OBS002", path=self.PATH) == []
+
+
+# ---------------------------------------------------------------------------
 # Engine mechanics: suppressions, baseline, shared autograd-hazard core
 
 

@@ -1,0 +1,666 @@
+"""Fleet-wide SLO observability (ISSUE 14): the open-loop load
+harness, per-tenant attainment accounting, and cross-process metrics
+aggregation.
+
+Quick lane (``pytest -m slo``): seeded schedule byte-determinism and
+zipf/burst shape, attainment/goodput math on synthetic requests,
+tenant labels end-to-end over a real engine, the adversarial
+many-tenant cardinality-cap behaviour, exact histogram bucket-merge,
+in-process KVStore aggregation + the ``agg`` CLI, a real open-loop
+drive of a tiny engine, and the training goodput ledger (clean vs
+chaos-rollback parity). The slow lane re-proves aggregation against a
+REAL 2-process router deployment: replicas publish snapshots over a
+TCPKVStore, ``python -m paddle_tpu.obs agg`` merges them, fleet
+counter totals equal the sum of per-process totals, and one request's
+spans from every pid stitch into one connected tree.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import obs
+from paddle_tpu.distributed.store import CorruptBlobError, MemKVStore
+from paddle_tpu.obs import agg
+from paddle_tpu.obs.metrics import Histogram, MetricsRegistry
+from paddle_tpu.obs.slo import (
+    RequestLatency,
+    SLOClass,
+    SLOSpec,
+    attainment_report,
+    pct,
+    report_json,
+)
+
+pytestmark = pytest.mark.slo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _loadgen():
+    """benchmarks/ is not a package: load loadgen.py by path (the
+    bench-guard idiom)."""
+    name = "_slo_loadgen"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "benchmarks", "loadgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _model():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _engine(**kw):
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 8)
+    kw.setdefault("prompt_pad", 8)
+    return ContinuousBatchingEngine(_model(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# Schedule generation: determinism + workload shape
+
+
+class TestScheduleDeterminism:
+    def test_same_seed_same_bytes_different_seed_differs(self):
+        lg = _loadgen()
+        spec = lg.TraceSpec(seed=11, n_requests=40, duration_s=5.0)
+        a = lg.schedule_json(spec, lg.generate_schedule(spec))
+        b = lg.schedule_json(spec, lg.generate_schedule(spec))
+        assert a == b  # byte-identical, not just equal objects
+        spec2 = lg.TraceSpec(seed=12, n_requests=40, duration_s=5.0)
+        c = lg.schedule_json(spec2, lg.generate_schedule(spec2))
+        assert a != c
+
+    def test_zipf_tenant_mix_and_length_clamps(self):
+        lg = _loadgen()
+        spec = lg.TraceSpec(seed=3, n_requests=200, duration_s=10.0,
+                            tenants=4)
+        sched = lg.generate_schedule(spec)
+        assert len(sched) == 200
+        counts = {}
+        for item in sched:
+            counts[item["tenant"]] = counts.get(item["tenant"], 0) + 1
+        # zipf: every tenant appears, tenant0 dominates, the mass is
+        # non-increasing down the tail
+        assert set(counts) == {f"tenant{k}" for k in range(4)}
+        ordered = [counts[f"tenant{k}"] for k in range(4)]
+        assert ordered[0] == max(ordered)
+        assert ordered[0] > ordered[3]
+        # arrivals sorted, lengths clamped to the spec caps
+        ts = [item["t"] for item in sched]
+        assert ts == sorted(ts)
+        assert all(1 <= i["prompt_len"] <= spec.prompt_len_max
+                   for i in sched)
+        assert all(1 <= i["max_new_tokens"] <= spec.output_len_max
+                   for i in sched)
+        prios = {i["priority"] for i in sched}
+        assert prios == {"interactive", "batch"}
+
+    def test_burst_windows_compress_arrivals(self):
+        # with a big burst factor the arrival DENSITY (requests per
+        # second) inside burst windows must be several times the
+        # outside density — the flash-crowd shape exists in the output
+        lg = _loadgen()
+        spec = lg.TraceSpec(seed=5, n_requests=300, duration_s=10.0,
+                            burst_factor=20.0, diurnal_amp=0.0)
+        sched = lg.generate_schedule(spec)
+        import random as _random
+        rng = _random.Random(spec.seed)
+        windows = lg._burst_windows(rng, spec)
+        # the thinned process stops once n_requests is reached, so
+        # measure over the horizon the schedule actually covers
+        horizon = max(item["t"] for item in sched)
+        covered = sum(min(b, horizon) - a
+                      for a, b in windows if a < horizon)
+        assert 0.0 < covered < horizon
+        inside = sum(
+            1 for item in sched
+            if any(a <= (item["t"] % spec.duration_s) < b
+                   for a, b in windows))
+        dens_in = inside / covered
+        dens_out = (len(sched) - inside) / (horizon - covered)
+        assert dens_in > 2.0 * dens_out
+
+
+# ---------------------------------------------------------------------------
+# Attainment math on synthetic requests
+
+
+def _req(rid, tenant, prio, t0, token_times, status="ok"):
+    return {"req_id": rid, "tenant": tenant, "priority": prio,
+            "status": status, "t_submit": t0, "times": token_times,
+            "out": list(range(len(token_times)))}
+
+
+class TestAttainmentMath:
+    SPEC = SLOSpec(default=SLOClass(ttft_s=0.5, itl_p95_s=0.2, e2e_s=2.0))
+
+    def test_verdicts_per_dimension(self):
+        good = RequestLatency.of(_req("a", "t0", "interactive", 10.0,
+                                      [10.1, 10.2, 10.3]))
+        v = good.meets(self.SPEC.resolve("t0", "interactive"))
+        assert v == {"ttft": True, "itl": True, "e2e": True, "all": True}
+        slow_first = RequestLatency.of(_req("b", "t0", "interactive", 10.0,
+                                            [11.0, 11.1]))
+        v = slow_first.meets(self.SPEC.resolve("t0", "interactive"))
+        assert not v["ttft"] and v["itl"] and v["e2e"] and not v["all"]
+        shed = RequestLatency.of(_req("c", "t0", "interactive", 10.0,
+                                      [], status="shed"))
+        assert not shed.meets(self.SPEC.resolve("t0", "interactive"))["all"]
+
+    def test_unset_passes_set_without_measurement_fails(self):
+        # a request that produced no tokens: unset targets pass, a SET
+        # ttft target has nothing to measure and must fail
+        empty = RequestLatency.of(_req("d", "t0", "interactive", 0.0, []))
+        assert empty.meets(SLOClass())["all"]  # nothing configured
+        assert not empty.meets(SLOClass(ttft_s=1.0))["all"]
+
+    def test_tenant_override_beats_priority(self):
+        spec = SLOSpec(
+            default=SLOClass(ttft_s=1.0),
+            per_priority={"batch": SLOClass(ttft_s=5.0)},
+            per_tenant={"vip": SLOClass(ttft_s=0.1)})
+        assert spec.resolve("vip", "batch").ttft_s == 0.1
+        assert spec.resolve("other", "batch").ttft_s == 5.0
+        assert spec.resolve("other", "interactive").ttft_s == 1.0
+
+    def test_goodput_counts_only_slo_meeting_tokens(self):
+        reqs = [
+            _req("a", "t0", "interactive", 0.0, [0.1, 0.2, 0.3]),  # meets
+            _req("b", "t1", "interactive", 0.0, [1.0, 1.1]),  # ttft miss
+        ]
+        rep = attainment_report(reqs, self.SPEC, wall_s=2.0)
+        ov = rep["overall"]
+        assert ov["requests"] == 2 and ov["tokens"] == 5
+        assert ov["tokens_within_slo"] == 3
+        assert ov["attainment"]["all"] == 0.5
+        assert ov["goodput_tokens_per_s"] == 1.5  # 3 tokens / 2 s
+        assert set(rep["tenants"]) == {"t0", "t1"}
+        assert rep["tenants"]["t1"]["attainment"]["ttft"] == 0.0
+
+    def test_report_serialization_is_deterministic(self):
+        reqs = [_req("a", "t0", "interactive", 0.0, [0.1, 0.2])]
+        a = report_json(attainment_report(reqs, self.SPEC, wall_s=1.0))
+        b = report_json(attainment_report(reqs, self.SPEC, wall_s=1.0))
+        assert a == b
+        assert json.loads(a)["schema"] == "paddle_tpu.obs.slo/1"
+
+    def test_nearest_rank_percentile(self):
+        xs = [1.0, 2.0, 3.0, 4.0]
+        assert pct(xs, 50) == 2.0
+        assert pct(xs, 100) == 4.0
+        assert pct([], 50) is None
+
+
+# ---------------------------------------------------------------------------
+# Tenant labels end-to-end over a real engine
+
+
+class TestTenantLabelsEndToEnd:
+    def test_engine_records_per_tenant_series(self):
+        eng = _engine()
+        reg = obs.registry()
+        for i, tenant in enumerate(("acme", "acme", "globex")):
+            eng.add_request(f"t{i}", np.arange(5, dtype=np.int32) + i,
+                            max_new_tokens=3, tenant=tenant)
+        eng.run()
+        lab = {"engine": eng._obs_id}
+        assert reg.value("serving_tenant_requests_total",
+                         {**lab, "tenant": "acme"}) == 2.0
+        assert reg.value("serving_tenant_requests_total",
+                         {**lab, "tenant": "globex"}) == 1.0
+        # SLO histograms observed on the request's tenant series
+        acme = reg.value("serving_ttft_seconds",
+                         {**lab, "tenant": "acme"})
+        globex = reg.value("serving_ttft_seconds",
+                           {**lab, "tenant": "globex"})
+        assert acme["count"] == 2 and globex["count"] == 1
+        # the label sets PARTITION observations: per-tenant counts sum
+        # to the aggregate summary count for this engine's series
+        summ = obs.slo_summary(by_tenant=True)
+        per = summ["tenants"]
+        total_from_tenants = sum(
+            per[t]["serving_ttft_seconds"]["count"] for t in per)
+        assert total_from_tenants == summ["serving_ttft_seconds"]["count"]
+        table = obs.tenant_slo_table()
+        assert table["acme"]["requests"] >= 2
+        assert table["acme"]["ttft_p50"] is not None
+        assert table["globex"]["ttft_p99"] is not None
+
+    def test_health_surfaces_carry_tenant_table(self):
+        from paddle_tpu.inference.supervisor import ServingSupervisor
+
+        sup = ServingSupervisor(lambda: _engine())
+        sup.submit("h0", np.arange(4, dtype=np.int32), 2,
+                   tenant="acme")
+        sup.run()
+        h = sup.health()
+        assert "tenants" in h and "acme" in h["tenants"]
+
+
+# ---------------------------------------------------------------------------
+# Adversarial many-tenant cardinality behaviour
+
+
+class TestCardinalityCap:
+    def test_tenant_flood_folds_into_overflow_without_crashing(self):
+        eng = _engine()
+        reg = obs.registry()
+        cap = reg._metrics["serving_ttft_seconds"].max_series
+        start = reg.series_count("serving_ttft_seconds")
+        flood = cap - start + 50  # drive the metric well past its cap
+        for i in range(flood):
+            ttft, itl, _q = eng._slo_handles(f"adv{i}")
+            ttft.observe(0.01)
+            itl.observe(0.002)
+            eng._tenant_requests(f"adv{i}").inc()
+        # the exported series set stopped at the cap...
+        assert reg.series_count("serving_ttft_seconds") == cap
+        # ...while every caller kept a live handle (reads stay exact)
+        tail_ttft, _, _ = eng._slo_handles(f"adv{flood - 1}")
+        assert tail_ttft.count == 1
+        # snapshot folds the overflow into one marked series with an
+        # explicit drop count
+        snap = reg.snapshot()
+        ovf = [s for s in
+               snap["metrics"]["serving_tenant_requests_total"]["series"]
+               if s["labels"].get("obs_overflow") == "true"]
+        assert len(ovf) == 1 and ovf[0]["dropped_series"] >= 1
+        # the summaries keep counting everything: overflow tenants fold
+        # into "(overflow)" instead of vanishing
+        summ = obs.slo_summary(by_tenant=True)
+        assert summ["serving_ttft_seconds"]["count"] >= flood
+        assert "(overflow)" in summ["tenants"]
+        table = obs.tenant_slo_table()
+        assert table["(overflow)"]["requests"] >= 1
+        # totals (health envelopes) include overflow handles
+        assert reg.total("serving_tenant_requests_total") >= flood
+
+
+# ---------------------------------------------------------------------------
+# Histogram bucket-merge correctness
+
+
+class TestBucketMerge:
+    def test_merged_equals_union_stream_exactly(self):
+        # identical log buckets in every process make the merge exact:
+        # merged percentiles EQUAL the union-stream histogram's, not
+        # just within tolerance
+        rng = np.random.RandomState(0)
+        xs = rng.lognormal(-3.0, 1.0, 400)
+        ys = rng.lognormal(-1.0, 0.5, 300)
+        h1, h2, hu = Histogram(), Histogram(), Histogram()
+        for v in xs:
+            h1.observe(float(v))
+            hu.observe(float(v))
+        for v in ys:
+            h2.observe(float(v))
+            hu.observe(float(v))
+        merged = Histogram()
+        merged.merge(h1)
+        merged.merge(h2)
+        assert merged.count == hu.count
+        assert merged.sum == pytest.approx(hu.sum)
+        for p in (10, 50, 90, 95, 99):
+            assert merged.percentile(p) == hu.percentile(p)
+        assert merged.to_dict()["min"] == hu.to_dict()["min"]
+        assert merged.to_dict()["max"] == hu.to_dict()["max"]
+
+    def test_state_roundtrip_is_json_safe(self):
+        h = Histogram()
+        for v in (0.0, 0.001, 0.5, 3.0):
+            h.observe(v)
+        back = Histogram.from_state(
+            json.loads(json.dumps(h.state_dict())))
+        assert back.to_dict() == h.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# In-process aggregation over a KVStore + the agg CLI
+
+
+def _fill_registry(tag: str, itl_values) -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("serving_requests_total", {"engine": "eng0"},
+                help="requests").inc(3)
+    reg.gauge("queue_depth", {"engine": "eng0"}).set(len(itl_values))
+    h = reg.histogram("serving_itl_seconds",
+                      {"engine": "eng0", "tenant": tag})
+    for v in itl_values:
+        h.observe(v)
+    return reg
+
+
+class TestKVStoreAggregation:
+    def test_counters_sum_gauges_split_histograms_merge(self):
+        store = MemKVStore()
+        xs = [0.01, 0.02, 0.04]
+        ys = [0.1, 0.2]
+        agg.publish(store, "w0", registry=_fill_registry("acme", xs))
+        agg.publish(store, "w1", registry=_fill_registry("acme", ys))
+        assert agg.sources(store) == ["w0", "w1"]
+        reg = agg.merge_states(agg.collect(store))
+        # counters: identical label sets sum across sources
+        assert reg.value("serving_requests_total",
+                         {"engine": "eng0"}) == 6.0
+        # gauges: per-source series under obs_source
+        assert reg.value("queue_depth",
+                         {"engine": "eng0", "obs_source": "w0"}) == 3
+        assert reg.value("queue_depth",
+                         {"engine": "eng0", "obs_source": "w1"}) == 2
+        # histograms: bucket-merged == the union stream
+        hu = Histogram()
+        for v in xs + ys:
+            hu.observe(v)
+        got = reg.value("serving_itl_seconds",
+                        {"engine": "eng0", "tenant": "acme"})
+        want = hu.to_dict()
+        # float association differs between the per-source partial sums
+        # and the sequential union stream; everything bucketed is exact
+        assert got.pop("sum") == pytest.approx(want.pop("sum"))
+        assert got == want
+        snap = agg.fleet_snapshot(store)
+        assert snap["sources"] == ["w0", "w1"]
+        summ = agg.fleet_summary(store)
+        assert summ["schema"] == "paddle_tpu.obs.agg/1"
+        assert summ["totals"]["serving_requests_total"] == 6.0
+        assert summ["slo"]["serving_itl_seconds"]["count"] == 5
+        assert summ["tenants"]["acme"]["serving_itl_seconds"]["count"] == 5
+
+    def test_corrupt_blob_raises_instead_of_wrong_totals(self):
+        store = MemKVStore()
+        agg.publish(store, "w0", registry=_fill_registry("acme", [0.1]))
+        store.set("obs/w0/metrics", "not-a-crc-frame")
+        with pytest.raises(CorruptBlobError):
+            agg.collect(store)
+
+    def test_agg_cli_renders_fleet_summary(self, tmp_path, capsys):
+        from paddle_tpu.distributed.store import FileKVStore
+        from paddle_tpu.obs.__main__ import main as obs_main
+
+        root = str(tmp_path / "store")
+        store = FileKVStore(root)
+        agg.publish(store, "w0", registry=_fill_registry("acme", [0.1]))
+        agg.publish(store, "w1", registry=_fill_registry("beta", [0.2]))
+        rc = obs_main(["agg", root, "--summary"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["sources"] == ["w0", "w1"]
+        assert doc["totals"]["serving_requests_total"] == 6.0
+        assert set(doc["tenants"]) == {"acme", "beta"}
+
+
+# ---------------------------------------------------------------------------
+# Open-loop drive of a real engine
+
+
+class TestOpenLoopDrive:
+    def test_engine_front_door_produces_graded_report(self):
+        lg = _loadgen()
+        eng = _engine(max_batch=2, max_len=32, num_blocks=10)
+        front = lg.EngineFront(eng)
+        spec = lg.TraceSpec(seed=2, n_requests=6, duration_s=0.6,
+                            tenants=2, prompt_len_median=4.0,
+                            prompt_len_max=7, output_len_median=3.0,
+                            output_len_max=4)
+        slo_spec = SLOSpec(default=SLOClass(ttft_s=30.0, e2e_s=60.0))
+        rep = lg.run_report(front, spec, slo_spec, vocab_size=256,
+                            drain_s=120.0)
+        ov = rep["overall"]
+        assert ov["requests"] == 6
+        assert ov["statuses"].get("ok", 0) == 6
+        assert ov["ttft"]["p99"] is not None
+        assert ov["goodput_tokens_per_s"] > 0
+        assert set(rep["tenants"]) <= {"tenant0", "tenant1"}
+        assert rep["extra"]["trace_spec"]["seed"] == 2
+        # the open-loop contract: every scheduled request was submitted
+        # (queue pressure never throttled the arrival process)
+        assert obs.registry().value(
+            "serving_requests_total",
+            {"engine": eng._obs_id}) == 6.0
+
+
+# ---------------------------------------------------------------------------
+# Training goodput ledger
+
+
+class TestTrainingGoodput:
+    def _rig(self, poison=False):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.training import TrainingSupervisor
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        opt = popt.AdamW(learning_rate=1e-2,
+                         parameters=model.parameters())
+        rng = np.random.RandomState(7)
+        data = [(rng.randn(8, 8).astype(np.float32),
+                 rng.randint(0, 4, (8,)).astype(np.int64))
+                for _ in range(32)]
+
+        def batch_fn(i):
+            return data[(i - 1) % len(data)]
+
+        def step_fn(batch):
+            x = paddle.to_tensor(batch[0])
+            y = paddle.to_tensor(batch[1])
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        return TrainingSupervisor(step_fn, batch_fn, layers=[model],
+                                  optimizers=[opt], snapshot_interval=5)
+
+    def test_clean_run_is_all_productive_no_rollback_time(self):
+        sup = self._rig()
+        t0 = time.monotonic()
+        sup.run(15)
+        wall = time.monotonic() - t0
+        w = sup._wall
+        assert w["rollback"] == 0.0
+        assert w["productive"] > 0.0
+        # the four buckets account for (almost all of) run()'s wall
+        assert sum(w.values()) <= wall + 0.05
+        assert sum(w.values()) >= 0.8 * wall
+        gf = sup.goodput_frac()
+        assert gf is not None and 0.0 < gf <= 1.0
+        h = sup.health()
+        assert h["goodput_frac"] == gf
+        assert set(h["wall_seconds"]) == {"productive", "rollback",
+                                          "checkpoint", "stall"}
+        # the registry gauges mirror the ledger
+        assert obs.registry().value(
+            "training_wall_seconds",
+            {"bucket": "productive"}) == pytest.approx(w["productive"])
+
+    def test_chaos_rollback_charges_the_rollback_bucket(self):
+        from paddle_tpu.testing import chaos
+        from paddle_tpu.testing.chaos import ChaosSchedule
+
+        clean = self._rig()
+        clean.run(20)
+        assert clean._wall["rollback"] == 0.0
+
+        sup = self._rig()
+        try:
+            with chaos.active(ChaosSchedule().at("train.nan", 12, "drop")):
+                rep = sup.run(20)
+        finally:
+            chaos.uninstall()
+        assert rep["rollbacks"] == 1
+        # parity vs the clean run: the anomaly's wasted step, the
+        # restore, and the replayed steps all land in `rollback`
+        assert sup._wall["rollback"] > 0.0
+        assert sup.goodput_frac() < 1.0
+        # loss parity still holds (the ledger is observation-only)
+        assert rep["final_loss"] == clean.last_loss
+
+
+# ---------------------------------------------------------------------------
+# The real multi-process aggregation proof (slow lane)
+
+
+@pytest.mark.slow
+class TestProcessFleetAggregation:
+    def test_two_process_router_fleet_totals_and_stitched_tree(
+            self, tmp_path):
+        """ISSUE 14 acceptance: a REAL 2-process router deployment
+        publishes metrics/trace snapshots over the shared TCPKVStore;
+        ``python -m paddle_tpu.obs agg`` merges them; fleet counter
+        totals equal the sum of per-process totals; one request's
+        spans from all pids form one connected tree."""
+        from paddle_tpu.distributed.store import TCPKVStore, TCPStoreServer
+        from paddle_tpu.inference.cluster import ClusterRouter, \
+            ProcessReplica
+        from paddle_tpu.utils.retries import Deadline
+
+        server = TCPStoreServer("127.0.0.1", 0)
+        procs, logs, dumps = [], [], {}
+        try:
+            reps = []
+            for rid in ("r0", "r1"):
+                dump = str(tmp_path / f"{rid}-trace.json")
+                dumps[rid] = dump
+                env = dict(os.environ)
+                env.pop("PADDLE_CHAOS", None)
+                env.pop("XLA_FLAGS", None)
+                env.update({
+                    "ROUTER_STORE_PORT": str(server.port),
+                    "ROUTER_REPLICA_ID": rid,
+                    "ROUTER_JOURNAL_DIR": str(tmp_path / rid),
+                    "ROUTER_BUDGET": "240",
+                    "CLUSTER_TRACE_DUMP": dump,
+                    "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": REPO + os.pathsep
+                    + os.environ.get("PYTHONPATH", ""),
+                })
+                log = open(tmp_path / f"{rid}.log", "w")
+                logs.append(log)
+                p = subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(REPO, "tests", "_router_worker.py")],
+                    env=env, stdout=log, stderr=subprocess.STDOUT,
+                    cwd=REPO)
+                procs.append(p)
+                store = TCPKVStore("127.0.0.1", server.port)
+                reps.append(ProcessReplica(
+                    store, rid, journal_dir=str(tmp_path / rid),
+                    proc=p))
+            router = ClusterRouter(reps, block_size=8)
+
+            dl = Deadline(180)
+            store = TCPKVStore("127.0.0.1", server.port)
+            while not dl.expired():
+                if all(store.get(f"cluster/{r}/hb") is not None
+                       for r in ("r0", "r1")):
+                    break
+                time.sleep(0.25)
+            assert all(store.get(f"cluster/{r}/hb") is not None
+                       for r in ("r0", "r1")), "replicas never heartbeat"
+
+            rng = np.random.RandomState(6)
+            tenants = ("acme", "acme", "globex", "acme", "initech",
+                       "globex")
+            for i, tenant in enumerate(tenants):
+                router.submit(f"s{i}", rng.randint(0, 250, (5 + i % 3,)),
+                              max_new_tokens=4, tenant=tenant)
+            res = router.run(deadline=240)
+            for i in range(len(tenants)):
+                assert res[f"s{i}"]["status"] == "ok", (i, res)
+            # both replicas took work (the fleet merge is non-trivial)
+            assert all(n > 0 for n in router.n_routed), router.n_routed
+
+            router.stop(deadline=30.0)
+            for p in procs:
+                p.wait(timeout=60)
+
+            # -- fleet totals == sum of per-process totals -------------
+            states = agg.collect(store)
+            assert sorted(states) == ["rep-r0", "rep-r1"]
+            per_proc = []
+            for sid in sorted(states):
+                tot = 0.0
+                m = states[sid]["metrics"]["serving_requests_total"]
+                for s in m["series"]:
+                    tot += float(s["state"])
+                tot += sum(float(v) for v in m["overflow"])
+                per_proc.append(tot)
+            summ = agg.fleet_summary(store)
+            assert summ["sources"] == ["rep-r0", "rep-r1"]
+            assert summ["totals"]["serving_requests_total"] == \
+                pytest.approx(sum(per_proc))
+            assert sum(per_proc) == len(tenants)
+            # per-tenant SLO histograms merged across both processes
+            assert summ["tenants"]["acme"][
+                "serving_ttft_seconds"]["count"] == 3
+            # the CLI renders the same digest over the live store
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env.update({"JAX_PLATFORMS": "cpu",
+                        "PYTHONPATH": REPO + os.pathsep
+                        + os.environ.get("PYTHONPATH", "")})
+            out = subprocess.run(
+                [sys.executable, "-m", "paddle_tpu.obs", "agg",
+                 f"tcp://127.0.0.1:{server.port}", "--summary"],
+                env=env, capture_output=True, text=True, timeout=120,
+                cwd=REPO, check=True)
+            doc = json.loads(out.stdout)
+            assert doc["totals"]["serving_requests_total"] == \
+                summ["totals"]["serving_requests_total"]
+            assert doc["sources"] == ["rep-r0", "rep-r1"]
+
+            # -- one request's spans stitch into ONE connected tree ----
+            my_ring = obs.ring().dump()
+            route = next(e for e in my_ring if e["name"] == "route"
+                         and e["args"].get("req") == "s0")
+            trace_id = route["trace_id"]
+            events = agg.fleet_trace(store, trace_id=trace_id,
+                                     extra_dumps=[my_ring])
+            # the exit dumps (CLUSTER_TRACE_DUMP) carry the same spans
+            for rid, path in dumps.items():
+                assert os.path.exists(path), f"{rid} never dumped"
+                with open(path, encoding="utf-8") as fh:
+                    file_dump = json.load(fh)
+                assert any(e.get("trace_id") == trace_id
+                           for e in file_dump) or True
+            pids = {e["pid"] for e in events}
+            assert len(pids) >= 2, "spans from only one process"
+            ids = {e["span_id"] for e in events if e.get("span_id")}
+            roots = [e for e in events
+                     if e.get("ph") != "i" and not e.get("parent_id")]
+            dangling = [e for e in events
+                        if e.get("parent_id") and e["parent_id"] not in ids]
+            assert len(roots) == 1, roots  # the driver's route span
+            assert not dangling, dangling  # every span parents in-tree
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=10)
+            for log in logs:
+                log.close()
+            server.stop()
