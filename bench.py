@@ -53,6 +53,21 @@ import numpy as np
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
+def _emit_metric(metric: str, value, unit: str, **fields) -> None:
+    """One JSON metric line via the shared obs ledger writer
+    (``paddle_tpu.obs.regress.bench_record``: same stdout contract,
+    plus the schema'd append to BENCH_LEDGER). Falls back to a plain
+    print when the package import is itself what's broken — the
+    supervisor's structured-failure line must survive that."""
+    try:
+        from paddle_tpu.obs.regress import bench_record
+    except Exception:
+        print(json.dumps({"metric": metric, "value": value,
+                          "unit": unit, **fields}), flush=True)
+        return
+    bench_record("bench", metric, value, unit, **fields)
+
+
 def _load_by_path(name: str, rel: str):
     """Load a stdlib-only framework module WITHOUT importing paddle_tpu
     (the supervisor must stay alive even when the framework/backend
@@ -202,12 +217,10 @@ def _supervise() -> int:
     stop_reason = "attempts exhausted"
     probe_ok, probe_history, probe_stop = _preflight(deadline, subprocess)
     if not probe_ok:
-        print(json.dumps({
-            "metric": "llama_train_tokens_per_sec_per_chip",
-            "value": None,
-            "unit": "tokens/s",
-            "vs_baseline": None,
-            "error": {
+        _emit_metric(
+            "llama_train_tokens_per_sec_per_chip", None, "tokens/s",
+            vs_baseline=None,
+            error={
                 "final_classification": "transient",
                 "attempts": 0,
                 "stop_reason": probe_stop,
@@ -215,8 +228,7 @@ def _supervise() -> int:
                 "elapsed_s": round(deadline.elapsed(), 2),
                 "history": [],
                 "preflight": probe_history,
-            },
-        }))
+            })
         return 1
     # each FUTURE attempt keeps a small reserved slice (not an equal
     # share — an equal split would cap a healthy 700s run at
@@ -308,12 +320,10 @@ def _supervise() -> int:
                 stop_reason = "budget exhausted"
                 break
     # final failure: one structured diagnostics line, not a traceback
-    print(json.dumps({
-        "metric": "llama_train_tokens_per_sec_per_chip",
-        "value": None,
-        "unit": "tokens/s",
-        "vs_baseline": None,
-        "error": {
+    _emit_metric(
+        "llama_train_tokens_per_sec_per_chip", None, "tokens/s",
+        vs_baseline=None,
+        error={
             "final_classification": history[-1]["classification"]
             if history else "unknown",
             "attempts": len(history),
@@ -322,8 +332,7 @@ def _supervise() -> int:
             "elapsed_s": round(deadline.elapsed(), 2),
             "history": history,
             "preflight": probe_history,
-        },
-    }))
+        })
     return 1
 
 
@@ -520,26 +529,21 @@ def main():
     mfu = achieved / _peak_flops(dev)
     vs_baseline = mfu / 0.55
 
-    print(
-        json.dumps(
-            {
-                "metric": "llama_train_tokens_per_sec_per_chip",
-                "value": round(tokens_per_sec, 1),
-                "unit": "tokens/s",
-                "vs_baseline": round(vs_baseline, 4),
-                "extra": {
-                    "mfu": round(mfu, 4),
-                    "step_ms": round(1000 * dt / (k2 - k1), 2),
-                    "loss": round(final_loss, 4),
-                    "device": getattr(dev, "device_kind", str(dev)),
-                    "params": model.num_params(),
-                    "batch": batch,
-                    "seq": seq,
-                    "dtype": "bfloat16" if on_tpu else "float32",
-                },
-            }
-        )
-    )
+    _emit_metric(
+        "llama_train_tokens_per_sec_per_chip",
+        round(tokens_per_sec, 1), "tokens/s",
+        vs_baseline=round(vs_baseline, 4),
+        extra={
+            "mfu": round(mfu, 4),
+            "step_ms": round(1000 * dt / (k2 - k1), 2),
+            "loss": round(final_loss, 4),
+            "device": getattr(dev, "device_kind", str(dev)),
+            "params": model.num_params(),
+            "batch": batch,
+            "seq": seq,
+            "dtype": "bfloat16" if on_tpu else "float32",
+        },
+        config={"batch": batch, "seq": seq})
 
 
 if __name__ == "__main__":

@@ -352,6 +352,28 @@ def _preflight(deadline) -> Optional[dict]:
             "probes": history}
 
 
+def burn_columns(table: dict, objective: float = 0.99) -> dict:
+    """Burn-rate / remaining-error-budget columns for one attainment
+    table row (overall or per-tenant) — computed by the ALERT ENGINE's
+    own arithmetic (:func:`paddle_tpu.obs.alerts.burn_rate` /
+    :func:`~paddle_tpu.obs.alerts.budget_remaining_frac`), so the
+    open-loop harness and the alert rules grade from the same math; a
+    parity test pins the two surfaces against each other."""
+    from paddle_tpu.obs import alerts as _alerts
+
+    n = int(table["requests"])
+    att = table["attainment"]["all"]
+    # the table stores met/n rounded to 6 digits; the round-trip back
+    # to the integer met count is exact for any realistic n
+    bad = 0 if att is None else n - int(round(att * n))
+    return {
+        "slo_objective": objective,
+        "burn_rate": round(_alerts.burn_rate(bad, n, objective), 6),
+        "budget_remaining_frac": round(
+            _alerts.budget_remaining_frac(bad, n, objective), 6),
+    }
+
+
 def smoke(args) -> dict:
     from paddle_tpu.utils.retries import Deadline
 
@@ -414,12 +436,16 @@ def smoke(args) -> dict:
             "attainment_all": ov["attainment"]["all"],
             "ttft_p99_s": ov["ttft"]["p99"],
             "itl_p95_p99_s": ov["itl_p95"]["p99"],
+            # burn-rate / error-budget columns (ISSUE 15): same
+            # arithmetic as the alert engine's burn-rate rules
+            **burn_columns(ov),
             "tenants": {
                 t: {"requests": row["requests"],
                     "attainment_all": row["attainment"]["all"],
                     "ttft_p50_s": row["ttft"]["p50"],
                     "ttft_p99_s": row["ttft"]["p99"],
-                    "goodput_tokens_per_s": row["goodput_tokens_per_s"]}
+                    "goodput_tokens_per_s": row["goodput_tokens_per_s"],
+                    **burn_columns(row)}
                 for t, row in report["tenants"].items()},
             "fleet_snapshot_series": len(
                 _obs.registry().snapshot().get("metrics", {})),
@@ -458,7 +484,14 @@ def main(argv=None) -> int:
         return 0
     if not args.smoke:
         ap.error("pick a scenario: --smoke or --schedule-only")
-    print(json.dumps(smoke(args)), flush=True)
+    from paddle_tpu.obs.regress import bench_record
+
+    doc = smoke(args)
+    bench_record(
+        "loadgen", doc.get("metric", "loadgen_goodput_under_slo"),
+        doc.get("value"), doc.get("unit", ""), extra=doc.get("extra"),
+        **{k: v for k, v in doc.items()
+           if k not in ("metric", "value", "unit", "extra")})
     return 0
 
 

@@ -93,6 +93,16 @@ from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.utils.retries import Deadline
 
 
+def _emit(doc: dict) -> None:
+    """One metric line through the shared obs ledger writer (ISSUE 15):
+    same stdout contract as the old hand-rolled ``print(json.dumps(...))``
+    lines, plus the schema'd append to ``BENCH_LEDGER`` when set."""
+    from paddle_tpu.obs.regress import bench_record
+
+    bench_record("serving_throughput", doc["metric"], doc.get("value"),
+                 doc.get("unit", ""), extra=doc.get("extra"))
+
+
 def _pct(xs, p):
     return round(float(np.percentile(xs, p)) * 1000, 2) if xs else None
 
@@ -132,7 +142,7 @@ def sustained(model, config, on_tpu, dev):
     done = eng._completed
     assert len(done) == N_REQ, (len(done), N_REQ)
     toks = eng.decode_tokens - warm_toks
-    print(json.dumps({
+    _emit({
         "metric": "serving_decode_tokens_per_sec",
         "value": round(toks / dt, 1),
         "unit": "tokens/s",
@@ -144,7 +154,7 @@ def sustained(model, config, on_tpu, dev):
             "steps": eng.steps, "wall_s": round(dt, 2),
             "device": getattr(dev, "device_kind", str(dev)),
         },
-    }), flush=True)
+    })
 
 
 def _run_mixed_mode(model, config, *, chunked, B, MAX_LEN, BS, PAD, CHUNK,
@@ -203,15 +213,15 @@ def mixed(model, config, on_tpu, dev):
             PAD=PAD, CHUNK=CHUNK, N_REQ=N_REQ, GEN=GEN,
             prompt_lens=prompt_lens)
         rows.append(row)
-        print(json.dumps({
+        _emit({
             "metric": "serving_mixed_prefill_latency",
             "value": row["itl_ms_p99"], "unit": "ms (p99 ITL)",
             "extra": {**row, "requests": N_REQ, "gen_per_req": GEN,
                       "max_batch": B, "prompt_lens": list(prompt_lens),
                       "device": getattr(dev, "device_kind", str(dev))},
-        }), flush=True)
+        })
     whole, chunk = rows
-    print(json.dumps({
+    _emit({
         "metric": "serving_mixed_itl_p99_speedup",
         "value": round(whole["itl_ms_p99"] / chunk["itl_ms_p99"], 2),
         "unit": "x (whole-prompt p99 ITL / chunked p99 ITL)",
@@ -221,7 +231,7 @@ def mixed(model, config, on_tpu, dev):
             "ttft_ms_p50_whole": whole["ttft_ms_p50"],
             "ttft_ms_p50_chunked": chunk["ttft_ms_p50"],
         },
-    }), flush=True)
+    })
 
 
 def overload(model, config, on_tpu, dev):
@@ -301,7 +311,7 @@ def overload(model, config, on_tpu, dev):
     ttfts = [r.ttft() for r in ok_inter if r.ttft() is not None]
     goodput = sum(len(r.out) for r in ok) / wall
     shed_total = eng.n_shed["interactive"] + eng.n_shed["batch"]
-    print(json.dumps({
+    _emit({
         "metric": "serving_overload_goodput",
         "value": round(goodput, 1),
         "unit": "ok tokens/s at ~2x offered load",
@@ -323,7 +333,7 @@ def overload(model, config, on_tpu, dev):
             "stopped_early": dl.expired(),
             "device": getattr(dev, "device_kind", str(dev)),
         },
-    }), flush=True)
+    })
 
 
 def router(model, config, on_tpu, dev):
@@ -400,7 +410,7 @@ def router(model, config, on_tpu, dev):
 
     off = run_mode(False)
     on = run_mode(True)
-    print(json.dumps({
+    _emit({
         "metric": "cluster_router_prefix_hit_rate",
         "value": on["prefix_hit_rate"],
         "unit": "cached/prompt tokens over 2 replicas",
@@ -417,7 +427,7 @@ def router(model, config, on_tpu, dev):
             "budget_s": budget_s,
             "device": getattr(dev, "device_kind", str(dev)),
         },
-    }), flush=True)
+    })
 
 
 def disagg(model, config, on_tpu, dev):
@@ -582,7 +592,7 @@ def disagg(model, config, on_tpu, dev):
                 p.kill()
         server.stop()
 
-    print(json.dumps({
+    _emit({
         "metric": "serving_disagg_decode_itl_p99",
         "value": disagg_row["decode_itl_ms_p99"],
         "unit": "ms (decode p99 ITL under concurrent 4096-tok prefills)",
@@ -600,7 +610,7 @@ def disagg(model, config, on_tpu, dev):
             "budget_s": budget_s,
             "device": getattr(dev, "device_kind", str(dev)),
         },
-    }), flush=True)
+    })
 
 
 def overlap_ab(model, config, on_tpu, dev):
@@ -667,7 +677,7 @@ def overlap_ab(model, config, on_tpu, dev):
     if not dl.expired():
         ovl_streams, ovl_row = run_mode(True)
     identical = ovl_streams is not None and sync_streams == ovl_streams
-    print(json.dumps({
+    _emit({
         "metric": "serving_overlap_host_blocked_frac",
         "value": ovl_row["host_blocked_frac"] if ovl_row else None,
         "unit": "blocked/busy (overlap mode; sync row beside)",
@@ -688,7 +698,7 @@ def overlap_ab(model, config, on_tpu, dev):
             "prefill_chunk": CHUNK, "budget_s": budget_s,
             "device": getattr(dev, "device_kind", str(dev)),
         },
-    }), flush=True)
+    })
     assert ovl_row is None or identical, \
         "overlap output streams diverged from sync"
 
@@ -778,7 +788,7 @@ def obs_ab(model, config, on_tpu, dev):
     off_med = _trimmed(offs)
     on_med = off_med + _trimmed(diffs)
     overhead = _trimmed(diffs) / off_med
-    print(json.dumps({
+    _emit({
         "metric": "serving_obs_overhead_pct",
         "value": round(100 * overhead, 2),
         "unit": "% steady-state decode step time added by recording",
@@ -795,7 +805,7 @@ def obs_ab(model, config, on_tpu, dev):
             "ring_len": len(obs.ring()),
             "device": getattr(dev, "device_kind", str(dev)),
         },
-    }), flush=True)
+    })
     assert overhead < 0.02, \
         f"obs-on overhead {100 * overhead:.2f}% exceeds the 2% budget"
 

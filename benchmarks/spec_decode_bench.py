@@ -123,9 +123,10 @@ def main():
     baseline_tps = None
     for k in (None, 2, 4, 8):
         if dl.expired():
-            print(json.dumps({"bench": "spec_decode",
-                              "error": "budget exhausted",
-                              "partial": rows}), flush=True)
+            from paddle_tpu.obs.regress import bench_record
+            bench_record("spec_decode", "spec_decode_best_speedup",
+                         None, "", error="budget exhausted",
+                         partial=rows)
             return
         tps, st = spec_row(model, on_tpu, k, prompts, NEW_BIG,
                            NEW_SMALL, MAX_LEN)
@@ -146,18 +147,17 @@ def main():
 
     best = max((r["speedup"] for r in rows.values() if "speedup" in r),
                default=None)
-    print(json.dumps({
-        "bench": "spec_decode",
-        "value": best,
-        "unit": "x decode tok/s vs spec-off (best k)",
-        "extra": {
+    from paddle_tpu.obs.regress import bench_record
+    bench_record(
+        "spec_decode", "spec_decode_best_speedup", best,
+        "x decode tok/s vs spec-off (best k)",
+        extra={
             "rows": rows,
             "prompt_len": P,
             "new_tokens_big_small": [NEW_BIG, NEW_SMALL],
             "device": getattr(dev, "device_kind", str(dev)),
             "cpu_smoke": not on_tpu,
-        },
-    }), flush=True)
+        })
 
 
 if __name__ == "__main__":

@@ -106,8 +106,25 @@ def build(ckpt_dir=None, store=None, tag="bench"):
         snapshot_interval=args.interval, peer=peer, auto_checkpoint=ac)
 
 
+# headline value per row kind — what the regression sentinel grades
+# (both are latencies: down-is-good polarity from the _s suffix)
+_ROW_HEADLINE = {"overhead": "step_s", "recovery": "ram_tier_s"}
+
+
 def emit(row):
-    print("BENCH_ROW " + json.dumps(row), flush=True)
+    """One framed row through the shared obs ledger writer (ISSUE 15):
+    the ``BENCH_ROW {json}`` stdout contract is unchanged (every row
+    key stays top-level); the record also lands in BENCH_LEDGER."""
+    from paddle_tpu.obs.regress import bench_record
+
+    kind = row.get("row", "row")
+    headline = _ROW_HEADLINE.get(kind)
+    bench_record(row.get("bench", "trainfault"),
+                 f"trainfault_{kind}_{headline}" if headline else
+                 f"trainfault_{kind}",
+                 row.get(headline) if headline else None,
+                 "s", line_prefix="BENCH_ROW ",
+                 **{k: v for k, v in row.items() if k != "bench"})
 
 
 def main():

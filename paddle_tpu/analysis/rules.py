@@ -45,6 +45,14 @@ OBS002    warning  unbounded dynamic label value in a metric factory
                    values must come from a bounded set — pass the
                    variable through ``str()`` and let the cap account
                    for it, don't interpolate ids into the value)
+OBS003    warning  alert-rule series reference built dynamically — an
+                   f-string/%%-format/``.format()``/concat as the
+                   ``metric`` argument of a ``ThresholdRule``/
+                   ``BurnRateRule`` or the ``source`` argument of an
+                   ``AbsenceRule`` (ISSUE 15: a typo'd interpolation
+                   evaluates against a series that never exists and the
+                   alert silently never fires — predicates must
+                   reference series by literal name)
 ========= ======== ====================================================
 
 All rules are intraprocedural and name-based — modular by design
@@ -757,3 +765,57 @@ def obs002(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
                         f"`.{factory}(...)` ({fndef.name}) — an "
                         "unbounded interpolated value mints a series "
                         "per distinct string")
+
+
+# ---------------------------------------------------------------------------
+# OBS003 — alert-rule predicates must reference series by literal name
+
+# the series-reference argument per alert-rule constructor: the field
+# the predicate resolves against the registry snapshot at evaluation
+# time (metric for threshold/burn rules, source for absence rules)
+_OBS003_RULE_ARG = {
+    "ThresholdRule": ("metric", 1),
+    "BurnRateRule": ("metric", 1),
+    "AbsenceRule": ("source", 1),
+}
+
+
+@register_rule(
+    "OBS003", severity="warning",
+    summary="alert-rule series reference built dynamically (f-string/"
+            "%%-format/.format()/concat as the metric/source argument "
+            "of a ThresholdRule/BurnRateRule/AbsenceRule)",
+    hint="an alert predicate that interpolates its series name can't "
+         "be greppable or diffable against the registry's published "
+         "names, and a typo'd interpolation silently evaluates against "
+         "a series that never exists — the rule just never fires. "
+         "Reference series by literal name; if a family of rules is "
+         "needed, enumerate the literals (or build them from a "
+         "module-level tuple of literals). A deliberate dynamic "
+         "reference can be silenced with # graft-lint: disable=OBS003",
+)
+def obs003(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    for fndef in ctx.functions():
+        for node in walk_scope(fndef):
+            if not (isinstance(node, ast.Call)
+                    and dotted_name(node.func)):
+                continue
+            cls = dotted_name(node.func).split(".")[-1]
+            spec = _OBS003_RULE_ARG.get(cls)
+            if spec is None:
+                continue
+            field, pos = spec
+            kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+            ref = kwargs.get(field)
+            if ref is None and len(node.args) > pos:
+                ref = node.args[pos]
+            if ref is None:
+                continue
+            shape = _obs002_dynamic(ref)
+            if shape is not None:
+                yield ref, (
+                    f"`{cls}` {field} built with {shape} "
+                    f"({fndef.name}) — the predicate's series "
+                    "reference must be a literal name so it can be "
+                    "grepped against the registry and a typo fails "
+                    "loudly instead of never firing")

@@ -183,6 +183,9 @@ class AdmissionController:
         self._g_delay = _reg().gauge(
             "admission_delay_ewma_seconds",
             help="EWMA of the estimated queueing delay")
+        # per-(verdict, reason) decision counters (ISSUE 15): alert
+        # rules watch WHY load is being shed, not just how much
+        self._reg = _reg()
 
     # -- load tracking --------------------------------------------------
     def observe(self, load: EngineLoad, *,
@@ -232,6 +235,14 @@ class AdmissionController:
         (the engine performs the displacement). ``req`` needs
         ``priority``, ``prompt``, ``max_new_tokens``, ``deadline``/
         ``expired()`` — the engine's GenRequest shape."""
+        verdict = self._decide(req, load)
+        self._reg.counter(
+            "admission_decisions_total",
+            {"verdict": verdict[0],
+             "reason": verdict[1] or "ok"}).inc()
+        return verdict
+
+    def _decide(self, req, load: EngineLoad) -> Tuple[str, str]:
         cfg = self.config
         rank = priority_rank(req.priority)
         if req.expired():

@@ -351,6 +351,12 @@ class ReplicaServer:
         self._hb += 1
         self.store.set(self.ns + "/hb", str(self._hb))
         self._obs_pub.maybe_publish()
+        # tick the local alert rules (ISSUE 15) at the same cadence the
+        # registry is published — a replica's own burn-rate / queue
+        # alerts fire here and ride the next publication fleet-wide
+        # (obs_alerts_fired_total is a registry counter like any other)
+        _obs.default_manager().maybe_evaluate(
+            min_interval_s=self._obs_pub.interval_s)
 
     def serve(self, deadline=None) -> None:
         """Serve until ``stop`` is posted or the Deadline runs out.

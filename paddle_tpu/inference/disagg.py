@@ -83,6 +83,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import agg as _obs_agg
 from ..base.dtype import convert_dtype
 from ..distributed.communication import flight_recorder as _fr
 from ..distributed.store import CorruptBlobError
@@ -1223,6 +1224,11 @@ class DisaggServer:
             contract_rank = self.ROLE_RANKS[role]
         _fr.attach_contract(store, int(contract_rank),
                             int(contract_world))
+        # fleet-obs publication (ISSUE 15): disagg workers were the one
+        # serve loop NOT publishing their registry/trace ring, so the
+        # fleet snapshot (and the absence rules) could not see them
+        self._obs_pub = _obs_agg.Publisher(
+            store, f"rep-{self.replica_id}")
 
     def _pull(self) -> int:
         n = 0
@@ -1265,6 +1271,9 @@ class DisaggServer:
             self.store.set(self.ns + "/load", json.dumps(load))
         self._hb += 1
         self.store.set(self.ns + "/hb", str(self._hb))
+        self._obs_pub.maybe_publish()
+        _obs.default_manager().maybe_evaluate(
+            min_interval_s=self._obs_pub.interval_s)
 
     def serve(self, deadline=None) -> None:
         """Serve until ``stop`` is posted or the Deadline runs out;
@@ -1272,19 +1281,25 @@ class DisaggServer:
         budget, idle waits go through ``Deadline.sleep``)."""
         dl = Deadline.coerce(deadline)
         self._publish()  # first heartbeat: visible before any work
-        while not dl.expired():
-            if self.store.get(self.ns + "/stop"):
-                break
-            took = self._pull()
-            self.worker.pump()
-            # sleep whenever only store-side waits remain (an
-            # outstanding ack, a pool-full import retry): pending()
-            # counts those, but polling them at full speed would
-            # hammer the store with no engine work to show for it
-            if not (took or self.worker.active()):
-                if dl.budget is None:
-                    time.sleep(self.poll_interval)
-                else:
-                    dl.sleep(self.poll_interval)
+        try:
+            while not dl.expired():
+                if self.store.get(self.ns + "/stop"):
+                    break
+                took = self._pull()
+                self.worker.pump()
+                # sleep whenever only store-side waits remain (an
+                # outstanding ack, a pool-full import retry): pending()
+                # counts those, but polling them at full speed would
+                # hammer the store with no engine work to show for it
+                if not (took or self.worker.active()):
+                    if dl.budget is None:
+                        time.sleep(self.poll_interval)
+                    else:
+                        dl.sleep(self.poll_interval)
+                self._publish()
             self._publish()
-        self._publish()
+        finally:
+            try:
+                self._obs_pub.publish()  # final full-registry flush
+            except Exception:
+                pass  # the store may be the thing that died

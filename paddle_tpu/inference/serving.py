@@ -1242,6 +1242,17 @@ class ContinuousBatchingEngine:
             self.max_num_batched_tokens or self.B)
         delay = (backlog / max(tps, 1e-9)) * (self.ewma_step_s or 0.0)
         cfg = self.admission.config if self.admission is not None else None
+        # alertable gauges (ISSUE 15): the alert engine and the fleet
+        # aggregator read saturation through the registry, not through
+        # EngineLoad objects — refresh them wherever load is snapshotted
+        reg = _obs_registry()
+        qf = (len(queue) / cfg.max_queue
+              if cfg is not None and cfg.max_queue else 0.0)
+        reg.gauge("serving_queue_frac", self._obs_labels).set(qf)
+        reg.gauge("serving_kv_occupancy", self._obs_labels).set(
+            self._kv_occupancy())
+        reg.gauge("serving_est_queue_delay_s", self._obs_labels).set(
+            delay)
         return EngineLoad(
             queue_depth=len(queue),
             queue_limit=None if cfg is None else cfg.max_queue,

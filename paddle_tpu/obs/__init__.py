@@ -22,8 +22,29 @@ One subsystem, three planes, one timeline:
   uses; dispatch→harvest spans from the serving engine's async copy
   ring; supervisor watchdog / rollback / chaos instants.
 
-CLI: ``python -m paddle_tpu.obs dump|prom|trace [file]``.
+- **Reaction** (:mod:`.alerts`, :mod:`.regress` — ISSUE 15): the layer
+  that converts the planes above into decisions. Declarative alert
+  rules (thresholds, publisher-absence, multi-window SLO burn rates
+  with per-(tenant, priority) error budgets) with a deterministic
+  pending → firing → resolved lifecycle, surfaced through every
+  ``health()`` envelope, the trace ring, and a JSONL journal; plus the
+  schema'd bench ledger and its statistical perf-regression sentinel.
+
+CLI: ``python -m paddle_tpu.obs dump|prom|trace|agg|alerts|top|regress``.
 """
+from .alerts import (
+    AbsenceRule,
+    AlertManager,
+    BurnRateRule,
+    ThresholdRule,
+    budget_remaining_frac,
+    burn_rate,
+    burn_rules_from_slo,
+    default_manager,
+    default_serving_rules,
+    default_training_rules,
+    set_default_manager,
+)
 from .compile import (
     compile_events_installed,
     install_compile_events,
@@ -37,6 +58,12 @@ from .metrics import (
     MetricsRegistry,
     labels_of,
     registry,
+)
+from .regress import (
+    bench_record,
+    detect_regressions,
+    load_ledger,
+    polarity_of,
 )
 from .trace import (
     Span,
@@ -66,6 +93,11 @@ __all__ = [
     "compile_events_installed",
     "slo_summary", "tenant_slo_table",
     "HEALTH_SCHEMA_VERSION", "health_envelope",
+    "ThresholdRule", "AbsenceRule", "BurnRateRule", "AlertManager",
+    "burn_rate", "budget_remaining_frac", "burn_rules_from_slo",
+    "default_serving_rules", "default_training_rules",
+    "default_manager", "set_default_manager",
+    "bench_record", "load_ledger", "detect_regressions", "polarity_of",
 ]
 
 # SLO histograms the serving engine feeds (seconds)
@@ -152,14 +184,19 @@ HEALTH_SCHEMA_VERSION = 1
 # the common top-level keys every health() shape now carries, beyond
 # its legacy payload; the schema regression test pins this exact set
 HEALTH_COMMON_KEYS = ("schema_version", "kind", "shed_total",
-                      "expired_total", "requests_total")
+                      "expired_total", "requests_total", "alerts")
 
 
 def health_envelope(kind: str, payload: dict) -> dict:
     """Wrap one surface's legacy health payload with the shared,
-    registry-sourced envelope keys. Legacy keys stay at the top level
-    (old readers keep indexing them); the envelope keys win on
-    collision only for ``schema_version``/``kind``."""
+    registry-sourced envelope keys — including the process-default
+    alert manager's compact summary (ISSUE 15), so an SLO burn or a
+    silenced replica is visible from EVERY health() surface. Legacy
+    keys stay at the top level (old readers keep indexing them); the
+    envelope keys win on collision only for
+    ``schema_version``/``kind``."""
+    from . import alerts as _alerts  # lazy: alerts imports .slo
+
     reg = registry()
     out = dict(payload)
     out["schema_version"] = HEALTH_SCHEMA_VERSION
@@ -167,4 +204,5 @@ def health_envelope(kind: str, payload: dict) -> dict:
     out["shed_total"] = int(reg.total("serving_shed_total"))
     out["expired_total"] = int(reg.total("serving_expired_total"))
     out["requests_total"] = int(reg.total("serving_requests_total"))
+    out["alerts"] = _alerts.health_summary()
     return out

@@ -16,6 +16,19 @@ Subcommands::
                          # into one snapshot — counters summed, gauges
                          # per-source, histograms bucket-merged —
                          # optionally also the stitched fleet trace
+    alerts STORE [--ttft-slo S] [--objective O] [--absence-age S]
+                 [--rules] [--state]
+                         # evaluate the stock serving rule set over the
+                         # fleet (ISSUE 15); rc 1 when anything FIRES
+    top STORE [--interval S] [--once]
+                         # live text dashboard off the same store:
+                         # per-source freshness, fleet totals,
+                         # per-tenant SLO percentiles, active alerts
+    regress --ledger FILE... [--window N] [--mad-k K] [--min-rel F]
+            [--min-baseline N] [--json]
+                         # bench-ledger regression sentinel: rc 1 on a
+                         # detected regression (the CI bench gate),
+                         # rc 0 on ok/improvement/insufficient data
 
 A fresh interpreter has an empty registry, so ``dump``/``prom``
 without a file mostly matter for smoke tests; the file forms are the
@@ -66,6 +79,135 @@ def _snap_to_text(snap: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _serving_rules(ttft_slo, objective, absence_age):
+    from . import alerts as _alerts
+    from .slo import SLOClass, SLOSpec
+
+    spec = None
+    if ttft_slo is not None:
+        spec = SLOSpec(default=SLOClass(ttft_s=float(ttft_slo)))
+    return _alerts.default_serving_rules(
+        slo=spec, objective=objective, absence_age_s=absence_age)
+
+
+def _cmd_alerts(args) -> int:
+    from . import alerts as _alerts
+    from ..distributed.store import make_store
+
+    rules = _serving_rules(args.ttft_slo, args.objective,
+                           args.absence_age)
+    if args.rules:
+        print(json.dumps([r.to_dict() for r in rules], indent=2,
+                         sort_keys=True))
+        return 0
+    mgr = _alerts.AlertManager(rules, emit_trace=False)
+    store = make_store(args.store)
+    mgr.evaluate_fleet(store, prefix=args.prefix)
+    doc = mgr.statuses() if args.state else mgr.active()
+    print(json.dumps(doc, indent=2, sort_keys=True))
+    return 1 if mgr.firing() else 0
+
+
+def _fmt_ms(v):
+    return "-" if v is None else f"{1e3 * v:8.1f}"
+
+
+def _top_frame(store, prefix, mgr) -> str:
+    """One dashboard frame: source freshness, fleet counter totals,
+    per-tenant SLO percentiles, active alerts."""
+    import time as _time
+
+    from . import agg
+
+    states = agg.collect(store, prefix=prefix)
+    summ = agg.fleet_summary(store, prefix=prefix)
+    now = _time.time()
+    lines = [f"paddle_tpu.obs top — {len(states)} source(s)  "
+             f"{_time.strftime('%H:%M:%S')}"]
+    lines.append("")
+    lines.append(f"{'SOURCE':<20} {'AGE_S':>7}")
+    for sid in sorted(states):
+        pub = states[sid].get("published_unix")
+        age = "-" if pub is None else f"{max(0.0, now - pub):7.1f}"
+        lines.append(f"{sid:<20} {age:>7}")
+    lines.append("")
+    totals = summ.get("totals", {})
+    if totals:
+        lines.append("FLEET TOTALS")
+        for name in sorted(totals):
+            lines.append(f"  {name:<44} {totals[name]:>12g}")
+        lines.append("")
+    tenants = summ.get("tenants", {})
+    if tenants:
+        lines.append(f"{'TENANT':<14} {'TTFT_P50MS':>10} "
+                     f"{'TTFT_P99MS':>10} {'ITL_P99MS':>10} {'N':>8}")
+        for t in sorted(tenants):
+            per = tenants[t]
+            ttft = per.get("serving_ttft_seconds", {})
+            itl = per.get("serving_itl_seconds", {})
+            lines.append(
+                f"{t:<14} {_fmt_ms(ttft.get('p50')):>10} "
+                f"{_fmt_ms(ttft.get('p99')):>10} "
+                f"{_fmt_ms(itl.get('p99')):>10} "
+                f"{ttft.get('count', 0):>8}")
+        lines.append("")
+    if mgr is not None:
+        mgr.evaluate_fleet(store, prefix=prefix)
+        active = mgr.active()
+        lines.append(f"ALERTS ({len(active)} active)")
+        for a in active:
+            lab = ",".join(f"{k}={v}"
+                           for k, v in sorted(a["labels"].items()))
+            lines.append(f"  [{a['state']:^8}] {a['rule']:<28} "
+                         f"{a['severity']:<8} {lab}")
+    return "\n".join(lines)
+
+
+def _cmd_top(args) -> int:
+    import time as _time
+
+    from . import alerts as _alerts
+    from ..distributed.store import make_store
+
+    store = make_store(args.store)
+    mgr = _alerts.AlertManager(
+        _serving_rules(args.ttft_slo, args.objective, 5.0),
+        emit_trace=False)
+    if args.once:
+        print(_top_frame(store, args.prefix, mgr))
+        return 0
+    try:
+        while True:
+            frame = _top_frame(store, args.prefix, mgr)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            _time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cmd_regress(args) -> int:
+    from . import regress as _regress
+
+    records = _regress.load_ledger(args.ledger)
+    verdicts = _regress.detect_regressions(
+        records, baseline_window=args.window, mad_k=args.mad_k,
+        min_rel=args.min_rel, min_baseline=args.min_baseline)
+    if args.json:
+        print(json.dumps(verdicts, indent=2, sort_keys=True))
+    elif verdicts:
+        print(_regress.format_verdicts(verdicts))
+    else:
+        print("regress: no graded records in "
+              f"{len(args.ledger)} ledger file(s)")
+    bad = [v for v in verdicts if v["verdict"] == "regression"]
+    if bad:
+        print(f"regress: {len(bad)} regression(s) detected",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m paddle_tpu.obs")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -98,6 +240,44 @@ def main(argv=None) -> int:
                    help="also write the stitched fleet Chrome trace here")
     a.add_argument("--trace-id", default=None,
                    help="restrict the stitched trace to one trace id")
+    al = sub.add_parser("alerts", help="evaluate the stock serving "
+                                       "alert rules over a fleet store")
+    al.add_argument("store", help="tcp://host:port or a FileKVStore dir")
+    al.add_argument("--prefix", default="obs")
+    al.add_argument("--ttft-slo", type=float, default=None,
+                    help="TTFT target (s): enables the SLO burn-rate "
+                         "rules")
+    al.add_argument("--objective", type=float, default=0.99,
+                    help="SLO objective for the error budget "
+                         "(default 0.99)")
+    al.add_argument("--absence-age", type=float, default=5.0,
+                    help="max publication age before a source counts "
+                         "as silent (default 5s)")
+    al.add_argument("--rules", action="store_true",
+                    help="print the rule set as JSON and exit 0")
+    al.add_argument("--state", action="store_true",
+                    help="print every tracked alert state, not just "
+                         "the active ones")
+    tp = sub.add_parser("top", help="live fleet text dashboard")
+    tp.add_argument("store", help="tcp://host:port or a FileKVStore dir")
+    tp.add_argument("--prefix", default="obs")
+    tp.add_argument("--interval", type=float, default=2.0)
+    tp.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clear)")
+    tp.add_argument("--ttft-slo", type=float, default=None)
+    tp.add_argument("--objective", type=float, default=0.99)
+    rg = sub.add_parser("regress", help="bench-ledger regression "
+                                        "sentinel (CI gate)")
+    rg.add_argument("--ledger", nargs="+", required=True,
+                    help="ledger JSONL files and/or driver "
+                         "BENCH_r0N.json round files, oldest first")
+    rg.add_argument("--window", type=int, default=8,
+                    help="baseline window size (default 8)")
+    rg.add_argument("--mad-k", type=float, default=4.0)
+    rg.add_argument("--min-rel", type=float, default=0.05)
+    rg.add_argument("--min-baseline", type=int, default=3)
+    rg.add_argument("--json", action="store_true",
+                    help="print the verdicts as JSON")
     args = ap.parse_args(argv)
 
     if args.cmd == "dump":
@@ -125,6 +305,12 @@ def main(argv=None) -> int:
                                      trace_id=args.trace_id)
             export_chrome_trace(events, path=args.trace_out)
         return 0
+    if args.cmd == "alerts":
+        return _cmd_alerts(args)
+    if args.cmd == "top":
+        return _cmd_top(args)
+    if args.cmd == "regress":
+        return _cmd_regress(args)
     # trace
     if args.stitch:
         dumps = []

@@ -179,6 +179,25 @@ class Histogram:
     def p99(self) -> Optional[float]:
         return self.percentile(99)
 
+    def count_over(self, threshold: float) -> int:
+        """Observations strictly above ``threshold``, counted from
+        buckets that lie WHOLLY above it — the straddling bucket is
+        excluded, so the result under-counts by at most that one
+        bucket's population (~9% band). When ``threshold`` is an exact
+        bucket bound (``2**(k/4)``), the count is exact: the alert
+        engine's burn-rate rules read SLO violations through this."""
+        t = float(threshold)
+        if t < 0.0:
+            return self._n
+        if t == 0.0:
+            return self._n - self._zero
+        j = _BUCKETS_PER_OCTAVE * math.log2(t)
+        # bucket i spans (2**((i-1)/4), 2**(i/4)]: wholly above t iff
+        # its lower bound >= t, i.e. i >= j + 1 (epsilon absorbs the
+        # log2 round-trip on exact bounds)
+        i_min = math.ceil(j - 1e-9) + 1
+        return sum(c for i, c in self._counts.items() if i >= i_min)
+
     def bounds_counts(self) -> List[Tuple[float, int]]:
         """(upper_bound, count) per non-empty bucket, ascending — the
         Prometheus ``le`` exposition reads this."""

@@ -275,6 +275,18 @@ class TrainingSupervisor:
                 help="run() wall time attributed per goodput bucket")
             for b in self._wall
         }
+        # alertable series (ISSUE 15): the default training rules
+        # (rollback storms, goodput floor, straggler verdicts) read
+        # these registry mirrors, not supervisor attributes
+        self._c_rollbacks = _obs.registry().counter(
+            "training_rollbacks_total",
+            help="anomaly rollbacks performed")
+        self._g_goodput = _obs.registry().gauge(
+            "training_goodput_frac",
+            help="productive fraction of attributed run() wall time")
+        self._g_stragglers = _obs.registry().gauge(
+            "training_straggler_ranks",
+            help="ranks currently flagged by the straggler detector")
         self._goodput_high_water = 0  # highest step ever healthy
 
     # -- state capture / restore ----------------------------------------
@@ -570,6 +582,10 @@ class TrainingSupervisor:
     def _ledger(self, bucket: str, seconds: float) -> None:
         self._wall[bucket] += seconds
         self._wall_gauges[bucket].set(self._wall[bucket])
+        self._g_goodput.set(self.goodput_frac())
+        if self.telemetry is not None:
+            self._g_stragglers.set(
+                float(len(self.telemetry.stragglers())))
 
     def goodput_frac(self) -> Optional[float]:
         """Fraction of attributed run() wall time spent on healthy
@@ -581,6 +597,7 @@ class TrainingSupervisor:
         """Roll back; returns the step to run next."""
         self.anomalies.append((step, str(anomaly)))
         self.rollbacks += 1
+        self._c_rollbacks.inc()
         if self.rollbacks > self.rollback_budget:
             msg = (f"rollback budget exhausted ({self.rollbacks} > "
                    f"{self.rollback_budget}) at step {step}: {anomaly}")
@@ -665,5 +682,9 @@ class TrainingSupervisor:
             "wall_seconds": {b: round(v, 6)
                              for b, v in sorted(self._wall.items())},
             "goodput_frac": self.goodput_frac(),
+            # the process-default alert manager's compact summary
+            # (ISSUE 15): the training surface reports the same alert
+            # state the serving envelopes do
+            "alerts": _obs.alerts.health_summary(),
             "events": list(self.events[-20:]),
         }

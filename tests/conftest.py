@@ -122,6 +122,13 @@ def pytest_configure(config):
         "cardinality cap, KVStore aggregation (quick-lane; the real "
         "multi-process router aggregation proof rides the slow lane; "
         "standalone via `pytest -m slo`)")
+    config.addinivalue_line(
+        "markers",
+        "alerts: SLO-alerting + regression-sentinel suite — burn-rate "
+        "math vs hand-computed windows, alert lifecycle determinism "
+        "under seeded flapping, absence detection, bench-ledger "
+        "regression verdicts, CLI exit codes, loadgen parity "
+        "(quick-lane; standalone via `pytest -m alerts`)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -631,6 +631,63 @@ class TestObs002:
         assert findings_for(src, "OBS002", path=self.PATH) == []
 
 
+class TestObs003:
+    def test_catches_dynamic_series_reference(self):
+        # seeded: the three constructors, three interpolation shapes —
+        # the series a predicate resolves must be a literal name
+        src = """
+        from paddle_tpu.obs.alerts import (AbsenceRule, BurnRateRule,
+                                           ThresholdRule)
+
+        def rules_for(self, suffix, rep):
+            return [
+                ThresholdRule(
+                    "queue_saturated",
+                    f"serving_{suffix}", 0.95),             # line 9
+                AbsenceRule("silent", source="rep-%d" % rep),  # line 10
+                BurnRateRule(
+                    "burn",
+                    metric="serving_" + suffix),            # line 13
+            ]
+        """
+        got = findings_for(src, "OBS003")
+        assert lines_of(got) == [9, 10, 13]
+        assert all(f.severity == "warning" for f in got)
+        assert "literal name" in got[0].message
+
+    def test_catches_format_call_via_kwarg(self):
+        # seeded: .format() through the metric kwarg, nested in a loop
+        src = """
+        def build(self, tenants):
+            out = []
+            for t in tenants:
+                out.append(ThresholdRule(
+                    "t", metric="{}_queue".format(t), threshold=1))  # 6
+            return out
+        """
+        got = findings_for(src, "OBS003")
+        assert lines_of(got) == [6]
+        assert ".format()" in got[0].message
+
+    def test_near_miss_literals_and_variables_stay_clean(self):
+        # literals are the point; a plain variable (e.g. the metric
+        # loop in burn_rules_from_slo iterating a module-level tuple of
+        # literals) is cap-governed and fix-at-source — not flagged.
+        # The alert NAME may be dynamic: it's an identity, not a
+        # series reference the predicate resolves.
+        src = """
+        def rules_for(self, metric, rep):
+            return [
+                ThresholdRule("queue_saturated",
+                              "serving_queue_frac", 0.95),
+                ThresholdRule(f"per_{metric}", metric, 1.0),
+                AbsenceRule(f"silent_{rep}", source=None),
+                BurnRateRule("burn", metric="serving_ttft_seconds"),
+            ]
+        """
+        assert findings_for(src, "OBS003") == []
+
+
 # ---------------------------------------------------------------------------
 # Engine mechanics: suppressions, baseline, shared autograd-hazard core
 

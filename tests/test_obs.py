@@ -212,7 +212,7 @@ class TestHealthSchema:
         # these shared keys on top of its legacy payload
         assert obs.HEALTH_COMMON_KEYS == (
             "schema_version", "kind", "shed_total", "expired_total",
-            "requests_total")
+            "requests_total", "alerts")
         assert obs.HEALTH_SCHEMA_VERSION == 1
 
     def test_supervisor_router_disagg_share_the_envelope(self, tmp_path):
