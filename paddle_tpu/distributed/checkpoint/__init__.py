@@ -25,9 +25,11 @@ import numpy as np
 
 from ...base.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "reshard"]
 
 _META_FILE = "0.metadata"
+
+from . import reshard  # noqa: E402,F401 — in-RAM cross-topology reshard
 
 
 @dataclasses.dataclass

@@ -1,0 +1,218 @@
+"""In-memory cross-topology reshard of sharded training state.
+
+The file checkpoint (``distributed/checkpoint``) already reshards
+across topologies: save writes per-rank shard files keyed by global
+offset, load assembles by offset and re-places with the CURRENT
+sharding. The peer-RAM recovery tier (``training/peer_snapshot.py``)
+needs the same property without touching disk: each rank serializes
+only the shards ITS devices own, a (possibly different) future
+incarnation gathers every rank's payload and assembles the full host
+tree, then re-places it on whatever mesh it is running.
+
+Wire format: the tree piggybacks on ``framework.io``'s format-stable
+pickling — host leaves keep the ``_TENSOR_TAG`` dict shape ``fio``
+uses, and each sharded device leaf is replaced by a ``_SHARD_TAG``
+dict carrying {global_shape, dtype, local shards by offset}. A leaf
+counts as sharded when its sharding is not fully replicated (a fully
+replicated global array converts to host whole, no assembly needed).
+
+Assembly (:func:`loads_combined`) is coverage-checked: a hole in the
+offset map — a rank's payload missing from the gather — raises, it
+never yields silently-zeroed state. Layout validation is the explicit
+error path the elastic resume relies on: restoring onto a mesh whose
+sharding degree no longer divides a saved-sharded tensor raises
+:class:`ReshardLayoutError` (a ``ValueError``) naming BOTH layouts —
+permanent, not a tier to fall back from.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["ReshardLayoutError", "dumps_sharded", "loads_combined",
+           "sharded_leaf_count"]
+
+_SHARD_TAG = "__paddle_tpu_shard__"
+_PROTOCOL = 4
+
+
+class ReshardLayoutError(ValueError):
+    """Restoring sharded state onto an incompatible topology: some
+    saved-sharded tensor has no dimension divisible by the target
+    mesh's sharding degree. Permanent — retrying or falling back to an
+    older snapshot of the SAME layout cannot fix a mesh mismatch."""
+
+
+def _is_sharded(arr) -> bool:
+    if not isinstance(arr, jax.Array):
+        return False
+    sharding = getattr(arr, "sharding", None)
+    return sharding is not None and not sharding.is_fully_replicated
+
+
+def _shard_leaf(arr, *, tensor: bool, stop_gradient=True, name=None) -> dict:
+    """Local unique shards of one sharded array, keyed by global
+    offset (the file checkpoint's dedup rule: one copy per offset)."""
+    shards: Dict[Tuple[int, ...], np.ndarray] = {}
+    for sh in arr.addressable_shards:
+        offset = tuple(
+            (s.start or 0) if isinstance(s, slice) else 0 for s in sh.index)
+        if offset not in shards:
+            shards[offset] = np.asarray(sh.data)
+    return {
+        _SHARD_TAG: 1,
+        "global_shape": tuple(int(d) for d in arr.shape),
+        "dtype": str(np.dtype(arr.dtype)),
+        "tensor": bool(tensor),
+        "stop_gradient": stop_gradient,
+        "name": name,
+        "shards": shards,
+    }
+
+
+def _strip_sharded(obj):
+    """Replace sharded device leaves with ``_SHARD_TAG`` dicts so the
+    rest of the tree can go through fio's host serialization (which
+    would raise trying to ``np.asarray`` a non-addressable array)."""
+    from ...base.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        if _is_sharded(obj._data):
+            return _shard_leaf(obj._data, tensor=True,
+                               stop_gradient=obj.stop_gradient,
+                               name=obj.name)
+        return obj
+    if isinstance(obj, jax.Array):
+        if _is_sharded(obj):
+            return _shard_leaf(obj, tensor=False)
+        return obj
+    if isinstance(obj, dict):
+        return {k: _strip_sharded(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)) and not hasattr(obj, "_fields"):
+        return type(obj)(_strip_sharded(v) for v in obj)
+    return obj
+
+
+def dumps_sharded(state, layout: Optional[dict] = None) -> bytes:
+    """Serialize this rank's view of (possibly sharded) ``state``:
+    sharded leaves carry only LOCALLY-owned shards, everything else the
+    usual fio host form. ``layout`` (e.g. ``{"world": 2, "mesh":
+    {"sharding": 2}}``) rides along so the restoring side can name the
+    saved topology in errors and count reshard-on-resume events."""
+    from ...framework import io as fio
+
+    tree = fio._to_serializable(_strip_sharded(state))
+    return pickle.dumps({"layout": layout, "state": tree},
+                        protocol=_PROTOCOL)
+
+
+def _validate_leaf(path: str, global_shape: Tuple[int, ...],
+                   saved_layout, target_layout) -> None:
+    mesh = (target_layout or {}).get("mesh", {})
+    for axis, degree in mesh.items():
+        degree = int(degree)
+        if degree <= 1:
+            continue
+        if not any(d % degree == 0 and d >= degree for d in global_shape):
+            raise ReshardLayoutError(
+                f"cannot reshard {path!r} of global shape "
+                f"{tuple(global_shape)}: saved on layout {saved_layout!r} "
+                f"but the target layout {target_layout!r} shards axis "
+                f"{axis!r} {degree}-way and no dimension is divisible "
+                f"by {degree}")
+
+
+def _assemble(path: str, leaves: List[dict], saved_layout,
+              target_layout) -> Any:
+    """Merge one sharded leaf's shard maps from every payload into the
+    full host array; coverage-checked against the global shape."""
+    from ...base.tensor import Tensor
+
+    head = leaves[0]
+    shape = tuple(head["global_shape"])
+    _validate_leaf(path, shape, saved_layout, target_layout)
+    full = np.zeros(shape, np.dtype(head["dtype"]))
+    covered = np.zeros(shape, np.bool_)
+    for leaf in leaves:
+        for offset, data in leaf["shards"].items():
+            slices = tuple(slice(o, o + s)
+                           for o, s in zip(offset, data.shape))
+            full[slices] = data
+            covered[slices] = True
+    if not covered.all():
+        raise ValueError(
+            f"incomplete shard coverage for {path!r}: "
+            f"{int((~covered).sum())}/{covered.size} elements missing — "
+            "a rank's payload is absent from the gather")
+    if head["tensor"]:
+        t = Tensor(full, stop_gradient=head["stop_gradient"],
+                   _internal=True)
+        if head.get("name"):
+            t.name = head["name"]
+        return t
+    return full
+
+
+def _combine(path: str, nodes: List[Any], saved_layout,
+             target_layout) -> Any:
+    head = nodes[0]
+    if isinstance(head, dict) and head.get(_SHARD_TAG) == 1:
+        return _assemble(path, nodes, saved_layout, target_layout)
+    if isinstance(head, dict):
+        return {k: _combine(f"{path}.{k}" if path else str(k),
+                            [n[k] for n in nodes], saved_layout,
+                            target_layout)
+                for k in head}
+    if isinstance(head, (list, tuple)) and not hasattr(head, "_fields"):
+        return type(head)(
+            _combine(f"{path}[{i}]", [n[i] for n in nodes],
+                     saved_layout, target_layout)
+            for i in range(len(head)))
+    return head  # host leaf / scalar: identical on every rank, take 0's
+
+
+def loads_combined(payloads: Sequence[bytes], *,
+                   target_layout: Optional[dict] = None):
+    """Assemble every rank's :func:`dumps_sharded` payload into one
+    full-host state tree. Returns ``(state, saved_layout)``.
+
+    ``target_layout`` (same shape as the saved one) turns on the
+    explicit compatibility check: any saved-sharded leaf with no
+    dimension divisible by a target mesh axis degree raises
+    :class:`ReshardLayoutError` naming both layouts. Assembly itself
+    is layout-free — the full host tree re-places onto ANY compatible
+    mesh (the file checkpoint's reshard-on-load rule, in RAM).
+    """
+    from ...framework import io as fio
+
+    if not payloads:
+        raise ValueError("no shard payloads to combine")
+    trees, layouts = [], []
+    for p in payloads:
+        blob = pickle.loads(p)
+        trees.append(blob["state"])
+        layouts.append(blob["layout"])
+    saved_layout = layouts[0]
+    state = _combine("", trees, saved_layout, target_layout)
+    return fio._from_serializable(state, False), saved_layout
+
+
+def sharded_leaf_count(payload: bytes) -> int:
+    """How many sharded leaves one payload carries (diagnostics: 0
+    means the state was effectively replicated and a single payload
+    restores alone)."""
+    blob = pickle.loads(payload)
+
+    def walk(obj) -> int:
+        if isinstance(obj, dict):
+            if obj.get(_SHARD_TAG) == 1:
+                return 1
+            return sum(walk(v) for v in obj.values())
+        if isinstance(obj, (list, tuple)):
+            return sum(walk(v) for v in obj)
+        return 0
+
+    return walk(blob["state"])

@@ -83,6 +83,10 @@ class ElasticManager:
         self._beat_failures = 0
         self._last_beat_error: Optional[BaseException] = None
         self._dead = False
+        # distinct re-mesh decisions (real membership changes and
+        # chaos-forced ones alike) — surfaced via health()
+        self.remesh_events = 0
+        self._remesh_latched = False
 
     # -- membership ----------------------------------------------------
     def _beat(self):
@@ -182,9 +186,19 @@ class ElasticManager:
         return self._registered_world
 
     def world_changed(self) -> bool:
-        return self._registered_world is not None and (
+        # chaos-forced re-mesh decision (site ``elastic.remesh``): the
+        # membership is intact but the manager reports change, driving
+        # the full watch() → relaunch → re-register recompile path
+        forced = not _chaos.inject("elastic.remesh")
+        changed = forced or (self._registered_world is not None and (
             self.alive_nodes() != self._registered_world
-        )
+        ))
+        # count re-mesh EVENTS, not polls: watch() re-asks every beat
+        # once the world diverges, so latch until it settles again
+        if changed and not self._remesh_latched:
+            self.remesh_events += 1
+        self._remesh_latched = changed
+        return changed
 
     def watch(self, deadline: Optional[Deadline] = None) -> int:
         """Block until membership changes; returns ELASTIC_EXIT_CODE
@@ -221,6 +235,9 @@ class ElasticManager:
             "max_beat_failures": self.max_beat_failures,
             "registered_world": self._registered_world,
             "rank": self.rank(),
+            "world_size": (len(self._registered_world)
+                           if self._registered_world is not None else 0),
+            "remesh_events": self.remesh_events,
         }
 
     def exit(self):

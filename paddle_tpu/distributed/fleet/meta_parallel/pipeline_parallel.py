@@ -506,6 +506,8 @@ class PipelineParallel:
     def _place_stacked(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from paddle_tpu.utils.jax_compat import global_device_put
+
         if self._mesh is None:
             return
         mp_size = dict(self._mesh.shape).get("mp", 1)
@@ -518,7 +520,8 @@ class PipelineParallel:
             ):
                 # template axis tp_axis is stacked axis tp_axis+1
                 spec[tp_axis + 1] = "mp"
-            p._data = jax.device_put(p._data, NamedSharding(self._mesh, P(*spec)))
+            p._data = global_device_put(
+                p._data, NamedSharding(self._mesh, P(*spec)))
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)

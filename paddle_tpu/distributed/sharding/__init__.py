@@ -93,10 +93,12 @@ def _shard_spec(shape, mesh: Mesh, axis: str) -> PartitionSpec:
 
 
 def _place(arr, mesh: Mesh, axis: str):
+    from ...utils.jax_compat import global_device_put
+
     sharding = NamedSharding(mesh, _shard_spec(arr.shape, mesh, axis))
     if isinstance(arr, jax.core.Tracer):
         return jax.lax.with_sharding_constraint(arr, sharding)
-    return jax.device_put(arr, sharding)
+    return global_device_put(arr, sharding)
 
 
 def group_sharded_parallel(

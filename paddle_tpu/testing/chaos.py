@@ -60,6 +60,17 @@ Instrumented sites (grep for ``chaos.inject``):
   (training/peer_snapshot.py); a byte site — ``corrupt`` flips a
   payload bit (the put_bytes CRC framing must catch it at restore),
   ``drop`` loses the publish (recovery falls to an older tier)
+- ``train.kill_rank.<r>`` — each supervised training step, suffixed
+  with the supervisor's rank (training/supervisor.py); a no-arg
+  ``kill`` scheduled at step N SIGKILLs exactly rank ``<r>`` at its
+  N-th executed step — the pod-scale "one worker dies mid-pretrain"
+  fault the elastic kill-and-resume proof injects. Other ranks'
+  schedules never match the suffix, so a single shared PADDLE_CHAOS
+  spec names its victim
+- ``elastic.remesh``     — each ``ElasticManager.world_changed()``
+  membership comparison (fleet/elastic); a ``drop`` FORCES the
+  re-mesh decision true even with a stable world — exercises the
+  re-mesh/recompile path without actually losing a node
 
 Faults (``Fault.kind``): ``hang``/``slow`` (sleep ``arg`` seconds;
 ``hang`` requires a positive arg), ``reset`` (raise

@@ -213,6 +213,46 @@ class PeerReplicator:
         except (ValueError, KeyError, TypeError):
             return None
 
+    def ranks(self) -> list:
+        """Every rank with a committed meta under this tag — the SAVED
+        world, which after an elastic shrink can be LARGER than the
+        current ``world_size`` (a relaunched smaller fleet still needs
+        all the old ranks' shard payloads to assemble full state)."""
+        out = set()
+        try:
+            for k in self.store.keys(f"{self.tag}/snap/"):
+                parts = k.split("/")
+                if parts and parts[-1] == "meta" and parts[-2].isdigit():
+                    out.add(int(parts[-2]))
+        except (OSError, ValueError, RuntimeError, TimeoutError):
+            return []
+        return sorted(out)
+
+    def fetch_at(self, rank: int, step: int) -> Optional[bytes]:
+        """The VERIFIED payload for EXACTLY ``step`` of ``rank``, or
+        None. The sharded restore gathers a consistent cut — every
+        rank at the same step — so unlike :meth:`fetch` there is no
+        older-tier fallback: a missing/corrupt payload at the cut step
+        means this cut is unusable, full stop."""
+        dl = Deadline(self.deadline_s)
+        meta_step = self.latest_step(rank)
+        if meta_step is None or meta_step < step:
+            return None  # not committed: a torn or missing publish
+        try:
+            envelope = self.retry.call(
+                lambda: self.store.get_bytes(self._data_key(step, rank)),
+                deadline=dl, describe="peer snapshot data get")
+        except (CorruptBlobError, OSError, ValueError, RuntimeError,
+                TimeoutError):
+            return None
+        if envelope is None or len(envelope) < 4:
+            return None
+        (want,) = struct.unpack("!I", envelope[:4])
+        payload = envelope[4:]
+        if binascii.crc32(payload) & 0xFFFFFFFF != want:
+            return None
+        return payload
+
     def fetch(self, rank: Optional[int] = None
               ) -> Optional[Tuple[int, bytes]]:
         """The newest VERIFIED (step, payload) for ``rank`` (default:

@@ -185,6 +185,10 @@ class TrainingSupervisor:
         copy_snapshots: bool = True,
         extra_state=None,
         set_extra_state=None,
+        rank: Optional[int] = None,
+        elastic=None,
+        sharded_state: bool = False,
+        state_layout: Optional[dict] = None,
     ):
         if escalate not in ("raise", "exit"):
             raise ValueError("escalate must be 'raise' or 'exit'")
@@ -288,6 +292,40 @@ class TrainingSupervisor:
             "training_straggler_ranks",
             help="ranks currently flagged by the straggler detector")
         self._goodput_high_water = 0  # highest step ever healthy
+        # pod-scale elastic surfaces (ISSUE 16): explicit rank (falls
+        # back to telemetry's, then the peer ring slot), the elastic
+        # membership manager whose health() this supervisor embeds,
+        # and the sharded-state mode where peer snapshots carry only
+        # locally-owned shards (restored via the cross-topology
+        # checkpoint reshard)
+        self._rank_override = rank
+        self.elastic = elastic
+        self.sharded_state = bool(sharded_state)
+        self.state_layout = state_layout
+        self.reshard_resumes = 0
+        self._g_world = _obs.registry().gauge(
+            "training_world_size",
+            help="registered elastic world size (0 before register)")
+        self._g_remesh = _obs.registry().gauge(
+            "training_remesh_events",
+            help="distinct re-mesh decisions the elastic manager took")
+        self._c_reshard = _obs.registry().counter(
+            "training_reshard_resume_total",
+            help="resumes that restored state saved on a different "
+                 "topology (cross-topology reshard on the peer tier)")
+
+    @property
+    def rank(self) -> int:
+        """This supervisor's rank: explicit override, else telemetry's,
+        else the peer ring slot, else 0 — the suffix the
+        ``train.kill_rank.<rank>`` chaos site fires under."""
+        if self._rank_override is not None:
+            return int(self._rank_override)
+        if self.telemetry is not None:
+            return int(self.telemetry.rank)
+        if self.peer is not None:
+            return int(self.peer.rank)
+        return 0
 
     # -- state capture / restore ----------------------------------------
     def _snap_tree(self, obj):
@@ -368,6 +406,13 @@ class TrainingSupervisor:
 
         wire = dict(state)
         wire["rng"] = _random.encode_rng_state(state["rng"])
+        if self.sharded_state:
+            # each rank ships only the shards its devices own; the
+            # restoring incarnation gathers every rank's payload and
+            # assembles the full host tree (reshard-on-resume)
+            from ..distributed.checkpoint import reshard
+
+            return reshard.dumps_sharded(wire, layout=self.state_layout)
         return fio.dumps(wire)
 
     def _deserialize(self, payload: bytes) -> dict:
@@ -403,13 +448,98 @@ class TrainingSupervisor:
         run (1 on a fresh start). Order: peer RAM when its committed
         step >= the newest verified disk step (RAM wins ties — it is
         the cheaper restore and never older), else disk; a corrupt or
-        unreadable peer payload falls back to disk."""
-        peer_step = self.peer.latest_step() if self.peer is not None \
-            else None
+        unreadable peer payload falls back to disk.
+
+        Goodput accounting (ISSUE 16): the restore itself is charged to
+        the ``checkpoint`` wall bucket, and the fleet's pre-kill
+        high-water step is learned from the telemetry rings — every
+        replayed step up to it then lands in the ``rollback`` bucket,
+        so wall lost to a killed incarnation shows up in THIS
+        incarnation's ledger instead of silently counting as progress.
+
+        An incompatible sharded layout raises
+        :class:`...checkpoint.reshard.ReshardLayoutError` — permanent,
+        never a tier fallback."""
+        t_resume = time.monotonic()
+        try:
+            return self._resume_tiers()
+        finally:
+            self._ledger("checkpoint", time.monotonic() - t_resume)
+            if self.telemetry is not None:
+                hw = self.telemetry.high_water()
+                if hw is not None and hw > self._goodput_high_water:
+                    self._goodput_high_water = hw
+
+    def _peer_cut(self):
+        """The newest restorable peer step: the committed step for the
+        plain (whole-payload) mode; in sharded mode the CONSISTENT CUT
+        — the newest step at which EVERY saved rank has a committed
+        payload (publish cadence is deterministic, so min-of-newest is
+        that cut)."""
+        if self.peer is None:
+            return None, None
+        if not self.sharded_state:
+            return self.peer.latest_step(), None
+        ranks = self.peer.ranks()
+        if not ranks:
+            return None, None
+        steps = [self.peer.latest_step(r) for r in ranks]
+        if any(s is None for s in steps):
+            return None, None
+        return min(steps), ranks
+
+    def _restore_sharded_peer(self, step: int, ranks) -> Optional[int]:
+        """Gather every saved rank's payload at ``step``, assemble the
+        full host tree through the cross-topology reshard, restore.
+        Returns the restored step, or None to fall to the next tier
+        (missing/corrupt payloads); an incompatible layout RAISES."""
+        from ..distributed.checkpoint import reshard
+
+        payloads = []
+        for r in ranks:
+            p = self.peer.fetch_at(r, step)
+            if p is None:
+                self._note("resume_peer_failed",
+                           f"sharded cut at step {step}: rank {r}'s "
+                           "payload missing or corrupt")
+                return None
+            payloads.append(p)
+        try:
+            state, saved_layout = reshard.loads_combined(
+                payloads, target_layout=self.state_layout)
+        except reshard.ReshardLayoutError:
+            raise  # permanent: a mesh mismatch, not a bad tier
+        except Exception as e:  # noqa: BLE001 — tier fallback
+            self._note("resume_peer_failed",
+                       f"{type(e).__name__}: {e}")
+            return None
+        restored = self._restore(state)
+        if saved_layout is not None and self.state_layout is not None \
+                and saved_layout != self.state_layout:
+            self.reshard_resumes += 1
+            self._c_reshard.inc()
+            self._note("reshard_resume",
+                       f"state saved on layout {saved_layout} restored "
+                       f"onto {self.state_layout}")
+        self._note("resume",
+                   f"peer RAM tier (sharded, {len(payloads)} rank "
+                   f"payloads) at step {restored}")
+        return restored
+
+    def _resume_tiers(self) -> int:
+        peer_step, peer_ranks = self._peer_cut()
         disk_step = self.auto_checkpoint.latest_step() \
             if self.auto_checkpoint is not None else None
         if peer_step is not None and (disk_step is None
-                                      or peer_step >= disk_step):
+                                      or peer_step >= disk_step) \
+                and self.sharded_state:
+            restored = self._restore_sharded_peer(peer_step, peer_ranks)
+            if restored is not None:
+                self._snapshots = [(restored, self._capture(restored))]
+                self._step = restored
+                return restored + 1
+        elif peer_step is not None and (disk_step is None
+                                        or peer_step >= disk_step):
             got = self.peer.fetch()
             # fetch() may fall back to an OLDER verified replica when
             # the newest payload is corrupt — re-compare the step we
@@ -508,6 +638,11 @@ class TrainingSupervisor:
         while step <= total_steps:
             t_iter = time.monotonic()
             batch = self._corrupt(self.cursor.batch(step))
+            # pod-scale worker-death fault: a no-arg ``kill`` scheduled
+            # on ``train.kill_rank.<rank>`` SIGKILLs exactly this rank
+            # at its N-th executed step — other ranks share the spec
+            # but their suffix never matches
+            _chaos.inject(f"train.kill_rank.{self.rank}")
             t0 = time.monotonic()
             out = self.step_fn(batch)
             loss, gn, lfin, gfin, fp = self._parse_result(out)
@@ -652,7 +787,11 @@ class TrainingSupervisor:
     def health(self) -> dict:
         """Structured snapshot (the ServingSupervisor.health() analogue)
         for probes/tests: progress, rollback ledger, detector stats,
-        per-tier freshness, telemetry verdicts."""
+        per-tier freshness, telemetry verdicts, and — when an elastic
+        manager is attached — the membership self-report plus the
+        world-size/re-mesh/reshard gauges. Wrapped in the shared
+        :func:`obs.health_envelope` (HEALTH_COMMON_KEYS-conformant,
+        like every other health() surface)."""
         tiers = {
             "ram": self._snapshots[-1][0] if self._snapshots else None,
             "peer": (self.peer.last_published_step
@@ -668,23 +807,27 @@ class TrainingSupervisor:
                 "sdc_suspects": (v.sdc_suspects if v is not None else []),
                 "published": self.telemetry.n_published,
             }
-        return {
+        elastic = None
+        if self.elastic is not None:
+            elastic = self.elastic.health()
+            self._g_world.set(float(elastic.get("world_size") or 0))
+            self._g_remesh.set(float(elastic.get("remesh_events") or 0))
+        return _obs.health_envelope("training", {
             "step": self._step,
             "last_loss": self.last_loss,
+            "rank": self.rank,
             "rollbacks": self.rollbacks,
             "rollback_budget": self.rollback_budget,
             "quarantined": sorted(self.cursor.quarantined),
             "detector": self.detector.snapshot(),
             "tiers": tiers,
             "telemetry": tele,
+            "elastic": elastic,
+            "reshard_resumes": self.reshard_resumes,
             "scaler_skips": (self.scaler.n_skipped_steps
                              if self.scaler is not None else None),
             "wall_seconds": {b: round(v, 6)
                              for b, v in sorted(self._wall.items())},
             "goodput_frac": self.goodput_frac(),
-            # the process-default alert manager's compact summary
-            # (ISSUE 15): the training surface reports the same alert
-            # state the serving envelopes do
-            "alerts": _obs.alerts.health_summary(),
             "events": list(self.events[-20:]),
-        }
+        })

@@ -189,6 +189,30 @@ class TrainTelemetry:
                 continue
         return rings
 
+    def high_water(self) -> Optional[int]:
+        """The max step ANY rank ever published under this tag — stale
+        entries included on purpose: a killed rank's last words are
+        exactly the evidence a relaunched incarnation needs to charge
+        its replayed steps to the rollback goodput bucket instead of
+        counting them as fresh progress."""
+        try:
+            entries = self.retry.call(
+                lambda: self.store.dump(f"{self.tag}/tele/"),
+                deadline=Deadline(self.deadline_s),
+                describe="telemetry high-water dump")
+        except (OSError, ValueError, RuntimeError, TimeoutError):
+            return None
+        best = None
+        for _key, val, _age in entries:
+            try:
+                for rec in json.loads(val).get("ring", []):
+                    s = int(rec.get("step", -1))
+                    if best is None or s > best:
+                        best = s
+            except (ValueError, KeyError, AttributeError, TypeError):
+                continue
+        return best
+
     def wait_for_peers(self, step: int, deadline=None) -> bool:
         """Block (bounded) until every dp peer has published a record
         at/past ``step``; False when the deadline lapsed first — a dead

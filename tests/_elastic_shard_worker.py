@@ -1,0 +1,188 @@
+"""Elastic sharded-pretrain worker (ISSUE 16 tentpole).
+
+Two modes, selected by ``ELASTIC_SHARD_MODE``:
+
+- ``dist``: run under ``paddle_tpu.distributed.launch`` as one of 2
+  processes x 1 device each — the global 2-device ("sharding",) mesh
+  CROSSES the process boundary. Each rank trains stage-3 group-sharded
+  under a TrainingSupervisor whose peer tier publishes SHARDED
+  payloads (each rank ships only its own shards) to the shared
+  FileKVStore, with ElasticManager membership and per-step telemetry.
+  A ``train.kill_rank.<r>@N=kill`` chaos spec SIGKILLs the named rank
+  mid-pretrain.
+- ``solo``: one process x 2 devices, same logical ("sharding", 2)
+  mesh. Used both for the uninjected reference run and for the
+  post-kill relaunch: ElasticManager re-registers (the dead node has
+  aged out → world shrinks 2→1, a re-mesh decision), resume() gathers
+  BOTH saved ranks' shard payloads from the store and restores through
+  the cross-topology reshard, then training continues to the same
+  final loss BITWISE (2-way reductions are order-commutative, so the
+  cross-process wave and the single-process wave agree to the bit).
+
+Env: ``ELASTIC_DIR`` (shared scratch: KV store + elastic membership),
+``TOTAL_STEPS``, ``ELASTIC_SETTLE_S`` (sleep before register so a
+killed wave's heartbeats age out).
+"""
+import os
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+if "jax_num_cpu_devices" in jax.config.values:
+    jax.config.update("jax_num_cpu_devices",
+                      int(os.environ.get("MC_LOCAL_DEVICES", "2")))
+else:
+    _n = int(os.environ.get("MC_LOCAL_DEVICES", "2"))
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + f" --xla_force_host_platform_device_count={_n}"
+        ).strip()
+# gloo only in dist mode: single-process runs have no distributed
+# client for the gloo transport to attach to
+if (os.environ.get("ELASTIC_SHARD_MODE") == "dist"
+        and "jax_cpu_collectives_implementation" in jax.config.values):
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+import paddle_tpu.optimizer as popt  # noqa: E402
+from paddle_tpu.base.tensor import Tensor  # noqa: E402
+from paddle_tpu.utils.jax_compat import global_device_put  # noqa: E402
+
+SHARD_DEGREE = 2
+
+
+def batch_fn(index):
+    rng = np.random.RandomState(1000 + int(index))
+    x = rng.randn(8, 16).astype(np.float32)
+    y = rng.randint(0, 8, (8,)).astype(np.int64)
+    return x, y
+
+
+def build_model():
+    paddle.seed(31)
+    model = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 8))
+    opt = popt.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    return model, opt
+
+
+def main():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    from paddle_tpu.distributed.store import FileKVStore
+    from paddle_tpu.training.peer_snapshot import PeerReplicator
+    from paddle_tpu.training.supervisor import TrainingSupervisor
+    from paddle_tpu.training.telemetry import TrainTelemetry
+    from paddle_tpu.utils.retries import Deadline
+
+    mode = os.environ.get("ELASTIC_SHARD_MODE", "solo")
+    scratch = os.environ["ELASTIC_DIR"]
+    total = int(os.environ.get("TOTAL_STEPS", "8"))
+    settle = float(os.environ.get("ELASTIC_SETTLE_S", "0"))
+
+    if mode == "dist":
+        import paddle_tpu.distributed as dist
+
+        dist.init_parallel_env()
+        rank = dist.get_rank()
+    else:
+        rank = 0
+    assert len(jax.devices()) == SHARD_DEGREE, jax.devices()
+
+    # membership: a relaunch waits out the dead wave's heartbeats, so
+    # register() sees only the CURRENT incarnation — the world-size
+    # decision (2 in the pod wave, 1 after the kill) IS the re-mesh
+    if settle > 0:
+        time.sleep(settle)
+    mgr = ElasticManager(
+        os.path.join(scratch, "elastic"), node_id=f"n{rank}",
+        np=("2" if mode == "dist" else "1:2"),
+        heartbeat_interval=0.2, elastic_timeout=1.2)
+    # elastic_timeout is tuned for fast dead-node age-out; assembly of
+    # the 2-rank pod needs its own (longer) budget to ride out import
+    # and jax-init skew between the launcher's children
+    world_nodes = mgr.register(deadline=Deadline(60.0))
+    W = len(world_nodes)
+    print(f"rank {rank}: ELASTIC world={W} nodes={world_nodes}", flush=True)
+
+    store = FileKVStore(os.path.join(scratch, "store"))
+    peer = PeerReplicator(store, rank=rank, world_size=W, tag="esnap")
+    telemetry = TrainTelemetry(store, rank, W)
+
+    model, opt = build_model()
+
+    # compiled later (after resume + sharding); the closure keeps the
+    # supervisor's step_fn stable across both
+    compiled_box = {}
+    repl_box = {}
+
+    def step_fn(batch):
+        x_np, y_np = batch
+        x = Tensor(global_device_put(x_np, repl_box["repl"]),
+                   _internal=True)
+        y = Tensor(global_device_put(y_np, repl_box["repl"]),
+                   _internal=True)
+        loss = compiled_box["step"](x, y)
+        return float(np.asarray(loss._data))
+
+    sup = TrainingSupervisor(
+        step_fn, batch_fn, layers=[model], optimizers=[opt],
+        snapshot_interval=2, peer=peer, telemetry=telemetry,
+        elastic=mgr, rank=rank, sharded_state=True,
+        state_layout={"world": W, "mesh": {"sharding": SHARD_DEGREE}})
+
+    # resume BEFORE placement: the restore writes full host arrays;
+    # group_sharded_parallel then places params + restored moments on
+    # THIS incarnation's mesh (reshard-on-resume, in RAM)
+    nxt = sup.resume()
+    print(f"rank {rank}: RESUME next_step={nxt} "
+          f"gather_ranks={peer.ranks()}", flush=True)
+
+    for p in model.parameters():
+        p._data = np.asarray(p._data)
+    model, opt, _ = group_sharded_parallel(model, opt, "p_g_os")
+    mesh, axis = model._group_sharded_mesh
+    assert dict(mesh.shape)[axis] == SHARD_DEGREE, mesh
+    if mode == "dist":
+        assert {d.process_index for d in mesh.devices.flat} == {0, 1}
+    repl_box["repl"] = NamedSharding(mesh, P())
+
+    def step(x, y):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled_box["step"] = paddle.jit.to_static(
+        step, layers=[model], optimizers=[opt])
+
+    report = sup.run(total)
+    loss = report["final_loss"]
+    h = sup.health()
+    wall = h["wall_seconds"]
+    print(f"rank {rank}: final_step={report['final_step']}", flush=True)
+    print(f"rank {rank}: final_loss={loss!r}", flush=True)
+    print(f"rank {rank}: final_loss_hex="
+          f"{np.float32(loss).tobytes().hex()}", flush=True)
+    print(f"rank {rank}: reshard_resumes={h['reshard_resumes']}",
+          flush=True)
+    print(f"rank {rank}: elastic_world={h['elastic']['world_size']} "
+          f"remesh_events={h['elastic']['remesh_events']}", flush=True)
+    print(f"rank {rank}: LEDGER productive={wall['productive']:.4f} "
+          f"rollback={wall['rollback']:.4f} "
+          f"checkpoint={wall['checkpoint']:.4f} "
+          f"stall={wall['stall']:.4f}", flush=True)
+    mgr.exit()
+    print(f"ESHARD_OK rank {rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

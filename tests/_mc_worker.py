@@ -19,7 +19,23 @@ import jax
 # CPU topology for the test: 2 local devices per process → 4 global.
 # Must run before the backend initializes.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+if "jax_num_cpu_devices" in jax.config.values:
+    jax.config.update("jax_num_cpu_devices", int(os.environ.get("MC_LOCAL_DEVICES", "2")))
+else:
+    # jax 0.4.37: no jax_num_cpu_devices config — request virtual host
+    # devices through XLA_FLAGS instead (same effect, must also precede
+    # backend init)
+    _n = int(os.environ.get("MC_LOCAL_DEVICES", "2"))
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + f" --xla_force_host_platform_device_count={_n}"
+        ).strip()
+# older jax defaults the CPU cross-process collectives implementation to
+# "none", which cannot run multi-process computations at all ("Multiprocess
+# computations aren't implemented on the CPU backend"); gloo is compiled in
+if "jax_cpu_collectives_implementation" in jax.config.values:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 import paddle_tpu as paddle  # noqa: E402
 import paddle_tpu.distributed as dist  # noqa: E402
@@ -27,6 +43,7 @@ import paddle_tpu.nn as nn  # noqa: E402
 import paddle_tpu.nn.functional as F  # noqa: E402
 import paddle_tpu.optimizer as popt  # noqa: E402
 from paddle_tpu.base.tensor import Tensor  # noqa: E402
+from paddle_tpu.utils.jax_compat import global_device_put  # noqa: E402
 
 
 def check_collectives(rank, world):
@@ -101,7 +118,7 @@ def check_dp_loss_parity(rank, world):
     # identical values from the same seed)
     repl = NamedSharding(mesh, P())
     for p in model.parameters():
-        p._data = jax.device_put(np.asarray(p._data), repl)
+        p._data = global_device_put(np.asarray(p._data), repl)
 
     # serial twin: same init, full global batch, purely process-local
     paddle.seed(0)
@@ -148,6 +165,257 @@ def check_dp_loss_parity(rank, world):
           f"{loss_serial:.6f})", flush=True)
 
 
+def check_tp_loss_parity(rank, world):
+    """TP with the mp axis CROSSING the process boundary.
+
+    jax.devices() orders process 0's devices first, so reshape(2, 2).T
+    pairs device i of process 0 with device i of process 1 along the
+    second mesh axis — the partitioned matmul's all-reduce/all-gather
+    runs across the boundary, which the single-controller 8-vdev dryrun
+    can never exercise. Loss must match a serial replicated twin.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.collective import Group
+    from paddle_tpu.distributed.fleet.layers.mpu import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+    )
+
+    devices = np.array(jax.devices()).reshape(world, 2).T  # mp spans procs
+    mesh = Mesh(devices, ("dp", "mp"))
+    assert {d.process_index for d in devices[0]} == {0, 1}, (
+        "mp group must span both processes")
+    mp_group = Group([0, 1], "mp", mesh=mesh, name="mp")
+
+    def build(group):
+        paddle.seed(11)
+        return nn.Sequential(
+            nn.Embedding(64, 32),
+            ColumnParallelLinear(32, 64, has_bias=True, gather_output=False,
+                                 mp_group=group),
+            nn.ReLU(),
+            RowParallelLinear(64, 32, has_bias=True, input_is_parallel=True,
+                              mp_group=group),
+            nn.Linear(32, 64),
+        )
+
+    model = build(mp_group)
+    serial = build(None)  # mp_group=None + no HCG -> plain layers
+    opt = popt.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    sopt = popt.AdamW(learning_rate=1e-2, parameters=serial.parameters())
+
+    # place params on the global mesh: TP weights sharded over mp via the
+    # layers' tp_axis metadata, everything else replicated
+    for p in model.parameters():
+        arr = np.asarray(p._data)
+        spec = [None] * arr.ndim
+        tp_axis = getattr(p, "tp_axis", None)
+        if tp_axis is not None and getattr(p, "is_distributed", False):
+            spec[tp_axis] = "mp"
+        p._data = global_device_put(arr, NamedSharding(mesh, P(*spec)))
+
+    def step(ids, labels):
+        logits = model(ids)
+        b, s, v = logits.shape
+        loss = F.cross_entropy(
+            logits.reshape([b * s, v]), labels.reshape([b * s]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = paddle.jit.to_static(step, layers=[model], optimizers=[opt])
+
+    B, S, steps = 8, 16, 3
+    rng = np.random.RandomState(21)
+    data_sh = NamedSharding(mesh, P("dp", None))
+    for i in range(steps):
+        ids_np = rng.randint(0, 64, (B, S)).astype(np.int32)
+        gids = global_device_put(ids_np, data_sh)
+        glab = global_device_put(ids_np.astype(np.int64), data_sh)
+        loss = compiled(Tensor(gids, _internal=True),
+                        Tensor(glab, _internal=True))
+        loss_tp = float(np.asarray(loss._data))
+
+        slogits = serial(paddle.to_tensor(ids_np))
+        b, s, v = slogits.shape
+        sloss = F.cross_entropy(
+            slogits.reshape([b * s, v]),
+            paddle.to_tensor(ids_np.astype(np.int64)).reshape([b * s]))
+        sloss.backward()
+        sopt.step()
+        sopt.clear_grad()
+        loss_serial = float(sloss)
+        assert abs(loss_tp - loss_serial) < 5e-4 * max(1.0, abs(loss_serial)), (
+            f"step {i}: tp {loss_tp} vs serial {loss_serial}")
+    print(f"rank {rank}: TP loss parity OK ({loss_tp:.6f} vs "
+          f"{loss_serial:.6f})", flush=True)
+
+
+def check_sharding3_loss_parity(rank, world):
+    """Sharding stage 3 (param + grad + optimizer-state sharded) with the
+    4-way ``sharding`` axis spanning both processes: devices 0,1 belong
+    to process 0 and 2,3 to process 1, so every shard boundary at index
+    2 is a process boundary. The stage is a placement policy, so the
+    loss must match a serial (unsharded) twin step for step.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+    def build():
+        paddle.seed(13)
+        return nn.Sequential(
+            nn.Embedding(64, 32), nn.Linear(32, 64), nn.ReLU(),
+            nn.Linear(64, 64),
+        )
+
+    model = build()
+    serial = build()
+    opt = popt.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    sopt = popt.AdamW(learning_rate=1e-2, parameters=serial.parameters())
+
+    # host-convert before placement so device_put shards from host values;
+    # group_sharded_parallel's fallback mesh is 1-D ("sharding",) over ALL
+    # visible devices — 4 global here, crossing the process boundary
+    for p in model.parameters():
+        p._data = np.asarray(p._data)
+    model, opt, _ = group_sharded_parallel(model, opt, "p_g_os")
+    mesh, axis = model._group_sharded_mesh
+    assert dict(mesh.shape)[axis] == 4, mesh
+    assert {d.process_index for d in mesh.devices.flat} == {0, 1}
+
+    def step(ids, labels):
+        logits = model(ids)
+        b, s, v = logits.shape
+        loss = F.cross_entropy(
+            logits.reshape([b * s, v]), labels.reshape([b * s]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    compiled = paddle.jit.to_static(step, layers=[model], optimizers=[opt])
+
+    B, S, steps = 8, 16, 3
+    rng = np.random.RandomState(22)
+    repl = NamedSharding(mesh, P())
+    for i in range(steps):
+        ids_np = rng.randint(0, 64, (B, S)).astype(np.int32)
+        gids = global_device_put(ids_np, repl)
+        glab = global_device_put(ids_np.astype(np.int64), repl)
+        loss = compiled(Tensor(gids, _internal=True),
+                        Tensor(glab, _internal=True))
+        loss_sh = float(np.asarray(loss._data))
+
+        slogits = serial(paddle.to_tensor(ids_np))
+        b, s, v = slogits.shape
+        sloss = F.cross_entropy(
+            slogits.reshape([b * s, v]),
+            paddle.to_tensor(ids_np.astype(np.int64)).reshape([b * s]))
+        sloss.backward()
+        sopt.step()
+        sopt.clear_grad()
+        loss_serial = float(sloss)
+        assert abs(loss_sh - loss_serial) < 5e-4 * max(1.0, abs(loss_serial)), (
+            f"step {i}: sharding3 {loss_sh} vs serial {loss_serial}")
+    print(f"rank {rank}: sharding3 loss parity OK ({loss_sh:.6f} vs "
+          f"{loss_serial:.6f})", flush=True)
+
+
+def check_pipeline_loss_parity(rank, world):
+    """The scan+ppermute pipeline with the pp axis CROSSING the process
+    boundary: fleet.init with order=['pp','dp',...] makes pp the
+    slowest-varying mesh axis, so stage 0 = process 0's devices and
+    stage 1 = process 1's — every ppermute ring hop is a cross-process
+    transfer. train_batch loss must match a serial twin.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineLayer,
+        PipelineParallel,
+    )
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.tensor import manipulation as M_
+
+    M, mb, S = 2, 4, 16  # microbatches, microbatch size, seq len
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    paddle.seed(17)
+    donor = LlamaForCausalLM(cfg)
+    snapshot = [np.asarray(p._data).copy()
+                for _, p in donor.named_parameters()]
+
+    def loss_fn(logits, y):
+        b, s, v = logits.shape
+        return F.cross_entropy(
+            M_.reshape(logits, [b * s, v]), M_.reshape(y, [b * s]))
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "pp_degree": 2, "dp_degree": 2,
+        "order": ["pp", "dp", "sharding", "sep", "mp"],
+    }
+    strategy.pipeline_configs = {"accumulate_steps": M}
+    hcg = fleet.init(strategy=strategy)
+    try:
+        # pp must be the process-crossing axis: stage 0 on process 0,
+        # stage 1 on process 1
+        stages = hcg.mesh.devices.reshape(2, -1)
+        assert {d.process_index for d in stages[0]} == {0}
+        assert {d.process_index for d in stages[1]} == {1}
+
+        pipe = PipelineLayer(
+            layers=[donor.llama.embed_tokens, *donor.llama.layers,
+                    donor.llama.norm, donor.lm_head],
+            num_stages=2, loss_fn=loss_fn,
+        )
+        pp_model = PipelineParallel(pipe, hcg, strategy)
+        # _place_stacked put the stage stack on the global mesh; the
+        # prologue/epilogue params must be globally replicated too or the
+        # multi-process jit sees process-local inputs
+        repl = NamedSharding(hcg.mesh, P())
+        for p in pipe.parameters():
+            if not isinstance(p._data.sharding, NamedSharding):
+                p._data = global_device_put(p._data, repl)
+        pp_opt = popt.SGD(learning_rate=0.1, parameters=pipe.parameters())
+
+        serial = LlamaForCausalLM(cfg)
+        for (_, p), snap in zip(serial.named_parameters(), snapshot):
+            p.set_value(paddle.to_tensor(snap))
+        serial_opt = popt.SGD(learning_rate=0.1,
+                              parameters=serial.parameters())
+
+        rng = np.random.RandomState(23)
+        for i in range(2):
+            ids_np = rng.randint(0, cfg.vocab_size, (M * mb, S)).astype(
+                np.int32)
+            y_np = ids_np.astype(np.int64)
+            x = Tensor(global_device_put(ids_np, repl), _internal=True)
+            y = Tensor(global_device_put(y_np, repl), _internal=True)
+            loss_pp = float(pp_model.train_batch((x, y), pp_opt))
+
+            sloss = loss_fn(serial(paddle.to_tensor(ids_np)),
+                            paddle.to_tensor(y_np))
+            sloss.backward()
+            serial_opt.step()
+            serial_opt.clear_grad()
+            loss_serial = float(sloss)
+            assert np.isfinite(loss_pp), loss_pp
+            assert abs(loss_pp - loss_serial) < (
+                5e-4 * max(1.0, abs(loss_serial))), (
+                f"step {i}: pipeline {loss_pp} vs serial {loss_serial}")
+        print(f"rank {rank}: pipeline loss parity OK ({loss_pp:.6f} vs "
+              f"{loss_serial:.6f})", flush=True)
+    finally:
+        fleet.set_hybrid_communicate_group(None)
+
+
 def main():
     # the common reference pattern: seed BEFORE init — must stay
     # backend-free (lazy PRNG key) or jax.distributed.initialize fails
@@ -168,6 +436,9 @@ def main():
 
     check_collectives(rank, world)
     check_dp_loss_parity(rank, world)
+    check_tp_loss_parity(rank, world)
+    check_sharding3_loss_parity(rank, world)
+    check_pipeline_loss_parity(rank, world)
     dist.barrier()
     print(f"MC_WORKER_OK rank {rank}", flush=True)
 

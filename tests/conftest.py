@@ -124,6 +124,12 @@ def pytest_configure(config):
         "standalone via `pytest -m slo`)")
     config.addinivalue_line(
         "markers",
+        "mc2: real 2-process multi-controller lane — launcher-spawned "
+        "jax.distributed workers running cross-process collectives, "
+        "DP/TP/sharding-3/pipeline parity, and the kill-one-rank "
+        "sharded elastic resume proof (standalone via `pytest -m mc2`)")
+    config.addinivalue_line(
+        "markers",
         "alerts: SLO-alerting + regression-sentinel suite — burn-rate "
         "math vs hand-computed windows, alert lifecycle determinism "
         "under seeded flapping, absence detection, bench-ledger "

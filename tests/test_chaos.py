@@ -509,3 +509,83 @@ class TestEndToEndRelaunch:
         got = self._final_loss(w2.stdout)
         assert got is not None
         np.testing.assert_allclose(got, want, rtol=1e-7)
+
+
+class TestPodScaleSites:
+    """The ISSUE 16 sites: ``train.kill_rank.<rank>`` (SIGKILL a NAMED
+    rank at a scheduled executed step — the pod-scale one-worker-dies
+    fault) and ``elastic.remesh`` (force a re-mesh decision with the
+    membership intact)."""
+
+    def test_kill_rank_spec_round_trips_and_targets_only_named_rank(self):
+        s = (ChaosSchedule(seed=5)
+             .at("train.kill_rank.1", 3, "kill")
+             .at("elastic.remesh", 2, "drop"))
+        r = ChaosSchedule.from_spec(s.to_spec())
+        for site in ("train.kill_rank.0", "train.kill_rank.1",
+                     "elastic.remesh"):
+            for i in range(1, 6):
+                a, b = s.fault_for(site, i), r.fault_for(site, i)
+                assert (a is None) == (b is None)
+                if a is not None:
+                    assert (a.kind, a.arg) == (b.kind, b.arg)
+        # the schedule names rank 1: rank 0's suffix never draws a fault
+        assert all(s.fault_for("train.kill_rank.0", i) is None
+                   for i in range(1, 20))
+        hit = s.fault_for("train.kill_rank.1", 3)
+        assert hit is not None and hit.kind == "kill"
+
+    def test_supervisor_kill_rank_site_kills_exactly_the_named_rank(self):
+        # a minimal supervised loop in a child per rank, sharing ONE
+        # spec: rank 1 must die by SIGKILL at its 3rd executed step,
+        # rank 0 must run to completion untouched
+        prog = (
+            "import os; os.environ.setdefault('JAX_PLATFORMS','cpu');\n"
+            "import numpy as np\n"
+            "from paddle_tpu.training.supervisor import TrainingSupervisor\n"
+            "sup = TrainingSupervisor(lambda b: float(np.sum(b)),\n"
+            "    lambda i: np.ones(2, np.float32) * (1 + 0.01 * i),\n"
+            "    rank=int(os.environ['SUP_RANK']), snapshot_interval=100)\n"
+            "sup.run(6)\n"
+            "print('SUP_DONE step', sup.report()['final_step'])\n"
+        )
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["PADDLE_CHAOS"] = "train.kill_rank.1@3=kill"
+        out = {}
+        for rank in (0, 1):
+            env["SUP_RANK"] = str(rank)
+            out[rank] = subprocess.run(
+                [sys.executable, "-c", prog], env=env, cwd=REPO,
+                capture_output=True, text=True, timeout=180)
+        assert out[0].returncode == 0, out[0].stderr[-2000:]
+        assert "SUP_DONE step 6" in out[0].stdout
+        # rc < 0 is the genuine worker-death shape (SIGKILL)
+        assert out[1].returncode < 0, (out[1].returncode,
+                                       out[1].stderr[-2000:])
+        assert "SUP_DONE" not in out[1].stdout
+
+    def test_remesh_drop_forces_world_changed_and_latches_events(
+            self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        m = ElasticManager(str(tmp_path), node_id="n0", np=1,
+                           heartbeat_interval=0.05, elastic_timeout=5.0)
+        m._beat()
+        m._registered_world = m.alive_nodes()
+        assert m.world_changed() is False
+        assert m.remesh_events == 0
+        with chaos.active(ChaosSchedule().at("elastic.remesh", 1, "drop")):
+            assert m.world_changed() is True  # forced: membership intact
+            assert m.remesh_events == 1
+            assert m.world_changed() is False  # settles; latch resets
+            assert m.remesh_events == 1
+        # a REAL membership change counts once however often it is
+        # re-polled (watch() asks every beat)
+        m.store.delete("nodes/n0")
+        assert m.world_changed() is True
+        assert m.world_changed() is True
+        assert m.remesh_events == 2
+        assert m.health()["remesh_events"] == 2
