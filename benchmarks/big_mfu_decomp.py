@@ -7,6 +7,11 @@ methodology, BASELINE.md "Flagship step decomposition"):
                      1.59B where fp32 masters don't fit),
 - adamw+interleave — the fused-optimizer-into-backward schedule
                      (optimizer.interleave_updates),
+- fused_adamw      — interleave + the single-pass Pallas AdamW kernel
+                     (AdamW(fused=True): one HBM read of p/g/m/v, one
+                     write of p/m/v per layer, SR in-register),
+- fp8              — every Linear except the lm_head swapped for
+                     Fp8Linear (delayed-scaling e4m3/e5m2 GEMMs),
 - sgd              — optimizer-pass cost by substitution,
 - mean-loss        — cross_entropy replaced by logits.mean(): isolates
                      the 32k-vocab logsumexp/gather CE epilogue (the
@@ -16,10 +21,17 @@ methodology, BASELINE.md "Flagship step decomposition"):
   than the h=2048 GEMMs, capping achievable MFU below the dense-GEMM
   ceiling (~0.85 of peak on v5e, measured for the flagship).
 
+Rows also land in the BENCH_LEDGER via obs.regress.bench_record, so
+``obs regress`` tracks round-over-round movement.
+
 Run (real chip):
     PYTHONPATH="/root/repo:$PYTHONPATH" python benchmarks/big_mfu_decomp.py
     BIG_ONLY=long|big limits to one config; BIG_STEPS overrides K.
+    --smoke runs a tiny config few-step pass (CPU-safe: the fused
+    kernel interprets, fp8 GEMMs run on XLA CPU) so CI exercises every
+    variant's full compile+step path without a chip.
 """
+import argparse
 import json
 import os
 import sys
@@ -38,28 +50,45 @@ from paddle_tpu.tensor import manipulation as M
 PEAK = 197e12  # v5e bf16
 
 
+VARIANTS = ("adamw", "interleave", "fused_adamw", "fp8", "sgd", "meanloss")
+
+
 def probe(name, config, batch, seq, steps, multi_precision,
-          variants=("adamw", "interleave", "sgd", "meanloss")):
+          variants=VARIANTS, record=True):
     paddle.seed(0)
     model = LlamaForCausalLM(config)
     model.bfloat16()
     rows = {}
     for variant in variants:
+        model_v = model
+        if variant == "fp8":
+            # conversion swaps sublayers in place — give fp8 its own
+            # identically-seeded model so later variants stay bf16
+            from paddle_tpu.amp import convert_to_fp8
+
+            paddle.seed(0)
+            model_v = LlamaForCausalLM(config)
+            model_v.bfloat16()
+            convert_to_fp8(model_v, exclude=lambda n: "lm_head" in n)
         opt = None
-        if variant in ("adamw", "interleave", "meanloss"):
+        if variant in ("adamw", "interleave", "fused_adamw", "fp8",
+                       "meanloss"):
             opt = popt.AdamW(
-                learning_rate=1e-4, parameters=model.parameters(),
+                learning_rate=1e-4, parameters=model_v.parameters(),
                 multi_precision=multi_precision,
                 use_stochastic_rounding=not multi_precision,
                 moment_dtype="bfloat16",
-                interleave_updates=(variant == "interleave"))
+                interleave_updates=(variant in ("interleave",
+                                                "fused_adamw")),
+                fused=(variant == "fused_adamw"))
         elif variant == "sgd":
-            opt = popt.SGD(learning_rate=1e-5, parameters=model.parameters())
+            opt = popt.SGD(learning_rate=1e-5,
+                           parameters=model_v.parameters())
 
         mean_loss = variant == "meanloss"
 
         def step(ids, labels):
-            logits = model(ids)
+            logits = model_v(ids)
             if mean_loss:
                 loss = logits.mean()
             else:
@@ -72,7 +101,7 @@ def probe(name, config, batch, seq, steps, multi_precision,
             opt.clear_grad()
             return loss
 
-        compiled = paddle.jit.to_static(step, layers=[model],
+        compiled = paddle.jit.to_static(step, layers=[model_v],
                                         optimizers=[opt])
         rng = np.random.RandomState(0)
         ids_np = rng.randint(0, config.vocab_size, (batch, seq))
@@ -81,7 +110,7 @@ def probe(name, config, batch, seq, steps, multi_precision,
         compiled(ids, labels)
         rows[variant] = round(
             _timing.diff_time_ms(compiled, ids, labels, steps), 2)
-        del opt, compiled
+        del opt, compiled, model_v
 
     fpt = model.flops_per_token(seq)
     tok = batch * seq
@@ -97,6 +126,15 @@ def probe(name, config, batch, seq, steps, multi_precision,
         "head_flop_frac": round(head_frac, 3),
         "params": model.num_params(),
     }), flush=True)
+    if record:
+        from paddle_tpu.obs.regress import bench_record
+
+        cfg = {"config": name, "batch": batch, "seq": seq,
+               "multi_precision": multi_precision}
+        for variant, ms in rows.items():
+            bench_record("big_mfu_decomp", f"step_ms_{variant}", ms,
+                         "ms", config=cfg, mfu=mfu[variant])
+    return rows, mfu
 
 
 LONG = LlamaConfig(vocab_size=32000, hidden_size=2048,
@@ -109,6 +147,15 @@ BIG = LlamaConfig(vocab_size=32000, hidden_size=2560,
                   max_position_embeddings=2048)
 
 if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny config, 2 differencing steps — CPU-safe "
+                         "compile+step coverage of every variant")
+    args = ap.parse_args()
+    if args.smoke:
+        tiny = LlamaConfig.tiny()
+        probe("smoke-tiny", tiny, 2, 32, 3, multi_precision=False)
+        sys.exit(0)
     only = os.environ.get("BIG_ONLY")
     steps = int(os.environ.get("BIG_STEPS", 24))
     if only in (None, "long"):

@@ -14,10 +14,16 @@ from .auto_cast import (  # noqa: F401
     is_bfloat16_supported,
     is_float16_supported,
 )
+from .fp8 import E4M3_MAX, E5M2_MAX, Fp8Linear, convert_to_fp8, fp8_linear  # noqa: F401,E501
 from .grad_scaler import AmpScaler, GradScaler, OptimizerState  # noqa: F401
 
 __all__ = [
     "auto_cast",
+    "Fp8Linear",
+    "convert_to_fp8",
+    "fp8_linear",
+    "E4M3_MAX",
+    "E5M2_MAX",
     "amp_guard",
     "decorate",
     "amp_decorate",

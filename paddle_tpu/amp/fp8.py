@@ -1,0 +1,216 @@
+"""fp8 delayed-scaling GEMMs — training + prefill (ISSUE 17 lever (b)).
+
+Generalizes the Int8InferenceLinear pattern (quantization/__init__.py)
+from inference-only int8 to TRAINING: ``fp8_linear`` runs the matmul on
+fp8 operands with a custom VJP, following the delayed-scaling recipe of
+Micikevicius et al., *FP8 Formats for Deep Learning* (2022):
+
+- forward: x and w cast to **e4m3** (max 448; 3 mantissa bits — the
+  activations/weights format) with per-tensor scales derived from an
+  amax HISTORY recorded on previous steps, accumulation in >= bf16
+  (``preferred_element_type=f32`` — the MXU's fp8 path accumulates
+  wide natively; off-TPU XLA computes the same f32 accumulation).
+- backward: dy cast to **e5m2** (max 57344; wider exponent — gradient
+  magnitudes swing orders across layers) with a just-in-time scale
+  (grad statistics move too fast step-to-step for a useful history);
+  dgrad/wgrad run fp8 x fp8 against the saved e4m3 operands.
+- scales: ``scale = E4M3_MAX / max(amax_history)`` — the cast uses the
+  scale derived BEFORE this step's amax is recorded (delayed scaling:
+  no serializing amax round-trip inside the step). An empty history
+  (fresh layer, or eval/prefill without a warmup) falls back to the
+  current tensor's amax just-in-time.
+
+``Fp8Linear`` wraps an existing ``nn.Linear`` keeping the SAME weight/
+bias parameters (drop-in for training — the optimizer keeps driving
+the master weights; only the GEMM operands are fp8), with the amax
+histories as ``register_buffer`` entries so ``paddle_tpu.jit`` threads
+them through compiled train steps. ``convert_to_fp8`` swaps every
+Linear in a model (the convert_to_weight_only pattern).
+
+Quality contract (tests/test_fp8.py): per-tensor rel-err of the fp8
+linear vs the float linear stays within the gate (int8's rel-err test
+style, 0.031-class), and an fp8-converted tiny model's N-step loss
+curve tracks the bf16 run within tolerance.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..base.tape import apply, no_grad
+from ..base.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["Fp8Linear", "convert_to_fp8", "fp8_linear",
+           "E4M3_MAX", "E5M2_MAX"]
+
+E4M3_MAX = 448.0    # jnp.finfo(float8_e4m3fn).max
+E5M2_MAX = 57344.0  # jnp.finfo(float8_e5m2).max
+
+
+def _cast_fp8(x, scale, dtype, fmax):
+    return jnp.clip(x.astype(jnp.float32) * scale, -fmax, fmax).astype(dtype)
+
+
+def _jit_scale(t, fmax):
+    """Just-in-time per-tensor scale: fmax / amax (1.0 for an all-zero
+    tensor)."""
+    amax = jnp.max(jnp.abs(t)).astype(jnp.float32)
+    return jnp.where(amax > 0, fmax / amax, 1.0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _fp8_dot(x_dtype, w_dtype, x, w, x_scale, w_scale):
+    y, _ = _fp8_dot_fwd(x_dtype, w_dtype, x, w, x_scale, w_scale)
+    return y
+
+
+def _fp8_dot_fwd(x_dtype, w_dtype, x, w, x_scale, w_scale):
+    qx = _cast_fp8(x, x_scale, jnp.float8_e4m3fn, E4M3_MAX)
+    qw = _cast_fp8(w, w_scale, jnp.float8_e4m3fn, E4M3_MAX)
+    acc = jax.lax.dot_general(
+        qx, qw, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    y = (acc / (x_scale * w_scale)).astype(x_dtype)
+    # residuals are the fp8 images: backward re-uses them as the e4m3
+    # operands of dgrad/wgrad — half the residual HBM of a bf16 save
+    return y, (qx, qw, x_scale, w_scale)
+
+
+def _fp8_dot_bwd(x_dtype, w_dtype, res, dy):
+    qx, qw, x_scale, w_scale = res
+    dy_scale = _jit_scale(dy, E5M2_MAX)
+    qdy = _cast_fp8(dy, dy_scale, jnp.float8_e5m2, E5M2_MAX)
+    # dx = dy @ w.T
+    dx = jax.lax.dot_general(
+        qdy, qw, (((dy.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dx = (dx / (dy_scale * w_scale)).astype(x_dtype)
+    # dw = x.T @ dy (contract every leading dim)
+    lead = tuple(range(qx.ndim - 1))
+    dw = jax.lax.dot_general(
+        qx, qdy, ((lead, lead), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dw = (dw / (x_scale * dy_scale)).astype(w_dtype)
+    # scales are amax-derived controls, not trainable signal
+    return dx, dw, jnp.zeros_like(x_scale), jnp.zeros_like(w_scale)
+
+
+_fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+
+
+def fp8_linear(x, weight, bias=None, x_scale=None, w_scale=None):
+    """y = fp8_dot(x, w) + bias at the tape level. ``x_scale``/
+    ``w_scale`` are per-tensor f32 cast scales (Tensors; from an
+    Fp8Linear's delayed-scaling histories) — omitted, each is computed
+    just-in-time from the tensor's current amax."""
+    has_xs = x_scale is not None
+    has_ws = w_scale is not None
+    has_b = bias is not None
+
+    def _f(a, w, *rest):
+        i = 0
+        if has_xs:
+            xs = rest[i]; i += 1  # noqa: E702
+        else:
+            xs = _jit_scale(a, E4M3_MAX)
+        if has_ws:
+            ws = rest[i]; i += 1  # noqa: E702
+        else:
+            ws = _jit_scale(w, E4M3_MAX)
+        out = _fp8_dot(str(a.dtype), str(w.dtype), a, w, xs, ws)
+        if has_b:
+            out = out + rest[i]
+        return out
+
+    args = [x, weight]
+    if has_xs:
+        args.append(x_scale)
+    if has_ws:
+        args.append(w_scale)
+    if has_b:
+        args.append(bias)
+    return apply(_f, *args, op_name="fp8_linear")
+
+
+class Fp8Linear(Layer):
+    """Drop-in fp8 training Linear: wraps an existing ``nn.Linear``
+    KEEPING its weight/bias parameters (the optimizer state, master
+    weights and sharding placement survive the conversion untouched —
+    only the GEMM runs on fp8 operands). Amax histories live as
+    buffers, so ``to_static`` threads them and a compiled train step
+    carries the delayed-scaling state on device."""
+
+    def __init__(self, linear, history_len: int = 16):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self.history_len = int(history_len)
+        # two DISTINCT zero arrays: buffers thread through to_static
+        # with donate_state, and one shared buffer would be donated
+        # twice in a single compiled call
+        self.register_buffer("amax_history_x", Tensor(
+            jnp.zeros((self.history_len,), jnp.float32), _internal=True))
+        self.register_buffer("amax_history_w", Tensor(
+            jnp.zeros((self.history_len,), jnp.float32), _internal=True))
+
+    def _scale_from(self, hist, cur):
+        def _f(h, c):
+            hmax = jnp.max(h)
+            amax = jnp.where(hmax > 0, hmax, c)
+            return jnp.where(amax > 0, E4M3_MAX / amax, 1.0).astype(
+                jnp.float32)
+
+        return apply(_f, hist, cur, op_name="fp8_scale")
+
+    def forward(self, x):
+        with no_grad():
+            amax = lambda a: jnp.max(jnp.abs(a)).astype(jnp.float32)  # noqa: E731
+            cur_x = apply(amax, x, op_name="fp8_amax")
+            cur_w = apply(amax, self.weight, op_name="fp8_amax")
+            # delayed scaling: cast with the scale the HISTORY implies,
+            # THEN record this step's amax for future steps
+            xs = self._scale_from(self.amax_history_x, cur_x)
+            ws = self._scale_from(self.amax_history_w, cur_w)
+            if self.training:
+                roll = lambda h, c: jnp.concatenate([h[1:], c.reshape(1)])  # noqa: E731,E501
+                self.amax_history_x.set_value(apply(
+                    roll, self.amax_history_x, cur_x,
+                    op_name="fp8_amax_roll")._data)
+                self.amax_history_w.set_value(apply(
+                    roll, self.amax_history_w, cur_w,
+                    op_name="fp8_amax_roll")._data)
+        return fp8_linear(x, self.weight, self.bias,
+                          x_scale=xs, w_scale=ws)
+
+    def extra_repr(self):
+        return (f"in_features={self.weight.shape[0]}, "
+                f"out_features={self.weight.shape[1]}, fp8=e4m3/e5m2, "
+                f"history_len={self.history_len}")
+
+
+def convert_to_fp8(model, exclude=lambda name: False,
+                   history_len: int = 16) -> int:
+    """Swap every ``nn.Linear`` in ``model`` for an :class:`Fp8Linear`
+    sharing the same parameters (the convert_to_weight_only pattern).
+    Returns the number of layers converted. Typical exclusions: the
+    lm_head (its logits feed the loss — fp8 there costs measurable
+    perplexity for one GEMM of savings)."""
+    from ..nn.layer.common import Linear
+
+    n = 0
+    for name, sub in list(model.named_sublayers(include_self=False)):
+        if not isinstance(sub, Linear) or exclude(name):
+            continue
+        parent = model
+        parts = name.split(".")
+        for p in parts[:-1]:
+            parent = getattr(parent, p)
+        setattr(parent, parts[-1], Fp8Linear(sub, history_len=history_len))
+        n += 1
+    return n

@@ -442,6 +442,7 @@ class ContinuousBatchingEngine:
                  spec_decode_k: Optional[int] = None,
                  draft_proposer: Optional[DraftProposer] = None,
                  kv_dtype: Optional[str] = None,
+                 fp8: bool = False,
                  role: str = "unified",
                  overlap: bool = False):
         """``num_blocks`` fixes the HBM budget (the pool allocates one
@@ -596,6 +597,18 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
         self.kv_dtype = kv_dtype
+        # fp8 GEMMs: swap every Linear (except the lm_head — its logits
+        # feed sampling, where fp8 costs measurable quality for one GEMM
+        # of savings) for Fp8Linear BEFORE the programs compile. The
+        # payoff is prefill: its wide [tokens, d] x [d, 4d] GEMMs are
+        # MXU-bound, where fp8 doubles per-pass throughput; decode GEMMs
+        # are HBM-bound so fp8 halves the weight-stream bytes instead.
+        self.fp8 = bool(fp8)
+        if self.fp8:
+            from ..amp import convert_to_fp8
+
+            self.fp8_layers = convert_to_fp8(
+                model, exclude=lambda name: "lm_head" in name)
         self.spec_k = None if spec_decode_k is None else int(spec_decode_k)
         if self.spec_k is not None and self.spec_k < 1:
             raise ValueError(f"spec_decode_k must be >= 1, got {self.spec_k}")

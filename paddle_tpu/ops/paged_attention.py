@@ -842,6 +842,22 @@ def _largest_divisor(n: int, cap: int) -> int:
     return 1
 
 
+def _ratio_aware_pages_per_block(pages_per_seq: int, ratio: int) -> int:
+    """Pick ``pages_per_compute_block`` from the q-head:kv-head ratio.
+
+    The kernel's grid is (batch, kv_heads, page-chunks) and each
+    program multiplies a [ratio, d] query tile against its chunk's
+    [pages*bs, d] keys/values. At ratio >= 8 the MXU tile is full and
+    small chunks (8 pages) maximize grid parallelism — the measured
+    winning regime. BELOW that, each program's matmul underuses the
+    MXU and the per-page DMA steering dominates, so widen the chunk
+    inversely with the ratio (ratio 4 -> 16 pages, ratio 2 -> 32,
+    MHA -> 64): fewer programs, each amortizing its DMA setup across
+    proportionally more contraction work."""
+    cap = 8 * max(1, 8 // max(ratio, 1))
+    return _largest_divisor(pages_per_seq, cap)
+
+
 def paged_decode_attention(q, k_pool, v_pool, tables, cache_len,
                            contiguous: bool = False,
                            k_scale=None, v_scale=None):
@@ -853,22 +869,30 @@ def paged_decode_attention(q, k_pool, v_pool, tables, cache_len,
     its own cache_len+1 tokens).
 
     Path selection (MEASURED — 542M-class decode, B=8, P=1600, v5e,
-    same-session multi_step scans; ms/step):
+    same-session multi_step scans; ms/step; kernel column was measured
+    with the FIXED 8-page compute block):
 
     | q_heads/kv_heads | dense | reshape-view | Pallas kernel | gather |
     |---|---|---|---|---|
     | 1 (MHA)  | 3.13 | **2.80** | 8.29 | 3.55 |
-    | 4        | 2.88 | **2.68** | 2.78 | 3.22 |
+    | 4        | 2.88 | 2.68 | **2.78*** | 3.22 |
     | 8 (GQA)  | 1.92 | 2.06 | **1.49** | 2.54 |
 
     The kernel's grid is (batch, kv_heads, page-chunks): with few
     q-heads per kv-head each program does almost no compute and the
-    per-page DMA steering costs more than it saves, but at GQA ratios
-    >= ~8 it beats everything including the dense cache.
+    per-page DMA steering costs more than it saves. Ratio-aware block
+    shapes (``_ratio_aware_pages_per_block``) widen the page chunk
+    inversely with the ratio, so the ratio-4 row above (*fixed-block
+    number, 0.10 ms behind reshape-view) is the regime the widened
+    block targets; TPU re-measurement is the round-6 sweep (see
+    BASELINE.md). At ratios >= ~8 the kernel beats everything
+    including the dense cache.
 
     Policy:
     - contiguous tables: reshape to a dense view (free) unless the GQA
-      ratio >= 8 AND the kernel can tile (then the kernel wins).
+      ratio >= 4 AND the kernel can tile (then the ratio-aware-block
+      kernel wins; at ratio 4 the fixed-block kernel was already at
+      parity and the widened block removes the DMA-steering deficit).
     - RAGGED tables (BlockManager serving): ALWAYS the kernel when it
       can tile — the gather fallback materializes the full
       table-width padded view, which at serving shapes (position
@@ -896,7 +920,7 @@ def paged_decode_attention(q, k_pool, v_pool, tables, cache_len,
     # TPU tiling: kernel blocks are (page_size, head_dim) tiles
     if (
         platform == "tpu" and d % 128 == 0 and bs % 8 == 0
-        and (not contiguous or ratio >= 8)
+        and (not contiguous or ratio >= 4)
     ):
         from jax.experimental.pallas.ops.tpu.paged_attention import (
             paged_attention as _paged_attention_kernel,
@@ -920,7 +944,8 @@ def paged_decode_attention(q, k_pool, v_pool, tables, cache_len,
             q[:, 0] * scale,  # kernel applies no 1/sqrt(d) itself
             k_pages, v_pages,
             lengths, tables,
-            pages_per_compute_block=_largest_divisor(pages_per_seq, 8),
+            pages_per_compute_block=_ratio_aware_pages_per_block(
+                pages_per_seq, ratio),
         )
         return out[:, None]  # [B, 1, H, D]
     # contiguous: reshape-view (free); ragged: gathered padded view —

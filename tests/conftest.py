@@ -82,6 +82,11 @@ def pytest_configure(config):
         "(standalone via `pytest -m analysis`, < 60 s)")
     config.addinivalue_line(
         "markers",
+        "kernels: Pallas kernel numerics lane — fused-AdamW parity/"
+        "HBM-model + fp8 GEMM quality gates, interpret-mode on CPU "
+        "(standalone via `pytest -m kernels`)")
+    config.addinivalue_line(
+        "markers",
         "robustness: overload-control / chaos / self-healing serving "
         "suite (standalone via `pytest -m robustness`)")
     config.addinivalue_line(

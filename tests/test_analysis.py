@@ -361,6 +361,27 @@ class TestDonate001:
         """
         assert findings_for(src, "DONATE001") == []
 
+    def test_fused_optimizer_rebind_writeback_stays_clean(self):
+        """The fused-AdamW writeback idiom (optimizer._fused_update):
+        the kernel returns FRESH buffers and the caller rebinds the
+        param/accumulator slots — in-place-looking, but no read of a
+        donated original ever follows the compiled call."""
+        src = """
+        import jax
+
+        def kernel(p, g, m, v):
+            return p, m, v
+        fused = jax.jit(kernel, donate_argnums=(0, 2, 3))
+
+        def fused_update(p, g, m, v):
+            p_new, m_new, v_new = fused(p, g, m, v)
+            p = p_new                   # rebind: the NEW buffer
+            m = m_new
+            v = v_new
+            return p, m, v
+        """
+        assert findings_for(src, "DONATE001") == []
+
     def test_raw_function_in_loop_is_not_a_jit_wrapper(self):
         """`step = jax.jit(fn)` must not make eager `fn(...)` calls
         look compiled — the eager/reference-path idiom stays clean for

@@ -1,0 +1,178 @@
+"""fp8 delayed-scaling GEMMs (ISSUE 17 lever (b)).
+
+Quality contract, in the int8 rel-err test's style (its gate pinned
+0.031-class error for int8 weight-only): the fp8 linear's per-tensor
+relative error vs the float linear stays under 0.06 (measured 0.037 on
+a 256x256 layer — e4m3 keeps 3 mantissa bits), gradients flow through
+the custom VJP, and an fp8-converted tiny model's short loss curve
+tracks the bf16/f32 run (the convergence gate).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as popt
+from paddle_tpu.amp import Fp8Linear, convert_to_fp8, fp8_linear
+
+pytestmark = [pytest.mark.kernels, pytest.mark.quick]
+
+
+def _rel_err(a, b):
+    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    return np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-30)
+
+
+class TestFp8Linear:
+    def test_forward_quality_gate(self):
+        paddle.seed(0)
+        lin = nn.Linear(256, 256)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(64, 256).astype(np.float32))
+        ref = lin(x)
+        got = fp8_linear(x, lin.weight, lin.bias)
+        assert _rel_err(got.numpy(), ref.numpy()) < 0.06
+
+    def test_gradients_flow_and_track_reference(self):
+        paddle.seed(0)
+        lin = nn.Linear(64, 32)
+        rng = np.random.RandomState(2)
+        xnp = rng.randn(16, 64).astype(np.float32)
+
+        def grads(fp8):
+            lin.clear_gradients()
+            x = paddle.to_tensor(xnp)
+            y = (fp8_linear(x, lin.weight, lin.bias) if fp8
+                 else lin(x))
+            (y ** 2).mean().backward()
+            return [np.asarray(p.grad._data) for p in lin.parameters()]
+
+        gr, gq = grads(False), grads(True)
+        for a, b in zip(gq, gr):
+            assert a.shape == b.shape
+            assert np.isfinite(a).all()
+            # e5m2 grad cast: 2 mantissa bits — a loose tracking gate
+            assert _rel_err(a, b) < 0.12
+
+    def test_wrapper_keeps_parameters_and_rolls_history(self):
+        paddle.seed(0)
+        lin = nn.Linear(16, 8)
+        fl = Fp8Linear(lin, history_len=4)
+        assert fl.weight is lin.weight and fl.bias is lin.bias
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(4, 16).astype(np.float32))
+        fl.train()
+        fl(x)
+        hx = np.asarray(fl.amax_history_x._data)
+        assert hx[-1] > 0 and (hx[:-1] == 0).all()
+        fl(x)
+        hx2 = np.asarray(fl.amax_history_x._data)
+        assert hx2[-1] > 0 and hx2[-2] > 0
+        # eval mode: scales still derive from history, nothing recorded
+        fl.eval()
+        fl(x)
+        assert np.array_equal(np.asarray(fl.amax_history_x._data), hx2)
+
+    def test_convert_excludes_by_name(self):
+        paddle.seed(0)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.body = nn.Linear(8, 8)
+                self.lm_head = nn.Linear(8, 4)
+
+            def forward(self, x):
+                return self.lm_head(self.body(x))
+
+        m = M()
+        n = convert_to_fp8(m, exclude=lambda name: "lm_head" in name)
+        assert n == 1
+        assert isinstance(m.body, Fp8Linear)
+        assert isinstance(m.lm_head, nn.Linear)
+        x = paddle.to_tensor(
+            np.random.RandomState(4).randn(2, 8).astype(np.float32))
+        assert np.isfinite(np.asarray(m(x)._data)).all()
+
+
+class TestFp8Convergence:
+    """The convergence gate: an fp8-converted model's short training
+    run must track the float run — delayed scaling included (the
+    histories warm up from empty over the first steps)."""
+
+    def _run(self, fp8, steps=25):
+        paddle.seed(7)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 4))
+        if fp8:
+            assert convert_to_fp8(model) == 2
+        opt = popt.AdamW(learning_rate=1e-2,
+                         parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(32, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (32,)).astype(np.int64))
+        import paddle_tpu.nn.functional as F
+        losses = []
+        for _ in range(steps):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        return losses
+
+    def test_fp8_tracks_float_training(self):
+        ref = self._run(False)
+        fp8 = self._run(True)
+        assert fp8[-1] < ref[0] * 0.5          # it actually learns
+        assert fp8[-1] < ref[-1] + 0.25        # and lands near the ref
+
+    def test_compiled_step_threads_amax_state(self):
+        # to_static(donate_state=True default) must carry the amax
+        # histories on device — and each must be a distinct buffer
+        paddle.seed(7)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 4))
+        convert_to_fp8(model)
+        opt = popt.AdamW(learning_rate=1e-2,
+                         parameters=model.parameters())
+        import paddle_tpu.nn.functional as F
+
+        def body(x, y):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        compiled = paddle.jit.to_static(body, layers=[model],
+                                        optimizers=[opt])
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(32, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (32,)).astype(np.int64))
+        losses = [float(np.asarray(compiled(x, y)._data))
+                  for _ in range(8)]
+        assert losses[-1] < losses[0]
+        for name, buf in model.named_buffers():
+            if "amax_history" in name:
+                h = np.asarray(buf._data)
+                assert h[-1] > 0, f"{name} never recorded an amax"
+
+
+class TestEngineFp8:
+    def test_serving_engine_fp8_flag(self):
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        eng = ContinuousBatchingEngine(
+            model, max_batch=2, max_len=64, block_size=8, num_blocks=24,
+            prompt_pad=16, fp8=True)
+        assert eng.fp8_layers > 0
+        assert isinstance(model.lm_head, nn.Linear)  # excluded
+        rng = np.random.RandomState(0)
+        eng.add_request("a", rng.randint(0, 250, (5,)),
+                        max_new_tokens=4)
+        done = eng.run()
+        assert len(done["a"].out) == 4

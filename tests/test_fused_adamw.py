@@ -1,0 +1,405 @@
+"""Fused AdamW Pallas kernel (ISSUE 17 lever (a)).
+
+Numerics contract: with stochastic rounding OFF the kernel reproduces
+the reference ``AdamW._update_param`` math BIT-FOR-BIT against the
+JITTED reference expressions (both production paths run under jit —
+to_static compiles the train step, and interpret-mode pallas jits
+internally — and XLA CPU contracts ``b1*m + (1-b1)*g`` into an FMA
+under jit but not in eager dispatch, so the jitted reference is the
+honest comparison; the eager deviation is <= 1 ulp). With SR on, the
+writeback matches the reference lowbias32 hash element-for-element
+given the same salts.
+
+The HBM model: the kernel streams p/g/m/v through VMEM exactly once
+(read p+g+m+v, write p+m+v) vs the reference's op-boundary schedule —
+asserted >= 2x cheaper for every dtype combo, and handed to the
+compiler as ``pl.CostEstimate``.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.amp as amp
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as popt
+from paddle_tpu.ops.fused_adamw import (
+    fused_adamw_hbm_bytes,
+    fused_adamw_update,
+    unfused_adamw_hbm_bytes,
+)
+
+pytestmark = [pytest.mark.kernels, pytest.mark.quick]
+
+LR, B1, B2, EPS = 1e-2, 0.9, 0.999, 1e-8
+
+
+def _ref_update(p, g, m, v, *, lr, wd, b1p, b2p, m_store):
+    """The reference AdamW._update_param expressions, verbatim
+    (beta pows already advanced — matching the kernel's contract)."""
+    g32 = g.astype(jnp.float32)
+    m32 = m.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    m_new = B1 * m32 + (1 - B1) * g32
+    v_new = B2 * v32 + (1 - B2) * g32 * g32
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    delta = lr_t * m_new / (jnp.sqrt(v_new) + EPS * jnp.sqrt(1 - b2p))
+    new = p.astype(jnp.float32) * (1.0 - lr * wd) - delta
+    return new.astype(p.dtype), m_new.astype(m_store), v_new.astype(m_store)
+
+
+def _ref_sr(x32, salts):
+    """_stochastic_round_bf16's hash with pinned salts (C-order iota)."""
+    u = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+    i = jax.lax.iota(jnp.uint32, x32.size).reshape(x32.shape)
+    b = i * jnp.uint32(0x9E3779B9) + salts[0]
+    b = (b ^ (b >> 16)) * jnp.uint32(0x7FEB352D)
+    b = (b ^ (b >> 15)) * jnp.uint32(0x846CA68B)
+    b = (b ^ (b >> 16)) + salts[1]
+    r = jax.lax.bitcast_convert_type(
+        (u + (b & jnp.uint32(0xFFFF))) & jnp.uint32(0xFFFF0000),
+        jnp.float32)
+    return jnp.where(jnp.isfinite(x32), r, x32).astype(jnp.bfloat16)
+
+
+def _inputs(shape, p_dtype, m_dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    p = jnp.asarray(rng.randn(*shape), p_dtype)
+    g = jnp.asarray(0.1 * rng.randn(*shape), p_dtype)
+    m = jnp.asarray(0.01 * rng.randn(*shape), m_dtype)
+    v = jnp.asarray(0.01 * rng.rand(*shape), m_dtype)
+    return p, g, m, v
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("p_dtype,m_dtype", [
+        (jnp.float32, jnp.float32),
+        (jnp.float32, jnp.bfloat16),
+        (jnp.bfloat16, jnp.bfloat16),
+        (jnp.bfloat16, jnp.float32),
+    ], ids=["f32", "f32-m_bf16", "bf16", "bf16-m_f32"])
+    @pytest.mark.parametrize("wd", [0.0, 0.01], ids=["wd0", "wd.01"])
+    def test_bitwise_vs_jitted_reference(self, p_dtype, m_dtype, wd):
+        # (37, 19): 703 elements — exercises the lane-grid zero padding
+        p, g, m, v = _inputs((37, 19), p_dtype, m_dtype)
+        # beta pows are f32 accumulators in production: round FIRST
+        # (python-f64 scalars here would change 1-b1p by half an ulp)
+        b1p = jnp.asarray(B1 ** 3, jnp.float32)  # step 3
+        b2p = jnp.asarray(B2 ** 3, jnp.float32)
+        got = fused_adamw_update(
+            p, g, m, v, lr=LR, beta1=B1, beta2=B2, epsilon=EPS,
+            beta1_pow=b1p, beta2_pow=b2p, weight_decay=wd)
+        ref = jax.jit(functools.partial(
+            _ref_update, lr=LR, wd=wd, b1p=b1p, b2p=b2p,
+            m_store=m_dtype))(p, g, m, v)
+        for a, b, name in zip(got, ref, "pmv"):
+            assert a.dtype == b.dtype, name
+            np.testing.assert_array_equal(
+                np.asarray(a).view(np.uint8), np.asarray(b).view(np.uint8),
+                err_msg=f"{name} not bitwise-identical")
+
+    def test_multi_tile_grid_bitwise(self):
+        # 39000 elements -> 305 rows -> bt=256, grid=(2,): the tile
+        # index offset must keep the flat-index bookkeeping exact
+        p, g, m, v = _inputs((300, 130), jnp.float32, jnp.float32)
+        b1p = jnp.asarray(B1, jnp.float32)
+        b2p = jnp.asarray(B2, jnp.float32)
+        got = fused_adamw_update(
+            p, g, m, v, lr=LR, beta1=B1, beta2=B2, epsilon=EPS,
+            beta1_pow=b1p, beta2_pow=b2p)
+        ref = jax.jit(functools.partial(
+            _ref_update, lr=LR, wd=0.0, b1p=b1p, b2p=b2p,
+            m_store=jnp.float32))(p, g, m, v)
+        for a, b in zip(got, ref):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_sr_writeback_matches_reference_hash(self):
+        # multi-tile shape: the global flat index the in-kernel hash
+        # sees (tile*bt*128 + row*128 + lane) must equal the
+        # reference's C-order iota over the unflattened array
+        salts = jnp.asarray([0xDEADBEEF, 0x12345678], jnp.uint32)
+        b1p = jnp.asarray(B1, jnp.float32)
+        b2p = jnp.asarray(B2, jnp.float32)
+        p, g, m, v = _inputs((300, 130), jnp.bfloat16, jnp.bfloat16)
+        got_p, _, _ = fused_adamw_update(
+            p, g, m, v, lr=LR, beta1=B1, beta2=B2, epsilon=EPS,
+            beta1_pow=b1p, beta2_pow=b2p, weight_decay=0.01,
+            sr_salts=salts)
+
+        def ref(p, g, m, v):
+            new, _, _ = _ref_update(p, g, m, v, lr=LR, wd=0.01,
+                                    b1p=B1, b2p=B2, m_store=jnp.float32)
+            # reference rounds the pre-cast f32 value
+            g32 = g.astype(jnp.float32)
+            m_new = B1 * m.astype(jnp.float32) + (1 - B1) * g32
+            v_new = B2 * v.astype(jnp.float32) + (1 - B2) * g32 * g32
+            lr_t = LR * jnp.sqrt(1 - b2p) / (1 - b1p)
+            d = lr_t * m_new / (jnp.sqrt(v_new) + EPS * jnp.sqrt(1 - b2p))
+            x32 = p.astype(jnp.float32) * (1.0 - LR * 0.01) - d
+            return _ref_sr(x32, salts)
+
+        ref_p = jax.jit(ref)(p, g, m, v)
+        np.testing.assert_array_equal(
+            np.asarray(got_p).view(np.uint8),
+            np.asarray(ref_p).view(np.uint8))
+
+    def test_sr_deterministic_and_salt_sensitive(self):
+        p, g, m, v = _inputs((64, 64), jnp.bfloat16, jnp.bfloat16)
+        kw = dict(lr=LR, beta1=B1, beta2=B2, epsilon=EPS,
+                  beta1_pow=B1, beta2_pow=B2)
+        s1 = jnp.asarray([1, 2], jnp.uint32)
+        a, _, _ = fused_adamw_update(p, g, m, v, sr_salts=s1, **kw)
+        b, _, _ = fused_adamw_update(p, g, m, v, sr_salts=s1, **kw)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c, _, _ = fused_adamw_update(
+            p, g, m, v, sr_salts=jnp.asarray([3, 4], jnp.uint32), **kw)
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_sr_requires_bf16(self):
+        p, g, m, v = _inputs((8, 8), jnp.float32, jnp.float32)
+        with pytest.raises(ValueError, match="bf16"):
+            fused_adamw_update(
+                p, g, m, v, lr=LR, beta1=B1, beta2=B2, epsilon=EPS,
+                beta1_pow=B1, beta2_pow=B2,
+                sr_salts=jnp.zeros((2,), jnp.uint32))
+
+    def test_skip_veto_returns_inputs_bitwise(self):
+        for salts in (None, jnp.asarray([9, 9], jnp.uint32)):
+            p, g, m, v = _inputs((33, 7), jnp.bfloat16, jnp.bfloat16)
+            out = fused_adamw_update(
+                p, g, m, v, lr=LR, beta1=B1, beta2=B2, epsilon=EPS,
+                beta1_pow=B1, beta2_pow=B2, sr_salts=salts,
+                skip=jnp.asarray(True))
+            for a, b in zip(out, (p, m, v)):
+                np.testing.assert_array_equal(
+                    np.asarray(a).view(np.uint8),
+                    np.asarray(b).view(np.uint8))
+
+    def test_empty_param_noop(self):
+        p = jnp.zeros((0,), jnp.float32)
+        out = fused_adamw_update(
+            p, p, p, p, lr=LR, beta1=B1, beta2=B2, epsilon=EPS,
+            beta1_pow=B1, beta2_pow=B2)
+        assert all(o.size == 0 for o in out)
+
+
+class TestHbmModel:
+    @pytest.mark.parametrize("p_dtype,m_dtype", [
+        (jnp.float32, jnp.float32),
+        (jnp.float32, jnp.bfloat16),
+        (jnp.bfloat16, jnp.bfloat16),
+    ], ids=["f32", "f32-m_bf16", "bf16"])
+    def test_fused_at_least_2x_cheaper(self, p_dtype, m_dtype):
+        n = 1 << 20
+        fused = fused_adamw_hbm_bytes(n, p_dtype, p_dtype, m_dtype)
+        unfused = unfused_adamw_hbm_bytes(n, p_dtype, p_dtype, m_dtype)
+        assert fused * 2 <= unfused, (fused, unfused)
+
+    def test_model_matches_one_streamed_pass(self):
+        # one read of p/g/m/v + one write of p/m/v, nothing else
+        n = 1000
+        assert fused_adamw_hbm_bytes(
+            n, jnp.float32, jnp.float32, jnp.float32) == n * 4 * 7
+        assert fused_adamw_hbm_bytes(
+            n, jnp.bfloat16, jnp.bfloat16, jnp.bfloat16) == n * 2 * 7
+
+    @pytest.mark.skipif(jax.devices()[0].platform != "tpu",
+                        reason="pl.CostEstimate is only authoritative on "
+                               "the TPU compile path (interpret mode "
+                               "lowers to plain XLA ops)")
+    def test_cost_analysis_reports_the_model(self):  # pragma: no cover
+        n = 256 * 128
+        p = jnp.ones((n,), jnp.float32)
+        f = jax.jit(functools.partial(
+            fused_adamw_update, lr=LR, beta1=B1, beta2=B2, epsilon=EPS,
+            beta1_pow=B1, beta2_pow=B2))
+        c = f.lower(p, p, p, p).compile().cost_analysis()
+        c = c[0] if isinstance(c, list) else c
+        model = fused_adamw_hbm_bytes(n, jnp.float32, jnp.float32,
+                                      jnp.float32)
+        assert abs(c["bytes accessed"] - model) <= 0.25 * model
+
+    def test_interpret_path_traffic_bounded(self):
+        # CPU sanity: the interpret lowering (pad/reshape round trips
+        # included) must stay within a small multiple of the model —
+        # a second streamed pass sneaking into the kernel would blow
+        # straight through this bound (measured ~3.9x on jax 0.4.37)
+        n = 1000
+        p = jnp.ones((n,), jnp.float32)
+        f = jax.jit(functools.partial(
+            fused_adamw_update, lr=LR, beta1=B1, beta2=B2, epsilon=EPS,
+            beta1_pow=B1, beta2_pow=B2, interpret=True))
+        c = f.lower(p, p, p, p).compile().cost_analysis()
+        c = c[0] if isinstance(c, list) else c
+        model = fused_adamw_hbm_bytes(n, jnp.float32, jnp.float32,
+                                      jnp.float32)
+        assert c["bytes accessed"] <= 8 * model
+
+
+def _train(fused, steps=10, interleave=False, scaler=None, seed=3,
+           **adamw_kw):
+    paddle.seed(seed)
+    m = nn.Linear(8, 8)
+    o = popt.AdamW(learning_rate=1e-2, parameters=m.parameters(),
+                   weight_decay=0.01, fused=fused,
+                   interleave_updates=interleave, **adamw_kw)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(16, 8).astype(np.float32))
+    loss = None
+    for _ in range(steps):
+        loss = (m(x) ** 2).mean()
+        if scaler is not None:
+            scaler.scale(loss).backward()
+            scaler.step(o)
+            scaler.update()
+        else:
+            loss.backward()
+            o.step()
+        o.clear_grad()
+    return ([np.asarray(p._data) for p in m.parameters()],
+            float(np.asarray(loss._data)))
+
+
+class TestFusedOptimizerBackend:
+    def test_tracks_reference_training(self):
+        # eager reference vs fused (interpret jits internally): the only
+        # deviation is XLA's jit-time FMA contraction, <= 1 ulp/step
+        pr, lr_ = _train(False)
+        pf, lf = _train(True)
+        for a, b in zip(pr, pf):
+            np.testing.assert_allclose(a, b, atol=5e-6)
+        assert abs(lr_ - lf) < 1e-6
+
+    def test_moment_dtype_bf16_tracks_reference(self):
+        pr, _ = _train(False, moment_dtype="bfloat16")
+        pf, _ = _train(True, moment_dtype="bfloat16")
+        for a, b in zip(pr, pf):
+            np.testing.assert_allclose(a, b, atol=5e-5)
+
+    def test_multi_precision_master_weights(self):
+        pr, _ = _train(False, multi_precision=True)
+        pf, _ = _train(True, multi_precision=True)
+        for a, b in zip(pr, pf):
+            np.testing.assert_allclose(a, b, atol=5e-6)
+
+    def test_sr_deterministic_under_fixed_seed(self):
+        def run():
+            paddle.seed(11)
+            m = nn.Linear(8, 8)
+            m.bfloat16()
+            o = popt.AdamW(learning_rate=1e-2,
+                           parameters=m.parameters(), fused=True,
+                           use_stochastic_rounding=True)
+            x = paddle.to_tensor(np.random.RandomState(0)
+                                 .randn(16, 8).astype(np.float32))
+            for _ in range(5):
+                loss = (m(x.astype("bfloat16")) ** 2).mean()
+                loss.backward()
+                o.step()
+                o.clear_grad()
+            return [np.asarray(p._data) for p in m.parameters()]
+
+        a, b = run(), run()
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x.view(np.uint8),
+                                          y.view(np.uint8))
+
+    def test_compiled_step_with_donated_state(self):
+        # to_static defaults to donate_state=True: the fused backend's
+        # accumulator writebacks must be donation-safe (distinct
+        # buffers, no aliased reuse of a donated input)
+        paddle.seed(5)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        o = popt.AdamW(learning_rate=1e-2, parameters=model.parameters(),
+                       fused=True)
+
+        def body(x, y):
+            import paddle_tpu.nn.functional as F
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return loss
+
+        compiled = paddle.jit.to_static(body, layers=[model],
+                                        optimizers=[o])
+        rng = np.random.RandomState(7)
+        x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (8,)).astype(np.int64))
+        losses = [float(np.asarray(compiled(x, y)._data))
+                  for _ in range(6)]
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+
+class TestScalerFusedInterleave:
+    """GradScaler x interleave_updates seam: fused=True is the one
+    interleaved configuration the scaler accepts — the kernel's
+    found-inf veto plus the scaler's snapshot rollback keep a skipped
+    step bitwise clean even though updates land DURING backward."""
+
+    def test_finite_path_matches_unscaled_reference(self):
+        pr, lr_ = _train(False)
+        sc = amp.GradScaler(init_loss_scaling=2.0 ** 10)
+        pi, li = _train(True, interleave=True, scaler=sc)
+        for a, b in zip(pr, pi):
+            np.testing.assert_allclose(a, b, atol=5e-6)
+        assert abs(lr_ - li) < 1e-6
+
+    def test_inf_grad_leaves_params_bitwise_untouched(self):
+        paddle.seed(3)
+        m = nn.Linear(8, 8)
+        o = popt.AdamW(learning_rate=1e-2, parameters=m.parameters(),
+                       fused=True, interleave_updates=True)
+        sc = amp.GradScaler(init_loss_scaling=2.0 ** 10)
+        before = [np.asarray(p._data).copy() for p in m.parameters()]
+        # chaos-shaped injection: the batch itself is poisoned, so the
+        # inf appears mid-backward — after some layers may already
+        # have seen their (vetoed or rolled-back) fused update
+        x = paddle.to_tensor(np.full((4, 8), np.inf, np.float32))
+        loss = (m(x) ** 2).mean()
+        sc.scale(loss).backward()
+        sc.step(o)
+        sc.update()
+        o.clear_grad()
+        after = [np.asarray(p._data) for p in m.parameters()]
+        for a, b in zip(before, after):
+            np.testing.assert_array_equal(a.view(np.uint8),
+                                          b.view(np.uint8))
+        assert sc.n_skipped_steps == 1
+
+    def test_recovers_after_skipped_step(self):
+        paddle.seed(3)
+        m = nn.Linear(8, 8)
+        o = popt.AdamW(learning_rate=1e-2, parameters=m.parameters(),
+                       fused=True, interleave_updates=True)
+        sc = amp.GradScaler(init_loss_scaling=2.0 ** 10)
+        bad = paddle.to_tensor(np.full((4, 8), np.inf, np.float32))
+        good = paddle.to_tensor(
+            np.random.RandomState(0).randn(16, 8).astype(np.float32))
+        losses = []
+        for i in range(6):
+            x = bad if i == 0 else good
+            loss = (m(x) ** 2).mean()
+            sc.scale(loss).backward()
+            sc.step(o)
+            sc.update()
+            o.clear_grad()
+            if i > 0:
+                losses.append(float(np.asarray(loss._data)))
+        assert sc.n_skipped_steps == 1
+        assert losses[-1] < losses[0]
+
+    def test_non_fused_interleave_still_refused(self):
+        paddle.seed(3)
+        m = nn.Linear(4, 4)
+        o = popt.AdamW(learning_rate=1e-2, parameters=m.parameters(),
+                       interleave_updates=True)
+        assert o._interleave  # keep the registry weakref alive
+        sc = amp.GradScaler()
+        with pytest.raises(ValueError, match="interleave_updates"):
+            sc.scale(paddle.to_tensor(np.float32(1.0)))
