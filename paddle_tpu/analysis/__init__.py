@@ -15,7 +15,11 @@ layer (``interproc.py``, on by default; ``--no-interprocedural``
 disables): COLL002 cross-function collective-schedule divergence,
 COLL003 cross-function send/recv peer mismatch, DDL002 un-threaded
 Deadline propagation, all computed over a project-wide call graph with
-per-function effect summaries. Suppress per file with
+per-function effect summaries — and the graft-race thread-safety
+layer (``threads.py``, same machinery): RACE001 guarded-by inference
+(write to a lock-guarded attribute reachable from a thread entrypoint
+without the lock), LOCK001 lock-acquisition-order cycles, LOCK002
+blocking while holding a hot-path lock. Suppress per file with
 ``# graft-lint: disable=RULE``; absorb existing debt with the
 committed ``baseline.json`` (regenerate via ``--write-baseline``).
 
@@ -24,7 +28,11 @@ compile budget; :func:`collective_contract` cross-checks the
 collective flight recorder's per-rank schedules and raises
 :class:`CollectiveScheduleMismatch` naming every rank's last-N
 schedule (see ``sanitizers.py`` and
-``distributed/communication/flight_recorder.py``).
+``distributed/communication/flight_recorder.py``); the graft-race
+lock sanitizer (``utils/locks.py``, re-exported via ``sanitizers``)
+traces per-thread held-lock sets, raises :class:`LockOrderViolation`
+naming both stacks on an inverted acquisition order, and renders
+every thread's held locks into CommWatchdog hang dumps.
 """
 from .core import (  # noqa: F401
     Finding,
@@ -47,6 +55,18 @@ from .sanitizers import (  # noqa: F401
     recompile_guard,
 )
 
+_LAZY = ("LockOrderViolation", "TracedLock", "instrument_locks",
+         "uninstrument_locks")
+
+
+def __getattr__(name: str):
+    if name in _LAZY:  # lazy: sanitizers resolves them from utils.locks
+        from . import sanitizers as _s
+
+        return getattr(_s, name)
+    raise AttributeError(name)
+
+
 __all__ = [
     "Finding",
     "Rule",
@@ -64,4 +84,8 @@ __all__ = [
     "RecompileGuard",
     "collective_contract",
     "recompile_guard",
+    "LockOrderViolation",
+    "TracedLock",
+    "instrument_locks",
+    "uninstrument_locks",
 ]

@@ -153,6 +153,7 @@ def all_rules() -> Dict[str, Rule]:
     # importing registers the rules (module scope, then project scope)
     from . import rules  # noqa: F401
     from . import interproc  # noqa: F401
+    from . import threads  # noqa: F401
     return dict(_RULES)
 
 

@@ -77,6 +77,11 @@ __all__ = [
     "cache_stats",
     "MAX_SCHEDULES",
     "MAX_SCHEDULE_LEN",
+    "AcqEffect",
+    "RelEffect",
+    "AccessEffect",
+    "SpawnEffect",
+    "SleepEffect",
 ]
 
 # Expansion budgets: each rank-conditional fork inside a CALLEE doubles
@@ -186,6 +191,62 @@ class LoopEffect:
 
 
 @dataclass(frozen=True)
+class AcqEffect:
+    """``with <lock>:`` entry — the lock-ish context expression as
+    written (``self._mu``, ``CommWatchdog._lock``). Emitted FLAT into
+    the enclosing effect list, paired with a RelEffect after the body's
+    effects, so held-set walks need no new nesting."""
+
+    qual: str
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class RelEffect:
+    qual: str
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class AccessEffect:
+    """A ``self.<attr>`` attribute access (``write`` for Store/Del
+    context). Only direct attribute loads/stores — ``self.d[k] = v``
+    is a READ of ``d`` (the dict mutates, the binding doesn't), which
+    keeps RACE001's guarded-by tally anchored on rebindings."""
+
+    attr: str
+    write: bool
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class SpawnEffect:
+    """``threading.Thread(target=f)`` / ``threading.Timer(t, f)`` —
+    ``f`` becomes a thread entrypoint: it starts on a fresh stack with
+    an EMPTY held-lock set."""
+
+    name: str  # tail name of the spawned target
+    self_call: bool
+    has_receiver: bool
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class SleepEffect:
+    """A literal-argument ``time.sleep`` OUTSIDE a loop (in-loop
+    sleeps stay BlockEffect 'sleep-poll loop'). LOCK002 compares
+    ``seconds`` against its threshold."""
+
+    seconds: float
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
 class FunctionSummary:
     name: str
     path: str
@@ -196,6 +257,8 @@ class FunctionSummary:
     deadline_param_pos: int
     mentions_deadline: bool
     sets_timeout: bool
+    cls: str = ""  # innermost enclosing class name ("" at module level)
+    bases: Tuple[str, ...] = ()  # that class's base-class dotted names
     effects: Tuple = ()
 
     def fid(self) -> Tuple[str, int, str]:
@@ -228,6 +291,16 @@ def _effect_to_json(e):
     if isinstance(e, LoopEffect):
         return ["O", e.line, e.col,
                 [_effect_to_json(x) for x in e.body]]
+    if isinstance(e, AcqEffect):
+        return ["Q", e.qual, e.line, e.col]
+    if isinstance(e, RelEffect):
+        return ["E", e.qual, e.line, e.col]
+    if isinstance(e, AccessEffect):
+        return ["A", e.attr, e.write, e.line, e.col]
+    if isinstance(e, SpawnEffect):
+        return ["S", e.name, e.self_call, e.has_receiver, e.line, e.col]
+    if isinstance(e, SleepEffect):
+        return ["Z", e.seconds, e.line, e.col]
     raise TypeError(type(e))
 
 
@@ -250,6 +323,16 @@ def _effect_from_json(d):
     if tag == "O":
         return LoopEffect(d[1], d[2],
                           tuple(_effect_from_json(x) for x in d[3]))
+    if tag == "Q":
+        return AcqEffect(d[1], d[2], d[3])
+    if tag == "E":
+        return RelEffect(d[1], d[2], d[3])
+    if tag == "A":
+        return AccessEffect(d[1], bool(d[2]), d[3], d[4])
+    if tag == "S":
+        return SpawnEffect(d[1], bool(d[2]), bool(d[3]), d[4], d[5])
+    if tag == "Z":
+        return SleepEffect(float(d[1]), d[2], d[3])
     raise ValueError(tag)
 
 
@@ -265,6 +348,7 @@ def _file_to_json(fs: FileSummary):
                 "deadline_param_pos": f.deadline_param_pos,
                 "mentions_deadline": f.mentions_deadline,
                 "sets_timeout": f.sets_timeout,
+                "cls": f.cls, "bases": list(f.bases),
                 "effects": [_effect_to_json(e) for e in f.effects],
             }
             for f in fs.functions
@@ -283,6 +367,7 @@ def _file_from_json(d) -> FileSummary:
                 deadline_param_pos=f["deadline_param_pos"],
                 mentions_deadline=f["mentions_deadline"],
                 sets_timeout=f["sets_timeout"],
+                cls=f.get("cls", ""), bases=tuple(f.get("bases", ())),
                 effects=tuple(_effect_from_json(e) for e in f["effects"]),
             )
             for f in d["functions"]
@@ -354,6 +439,29 @@ def _hard_bounds(call: ast.Call) -> bool:
     return False
 
 
+_LOCKISH = re.compile(
+    r"(^|_)(lock|locks|mutex|mu|guard|rlock|sem|cv|cond|condition)\d*$",
+    re.I)
+
+
+def _lock_qual(expr: ast.AST) -> Optional[str]:
+    """The dotted text of a lock-ish ``with`` item (``self._mu``,
+    ``Cls._lock``, a bare ``lock``), or None for non-lock context
+    managers. Name-based on the TAIL component, same contract as the
+    rest of the analyzer: false negatives over false positives."""
+    d = dotted_name(expr)
+    if d is None:
+        return None
+    return d if _LOCKISH.search(d.split(".")[-1]) else None
+
+
+def _literal_number(node: Optional[ast.AST]) -> Optional[float]:
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)) and not isinstance(node.value, bool):
+        return float(node.value)
+    return None
+
+
 def _rank_literal(test: ast.AST) -> Tuple[Optional[int], bool]:
     """(K, eq_in_body) for `rank ==/!= K` tests; (None, True) else."""
     if isinstance(test, ast.Compare) and len(test.ops) == 1:
@@ -373,9 +481,12 @@ def _rank_literal(test: ast.AST) -> Tuple[Optional[int], bool]:
 class _FnSummarizer:
     """Builds one FunctionSummary from an ast.FunctionDef."""
 
-    def __init__(self, fndef: ast.AST, path: str):
+    def __init__(self, fndef: ast.AST, path: str, cls: str = "",
+                 bases: Tuple[str, ...] = ()):
         self.fndef = fndef
         self.path = path
+        self.cls = cls
+        self.bases = bases
         self.sets_timeout = False
 
     def run(self) -> FunctionSummary:
@@ -389,7 +500,8 @@ class _FnSummarizer:
             params=tuple(params), deadline_param=dl_param,
             deadline_param_pos=dl_pos,
             mentions_deadline=_mentions_deadline(self.fndef),
-            sets_timeout=self.sets_timeout, effects=effects)
+            sets_timeout=self.sets_timeout, cls=self.cls,
+            bases=self.bases, effects=effects)
 
     @staticmethod
     def _deadline_param(args: ast.arguments,
@@ -464,6 +576,25 @@ class _FnSummarizer:
                             is_rank=False))
                 out.extend(self._stmts(stmt.orelse, in_loop))
                 out.extend(self._stmts(stmt.finalbody, in_loop))
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                # lock-ish items become FLAT Acq/Rel markers around the
+                # body's effects (the body runs exactly once, so no fork
+                # or nesting is needed); non-lock items keep the old
+                # behavior — header effects then body effects inline
+                acquired: List[str] = []
+                for item in stmt.items:
+                    out.extend(self._expr_effects(item, in_loop))
+                    qual = _lock_qual(item.context_expr)
+                    if qual is not None:
+                        out.append(AcqEffect(
+                            qual, item.context_expr.lineno,
+                            item.context_expr.col_offset + 1))
+                        acquired.append(qual)
+                out.extend(self._stmts(stmt.body, in_loop))
+                for qual in reversed(acquired):
+                    out.append(RelEffect(
+                        qual, stmt.lineno, stmt.col_offset + 1))
                 continue
             if hasattr(ast, "Match") and isinstance(stmt, ast.Match):
                 # each arm is an alternative continuation: fork every
@@ -542,6 +673,11 @@ class _FnSummarizer:
                 eff = self._classify(n, in_loop)
                 if eff is not None:
                     acc.append(eff)
+            elif isinstance(n, ast.Attribute) and isinstance(
+                    n.value, ast.Name) and n.value.id in ("self", "cls"):
+                acc.append(AccessEffect(
+                    n.attr, isinstance(n.ctx, (ast.Store, ast.Del)),
+                    n.lineno, n.col_offset + 1))
 
         out: List = []
         visit(node, out)
@@ -570,6 +706,29 @@ class _FnSummarizer:
             return P2PEffect("send", _peer_of(call, tail), line, col)
         if tail in _RECV_TAILS and (distish or tail in _UNAMBIGUOUS_P2P):
             return P2PEffect("recv", _peer_of(call, tail), line, col)
+
+        if tail in ("Thread", "Timer"):
+            # the spawned target runs on a fresh stack: a thread
+            # ENTRYPOINT for the race rules. Only statically named
+            # targets resolve (lambdas/partials stay invisible).
+            target = call_keyword(call, "target") or call_keyword(
+                call, "function")
+            if target is None and tail == "Timer" and len(call.args) > 1:
+                target = call.args[1]
+            td = dotted_name(target) if target is not None else None
+            if td is not None:
+                tprefix = td.rsplit(".", 1)[0] if "." in td else ""
+                return SpawnEffect(
+                    name=td.split(".")[-1],
+                    self_call=(tprefix.split(".")[0] == "self"
+                               if tprefix else False),
+                    has_receiver=bool(tprefix), line=line, col=col)
+            return None
+
+        if d in ("time.sleep", "sleep") and not in_loop and call.args:
+            secs = _literal_number(call.args[0])
+            if secs is not None:
+                return SleepEffect(secs, line, col)
 
         blocked = self._blocking(call, d, tail, prefix, in_loop)
         if blocked is not None:
@@ -632,9 +791,23 @@ def summarize_source(src: str, path: str,
     if tree is None:
         tree = ast.parse(src)
     fns: List[FunctionSummary] = []
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            fns.append(_FnSummarizer(node, path).run())
+
+    def collect(node: ast.AST, cls: str, bases: Tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                cb = tuple(b for b in (dotted_name(x)
+                                       for x in child.bases) if b)
+                collect(child, child.name, cb)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                fns.append(_FnSummarizer(child, path, cls, bases).run())
+                # defs nested in a method keep the class context (a
+                # closure over `self` still touches the same object)
+                collect(child, cls, bases)
+            else:
+                collect(child, cls, bases)
+
+    collect(tree, "", ())
     fns.sort(key=lambda f: (f.line, f.col))
     return FileSummary(path=path,
                        imports_retries=_module_imports_retries(tree),
@@ -644,7 +817,7 @@ def summarize_source(src: str, path: str,
 # ---------------------------------------------------------------------------
 # Summary cache: in-memory keyed by (path, mtime, size) + JSON disk tier
 
-_CACHE_VERSION = 5  # bump when the summary/effect shapes change
+_CACHE_VERSION = 6  # bump when the summary/effect shapes change
 # (hits, misses) observable by tests; misses == real summarize runs
 _cache_stats = {"hits": 0, "misses": 0}
 _mem_cache: Dict[str, Tuple[float, int, FileSummary]] = {}
@@ -715,7 +888,8 @@ def _rebind_path(fs: FileSummary, path: str) -> FileSummary:
                 params=f.params, deadline_param=f.deadline_param,
                 deadline_param_pos=f.deadline_param_pos,
                 mentions_deadline=f.mentions_deadline,
-                sets_timeout=f.sets_timeout, effects=f.effects)
+                sets_timeout=f.sets_timeout, cls=f.cls, bases=f.bases,
+                effects=f.effects)
             for f in fs.functions))
 
 

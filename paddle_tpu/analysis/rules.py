@@ -53,6 +53,19 @@ OBS003    warning  alert-rule series reference built dynamically — an
                    evaluates against a series that never exists and the
                    alert silently never fires — predicates must
                    reference series by literal name)
+RACE001   error    (threads.py, project scope) write to a lock-guarded
+                   class attribute — guard inferred from the majority
+                   of writes under ``with self._lock:`` — reachable
+                   from a thread entrypoint without the lock held
+LOCK001   error    (threads.py, project scope) lock-acquisition-order
+                   cycle over nested ``with lock:`` regions, resolved
+                   through the project call graph — a potential
+                   deadlock; the runtime twin is
+                   ``utils.locks.TracedLock``
+LOCK002   warning  (threads.py, project scope) blocking call while
+                   holding a lock the inference hot path
+                   (step/pump/harvest) also takes — serving steps
+                   stall behind the cold thread's wait
 ========= ======== ====================================================
 
 All rules are intraprocedural and name-based — modular by design

@@ -19,6 +19,17 @@ last-N schedule — when they diverge. What the static rules prove
 impossible on the analyzable call graph, the contract catches at test
 time, and the CommWatchdog dumps at hang time.
 
+The lock-order sanitizer (graft-race's runtime half, the dynamic
+companion to RACE001/LOCK001/LOCK002) lives in
+``paddle_tpu/utils/locks.py`` and is RE-EXPORTED here lazily:
+:class:`TracedLock` records per-thread held-lock sets and acquisition
+sites, maintains the runtime lock-order graph, and raises
+:class:`LockOrderViolation` naming both stacks the moment two locks
+are taken in inverted order; :func:`instrument_locks` patches the
+``threading.Lock``/``RLock`` factories so a whole process runs under
+it, and a ``flight_recorder.register_dump_extra`` hook renders every
+thread's held locks into CommWatchdog/supervisor hang dumps.
+
 Implementation: jax logs one "Compiling <name> with global shapes and
 types [...]" record per XLA compilation (module ``jax._src.
 interpreters.pxla``, DEBUG level unless jax_log_compiles is set). The
@@ -39,7 +50,23 @@ from typing import List, Optional
 
 __all__ = ["CompileEvent", "RecompileError", "RecompileGuard",
            "recompile_guard", "CollectiveScheduleMismatch",
-           "collective_contract", "COMPILE_LOGGERS", "COMPILING_RE"]
+           "collective_contract", "COMPILE_LOGGERS", "COMPILING_RE",
+           "LockOrderViolation", "TracedLock", "instrument_locks",
+           "uninstrument_locks"]
+
+_LOCK_SANITIZER_API = ("LockOrderViolation", "TracedLock",
+                       "instrument_locks", "uninstrument_locks")
+
+
+def __getattr__(name: str):
+    # the lock sanitizer lives in utils/locks.py (stdlib-only, usable
+    # without the analysis package); re-exported lazily so importing
+    # the analyzer never drags paddle_tpu.utils in, and vice versa
+    if name in _LOCK_SANITIZER_API:
+        from ..utils import locks as _locks
+
+        return getattr(_locks, name)
+    raise AttributeError(name)
 
 
 class CollectiveScheduleMismatch(AssertionError):

@@ -1,0 +1,477 @@
+"""graft-race — thread-safety rules over the interproc effect model.
+
+The serving/training stack is deeply multi-threaded (supervisor
+watchdog ladders, the overlap engine's harvest ring, replica/disagg
+serve loops, elastic heartbeats, KV store servers) with over a dozen
+ad-hoc ``threading.Lock``s. These rules bring lockdep/ThreadSanitizer
+discipline to that surface, statically, riding the same effect
+summaries as COLL002/COLL003/DDL002:
+
+========= ======== =================================================
+RACE001   error    guarded-by inference: a class attribute written
+                   mostly under ``with self._lock:`` is inferred
+                   GUARDED by that lock; an unguarded write reachable
+                   from a thread entrypoint (``Thread(target=...)``,
+                   ``Timer``, a ``Thread`` subclass ``run``, a serve
+                   loop) without the lock is a data race
+LOCK001   error    lock-acquisition-order cycle: the interprocedural
+                   lock-order graph (nested ``with lock:`` regions,
+                   calls resolved through the project call graph with
+                   the held set at each call site) contains a cycle —
+                   two threads taking the locks in opposite order
+                   deadlock
+LOCK002   warning  blocking call (KVStore request, socket/queue wait,
+                   collective/recv, ``time.sleep`` >= 50ms, subprocess
+                   wait, or a call into a transitively-blocking
+                   project function) while holding a lock that a
+                   hot-path function (the HOTSYNC001 surface:
+                   inference/ step/pump/harvest) also acquires — the
+                   serving step stalls behind the slow critical
+                   section
+========= ======== =================================================
+
+Lock identity is ``(defining file, owner.attr)``: ``self._mu`` inside
+class ``C`` and ``C._mu`` name the SAME lock (class granularity —
+instance-per-object locks share a lock ORDER even though the objects
+differ, which is exactly what lockdep's lock classes model); locks of
+the same spelling in different files stay distinct.
+
+Same contract as the rest of the analyzer: name-based, false
+negatives over false positives, stdlib-only.
+"""
+from __future__ import annotations
+
+import re
+from collections import deque
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import register_rule
+from .interproc import (
+    AccessEffect,
+    AcqEffect,
+    BlockEffect,
+    CallEffect,
+    CollEffect,
+    FunctionSummary,
+    LoopEffect,
+    P2PEffect,
+    ProjectContext,
+    RankBranch,
+    RelEffect,
+    SleepEffect,
+    SpawnEffect,
+    _tarjan,
+)
+
+__all__ = ["LOCK002_SLEEP_THRESHOLD"]
+
+# a literal sleep at or above this (seconds) counts as blocking for
+# LOCK002; shorter sleeps are backoff jitter, not a stall
+LOCK002_SLEEP_THRESHOLD = 0.05
+
+# transitive-acquire cap per function: past this the set is truncated
+# (deterministically) — an accepted false negative, same spirit as the
+# COLL002 schedule budget
+_MAX_TRANSITIVE_LOCKS = 64
+
+# serve-loop entrypoints by NAME (spawned targets and Thread.run are
+# found structurally; a serve loop is usually called from main but
+# runs concurrently with the threads it spawns)
+_SERVE_NAMES = {"serve", "serve_forever"}
+
+_HOT_NAME = re.compile(r"^(step|\w*_step|pump\w*|harvest\w*)$")
+
+# a guard needs at least this many locked writes before it is believed
+_MIN_GUARDED_WRITES = 2
+
+LockKey = Tuple[str, str]  # (defining path, "Owner.attr" | bare name)
+
+
+def _lock_key(fn: FunctionSummary, qual: str) -> LockKey:
+    head, _, rest = qual.partition(".")
+    if head in ("self", "cls") and rest:
+        owner = fn.cls or fn.name
+        return (fn.path, f"{owner}.{rest}")
+    return (fn.path, qual)
+
+
+def _is_hot(fn: FunctionSummary) -> bool:
+    parts = fn.path.replace("\\", "/").split("/")
+    return "inference" in parts and bool(_HOT_NAME.fullmatch(fn.name))
+
+
+class _FnFacts:
+    """Held-set facts for one function, from a single effect walk."""
+
+    __slots__ = ("acquires", "pairs", "calls", "blocking", "writes",
+                 "spawns")
+
+    def __init__(self, fn: FunctionSummary):
+        self.acquires: Dict[LockKey, Tuple[int, int]] = {}
+        # (outer, inner, line, col) per nested acquire
+        self.pairs: List[Tuple[LockKey, LockKey, int, int]] = []
+        self.calls: List[Tuple[CallEffect, FrozenSet[LockKey]]] = []
+        # (description, line, col, held) per blocking effect under a lock
+        self.blocking: List[Tuple[str, int, int, FrozenSet[LockKey]]] = []
+        self.writes: List[Tuple[AccessEffect, FrozenSet[LockKey]]] = []
+        self.spawns: List[SpawnEffect] = []
+        self._walk(fn, fn.effects, [])
+
+    def _walk(self, fn: FunctionSummary, effects, held: List[LockKey]):
+        for e in effects:
+            if isinstance(e, AcqEffect):
+                k = _lock_key(fn, e.qual)
+                self.acquires.setdefault(k, (e.line, e.col))
+                for h in held:
+                    if h != k:
+                        self.pairs.append((h, k, e.line, e.col))
+                held.append(k)
+            elif isinstance(e, RelEffect):
+                k = _lock_key(fn, e.qual)
+                for i in range(len(held) - 1, -1, -1):
+                    if held[i] == k:
+                        del held[i]
+                        break
+            elif isinstance(e, CallEffect):
+                self.calls.append((e, frozenset(held)))
+            elif isinstance(e, AccessEffect):
+                if e.write:
+                    self.writes.append((e, frozenset(held)))
+            elif isinstance(e, SpawnEffect):
+                self.spawns.append(e)
+            elif isinstance(e, BlockEffect):
+                if held:
+                    self.blocking.append(
+                        (e.what, e.line, e.col, frozenset(held)))
+            elif isinstance(e, CollEffect):
+                if held:
+                    self.blocking.append((f"collective `{e.op}`",
+                                          e.line, e.col, frozenset(held)))
+            elif isinstance(e, P2PEffect):
+                if held and e.kind == "recv":
+                    self.blocking.append(
+                        ("p2p recv", e.line, e.col, frozenset(held)))
+            elif isinstance(e, SleepEffect):
+                if held and e.seconds >= LOCK002_SLEEP_THRESHOLD:
+                    self.blocking.append(
+                        (f"time.sleep({e.seconds:g})",
+                         e.line, e.col, frozenset(held)))
+            elif isinstance(e, RankBranch):
+                self._walk(fn, e.body, list(held))
+                self._walk(fn, e.orelse, list(held))
+            elif isinstance(e, LoopEffect):
+                self._walk(fn, e.body, list(held))
+
+
+class _RaceInfo:
+    """Project-wide lock/threading facts, computed once per
+    ProjectContext and shared by the three rules (memoized as an
+    attribute on the context instance)."""
+
+    def __init__(self, project: ProjectContext):
+        self.project = project
+        self.facts: Dict[Tuple, _FnFacts] = {
+            fid: _FnFacts(fn) for fid, fn in project.by_fid.items()}
+        # resolved call edges annotated with the held set AT THE SITE
+        self.edges: Dict[Tuple, List[Tuple[Tuple, FrozenSet[LockKey],
+                                           int, int]]] = {}
+        for fid, fn in project.by_fid.items():
+            out = []
+            for call, held in self.facts[fid].calls:
+                target = project.resolve(fn.path, call)
+                if target is not None:
+                    out.append((target.fid(), held, call.line, call.col))
+            self.edges[fid] = out
+        self.transitive = self._transitive_acquires()
+        self.entrypoints = self._entrypoints()
+        self._reach_memo: Dict[Optional[LockKey], Dict[Tuple, str]] = {}
+
+    # -- transitive lock acquisition (bottom-up over SCCs) -------------
+    def _transitive_acquires(self) -> Dict[Tuple, FrozenSet[LockKey]]:
+        plain = {fid: [c for c, _h, _l, _c in es]
+                 for fid, es in self.edges.items()}
+        out: Dict[Tuple, FrozenSet[LockKey]] = {}
+        for scc in _tarjan(plain):  # reverse topological: callees first
+            scc_set = set(scc)
+            acq: Set[LockKey] = set()
+            for fid in scc:
+                acq.update(self.facts[fid].acquires)
+                for c, _h, _l, _c in self.edges[fid]:
+                    if c not in scc_set:
+                        acq.update(out.get(c, ()))
+            if len(acq) > _MAX_TRANSITIVE_LOCKS:
+                acq = set(sorted(acq)[:_MAX_TRANSITIVE_LOCKS])
+            frozen = frozenset(acq)
+            for fid in scc:
+                out[fid] = frozen
+        return out
+
+    # -- thread entrypoints --------------------------------------------
+    def _entrypoints(self) -> Dict[Tuple, str]:
+        """fid -> human-readable entry description. A spawned target /
+        Thread-subclass run / serve loop starts on a fresh stack with
+        an EMPTY held set."""
+        out: Dict[Tuple, str] = {}
+        for fid, fn in self.project.by_fid.items():
+            if fn.name == "run" and any(
+                    b.split(".")[-1] == "Thread" for b in fn.bases):
+                out.setdefault(fid, f"{fn.cls}.run (Thread subclass)")
+            elif fn.name in _SERVE_NAMES:
+                out.setdefault(fid, f"serve loop `{fn.name}`")
+        for fid, fn in self.project.by_fid.items():
+            for s in self.facts[fid].spawns:
+                probe = CallEffect(
+                    name=s.name, self_call=s.self_call,
+                    has_receiver=s.has_receiver, hard_bounds=False,
+                    kwargs=(), nargs=0, line=s.line, col=s.col)
+                target = self.project.resolve(fn.path, probe)
+                if target is not None:
+                    out.setdefault(
+                        target.fid(),
+                        f"Thread(target={s.name}) at "
+                        f"{fn.path}:{s.line}")
+        return out
+
+    # -- reachability without a given lock -----------------------------
+    def reachable_without(
+            self, lock: Optional[LockKey]) -> Dict[Tuple, str]:
+        """fid -> entry description, for every function reachable from
+        a thread entrypoint along call edges at which ``lock`` is NOT
+        held (``None``: plain reachability)."""
+        memo = self._reach_memo.get(lock)
+        if memo is not None:
+            return memo
+        seen: Dict[Tuple, str] = {}
+        q: deque = deque()
+        for fid in sorted(self.entrypoints):
+            if fid not in seen:
+                seen[fid] = self.entrypoints[fid]
+                q.append(fid)
+        while q:
+            fid = q.popleft()
+            for callee, held, _l, _c in self.edges.get(fid, ()):
+                if lock is not None and lock in held:
+                    continue
+                if callee not in seen:
+                    seen[callee] = seen[fid]
+                    q.append(callee)
+        self._reach_memo[lock] = seen
+        return seen
+
+
+def _race_info(project: ProjectContext) -> _RaceInfo:
+    info = getattr(project, "_graft_race_info", None)
+    if info is None or info.project is not project:
+        info = _RaceInfo(project)
+        project._graft_race_info = info
+    return info
+
+
+def _lname(key: LockKey) -> str:
+    return key[1]
+
+
+# ---------------------------------------------------------------------------
+# LOCK001 — lock-order cycles
+
+
+@register_rule(
+    "LOCK001", severity="error", scope="project",
+    summary="lock-acquisition-order cycle (potential deadlock)",
+    hint="two threads taking these locks in opposite order deadlock; "
+         "impose one global order (acquire the shared outer lock "
+         "first everywhere), or narrow one critical section so the "
+         "nested acquire happens after the outer release. A deliberate "
+         "ordering can be silenced with # graft-lint: disable=LOCK001",
+)
+def lock001(project: ProjectContext):
+    info = _race_info(project)
+    # edge (A -> B): A held while B is acquired; evidence = first site
+    edges: Dict[LockKey, Set[LockKey]] = {}
+    sites: Dict[Tuple[LockKey, LockKey], Tuple[str, int, int, str]] = {}
+
+    def add(a: LockKey, b: LockKey, path: str, line: int, col: int,
+            via: str) -> None:
+        edges.setdefault(a, set()).add(b)
+        edges.setdefault(b, set())
+        key = (a, b)
+        ev = (path, line, col, via)
+        if key not in sites or ev < sites[key]:
+            sites[key] = ev
+
+    for fid in sorted(info.facts):
+        fn = project.by_fid[fid]
+        facts = info.facts[fid]
+        for a, b, line, col in facts.pairs:
+            add(a, b, fn.path, line, col,
+                f"nested `with` in `{fn.name}`")
+        for callee, held, line, col in info.edges[fid]:
+            if not held:
+                continue
+            cfn = project.by_fid[callee]
+            for b in info.transitive.get(callee, ()):
+                if b in held:
+                    continue
+                for a in held:
+                    add(a, b, fn.path, line, col,
+                        f"`{fn.name}` calls `{cfn.name}()` which "
+                        f"acquires it")
+
+    for scc in _tarjan({k: sorted(v) for k, v in edges.items()}):
+        if len(scc) < 2:
+            continue
+        cyc = sorted(scc)
+        a = cyc[0]
+        nxt = min(b for b in edges[a] if b in scc)
+        back = min(y for y in cyc if a in edges.get(y, ()))
+        p1, l1, c1, via1 = sites[(a, nxt)]
+        p2, l2, c2, via2 = sites[(back, a)]
+        locks = ", ".join(f"`{_lname(k)}`" for k in cyc)
+        yield (p1, l1, c1,
+               f"lock-order cycle between {locks}: `{_lname(a)}` is "
+               f"held while `{_lname(nxt)}` is acquired ({via1}), but "
+               f"`{_lname(back)}` is held while `{_lname(a)}` is "
+               f"acquired at {p2}:{l2} ({via2}) — two threads taking "
+               "them in opposite order deadlock")
+
+
+# ---------------------------------------------------------------------------
+# LOCK002 — blocking while holding a hot-path lock
+
+
+@register_rule(
+    "LOCK002", severity="warning", scope="project",
+    summary="blocking call while holding a lock a hot-path "
+            "(inference step/pump/harvest) function also acquires",
+    hint="the serving step stalls behind this critical section: move "
+         "the blocking call outside the `with`, snapshot the state "
+         "under the lock and do the slow work after release, or give "
+         "the slow path its own lock. A deliberately-held wait can be "
+         "silenced with # graft-lint: disable=LOCK002",
+)
+def lock002(project: ProjectContext):
+    info = _race_info(project)
+    # a hot lock is any lock the hot path takes — directly or through
+    # its callees (`step` -> `_run_jit` -> `with self._exec_lock:`)
+    hot: Dict[LockKey, str] = {}
+    hot_fids: List[Tuple] = []
+    for fid in sorted(info.facts):
+        fn = project.by_fid[fid]
+        if not _is_hot(fn):
+            continue
+        hot_fids.append(fid)
+        for k in sorted(info.transitive.get(fid, ())):
+            hot.setdefault(k, f"{fn.name} ({fn.path}:{fn.line})")
+
+    if not hot:
+        return
+    # functions ON the hot path are exempt: the hot path blocking
+    # under its own lock is a hot-path-latency bug (HOTSYNC001's
+    # territory), not a cold thread stalling the hot one
+    on_hot_path: Set[Tuple] = set()
+    q: deque = deque(hot_fids)
+    while q:
+        fid = q.popleft()
+        if fid in on_hot_path:
+            continue
+        on_hot_path.add(fid)
+        for callee, _h, _l, _c in info.edges.get(fid, ()):
+            if callee not in on_hot_path:
+                q.append(callee)
+
+    for fid in sorted(info.facts):
+        if fid in on_hot_path:
+            continue
+        fn = project.by_fid[fid]
+        facts = info.facts[fid]
+        for what, line, col, held in facts.blocking:
+            for k in sorted(held):
+                if k in hot:
+                    yield (fn.path, line, col,
+                           f"`{fn.name}` blocks on {what} while "
+                           f"holding `{_lname(k)}`, which hot-path "
+                           f"`{hot[k]}` also acquires — serving steps "
+                           "stall behind this wait")
+                    break
+        for call, held in facts.calls:
+            if call.hard_bounds or not held:
+                continue
+            hot_held = [k for k in sorted(held) if k in hot]
+            if not hot_held:
+                continue
+            target = project.resolve(fn.path, call)
+            if target is None or not project.blocks(target):
+                continue
+            k = hot_held[0]
+            yield (fn.path, call.line, call.col,
+                   f"`{fn.name}` calls `{target.name}()` (can block "
+                   f"indefinitely, {target.path}:{target.line}) while "
+                   f"holding `{_lname(k)}`, which hot-path `{hot[k]}` "
+                   "also acquires — serving steps stall behind this "
+                   "wait")
+
+
+# ---------------------------------------------------------------------------
+# RACE001 — guarded-by inference
+
+
+@register_rule(
+    "RACE001", severity="error", scope="project",
+    summary="write to a lock-guarded attribute reachable from a "
+            "thread entrypoint without the lock",
+    hint="most writes to this attribute hold the inferred guard; this "
+         "one races with them on a concurrently running thread. Take "
+         "the lock around the write, or — if the attribute is "
+         "genuinely single-threaded by construction — silence with "
+         "# graft-lint: disable=RACE001",
+)
+def race001(project: ProjectContext):
+    info = _race_info(project)
+    # group methods by (path, class); tally NON-__init__ writes
+    classes: Dict[Tuple[str, str], List[Tuple]] = {}
+    for fid, fn in project.by_fid.items():
+        if fn.cls:
+            classes.setdefault((fn.path, fn.cls), []).append(fid)
+
+    for (path, cls), fids in sorted(classes.items()):
+        guarded: Dict[str, Dict[LockKey, int]] = {}
+        unguarded: Dict[str, int] = {}
+        for fid in fids:
+            fn = project.by_fid[fid]
+            if fn.name in ("__init__", "__new__", "__del__"):
+                continue  # construction/teardown precede/outlive sharing
+            for acc, held in info.facts[fid].writes:
+                if held:
+                    per = guarded.setdefault(acc.attr, {})
+                    for k in held:
+                        per[k] = per.get(k, 0) + 1
+                else:
+                    unguarded[acc.attr] = unguarded.get(acc.attr, 0) + 1
+
+        for attr in sorted(guarded):
+            per = guarded[attr]
+            lock, n = max(sorted(per.items()),
+                          key=lambda kv: kv[1])
+            total_guarded = sum(per.values())
+            if n < _MIN_GUARDED_WRITES:
+                continue
+            if total_guarded <= unguarded.get(attr, 0):
+                continue  # no majority: the guard is not believed
+            reach = info.reachable_without(lock)
+            for fid in fids:
+                fn = project.by_fid[fid]
+                if fn.name in ("__init__", "__new__", "__del__"):
+                    continue
+                entry = reach.get(fid)
+                if entry is None:
+                    continue
+                for acc, held in info.facts[fid].writes:
+                    if acc.attr != attr or lock in held:
+                        continue
+                    yield (path, acc.line, acc.col,
+                           f"write to `self.{attr}` in `{cls}."
+                           f"{fn.name}` without `{_lname(lock)}` — "
+                           f"{n} of {total_guarded + unguarded.get(attr, 0)} "
+                           f"writes hold that lock, and `{fn.name}` "
+                           f"is reachable from {entry} with the lock "
+                           "not held (data race)")

@@ -217,9 +217,15 @@ class CommWatchdog:
     def stop(self):
         self._stop.set()
         self._kick.set()
-        if self._thread is not None:
-            self._thread.join(timeout=1.0)
-            self._thread = None
+        # `_thread` is guarded by `_mu` (see _ensure_thread): take the
+        # handoff under the lock so a stop() racing a watch() cannot
+        # observe a half-installed daemon — but join OUTSIDE it, since
+        # the daemon itself takes `_mu` in _poll_interval and would
+        # stall the join until its timeout
+        with self._mu:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=1.0)
 
 
 def watch(desc: str, deadline: Optional[Deadline] = None):

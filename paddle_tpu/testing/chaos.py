@@ -71,6 +71,12 @@ Instrumented sites (grep for ``chaos.inject``):
   membership comparison (fleet/elastic); a ``drop`` FORCES the
   re-mesh decision true even with a stable world — exercises the
   re-mesh/recompile path without actually losing a node
+- ``thread.preempt``     — each ``TracedLock`` release
+  (utils/locks.py, only when the lock sanitizer is active); a
+  ``slow`` stretches the critical section right before the drop —
+  the seeded preemption that shakes latent lock-order interleavings
+  out of the chaos-driven tests. The release itself always happens
+  (``drop`` is ignored)
 
 Faults (``Fault.kind``): ``hang``/``slow`` (sleep ``arg`` seconds;
 ``hang`` requires a positive arg), ``reset`` (raise

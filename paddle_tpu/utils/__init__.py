@@ -5,6 +5,7 @@ FLAGS_log_level), deprecated-API decorator, unique_name (re-exported
 from base), and cpp_extension (native custom-op build + load).
 """
 from . import cpp_extension  # noqa: F401
+from . import locks  # noqa: F401
 from . import log  # noqa: F401
 from . import retries  # noqa: F401
 from .log import get_logger  # noqa: F401

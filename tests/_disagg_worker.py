@@ -38,6 +38,9 @@ env:
                         dump (JSON list of span dicts) to the path on
                         serve-loop exit, for cross-process stitching
   PADDLE_CHAOS        — optional fault schedule (the victim only)
+  PADDLE_LOCK_SANITIZER — non-empty: run under the graft-race lockdep
+                        sanitizer (utils/locks.py) and assert zero
+                        lock-order violations on clean exit
 """
 import json
 import os
@@ -59,6 +62,15 @@ from paddle_tpu.models import LlamaConfig, LlamaForCausalLM  # noqa: E402
 
 
 def main():
+    # graft-race slow lane: PADDLE_LOCK_SANITIZER=1 runs the whole
+    # worker under TracedLock (lockdep) — an inverted acquisition
+    # order anywhere in prefill/decode raises LockOrderViolation
+    # in-process, and the exit assertion below makes a recorded
+    # violation a nonzero worker exit the driving test sees
+    sanitize = bool(os.environ.get("PADDLE_LOCK_SANITIZER"))
+    if sanitize:
+        from paddle_tpu.utils.locks import instrument_locks, violation_count
+        instrument_locks()
     paddle.seed(0)
     role = os.environ["DISAGG_ROLE"]
     max_len = int(os.environ.get("DISAGG_MAX_LEN", "32"))
@@ -123,6 +135,10 @@ def main():
         if dump_path:
             with open(dump_path, "w", encoding="utf-8") as fh:
                 json.dump(obs.ring().dump(), fh)
+    if sanitize:
+        n = violation_count()
+        assert n == 0, f"lock sanitizer recorded {n} violation(s)"
+        print("lock-sanitizer: clean", flush=True)
 
 
 if __name__ == "__main__":

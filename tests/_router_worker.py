@@ -15,6 +15,9 @@ env:
   ROUTER_JOURNAL_DIR  — journal directory (read by the router on death)
   ROUTER_BUDGET       — serve-loop wall budget in seconds (default 120)
   PADDLE_CHAOS        — optional fault schedule (the victim only)
+  PADDLE_LOCK_SANITIZER — non-empty: run under the graft-race lockdep
+                        sanitizer (utils/locks.py) and assert zero
+                        lock-order violations on clean exit
 """
 import os
 
@@ -31,6 +34,15 @@ from paddle_tpu.models import LlamaConfig, LlamaForCausalLM  # noqa: E402
 
 
 def main():
+    # graft-race slow lane: PADDLE_LOCK_SANITIZER=1 runs the whole
+    # replica under TracedLock (lockdep) — an inverted acquisition
+    # order anywhere in the serve loop raises LockOrderViolation
+    # in-process, and the exit assertion below makes a recorded
+    # violation a nonzero worker exit the driving test sees
+    sanitize = bool(os.environ.get("PADDLE_LOCK_SANITIZER"))
+    if sanitize:
+        from paddle_tpu.utils.locks import instrument_locks, violation_count
+        instrument_locks()
     paddle.seed(0)
     # name this process's track so stitched fleet traces and published
     # metrics snapshots are attributable to the replica, not a bare pid
@@ -50,6 +62,10 @@ def main():
         store, os.environ["ROUTER_REPLICA_ID"], factory,
         journal_dir=os.environ["ROUTER_JOURNAL_DIR"])
     server.serve(deadline=float(os.environ.get("ROUTER_BUDGET", "120")))
+    if sanitize:
+        n = violation_count()
+        assert n == 0, f"lock sanitizer recorded {n} violation(s)"
+        print("lock-sanitizer: clean", flush=True)
 
 
 if __name__ == "__main__":

@@ -129,6 +129,14 @@ def pytest_configure(config):
         "standalone via `pytest -m slo`)")
     config.addinivalue_line(
         "markers",
+        "race: graft-race lane — RACE001/LOCK001/LOCK002 static-rule "
+        "fixtures, the TracedLock lockdep sanitizer units, the seeded "
+        "two-lock deadlock proof (static + runtime + hang dump), the "
+        "thread.preempt chaos site, and the CLI gate (quick-lane; the "
+        "sanitizer-overhead A/B rides the slow lane; standalone via "
+        "`pytest -m race`)")
+    config.addinivalue_line(
+        "markers",
         "mc2: real 2-process multi-controller lane — launcher-spawned "
         "jax.distributed workers running cross-process collectives, "
         "DP/TP/sharding-3/pipeline parity, and the kill-one-rank "

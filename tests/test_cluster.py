@@ -353,6 +353,10 @@ class TestProcessClusterKill:
                     "ROUTER_REPLICA_ID": rid,
                     "ROUTER_JOURNAL_DIR": str(tmp_path / rid),
                     "ROUTER_BUDGET": "240",
+                    # graft-race: run both replicas under the lockdep
+                    # sanitizer — an inverted lock order in the serve
+                    # loop fails the worker, and this test with it
+                    "PADDLE_LOCK_SANITIZER": "1",
                     "JAX_PLATFORMS": "cpu",
                     "PYTHONPATH": REPO + os.pathsep
                     + os.environ.get("PYTHONPATH", ""),

@@ -886,6 +886,10 @@ class TestProcessDisaggKill:
                     "DISAGG_DECODE_IDS": "dx0",
                     "DISAGG_BUDGET": "240",
                     "DISAGG_N_PARTS": str(N_PARTS),
+                    # graft-race: both pools run under the lockdep
+                    # sanitizer — an inverted lock order anywhere in
+                    # prefill/decode fails the worker, and the test
+                    "PADDLE_LOCK_SANITIZER": "1",
                     "JAX_PLATFORMS": "cpu",
                     "PYTHONPATH": REPO + os.pathsep
                     + os.environ.get("PYTHONPATH", ""),

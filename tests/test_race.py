@@ -1,0 +1,826 @@
+"""graft-race: RACE001/LOCK001/LOCK002 rule fixtures, the TracedLock
+lockdep sanitizer, the seeded two-lock deadlock proof (the SAME fixture
+source flagged statically AND caught at runtime naming both stacks,
+plus a forced hang dump printing per-thread held locks), the
+``thread.preempt`` chaos site, the CLI gate, and the sanitizer-overhead
+A/B (ISSUE 18).
+
+Every rule is proven BOTH ways: fixtures seed >= 2 true violations it
+must catch AND >= 2 near-misses it must NOT flag (all-guarded writes,
+``__init__`` writes, no-majority guards, writes only reachable under
+the lock, consistent lock orders, re-acquiring the same lock class,
+sub-threshold sleeps, cold locks, the hot path's own critical section).
+
+Run standalone via ``pytest -m race`` (quick lane; the overhead A/B
+rides the slow lane).
+"""
+import io
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis import analyze_source
+from paddle_tpu.testing import chaos
+from paddle_tpu.utils import locks
+
+pytestmark = pytest.mark.race
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def findings_for(src, rule, path="fixture.py"):
+    return analyze_source(textwrap.dedent(src), path, select=[rule])
+
+
+def lines_of(findings):
+    return [f.line for f in findings]
+
+
+@pytest.fixture(autouse=True)
+def _pristine_sanitizer():
+    """The sanitizer's order graph / held sets are process-global (as
+    they must be — a lock ORDER is a process-wide fact); tests start
+    and leave it empty and uninstrumented."""
+    locks.uninstrument_locks()
+    locks.reset()
+    yield
+    locks.uninstrument_locks()
+    locks.reset()
+
+
+# ---------------------------------------------------------------------------
+# RACE001 — guarded-by inference
+
+
+class TestRace001:
+    def test_unguarded_writes_reachable_from_thread_flagged(self):
+        src = '''
+        import threading
+        import time
+
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.hits = 0
+                self.total = 0
+
+            def bump(self):
+                with self._lock:
+                    self.hits += 1
+                    self.total += 1
+
+            def flush(self):
+                with self._lock:
+                    self.hits = 0
+                    self.total = 0
+
+            def racy_reset(self):
+                self.hits = 0        # line 23: no lock, thread-reachable
+                self.total = 0       # line 24
+
+
+        def spin(c):
+            while True:
+                c.racy_reset()
+                time.sleep(0.01)
+
+
+        def start(c):
+            t = threading.Thread(target=spin, args=(c,))
+            t.start()
+            return t
+        '''
+        got = findings_for(src, "RACE001")
+        assert lines_of(got) == [23, 24]
+        assert all(f.severity == "error" for f in got)
+        assert "Counter._lock" in got[0].message
+        assert "2 of 3 writes" in got[0].message
+        # the message names the concurrent entrypoint — the evidence
+        # that the write actually races, not just that it is bare
+        assert "Thread(target=spin)" in got[0].message
+
+    def test_near_misses_stay_clean(self):
+        src = '''
+        import threading
+        import time
+
+
+        class Clean:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.hits = 0          # __init__ precedes sharing: exempt
+                self.mode = "idle"
+
+            def bump(self):
+                with self._lock:
+                    self.hits += 1
+
+            def flush(self):
+                with self._lock:
+                    self.hits = 0
+
+            def guarded_entry(self):
+                with self._lock:
+                    self.helper()
+
+            def helper(self):
+                self._apply()
+
+            def _apply(self):
+                # bare write — but only reachable from the thread WITH
+                # the lock held (through guarded_entry), so no race
+                self.hits = 0
+
+            def set_mode(self, m):
+                # `mode` has no majority of guarded writes: no inferred
+                # guard, nothing to enforce
+                self.mode = m
+
+            def set_mode2(self, m):
+                self.mode = m
+
+
+        def spin(c):
+            while True:
+                c.guarded_entry()
+                c.set_mode("busy")
+                time.sleep(0.01)
+
+
+        def start(c):
+            t = threading.Thread(target=spin, args=(c,))
+            t.start()
+        '''
+        assert findings_for(src, "RACE001") == []
+
+    def test_no_thread_no_finding(self):
+        # the same racy shape with NO concurrency anywhere: a bare
+        # write is a style choice, not a race — stays clean
+        src = '''
+        import threading
+
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.hits = 0
+
+            def bump(self):
+                with self._lock:
+                    self.hits += 1
+
+            def flush(self):
+                with self._lock:
+                    self.hits = 0
+
+            def racy_reset(self):
+                self.hits = 0
+        '''
+        assert findings_for(src, "RACE001") == []
+
+
+# ---------------------------------------------------------------------------
+# LOCK001 — lock-acquisition-order cycles
+
+
+class TestLock001:
+    def test_direct_nested_inversion_flagged(self):
+        src = '''
+        import threading
+
+
+        class Supervisor:
+            def __init__(self):
+                self._state_lock = threading.Lock()
+                self._sched_lock = threading.Lock()
+                self.paused = False
+                self.queue = []
+
+            def pause(self):
+                with self._state_lock:
+                    self.paused = True
+                    with self._sched_lock:     # state -> sched
+                        self.queue.clear()
+
+            def requeue(self, item):
+                with self._sched_lock:
+                    self.queue.append(item)
+                    with self._state_lock:     # line 21: sched -> state
+                        self.paused = False
+        '''
+        got = findings_for(src, "LOCK001")
+        assert len(got) == 1 and got[0].severity == "error"
+        msg = got[0].message
+        assert "Supervisor._state_lock" in msg
+        assert "Supervisor._sched_lock" in msg
+        assert "opposite order deadlock" in msg
+        # both evidence sites are named: the finding's anchor plus the
+        # inverse acquisition's file:line in the message
+        assert "fixture.py:" in msg
+
+    def test_interprocedural_cycle_flagged(self):
+        src = '''
+        import threading
+
+        _a_lock = threading.Lock()
+        _b_lock = threading.Lock()
+
+
+        def commit():
+            with _b_lock:
+                pass
+
+
+        def publish():
+            with _a_lock:          # a held ...
+                commit()           # ... while commit() takes b
+
+
+        def grab():
+            with _a_lock:
+                pass
+
+
+        def drain():
+            with _b_lock:          # b held ...
+                grab()             # ... while grab() takes a
+        '''
+        got = findings_for(src, "LOCK001")
+        assert len(got) == 1
+        assert "`publish` calls `commit()`" in got[0].message
+        assert "`drain` calls `grab()`" in got[0].message
+
+    def test_consistent_order_stays_clean(self):
+        src = '''
+        import threading
+
+
+        class Ordered:
+            def __init__(self):
+                self._outer_lock = threading.Lock()
+                self._inner_lock = threading.Lock()
+
+            def a(self):
+                with self._outer_lock:
+                    with self._inner_lock:
+                        pass
+
+            def b(self):
+                with self._outer_lock:
+                    with self._inner_lock:
+                        pass
+        '''
+        assert findings_for(src, "LOCK001") == []
+
+    def test_same_lock_class_through_a_call_stays_clean(self):
+        # calling a helper that takes the SAME lock class the caller
+        # holds is a re-entrancy question (RLock territory), not an
+        # ordering cycle — lockdep's lock classes never self-edge
+        src = '''
+        import threading
+
+
+        class Reenter:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def helper(self):
+                with self._mu:
+                    pass
+
+            def calls_under_same(self):
+                with self._mu:
+                    self.helper()
+        '''
+        assert findings_for(src, "LOCK001") == []
+
+
+# ---------------------------------------------------------------------------
+# LOCK002 — blocking while holding a hot-path lock
+
+
+class TestLock002:
+    HOT = '''
+    import threading
+    import time
+
+
+    class Engine:
+        def __init__(self):
+            self._exec_lock = threading.Lock()
+            self._log_mu = threading.Lock()
+            self.stats = None
+
+        def step(self):
+            with self._exec_lock:
+                self.stats = None
+
+        def snapshot(self, store):
+            with self._exec_lock:
+                time.sleep(0.5)                         # line 18
+                store.blocking_key_value_get("stats")   # line 19
+
+        def log_snapshot(self, store):
+            with self._log_mu:                          # cold lock
+                store.blocking_key_value_get("stats")
+
+        def backoff(self):
+            with self._exec_lock:
+                time.sleep(0.001)                       # jitter, not a stall
+    '''
+
+    def test_blocking_under_hot_lock_flagged(self):
+        got = findings_for(self.HOT, "LOCK002",
+                           path="paddle_tpu/inference/fixture.py")
+        assert lines_of(got) == [18, 19]
+        assert all(f.severity == "warning" for f in got)
+        assert "time.sleep(0.5)" in got[0].message
+        assert "Engine._exec_lock" in got[0].message
+        assert "hot-path `step" in got[0].message
+        assert ".blocking_key_value_get()" in got[1].message
+
+    def test_cold_lock_and_short_sleep_stay_clean(self):
+        got = findings_for(self.HOT, "LOCK002",
+                           path="paddle_tpu/inference/fixture.py")
+        # the cold-lock snapshot (line 23) and the 1ms backoff
+        # (line 27) are the near-misses: neither is flagged
+        assert 23 not in lines_of(got) and 27 not in lines_of(got)
+
+    def test_outside_inference_there_is_no_hot_path(self):
+        assert findings_for(self.HOT, "LOCK002",
+                            path="paddle_tpu/training/fixture.py") == []
+
+    def test_hot_path_own_blocking_is_exempt(self):
+        # `step` stalling in ITS OWN critical section is a hot-path
+        # latency bug (HOTSYNC001's territory), not a cold thread
+        # stalling the hot one — LOCK002 stays quiet
+        src = '''
+        import threading
+        import time
+
+
+        class Engine:
+            def __init__(self):
+                self._exec_lock = threading.Lock()
+
+            def step(self):
+                with self._exec_lock:
+                    self._refill()
+
+            def _refill(self):
+                time.sleep(0.5)
+
+            def idle_wait(self):
+                time.sleep(0.5)       # blocking, but no lock held
+        '''
+        assert findings_for(src, "LOCK002",
+                            path="paddle_tpu/inference/fixture.py") == []
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer units
+
+
+class TestTracedLock:
+    def test_inversion_raises_naming_both_stacks(self):
+        a = locks.TracedLock(name="alpha_mu")
+        b = locks.TracedLock(name="beta_mu")
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(locks.LockOrderViolation) as ei:
+                a.acquire()
+        msg = str(ei.value)
+        assert "alpha_mu" in msg and "beta_mu" in msg
+        assert "established order" in msg and "this thread" in msg
+        # BOTH stacks point into this test — the one recorded when the
+        # a->b order was first taken, and the inverted acquisition's
+        assert msg.count("test_race.py") >= 2
+        assert locks.violation_count() == 1
+
+    def test_order_edges_record_first_stack(self):
+        a = locks.TracedLock(name="first_mu")
+        b = locks.TracedLock(name="second_mu")
+        with a:
+            with b:
+                pass
+        edges = locks.lock_order_edges()
+        assert ("first_mu", "second_mu") in edges
+        assert "test_race.py" in edges[("first_mu", "second_mu")]
+
+    def test_held_locks_and_max_hold_times(self):
+        mu = locks.TracedLock(name="obs_mu")
+        with mu:
+            held = locks.held_locks()
+            mine = held[threading.current_thread().name]
+            assert mine[0][0] == "obs_mu"
+            assert "test_race" in mine[0][1]  # site points at user code
+            time.sleep(0.02)
+        assert locks.held_locks() == {}
+        assert locks.max_hold_times()["obs_mu"] >= 0.02
+
+    def test_trylock_timeout_and_locked(self):
+        mu = locks.TracedLock(name="try_mu")
+        assert mu.acquire(False)
+        assert mu.locked()
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(mu.acquire(True, 0.05)))
+        t.start()
+        t.join(5)
+        assert got == [False]  # contended timeout fails cleanly
+        mu.release()
+        assert not mu.locked()
+
+    def test_same_class_instances_share_order_but_not_exclusion(self):
+        # two instances born with the same name are one lockdep CLASS:
+        # holding one while taking the other records no self-edge (and
+        # is not a violation), mirroring per-shard instance locks
+        a = locks.TracedLock(name="shard_mu")
+        b = locks.TracedLock(name="shard_mu")
+        with a:
+            with b:
+                pass
+        assert ("shard_mu", "shard_mu") not in locks.lock_order_edges()
+
+    def test_instrumentation_patches_and_restores_factories(self):
+        import _thread
+
+        assert threading.Lock is _thread.allocate_lock  # zero cost off
+        assert locks.instrument_locks() is True
+        try:
+            assert isinstance(threading.Lock(), locks.TracedLock)
+            assert isinstance(threading.RLock(), locks.TracedLock)
+            assert locks.instrument_locks() is False  # idempotent
+        finally:
+            locks.uninstrument_locks()
+        assert threading.Lock is _thread.allocate_lock
+        assert threading.RLock is locks._REAL_RLOCK
+
+    def test_reentrant_rlock_and_condition_survive_instrumentation(self):
+        locks.instrument_locks()
+        try:
+            r = threading.RLock()
+            with r:
+                with r:  # re-acquire: count bookkeeping, no edge
+                    pass
+            cv = threading.Condition()  # wraps a traced RLock
+            results = []
+
+            def waiter():
+                with cv:
+                    results.append(cv.wait(timeout=5))
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            t.join(0.05)
+            while t.is_alive():
+                with cv:
+                    cv.notify()
+                t.join(0.05)
+            assert results == [True]
+            assert locks.violation_count() == 0
+        finally:
+            locks.uninstrument_locks()
+
+
+# ---------------------------------------------------------------------------
+# the seeded deadlock proof — ONE fixture, caught statically AND at
+# runtime, plus the forced hang dump
+
+# a real supervisor/worker shape: pause() takes state -> sched, the
+# worker's requeue() takes sched -> state. Two threads, the right
+# interleaving, and this deadlocks silently — unless flagged first.
+DEADLOCK_SRC = '''
+import threading
+import time
+
+
+class Supervisor:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._sched_lock = threading.Lock()
+        self.paused = False
+        self.queue = []
+
+    def pause(self):
+        with self._state_lock:
+            self.paused = True
+            with self._sched_lock:     # state -> sched
+                self.queue.clear()
+
+    def requeue(self, item):
+        with self._sched_lock:
+            self.queue.append(item)
+            with self._state_lock:     # sched -> state: the inversion
+                self.paused = False
+
+
+def worker(sup, errors):
+    try:
+        for i in range(3):
+            sup.requeue(i)
+            time.sleep(0.001)
+    except Exception as e:   # noqa: BLE001 — relayed to the test
+        errors.append(e)
+'''
+
+
+class TestSeededDeadlockProof:
+    def test_static_lock001_flags_the_fixture(self):
+        got = findings_for(DEADLOCK_SRC, "LOCK001",
+                           path="deadlock_fixture.py")
+        assert len(got) == 1
+        assert "Supervisor._state_lock" in got[0].message
+        assert "Supervisor._sched_lock" in got[0].message
+
+    def test_runtime_catches_the_inversion_naming_both_stacks(self):
+        # the SAME source, executed under instrument_locks() with a
+        # seeded thread.preempt schedule stretching critical sections:
+        # the order graph catches the inversion BEFORE any deadlock,
+        # in whichever thread closes the cycle
+        assert locks.instrument_locks()
+        sched = chaos.ChaosSchedule().every("thread.preempt", 2,
+                                            "slow", 0.002)
+        with chaos.active(sched):
+            ns = {}
+            exec(compile(textwrap.dedent(DEADLOCK_SRC),
+                         "deadlock_fixture.py", "exec"), ns)
+            sup = ns["Supervisor"]()
+            errors = []
+            t = threading.Thread(target=ns["worker"], args=(sup, errors),
+                                 name="requeue-worker")
+            t.start()
+            t.join(30)
+            assert not t.is_alive() and not errors
+            with pytest.raises(locks.LockOrderViolation) as ei:
+                sup.pause()
+        msg = str(ei.value)
+        # both stacks are named: the worker's established sched->state
+        # order and this thread's inverted state->sched acquisition
+        assert "in requeue" in msg
+        assert "in pause" in msg
+        assert "deadlock_fixture.py" in msg
+        assert locks.violation_count() == 1
+
+    def test_forced_hang_dump_prints_per_thread_held_locks(self):
+        # freeze a thread mid-acquisition and force the CommWatchdog
+        # hang dump: it must name who holds what (and for how long)
+        # and what the stuck thread is waiting for
+        from paddle_tpu.distributed.communication import (
+            flight_recorder as fr,
+        )
+
+        locks.instrument_locks()  # registers the dump extra
+        inner = locks.TracedLock(name="inner_mu")
+        outer = locks.TracedLock(name="outer_mu")
+        inner.acquire()
+        entered = threading.Event()
+
+        def victim():
+            with outer:
+                entered.set()
+                with inner:  # blocks: main thread holds it
+                    pass
+
+        t = threading.Thread(target=victim, name="victim", daemon=True)
+        t.start()
+        assert entered.wait(5)
+        text = ""
+        for _ in range(250):  # wait for the WAITING record to appear
+            buf = io.StringIO()
+            fr.dump_on_watchdog(buf)
+            text = buf.getvalue()
+            if "WAITING for `inner_mu`" in text:
+                break
+            time.sleep(0.02)
+        assert "-- graft-race: per-thread held locks --" in text
+        assert "thread victim:" in text
+        assert "holds `outer_mu` for" in text
+        assert "WAITING for `inner_mu`" in text
+        assert "holds `inner_mu` for" in text  # the main thread's side
+        inner.release()
+        t.join(5)
+        assert not t.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# thread.preempt chaos site
+
+
+class TestThreadPreemptChaos:
+    def test_seeded_slow_stretches_the_release(self):
+        lk = locks.TracedLock(name="preempt_mu")
+        sched = chaos.ChaosSchedule().at("thread.preempt", 1,
+                                         "slow", 0.15)
+        with chaos.active(sched) as mk:
+            t0 = time.perf_counter()
+            with lk:
+                pass
+            dt = time.perf_counter() - t0
+        assert dt >= 0.14, dt
+        assert ("thread.preempt", 1, "slow") in mk.events
+        assert not lk.locked()  # the release itself always happens
+
+    def test_error_fault_still_releases_the_lock(self):
+        lk = locks.TracedLock(name="chaos_err_mu")
+        sched = chaos.ChaosSchedule().at("thread.preempt", 1, "error")
+        with chaos.active(sched):
+            with pytest.raises(RuntimeError, match="chaos"):
+                with lk:
+                    pass
+        assert not lk.locked()  # released in the finally despite the raise
+
+
+# ---------------------------------------------------------------------------
+# CLI gate — the CI command
+
+
+class TestRaceCliGate:
+    def test_package_is_clean_under_the_race_rules(self):
+        """The CI command: `python -m paddle_tpu.analysis paddle_tpu
+        --select RACE001,LOCK001,LOCK002 --format github` exits 0 on
+        the tree — real findings were FIXED, not baselined."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", "paddle_tpu",
+             "--select", "RACE001,LOCK001,LOCK002", "--format",
+             "github", "--no-baseline"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "::error" not in proc.stdout
+        assert "::warning" not in proc.stdout
+
+    def test_exit_one_and_annotations_on_seeded_violations(self, tmp_path):
+        bad = tmp_path / "inference" / "bad.py"
+        bad.parent.mkdir()
+        bad.write_text(textwrap.dedent('''
+        import threading
+        import time
+
+
+        class Engine:
+            def __init__(self):
+                self._exec_lock = threading.Lock()
+                self._sched_lock = threading.Lock()
+                self.active = 0
+
+            def step(self):
+                with self._exec_lock:
+                    self.active += 1
+
+            def drain(self):
+                with self._exec_lock:
+                    self.active = 0
+
+            def snapshot(self, store):
+                with self._exec_lock:
+                    store.blocking_key_value_get("stats")
+
+            def pause(self):
+                with self._exec_lock:
+                    with self._sched_lock:
+                        pass
+
+            def resume(self):
+                with self._sched_lock:
+                    with self._exec_lock:
+                        pass
+
+            def racy_reset(self):
+                self.active = 0
+
+
+        def spin(eng):
+            while True:
+                eng.racy_reset()
+                time.sleep(0.01)
+
+
+        def start(eng):
+            t = threading.Thread(target=spin, args=(eng,))
+            t.start()
+        '''))
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", str(tmp_path),
+             "--select", "RACE001,LOCK001,LOCK002", "--format",
+             "github", "--no-baseline"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        out = proc.stdout
+        for rule in ("RACE001", "LOCK001", "LOCK002"):
+            assert f"graft-lint {rule}" in out
+        assert "::error" in out    # RACE001/LOCK001
+        assert "::warning" in out  # LOCK002
+
+
+# ---------------------------------------------------------------------------
+# sanitizer overhead — the PR 11 paired-step A/B
+
+
+@pytest.mark.slow
+class TestSanitizerOverhead:
+    def test_traced_engine_steps_within_two_percent(self):
+        """Two identical engines over one model — one constructed under
+        instrument_locks() (every lock it builds is traced), one with
+        the real factories — stepped alternately through the same
+        workload. Adjacent steps sample the same machine conditions,
+        so per-pair (traced - plain) diffs cancel the drift that swamps
+        unpaired medians at this scale (the PR 11 obs A/B estimator).
+        Uninstrumented is structurally zero-cost: `threading.Lock` IS
+        the C allocator again after uninstrument_locks()."""
+        import _thread
+
+        import paddle_tpu as paddle
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.utils.retries import Deadline
+
+        assert threading.Lock is _thread.allocate_lock  # off = free
+
+        config = LlamaConfig(
+            vocab_size=2048, hidden_size=256, intermediate_size=688,
+            num_hidden_layers=4, num_attention_heads=8,
+            num_key_value_heads=4, max_position_embeddings=256)
+        paddle.seed(0)
+        model = LlamaForCausalLM(config)
+        B, MAX_LEN, BS, PAD = 4, 64, 8, 16
+        N_REQ, GEN = 48, 40
+        kw = dict(max_batch=B, max_len=MAX_LEN, block_size=BS,
+                  num_blocks=B * (-(-MAX_LEN // BS)) + 2,
+                  prompt_pad=PAD, decode_chunk=4)
+        locks.instrument_locks()
+        try:
+            traced = ContinuousBatchingEngine(model, **kw)
+        finally:
+            locks.uninstrument_locks()
+        plain = ContinuousBatchingEngine(model, **kw)
+
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, config.vocab_size,
+                               (int((5, 9, 14)[i % 3]),))
+                   for i in range(N_REQ)]
+        for eng in (traced, plain):
+            eng.add_request("warm", np.ones(5, np.int32),
+                            max_new_tokens=2)
+            eng.run()  # compile both phases outside the timed loop
+
+        dl = Deadline(float(os.environ.get("RACE_AB_BUDGET", "300")))
+
+        def _measure():
+            for eng in (traced, plain):
+                for i, p in enumerate(prompts):
+                    eng.add_request(i, p, max_new_tokens=GEN)
+            diffs, offs = [], []
+            i = 0
+            while ((traced._queue or traced.num_active)
+                   and not dl.expired()):
+                # identical deterministic workloads keep the two
+                # engines' admission patterns in lockstep, so "steady"
+                # coincides; alternate which engine steps first to
+                # cancel ordering bias
+                first, second = ((traced, plain) if i % 2 == 0
+                                 else (plain, traced))
+                steady = all(
+                    e.num_active == B and e.num_prefilling == 0
+                    for e in (traced, plain))
+                ts = {}
+                for eng in (first, second):
+                    d0 = eng.decode_tokens
+                    t0 = time.perf_counter()
+                    eng.step()
+                    ts[id(eng)] = (time.perf_counter() - t0,
+                                   eng.decode_tokens - d0)
+                if steady and all(
+                        v[1] == B * traced.decode_chunk
+                        for v in ts.values()):
+                    diffs.append(ts[id(traced)][0] - ts[id(plain)][0])
+                    offs.append(ts[id(plain)][0])
+                i += 1
+            assert not traced._queue and not traced.num_active, \
+                "budget too small to drain the workload"
+            assert len(diffs) >= 25, len(diffs)
+
+            def _trimmed(xs, frac=0.25):
+                xs = np.sort(np.asarray(xs))
+                k = int(len(xs) * frac)
+                return float(np.mean(xs[k:len(xs) - k]))
+
+            return _trimmed(diffs) / _trimmed(offs), len(diffs)
+
+        # the true effect is ~0.1-0.5% of a step; a shared noisy box
+        # can push one trimmed-mean sample past the budget, so a
+        # breach gets ONE fresh re-measurement before it counts
+        overhead, n = _measure()
+        if overhead >= 0.02:
+            overhead, n = _measure()
+        assert overhead < 0.02, (
+            f"traced-lock overhead {100 * overhead:.2f}% exceeds the "
+            f"2% budget ({n} paired steps)")
