@@ -32,6 +32,25 @@ Three cooperating pieces, all host-side and model-free:
   measured queueing delay crosses the target (hysteresis + hold to
   avoid flapping).
 
+Per-tenant isolation (ISSUE 19) rides on the same front door. DAGOR
+sheds *total* overload but is tenant-blind: one hot tenant fills the
+queue and every other tenant's attainment collapses while the engine
+is nominally healthy. Two mechanisms close that hole:
+
+- **token-bucket quotas** (:class:`TenantPolicy` ``rate_tokens_per_s``/
+  ``burst_tokens``): a tenant that exceeds its refill rate is shed with
+  reason ``tenant-quota`` at submit time, before it costs anything.
+  Refill is computed from the injected clock, so a seeded schedule
+  replays to identical verdicts.
+- **weighted fair queueing** (start-time fair queueing / SFQ): each
+  admission is stamped with a virtual start/finish tag
+  (``finish = start + cost / weight``); the engine orders its queue by
+  finish tag within a priority class, and feeds served start tags back
+  via :meth:`AdmissionController.wfq_served` to advance virtual time.
+  A quiet tenant's first arrival tags at the current virtual time and
+  overtakes a hot tenant's long backlog — starvation becomes
+  structurally impossible rather than merely visible.
+
 The controller is deliberately engine-agnostic: it consumes
 :class:`EngineLoad` values and returns verdicts, so it unit-tests
 without a model and could front any engine with the same signal.
@@ -39,13 +58,14 @@ without a model and could front any engine with the same signal.
 from __future__ import annotations
 
 import time
-from dataclasses import asdict, dataclass
-from typing import Optional, Tuple
+from dataclasses import asdict, dataclass, field
+from typing import Dict, Optional, Tuple
 
 __all__ = [
     "PRIORITIES",
     "priority_rank",
     "EngineLoad",
+    "TenantPolicy",
     "AdmissionConfig",
     "AdmissionController",
 ]
@@ -119,6 +139,39 @@ class EngineLoad:
         return asdict(self)
 
 
+@dataclass(frozen=True)
+class TenantPolicy:
+    """Per-tenant isolation knobs.
+
+    ``weight`` steers WFQ service share (a weight-2 tenant drains twice
+    as fast as a weight-1 tenant under contention). ``rate_tokens_per_s``
+    enables a token-bucket quota over REAL work (prompt + generation
+    budget tokens); ``burst_tokens`` is the bucket depth (defaults to
+    one second of rate). ``None`` rate means unmetered."""
+
+    weight: float = 1.0
+    rate_tokens_per_s: Optional[float] = None
+    burst_tokens: Optional[float] = None
+
+    def __post_init__(self):
+        if self.weight <= 0.0:
+            raise ValueError("weight must be > 0")
+        if self.rate_tokens_per_s is not None and self.rate_tokens_per_s <= 0:
+            raise ValueError("rate_tokens_per_s must be > 0 or None")
+        if self.burst_tokens is not None and self.burst_tokens <= 0:
+            raise ValueError("burst_tokens must be > 0 or None")
+
+    @property
+    def burst(self) -> Optional[float]:
+        if self.rate_tokens_per_s is None:
+            return None
+        return (self.burst_tokens if self.burst_tokens is not None
+                else self.rate_tokens_per_s)
+
+
+_DEFAULT_POLICY = TenantPolicy()
+
+
 @dataclass
 class AdmissionConfig:
     """Knobs for :class:`AdmissionController` and the engine's degraded
@@ -144,6 +197,11 @@ class AdmissionConfig:
     # measured service rate (margin > 1 sheds earlier)
     deadline_feasibility: bool = True
     feasibility_margin: float = 1.0
+    # per-tenant isolation: policies keyed by tenant name ("*" is the
+    # fallback for unlisted tenants). Any policy — or wfq=True — turns
+    # on WFQ queue tagging; quotas only meter tenants with a rate.
+    tenants: Dict[str, TenantPolicy] = field(default_factory=dict)
+    wfq: bool = False
 
     def __post_init__(self):
         if self.max_queue < 1:
@@ -174,6 +232,11 @@ class AdmissionController:
         self.level = 0
         self.delay_ewma = 0.0
         self._since_change = self.config.level_hold  # free first move
+        # per-tenant isolation state (SFQ virtual time + token buckets)
+        self._vtime = 0.0
+        self._tenant_finish: Dict[str, float] = {}
+        self._buckets: Dict[str, list] = {}  # tenant -> [level, last_t]
+        self.n_quota_shed = 0
         # obs registry mirror (ISSUE 12): the controller's adaptive
         # state, readable from `python -m paddle_tpu.obs dump` without
         # holding a reference to the engine
@@ -227,6 +290,71 @@ class AdmissionController:
             d = self.delay_ewma / cfg.target_delay_s
         return max(q, d)
 
+    # -- per-tenant isolation -------------------------------------------
+    @property
+    def wfq_enabled(self) -> bool:
+        return self.config.wfq or bool(self.config.tenants)
+
+    def _policy(self, tenant: str) -> TenantPolicy:
+        t = self.config.tenants
+        return t.get(tenant) or t.get("*") or _DEFAULT_POLICY
+
+    @staticmethod
+    def _cost(req) -> float:
+        return float(int(req.prompt.size) + int(req.max_new_tokens))
+
+    def wfq_tag(self, tenant: str, cost: float) -> Tuple[float, float]:
+        """Start-time-fair-queueing tags for one admission:
+        ``start = max(vtime, tenant's last finish)``,
+        ``finish = start + cost / weight``. The engine orders its queue
+        by the finish tag (within a priority class) and reports served
+        start tags back via :meth:`wfq_served`."""
+        w = self._policy(tenant).weight
+        start = max(self._vtime, self._tenant_finish.get(tenant, 0.0))
+        finish = start + float(cost) / w
+        self._tenant_finish[tenant] = finish
+        return start, finish
+
+    def wfq_served(self, start: Optional[float]) -> None:
+        """Service feedback: virtual time advances to the start tag of
+        the request entering service (SFQ). This is what lets a newly
+        arrived quiet tenant tag *at* vtime and overtake a hot tenant's
+        queued backlog."""
+        if start is not None:
+            self._vtime = max(self._vtime, float(start))
+
+    def _bucket_level(self, tenant: str, pol: TenantPolicy,
+                      now: float) -> float:
+        """Refilled bucket level (does not deduct)."""
+        burst = pol.burst
+        b = self._buckets.get(tenant)
+        if b is None:
+            b = self._buckets[tenant] = [burst, now]
+        level, last = b
+        level = min(burst, level + max(now - last, 0.0)
+                    * pol.rate_tokens_per_s)
+        b[0], b[1] = level, now
+        return level
+
+    def _quota_verdict(self, req) -> bool:
+        """True when the tenant's bucket covers this request's cost.
+        Unmetered tenants always pass."""
+        tenant = getattr(req, "tenant", "default")
+        pol = self._policy(tenant)
+        if pol.rate_tokens_per_s is None:
+            return True
+        return self._bucket_level(tenant, pol, self._clock()) \
+            >= self._cost(req)
+
+    def _quota_charge(self, req) -> None:
+        tenant = getattr(req, "tenant", "default")
+        pol = self._policy(tenant)
+        if pol.rate_tokens_per_s is None:
+            return
+        b = self._buckets.get(tenant)
+        if b is not None:
+            b[0] = max(b[0] - self._cost(req), 0.0)
+
     # -- the decision ---------------------------------------------------
     def decide(self, req, load: EngineLoad) -> Tuple[str, str]:
         """Verdict for one submission: ``("admit", "")``,
@@ -236,6 +364,9 @@ class AdmissionController:
         ``priority``, ``prompt``, ``max_new_tokens``, ``deadline``/
         ``expired()`` — the engine's GenRequest shape."""
         verdict = self._decide(req, load)
+        if verdict[0] in ("admit", "displace"):
+            # charge the tenant bucket only for work actually taken on
+            self._quota_charge(req)
         self._reg.counter(
             "admission_decisions_total",
             {"verdict": verdict[0],
@@ -248,6 +379,11 @@ class AdmissionController:
         if req.expired():
             # fast path: a dead-on-arrival budget never enters the queue
             return ("shed", "expired-at-submit")
+        if not self._quota_verdict(req):
+            # over-quota tenants shed at the front door regardless of
+            # engine health: isolation, not overload control
+            self.n_quota_shed += 1
+            return ("shed", "tenant-quota")
         if self.level >= 2:
             return ("shed", "overload")
         if self.level >= 1 and rank >= 1:
@@ -286,4 +422,8 @@ class AdmissionController:
             "delay_ewma_s": self.delay_ewma,
             "target_delay_s": self.config.target_delay_s,
             "max_queue": self.config.max_queue,
+            "wfq": self.wfq_enabled,
+            "vtime": self._vtime,
+            "n_quota_shed": self.n_quota_shed,
+            "buckets": {t: b[0] for t, b in self._buckets.items()},
         }

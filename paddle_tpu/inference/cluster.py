@@ -450,10 +450,15 @@ class ClusterRouter:
         self.delay_weight = float(delay_weight)
         self.latency_weight = float(latency_weight)
         self.blocked_weight = float(blocked_weight)
+        self._max_prefix_nodes = int(max_prefix_nodes)
         self._prefix = [PrefixCache(self.block_size,
-                                    max_nodes=max_prefix_nodes)
+                                    max_nodes=self._max_prefix_nodes)
                         for _ in self.replicas]
         self._sessions: Dict[str, int] = {}
+        # replicas being scaled down: zero-capacity for NEW placements,
+        # but session follow-ups still land on them (their KV/prefix
+        # state is there) until the autoscaler retires them
+        self.draining: Set[int] = set()
         self.inflight: Dict[object, Tuple[dict, int]] = {}
         # accepted records with NO live replica to take them (a total-
         # outage window): parked here, re-placed by every step() until
@@ -514,8 +519,22 @@ class ClusterRouter:
             raise NoLiveReplica(
                 f"no live replica ({len(self.replicas)} configured, "
                 f"{sorted(self.dead)} dead, {list(exclude)} excluded)")
-        loads = {i: self.replicas[i].load() for i in live}
-        best = min(live, key=lambda i: (
+        # a draining replica is ZERO-capacity for new placements — only
+        # a session already pinned to it may follow (its KV/prefix
+        # state lives there, and re-placing follow-ups elsewhere would
+        # keep the drain from ever finishing the conversation). If the
+        # whole fleet is draining, serve anyway: drain is a preference,
+        # refusal is an outage.
+        cands = [i for i in live if i not in self.draining]
+        pinned = self._sessions.get(session) if session is not None \
+            else None
+        if pinned is not None and pinned in live \
+                and pinned in self.draining:
+            cands.append(pinned)
+        if not cands:
+            cands = live
+        loads = {i: self.replicas[i].load() for i in cands}
+        best = min(cands, key=lambda i: (
             self._score(i, loads[i], prompt, session),
             self.n_routed[i], i))
         if not _chaos.inject("cluster.route"):
@@ -597,6 +616,7 @@ class ClusterRouter:
         accepted request can never be lost between the two."""
         rep = self.replicas[idx]
         self.dead.add(idx)
+        self.draining.discard(idx)  # a mid-drain death is just a death
         self.n_recoveries += 1
         try:  # last published results (process replicas: still in store)
             for rec in rep.poll_completed():
@@ -677,6 +697,72 @@ class ClusterRouter:
             placed += 1
         return placed
 
+    # -- fleet membership (the autoscaler's surface) ---------------------
+    def add_replica(self, rep) -> int:
+        """Join a freshly spawned replica to the rotation; returns its
+        index. The router starts it with an empty prefix tree and zero
+        routed count — affinity warms up as traffic lands."""
+        self.replicas.append(rep)
+        self._prefix.append(PrefixCache(self.block_size,
+                                        max_nodes=self._max_prefix_nodes))
+        self.n_routed.append(0)
+        idx = len(self.replicas) - 1
+        self.events.append(("replica-added", rep.replica_id))
+        return idx
+
+    def mark_draining(self, idx: int) -> None:
+        """Take ``idx`` out of NEW-placement rotation (in-flight work
+        and session follow-ups keep landing on it)."""
+        idx = int(idx)
+        if idx in self.dead:
+            raise ValueError(f"replica {idx} is dead, cannot drain")
+        self.draining.add(idx)
+        self.events.append(("replica-draining",
+                            self.replicas[idx].replica_id))
+
+    def clear_draining(self, idx: int) -> None:
+        """Cancel a drain (scale-up won the race): back in rotation."""
+        self.draining.discard(int(idx))
+
+    def inflight_on(self, idx: int) -> int:
+        """Router-table entries currently placed on ``idx``."""
+        idx = int(idx)
+        return sum(1 for _, where in self.inflight.values()
+                   if where == idx)
+
+    def drained(self, idx: int) -> bool:
+        """True when a draining replica has quiesced: nothing in the
+        routing table points at it and its local queue is empty — safe
+        to retire without recovery."""
+        idx = int(idx)
+        if self.inflight_on(idx):
+            return False
+        try:
+            return not self.replicas[idx].pending()
+        except Exception:  # noqa: BLE001 — an unreachable replica is
+            return True    # not quiescable; retire falls back to kill
+
+    def retire_replica(self, idx: int, deadline=None) -> None:
+        """Remove a QUIESCED draining replica from the fleet: its
+        prefix tree is forfeited (the radix state dies with it — the
+        cluster-level cache re-warms on the survivors), pinned sessions
+        are released for re-placement, and NO recovery runs — a clean
+        drain has nothing to recover. A replica that dies mid-drain
+        instead goes through :meth:`recover_replica` like any other
+        death (journal-∪-table requeue; zero accepted requests lost)."""
+        idx = int(idx)
+        rep = self.replicas[idx]
+        self.draining.discard(idx)
+        self.dead.add(idx)
+        self._prefix[idx].clear()
+        self._sessions = {s: i for s, i in self._sessions.items()
+                          if i != idx}
+        try:
+            rep.stop(deadline=deadline)
+        except Exception:  # noqa: BLE001 — already-gone is fine here
+            pass
+        self.events.append(("replica-retired", rep.replica_id))
+
     # -- the drive loop --------------------------------------------------
     def step(self) -> List[dict]:
         """One router tick: pump in-process replicas, harvest results,
@@ -746,6 +832,7 @@ class ClusterRouter:
         return _obs.health_envelope("router", {
             "replicas": reps,
             "dead": sorted(self.dead),
+            "draining": sorted(self.draining),
             "inflight": len(self.inflight),
             "orphans": len(self.orphans),
             "results": len(self.results),

@@ -444,7 +444,10 @@ class ContinuousBatchingEngine:
                  kv_dtype: Optional[str] = None,
                  fp8: bool = False,
                  role: str = "unified",
-                 overlap: bool = False):
+                 overlap: bool = False,
+                 cache_tier=None,
+                 tenant_namespaces: bool = False,
+                 shared_prefixes=None):
         """``num_blocks`` fixes the HBM budget (the pool allocates one
         extra trash block); ``max_len`` bounds any sequence's positions
         (tables carry ceil(max_len/block_size) slots per row);
@@ -516,7 +519,23 @@ class ContinuousBatchingEngine:
         priority queue, and the KV watermarks drive the degraded modes
         (pause new admissions / clamp batch token grants). Without it
         the queue stays plain FIFO and every submission is accepted —
-        the pre-overload-control behaviour, bit for bit.
+        the pre-overload-control behaviour, bit for bit. Tenant
+        policies in the config additionally turn on token-bucket quotas
+        and WFQ queue ordering (see :mod:`.admission`).
+
+        ``cache_tier=HostTier(...)`` adds a host-RAM spill tier under
+        the prefix cache (requires ``prefix_cache=True``): registered
+        prefixes are written through to host memory as CRC-framed
+        exports, and a prompt whose HBM radix hit is shorter than a
+        spilled prefix imports it back before reservation — prefix
+        capacity becomes a host-memory budget instead of an HBM one.
+
+        ``tenant_namespaces=True`` keys the prefix cache by tenant so
+        one tenant's prompts never adopt another's KV. Token sequences
+        in ``shared_prefixes`` (common system prompts) are additionally
+        registered under a shared namespace every tenant may adopt from
+        — the physical blocks are multi-pinned and copy-on-write, so
+        isolation costs nothing for the prompts everyone shares.
         """
         if role not in ("unified", "prefill_only", "decode_only"):
             raise ValueError(
@@ -568,6 +587,18 @@ class ContinuousBatchingEngine:
         self.manager = BlockManager(num_blocks, block_size)
         self.prefix_cache = (PrefixCache(block_size, manager=self.manager)
                              if prefix_cache else None)
+        if cache_tier is not None and self.prefix_cache is None:
+            raise ValueError("cache_tier requires prefix_cache=True")
+        if tenant_namespaces and self.prefix_cache is None:
+            raise ValueError("tenant_namespaces requires prefix_cache=True")
+        self.cache_tier = cache_tier
+        self._tenant_ns = bool(tenant_namespaces)
+        self._shared_prefixes = [
+            np.asarray(p, np.int32).reshape(-1)
+            for p in (shared_prefixes or ())]
+        self._tier_seq = 0
+        self.tier_restores = 0
+        self.tier_restore_tokens = 0
         self.prefix_hit_tokens = 0
         self.prefix_forks = 0
         self._trash = num_blocks  # reserved sacrificial pool row
@@ -1182,11 +1213,18 @@ class ContinuousBatchingEngine:
 
     def _enqueue(self, req: GenRequest):
         """Priority insert: interactive ahead of batch; within a class,
+        WFQ virtual-finish tag first (0.0 for every request when WFQ is
+        off — ordering is then exactly the pre-WFQ behaviour), then
         tighter deadline first (unbounded budgets last, arrival order
         preserved — the sort key is fixed at insert time)."""
         rem = (float("inf") if req.deadline is None
                else req.deadline.remaining())
-        req._okey = (priority_rank(req.priority), rem)
+        tag = 0.0
+        if self.admission is not None and self.admission.wfq_enabled:
+            start, tag = self.admission.wfq_tag(
+                req.tenant, self.admission._cost(req))
+            req._wfq_start = start
+        req._okey = (priority_rank(req.priority), tag, rem)
         lo = 0
         while lo < len(self._queue) and self._queue[lo]._okey <= req._okey:
             lo += 1
@@ -1318,6 +1356,10 @@ class ContinuousBatchingEngine:
                 "lookups": tree["lookups"],
                 "evicted_blocks": tree["evicted_blocks"],
             })
+        if self.cache_tier is not None:
+            base["tier"] = dict(self.cache_tier.stats(),
+                                restores=self.tier_restores,
+                                restore_tokens=self.tier_restore_tokens)
         return base
 
     def spec_stats(self) -> dict:
@@ -1449,6 +1491,107 @@ class ContinuousBatchingEngine:
             self._pools, jnp.asarray(src, jnp.int32),
             jnp.asarray(dst, jnp.int32))
 
+    # -- prefix namespaces + host tier ----------------------------------
+    def _shared_prefix_len(self, prompt) -> int:
+        """Longest registered shared system prompt that prefixes
+        ``prompt``, rounded DOWN to full blocks (only full blocks enter
+        the tree)."""
+        best = 0
+        psize = int(prompt.size)
+        for sp in self._shared_prefixes:
+            n = int(sp.size)
+            if n <= psize and n > best and np.array_equal(prompt[:n], sp):
+                best = n
+        return (best // self.block_size) * self.block_size
+
+    def _prefix_lookup(self, req):
+        """Namespace-aware radix lookup: the tenant's own tree, or the
+        shared-system-prompt tree when it covers more of the prompt."""
+        if not self._tenant_ns:
+            return self.prefix_cache.lookup(req.prompt)
+        n_t, b_t = self.prefix_cache.lookup(req.prompt, ns=req.tenant)
+        n_s, b_s = self.prefix_cache.lookup(req.prompt, ns="*")
+        return (n_t, b_t) if n_t >= n_s else (n_s, b_s)
+
+    def _prefix_insert(self, req, blocks) -> None:
+        """Register a freshly prefilled prompt's blocks: the tenant's
+        namespace (or the default tree), plus the shared namespace for
+        any registered system-prompt prefix (same physical blocks,
+        multi-pinned — COW sharing across tenants), plus a write-
+        through spill of the full-block prefix to the host tier."""
+        ns = req.tenant if self._tenant_ns else None
+        self.prefix_cache.insert(req.prompt, blocks, ns=ns)
+        if self._tenant_ns:
+            sh = self._shared_prefix_len(req.prompt)
+            if sh:
+                self.prefix_cache.insert(
+                    req.prompt[:sh], blocks[:sh // self.block_size],
+                    ns="*")
+        self._tier_spill(req, ns)
+
+    def _tier_spill(self, req, ns) -> None:
+        if self.cache_tier is None:
+            return
+        full = (int(req.prompt.size) // self.block_size) * self.block_size
+        if not full:
+            return
+        try:
+            pages, scales, meta = self.manager.export_blocks(
+                req.req_id, self._pools, num_tokens=full)
+            self.cache_tier.put(ns, req.prompt[:full], pages, scales, meta)
+            if self._tenant_ns:
+                sh = self._shared_prefix_len(req.prompt)
+                if sh:
+                    k = sh // self.block_size
+                    self.cache_tier.put(
+                        "*", req.prompt[:sh], pages[:, :, :, :k],
+                        None if scales is None
+                        else scales[:, :, :, :k],
+                        dict(meta, num_blocks=k))
+        except Exception:
+            # spill is strictly best-effort: a failed export must never
+            # fail the request that triggered it (chaos 'error' lands
+            # here too — the frame is simply not stored, i.e. a miss)
+            pass
+
+    def _tier_restore(self, req) -> None:
+        """Read-through: when the host tier holds a longer prefix than
+        the HBM radix tree, import it into fresh blocks and pin it —
+        the normal adoption path then treats it as an ordinary hit.
+        Any failure (CRC-rejected frame, pool too full even after
+        eviction) is a miss, never an error."""
+        if self.cache_tier is None:
+            return
+        cached_len, _ = self._prefix_lookup(req)
+        ns = req.tenant if self._tenant_ns else None
+        hit = self.cache_tier.lookup(
+            ns, req.prompt, block_size=self.block_size,
+            min_tokens=cached_len)
+        if hit is None and self._tenant_ns:
+            hit = self.cache_tier.lookup(
+                "*", req.prompt, block_size=self.block_size,
+                min_tokens=cached_len)
+        if hit is None:
+            return
+        n_tokens, pages, scales, meta = hit
+        need = int(meta["num_blocks"])
+        if need > self.manager.free_blocks:
+            self.prefix_cache.evict(need - self.manager.free_blocks)
+        sid = ("__tier__", self._tier_seq)
+        self._tier_seq += 1
+        try:
+            self._pools, blocks = self.manager.import_blocks(
+                sid, pages, scales, meta, self._pools)
+        except (BlockImportError, ValueError):
+            return  # pool genuinely full / config drift: plain miss
+        # pin under the tree first (new nodes take their own refs),
+        # then drop the import's ownership — surviving refs are the
+        # cache pins alone, exactly like post-free_sequence reuse
+        self.prefix_cache.insert(req.prompt[:n_tokens], blocks, ns=ns)
+        self.manager.free_sequence(sid)
+        self.tier_restores += 1
+        self.tier_restore_tokens += int(n_tokens)
+
     def _reserve_blocks(self, req, eff_new: int):
         """Block-availability half of slot binding, prefix-cache aware.
         Looks up the prompt's cached prefix, ADOPTS those blocks
@@ -1468,7 +1611,8 @@ class ContinuousBatchingEngine:
         psize = int(req.prompt.size)
         cached_len, cached_blocks = 0, []
         if self.prefix_cache is not None:
-            cached_len, cached_blocks = self.prefix_cache.lookup(req.prompt)
+            self._tier_restore(req)
+            cached_len, cached_blocks = self._prefix_lookup(req)
             if cached_len >= psize:
                 cached_len = psize - 1
         will_fork = bool(cached_len % self.block_size)
@@ -1575,6 +1719,11 @@ class ContinuousBatchingEngine:
                 prompt_tokens=int(req.prompt.size),
                 cached_tokens=int(cached_len))
             self._queue.pop(0)  # bound above: leaves the queue LAST
+            if self.admission is not None:
+                # WFQ service feedback: virtual time advances to the
+                # start tag of the request entering service
+                self.admission.wfq_served(getattr(req, "_wfq_start",
+                                                  None))
 
             if self.chunked:
                 slot.prefill_pos = cached_len
@@ -1609,7 +1758,7 @@ class ContinuousBatchingEngine:
                 # the prompt's full blocks now hold its exact KV: pin
                 # them for reuse BEFORE a possible same-step finish
                 # frees the sequence's own references
-                self.prefix_cache.insert(req.prompt, blocks)
+                self._prefix_insert(req, blocks)
             if self.overlap:
                 # the first token rides the copy ring; until it lands
                 # the slot must not join a decode dispatch
@@ -1921,8 +2070,8 @@ class ContinuousBatchingEngine:
                     if self.prefix_cache is not None:
                         # pin the finished prompt's full blocks before
                         # a same-chunk finish frees the sequence
-                        self.prefix_cache.insert(
-                            slot.req.prompt,
+                        self._prefix_insert(
+                            slot.req,
                             self.manager.owned_blocks(slot.req.req_id))
                     done_rows.append((i, slot.req))
             if done_rows:

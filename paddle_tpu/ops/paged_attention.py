@@ -383,6 +383,17 @@ class PrefixCache:
 
     Only FULL blocks enter the tree (a partial tail block keeps
     receiving decode writes, so sharing it would alias live state).
+
+    **Namespaces**: ``lookup``/``insert`` accept an optional ``ns`` key
+    selecting an independent tree root (``None`` = the default root).
+    The serving engine keys namespaces by tenant so one tenant's prompts
+    never match another's, while a designated shared namespace holds
+    common system prompts whose physical blocks are pinned from several
+    namespaces at once (ref-counted COW sharing: a cross-tenant adopter
+    forks before writing, exactly like any other prefix hit). LRU state
+    (clock, leaf registry, eviction) is global across namespaces — a
+    cold tenant's tree shrinks first regardless of where pressure
+    originated.
     """
 
     def __init__(self, block_size: int, manager: Optional[BlockManager]
@@ -391,6 +402,7 @@ class PrefixCache:
         self.manager = manager
         self.max_nodes = max_nodes
         self.root = _PrefixNode()
+        self._ns_roots: Dict[str, _PrefixNode] = {}
         self._clock = 0
         self._nodes = 0
         # incremental leaf registry (id(node) -> node): eviction picks
@@ -412,14 +424,22 @@ class PrefixCache:
         self._clock += 1
         node.stamp = self._clock
 
-    def lookup(self, tokens) -> Tuple[int, List[int]]:
+    def _root_for(self, ns) -> _PrefixNode:
+        if ns is None:
+            return self.root
+        root = self._ns_roots.get(ns)
+        if root is None:
+            root = self._ns_roots[ns] = _PrefixNode()
+        return root
+
+    def lookup(self, tokens, ns=None) -> Tuple[int, List[int]]:
         """Longest cached prefix of ``tokens``: returns
         ``(n_tokens, blocks)`` where ``n_tokens`` is a multiple of
         ``block_size`` and ``blocks`` the pinned physical blocks in
         logical order (empty in matcher mode). Touches the matched path
-        for LRU."""
+        for LRU. ``ns`` selects a namespace tree (None = default)."""
         self.lookups += 1
-        node, blocks, n = self.root, [], 0
+        node, blocks, n = self._root_for(ns), [], 0
         for key in self._chunks(tokens):
             child = node.children.get(key)
             if child is None:
@@ -434,11 +454,15 @@ class PrefixCache:
             self.hit_tokens += n
         return n, blocks
 
-    def insert(self, tokens, blocks: Optional[List[int]] = None) -> int:
+    def insert(self, tokens, blocks: Optional[List[int]] = None,
+               ns=None) -> int:
         """Register ``tokens``' full blocks. Idempotent: existing nodes
         are kept (their pinned block stays authoritative); each NEW node
         pins its block (manager mode). Returns the number of new nodes.
-        ``blocks`` must cover every full chunk in manager mode."""
+        ``blocks`` must cover every full chunk in manager mode. ``ns``
+        selects a namespace tree (None = default); inserting the same
+        physical blocks under two namespaces double-pins them, which is
+        exactly the COW-sharing contract for common system prompts."""
         chunks = self._chunks(tokens)
         if self.manager is not None:
             if blocks is None or len(blocks) < len(chunks):
@@ -446,7 +470,7 @@ class PrefixCache:
                     f"insert needs one block per full chunk: "
                     f"{len(chunks)} chunks, "
                     f"{0 if blocks is None else len(blocks)} blocks")
-        node, created = self.root, 0
+        node, created = self._root_for(ns), 0
         for i, key in enumerate(chunks):
             child = node.children.get(key)
             if child is None:
@@ -482,7 +506,8 @@ class PrefixCache:
         self._nodes -= 1
         self._leaf_reg.pop(id(leaf), None)
         parent = leaf.parent
-        if parent is not self.root and not parent.children:
+        # namespace roots (key is None) never enter the leaf registry
+        if parent.key is not None and not parent.children:
             self._leaf_reg[id(parent)] = parent
         return freed
 
@@ -522,6 +547,7 @@ class PrefixCache:
     def stats(self) -> dict:
         return {
             "nodes": self._nodes,
+            "namespaces": 1 + len(self._ns_roots),
             "lookups": self.lookups,
             "hits": self.hits,
             "hit_tokens": self.hit_tokens,

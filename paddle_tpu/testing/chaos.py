@@ -77,6 +77,19 @@ Instrumented sites (grep for ``chaos.inject``):
   the seeded preemption that shakes latent lock-order interleavings
   out of the chaos-driven tests. The release itself always happens
   (``drop`` is ignored)
+- ``scale.spawn``        — each autoscaler replica spawn
+  (inference/autoscale.py); ``drop`` or ``error`` fails the spawn —
+  the controller backs off exponentially (bounded), keeps its loop,
+  and withholds its heartbeat so an ``AbsenceRule`` pages: never a
+  crash-loop
+- ``scale.drain``        — each autoscaler drain start
+  (inference/autoscale.py); a ``drop`` SIGKILLs the victim MID-DRAIN
+  (``InProcessReplica.kill``) — the router's journal-∪-table
+  recovery must requeue its accepted work with zero losses
+- ``cache.spill``        — each host-tier prefix-KV frame store
+  (inference/cache_tier.py); a byte site — ``corrupt`` flips a
+  payload bit (the CRC check rejects the frame at lookup: a cache
+  miss, never a wrong-token serve), ``drop`` loses the spill
 
 Faults (``Fault.kind``): ``hang``/``slow`` (sleep ``arg`` seconds;
 ``hang`` requires a positive arg), ``reset`` (raise

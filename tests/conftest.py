@@ -148,6 +148,14 @@ def pytest_configure(config):
         "under seeded flapping, absence detection, bench-ledger "
         "regression verdicts, CLI exit codes, loadgen parity "
         "(quick-lane; standalone via `pytest -m alerts`)")
+    config.addinivalue_line(
+        "markers",
+        "autoscale: closed-loop fleet-control suite — burn-driven "
+        "scale-up/-down hysteresis, feed-forward floor, chaos spawn "
+        "backoff + alert visibility, draining placement, mid-drain "
+        "SIGKILL zero-loss, WFQ/token-bucket tenant isolation, and "
+        "the host-RAM prefix-cache tier (quick-lane; standalone via "
+        "`pytest -m autoscale`)")
 
 
 def pytest_collection_modifyitems(config, items):

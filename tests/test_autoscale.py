@@ -1,0 +1,443 @@
+"""Closed-loop fleet control (ISSUE 19 tentpole): the FleetAutoscaler
+over a ClusterRouter, per-tenant WFQ/quota isolation, and the router's
+draining placement semantics.
+
+Layers of proof:
+
+- ``TestController`` — model-free controller units over fake replicas
+  and a scripted alert feed: burn-breach scale-up with cooldown,
+  budget-hysteresis + hold scale-down, feed-forward floor pre-warming,
+  chaos spawn failure (bounded backoff, never a crash-loop, and
+  alert-VISIBLE via the withheld heartbeat + failure gauge), drain
+  timeout falling back to crash-only recovery.
+- ``TestRouterDraining`` — the placement fix: a draining replica is
+  zero-capacity for NEW requests while session follow-ups still land
+  on it; an all-draining fleet serves anyway.
+- ``TestDrainKillZeroLoss`` — real engines: chaos SIGKILLs the drain
+  victim MID-DRAIN with accepted work on it; journal-∪-table recovery
+  finishes everything — zero accepted requests lost.
+- ``TestTenantIsolation`` — WFQ tag algebra (a cold tenant's first
+  arrival overtakes a hot backlog; weights split service), token-bucket
+  quota verdicts deterministic under an injected clock.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    EngineLoad,
+    TenantPolicy,
+)
+from paddle_tpu.inference.autoscale import AutoscalerConfig, FleetAutoscaler
+from paddle_tpu.inference.cluster import ClusterRouter, InProcessReplica
+from paddle_tpu.obs.alerts import AlertManager
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosSchedule
+
+pytestmark = pytest.mark.autoscale
+
+
+@pytest.fixture(autouse=True)
+def _clean_monkey():
+    yield
+    chaos.uninstall()
+
+
+def _idle_load():
+    return {"queue_depth": 0, "queue_limit": 8, "kv_occupancy": 0.0,
+            "est_queue_delay_s": 0.0, "ewma_step_s": None}
+
+
+class _FakeReplica:
+    """Controller unit-test stand-in: static load, scripted liveness,
+    records submissions; ``busy`` keeps :meth:`pending` True so a drain
+    can never quiesce."""
+
+    def __init__(self, replica_id, load=None, busy=False):
+        self.replica_id = replica_id
+        self.journal_dir = None
+        self._load = load if load is not None else _idle_load()
+        self._dead = False
+        self._busy = busy
+        self.submitted = []
+
+    def alive(self):
+        return not self._dead
+
+    def kill(self):
+        self._dead = True
+
+    def submit(self, rec):
+        self.submitted.append(rec)
+
+    def poll_completed(self):
+        return []
+
+    def load(self):
+        return self._load
+
+    def pending(self):
+        return self._busy
+
+    def pump(self, deadline=None):
+        pass
+
+    def stop(self, deadline=None):
+        self._dead = True
+
+
+class _ScriptedAlerts:
+    """Stands in for AlertManager: one burn status whose state/budget
+    the test scripts directly."""
+
+    def __init__(self, firing=False, budget=1.0):
+        self.firing = firing
+        self.budget = budget
+
+    def maybe_evaluate(self, *, min_interval_s=0.25):
+        pass
+
+    def statuses(self):
+        return [{
+            "state": "firing" if self.firing else "inactive",
+            "annotations": {"budget_remaining_frac": self.budget},
+        }]
+
+
+def _fleet(n=1, alerts=None, feedforward=None, **cfg_over):
+    cfg_kw = dict(min_replicas=1, max_replicas=3,
+                  scale_up_cooldown_s=1.0, scale_down_cooldown_s=0.0,
+                  recover_budget_frac=0.5, recover_hold_s=1.0,
+                  spawn_backoff_s=0.5, drain_timeout_s=30.0,
+                  evaluate_interval_s=0.0)
+    cfg_kw.update(cfg_over)
+    router = ClusterRouter([_FakeReplica(f"r{i}") for i in range(n)],
+                           block_size=4)
+    scaler = FleetAutoscaler(
+        router, lambda rid: _FakeReplica(rid),
+        config=AutoscalerConfig(**cfg_kw), alerts=alerts,
+        feedforward=feedforward, clock=lambda: 0.0)
+    return router, scaler
+
+
+class TestController:
+    def test_burn_breach_scales_up_under_cooldown(self):
+        al = _ScriptedAlerts(firing=True, budget=-0.5)
+        router, scaler = _fleet(1, alerts=al)
+        assert scaler.step(now=0.0)["action"] == "scale-up"
+        assert len(router.replicas) == 2
+        # cooldown: still firing, but no second spawn yet
+        assert scaler.step(now=0.5)["action"] == "hold"
+        assert scaler.step(now=1.5)["action"] == "scale-up"
+        assert len(router.replicas) == 3
+        # at max_replicas: breach alone can't grow the fleet further
+        assert scaler.step(now=3.0)["action"] == "hold"
+        assert len(router.replicas) == 3
+
+    def test_scale_down_needs_budget_hold(self):
+        al = _ScriptedAlerts(firing=False, budget=0.1)
+        router, scaler = _fleet(2, alerts=al)
+        # budget below the hysteresis bar: no drain, ever
+        assert scaler.step(now=0.0)["action"] == "hold"
+        assert router.draining == set()
+        # budget recovers — but must HOLD for recover_hold_s first
+        al.budget = 0.9
+        assert scaler.step(now=1.0)["action"] == "hold"
+        assert scaler.step(now=1.5)["action"] == "hold"
+        # a dip mid-hold resets the timer
+        al.budget = 0.2
+        assert scaler.step(now=1.8)["action"] == "hold"
+        al.budget = 0.9
+        assert scaler.step(now=2.0)["action"] == "hold"
+        assert scaler.step(now=2.5)["action"] == "hold"
+        rec = scaler.step(now=3.1)
+        assert rec["action"] == "drain-start"
+        assert len(router.draining) == 1
+        # the idle fake quiesces instantly: next step retires it
+        scaler.step(now=3.2)
+        acts = [d["action"] for d in scaler.decisions]
+        assert "scale-down" in acts
+        assert len(scaler._live_idxs()) == 1
+
+    def test_min_replicas_floor_never_drained(self):
+        al = _ScriptedAlerts(firing=False, budget=1.0)
+        router, scaler = _fleet(1, alerts=al)
+        for t in (0.0, 2.0, 4.0, 6.0):
+            assert scaler.step(now=t)["action"] == "hold"
+        assert router.draining == set()
+
+    def test_feedforward_floor_prewarms(self):
+        router, scaler = _fleet(
+            1, alerts=_ScriptedAlerts(), feedforward=lambda now: 3.0,
+            feedforward_headroom=1.0)
+        assert scaler.step(now=0.0)["action"] == "scale-up"
+        assert scaler.step(now=0.1)["action"] == "scale-up"
+        assert len(router.replicas) == 3
+        assert scaler.step(now=0.2)["action"] == "hold"
+        reasons = {d["reason"] for d in scaler.decisions
+                   if d["action"] == "scale-up" and "reason" in d}
+        assert reasons == {"feedforward-floor"}
+        # a broken hint degrades to multiple=1.0, not a crash
+        scaler.feedforward = lambda now: 1 / 0
+        assert scaler.step(now=0.3)["floor"] == 1
+
+    def test_spawn_chaos_backs_off_and_pages(self):
+        chaos.install(ChaosSchedule(seed=1).every("scale.spawn", 1,
+                                                  "drop"))
+        router, scaler = _fleet(
+            1, alerts=_ScriptedAlerts(), feedforward=lambda now: 2.0,
+            feedforward_headroom=1.0, spawn_backoff_s=0.5,
+            spawn_backoff_max_s=2.0)
+        assert scaler.step(now=0.0)["action"] == "spawn-failed"
+        # inside the backoff window: no retry storm
+        assert scaler.step(now=0.1)["action"] == "spawn-backoff"
+        assert scaler.step(now=0.6)["action"] == "spawn-failed"
+        assert scaler.snapshot()["spawn_fail_streak"] == 2
+        # backoff is bounded: 0.5, 1.0, 2.0 (cap), 2.0 ...
+        fails = [d for d in scaler.decisions
+                 if d["action"] == "spawn-failed" and "backoff_s" in d]
+        assert [d["backoff_s"] for d in fails] == [0.5, 1.0]
+        # the stall is alert-visible: heartbeat withheld -> AbsenceRule
+        # fires; the consecutive-failure gauge trips its ThresholdRule
+        assert scaler.heartbeat_age(1.0) == math.inf
+        mgr = AlertManager(scaler.alert_rules(heartbeat_max_age_s=5.0),
+                           emit_trace=False)
+        mgr.evaluate(now=100.0,
+                     ages={"autoscaler": scaler.heartbeat_age(1.0)})
+        firing = {a["rule"] for a in mgr.firing()}
+        assert "autoscale_silent" in firing
+        assert "autoscale_spawn_failing" in firing
+        # fault lifts: the next due attempt succeeds, heartbeat returns
+        chaos.uninstall()
+        assert scaler.step(now=2.0)["action"] == "scale-up"
+        assert scaler.snapshot()["spawn_fail_streak"] == 0
+        assert scaler.heartbeat_age(2.0) == 0.0
+        mgr.evaluate(now=101.0,
+                     ages={"autoscaler": scaler.heartbeat_age(2.0)})
+        assert "autoscale_silent" not in {a["rule"]
+                                          for a in mgr.firing()}
+
+    def test_drain_timeout_falls_back_to_recovery(self):
+        al = _ScriptedAlerts(firing=False, budget=1.0)
+        router, scaler = _fleet(2, alerts=al, recover_hold_s=0.0,
+                                drain_timeout_s=5.0)
+        # make every replica un-quiesceable
+        for rep in router.replicas:
+            rep._busy = True
+        rec = scaler.step(now=0.0)
+        assert rec["action"] == "drain-start"
+        victim = rec["draining"][0]
+        assert scaler.step(now=2.0)["draining"] == [victim]
+        scaler.step(now=6.0)
+        acts = [d["action"] for d in scaler.decisions]
+        assert "drain-timeout" in acts
+        assert victim in router.dead  # crash-only recovery took it
+        assert router.health()["draining"] == []
+
+    def test_mid_drain_death_hands_off_to_router_recovery(self):
+        al = _ScriptedAlerts(firing=False, budget=1.0)
+        router, scaler = _fleet(2, alerts=al, recover_hold_s=0.0)
+        chaos.install(ChaosSchedule(seed=2).at("scale.drain", 1, "drop"))
+        rec = scaler.step(now=0.0)
+        assert rec["action"] == "drain-start"
+        victim = rec["draining"][0]
+        assert not router.replicas[victim].alive()  # chaos SIGKILL
+        scaler.step(now=0.1)
+        acts = [d["action"] for d in scaler.decisions]
+        assert "drain-died" in acts
+        assert victim not in scaler.snapshot()["draining"]
+
+
+class TestRouterDraining:
+    def test_draining_blocks_new_but_keeps_session_followups(self):
+        router = ClusterRouter([_FakeReplica("a"), _FakeReplica("b")],
+                               block_size=4)
+        # pin a session onto replica 0, then start draining it
+        assert router.submit("s0", np.arange(4), session="conv") == 0
+        router.mark_draining(0)
+        # follow-ups still land on the pinned draining replica...
+        assert router.submit("s1", np.arange(4), session="conv") == 0
+        # ...but NEW work gets zero capacity there
+        for i in range(3):
+            assert router.submit(f"n{i}", np.arange(8) + i) == 1
+        assert router.health()["draining"] == [0]
+        router.clear_draining(0)
+        assert router.health()["draining"] == []
+
+    def test_all_draining_still_serves(self):
+        router = ClusterRouter([_FakeReplica("a"), _FakeReplica("b")],
+                               block_size=4)
+        router.mark_draining(0)
+        router.mark_draining(1)
+        # drain is a preference; refusal would be an outage
+        assert router.submit("x", np.arange(4)) in (0, 1)
+
+    def test_drained_and_retire(self):
+        reps = [_FakeReplica("a"), _FakeReplica("b", busy=True)]
+        router = ClusterRouter(reps, block_size=4)
+        router.submit("q", np.arange(4), session="sess")
+        idx = router._sessions["sess"]
+        assert not router.drained(idx)  # inflight work
+        router.inflight.clear()
+        assert router.drained(0)
+        assert not router.drained(1)  # engine still has pending work
+        router.mark_draining(0)
+        router.retire_replica(0)
+        assert 0 in router.dead
+        assert router.health()["draining"] == []
+        # retire forfeits the radix tree and the session pins
+        assert router._prefix[0].stats()["nodes"] == 0
+        assert "sess" not in router._sessions or \
+            router._sessions["sess"] != 0 or idx != 0
+
+
+class TestDrainKillZeroLoss:
+    def test_mid_drain_sigkill_loses_zero_accepted_requests(
+            self, tmp_path):
+        """The acceptance proof with real engines: both replicas carry
+        accepted backlogs, a drain starts, chaos SIGKILLs the victim
+        mid-drain — journal-∪-table recovery must finish EVERY accepted
+        request on the survivor."""
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+
+        def factory():
+            return ContinuousBatchingEngine(
+                model, max_batch=1, max_len=32, block_size=8,
+                num_blocks=8, prompt_pad=8)
+
+        reps = [InProcessReplica(f"r{i}", factory,
+                                 journal_dir=str(tmp_path / f"r{i}"))
+                for i in range(2)]
+        router = ClusterRouter(reps, block_size=8)
+        rng = np.random.RandomState(7)
+        ids = []
+        # session-pin a 3-deep backlog onto each replica
+        for s, sess in enumerate(("left", "right")):
+            for j in range(3):
+                rid = f"{sess}{j}"
+                ids.append(rid)
+                router.submit(rid, rng.randint(0, 250, (5 + j,)),
+                              max_new_tokens=3, session=sess)
+        assert sorted(router._sessions.values()) == [0, 1]
+
+        chaos.install(ChaosSchedule(seed=3).at("scale.drain", 1, "drop"))
+        scaler = FleetAutoscaler(
+            router, lambda rid: _FakeReplica(rid),
+            config=AutoscalerConfig(
+                min_replicas=1, max_replicas=2, recover_hold_s=0.0,
+                scale_down_cooldown_s=0.0, evaluate_interval_s=0.0),
+            alerts=_ScriptedAlerts(firing=False, budget=1.0),
+            clock=lambda: 0.0)
+        rec = scaler.step(now=0.0)
+        assert rec["action"] == "drain-start"
+        victim = rec["draining"][0]
+        assert not router.replicas[victim].alive()  # killed MID-DRAIN
+
+        res = router.run(deadline=300)
+        scaler.step(now=1.0)  # sweep records the mid-drain death
+        for rid in ids:
+            assert res[rid]["status"] == "ok", res[rid]
+            assert len(res[rid]["out"]) > 0
+        assert router.n_recoveries == 1
+        assert router.poisoned_ids == []
+        acts = [d["action"] for d in scaler.decisions]
+        assert "drain-died" in acts
+
+
+def _req(tenant, prompt_len=20, max_new=30):
+    class _R:
+        pass
+
+    r = _R()
+    r.tenant = tenant
+    r.priority = "interactive"
+    r.prompt = np.zeros((prompt_len,), dtype=np.int32)
+    r.max_new_tokens = max_new
+    r.deadline = None
+    r.expired = lambda: False
+    return r
+
+
+class TestTenantIsolation:
+    def test_wfq_cold_tenant_overtakes_hot_backlog(self):
+        ctrl = AdmissionController(AdmissionConfig(wfq=True))
+        hot = [ctrl.wfq_tag("hot", 100.0) for _ in range(8)]
+        assert [f for _, f in hot] == [100.0 * (i + 1) for i in range(8)]
+        # serve three hot requests; virtual time follows served starts
+        for start, _ in hot[:3]:
+            ctrl.wfq_served(start)
+        # the cold tenant's FIRST arrival tags at current virtual time,
+        # overtaking the hot tenant's remaining backlog
+        c_start, c_finish = ctrl.wfq_tag("cold", 100.0)
+        assert c_start == 200.0
+        assert c_finish == 300.0
+        assert c_finish < hot[4][1]  # beats every un-served hot tag > 4
+
+    def test_wfq_weights_split_service(self):
+        ctrl = AdmissionController(AdmissionConfig(
+            wfq=True, tenants={"a": TenantPolicy(weight=1.0),
+                               "b": TenantPolicy(weight=2.0)}))
+        tags = [("a", ctrl.wfq_tag("a", 90.0)) for _ in range(3)]
+        tags += [("b", ctrl.wfq_tag("b", 100.0)) for _ in range(6)]
+        order = [t for t, _ in sorted(tags, key=lambda kv: kv[1][1])]
+        # finish tags a: 90/180/270, b: 50/100/.../300 — weight-2 b is
+        # served twice as often at near-equal per-request cost
+        assert order == ["b", "a", "b", "b", "a", "b", "b", "a", "b"]
+
+    def test_wfq_identical_streams_are_deterministic(self):
+        def run():
+            ctrl = AdmissionController(AdmissionConfig(wfq=True))
+            out = []
+            for i in range(12):
+                t = "hot" if i % 3 else "cold"
+                out.append(ctrl.wfq_tag(t, 10.0 + (i % 4)))
+                if i % 2:
+                    ctrl.wfq_served(out[-1][0])
+            return out
+
+        assert run() == run()
+
+    def test_token_bucket_quota_deterministic_verdicts(self):
+        clock_t = [0.0]
+        cfg = AdmissionConfig(tenants={
+            "hot": TenantPolicy(rate_tokens_per_s=50.0,
+                                burst_tokens=100.0)})
+        load = EngineLoad(queue_depth=0, queue_limit=16)
+
+        def run():
+            clock_t[0] = 0.0
+            ctrl = AdmissionController(cfg, clock=lambda: clock_t[0])
+            verdicts = []
+            # t=0: burst allows exactly two 50-token requests
+            for _ in range(3):
+                verdicts.append(ctrl.decide(_req("hot"), load)[0])
+            # unmetered tenant is untouched by the hot tenant's bucket
+            verdicts.append(ctrl.decide(_req("free"), load)[0])
+            clock_t[0] = 1.0  # refill: 50 tokens -> one more admit
+            verdicts.append(ctrl.decide(_req("hot"), load)[0])
+            verdicts.append(ctrl.decide(_req("hot"), load)[0])
+            return verdicts, ctrl.n_quota_shed
+
+        first, second = run(), run()
+        assert first == second
+        assert first == (["admit", "admit", "shed", "admit",
+                          "admit", "shed"], 2)
+
+    def test_quota_shed_reason_and_snapshot(self):
+        cfg = AdmissionConfig(tenants={
+            "t": TenantPolicy(rate_tokens_per_s=1.0, burst_tokens=1.0)})
+        ctrl = AdmissionController(cfg, clock=lambda: 0.0)
+        load = EngineLoad(queue_depth=0, queue_limit=16)
+        verdict, reason = ctrl.decide(_req("t"), load)
+        assert (verdict, reason) == ("shed", "tenant-quota")
+        snap = ctrl.snapshot()
+        assert snap["n_quota_shed"] == 1
+        assert snap["wfq"] is True  # tenant policies imply WFQ ordering

@@ -1,0 +1,275 @@
+"""Host-RAM prefix-cache tier (ISSUE 19): byte-exactness + failure matrix.
+
+Layers of proof:
+
+- ``TestFrameRoundTrip`` — model-free ``BlockManager`` export -> tier
+  ``put`` -> ``lookup`` -> ``import_blocks`` round trips, byte-exact
+  for bf16 pools (compared as raw uint16 words) AND int8 pools with
+  their scale rows carried; longest-block-aligned-prefix selection and
+  the ``min_tokens`` floor.
+- ``TestChaosSpill`` — the ``cache.spill`` chaos site: a ``corrupt``
+  fault is CRC-rejected at lookup (a miss, never bad KV) and the bad
+  frame is purged; a ``drop`` fault loses the spill silently
+  (``put_drops``) and a later re-put heals it.
+- ``TestLRUAndNamespaces`` — byte-budget LRU eviction (lookup
+  refreshes recency), idempotent re-puts, oversize-frame rejection,
+  and per-tenant namespace isolation (same tokens under two tenants
+  are distinct keys; neither leaks into the default namespace).
+- ``TestEngineRestore`` — the engine seam: a working set that
+  overflows the HBM pool replays TOKEN-EXACT through tier restores
+  (byte-exact KV => identical greedy argmax), with
+  ``prefix_stats()["tier"]`` accounting for the spills and restores.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.cache_tier import HostTier
+from paddle_tpu.ops.paged_attention import BlockManager
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosSchedule
+
+pytestmark = pytest.mark.autoscale
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    yield
+    chaos.uninstall()
+
+
+def _make_pools(layers=2, kvh=2, blocks=8, bs=4, d=8, dtype="bf16",
+                seed=0):
+    """KV pools shaped like the engine's: [kvh, blocks, bs, d] per k/v
+    per layer. ``dtype='int8'`` adds per-token scale rows, matching the
+    quantized-KV pool layout."""
+    rng = np.random.RandomState(seed)
+    pools = []
+    for _ in range(layers):
+        if dtype == "int8":
+            k = jnp.asarray(rng.randint(-127, 128, (kvh, blocks, bs, d)),
+                            jnp.int8)
+            v = jnp.asarray(rng.randint(-127, 128, (kvh, blocks, bs, d)),
+                            jnp.int8)
+            ks = jnp.asarray(rng.rand(kvh, blocks, bs), jnp.float32)
+            vs = jnp.asarray(rng.rand(kvh, blocks, bs), jnp.float32)
+            pools.append((k, v, ks, vs))
+        else:
+            k = jnp.asarray(rng.randn(kvh, blocks, bs, d), jnp.bfloat16)
+            v = jnp.asarray(rng.randn(kvh, blocks, bs, d), jnp.bfloat16)
+            pools.append((k, v))
+    return pools
+
+
+def _bits(a):
+    """Raw-word view for byte-exact comparison (bf16 has no native
+    numpy equality semantics worth trusting here)."""
+    a = np.asarray(a)
+    if a.dtype.itemsize == 2:
+        return a.view(np.uint16)
+    return a
+
+
+class TestFrameRoundTrip:
+    def test_bf16_roundtrip_byte_exact(self):
+        src = BlockManager(8, 4)
+        src.allocate("x", 10)  # 3 blocks, last partial
+        pools = _make_pools()
+        pages, scales, meta = src.export_blocks("x", pools, num_tokens=8)
+        assert scales is None and meta["num_blocks"] == 2
+
+        tier = HostTier()
+        tokens = np.arange(100, 108, dtype=np.int32)
+        assert tier.put("t0", tokens, pages, scales, meta)
+        hit = tier.lookup("t0", np.arange(100, 110), block_size=4)
+        assert hit is not None
+        n, rpages, rscales, rmeta = hit
+        assert n == 8 and rscales is None
+        np.testing.assert_array_equal(_bits(rpages), _bits(pages))
+
+        dst = BlockManager(16, 4)
+        dst.allocate("occupant", 12)  # different free-list shape
+        dpools = _make_pools(seed=9)
+        dpools, blocks = dst.import_blocks("x", rpages, rscales, rmeta,
+                                           dpools)
+        srow = np.asarray(src.owned_blocks("x"))[:2]
+        drow = np.asarray(blocks)
+        for es, ed in zip(pools, dpools):
+            for j in range(2):  # k, v
+                np.testing.assert_array_equal(
+                    _bits(np.asarray(es[j])[:, srow]),
+                    _bits(np.asarray(ed[j])[:, drow]))
+
+    def test_int8_scales_roundtrip_byte_exact(self):
+        src = BlockManager(8, 4)
+        src.allocate("q", 8)
+        pools = _make_pools(dtype="int8")
+        pages, scales, meta = src.export_blocks("q", pools, num_tokens=8)
+        assert pages.dtype == np.int8 and scales is not None
+        assert meta["quantized"]
+
+        tier = HostTier()
+        tokens = np.arange(8, dtype=np.int32)
+        assert tier.put(None, tokens, pages, scales, meta)
+        n, rpages, rscales, rmeta = tier.lookup(
+            None, tokens, block_size=4)
+        assert n == 8
+        np.testing.assert_array_equal(rpages, pages)
+        np.testing.assert_array_equal(rscales, scales)
+
+        dst = BlockManager(8, 4)
+        dpools = _make_pools(dtype="int8", seed=7)
+        dpools, blocks = dst.import_blocks("q", rpages, rscales, rmeta,
+                                           dpools)
+        srow = np.asarray(src.owned_blocks("q"))
+        drow = np.asarray(blocks)
+        for es, ed in zip(pools, dpools):
+            for j in range(4):  # k, v, k_scale, v_scale
+                np.testing.assert_array_equal(
+                    np.asarray(es[j])[:, srow],
+                    np.asarray(ed[j])[:, drow])
+
+    def test_longest_block_aligned_prefix_wins(self):
+        tier = HostTier()
+        toks = np.arange(16)
+        pages = np.zeros((1, 2, 4, 2), np.float32)
+        meta = {"num_blocks": 1}
+        tier.put(None, toks[:4], pages, None, meta)
+        tier.put(None, toks[:12], pages, None, meta)
+        n, _, _, _ = tier.lookup(None, toks, block_size=4)
+        assert n == 12  # not the shorter 4-token frame
+        # min_tokens floors the search: the HBM tree already covers 12
+        assert tier.lookup(None, toks, block_size=4,
+                           min_tokens=12) is None
+        # non-aligned queries truncate to full blocks first
+        n2, _, _, _ = tier.lookup(None, toks[:14], block_size=4)
+        assert n2 == 12
+
+
+class TestChaosSpill:
+    def _frame_args(self):
+        src = BlockManager(8, 4)
+        src.allocate("x", 8)
+        pools = _make_pools()
+        pages, scales, meta = src.export_blocks("x", pools, num_tokens=8)
+        return np.arange(8, dtype=np.int32), pages, scales, meta
+
+    def test_corrupt_spill_is_crc_rejected_miss(self):
+        tokens, pages, scales, meta = self._frame_args()
+        tier = HostTier()
+        chaos.install(ChaosSchedule(seed=1).at("cache.spill", 1,
+                                               "corrupt"))
+        assert tier.put("t", tokens, pages, scales, meta)  # stored...
+        assert len(tier) == 1
+        assert tier.lookup("t", tokens, block_size=4) is None  # ...bad
+        assert tier.corrupt_rejected == 1
+        assert len(tier) == 0  # purged, not retried forever
+        chaos.uninstall()
+        # a healthy re-put heals the entry
+        assert tier.put("t", tokens, pages, scales, meta)
+        hit = tier.lookup("t", tokens, block_size=4)
+        assert hit is not None and hit[0] == 8
+        np.testing.assert_array_equal(_bits(hit[1]), _bits(pages))
+
+    def test_dropped_spill_never_stored(self):
+        tokens, pages, scales, meta = self._frame_args()
+        tier = HostTier()
+        chaos.install(ChaosSchedule(seed=2).at("cache.spill", 1, "drop"))
+        assert not tier.put("t", tokens, pages, scales, meta)
+        assert tier.put_drops == 1 and len(tier) == 0
+        assert tier.lookup("t", tokens, block_size=4) is None
+        st = tier.stats()
+        assert st["puts"] == 1 and st["hits"] == 0
+
+
+class TestLRUAndNamespaces:
+    def _put(self, tier, ns, lo, n=4):
+        toks = np.arange(lo, lo + n, dtype=np.int32)
+        pages = np.full((1, 1, 4, 2), float(lo), np.float32)
+        assert tier.put(ns, toks, pages, None, {"num_blocks": 1})
+        return toks
+
+    def test_lru_eviction_and_lookup_refresh(self):
+        tier = HostTier()
+        t1 = self._put(tier, None, 100)
+        t2 = self._put(tier, None, 200)
+        tier.capacity_bytes = tier.stats()["bytes"]  # exactly two fit
+        # touching t1 makes t2 the LRU victim for the next insert
+        assert tier.lookup(None, t1, block_size=4) is not None
+        t3 = self._put(tier, None, 300)
+        assert tier.evictions == 1 and len(tier) == 2
+        assert tier.lookup(None, t2, block_size=4) is None
+        assert tier.lookup(None, t1, block_size=4) is not None
+        assert tier.lookup(None, t3, block_size=4) is not None
+        assert tier.stats()["bytes"] <= tier.capacity_bytes
+
+    def test_oversize_frame_rejected(self):
+        tier = HostTier(capacity_bytes=16)  # smaller than any frame
+        toks = np.arange(4, dtype=np.int32)
+        assert not tier.put(None, toks,
+                            np.zeros((1, 1, 4, 2), np.float32), None,
+                            {"num_blocks": 1})
+        assert tier.put_drops == 1 and len(tier) == 0
+
+    def test_idempotent_reput_refreshes_only(self):
+        tier = HostTier()
+        t1 = self._put(tier, None, 0)
+        self._put(tier, None, 0)
+        assert len(tier) == 1 and tier.stats()["puts"] == 2
+        assert tier.lookup(None, t1, block_size=4) is not None
+
+    def test_namespace_isolation(self):
+        tier = HostTier()
+        toks = self._put(tier, "tenantA", 0)
+        # same tokens, different tenant / default ns: all misses
+        assert tier.lookup("tenantB", toks, block_size=4) is None
+        assert tier.lookup(None, toks, block_size=4) is None
+        assert tier.lookup("tenantA", toks, block_size=4) is not None
+        # the shared-system-prompt namespace is just another ns
+        self._put(tier, "*", 0)
+        assert len(tier) == 2  # distinct keys, no aliasing
+
+
+class TestEngineRestore:
+    def test_replay_token_exact_through_tier_restores(self):
+        """Working set (4 prompts x 2 full blocks) overflows an 8-block
+        HBM pool: the replay pass can only hit through host-tier
+        restores, and restored KV must reproduce the warm pass's greedy
+        tokens exactly."""
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        config = LlamaConfig.tiny()
+        model = LlamaForCausalLM(config)
+        tier = HostTier()
+        eng = ContinuousBatchingEngine(
+            model, max_batch=1, max_len=32, block_size=8, num_blocks=8,
+            prompt_pad=24, prefix_cache=True, cache_tier=tier)
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(0, config.vocab_size, (17,)).astype(np.int32)
+                   for _ in range(4)]
+
+        def run(tag):
+            outs = []
+            for j, p in enumerate(prompts):
+                rid = f"{tag}-{j}"
+                eng.add_request(rid, p, 4)
+                for _ in range(512):
+                    if rid in eng._completed:
+                        break
+                    eng.step()
+                req = eng._completed[rid]
+                assert req.status == "ok"
+                outs.append(list(req.out))
+            return outs
+
+        warm = run("warm")
+        replay = run("replay")
+        assert replay == warm  # byte-exact KV => identical argmax
+        st = eng.prefix_stats()
+        assert st["tier"]["restores"] >= 1
+        assert st["tier"]["restore_tokens"] >= 16
+        assert st["tier"]["puts"] >= 4
+        assert st["hit_tokens"] > 0
