@@ -154,6 +154,7 @@ def all_rules() -> Dict[str, Rule]:
     from . import rules  # noqa: F401
     from . import interproc  # noqa: F401
     from . import threads  # noqa: F401
+    from . import ownership  # noqa: F401
     return dict(_RULES)
 
 

@@ -82,6 +82,11 @@ __all__ = [
     "AccessEffect",
     "SpawnEffect",
     "SleepEffect",
+    "ResAcqEffect",
+    "ResRelEffect",
+    "RaiseEffect",
+    "ReturnEffect",
+    "RESOURCE_KINDS",
 ]
 
 # Expansion budgets: each rank-conditional fork inside a CALLEE doubles
@@ -158,6 +163,14 @@ class CallEffect:
     nargs: int
     line: int
     col: int
+    # dotted names passed as POSITIONAL args, index-aligned with the
+    # call ("" for non-name args) — lets OWN003 follow a resource
+    # variable into a callee that releases its parameter
+    arg_names: Tuple[str, ...] = ()
+    # dotted names passed as KEYWORD values (unordered — keyword args
+    # can't map onto rel_params positions, but a resource handed over
+    # as `Node(block=block)` still leaves the caller's custody)
+    kw_arg_names: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -175,6 +188,10 @@ class RankBranch:
     body: Tuple = ()
     orelse: Tuple = ()
     is_rank: bool = True
+    # True for an except-handler fork: the body effects BEFORE the fork
+    # may not all have run when the handler does, so path-sensitive
+    # state (OWN003's released-set) must weaken at its entry
+    handler: bool = False
 
 
 @dataclass(frozen=True)
@@ -247,6 +264,68 @@ class SleepEffect:
 
 
 @dataclass(frozen=True)
+class ResAcqEffect:
+    """Acquisition of a paired-release resource (KV blocks, handoff
+    holds, engine slots, journal records, handoff transfer parts).
+    ``var`` is the name the resource was bound to — the assignment
+    target when the acquire's result was stored, else the first
+    positional name argument (the owning id) — "" when untrackable.
+    ``fresh`` marks creation-style acquires (``allocate``) as opposed
+    to use-style ones (``adopt``/``ref``/``fork``), which OWN003 treats
+    as uses of an existing resource."""
+
+    res: str  # resource kind, e.g. "kv.block" — see RESOURCE_KINDS
+    what: str  # the call tail as written (allocate, export_kv, ...)
+    var: str
+    fresh: bool
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class ResRelEffect:
+    """The paired release (``release``/``free_sequence``/...). ``var``
+    is the first positional name argument — the resource (or owning
+    id) being released — "" when untrackable."""
+
+    res: str
+    what: str
+    var: str
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
+class RaiseEffect:
+    """A ``raise`` statement. ``protected`` lists the resource kinds an
+    enclosing ``try/finally`` (or resource-acquiring ``with``) is
+    guaranteed to release on the way out — OWN001 only reports held
+    resources OUTSIDE that set."""
+
+    protected: Tuple[str, ...]
+    line: int
+    col: int
+    # raised inside a try that HAS handlers: an enclosing handler may
+    # resume the path, so this is not a guaranteed function exit —
+    # OWN001 neither reports nor terminates on it (FN over FP: we
+    # cannot tell whether the handler's type matches)
+    caught: bool = False
+
+
+@dataclass(frozen=True)
+class ReturnEffect:
+    """A ``return`` statement. ``names`` holds every dotted name in the
+    returned expression — a held resource whose bound name is returned
+    is an ownership TRANSFER to the caller (OWN002's territory), not a
+    leak."""
+
+    names: Tuple[str, ...]
+    protected: Tuple[str, ...]
+    line: int
+    col: int
+
+
+@dataclass(frozen=True)
 class FunctionSummary:
     name: str
     path: str
@@ -283,11 +362,13 @@ def _effect_to_json(e):
         return ["B", e.what, e.bounded, e.line, e.col]
     if isinstance(e, CallEffect):
         return ["L", e.name, e.self_call, e.has_receiver,
-                e.hard_bounds, list(e.kwargs), e.nargs, e.line, e.col]
+                e.hard_bounds, list(e.kwargs), e.nargs, e.line, e.col,
+                list(e.arg_names), list(e.kw_arg_names)]
     if isinstance(e, RankBranch):
         return ["R", e.rank_eq, e.eq_in_body, e.line, e.col,
                 [_effect_to_json(x) for x in e.body],
-                [_effect_to_json(x) for x in e.orelse], e.is_rank]
+                [_effect_to_json(x) for x in e.orelse], e.is_rank,
+                e.handler]
     if isinstance(e, LoopEffect):
         return ["O", e.line, e.col,
                 [_effect_to_json(x) for x in e.body]]
@@ -301,6 +382,14 @@ def _effect_to_json(e):
         return ["S", e.name, e.self_call, e.has_receiver, e.line, e.col]
     if isinstance(e, SleepEffect):
         return ["Z", e.seconds, e.line, e.col]
+    if isinstance(e, ResAcqEffect):
+        return ["RA", e.res, e.what, e.var, e.fresh, e.line, e.col]
+    if isinstance(e, ResRelEffect):
+        return ["RE", e.res, e.what, e.var, e.line, e.col]
+    if isinstance(e, RaiseEffect):
+        return ["RZ", list(e.protected), e.line, e.col, e.caught]
+    if isinstance(e, ReturnEffect):
+        return ["RT", list(e.names), list(e.protected), e.line, e.col]
     raise TypeError(type(e))
 
 
@@ -314,12 +403,15 @@ def _effect_from_json(d):
         return BlockEffect(d[1], bool(d[2]), d[3], d[4])
     if tag == "L":
         return CallEffect(d[1], bool(d[2]), bool(d[3]), bool(d[4]),
-                          tuple(d[5]), d[6], d[7], d[8])
+                          tuple(d[5]), d[6], d[7], d[8],
+                          tuple(d[9]) if len(d) > 9 else (),
+                          tuple(d[10]) if len(d) > 10 else ())
     if tag == "R":
         return RankBranch(d[1], bool(d[2]), d[3], d[4],
                           tuple(_effect_from_json(x) for x in d[5]),
                           tuple(_effect_from_json(x) for x in d[6]),
-                          bool(d[7]))
+                          bool(d[7]),
+                          bool(d[8]) if len(d) > 8 else False)
     if tag == "O":
         return LoopEffect(d[1], d[2],
                           tuple(_effect_from_json(x) for x in d[3]))
@@ -333,6 +425,15 @@ def _effect_from_json(d):
         return SpawnEffect(d[1], bool(d[2]), bool(d[3]), d[4], d[5])
     if tag == "Z":
         return SleepEffect(float(d[1]), d[2], d[3])
+    if tag == "RA":
+        return ResAcqEffect(d[1], d[2], d[3], bool(d[4]), d[5], d[6])
+    if tag == "RE":
+        return ResRelEffect(d[1], d[2], d[3], d[4], d[5])
+    if tag == "RZ":
+        return RaiseEffect(tuple(d[1]), d[2], d[3],
+                           bool(d[4]) if len(d) > 4 else False)
+    if tag == "RT":
+        return ReturnEffect(tuple(d[1]), tuple(d[2]), d[3], d[4])
     raise ValueError(tag)
 
 
@@ -462,6 +563,90 @@ def _literal_number(node: Optional[ast.AST]) -> Optional[float]:
     return None
 
 
+# ---------------------------------------------------------------------------
+# Resource-ownership registry (graft-own): known acquire sites and
+# their paired releases. Name-based on the call TAIL, with receiver
+# qualification for the ambiguous short names (`allocate`/`release`/
+# `ref` also name locks, weakrefs, allocators...) — the same
+# false-negatives-over-false-positives contract as the rest of the
+# analyzer. NOTE: the "put_bytes of handoff parts" acquire site is
+# keyed on `_put_transfer` (the disagg sender's part-upload helper),
+# NOT on bare `put_bytes` — every ordinary KVStore publish would
+# otherwise read as an unreleased resource.
+
+RESOURCE_KINDS = ("kv.block", "handoff.hold", "engine.slot",
+                  "journal.record", "handoff.part")
+
+# block-manager-ish receivers qualify the short kv-block verbs; a
+# self-call inside a *Manager*/*Pool*/*Cache* class qualifies too
+# (BlockManager.free_sequence internally calls `self.release(b)`)
+_RES_RECV = re.compile(r"(^|_)(manager|mgr|pool|bm|blocks?)$", re.I)
+_RES_CLS = re.compile(r"manager|pool|cache", re.I)
+_JOURNALISH = re.compile(r"journal", re.I)
+
+# tail -> (kind, fresh, qualification); qualification: None (the name
+# alone is unambiguous), "manager", or "journal"
+_RES_ACQ = {
+    "allocate": ("kv.block", True, "manager"),
+    "import_blocks": ("kv.block", True, None),
+    "adopt": ("kv.block", False, "manager"),
+    "fork": ("kv.block", False, "manager"),
+    "ref": ("kv.block", False, "manager"),
+    "export_kv": ("handoff.hold", True, None),
+    "export_blocks": ("handoff.hold", True, None),
+    "bind_slot": ("engine.slot", True, None),
+    "acquire_slot": ("engine.slot", True, None),
+    "submit": ("journal.record", True, "journal"),
+    "append": ("journal.record", True, "journal"),
+    "_put_transfer": ("handoff.part", True, None),
+}
+
+# tail -> (kinds released, qualification); `free_sequence` drops every
+# per-sequence hold (blocks AND the handoff view over them)
+_RES_REL = {
+    "release": (("kv.block",), "manager"),
+    "free_sequence": (("kv.block", "handoff.hold"), None),
+    "free_blocks": (("kv.block",), None),
+    "release_handoff": (("handoff.hold",), None),
+    "free_slot": (("engine.slot",), None),
+    "release_slot": (("engine.slot",), None),
+    "complete": (("journal.record",), "journal"),
+    "_gc": (("handoff.part",), None),
+    "_gc_orphans": (("handoff.part",), None),
+}
+
+
+def _res_arg_name(call: ast.Call) -> str:
+    """The first positional argument's dotted name ('' when the call
+    has none) — the resource or owning id a release/acquire names."""
+    for a in call.args:
+        d = dotted_name(a)
+        if d is not None:
+            return d
+        break
+    return ""
+
+
+def _rel_kinds_of(effects: Sequence) -> FrozenSet[str]:
+    """Resource kinds a summarized effect list DIRECTLY releases
+    (through forks/loops, but not through call edges) — what a
+    ``finally`` block provably guarantees."""
+    out: set = set()
+
+    def walk(effs):
+        for e in effs:
+            if isinstance(e, ResRelEffect):
+                out.add(e.res)
+            elif isinstance(e, RankBranch):
+                walk(e.body)
+                walk(e.orelse)
+            elif isinstance(e, LoopEffect):
+                walk(e.body)
+
+    walk(effects)
+    return frozenset(out)
+
+
 def _rank_literal(test: ast.AST) -> Tuple[Optional[int], bool]:
     """(K, eq_in_body) for `rank ==/!= K` tests; (None, True) else."""
     if isinstance(test, ast.Compare) and len(test.ops) == 1:
@@ -488,6 +673,13 @@ class _FnSummarizer:
         self.cls = cls
         self.bases = bases
         self.sets_timeout = False
+        # stack of resource-kind sets a surrounding try/finally (or
+        # resource-acquiring with) guarantees to release — captured
+        # into Raise/ReturnEffect.protected
+        self._protect: List[FrozenSet[str]] = []
+        # depth of enclosing try-bodies that have except handlers —
+        # raises there may be resumed (RaiseEffect.caught)
+        self._caught = 0
 
     def run(self) -> FunctionSummary:
         effects = tuple(self._stmts(self.fndef.body, in_loop=False))
@@ -565,7 +757,16 @@ class _FnSummarizer:
                 # normal-plus-handler) — appending it in sequence
                 # would fabricate a schedule in which both the try
                 # body AND every handler always run
+                fin = tuple(self._stmts(stmt.finalbody, in_loop)) \
+                    if stmt.finalbody else ()
+                guarded = _rel_kinds_of(fin)
+                if guarded:
+                    self._protect.append(guarded)
+                if stmt.handlers:
+                    self._caught += 1
                 out.extend(self._stmts(stmt.body, in_loop))
+                if stmt.handlers:
+                    self._caught -= 1
                 for h in stmt.handlers:
                     h_eff = self._stmts(h.body, in_loop)
                     if h_eff:
@@ -573,9 +774,11 @@ class _FnSummarizer:
                             rank_eq=None, eq_in_body=True,
                             line=h.lineno, col=h.col_offset + 1,
                             body=tuple(h_eff), orelse=(),
-                            is_rank=False))
+                            is_rank=False, handler=True))
                 out.extend(self._stmts(stmt.orelse, in_loop))
-                out.extend(self._stmts(stmt.finalbody, in_loop))
+                if guarded:
+                    self._protect.pop()
+                out.extend(fin)
                 continue
             if isinstance(stmt, (ast.With, ast.AsyncWith)):
                 # lock-ish items become FLAT Acq/Rel markers around the
@@ -583,18 +786,43 @@ class _FnSummarizer:
                 # or nesting is needed); non-lock items keep the old
                 # behavior — header effects then body effects inline
                 acquired: List[str] = []
+                res_cms: List[ResAcqEffect] = []
                 for item in stmt.items:
-                    out.extend(self._expr_effects(item, in_loop))
+                    item_eff = self._expr_effects(item, in_loop)
+                    # a resource-acquiring context manager: __exit__
+                    # IS the paired release, so the acquire is both
+                    # protected inside the body and released after it
+                    if item_eff and isinstance(item_eff[-1],
+                                               ResAcqEffect):
+                        racq = item_eff[-1]
+                        if item.optional_vars is not None:
+                            bound = dotted_name(item.optional_vars)
+                            if bound:
+                                racq = ResAcqEffect(
+                                    racq.res, racq.what, bound,
+                                    racq.fresh, racq.line, racq.col)
+                                item_eff[-1] = racq
+                        res_cms.append(racq)
+                    out.extend(item_eff)
                     qual = _lock_qual(item.context_expr)
                     if qual is not None:
                         out.append(AcqEffect(
                             qual, item.context_expr.lineno,
                             item.context_expr.col_offset + 1))
                         acquired.append(qual)
+                if res_cms:
+                    self._protect.append(
+                        frozenset(r.res for r in res_cms))
                 out.extend(self._stmts(stmt.body, in_loop))
+                if res_cms:
+                    self._protect.pop()
                 for qual in reversed(acquired):
                     out.append(RelEffect(
                         qual, stmt.lineno, stmt.col_offset + 1))
+                for racq in reversed(res_cms):
+                    out.append(ResRelEffect(
+                        racq.res, "__exit__", racq.var,
+                        stmt.lineno, stmt.col_offset + 1))
                 continue
             if hasattr(ast, "Match") and isinstance(stmt, ast.Match):
                 # each arm is an alternative continuation: fork every
@@ -611,6 +839,37 @@ class _FnSummarizer:
                             body=tuple(c_eff), orelse=(),
                             is_rank=False))
                 continue
+            if isinstance(stmt, ast.Assign):
+                effs = self._header_calls(stmt, in_loop)
+                # `blocks = mgr.allocate(...)`: the acquire's tracked
+                # var becomes the bound name (what a later `return
+                # blocks` transfers, what `mgr.release(b)` matches)
+                if effs and isinstance(effs[-1], ResAcqEffect) \
+                        and len(stmt.targets) == 1:
+                    tgt = dotted_name(stmt.targets[0]) or ""
+                    if tgt:
+                        r = effs[-1]
+                        effs[-1] = ResAcqEffect(
+                            r.res, r.what, tgt, r.fresh, r.line, r.col)
+                out.extend(effs)
+                continue
+            if isinstance(stmt, ast.Raise):
+                out.extend(self._header_calls(stmt, in_loop))
+                out.append(RaiseEffect(
+                    self._protection(), stmt.lineno,
+                    stmt.col_offset + 1, caught=self._caught > 0))
+                continue
+            if isinstance(stmt, ast.Return):
+                out.extend(self._header_calls(stmt, in_loop))
+                names: Tuple[str, ...] = ()
+                if stmt.value is not None:
+                    names = tuple(sorted(
+                        {dotted_name(n) for n in ast.walk(stmt.value)}
+                        - {None}))
+                out.append(ReturnEffect(
+                    names, self._protection(), stmt.lineno,
+                    stmt.col_offset + 1))
+                continue
             out.extend(self._header_calls(stmt, in_loop))
             for fname in ("body", "orelse", "finalbody"):
                 sub = getattr(stmt, fname, None)
@@ -618,6 +877,11 @@ class _FnSummarizer:
                         sub[0], ast.stmt):
                     out.extend(self._stmts(sub, in_loop))
         return out
+
+    def _protection(self) -> Tuple[str, ...]:
+        if not self._protect:
+            return ()
+        return tuple(sorted(frozenset().union(*self._protect)))
 
     def _header_calls(self, stmt: ast.stmt, in_loop: bool) -> List:
         out: List = []
@@ -673,6 +937,11 @@ class _FnSummarizer:
                 eff = self._classify(n, in_loop)
                 if eff is not None:
                     acc.append(eff)
+                # a call can be BOTH a project-call edge and a
+                # resource event (`manager.free_sequence(rid)` resolves
+                # to BlockManager.free_sequence AND releases blocks) —
+                # emit the resource leaves alongside, never instead
+                acc.extend(self._res_effect(n))
             elif isinstance(n, ast.Attribute) and isinstance(
                     n.value, ast.Name) and n.value.id in ("self", "cls"):
                 acc.append(AccessEffect(
@@ -682,6 +951,40 @@ class _FnSummarizer:
         out: List = []
         visit(node, out)
         return out
+
+    def _res_effect(self, call: ast.Call) -> List:
+        """ResAcq/ResRelEffect leaves for a registered resource site
+        (empty for everything else). Ambiguous tails (`allocate`,
+        `release`, `ref`, ...) qualify only with a block-manager-ish
+        receiver or as a self-call inside a manager-ish class;
+        `submit`/`append`/`complete` only with a journal-ish
+        receiver. A multi-kind release (`free_sequence`) yields one
+        leaf per kind."""
+        d = dotted_name(call.func)
+        if d is None:
+            return []
+        tail = d.split(".")[-1]
+        acq = _RES_ACQ.get(tail)
+        rel = _RES_REL.get(tail)
+        if acq is None and rel is None:
+            return []
+        prefix = _receiver_prefix(call.func)
+        need = acq[2] if acq is not None else rel[1]
+        if need is not None:
+            last = prefix.split(".")[-1] if prefix else ""
+            if need == "manager":
+                qualifies = bool(_RES_RECV.search(last)) or (
+                    prefix == "self"
+                    and bool(_RES_CLS.search(self.cls)))
+            else:  # journal
+                qualifies = bool(_JOURNALISH.search(last))
+            if not qualifies:
+                return []
+        line, col = call.lineno, call.col_offset + 1
+        var = _res_arg_name(call)
+        if acq is not None:
+            return [ResAcqEffect(acq[0], tail, var, acq[1], line, col)]
+        return [ResRelEffect(k, tail, var, line, col) for k in rel[0]]
 
     # -- call classification -------------------------------------------
     def _classify(self, call: ast.Call, in_loop: bool):
@@ -742,7 +1045,12 @@ class _FnSummarizer:
                 has_receiver=bool(prefix),
                 hard_bounds=_hard_bounds(call),
                 kwargs=tuple(kw.arg for kw in call.keywords if kw.arg),
-                nargs=len(call.args), line=line, col=col)
+                nargs=len(call.args), line=line, col=col,
+                arg_names=tuple(dotted_name(a) or ""
+                                for a in call.args),
+                kw_arg_names=tuple(sorted(
+                    {dotted_name(kw.value) for kw in call.keywords
+                     if kw.arg} - {None})))
         return None
 
     @staticmethod
@@ -817,7 +1125,9 @@ def summarize_source(src: str, path: str,
 # ---------------------------------------------------------------------------
 # Summary cache: in-memory keyed by (path, mtime, size) + JSON disk tier
 
-_CACHE_VERSION = 6  # bump when the summary/effect shapes change
+_CACHE_VERSION = 7  # bump when the summary/effect shapes change
+# (v7: graft-own resource leaves — ResAcq/ResRel/Raise/Return,
+# CallEffect.arg_names, RankBranch.handler)
 # (hits, misses) observable by tests; misses == real summarize runs
 _cache_stats = {"hits": 0, "misses": 0}
 _mem_cache: Dict[str, Tuple[float, int, FileSummary]] = {}

@@ -1,0 +1,538 @@
+"""graft-own static half — resource-lifecycle rules over effect
+summaries.
+
+The serving stack is built on ref-counted resources: COW KV blocks
+(``BlockManager.allocate/adopt/fork/ref/release``), disagg handoff
+holds (``export_kv``/``release_handoff``), engine slots, supervisor
+journal records, handoff transfer parts. A single missed release on an
+error path quietly shrinks the KV pool until a long-running replica
+starves. The interprocedural summarizer (interproc.py) records every
+registered acquire/release site as paired ``ResAcqEffect``/
+``ResRelEffect`` leaves plus explicit ``RaiseEffect``/``ReturnEffect``
+exit markers; this module walks them:
+
+========= ======== ==================================================
+OWN001    error    an acquire reaches a ``raise`` or early ``return``
+                   with no ``try/finally`` (or resource-acquiring
+                   context manager) guaranteeing the paired release —
+                   the classic error-path leak
+OWN002    warning  interprocedural ownership escape: a function
+                   returns or stores an acquired resource and neither
+                   it nor any caller in the (resolved, budgeted)
+                   reverse call chain ever reaches a release
+OWN003    error    double-release / use-after-release along a
+                   straight-line or cross-function path (a callee
+                   that releases its parameter counts as a release
+                   at the call site)
+========= ======== ==================================================
+
+Same contract as every other graft-lint family: name-based resolution,
+false negatives over false positives, findings anchored at the ACQUIRE
+site (OWN001/OWN002) or the offending second event (OWN003). The
+runtime companion — :class:`paddle_tpu.utils.resources.ResourceLedger`
+— catches at test time what the static walk cannot see.
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from .core import register_rule
+from .interproc import (
+    CallEffect,
+    LoopEffect,
+    ProjectContext,
+    RankBranch,
+    RaiseEffect,
+    ResAcqEffect,
+    ResRelEffect,
+    ReturnEffect,
+    _tarjan,
+)
+
+__all__ = ["own001", "own002", "own003"]
+
+# reverse-BFS budget for OWN002's caller-chain search: past this many
+# ancestors the chain is "unknown" and produces no finding
+MAX_ANCESTORS = 128
+
+_REL_NAMES = {
+    "kv.block": "release/free_sequence",
+    "handoff.hold": "release_handoff/free_sequence",
+    "engine.slot": "free_slot/release_slot",
+    "journal.record": "complete",
+    "handoff.part": "_gc/_gc_orphans",
+}
+
+
+def _iter_calls(effects) -> Iterator[CallEffect]:
+    for e in effects:
+        if isinstance(e, CallEffect):
+            yield e
+        elif isinstance(e, (RankBranch, LoopEffect)):
+            yield from _iter_calls(e.body)
+            yield from _iter_calls(getattr(e, "orelse", ()))
+
+
+def _iter_leaves(effects, kinds) -> Iterator:
+    for e in effects:
+        if isinstance(e, kinds):
+            yield e
+        if isinstance(e, (RankBranch, LoopEffect)):
+            yield from _iter_leaves(e.body, kinds)
+            yield from _iter_leaves(getattr(e, "orelse", ()), kinds)
+
+
+class _OwnInfo:
+    """Per-project ownership facts, computed once and memoized on the
+    ProjectContext (the threads.py `_graft_race_info` idiom):
+
+    - ``rel_kinds[fid]``: resource kinds the function (transitively,
+      through resolved calls, SCC-closed) releases;
+    - ``rel_params[fid]``: {param position -> kinds} — parameters the
+      function (transitively) releases, so a call site passing a
+      resource variable there counts as releasing it;
+    - ``redges[fid]``: resolved callers, for OWN002's reverse BFS.
+    """
+
+    def __init__(self, project: ProjectContext):
+        self.project = project
+        self.edges: Dict[Tuple, List[Tuple]] = {}
+        self.redges: Dict[Tuple, List[Tuple]] = {}
+        self.rel_kinds: Dict[Tuple, FrozenSet[str]] = {}
+        self.rel_params: Dict[Tuple, Dict[int, FrozenSet[str]]] = {}
+        self._calls: Dict[Tuple, List[Tuple[CallEffect, Tuple]]] = {}
+        self._compute()
+
+    def _compute(self) -> None:
+        p = self.project
+        for fid, fn in p.by_fid.items():
+            calls = []
+            out = []
+            for c in _iter_calls(fn.effects):
+                t = p.resolve(fn.path, c)
+                if t is not None:
+                    calls.append((c, t.fid()))
+                    out.append(t.fid())
+            self._calls[fid] = calls
+            self.edges[fid] = out
+            self.redges.setdefault(fid, [])
+            for callee in out:
+                self.redges.setdefault(callee, []).append(fid)
+        for scc in _tarjan(self.edges):  # reverse topological
+            scc_set = set(scc)
+            kinds: Set[str] = set()
+            for fid in scc:
+                fn = p.by_fid[fid]
+                kinds.update(r.res for r in _iter_leaves(
+                    fn.effects, ResRelEffect))
+                kinds.update(k for callee in self.edges[fid]
+                             if callee not in scc_set
+                             for k in self.rel_kinds.get(callee, ()))
+            for fid in scc:
+                self.rel_kinds[fid] = frozenset(kinds)
+            for fid in scc:
+                self.rel_params[fid] = self._fn_rel_params(fid, scc_set)
+
+    def _fn_rel_params(self, fid: Tuple,
+                       scc_set: Set[Tuple]) -> Dict[int, FrozenSet[str]]:
+        p = self.project
+        fn = p.by_fid[fid]
+        out: Dict[int, Set[str]] = {}
+        for r in _iter_leaves(fn.effects, ResRelEffect):
+            if r.var in fn.params:
+                out.setdefault(fn.params.index(r.var), set()).add(r.res)
+        for call, callee_fid in self._calls[fid]:
+            if callee_fid in scc_set:
+                continue  # recursion: direct facts only
+            sub = self.rel_params.get(callee_fid)
+            if not sub:
+                continue
+            target = p.by_fid[callee_fid]
+            offset = 1 if (call.has_receiver and target.params
+                           and target.params[0] in ("self", "cls")) else 0
+            for tpos, kinds in sub.items():
+                i = tpos - offset
+                if 0 <= i < len(call.arg_names) \
+                        and call.arg_names[i] in fn.params:
+                    out.setdefault(fn.params.index(call.arg_names[i]),
+                                   set()).update(kinds)
+        return {k: frozenset(v) for k, v in out.items()}
+
+    def call_releases(self, caller_path: str,
+                      call: CallEffect) -> FrozenSet[str]:
+        """Kinds a resolved call site (transitively) releases."""
+        t = self.project.resolve(caller_path, call)
+        if t is None:
+            return frozenset()
+        return self.rel_kinds.get(t.fid(), frozenset())
+
+    def call_released_args(self, caller_path: str,
+                           call: CallEffect) -> List[Tuple[str, str]]:
+        """(arg name, kind) pairs the callee releases — a release of
+        that variable AT the call site, for OWN003."""
+        t = self.project.resolve(caller_path, call)
+        if t is None:
+            return []
+        sub = self.rel_params.get(t.fid())
+        if not sub:
+            return []
+        offset = 1 if (call.has_receiver and t.params
+                       and t.params[0] in ("self", "cls")) else 0
+        out = []
+        for tpos, kinds in sub.items():
+            i = tpos - offset
+            if 0 <= i < len(call.arg_names) and call.arg_names[i]:
+                for k in kinds:
+                    out.append((call.arg_names[i], k))
+        return out
+
+
+def _own_info(project: ProjectContext) -> _OwnInfo:
+    info = getattr(project, "_graft_own_info", None)
+    if info is None or info.project is not project:
+        info = _OwnInfo(project)
+        project._graft_own_info = info
+    return info
+
+
+# ---------------------------------------------------------------------------
+# OWN001 — acquire leaked by a raise / early-return path
+
+
+def _walk001(effects, held: List[ResAcqEffect], fn, info: _OwnInfo,
+             leaks: List[Tuple[ResAcqEffect, str, int]],
+             reported: Set[Tuple[int, int]]) -> Tuple[List, bool]:
+    """-> (held after, path terminated). ``held`` entries are acquire
+    effects not yet provably released/transferred on this path."""
+    for e in effects:
+        if isinstance(e, ResAcqEffect):
+            held = held + [e]
+        elif isinstance(e, ResRelEffect):
+            # kind-level clearing (FN over FP): any release of a kind
+            # settles every held acquire of that kind on this path
+            held = [a for a in held if a.res != e.res]
+        elif isinstance(e, CallEffect):
+            cleared = info.call_releases(fn.path, e)
+            args = set(e.arg_names) | set(e.kw_arg_names)
+            # passing the bound name to ANY call may hand ownership
+            # over (append to a registry, push to a queue) — clear it
+            held = [a for a in held
+                    if a.res not in cleared and a.var not in args]
+        elif isinstance(e, RaiseEffect):
+            if e.caught:
+                continue  # an enclosing handler resumes the path
+            for a in held:
+                if a.res not in e.protected \
+                        and (a.line, a.col) not in reported:
+                    reported.add((a.line, a.col))
+                    leaks.append((a, "raise", e.line))
+            return [], True
+        elif isinstance(e, ReturnEffect):
+            for a in held:
+                if a.res in e.protected:
+                    continue
+                # returning the bound name is an ownership TRANSFER
+                # (OWN002 audits the caller chain); so is returning
+                # the acquire's own result or self-stored state
+                if a.var and (a.var in e.names
+                              or a.var.startswith("self.")):
+                    continue
+                if a.line == e.line:
+                    continue  # `return mgr.allocate(...)`
+                if (a.line, a.col) not in reported:
+                    reported.add((a.line, a.col))
+                    leaks.append((a, "early return", e.line))
+            return [], True
+        elif isinstance(e, RankBranch):
+            # a handler fork starts with NOTHING held: the try body's
+            # acquire may not have completed when the handler runs
+            # (the raise could BE the failed acquire) — FN over FP
+            hb, tb = _walk001(e.body, [] if e.handler else list(held),
+                              fn, info, leaks, reported)
+            ho, to = _walk001(e.orelse, list(held), fn, info, leaks,
+                              reported)
+            if tb and to:
+                return [], True
+            merged: List[ResAcqEffect] = []
+            for a in (hb if not tb else []) + (ho if not to else []):
+                if a not in merged:
+                    merged.append(a)
+            held = merged
+        elif isinstance(e, LoopEffect):
+            hb, _t = _walk001(e.body, list(held), fn, info, leaks,
+                              reported)
+            for a in hb:
+                if a not in held:
+                    held = held + [a]
+    return held, False
+
+
+@register_rule(
+    "OWN001", severity="error", scope="project",
+    summary="resource acquired on a path that raises or early-returns "
+            "with no try/finally or context manager guaranteeing the "
+            "paired release",
+    hint="wrap the acquire/use in try/finally (or a context manager) "
+         "so the error path releases what it took — a leaked KV block "
+         "shrinks the pool until the replica starves. A deliberate "
+         "hand-off can be silenced with # graft-lint: disable=OWN001",
+)
+def own001(project: ProjectContext):
+    info = _own_info(project)
+    for fs in project.files:
+        for fn in fs.functions:
+            leaks: List[Tuple[ResAcqEffect, str, int]] = []
+            _walk001(fn.effects, [], fn, info, leaks, set())
+            for acq, how, exit_line in leaks:
+                bound = f" (bound to `{acq.var}`)" if acq.var else ""
+                yield (fs.path, acq.line, acq.col,
+                       f"`{acq.what}()` acquires {acq.res}{bound} but "
+                       f"the {how} at line {exit_line} leaves "
+                       f"`{fn.name}` without the paired release "
+                       f"({_REL_NAMES.get(acq.res, 'release')}) and no "
+                       "try/finally or context manager guarantees it")
+
+
+# ---------------------------------------------------------------------------
+# OWN002 — interprocedural ownership escape
+
+
+def _dispositions(fn, info: _OwnInfo):
+    """Classify every acquire in ``fn``: 'handled' (released / passed
+    on), 'returned', 'stored', or 'dropped'."""
+    acqs = list(_iter_leaves(fn.effects, ResAcqEffect))
+    if not acqs:
+        return []
+    rel_kinds: Set[str] = set(
+        r.res for r in _iter_leaves(fn.effects, ResRelEffect))
+    passed: Set[str] = set()
+    for c in _iter_calls(fn.effects):
+        rel_kinds.update(info.call_releases(fn.path, c))
+        passed.update(n for n in c.arg_names if n)
+        passed.update(c.kw_arg_names)
+    returned_names: Set[str] = set()
+    returned_lines: Set[int] = set()
+    for r in _iter_leaves(fn.effects, ReturnEffect):
+        returned_names.update(r.names)
+        returned_lines.add(r.line)
+    out = []
+    for a in acqs:
+        if a.res in rel_kinds:
+            continue  # some path releases the kind: handled
+        if a.var and a.var in passed:
+            continue  # handed to a callee/registry: assume transfer
+        if a.var.startswith("self."):
+            out.append((a, "stored"))
+        elif (a.var and a.var in returned_names) \
+                or a.line in returned_lines:
+            out.append((a, "returned"))
+        else:
+            out.append((a, "dropped"))
+    return out
+
+
+def _callers_release(fn, kind: str, info: _OwnInfo) -> Optional[bool]:
+    """True/False: some/no function in the transitive caller closure
+    (transitively, through its own callees) releases ``kind``; None
+    when there are no resolved callers at all (public surface — the
+    release lives outside the analyzed project) or the budget is
+    blown — no finding either way."""
+    start = fn.fid()
+    callers = info.redges.get(start, [])
+    if not callers:
+        return None  # public surface: the release lives outside
+    seen = {start}
+    frontier = list(callers)
+    while frontier:
+        if len(seen) > MAX_ANCESTORS:
+            return None
+        fid = frontier.pop()
+        if fid in seen:
+            continue
+        seen.add(fid)
+        if kind in info.rel_kinds.get(fid, ()):
+            return True
+        frontier.extend(info.redges.get(fid, []))
+    return False
+
+
+@register_rule(
+    "OWN002", severity="warning", scope="project",
+    summary="ownership escape: an acquired resource is returned or "
+            "stored and no caller in the resolved call chain ever "
+            "releases it",
+    hint="whoever ends up owning the resource must release it "
+         "(release/free_sequence/release_handoff) — add the release "
+         "at the final owner, or silence a deliberate process-lifetime "
+         "hold with # graft-lint: disable=OWN002",
+)
+def own002(project: ProjectContext):
+    info = _own_info(project)
+    for fs in project.files:
+        for fn in fs.functions:
+            for acq, mode in _dispositions(fn, info):
+                if mode == "dropped":
+                    yield (fs.path, acq.line, acq.col,
+                           f"`{acq.what}()` acquires {acq.res} that "
+                           f"`{fn.name}` neither releases, returns, "
+                           "stores, nor passes on — the resource is "
+                           "unreachable after the call and can never "
+                           "be released")
+                elif mode == "returned":
+                    if _callers_release(fn, acq.res, info) is False:
+                        yield (fs.path, acq.line, acq.col,
+                               f"`{fn.name}` returns the {acq.res} "
+                               f"acquired by `{acq.what}()` but no "
+                               "caller in the resolved call chain "
+                               "ever releases it "
+                               f"({_REL_NAMES.get(acq.res, 'release')})")
+                elif mode == "stored":
+                    cls_rels: Set[str] = set()
+                    for other in project.by_fid.values():
+                        if other.path == fn.path and other.cls \
+                                and other.cls == fn.cls:
+                            cls_rels.update(
+                                info.rel_kinds.get(other.fid(), ()))
+                    if acq.res not in cls_rels:
+                        yield (fs.path, acq.line, acq.col,
+                               f"`{fn.name}` stores the {acq.res} "
+                               f"acquired by `{acq.what}()` on "
+                               f"`{acq.var}` but no method of "
+                               f"`{fn.cls or fn.name}` ever releases "
+                               "that kind")
+
+
+# ---------------------------------------------------------------------------
+# OWN003 — double-release / use-after-release
+
+
+def _walk003(effects, released: Dict[str, Tuple[FrozenSet[str], int, str]],
+             fn, info: _OwnInfo, findings: List, seen: Set) -> bool:
+    """``released``: var -> (kinds, line, what). Returns True when the
+    path terminated (raise/return)."""
+
+    def mark(var: str, kinds: FrozenSet[str], line: int,
+             what: str) -> None:
+        if not var:
+            return
+        old = released.get(var)
+        if old is not None and old[1] == line:
+            return  # same site seen twice: a registry leaf AND its
+            #         resolved callee's rel_params both mark the call
+        if old is not None and (old[0] & kinds):
+            key = (var, line)
+            if key not in seen:
+                seen.add(key)
+                findings.append(
+                    (line, 1,
+                     f"`{what}({var})` releases a resource already "
+                     f"released at line {old[1]} (via `{old[2]}`) on "
+                     f"the same path through `{fn.name}` — the second "
+                     "release corrupts another owner's refcount"))
+            return
+        merged = kinds if old is None else (old[0] | kinds)
+        released[var] = (merged, line, what)
+
+    prev_call: Optional[CallEffect] = None
+    for e in effects:
+        if isinstance(e, ResRelEffect):
+            mark(e.var, frozenset({e.res}), e.line, e.what)
+        elif isinstance(e, ResAcqEffect):
+            if e.fresh:
+                released.pop(e.var, None)  # re-armed binding
+            else:
+                cands = {e.var}
+                if prev_call is not None and prev_call.line == e.line \
+                        and prev_call.col == e.col:
+                    cands.update(n for n in prev_call.arg_names if n)
+                for v in cands:
+                    old = released.get(v)
+                    if old is not None and e.res in old[0]:
+                        key = (v, e.line)
+                        if key not in seen:
+                            seen.add(key)
+                            findings.append(
+                                (e.line, e.col,
+                                 f"`{e.what}({v})` uses a {e.res} "
+                                 f"released at line {old[1]} (via "
+                                 f"`{old[2]}`) on the same path "
+                                 f"through `{fn.name}`"))
+                        break
+        elif isinstance(e, CallEffect):
+            prev_call = e
+            for var, kind in info.call_released_args(fn.path, e):
+                mark(var, frozenset({kind}), e.line, e.name)
+            continue
+        elif isinstance(e, (RaiseEffect, ReturnEffect)):
+            if isinstance(e, RaiseEffect) and e.caught:
+                continue
+            return True
+        elif isinstance(e, RankBranch):
+            # handler forks weaken: the flat body effects before the
+            # fork may not have run when the handler does, so its
+            # path starts with NO released marks (the `_settle` nack
+            # handler re-running the ok path's `_gc` is not a double)
+            tb = _walk003(e.body, {} if e.handler else dict(released),
+                          fn, info, findings, seen)
+            to = _walk003(e.orelse, dict(released), fn, info,
+                          findings, seen)
+            if tb and to:
+                return True
+            # conservative merge: a var stays marked only when every
+            # surviving branch released it (intersection) — a release
+            # on one conditional path must not flag the other
+            if not e.handler:
+                b_rel = dict(released)
+                _collect_rels(e.body, b_rel, fn, info)
+                o_rel = dict(released)
+                _collect_rels(e.orelse, o_rel, fn, info)
+                keep = {}
+                for v in set(b_rel) & set(o_rel):
+                    kb, ko = b_rel[v], o_rel[v]
+                    common = kb[0] & ko[0]
+                    if common:
+                        keep[v] = (common, kb[1], kb[2])
+                released.clear()
+                released.update(keep)
+        elif isinstance(e, LoopEffect):
+            # iteration-isolated: marks made inside the body rebind
+            # next iteration, so they don't persist past the loop
+            _walk003(e.body, dict(released), fn, info, findings, seen)
+    return False
+
+
+def _collect_rels(effects, released, fn, info: _OwnInfo) -> None:
+    """Fold an already-walked branch's release marks into ``released``
+    without re-reporting (straight-line, unconditional events only)."""
+    for e in effects:
+        if isinstance(e, ResRelEffect) and e.var:
+            old = released.get(e.var)
+            kinds = frozenset({e.res})
+            released[e.var] = (kinds if old is None else old[0] | kinds,
+                              e.line, e.what)
+        elif isinstance(e, CallEffect):
+            for var, kind in info.call_released_args(fn.path, e):
+                old = released.get(var)
+                released[var] = (
+                    frozenset({kind}) if old is None
+                    else old[0] | frozenset({kind}), e.line, e.name)
+
+
+@register_rule(
+    "OWN003", severity="error", scope="project",
+    summary="double-release or use-after-release along a straight-line "
+            "or cross-function path",
+    hint="a second release corrupts another owner's refcount and a "
+         "use-after-release reads recycled blocks — drop the redundant "
+         "release, or re-acquire before reuse; a release helper that "
+         "tolerates repeats can be silenced with "
+         "# graft-lint: disable=OWN003",
+)
+def own003(project: ProjectContext):
+    info = _own_info(project)
+    for fs in project.files:
+        for fn in fs.functions:
+            findings: List = []
+            _walk003(fn.effects, {}, fn, info, findings, set())
+            for line, col, msg in findings:
+                yield (fs.path, line, col, msg)

@@ -30,6 +30,18 @@ are taken in inverted order; :func:`instrument_locks` patches the
 it, and a ``flight_recorder.register_dump_extra`` hook renders every
 thread's held locks into CommWatchdog/supervisor hang dumps.
 
+The resource-leak sanitizer (graft-own's runtime half, the dynamic
+companion to OWN001/OWN002/OWN003) lives in
+``paddle_tpu/utils/resources.py`` and is RE-EXPORTED here the same
+way: :class:`ResourceLedger` mirrors every KV-block / engine-slot /
+handoff-hold acquire+release with its acquisition site,
+:meth:`~ResourceLedger.verify` asserts block conservation against a
+live ``BlockManager``, and :meth:`~ResourceLedger.leak_check` raises
+:class:`ResourceLeakError` naming where every outstanding resource
+was taken; :func:`instrument_resources` wraps the ``BlockManager``
+reference primitives so a whole process runs under it
+(``PADDLE_LEAK_SANITIZER=1`` in the 2-process serving proofs).
+
 Implementation: jax logs one "Compiling <name> with global shapes and
 types [...]" record per XLA compilation (module ``jax._src.
 interpreters.pxla``, DEBUG level unless jax_log_compiles is set). The
@@ -52,20 +64,27 @@ __all__ = ["CompileEvent", "RecompileError", "RecompileGuard",
            "recompile_guard", "CollectiveScheduleMismatch",
            "collective_contract", "COMPILE_LOGGERS", "COMPILING_RE",
            "LockOrderViolation", "TracedLock", "instrument_locks",
-           "uninstrument_locks"]
+           "uninstrument_locks", "ResourceLeakError", "ResourceLedger",
+           "instrument_resources", "uninstrument_resources"]
 
 _LOCK_SANITIZER_API = ("LockOrderViolation", "TracedLock",
                        "instrument_locks", "uninstrument_locks")
+_LEAK_SANITIZER_API = ("ResourceLeakError", "ResourceLedger",
+                       "instrument_resources", "uninstrument_resources")
 
 
 def __getattr__(name: str):
-    # the lock sanitizer lives in utils/locks.py (stdlib-only, usable
+    # the runtime sanitizers live in utils/ (stdlib-only, usable
     # without the analysis package); re-exported lazily so importing
     # the analyzer never drags paddle_tpu.utils in, and vice versa
     if name in _LOCK_SANITIZER_API:
         from ..utils import locks as _locks
 
         return getattr(_locks, name)
+    if name in _LEAK_SANITIZER_API:
+        from ..utils import resources as _resources
+
+        return getattr(_resources, name)
     raise AttributeError(name)
 
 

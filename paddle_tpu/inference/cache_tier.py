@@ -35,6 +35,7 @@ from typing import Dict, List, MutableMapping, Optional, Tuple
 import numpy as np
 
 from ..testing import chaos as _chaos
+from ..utils import resources as _res
 
 __all__ = ["HostTier"]
 
@@ -134,6 +135,7 @@ class HostTier:
         self.hits = 0
         self.corrupt_rejected = 0
         self.evictions = 0
+        self._graft_ledger = _res.current()
 
     # -- write path ------------------------------------------------------
     def put(self, ns: Optional[str], tokens, pages, scales, meta) -> bool:
@@ -162,6 +164,8 @@ class HostTier:
         self._data[key] = bytes(frame)
         self._index[key] = len(frame)
         self._bytes += len(frame)
+        if self._graft_ledger is not None:
+            self._graft_ledger.acquire("host.frame", key)
         self._evict_to_capacity()
         return True
 
@@ -172,6 +176,8 @@ class HostTier:
             key, size = self._index.popitem(last=False)  # LRU first
             self._bytes -= size
             self.evictions += 1
+            if self._graft_ledger is not None:
+                self._graft_ledger.release("host.frame", key)
             try:
                 del self._data[key]
             except KeyError:
@@ -181,6 +187,8 @@ class HostTier:
         size = self._index.pop(key, None)
         if size is not None:
             self._bytes -= size
+            if self._graft_ledger is not None:
+                self._graft_ledger.release("host.frame", key)
         try:
             del self._data[key]
         except KeyError:

@@ -89,6 +89,7 @@ from ..distributed.communication import flight_recorder as _fr
 from ..distributed.store import CorruptBlobError
 from ..ops.paged_attention import BlockImportError
 from ..testing import chaos as _chaos
+from ..utils import resources as _res
 from ..utils.retries import Deadline, RetryPolicy
 from .cluster import make_record, remaining_budget, result_record
 from .serving import EngineFenced, GenRequest
@@ -546,6 +547,7 @@ class PrefillWorker:
         # posted transfers awaiting the receiver's verdict:
         # req_id -> {req, payload, sender, seq, dl, resends}
         self._outstanding: Dict[object, dict] = {}
+        self._graft_ledger = _res.current()
         self.export_retry = RetryPolicy(
             max_attempts=3, base_delay=0.02, max_delay=0.5,
             transient=_handoff_transient)
@@ -673,6 +675,15 @@ class PrefillWorker:
         self._outstanding[req.req_id] = {
             "req": req, "payload": payload, "sender": sender,
             "seq": seq, "dl": dl, "resends": 0}
+        if self._graft_ledger is not None:
+            self._graft_ledger.acquire("handoff.part", req.req_id)
+
+    def _drop_outstanding(self, rid) -> None:
+        """Every settle path funnels through here so the leak ledger's
+        ``handoff.part`` entry can never outlive the tracking dict."""
+        del self._outstanding[rid]
+        if self._graft_ledger is not None:
+            self._graft_ledger.release("handoff.part", rid)
 
     def _check_acks(self) -> None:
         """Settle posted transfers: ok → journal "transferred" + tell
@@ -686,21 +697,21 @@ class PrefillWorker:
             except (OSError, ValueError) as e:
                 verdict = None
                 if st["dl"].expired():
-                    del self._outstanding[rid]
+                    self._drop_outstanding(rid)
                     self._down_until[channel] = (
                         time.monotonic() + self.handoff_budget)
                     self._fail(st["req"],
                                f"ack: {type(e).__name__}: {e}")
                     continue
             if verdict == "ok":
-                del self._outstanding[rid]
+                self._drop_outstanding(rid)
                 self._down_until.pop(channel, None)
                 self.supervisor.mark_transferred(st["req"])
                 self._markers.append(result_record(
                     rid, "transferred", target=channel))
             elif verdict is None:
                 if st["dl"].expired():
-                    del self._outstanding[rid]
+                    self._drop_outstanding(rid)
                     self._down_until[channel] = (
                         time.monotonic() + self.handoff_budget)
                     self._fail(st["req"], "ack wait exceeded the "
@@ -710,7 +721,7 @@ class PrefillWorker:
                 st["resends"] += 1
                 if (st["resends"] > st["sender"].max_resends
                         or st["dl"].expired()):
-                    del self._outstanding[rid]
+                    self._drop_outstanding(rid)
                     self._fail(st["req"], f"nacked {st['resends']}x: "
                                           f"{verdict}")
                     continue
@@ -718,7 +729,7 @@ class PrefillWorker:
                     st["seq"] = st["sender"].send_handoff(
                         st["payload"], deadline=st["dl"])
                 except (OSError, ValueError, TimeoutError) as e:
-                    del self._outstanding[rid]
+                    self._drop_outstanding(rid)
                     self._fail(st["req"],
                                f"resend: {type(e).__name__}: {e}")
 

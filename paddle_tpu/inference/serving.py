@@ -157,6 +157,7 @@ from ..ops.paged_attention import (
     PrefixCache,
 )
 from ..testing import chaos as _chaos
+from ..utils import resources as _res
 from ..utils.retries import Deadline
 from .admission import (
     AdmissionConfig,
@@ -585,6 +586,9 @@ class ContinuousBatchingEngine:
                 f"max_position_embeddings ({limit})")
         self.eos_token_id = eos_token_id
         self.manager = BlockManager(num_blocks, block_size)
+        # leak-sanitizer stamp (graft-own): None when off — the slot/
+        # handoff accounting hooks gate on one attribute load
+        self._graft_ledger = _res.current()
         self.prefix_cache = (PrefixCache(block_size, manager=self.manager)
                              if prefix_cache else None)
         if cache_tier is not None and self.prefix_cache is None:
@@ -1437,6 +1441,9 @@ class ContinuousBatchingEngine:
                 self.manager.free_sequence(slot.req.req_id)
                 self._tables[slot_idx] = self._trash
                 self._expire(slot.req)
+                if self._graft_ledger is not None:
+                    self._graft_ledger.release(
+                        "engine.slot", slot.req.req_id)
                 slot.req = None
                 slot.pending_first = False
                 self._mark_dirty(slot_idx)
@@ -1447,6 +1454,8 @@ class ContinuousBatchingEngine:
                     if q.expired()]:
             req = self._handoff_ready.pop(rid)
             self.manager.free_sequence(rid)
+            if self._graft_ledger is not None:
+                self._graft_ledger.release("handoff.hold", rid)
             self._expire(req)
 
     @property
@@ -1619,7 +1628,10 @@ class ContinuousBatchingEngine:
         need = (self._blocks_needed(req, eff_new) - len(cached_blocks)
                 + (1 if will_fork else 0))
         if cached_blocks:
-            self.manager.adopt(req.req_id, cached_blocks)
+            # the `need > free_blocks` bail-out below undoes this adopt
+            # under the SAME `cached_blocks` guard (path-correlated
+            # conditions the analyzer cannot relate)
+            self.manager.adopt(req.req_id, cached_blocks)  # graft-lint: disable=OWN001
         if need > self.manager.free_blocks and self.prefix_cache is not None:
             self.prefix_cache.evict(need - self.manager.free_blocks)
         if need > self.manager.free_blocks:
@@ -1709,6 +1721,8 @@ class ContinuousBatchingEngine:
                 req.req_id, self.max_blocks_per_seq, fill=self._trash)
             self._tables[slot_idx] = row
             slot.req = req
+            if self._graft_ledger is not None:
+                self._graft_ledger.acquire("engine.slot", req.req_id)
             slot.remaining = req.max_new_tokens
             slot.pending_first = False
             self._mark_dirty(slot_idx)
@@ -1780,6 +1794,8 @@ class ContinuousBatchingEngine:
             self._finish_req_spans(req, tokens=len(req.out))
             self._completed[req.req_id] = req
             slot.req = None
+            if self._graft_ledger is not None:
+                self._graft_ledger.release("engine.slot", req.req_id)
             slot.pending_first = False
             self._mark_dirty(slot_idx)
         return done
@@ -1878,6 +1894,10 @@ class ContinuousBatchingEngine:
         self._handoff_ready[req.req_id] = req
         self._tables[slot_idx] = self._trash
         slot.req = None
+        if self._graft_ledger is not None:
+            # the slot frees; the HOLD on the exported blocks begins
+            self._graft_ledger.release("engine.slot", req.req_id)
+            self._graft_ledger.acquire("handoff.hold", req.req_id)
         slot.pending_first = False
         self._mark_dirty(slot_idx)
 
@@ -1907,7 +1927,10 @@ class ContinuousBatchingEngine:
         if kv_len is None:
             kv_len = (len(self.manager.owned_blocks(req_id))
                       * self.block_size)
-        pages, scales, meta = self.manager.export_blocks(
+        # read-only gather: the handoff hold is keyed by the CALLER's
+        # req_id and handed back by the return — the caller settles it
+        # via release_handoff on every path (see _begin_handoff)
+        pages, scales, meta = self.manager.export_blocks(  # graft-lint: disable=OWN001
             req_id, self._pools, num_tokens=int(kv_len))
         meta["kv_len"] = int(min(
             int(kv_len), meta["num_blocks"] * self.block_size))
@@ -1918,6 +1941,8 @@ class ContinuousBatchingEngine:
         or the caller is abandoning the handoff): blocks recycle via
         the ref-counted free — prefix-cache pins survive."""
         self.manager.free_sequence(req_id)
+        if self._graft_ledger is not None:
+            self._graft_ledger.release("handoff.hold", req_id)
         self.n_handed_off += 1
 
     def import_kv(self, req: GenRequest, first_token: int,
@@ -1981,6 +2006,8 @@ class ContinuousBatchingEngine:
         if not req.t_submit:
             req.t_submit = time.perf_counter()
         slot.req = req
+        if self._graft_ledger is not None:
+            self._graft_ledger.acquire("engine.slot", req.req_id)
         slot.prefill_pos = psize
         slot.cache_len = psize
         slot.remaining = req.max_new_tokens

@@ -238,6 +238,21 @@ class BlockManager:
         """The sequence's current logical block list (post-fork ids)."""
         return list(self._owned.get(seq_id, []))
 
+    def accounting(self) -> dict:
+        """Conservation snapshot for the leak sanitizer (graft-own):
+        ``{"total", "free", "refs": {block: live refs},
+        "owned": {seq_id: [blocks]}}``. The pool invariant is
+        ``free + len(refs) == total`` — every physical block is either
+        on the free list or live-referenced, never both, never
+        neither."""
+        return {
+            "total": int(self.num_blocks),
+            "free": len(self._free),
+            "refs": {int(b): int(c) for b, c in self._refs.items()},
+            "owned": {k: [int(b) for b in v]
+                      for k, v in self._owned.items()},
+        }
+
     def table_row(self, seq_id, max_blocks_per_seq: int,
                   fill: int = 0) -> np.ndarray:
         """The sequence's block-table row, padded with ``fill`` (the

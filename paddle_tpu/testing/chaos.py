@@ -90,6 +90,12 @@ Instrumented sites (grep for ``chaos.inject``):
   (inference/cache_tier.py); a byte site — ``corrupt`` flips a
   payload bit (the CRC check rejects the frame at lookup: a cache
   miss, never a wrong-token serve), ``drop`` loses the spill
+- ``leak.hold``          — each ``ResourceLedger`` release
+  (utils/resources.py, only when the leak sanitizer is active); a
+  ``drop`` DEFERS that accounting decrement — the underlying
+  release still happens, but the ledger now shows an outstanding
+  resource that ``leak_check()`` must catch: the sanitizer proving
+  it would catch a real missed release
 
 Faults (``Fault.kind``): ``hang``/``slow`` (sleep ``arg`` seconds;
 ``hang`` requires a positive arg), ``reset`` (raise

@@ -41,6 +41,11 @@ env:
   PADDLE_LOCK_SANITIZER — non-empty: run under the graft-race lockdep
                         sanitizer (utils/locks.py) and assert zero
                         lock-order violations on clean exit
+  PADDLE_LEAK_SANITIZER — non-empty: run under the graft-own resource
+                        ledger (utils/resources.py); on clean exit
+                        leak_check() must find ZERO outstanding KV
+                        blocks / slots / handoff holds — a leak names
+                        its acquisition site and fails the worker
 """
 import json
 import os
@@ -71,6 +76,14 @@ def main():
     if sanitize:
         from paddle_tpu.utils.locks import instrument_locks, violation_count
         instrument_locks()
+    # graft-own slow lane: PADDLE_LEAK_SANITIZER=1 mirrors every
+    # BlockManager acquire/release (and the slot/handoff lifecycle)
+    # in a ResourceLedger; instrument BEFORE the factory so the
+    # engine's manager is built already wrapped
+    leak_sanitize = bool(os.environ.get("PADDLE_LEAK_SANITIZER"))
+    if leak_sanitize:
+        from paddle_tpu.utils import resources as _res
+        _res.instrument_resources()
     paddle.seed(0)
     role = os.environ["DISAGG_ROLE"]
     max_len = int(os.environ.get("DISAGG_MAX_LEN", "32"))
@@ -139,6 +152,14 @@ def main():
         n = violation_count()
         assert n == 0, f"lock sanitizer recorded {n} violation(s)"
         print("lock-sanitizer: clean", flush=True)
+    if leak_sanitize:
+        eng = worker.supervisor.engine
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.clear()
+        led = _res.current()
+        led.verify(eng.manager)   # free + referenced == pool total
+        led.leak_check()          # raises naming acquisition sites
+        print("leak-sanitizer: clean", flush=True)
 
 
 if __name__ == "__main__":

@@ -18,6 +18,12 @@ env:
   PADDLE_LOCK_SANITIZER — non-empty: run under the graft-race lockdep
                         sanitizer (utils/locks.py) and assert zero
                         lock-order violations on clean exit
+  PADDLE_LEAK_SANITIZER — non-empty: run under the graft-own resource
+                        ledger (utils/resources.py); on clean exit the
+                        prefix cache is dropped and leak_check() must
+                        find ZERO outstanding KV blocks / slots — a
+                        leaked block names its acquisition site and
+                        fails the worker with a nonzero exit
 """
 import os
 
@@ -43,6 +49,14 @@ def main():
     if sanitize:
         from paddle_tpu.utils.locks import instrument_locks, violation_count
         instrument_locks()
+    # graft-own slow lane: PADDLE_LEAK_SANITIZER=1 mirrors every
+    # BlockManager acquire/release (and the engine's slot/handoff
+    # lifecycle) in a ResourceLedger; instrument BEFORE the factory so
+    # the engine's manager is built already wrapped
+    leak_sanitize = bool(os.environ.get("PADDLE_LEAK_SANITIZER"))
+    if leak_sanitize:
+        from paddle_tpu.utils import resources as _res
+        _res.instrument_resources()
     paddle.seed(0)
     # name this process's track so stitched fleet traces and published
     # metrics snapshots are attributable to the replica, not a bare pid
@@ -66,6 +80,16 @@ def main():
         n = violation_count()
         assert n == 0, f"lock sanitizer recorded {n} violation(s)"
         print("lock-sanitizer: clean", flush=True)
+    if leak_sanitize:
+        # prefix-cache pins are process-lifetime by design; drop them
+        # so a clean exit means literally zero outstanding resources
+        eng = server.supervisor.engine
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.clear()
+        led = _res.current()
+        led.verify(eng.manager)   # free + referenced == pool total
+        led.leak_check()          # raises naming acquisition sites
+        print("leak-sanitizer: clean", flush=True)
 
 
 if __name__ == "__main__":

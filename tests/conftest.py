@@ -156,6 +156,14 @@ def pytest_configure(config):
         "SIGKILL zero-loss, WFQ/token-bucket tenant isolation, and "
         "the host-RAM prefix-cache tier (quick-lane; standalone via "
         "`pytest -m autoscale`)")
+    config.addinivalue_line(
+        "markers",
+        "own: graft-own lane — OWN001/OWN002/OWN003 resource-lifecycle "
+        "static-rule fixtures, the ResourceLedger leak-sanitizer units "
+        "(conservation vs a live BlockManager, leak naming, leak.hold "
+        "chaos), the static+runtime double proof on one seeded leak, "
+        "and the CLI gate (quick-lane; the ledger-overhead A/B rides "
+        "the slow lane; standalone via `pytest -m own`)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -357,6 +357,10 @@ class TestProcessClusterKill:
                     # sanitizer — an inverted lock order in the serve
                     # loop fails the worker, and this test with it
                     "PADDLE_LOCK_SANITIZER": "1",
+                    # graft-own: and under the resource ledger — the
+                    # survivor's clean exit proves zero outstanding
+                    # KV blocks/slots after serving the whole backlog
+                    "PADDLE_LEAK_SANITIZER": "1",
                     "JAX_PLATFORMS": "cpu",
                     "PYTHONPATH": REPO + os.pathsep
                     + os.environ.get("PYTHONPATH", ""),
@@ -419,6 +423,14 @@ class TestProcessClusterKill:
             # prefix cache across a real process boundary
             assert router.prefix_hit_rate() > 0, router.health()
             router.stop(deadline=20.0)
+            # the survivor must exit THROUGH the resource ledger's
+            # leak_check: a leaked block would raise in-process (naming
+            # its acquisition site) and show here as a nonzero exit
+            procs[1].wait(timeout=60)
+            assert procs[1].returncode == 0, (
+                (tmp_path / "r1.log").read_text()[-2000:])
+            assert "leak-sanitizer: clean" in (
+                tmp_path / "r1.log").read_text()
         finally:
             for p in procs:
                 if p.poll() is None:

@@ -844,8 +844,12 @@ class TestHangDumpNamesBothRoles:
         summary = summarize_source(src, "fixture.py")
         effects = {f.name: [type(e).__name__ for e in f.effects]
                    for f in summary.functions}
-        assert effects["pf"] == ["P2PEffect"]
-        assert effects["dx"] == ["P2PEffect"]
+        # membership, not exact lists: graft-own's ReturnEffect leaves
+        # ride alongside (the result of each leg is returned here)
+        assert "P2PEffect" in effects["pf"]
+        assert "P2PEffect" in effects["dx"]
+        assert "CollEffect" not in effects["pf"]
+        assert "CollEffect" not in effects["dx"]
 
 
 # ---------------------------------------------------------------------------
@@ -890,6 +894,11 @@ class TestProcessDisaggKill:
                     # sanitizer — an inverted lock order anywhere in
                     # prefill/decode fails the worker, and the test
                     "PADDLE_LOCK_SANITIZER": "1",
+                    # graft-own: and under the resource ledger — the
+                    # surviving decode worker's clean exit proves zero
+                    # outstanding blocks/slots/holds after the partial
+                    # transfer was discarded and fallback served all
+                    "PADDLE_LEAK_SANITIZER": "1",
                     "JAX_PLATFORMS": "cpu",
                     "PYTHONPATH": REPO + os.pathsep
                     + os.environ.get("PYTHONPATH", ""),
@@ -955,6 +964,15 @@ class TestProcessDisaggKill:
             ev = [e for e in router.events if e[0] == "prefill-dead"]
             assert len(ev) == 1 and ev[0][1] == "pf0"
             router.stop(deadline=20.0)
+            # the decode worker must exit THROUGH the resource ledger's
+            # leak_check: a leaked block/slot/hold would raise
+            # in-process (naming its acquisition site) and show here
+            # as a nonzero exit
+            procs[1].wait(timeout=60)
+            assert procs[1].returncode == 0, (
+                (tmp_path / "dx0.log").read_text()[-2000:])
+            assert "leak-sanitizer: clean" in (
+                tmp_path / "dx0.log").read_text()
         finally:
             for p in procs:
                 if p.poll() is None:
